@@ -1,0 +1,78 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+Capability class of PaddlePaddle (reference snapshot surveyed in SURVEY.md),
+re-designed for TPU: jax.Array storage, XLA compilation, pjit/shard_map
+distribution over device meshes, and Pallas kernels for fused ops. The public
+API mirrors `paddle.*` (reference: python/paddle/__init__.py) so reference
+users can migrate; the implementation shares nothing with the reference's
+CUDA/C++ architecture.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core.dtype import (  # noqa: F401
+    float16, bfloat16, float32, float64, int8, int16, int32, int64,
+    uint8, uint16, uint32, uint64, bool_, complex64, complex128,
+    float8_e4m3fn, float8_e5m2,
+    set_default_dtype, get_default_dtype, convert_dtype,
+)
+from .core.tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from .core.autograd import no_grad, enable_grad, grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .core.random import seed  # noqa: F401
+from .core import ops as _ops
+from .core.ops import linalg, fft  # noqa: F401
+
+# Re-export the whole op surface at top level, paddle-style.
+_OP_EXPORTS = [
+    n for n in dir(_ops)
+    if not n.startswith("_") and callable(getattr(_ops, n))
+    and n not in ("Tensor", "apply_op", "to_tensor", "partial", "lax", "convert_dtype",
+                  "get_default_dtype", "linalg", "fft")
+]
+for _n in _OP_EXPORTS:
+    globals()[_n] = getattr(_ops, _n)
+del _n
+
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import framework  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from .framework.io import save, load  # noqa: F401,E402
+from .tensor import tensor as _tensor_ns  # noqa: F401,E402
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    import jax
+    try:
+        return any(d.platform in ("tpu", "axon") for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def in_dynamic_mode() -> bool:
+    from .jit.api import _in_jit_trace
+    return not _in_jit_trace()
+
+
+def set_device(device: str):
+    from .device import set_device as _sd
+    return _sd(device)
+
+
+def get_device() -> str:
+    from .device import get_device as _gd
+    return _gd()

@@ -1,0 +1,35 @@
+"""Automatic mixed precision (reference: python/paddle/amp/ — auto_cast at
+auto_cast.py:296, GradScaler at grad_scaler.py; C++ hooks in
+eager_amp_auto_cast.h).
+
+TPU-native stance: bf16 is the native matmul dtype, so AMP here is a dtype
+*policy* rather than a per-op rewrite pass. `auto_cast` installs a policy the
+eager op layer consults for MXU-bound ops (matmul/conv); O2 additionally casts
+parameters. GradScaler keeps the reference API; on bf16 loss scaling is
+mathematically unnecessary (8-bit exponent), so with bf16 it is a transparent
+pass-through unless the user forces fp16 semantics.
+"""
+from .auto_cast import auto_cast, amp_guard, get_amp_state, white_list  # noqa: F401
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+from ..core.tensor import _install_amp_hook
+_install_amp_hook()
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler", "decorate"]
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Reference: paddle.amp.decorate — O2 casts model params to the low
+    dtype (master weights kept fp32 inside optimizer states, which our
+    optimizers already do by keeping fp32 moments and computing in fp32)."""
+    from ..nn.layer import Layer
+    if level == "O2":
+        single = isinstance(models, Layer)
+        mlist = [models] if single else list(models)
+        for m in mlist:
+            m.to(dtype=dtype)
+        models = mlist[0] if single else mlist
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -1,0 +1,70 @@
+"""auto_cast context (reference: python/paddle/amp/auto_cast.py:296 amp_guard,
+fp16_lists.py white/black lists)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from ..core.dtype import convert_dtype
+
+# Ops that should run in low precision (MXU-bound) — analog of the reference
+# white list (amp/fp16_lists.py): matmul/conv/attention.
+white_list = {"matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+              "conv2d_transpose", "einsum", "sdpa", "flash_attention", "addmm"}
+# Ops that must stay fp32 (reductions / losses / norms / exp-like).
+black_list = {"softmax", "log_softmax", "cross_entropy", "layer_norm", "batch_norm",
+              "group_norm", "instance_norm", "rms_norm", "sum", "mean", "logsumexp",
+              "exp", "log", "pow", "norm", "mse_loss", "bce", "bce_with_logits",
+              "nll_loss", "kl_div", "cosine_similarity"}
+
+_state = threading.local()
+
+
+class _AmpState:
+    __slots__ = ("enabled", "dtype", "level", "custom_white", "custom_black")
+
+    def __init__(self, enabled=False, dtype=None, level="O1",
+                 custom_white=(), custom_black=()):
+        self.enabled = enabled
+        self.dtype = dtype
+        self.level = level
+        self.custom_white = set(custom_white or ())
+        self.custom_black = set(custom_black or ())
+
+
+def get_amp_state() -> _AmpState:
+    st = getattr(_state, "amp", None)
+    return st if st is not None else _AmpState()
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = getattr(_state, "amp", None)
+    _state.amp = _AmpState(enable, convert_dtype(dtype), level,
+                           custom_white_list, custom_black_list)
+    try:
+        yield
+    finally:
+        _state.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def amp_cast_inputs(op_name, arrays):
+    """Called from the eager op path: cast inputs per active policy."""
+    import jax.numpy as jnp
+    st = get_amp_state()
+    if not st.enabled:
+        return arrays
+    wl = (white_list | st.custom_white) - st.custom_black
+    bl = (black_list | st.custom_black) - st.custom_white
+    low = st.dtype
+    if op_name in wl or (st.level == "O2" and op_name not in bl):
+        return [a.astype(low) if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+                and a.dtype != jnp.float64 else a for a in arrays]
+    if op_name in bl:
+        return [a.astype(jnp.float32) if hasattr(a, "dtype") and a.dtype in (jnp.bfloat16, jnp.float16)
+                else a for a in arrays]
+    return arrays

@@ -1,0 +1,87 @@
+"""paddle.autograd namespace: PyLayer + backward/grad.
+
+Reference: python/paddle/autograd/py_layer.py:29 (PyLayer),
+backward_mode.py (paddle.autograd.backward). PyLayer here records a custom
+forward/backward pair onto the same eager tape core.autograd uses, so user
+custom ops compose with builtin ops.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.autograd import backward, grad, no_grad, enable_grad, Node, is_grad_enabled  # noqa: F401
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    """Mirrors reference PyLayerContext (py_layer.py:60): save_for_backward /
+    saved_tensor plus arbitrary attribute stashing."""
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *a, **k):
+        raise RuntimeError("PyLayer is not instantiable; call .apply()")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined differentiable function (reference py_layer.py:29).
+
+    class Exp(PyLayer):
+        @staticmethod
+        def forward(ctx, x): ...
+        @staticmethod
+        def backward(ctx, dy): ...
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        in_tensors = [a for a in args if isinstance(a, Tensor)]
+        record = is_grad_enabled() and any(
+            (not t.stop_gradient or t._node is not None) for t in in_tensors)
+
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+        out_list = [o if isinstance(o, Tensor) else Tensor(jnp.asarray(o)) for o in out_list]
+
+        if record:
+            avals = [type("A", (), {"shape": o._data.shape, "dtype": o._data.dtype})()
+                     for o in out_list]
+
+            def vjp_fn(cts):
+                ct_tensors = tuple(Tensor(c) for c in cts)
+                with no_grad():
+                    gin = cls.backward(ctx, *ct_tensors)
+                gin = gin if isinstance(gin, (tuple, list)) else (gin,)
+                if len(gin) != len(in_tensors):
+                    raise RuntimeError(
+                        f"{cls.__name__}.backward returned {len(gin)} grads for "
+                        f"{len(in_tensors)} tensor inputs")
+                return [None if g is None else (g._data if isinstance(g, Tensor) else jnp.asarray(g))
+                        for g in gin]
+
+            node = Node(cls.__name__, vjp_fn, in_tensors, avals)
+            for i, o in enumerate(out_list):
+                o._node = node
+                o._out_idx = i
+                o.stop_gradient = False
+        return tuple(out_list) if multi else out_list[0]

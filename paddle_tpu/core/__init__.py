@@ -1,0 +1,3 @@
+from . import dtype, random, autograd, tensor, ops  # noqa: F401
+from .tensor import Tensor, Parameter, to_tensor, apply_op  # noqa: F401
+from .autograd import no_grad, enable_grad, grad, backward, is_grad_enabled, set_grad_enabled  # noqa: F401

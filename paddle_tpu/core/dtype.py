@@ -1,0 +1,100 @@
+"""Dtype system.
+
+TPU-native reimagining of the reference's dtype surface
+(reference: paddle/phi/common/data_type.h — DataType enum; python/paddle
+`paddle.float32` etc.). We expose paddle-style dtype names backed directly by
+numpy/jax dtypes: there is no separate enum because JAX arrays carry numpy
+dtypes natively and XLA handles layout.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical dtype objects (numpy dtype instances).
+float16 = np.dtype("float16")
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+uint8 = np.dtype("uint8")
+uint16 = np.dtype("uint16")
+uint32 = np.dtype("uint32")
+uint64 = np.dtype("uint64")
+bool_ = np.dtype("bool")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+
+_ALIASES = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn, "float8_e5m2": float8_e5m2,
+}
+
+FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+INTEGER = {int8, int16, int32, int64, uint8, uint16, uint32, uint64}
+COMPLEX = {complex64, complex128}
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize any user-supplied dtype spec to a numpy dtype.
+
+    Accepts strings ("float32", "bf16"), numpy dtypes, jnp dtypes, python
+    types (float/int/bool), and Tensor.dtype values.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key in _ALIASES:
+            return _ALIASES[key]
+        raise ValueError(f"Unknown dtype string: {dtype!r}")
+    if dtype is float:
+        return float32
+    if dtype is int:
+        return int64
+    if dtype is bool:
+        return bool_
+    try:
+        return np.dtype(dtype)
+    except TypeError:
+        raise ValueError(f"Cannot convert {dtype!r} to a dtype") from None
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in FLOATING
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in INTEGER
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype) in COMPLEX
+
+
+_DEFAULT_DTYPE = [float32]
+
+
+def set_default_dtype(dtype):
+    """paddle.set_default_dtype analog (reference: python/paddle/framework/framework.py)."""
+    d = convert_dtype(dtype)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _DEFAULT_DTYPE[0] = d
+
+
+def get_default_dtype() -> np.dtype:
+    return _DEFAULT_DTYPE[0]

@@ -1,0 +1,1414 @@
+"""The eager op surface — paddle.tensor.* semantics lowered to jnp/lax.
+
+TPU-native replacement for the reference's op stack: where the reference
+needs a per-backend kernel matrix (paddle/phi/kernels/{cpu,gpu,...} with
+KernelKey dispatch, kernel_factory.h:62) plus YAML-generated C++ APIs
+(paddle/phi/api/yaml/ops.yaml, api_base.py:1182), a TPU framework needs only
+ONE lowering per op — to XLA HLO via jax.numpy/lax — because XLA owns
+backend specialization, fusion and layout. Shape/dtype inference (the
+reference's infermeta/) is likewise inherited from jax's abstract eval.
+
+Every function here takes/returns `Tensor` and routes through
+`tensor.apply_op`, which records the autograd tape. Functions are also
+attached as Tensor methods at import (analog of generated
+pybind eager_method.cc methods).
+"""
+from __future__ import annotations
+
+import builtins
+import math as _math
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .tensor import Tensor, apply_op, to_tensor
+from .dtype import convert_dtype, get_default_dtype
+from . import random as _random
+from . import autograd
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _nodiff(fn, *args, **kw):
+    """Run a non-differentiable op without tape recording."""
+    out = fn(*[_arr(a) for a in args], **kw)
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor(o) for o in out)
+    return Tensor(out)
+
+
+def _unary(name, fn):
+    def op(x, name=None):
+        return apply_op(name or op.__name__, fn, [x])
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"Elementwise {name} (reference: paddle.{name}; PHI kernel phi/kernels/*/{name}_kernel)."
+    return op
+
+
+def _binary(name, fn):
+    def op(x, y, name=None):
+        return apply_op(name or op.__name__, fn, [x, y])
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"Elementwise {name} with broadcasting (reference: paddle.{name})."
+    return op
+
+
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        return _nodiff(fn, x, y)
+    op.__name__ = name
+    op.__doc__ = f"Elementwise comparison {name} -> bool tensor (reference: paddle.{name})."
+    return op
+
+
+# ---------------------------------------------------------------- math: unary
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", lax.rsqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)  # noqa: A001
+sign = _unary("sign", jnp.sign)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)  # noqa: A001
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
+neg = _unary("neg", jnp.negative)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+logsigmoid = _unary("logsigmoid", jax.nn.log_sigmoid)
+erf = _unary("erf", lax.erf)
+erfinv = _unary("erfinv", lax.erf_inv)
+lgamma = _unary("lgamma", lax.lgamma)
+digamma = _unary("digamma", lax.digamma)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+
+isnan = lambda x, name=None: _nodiff(jnp.isnan, x)
+isinf = lambda x, name=None: _nodiff(jnp.isinf, x)
+isfinite = lambda x, name=None: _nodiff(jnp.isfinite, x)
+
+# --------------------------------------------------------------- math: binary
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.mod)
+remainder = mod
+pow = _binary("pow", jnp.power)  # noqa: A001
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+hypot = _binary("hypot", jnp.hypot)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+heaviside = _binary("heaviside", jnp.heaviside)
+nextafter = _binary("nextafter", jnp.nextafter)
+copysign = _binary("copysign", jnp.copysign)
+inner = _binary("inner", jnp.inner)
+outer = _binary("outer", lambda a, b: jnp.outer(a, b))
+kron = _binary("kron", jnp.kron)
+cross = _binary("cross", jnp.cross)
+dot = _binary("dot", jnp.dot)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """Reference: paddle.scale (phi/kernels/*/scale_kernel)."""
+    def fn(a):
+        out = a * scale + bias if bias_after_scale else (a + bias) * scale
+        return out
+    return apply_op("scale", fn, [x])
+
+
+def multiplex(inputs, index, name=None):
+    arrs = [_arr(i) for i in inputs]
+    idx = _arr(index).reshape(-1)
+    def fn(*xs):
+        stacked = jnp.stack(xs, axis=0)
+        return stacked[idx, jnp.arange(stacked.shape[1])]
+    return apply_op("multiplex", fn, list(inputs))
+
+
+# ---------------------------------------------------------------- reductions
+def _reduce(name, fn):
+    def op(x, axis=None, keepdim=False, name=None):
+        if isinstance(axis, (list, tuple)):
+            axis = tuple(axis)
+        return apply_op(name, lambda a: fn(a, axis=axis, keepdims=keepdim), [x])
+    op.__name__ = name
+    op.__doc__ = f"Reduction {name} (reference: paddle.{name}; phi/kernels reduce)."
+    return op
+
+
+sum = _reduce("sum", jnp.sum)  # noqa: A001
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+max = _reduce("max", jnp.max)  # noqa: A001
+min = _reduce("min", jnp.min)  # noqa: A001
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+nanmean = _reduce("nanmean", jnp.nanmean)
+nansum = _reduce("nansum", jnp.nansum)
+logsumexp = _reduce("logsumexp", jax.scipy.special.logsumexp)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return apply_op("std", lambda a: jnp.std(a, axis=axis, ddof=ddof, keepdims=keepdim), [x])
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return apply_op("var", lambda a: jnp.var(a, axis=axis, ddof=ddof, keepdims=keepdim), [x])
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply_op("median", lambda a: jnp.median(a, axis=axis, keepdims=keepdim), [x])
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op("quantile", lambda a: jnp.quantile(a, q, axis=axis, keepdims=keepdim), [x])
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return _nodiff(lambda a: jnp.all(a, axis=axis, keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return _nodiff(lambda a: jnp.any(a, axis=axis, keepdims=keepdim), x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+    return _nodiff(lambda a: jnp.argmax(a, axis=axis, keepdims=keepdim).astype(dt), x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+    return _nodiff(lambda a: jnp.argmin(a, axis=axis, keepdims=keepdim).astype(dt), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return _nodiff(lambda a: jnp.count_nonzero(a, axis=axis, keepdims=keepdim), x)
+
+
+# --------------------------------------------------------------- scans
+def cumsum(x, axis=None, dtype=None, name=None):
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=convert_dtype(dtype))
+        return jnp.cumsum(a, axis=axis, dtype=convert_dtype(dtype))
+    return apply_op("cumsum", fn, [x])
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def fn(a):
+        if dim is None:
+            a = a.reshape(-1)
+            return jnp.cumprod(a, dtype=convert_dtype(dtype))
+        return jnp.cumprod(a, axis=dim, dtype=convert_dtype(dtype))
+    return apply_op("cumprod", fn, [x])
+
+
+def _cum_extreme(name, better):
+    """cummax/cummin (reference: paddle.cummax returning (values, indices)).
+
+    Pairwise associative scan carrying (value, index) so the whole op stays a
+    single XLA scan — no serial loop."""
+    def op(x, axis=None, dtype="int64", name_=None):
+        ax = 0 if axis is None else axis
+
+        def fn(a):
+            a2 = a.reshape(-1) if axis is None else a
+            ax_ = ax % a2.ndim
+            n = a2.shape[ax_]
+            iota_shape = [1] * a2.ndim
+            iota_shape[ax_] = n
+            idx0 = jnp.broadcast_to(
+                jnp.arange(n).reshape(iota_shape), a2.shape)
+
+            def comb(l, r):
+                lv, li = l
+                rv, ri = r
+                take_r = better(rv, lv)
+                return jnp.where(take_r, rv, lv), jnp.where(take_r, ri, li)
+
+            vals, idxs = lax.associative_scan(comb, (a2, idx0), axis=ax_)
+            return vals, idxs.astype(convert_dtype(dtype))
+
+        vals, idxs = apply_op(name, fn, [x], n_outputs=2)
+        idxs.stop_gradient = True
+        return vals, idxs
+    op.__name__ = name
+    return op
+
+
+cummax = _cum_extreme("cummax", lambda r, l: r > l)
+cummin = _cum_extreme("cummin", lambda r, l: r < l)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    ax = 0 if axis is None else axis
+
+    def fn(a):
+        a2 = a.reshape(-1) if axis is None else a
+        return lax.associative_scan(jnp.logaddexp, a2, axis=ax)
+    return apply_op("logcumsumexp", fn, [x])
+
+
+# ------------------------------------------------------------- linear algebra
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """Reference: paddle.matmul (phi/kernels/*/matmul_kernel, MatmulInferMeta
+    phi/infermeta/binary.cc). On TPU this maps straight onto the MXU; we set
+    preferred_element_type to float32 for low-precision inputs so accumulation
+    stays fp32 (the MXU-native contract)."""
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        if a.dtype in (jnp.bfloat16, jnp.float16) and a.dtype == b.dtype:
+            # fp32 accumulation on the MXU, output stays in the input dtype
+            return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+        return jnp.matmul(a, b)
+    return apply_op("matmul", fn, [x, y])
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    return apply_op("mv", jnp.matmul, [x, vec])
+
+
+def t(x, name=None):
+    def fn(a):
+        if a.ndim < 2:
+            return a
+        return jnp.swapaxes(a, -1, -2)
+    return apply_op("t", fn, [x])
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op("addmm", lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), [input, x, y])
+
+
+def einsum(equation, *operands, name=None):
+    """Reference: paddle.einsum (python/paddle/tensor/einsum.py)."""
+    return apply_op("einsum", lambda *xs: jnp.einsum(equation, *xs), list(operands))
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def fn(a):
+        if p == "fro" and axis is None:
+            return jnp.sqrt(jnp.sum(a * a))
+        ord_ = p if p != "fro" else "fro"
+        if axis is None:
+            return jnp.linalg.norm(a.reshape(-1), ord=ord_ if ord_ != "fro" else None, keepdims=keepdim)
+        if isinstance(axis, (list, tuple)):
+            return jnp.linalg.norm(a, ord=ord_, axis=tuple(axis), keepdims=keepdim)
+        return jnp.linalg.norm(a, ord=ord_, axis=axis, keepdims=keepdim)
+    return apply_op("norm", fn, [x])
+
+
+def dist(x, y, p=2.0, name=None):
+    return apply_op("dist", lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), [x, y])
+
+
+def matrix_power(x, n, name=None):
+    return apply_op("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), [x])
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def fn(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(a), k=offset)
+                out = out + (1 - mask) * padding_value
+            return out
+        return jnp.diagonal(a, offset=offset)
+    return apply_op("diag", fn, [x])
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("diagonal", lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), [x])
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), [x])
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op("tril", lambda a: jnp.tril(a, k=diagonal), [x])
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op("triu", lambda a: jnp.triu(a, k=diagonal), [x])
+
+
+# ------------------------------------------------------------- manipulation
+def reshape(x, shape, name=None):
+    shape = [int(s) for s in shape]
+    return apply_op("reshape", lambda a: jnp.reshape(a, shape), [x])
+
+
+def reshape_(x, shape, name=None):
+    return x._replace(reshape(x, shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = list(a.shape[:s]) + [-1] + list(a.shape[e + 1:])
+        return jnp.reshape(a, new_shape)
+    return apply_op("flatten", fn, [x])
+
+
+def transpose(x, perm, name=None):
+    return apply_op("transpose", lambda a: jnp.transpose(a, axes=perm), [x])
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), [x])
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), [x])
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+        ax = tuple(a_ for a_ in ax if a.shape[a_] == 1)
+        return jnp.squeeze(a, axis=ax) if ax else a
+    return apply_op("squeeze", fn, [x])
+
+
+def unsqueeze(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply_op("unsqueeze", lambda a: jnp.expand_dims(a, ax), [x])
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    return apply_op("concat", lambda *xs: jnp.concatenate(xs, axis=int(axis)), tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply_op("stack", lambda *xs: jnp.stack(xs, axis=axis), tensors)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or x.shape[axis]
+    outs = apply_op("unstack", lambda a: tuple(jnp.moveaxis(a, axis, 0)[i] for i in builtins.range(n)),
+                    [x], n_outputs=n)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    def fn(a):
+        ax = int(axis)
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=ax))
+        sections = list(num_or_sections)
+        total = a.shape[ax]
+        known = builtins.sum(s for s in sections if s != -1)
+        sections = [s if s != -1 else total - known for s in sections]
+        idx = np.cumsum(sections)[:-1].tolist()
+        return tuple(jnp.split(a, idx, axis=ax))
+    n = num_or_sections if isinstance(num_or_sections, int) else len(num_or_sections)
+    outs = apply_op("split", fn, [x], n_outputs=n)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def tile(x, repeat_times, name=None):
+    return apply_op("tile", lambda a: jnp.tile(a, repeat_times), [x])
+
+
+def expand(x, shape, name=None):
+    def fn(a):
+        tgt = [a.shape[i - (len(shape) - a.ndim)] if s == -1 else s for i, s in enumerate(shape)]
+        return jnp.broadcast_to(a, tgt)
+    return apply_op("expand", fn, [x])
+
+
+def expand_as(x, y, name=None):
+    return apply_op("expand_as", lambda a, b: jnp.broadcast_to(a, b.shape), [x, y])
+
+
+def broadcast_to(x, shape, name=None):
+    return apply_op("broadcast_to", lambda a: jnp.broadcast_to(a, shape), [x])
+
+
+def broadcast_tensors(inputs, name=None):
+    outs = apply_op("broadcast_tensors", lambda *xs: tuple(jnp.broadcast_arrays(*xs)),
+                    list(inputs), n_outputs=len(inputs))
+    return list(outs)
+
+
+def flip(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_op("flip", lambda a: jnp.flip(a, axis=tuple(ax)), [x])
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op("roll", lambda a: jnp.roll(a, shifts, axis=axis), [x])
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), [x])
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    """Reference: paddle.nn.functional.pad semantics (phi pad/pad3d kernels)."""
+    def fn(a):
+        p = list(pad)
+        if len(p) == 2 * a.ndim:
+            # full-rank pad: first dim -> last dim, (before, after) pairs
+            width = [(p[2 * i], p[2 * i + 1]) for i in builtins.range(a.ndim)]
+        else:
+            # short pad applies to the trailing dims, innermost first:
+            # (left, right, top, bottom, ...) i.e. first pair = last dim
+            n = len(p) // 2
+            trailing = [(p[2 * i], p[2 * i + 1]) for i in builtins.range(n)]
+            width = [(0, 0)] * (a.ndim - n) + list(reversed(trailing))
+        if mode == "constant":
+            return jnp.pad(a, width, mode="constant", constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        return jnp.pad(a, width, mode=jmode)
+    return apply_op("pad", fn, [x])
+
+
+def gather(x, index, axis=0, name=None):
+    idx = _arr(index)
+    return apply_op("gather", lambda a: jnp.take(a, idx, axis=axis), [x])
+
+
+def gather_nd(x, index, name=None):
+    idx = _arr(index)
+    def fn(a):
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+    return apply_op("gather_nd", fn, [x])
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    idx = _arr(indices)
+    return apply_op("take_along_axis", lambda a: jnp.take_along_axis(a, idx, axis=axis), [arr])
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):  # noqa: A002
+    idx = _arr(indices)
+    def fn(a, v):
+        v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
+        dims = [jnp.arange(s).reshape([-1 if i == d else 1 for i in builtins.range(idx.ndim)])
+                for d, s in enumerate(idx.shape)]
+        full_idx = tuple(idx if d == axis else jnp.broadcast_to(dims[d], idx.shape)
+                         for d in builtins.range(idx.ndim))
+        if reduce == "assign":
+            return a.at[full_idx].set(v)
+        if reduce == "add":
+            return a.at[full_idx].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[full_idx].multiply(v)
+        raise ValueError(f"unknown reduce {reduce}")
+    return apply_op("put_along_axis", fn, [arr, values])
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """Reference: paddle.scatter (phi scatter kernel) — row-wise scatter."""
+    idx = _arr(index)
+    def fn(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        return a.at[idx].add(u)
+    return apply_op("scatter", fn, [x, updates])
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = _arr(index)
+    def fn(a, u):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+    return apply_op("scatter_nd_add", fn, [x, updates])
+
+
+def scatter_nd(index, updates, shape, name=None):
+    idx = _arr(index)
+    def fn(u):
+        z = jnp.zeros(shape, dtype=u.dtype)
+        return z.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+    return apply_op("scatter_nd", fn, [updates])
+
+
+def index_select(x, index, axis=0, name=None):
+    idx = _arr(index)
+    return apply_op("index_select", lambda a: jnp.take(a, idx, axis=axis), [x])
+
+
+def index_sample(x, index, name=None):
+    idx = _arr(index)
+    return apply_op("index_sample", lambda a: jnp.take_along_axis(a, idx, axis=1), [x])
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = _arr(index)
+    def fn(a, v):
+        a_m = jnp.moveaxis(a, axis, 0)
+        v_m = jnp.moveaxis(v, axis, 0)
+        out = a_m.at[idx].add(v_m)
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op("index_add", fn, [x, value])
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idxs = tuple(_arr(i) for i in indices)
+    def fn(a, v):
+        return a.at[idxs].add(v) if accumulate else a.at[idxs].set(v)
+    return apply_op("index_put", fn, [x, value])
+
+
+def masked_select(x, mask, name=None):
+    m = np.asarray(_arr(mask))  # data-dependent shape: host round-trip, eager only
+    def fn(a):
+        return a[jnp.asarray(m)]
+    return apply_op("masked_select", fn, [x])
+
+
+def masked_fill(x, mask, value, name=None):
+    mk = _arr(mask)
+    def fn(a, v):
+        return jnp.where(mk, v.astype(a.dtype) if hasattr(v, "astype") else v, a)
+    if isinstance(value, Tensor):
+        return apply_op("masked_fill", fn, [x, value])
+    return apply_op("masked_fill", lambda a: jnp.where(mk, value, a), [x])
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    cond = _arr(condition)
+    return apply_op("where", lambda a, b: jnp.where(cond, a, b), [x, y])
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(_arr(x))  # data-dependent shape: eager host computation
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    mn = _arr(min) if isinstance(min, Tensor) else min
+    mx = _arr(max) if isinstance(max, Tensor) else max
+    return apply_op("clip", lambda a: jnp.clip(a, mn, mx), [x])
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply_op("lerp", lambda a, b, w: a + w * (b - a), [x, y, weight])
+    return apply_op("lerp", lambda a, b: a + weight * (b - a), [x, y])
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op("nan_to_num", lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), [x])
+
+
+def diff(x, n=1, axis=-1, name=None):
+    return apply_op("diff", lambda a: jnp.diff(a, n=n, axis=axis), [x])
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = _arr(repeats) if isinstance(repeats, Tensor) else repeats
+    return apply_op("repeat_interleave", lambda a: jnp.repeat(a, r, axis=axis), [x])
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def fn(a):
+        flat = a.reshape(-1)
+        idx = offset + builtins.sum(
+            np.indices(shape)[i] * stride[i] for i in builtins.range(len(shape)))
+        return flat[jnp.asarray(idx.reshape(-1))].reshape(shape)
+    return apply_op("as_strided", fn, [x])
+
+
+def unfold(x, axis, size, step, name=None):
+    def fn(a):
+        n = (a.shape[axis] - size) // step + 1
+        idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+        moved = jnp.moveaxis(a, axis, 0)
+        out = moved[idx]  # [n, size, ...rest]
+        out = jnp.moveaxis(out, (0, 1), (axis, a.ndim))
+        return out
+    return apply_op("unfold", fn, [x])
+
+
+# ------------------------------------------------------------------ search
+def argsort(x, axis=-1, descending=False, name=None):
+    def fn(a):
+        idx = jnp.argsort(a, axis=axis)
+        return jnp.flip(idx, axis=axis) if descending else idx
+    return _nodiff(fn, x)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def fn(a):
+        s = jnp.sort(a, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+    return apply_op("sort", fn, [x])
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    def fn(a):
+        ax = axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = lax.top_k(src, k)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+    vals, idx = apply_op("topk", fn, [x], n_outputs=2)
+    idx.stop_gradient = True
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(a):
+        s = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis)
+        v = jnp.take(s, k - 1, axis=axis)
+        ix = jnp.take(i, k - 1, axis=axis)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            ix = jnp.expand_dims(ix, axis)
+        return v, ix
+    v, i = apply_op("kthvalue", fn, [x], n_outputs=2)
+    i.stop_gradient = True
+    return v, i
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = np.asarray(_arr(x))
+    from scipy import stats  # available via numpy ecosystem; fallback below
+    raise NotImplementedError("mode: host-side op, planned")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, name=None):
+    arr = np.asarray(_arr(x))  # data-dependent output shape → host, eager only
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    arr = np.asarray(_arr(x))
+    if axis is not None:
+        raise NotImplementedError
+    flat = arr.reshape(-1)
+    keep = np.concatenate([[True], flat[1:] != flat[:-1]]) if flat.size else np.array([], bool)
+    out = [Tensor(jnp.asarray(flat[keep]))]
+    if return_inverse:
+        out.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, flat.size))
+        out.append(Tensor(jnp.asarray(counts)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+    return _nodiff(lambda s, v: jnp.searchsorted(s, v, side=side).astype(dt),
+                   sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    arr = np.asarray(_arr(x))
+    w = np.asarray(_arr(weights)) if weights is not None else None
+    return Tensor(jnp.asarray(np.bincount(arr, weights=w, minlength=minlength)))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    arr = np.asarray(_arr(input))
+    rng = None if (min == 0 and max == 0) else (min, max)
+    h, _ = np.histogram(arr, bins=bins, range=rng)
+    return Tensor(jnp.asarray(h))
+
+
+# ------------------------------------------------------------------ logical
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+
+
+def logical_not(x, name=None):
+    return _nodiff(jnp.logical_not, x)
+
+
+def equal_all(x, y, name=None):
+    return _nodiff(lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _nodiff(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _nodiff(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def bitwise_and(x, y, name=None):
+    return _nodiff(jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y, name=None):
+    return _nodiff(jnp.bitwise_or, x, y)
+
+
+def bitwise_xor(x, y, name=None):
+    return _nodiff(jnp.bitwise_xor, x, y)
+
+
+def bitwise_not(x, name=None):
+    return _nodiff(jnp.bitwise_not, x)
+
+
+def bitwise_left_shift(x, y, name=None):
+    return _nodiff(jnp.left_shift, x, y)
+
+
+def bitwise_right_shift(x, y, name=None):
+    return _nodiff(jnp.right_shift, x, y)
+
+
+# ------------------------------------------------------------------ creation
+def _creation_dtype(dtype):
+    return convert_dtype(dtype) or get_default_dtype()
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(shape, dtype=_creation_dtype(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(shape, dtype=_creation_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fv = _arr(fill_value) if isinstance(fill_value, Tensor) else fill_value
+    if dtype is None:
+        return Tensor(jnp.full(shape, fv))
+    return Tensor(jnp.full(shape, fv, dtype=convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(_arr(x), dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(_arr(x), dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(_arr(x), fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = _arr(start) if isinstance(start, Tensor) else start
+    end = _arr(end) if isinstance(end, Tensor) else end
+    step = _arr(step) if isinstance(step, Tensor) else step
+    dt = convert_dtype(dtype)
+    if end is None:
+        start, end = 0, start
+    if dt is None:
+        if builtins.all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dt = convert_dtype("int64")
+        else:
+            dt = get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(_arr(start) if isinstance(start, Tensor) else start,
+                               _arr(stop) if isinstance(stop, Tensor) else stop,
+                               int(num), dtype=_creation_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_creation_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_creation_dtype(dtype)))
+
+
+def meshgrid(*args, name=None):
+    arrs = [_arr(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return [Tensor(m) for m in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def tril_indices(row, col, offset=0, dtype="int64", name=None):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def clone(x, name=None):
+    return apply_op("clone", lambda a: a + 0, [x])
+
+
+def assign(x, output=None, name=None):
+    t = to_tensor(x) if not isinstance(x, Tensor) else clone(x)
+    if output is not None:
+        output._replace(t)
+        return output
+    return t
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def complex(real, imag, name=None):  # noqa: A001
+    return apply_op("complex", lambda r, i: lax.complex(r, i), [real, imag])
+
+
+def as_complex(x, name=None):
+    return apply_op("as_complex", lambda a: lax.complex(a[..., 0], a[..., 1]), [x])
+
+
+def as_real(x, name=None):
+    return apply_op("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), [x])
+
+
+# ------------------------------------------------------------------ random
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(_random.split_key(), tuple(shape),
+                                     dtype=_creation_dtype(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(_random.split_key(), tuple(shape),
+                                    dtype=_creation_dtype(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_random.split_key(), tuple(shape), low, high,
+                                     dtype=convert_dtype(dtype)))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_random.split_key(), n).astype(convert_dtype(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    return Tensor(jax.random.uniform(_random.split_key(), tuple(shape),
+                                     dtype=_creation_dtype(dtype), minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = _arr(mean) if isinstance(mean, Tensor) else mean, _arr(std) if isinstance(std, Tensor) else std
+        sh = jnp.broadcast_shapes(np.shape(m), np.shape(s))
+        return Tensor(m + s * jax.random.normal(_random.split_key(), sh))
+    return Tensor(mean + std * jax.random.normal(_random.split_key(), tuple(shape),
+                                                 dtype=get_default_dtype()))
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(_random.split_key(), _arr(x)).astype(_arr(x).dtype))
+
+
+def bernoulli(x, name=None):
+    return Tensor(jax.random.bernoulli(_random.split_key(), _arr(x)).astype(_arr(x).dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    logits = jnp.log(jnp.clip(_arr(x), 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(_random.split_key(), logits, axis=-1,
+                                     shape=(*logits.shape[:-1], num_samples))
+    else:
+        g = jax.random.gumbel(_random.split_key(), logits.shape)
+        _, out = lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def rand_like(x, name=None):
+    return rand(x.shape, x.dtype)
+
+
+def randn_like(x, name=None):
+    return randn(x.shape, x.dtype)
+
+
+# ------------------------------------------------------------------ dtype/cast
+def cast(x, dtype, name=None):
+    dt = convert_dtype(dtype)
+    return apply_op("cast", lambda a: a.astype(dt), [x])
+
+
+def astype(x, dtype):
+    return cast(x, dtype)
+
+
+# ------------------------------------------------------------------ activations (op-level)
+def relu(x, name=None):
+    return apply_op("relu", jax.nn.relu, [x])
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return apply_op("softmax", fn, [x])
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply_op("log_softmax", fn, [x])
+
+
+# ------------------------------------------------------------------ linalg namespace
+class linalg:
+    """paddle.linalg analog (reference: python/paddle/tensor/linalg.py);
+    lowers to jnp.linalg (XLA custom calls / decompositions on TPU)."""
+
+    @staticmethod
+    def svd(x, full_matrices=False, name=None):
+        u, s, vh = apply_op("svd", lambda a: jnp.linalg.svd(a, full_matrices=full_matrices),
+                            [x], n_outputs=3)
+        return u, s, apply_op("conj_t", lambda a: jnp.swapaxes(a, -1, -2), [vh])
+
+    @staticmethod
+    def qr(x, mode="reduced", name=None):
+        return apply_op("qr", lambda a: jnp.linalg.qr(a, mode=mode), [x], n_outputs=2)
+
+    @staticmethod
+    def eig(x, name=None):
+        arr = np.asarray(_arr(x))
+        w, v = np.linalg.eig(arr)  # CPU-only in XLA; host fallback
+        return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+    @staticmethod
+    def eigh(x, UPLO="L", name=None):
+        return apply_op("eigh", lambda a: jnp.linalg.eigh(a, symmetrize_input=True), [x], n_outputs=2)
+
+    @staticmethod
+    def eigvals(x, name=None):
+        arr = np.asarray(_arr(x))
+        return Tensor(jnp.asarray(np.linalg.eigvals(arr)))
+
+    @staticmethod
+    def eigvalsh(x, UPLO="L", name=None):
+        return apply_op("eigvalsh", lambda a: jnp.linalg.eigvalsh(a), [x])
+
+    @staticmethod
+    def cholesky(x, upper=False, name=None):
+        def fn(a):
+            c = jnp.linalg.cholesky(a)
+            return jnp.swapaxes(c, -1, -2).conj() if upper else c
+        return apply_op("cholesky", fn, [x])
+
+    @staticmethod
+    def cholesky_solve(x, y, upper=False, name=None):
+        def fn(b, l):
+            return jax.scipy.linalg.cho_solve((l, not upper), b)
+        return apply_op("cholesky_solve", fn, [x, y])
+
+    @staticmethod
+    def inv(x, name=None):
+        return apply_op("inv", jnp.linalg.inv, [x])
+
+    @staticmethod
+    def pinv(x, rcond=1e-15, hermitian=False, name=None):
+        return apply_op("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), [x])
+
+    @staticmethod
+    def det(x, name=None):
+        return apply_op("det", jnp.linalg.det, [x])
+
+    @staticmethod
+    def slogdet(x, name=None):
+        return apply_op("slogdet", lambda a: tuple(jnp.linalg.slogdet(a)), [x], n_outputs=2)
+
+    @staticmethod
+    def solve(x, y, name=None):
+        return apply_op("solve", jnp.linalg.solve, [x, y])
+
+    @staticmethod
+    def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+        def fn(a, b):
+            return jax.scipy.linalg.solve_triangular(
+                a, b, lower=not upper, trans=1 if transpose else 0,
+                unit_diagonal=unitriangular)
+        return apply_op("triangular_solve", fn, [x, y])
+
+    @staticmethod
+    def lstsq(x, y, rcond=None, driver=None, name=None):
+        def fn(a, b):
+            sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+            return sol, res, rank, sv
+        return apply_op("lstsq", fn, [x, y], n_outputs=4)
+
+    @staticmethod
+    def matrix_rank(x, tol=None, hermitian=False, name=None):
+        return _nodiff(lambda a: jnp.linalg.matrix_rank(a, rtol=tol), x)
+
+    @staticmethod
+    def matrix_power(x, n, name=None):
+        return matrix_power(x, n)
+
+    @staticmethod
+    def norm(x, p="fro", axis=None, keepdim=False, name=None):
+        return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+    @staticmethod
+    def cond(x, p=None, name=None):
+        return _nodiff(lambda a: jnp.linalg.cond(a, p=p), x)
+
+    @staticmethod
+    def multi_dot(tensors, name=None):
+        return apply_op("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs), list(tensors))
+
+    @staticmethod
+    def lu(x, pivot=True, get_infos=False, name=None):
+        def fn(a):
+            lu_, piv = jax.scipy.linalg.lu_factor(a)
+            return lu_, piv.astype(jnp.int32) + 1  # paddle uses 1-based pivots
+        lu_, piv = apply_op("lu", fn, [x], n_outputs=2)
+        piv.stop_gradient = True
+        if get_infos:
+            return lu_, piv, Tensor(jnp.zeros((), jnp.int32))
+        return lu_, piv
+
+    @staticmethod
+    def corrcoef(x, rowvar=True, name=None):
+        return apply_op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), [x])
+
+    @staticmethod
+    def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+        return apply_op("cov", lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), [x])
+
+    @staticmethod
+    def householder_product(x, tau, name=None):
+        def fn(a, t):
+            m, n = a.shape[-2], a.shape[-1]
+            q = jnp.eye(m, dtype=a.dtype)
+            q = jnp.broadcast_to(q, (*a.shape[:-2], m, m)).copy() if a.ndim > 2 else q
+            for i in builtins.range(n):
+                v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype), a[..., i + 1:, i]], axis=-1)
+                h = jnp.eye(m, dtype=a.dtype) - t[..., i, None, None] * v[..., :, None] * v[..., None, :]
+                q = q @ h
+            return q[..., :, :n]
+        return apply_op("householder_product", fn, [x, tau])
+
+
+# --------------------------------------------------------------- fft namespace
+class fft:
+    """paddle.fft analog — lowers to jnp.fft."""
+    @staticmethod
+    def fft(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op("fft", lambda a: jnp.fft.fft(a, n=n, axis=axis, norm=norm), [x])
+
+    @staticmethod
+    def ifft(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op("ifft", lambda a: jnp.fft.ifft(a, n=n, axis=axis, norm=norm), [x])
+
+    @staticmethod
+    def rfft(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op("rfft", lambda a: jnp.fft.rfft(a, n=n, axis=axis, norm=norm), [x])
+
+    @staticmethod
+    def irfft(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op("irfft", lambda a: jnp.fft.irfft(a, n=n, axis=axis, norm=norm), [x])
+
+    @staticmethod
+    def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply_op("fft2", lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=norm), [x])
+
+    @staticmethod
+    def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply_op("ifft2", lambda a: jnp.fft.ifft2(a, s=s, axes=axes, norm=norm), [x])
+
+    @staticmethod
+    def fftn(x, s=None, axes=None, norm="backward", name=None):
+        return apply_op("fftn", lambda a: jnp.fft.fftn(a, s=s, axes=axes, norm=norm), [x])
+
+    @staticmethod
+    def ifftn(x, s=None, axes=None, norm="backward", name=None):
+        return apply_op("ifftn", lambda a: jnp.fft.ifftn(a, s=s, axes=axes, norm=norm), [x])
+
+    @staticmethod
+    def fftshift(x, axes=None, name=None):
+        return apply_op("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), [x])
+
+    @staticmethod
+    def ifftshift(x, axes=None, name=None):
+        return apply_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), [x])
+
+    @staticmethod
+    def fftfreq(n, d=1.0, dtype=None, name=None):
+        return Tensor(jnp.fft.fftfreq(n, d=d).astype(_creation_dtype(dtype)))
+
+    @staticmethod
+    def rfftfreq(n, d=1.0, dtype=None, name=None):
+        return Tensor(jnp.fft.rfftfreq(n, d=d).astype(_creation_dtype(dtype)))
+
+
+# --------------------------------------------------------- indexing on Tensor
+def _norm_index(idx):
+    if isinstance(idx, Tensor):
+        return _arr(idx)
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx))
+    return idx
+
+
+def _getitem(self, idx):
+    jidx = _norm_index(idx)
+    return apply_op("getitem", lambda a: a[jidx], [self])
+
+
+def _setitem(self, idx, value):
+    jidx = _norm_index(idx)
+    if isinstance(value, Tensor):
+        out = apply_op("setitem", lambda a, v: a.at[jidx].set(v.astype(a.dtype)), [self, value])
+    else:
+        out = apply_op("setitem", lambda a: a.at[jidx].set(value), [self])
+    self._replace(out)
+
+
+# ------------------------------------------------------------ in-place helpers
+def _make_inplace(fn):
+    def inplace(self, *args, **kw):
+        return self._replace(fn(self, *args, **kw))
+    return inplace
+
+
+def zero_(self):
+    self._data = jnp.zeros_like(self._data)
+    self._node = None
+    return self
+
+
+def fill_(self, value):
+    self._data = jnp.full_like(self._data, value)
+    self._node = None
+    return self
+
+
+def uniform_(self, min=-1.0, max=1.0, seed=0):  # noqa: A002
+    self._data = jax.random.uniform(_random.split_key(), self._data.shape,
+                                    dtype=self._data.dtype, minval=min, maxval=max)
+    self._node = None
+    return self
+
+
+def normal_(self, mean=0.0, std=1.0):
+    self._data = mean + std * jax.random.normal(_random.split_key(), self._data.shape,
+                                                dtype=self._data.dtype)
+    self._node = None
+    return self
+
+
+def exponential_(self, lam=1.0):
+    u = jax.random.uniform(_random.split_key(), self._data.shape, dtype=self._data.dtype)
+    self._data = -jnp.log1p(-u) / lam
+    self._node = None
+    return self
+
+
+# ------------------------------------------------------------ method attach
+def _attach_methods():
+    T = Tensor
+    T.__add__ = lambda s, o: add(s, o)
+    T.__radd__ = lambda s, o: add(o if isinstance(o, Tensor) else to_tensor(o), s)
+    T.__sub__ = lambda s, o: subtract(s, o)
+    T.__rsub__ = lambda s, o: subtract(to_tensor(o) if not isinstance(o, Tensor) else o, s)
+    T.__mul__ = lambda s, o: multiply(s, o)
+    T.__rmul__ = lambda s, o: multiply(to_tensor(o) if not isinstance(o, Tensor) else o, s)
+    T.__truediv__ = lambda s, o: divide(s, o)
+    T.__rtruediv__ = lambda s, o: divide(to_tensor(o) if not isinstance(o, Tensor) else o, s)
+    T.__floordiv__ = lambda s, o: floor_divide(s, o)
+    T.__mod__ = lambda s, o: mod(s, o)
+    T.__pow__ = lambda s, o: pow(s, o)
+    T.__rpow__ = lambda s, o: pow(to_tensor(o) if not isinstance(o, Tensor) else o, s)
+    T.__matmul__ = lambda s, o: matmul(s, o)
+    T.__rmatmul__ = lambda s, o: matmul(to_tensor(o) if not isinstance(o, Tensor) else o, s)
+    T.__neg__ = lambda s: neg(s)
+    T.__abs__ = lambda s: abs(s)
+    T.__invert__ = lambda s: logical_not(s) if s.dtype == np.dtype(builtins.bool) else bitwise_not(s)
+    T.__eq__ = lambda s, o: equal(s, o)
+    T.__ne__ = lambda s, o: not_equal(s, o)
+    T.__lt__ = lambda s, o: less_than(s, o)
+    T.__le__ = lambda s, o: less_equal(s, o)
+    T.__gt__ = lambda s, o: greater_than(s, o)
+    T.__ge__ = lambda s, o: greater_equal(s, o)
+    T.__and__ = lambda s, o: logical_and(s, o) if s.dtype == np.dtype(builtins.bool) else bitwise_and(s, o)
+    T.__or__ = lambda s, o: logical_or(s, o) if s.dtype == np.dtype(builtins.bool) else bitwise_or(s, o)
+    T.__xor__ = lambda s, o: logical_xor(s, o) if s.dtype == np.dtype(builtins.bool) else bitwise_xor(s, o)
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+    this = globals()
+    method_names = [
+        "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "square",
+        "abs", "sign", "floor", "ceil", "round", "trunc", "frac", "reciprocal", "neg",
+        "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh", "asinh",
+        "acosh", "atanh", "sigmoid", "erf", "erfinv", "lgamma", "digamma", "angle",
+        "conj", "real", "imag", "isnan", "isinf", "isfinite",
+        "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+        "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "hypot", "logaddexp",
+        "heaviside", "inner", "outer", "kron", "cross", "dot", "scale",
+        "sum", "mean", "prod", "max", "min", "amax", "amin", "nanmean", "nansum",
+        "logsumexp", "std", "var", "median", "quantile", "all", "any", "argmax",
+        "argmin", "count_nonzero", "cumsum", "cumprod", "logcumsumexp",
+        "matmul", "mm", "bmm", "mv", "addmm", "norm", "dist", "matrix_power",
+        "diag", "diagonal", "trace", "tril", "triu",
+        "reshape", "reshape_", "flatten", "transpose", "moveaxis", "swapaxes",
+        "squeeze", "unsqueeze", "split", "chunk", "tile", "expand", "expand_as",
+        "broadcast_to", "flip", "roll", "rot90", "pad", "gather", "gather_nd",
+        "take_along_axis", "put_along_axis", "scatter", "scatter_nd_add",
+        "index_select", "index_sample", "index_add", "index_put", "masked_select",
+        "masked_fill", "where", "clip", "lerp", "nan_to_num", "diff",
+        "repeat_interleave", "unfold", "argsort", "sort", "topk", "kthvalue",
+        "unique", "unique_consecutive", "bincount", "histogram",
+        "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+        "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+        "equal_all", "allclose", "isclose", "bitwise_and", "bitwise_or",
+        "bitwise_xor", "bitwise_not", "cast", "astype", "clone", "numel",
+        "zeros_like", "ones_like", "relu", "softmax", "log_softmax", "unstack",
+        "unbind",
+    ]
+    for nm in method_names:
+        if nm in this:
+            setattr(T, nm, this[nm])
+    # in-place ops
+    T.zero_ = zero_
+    T.fill_ = fill_
+    T.uniform_ = uniform_
+    T.normal_ = normal_
+    T.exponential_ = exponential_
+    T.add_ = _make_inplace(add)
+    T.subtract_ = _make_inplace(subtract)
+    T.multiply_ = _make_inplace(multiply)
+    T.divide_ = _make_inplace(divide)
+    T.scale_ = _make_inplace(scale)
+    T.clip_ = _make_inplace(clip)
+    T.floor_ = _make_inplace(floor)
+    T.ceil_ = _make_inplace(ceil)
+    T.exp_ = _make_inplace(exp)
+    T.sqrt_ = _make_inplace(sqrt)
+    T.rsqrt_ = _make_inplace(rsqrt)
+    T.reciprocal_ = _make_inplace(reciprocal)
+    T.round_ = _make_inplace(round)
+    T.tanh_ = _make_inplace(tanh)
+    T.squeeze_ = _make_inplace(squeeze)
+    T.unsqueeze_ = _make_inplace(unsqueeze)
+    T.flatten_ = _make_inplace(flatten)
+
+
+def unbind(x, axis=0, name=None):
+    return unstack(x, axis=axis)
+
+
+def increment(x, value=1.0, name=None):
+    return x._replace(add(x, value))
+
+
+_attach_methods()

@@ -1,0 +1,86 @@
+"""Global RNG state.
+
+The reference carries per-device Generator state (paddle/phi/core/generator.h)
+and exposes `paddle.seed`. On TPU the idiomatic substrate is JAX's splittable
+threefry keys: we keep one global key for the eager path and split on every
+draw; jitted/functional paths take explicit keys (see nn.Layer functional
+apply and distributed.random RNG trackers for TP-determinism, mirroring the
+reference's mpu/random.py tracker semantics).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _get():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.key(_DEFAULT_SEED)
+    return _state.key
+
+
+def seed(s: int):
+    """Reset the global RNG (reference: paddle.seed, framework/random.py)."""
+    _state.key = jax.random.key(int(s))
+    return _state.key
+
+
+def get_state():
+    return _get()
+
+
+def set_state(key):
+    _state.key = key
+
+
+class trace_key_scope:
+    """Bind randomness to an explicit key while tracing a jitted function.
+
+    Inside `paddle_tpu.jit` traces, drawing from the global eager key would
+    bake the randomness in as a compile-time constant (same dropout mask every
+    step). The jit layer wraps traces in this scope with a per-step key input;
+    `split_key()` then derives subkeys from it, so randomness is a proper
+    traced input. Analog of the reference's seed plumbing into dropout kernels
+    (phi dropout kernels take a seed tensor) and the mpu RNG trackers.
+    """
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        stack = getattr(_state, "trace_stack", None)
+        if stack is None:
+            stack = _state.trace_stack = []
+        stack.append([self._key])
+        return self
+
+    def __exit__(self, *exc):
+        _state.trace_stack.pop()
+        return False
+
+
+def in_trace_scope() -> bool:
+    stack = getattr(_state, "trace_stack", None)
+    return bool(stack)
+
+
+def _original_split_key():
+    key, sub = jax.random.split(_get())
+    _state.key = key
+    return sub
+
+
+def split_key():
+    """Return a fresh subkey — from the trace scope if active, else the
+    global eager stream."""
+    stack = getattr(_state, "trace_stack", None)
+    if stack:
+        cell = stack[-1]
+        key, sub = jax.random.split(cell[0])
+        cell[0] = key
+        return sub
+    return _original_split_key()

@@ -1,0 +1,303 @@
+"""Eager Tensor — a thin autograd-aware wrapper over jax.Array.
+
+TPU-native redesign of the reference's eager Tensor
+(paddle/fluid/eager/ + pybind/eager.cc:1246 Tensor type, eager_method.cc
+methods). The reference couples a C++ DenseTensor with AutogradMeta; here the
+storage IS a jax.Array (device-resident, XLA-managed — no custom allocator:
+the StreamSafeCUDAAllocator concern of
+paddle/fluid/memory/allocation/stream_safe_cuda_allocator.h:61 does not exist
+on TPU, where XLA owns buffers and ordering), and autograd metadata is the
+(`_node`, `_out_idx`, `stop_gradient`, `grad`) quadruple consumed by
+core.autograd.
+
+`apply_op` is the single entry point every eager op goes through — the analog
+of the generated `*_ad_func` forward functions (eager_gen.py:192): run the
+forward, and iff grad is enabled and some input requires grad, capture a
+jax.vjp closure on the tape.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .dtype import convert_dtype, get_default_dtype, is_floating_point
+
+_PRINT_OPTS = {"precision": 8, "threshold": 1000, "edgeitems": 3, "linewidth": 80}
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx", "name",
+                 "persistable", "_hooks", "pspec", "__weakref__")
+
+    def __init__(self, data, stop_gradient: bool = True, name: str = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        elif not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_idx = 0
+        self.name = name
+        self.persistable = False
+        self._hooks = []
+        self.pspec = None  # optional jax PartitionSpec annotation (distributed)
+
+    # ---- basic properties -------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def T(self):
+        from . import ops
+        return ops.t(self)
+
+    @property
+    def place(self):
+        d = self._data.devices()
+        return next(iter(d)) if d else None
+
+    def numel(self):
+        return self.size
+
+    def is_floating_point(self):
+        return is_floating_point(self.dtype)
+
+    # ---- host interop -----------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of a multi-element Tensor is ambiguous")
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._data.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        with np.printoptions(**{k: v for k, v in _PRINT_OPTS.items() if k != "linewidth"}):
+            body = str(self.numpy())
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"stop_gradient={self.stop_gradient},\n       {body})")
+
+    # ---- autograd surface -------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._data), stop_gradient=True)
+        else:
+            self.grad = None
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._data, stop_gradient=True, name=self.name)
+
+    def detach_(self) -> "Tensor":
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def register_hook(self, hook):
+        """Reference analog: Tensor.register_hook (varbase_patch_methods.py)."""
+        self._hooks.append(hook)
+
+        class _Remover:
+            def remove(_self):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Remover()
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    # ---- in-place-style mutation (functional under the hood) --------------
+    def _replace(self, new: "Tensor"):
+        """Adopt another tensor's value+graph in place.
+
+        XLA is functional, so the reference's true in-place ops
+        (ops.yaml `inplace` annotations) are emulated by rebinding this
+        python object to the functionally-updated array while keeping the
+        autograd edge — same user-visible semantics, no aliasing.
+        """
+        self._data = new._data
+        self._node = new._node
+        self._out_idx = new._out_idx
+        self.stop_gradient = new.stop_gradient
+        return self
+
+    def set_value(self, value):
+        value = value._data if isinstance(value, Tensor) else jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(f"set_value shape mismatch {value.shape} vs {self._data.shape}")
+        self._data = value
+        self._node = None
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    # NOTE: arithmetic dunders, indexing, and the ~200 tensor methods are
+    # attached by core.ops at import time (single source of truth for the op
+    # surface — the analog of the generated pybind methods in
+    # eager_op_function.cc).
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _wrap_scalar(x, like=None):
+    """Convert python scalars / numpy to jnp for vjp-traced args."""
+    return jnp.asarray(x)
+
+
+_amp_cast = None  # installed lazily by paddle_tpu.amp to avoid an import cycle
+
+
+def _install_amp_hook():
+    global _amp_cast
+    from ..amp.auto_cast import amp_cast_inputs
+    _amp_cast = amp_cast_inputs
+
+
+def apply_op(name, fn, tensor_args, static_kwargs=None, n_outputs=None):
+    """Run `fn(*arrays, **static_kwargs)` eagerly, recording a tape node.
+
+    - `tensor_args`: positional inputs that participate in differentiation
+      (Tensors or array-likes; non-Tensors are treated as constants).
+    - `static_kwargs`: non-differentiable config closed over the vjp.
+    Returns Tensor or tuple of Tensors matching fn's output structure.
+
+    Reference analog: the eager_gen.py:192 FORWARD_FUNCTION_TEMPLATE body
+    (minus AMP/layout autotune, which live in paddle_tpu.amp as dtype
+    policies instead of per-op rewrite).
+    """
+    static_kwargs = static_kwargs or {}
+    arrays = []
+    diff_mask = []
+    for a in tensor_args:
+        if isinstance(a, Tensor):
+            arrays.append(a._data)
+            diff_mask.append(not a.stop_gradient or a._node is not None)
+        else:
+            arrays.append(a if isinstance(a, jax.Array) else jnp.asarray(a))
+            diff_mask.append(False)
+
+    if _amp_cast is not None:
+        arrays = _amp_cast(name, arrays)
+
+    record = autograd.is_grad_enabled() and any(diff_mask)
+
+    if not record:
+        out = fn(*arrays, **static_kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+        ts = tuple(Tensor(o, stop_gradient=True) for o in outs)
+        return ts if multi else ts[0]
+
+    def pure(*xs):
+        res = fn(*xs, **static_kwargs)
+        return tuple(res) if isinstance(res, (tuple, list)) else (res,)
+
+    outs, vjp_fn = jax.vjp(pure, *arrays)
+    multi_out = n_outputs is not None or len(outs) > 1
+    avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+
+    in_tensors = [a for a in tensor_args if isinstance(a, Tensor)]
+    t_idx = [i for i, a in enumerate(tensor_args) if isinstance(a, Tensor)]
+
+    def node_vjp(cts, _vjp=vjp_fn, _t_idx=tuple(t_idx), _n=len(arrays)):
+        full = _vjp(cts)
+        return [full[i] for i in _t_idx]
+
+    node = autograd.Node(name, node_vjp, in_tensors, avals)
+    results = []
+    for i, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=False)
+        t._node = node
+        t._out_idx = i
+        results.append(t)
+    # fn may genuinely return a 1-tuple; treat len>1 or explicit n_outputs as multi
+    if len(results) == 1 and n_outputs is None:
+        return results[0]
+    return tuple(results)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor analog (reference: python/paddle/tensor/creation.py)."""
+    del place  # single logical device space; sharding handles placement
+    if isinstance(data, Tensor):
+        arr = data._data
+    elif isinstance(data, jax.Array):
+        arr = data
+    else:
+        arr = np.asarray(data)
+        if arr.dtype == np.float64 and dtype is None:
+            arr = arr.astype(get_default_dtype())
+    dt = convert_dtype(dtype)
+    arr = jnp.asarray(arr, dtype=dt) if dt is not None else jnp.asarray(arr)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: fluid/framework.py Parameter — a Variable
+    with trainable=True; here simply stop_gradient=False + persistable)."""
+
+    def __init__(self, data, trainable: bool = True, name: str = None):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v

@@ -1,0 +1,71 @@
+"""Device management (reference: python/paddle/device/__init__.py).
+
+The reference juggles CUDAPlace/XPUPlace/NPUPlace and streams
+(paddle/phi/common/place.h, device/cuda/streams). On TPU there is a single
+logical device space managed by XLA; placement happens via shardings, and
+stream semantics do not exist (XLA program order). We expose the same API
+shape with TPU-truthful behavior.
+"""
+from __future__ import annotations
+
+import jax
+
+_current = [None]
+
+
+def get_all_devices():
+    return jax.devices()
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def set_device(device: str):
+    """Accepts 'tpu', 'tpu:N', 'cpu', 'cpu:N'. Returns the jax device."""
+    if ":" in device:
+        kind, idx = device.split(":")
+        idx = int(idx)
+    else:
+        kind, idx = device, 0
+    if kind in ("gpu", "cuda"):
+        raise ValueError("paddle_tpu is a TPU framework; no CUDA devices. "
+                         "Use 'tpu' or 'cpu'.")
+    devs = [d for d in jax.devices() if d.platform in (kind, "axon" if kind == "tpu" else kind)]
+    if not devs:
+        devs = jax.devices()
+    _current[0] = devs[idx % len(devs)]
+    return _current[0]
+
+
+def get_device() -> str:
+    d = _current[0] or jax.devices()[0]
+    plat = "tpu" if d.platform in ("tpu", "axon") else d.platform
+    return f"{plat}:{d.id}"
+
+
+def current_device():
+    return _current[0] or jax.devices()[0]
+
+
+def synchronize():
+    """Block until all dispatched work completes (reference:
+    paddle.device.cuda.synchronize). jax.block_until_ready on a trivial op."""
+    jax.block_until_ready(jax.numpy.zeros(()))
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+class Stream:
+    """Compat no-op: XLA has no user-visible streams; ordering is program
+    order (replaces reference stream/event machinery,
+    paddle/phi/backends/gpu/gpu_context.h:97)."""
+
+    def synchronize(self):
+        synchronize()
+
+
+def cuda_empty_cache():
+    pass

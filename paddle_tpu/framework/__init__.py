@@ -1,0 +1,2 @@
+from . import io  # noqa: F401
+from .io import save, load  # noqa: F401

@@ -1,0 +1,74 @@
+"""paddle.save / paddle.load — object checkpointing.
+
+Reference: python/paddle/framework/io.py:637 (save), :879 (load) — pickles
+nested state_dicts with tensors converted to numpy. We keep the same contract
+(nested dict/list of Tensors + python scalars, file or path-like), storing
+tensors as numpy inside a single pickle; large-scale sharded/async checkpoints
+live in paddle_tpu.distributed.checkpoint (orbax-backed), the analog of the
+reference's incubate dist_save (incubate/distributed/utils/io/dist_save.py).
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+
+import numpy as np
+import jax
+
+from ..core.tensor import Tensor, Parameter
+
+
+_SENTINEL = "__paddle_tpu_tensor__"
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return {_SENTINEL: True, "data": np.asarray(obj._data),
+                "stop_gradient": obj.stop_gradient,
+                "param": isinstance(obj, Parameter)}
+    if isinstance(obj, jax.Array):
+        return {_SENTINEL: True, "data": np.asarray(obj), "stop_gradient": True,
+                "param": False}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get(_SENTINEL):
+            if return_numpy:
+                return obj["data"]
+            if obj["param"]:
+                return Parameter(obj["data"], trainable=not obj["stop_gradient"])
+            return Tensor(obj["data"], stop_gradient=obj["stop_gradient"])
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol: int = 4):
+    """Serialize a (possibly nested) object containing Tensors."""
+    packed = _pack(obj)
+    if hasattr(path, "write"):
+        pickle.dump(packed, path, protocol=protocol)
+        return
+    d = os.path.dirname(str(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(packed, f, protocol=protocol)
+
+
+def load(path, return_numpy: bool = False, **config):
+    if hasattr(path, "read"):
+        packed = pickle.load(path)
+    else:
+        with open(path, "rb") as f:
+            packed = pickle.load(f)
+    return _unpack(packed, return_numpy=return_numpy)

@@ -1,0 +1,19 @@
+"""paddle.io analog — Dataset/Sampler/DataLoader.
+
+Reference: python/paddle/io/ + fluid/dataloader/ (dataloader_iter.py:162
+single-process, :370 multi-process with shared memory + C++ BlockingQueue).
+TPU-native design: the loader produces numpy batches on host and ships them
+with a background thread + double buffering (device_put overlap); there is no
+forked-worker shared-memory machinery because the expensive path on TPU is
+host→HBM transfer, which jax pipelines. A `places`-style API is kept for
+signature parity.
+"""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    Subset, random_split,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,222 @@
+"""@to_static — trace-and-compile (reference: python/paddle/jit/api.py:222,
+dy2static/program_translator.py:283 StaticFunction + ProgramCache).
+
+The reference rewrites Python AST into a static Program executed by
+InterpreterCore (run_program op). TPU-native: jax.jit IS the tracer/compiler —
+we functionalize a Layer by swapping its Parameters' storage for tracers,
+trace the Python forward once per input signature (cache keyed like
+CacheKey: shapes/dtypes/training flag), and register the whole compiled
+function as ONE tape op so eager `.backward()` differentiates through it
+(jax.vjp of a jitted function stays compiled).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter, apply_op
+from ..core import random as _random
+from ..core import autograd
+from ..core.dtype import convert_dtype
+
+_trace_state = threading.local()
+
+
+def _in_jit_trace() -> bool:
+    return getattr(_trace_state, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def _trace_guard():
+    _trace_state.depth = getattr(_trace_state, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _trace_state.depth -= 1
+
+
+class InputSpec:
+    """Reference: paddle.static.InputSpec (static/input.py)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+@contextlib.contextmanager
+def _swap_params(params: List[Tensor], arrays):
+    """Temporarily rebind Tensor storage to (traced) arrays."""
+    saved = [p._data for p in params]
+    saved_nodes = [p._node for p in params]
+    for p, a in zip(params, arrays):
+        p._data = a
+        p._node = None
+    try:
+        yield
+    finally:
+        for p, s, n in zip(params, saved, saved_nodes):
+            p._data = s
+            p._node = n
+
+
+def _tree_unwrap(obj):
+    if isinstance(obj, Tensor):
+        return obj._data
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_unwrap(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_unwrap(v) for k, v in obj.items()}
+    return obj
+
+
+def _tree_wrap(obj):
+    if isinstance(obj, jax.Array):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_wrap(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_wrap(v) for k, v in obj.items()}
+    return obj
+
+
+def _collect_layers(fn):
+    """Find Layer instances reachable from fn (bound self or closure)."""
+    from ..nn.layer import Layer
+    layers = []
+    self_obj = getattr(fn, "__self__", None)
+    if isinstance(self_obj, Layer):
+        layers.append(self_obj)
+    closure = getattr(fn, "__closure__", None)
+    if closure:
+        for cell in closure:
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if isinstance(v, Layer):
+                layers.append(v)
+    return layers
+
+
+class StaticFunction:
+    def __init__(self, function, input_spec=None, layer=None, **kwargs):
+        self._fn = function
+        self._input_spec = input_spec
+        self._layer = layer
+        self._cache = {}
+        self.__name__ = getattr(function, "__name__", "static_fn")
+
+    @property
+    def _layers(self):
+        if self._layer is not None:
+            return [self._layer]
+        return _collect_layers(self._fn)
+
+    def _params_and_buffers(self):
+        params, buffers = [], []
+        for layer in self._layers:
+            for _, p in layer.named_parameters():
+                params.append(p)
+            for _, b in layer.named_buffers():
+                buffers.append(b)
+        return params, buffers
+
+    def __call__(self, *args, **kwargs):
+        params, buffers = self._params_and_buffers()
+        arg_arrays = _tree_unwrap(args)
+        kw_arrays = _tree_unwrap(kwargs)
+        flat_args, treedef = jax.tree.flatten((arg_arrays, kw_arrays))
+        training = any(getattr(l, "training", False) for l in self._layers)
+        key_shapes = tuple(
+            (tuple(a.shape), str(a.dtype)) if hasattr(a, "shape") else repr(a)
+            for a in flat_args)
+        cache_key = (treedef, key_shapes, training, len(params), len(buffers))
+
+        entry = self._cache.get(cache_key)
+        if entry is None:
+            fn = self._fn
+            out_treedef_box = []
+
+            def pure(param_arrays, buffer_arrays, key, *flat):
+                a_args, a_kwargs = jax.tree.unflatten(treedef, flat)
+                with _trace_guard(), _swap_params(params + buffers,
+                                                  list(param_arrays) + list(buffer_arrays)), \
+                        _random.trace_key_scope(key), autograd.no_grad():
+                    w_args = _tree_wrap(a_args)
+                    w_kwargs = _tree_wrap(a_kwargs)
+                    out = fn(*w_args, **w_kwargs)
+                flat_out, out_treedef = jax.tree.flatten(_tree_unwrap(out))
+                if not out_treedef_box:
+                    out_treedef_box.append(out_treedef)
+                return tuple(flat_out)
+
+            entry = (jax.jit(pure), out_treedef_box)
+            self._cache[cache_key] = entry
+        jitted, out_treedef_box = entry
+
+        key = _random.split_key()
+        buffer_arrays = [b._data for b in buffers]
+
+        # Register as one tape op: grads flow to params (and tensor args).
+        def op_fn(*xs):
+            p_arrays = xs[:len(params)]
+            rest = xs[len(params):]
+            return jitted(p_arrays, buffer_arrays, key, *rest)
+
+        n_out_hint = None if not out_treedef_box else out_treedef_box[0].num_leaves
+        out = apply_op(f"to_static[{self.__name__}]", op_fn,
+                       list(params) + [a if isinstance(a, jax.Array) else jnp.asarray(a)
+                                       for a in flat_args],
+                       n_outputs=n_out_hint)
+        leaves = list(out) if isinstance(out, tuple) else [out]
+        structured = jax.tree.unflatten(out_treedef_box[0], leaves)
+        return structured
+
+    # reference-API compat
+    def concrete_program_specify_input_spec(self, *a, **k):
+        return None
+
+    @property
+    def code(self):
+        import inspect
+        try:
+            return inspect.getsource(self._fn)
+        except OSError:
+            return "<source unavailable>"
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              **kwargs):
+    """Decorator: compile a function/Layer.forward with XLA
+    (reference: paddle.jit.to_static, jit/api.py:222)."""
+    from ..nn.layer import Layer
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(layer.forward, input_spec, layer=layer)
+            layer.forward = sf
+            return layer
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass

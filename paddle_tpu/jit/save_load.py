@@ -1,0 +1,71 @@
+"""jit.save / jit.load (reference: python/paddle/jit/api.py save/load +
+fluid/jit serializer).
+
+The reference serializes a translated static Program plus params. TPU-native:
+we persist (a) the model's state_dict and (b) a small manifest; on load we
+return a TranslatedLayer that replays the original Layer class if importable,
+else a pure state container. AOT-compiled executable export (XLA serialized
+computation) is planned in the inference subsystem (paddle_tpu.inference).
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework.io import save as _save_obj, load as _load_obj
+
+
+def save(layer, path, input_spec=None, **configs):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = layer.state_dict() if hasattr(layer, "state_dict") else {}
+    manifest = {
+        "class_module": type(layer).__module__,
+        "class_name": type(layer).__name__,
+        "format": "paddle_tpu.jit.v1",
+    }
+    _save_obj({"state_dict": state, "manifest": manifest}, path + ".pdparams")
+    with open(path + ".pdmodel.json", "w") as f:
+        json.dump(manifest, f)
+
+
+class TranslatedLayer:
+    """Loaded model artifact (reference: fluid/dygraph/io.py TranslatedLayer)."""
+
+    def __init__(self, state_dict, manifest, layer=None):
+        self._state_dict = state_dict
+        self._manifest = manifest
+        self._layer = layer
+
+    def state_dict(self):
+        return self._state_dict
+
+    def __call__(self, *args, **kwargs):
+        if self._layer is None:
+            raise RuntimeError(
+                f"Model class {self._manifest.get('class_module')}."
+                f"{self._manifest.get('class_name')} could not be re-imported; "
+                "only state_dict() is available.")
+        return self._layer(*args, **kwargs)
+
+
+def load(path, **configs):
+    blob = _load_obj(path + ".pdparams")
+    state, manifest = blob["state_dict"], blob["manifest"]
+    layer = None
+    try:
+        mod = importlib.import_module(manifest["class_module"])
+        cls = getattr(mod, manifest["class_name"])
+        # only auto-instantiate no-arg constructibles
+        try:
+            layer = cls()
+            layer.set_state_dict(state)
+        except TypeError:
+            layer = None
+    except Exception:
+        layer = None
+    return TranslatedLayer(state, manifest, layer)

@@ -1,0 +1,1039 @@
+"""nn.functional — neural-net functional ops.
+
+Reference: python/paddle/nn/functional/* (common.py:1814 linear, conv.py,
+pooling.py, loss.py, activation.py, norm.py). One lowering per op to
+jax.lax/jnp: XLA fuses elementwise chains into matmul/conv epilogues on TPU,
+which is why there is no separate "fused op" corpus here (the reference's
+operators/fused/* exists because CUDA needs hand-fused kernels; on TPU the
+compiler does it, and the few genuinely hard fusions — flash attention —
+live in paddle_tpu.ops.pallas as Pallas kernels).
+"""
+from __future__ import annotations
+
+import builtins
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply_op
+from ..core.dtype import convert_dtype
+from ..core import random as _random
+from ..core.ops import (  # re-exported op-level functions  # noqa: F401
+    relu, softmax, log_softmax, sigmoid, tanh,
+)
+
+__all__ = [
+    "linear", "embedding", "one_hot",
+    "conv1d", "conv2d", "conv3d", "conv2d_transpose",
+    "max_pool1d", "max_pool2d", "avg_pool1d", "avg_pool2d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_max_pool2d",
+    "relu", "relu6", "gelu", "silu", "swish", "elu", "selu", "celu",
+    "leaky_relu", "prelu", "hardshrink", "softshrink", "tanhshrink",
+    "hardtanh", "hardsigmoid", "hardswish", "mish", "softplus", "softsign",
+    "sigmoid", "tanh", "softmax", "log_softmax", "gumbel_softmax", "glu",
+    "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm", "rms_norm",
+    "local_response_norm", "normalize",
+    "cross_entropy", "softmax_with_cross_entropy", "nll_loss", "mse_loss",
+    "l1_loss", "smooth_l1_loss", "kl_div", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "margin_ranking_loss",
+    "cosine_similarity", "cosine_embedding_loss", "ctc_loss", "hinge_embedding_loss",
+    "square_error_cost", "log_loss", "sigmoid_focal_loss", "triplet_margin_loss",
+    "pad", "interpolate", "upsample", "pixel_shuffle", "unfold",
+    "scaled_dot_product_attention", "label_smooth", "temporal_shift",
+    "sequence_mask", "grid_sample", "affine_grid",
+]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+# ----------------------------------------------------------------- dense
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, W stored [in, out] (reference: functional/common.py:1814).
+
+    Single MXU matmul; bias add fuses into the epilogue under XLA.
+    """
+    if bias is None:
+        return apply_op("linear", lambda a, w: a @ w, [x, weight])
+    return apply_op("linear", lambda a, w, b: a @ w + b, [x, weight, bias])
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Reference: functional/input.py embedding. Gather from the table; rows
+    at padding_idx produce zero gradient (masked in fwd so vjp zeroes it)."""
+    idx = _arr(x)
+    def fn(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply_op("embedding", fn, [weight])
+
+
+def one_hot(x, num_classes, name=None):
+    idx = _arr(x)
+    return Tensor(jax.nn.one_hot(idx, num_classes, dtype=jnp.float32))
+
+
+# ----------------------------------------------------------------- convs
+def _conv_dn(ndim, channel_last=False):
+    if ndim == 1:
+        return ("NCH", "OIH", "NCH") if not channel_last else ("NHC", "OIH", "NHC")
+    if ndim == 2:
+        return ("NCHW", "OIHW", "NCHW") if not channel_last else ("NHWC", "OIHW", "NHWC")
+    return ("NCDHW", "OIDHW", "NCDHW") if not channel_last else ("NDHWC", "OIDHW", "NDHWC")
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and builtins.all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in builtins.range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _convnd(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC", "NHC")
+    dn = lax.conv_dimension_numbers(
+        _arr(x).shape, _arr(weight).shape, _conv_dn(n, channel_last))
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    pad_cfg = _conv_padding(padding, n)
+
+    def fn(a, w, *b):
+        out = lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad_cfg,
+            rhs_dilation=dil, dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=jnp.float32 if a.dtype in (jnp.bfloat16, jnp.float16) else None)
+        out = out.astype(a.dtype)
+        if b:
+            bshape = [1] * out.ndim
+            c_axis = out.ndim - 1 if channel_last else 1
+            bshape[c_axis] = -1
+            out = out + b[0].reshape(bshape)
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply_op("conv%dd" % n, fn, args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NHC" if data_format == "NLC" else "NCH"
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 1, fmt)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    """Reference: functional/conv.py conv2d → phi conv kernel; here one
+    lax.conv_general_dilated, which XLA tiles onto the MXU."""
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+    """Reference: functional/conv.py conv2d_transpose. Implemented as the
+    gradient of conv2d (lax.conv_transpose), weight layout [in, out/groups, kh, kw]."""
+    strides = _norm_tuple(stride, 2)
+    dil = _norm_tuple(dilation, 2)
+    pad = _norm_tuple(padding, 2) if not isinstance(padding, str) else padding
+    out_pad = _norm_tuple(output_padding, 2)
+
+    def fn(a, w, *b):
+        # lax.conv_transpose with IOHW spec: transpose weight [I,O,kh,kw]
+        kh, kw = w.shape[2], w.shape[3]
+        if isinstance(pad, str):
+            padding_cfg = pad.upper()
+        else:
+            padding_cfg = [
+                (dil[i] * (k - 1) - pad[i], dil[i] * (k - 1) - pad[i] + out_pad[i])
+                for i, k in enumerate((kh, kw))
+            ]
+        if groups == 1:
+            out = lax.conv_transpose(
+                a, w, strides=strides, padding=padding_cfg,
+                rhs_dilation=dil, dimension_numbers=("NCHW", "IOHW", "NCHW"),
+                transpose_kernel=True)
+        else:
+            xs = jnp.split(a, groups, axis=1)
+            ws = jnp.split(w, groups, axis=0)
+            out = jnp.concatenate([
+                lax.conv_transpose(xi, wi, strides=strides, padding=padding_cfg,
+                                   rhs_dilation=dil,
+                                   dimension_numbers=("NCHW", "IOHW", "NCHW"),
+                                   transpose_kernel=True)
+                for xi, wi in zip(xs, ws)], axis=1)
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply_op("conv2d_transpose", fn, args)
+
+
+# ----------------------------------------------------------------- pooling
+def _pool(x, kernel, stride, padding, n, reducer, init, data_format="NCHW",
+          ceil_mode=False, count_include_pad=True, exclusive=True):
+    k = _norm_tuple(kernel, n)
+    s = _norm_tuple(stride if stride is not None else kernel, n)
+    p = _conv_padding(padding, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    if channel_last:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        spatial = builtins.range(1, 1 + n)
+    else:
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+        spatial = builtins.range(2, 2 + n)
+    if isinstance(p, str):
+        pads = p
+    else:
+        full = [(0, 0)] * _arr(x).ndim
+        for i, ax in enumerate(spatial):
+            full[ax] = p[i]
+        pads = full
+
+    def fn(a):
+        out = lax.reduce_window(a, init(a.dtype), reducer, dims, strides,
+                                pads if isinstance(pads, list) else pads)
+        return out
+    return fn, dims, strides, pads, spatial
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    fn, *_ = _pool(x, kernel_size, stride, padding, 2, lax.max,
+                   lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).min,
+                   data_format)
+    out = apply_op("max_pool2d", fn, [x])
+    if return_mask:
+        raise NotImplementedError("return_mask not yet supported")
+    return out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    fn, *_ = _pool(x, kernel_size, stride, padding, 1, lax.max,
+                   lambda dt: -jnp.inf, "NCL")
+    return apply_op("max_pool1d", fn, [x])
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    k = _norm_tuple(kernel_size, 2)
+    fn_sum, dims, strides, pads, spatial = _pool(
+        x, kernel_size, stride, padding, 2, lax.add, lambda dt: jnp.array(0, dt), data_format)
+
+    def fn(a):
+        ssum = lax.reduce_window(a, jnp.array(0, a.dtype), lax.add, dims, strides, pads)
+        if divisor_override:
+            return ssum / divisor_override
+        if exclusive and pads != "VALID" and not isinstance(pads, str):
+            ones = jnp.ones(a.shape, a.dtype)
+            cnt = lax.reduce_window(ones, jnp.array(0, a.dtype), lax.add, dims, strides, pads)
+            return ssum / cnt
+        return ssum / math.prod(k)
+    return apply_op("avg_pool2d", fn, [x])
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    k = _norm_tuple(kernel_size, 1)
+    fn_sum, dims, strides, pads, spatial = _pool(
+        x, kernel_size, stride, padding, 1, lax.add, lambda dt: jnp.array(0, dt), "NCL")
+
+    def fn(a):
+        ssum = lax.reduce_window(a, jnp.array(0, a.dtype), lax.add, dims, strides, pads)
+        return ssum / math.prod(k)
+    return apply_op("avg_pool1d", fn, [x])
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out_hw = _norm_tuple(output_size, 2)
+
+    def fn(a):
+        h, w = a.shape[-2], a.shape[-1]
+        oh, ow = out_hw
+        if h % oh == 0 and w % ow == 0:
+            a2 = a.reshape(*a.shape[:-2], oh, h // oh, ow, w // ow)
+            return a2.mean(axis=(-3, -1))
+        # general case: interpolate bin edges
+        out = jnp.zeros((*a.shape[:-2], oh, ow), a.dtype)
+        rows = [(int(np.floor(i * h / oh)), int(np.ceil((i + 1) * h / oh))) for i in builtins.range(oh)]
+        cols = [(int(np.floor(j * w / ow)), int(np.ceil((j + 1) * w / ow))) for j in builtins.range(ow)]
+        parts = []
+        for (r0, r1) in rows:
+            row_parts = [a[..., r0:r1, c0:c1].mean(axis=(-2, -1)) for (c0, c1) in cols]
+            parts.append(jnp.stack(row_parts, axis=-1))
+        return jnp.stack(parts, axis=-2)
+    return apply_op("adaptive_avg_pool2d", fn, [x])
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    o = output_size if isinstance(output_size, int) else output_size[0]
+
+    def fn(a):
+        l = a.shape[-1]
+        if l % o == 0:
+            return a.reshape(*a.shape[:-1], o, l // o).mean(axis=-1)
+        edges = [(int(np.floor(i * l / o)), int(np.ceil((i + 1) * l / o))) for i in builtins.range(o)]
+        return jnp.stack([a[..., s:e].mean(axis=-1) for s, e in edges], axis=-1)
+    return apply_op("adaptive_avg_pool1d", fn, [x])
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out_hw = _norm_tuple(output_size, 2)
+
+    def fn(a):
+        h, w = a.shape[-2], a.shape[-1]
+        oh, ow = out_hw
+        if h % oh == 0 and w % ow == 0:
+            a2 = a.reshape(*a.shape[:-2], oh, h // oh, ow, w // ow)
+            return a2.max(axis=(-3, -1))
+        rows = [(int(np.floor(i * h / oh)), int(np.ceil((i + 1) * h / oh))) for i in builtins.range(oh)]
+        cols = [(int(np.floor(j * w / ow)), int(np.ceil((j + 1) * w / ow))) for j in builtins.range(ow)]
+        parts = []
+        for (r0, r1) in rows:
+            parts.append(jnp.stack([a[..., r0:r1, c0:c1].max(axis=(-2, -1)) for (c0, c1) in cols], axis=-1))
+        return jnp.stack(parts, axis=-2)
+    return apply_op("adaptive_max_pool2d", fn, [x])
+
+
+# ----------------------------------------------------------------- activations
+def relu6(x, name=None):
+    return apply_op("relu6", lambda a: jnp.clip(a, 0, 6), [x])
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), [x])
+
+
+def silu(x, name=None):
+    return apply_op("silu", jax.nn.silu, [x])
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda a: jax.nn.elu(a, alpha=alpha), [x])
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), [x])
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda a: jax.nn.celu(a, alpha=alpha), [x])
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope=negative_slope), [x])
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        c_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape[c_axis] = -1
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return apply_op("prelu", fn, [x, weight])
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op("hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0), [x])
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op("softshrink",
+                    lambda a: jnp.where(a > threshold, a - threshold,
+                                        jnp.where(a < -threshold, a + threshold, 0)), [x])
+
+
+def tanhshrink(x, name=None):
+    return apply_op("tanhshrink", lambda a: a - jnp.tanh(a), [x])
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return apply_op("hardtanh", lambda a: jnp.clip(a, min, max), [x])
+
+
+def hardsigmoid(x, slope=1 / 6, offset=0.5, name=None):
+    return apply_op("hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), [x])
+
+
+def hardswish(x, name=None):
+    return apply_op("hardswish", lambda a: a * jnp.clip(a + 3, 0, 6) / 6, [x])
+
+
+def mish(x, name=None):
+    return apply_op("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), [x])
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op("softplus",
+                    lambda a: jnp.where(beta * a > threshold, a,
+                                        jax.nn.softplus(beta * a) / beta), [x])
+
+
+def softsign(x, name=None):
+    return apply_op("softsign", jax.nn.soft_sign, [x])
+
+
+def glu(x, axis=-1, name=None):
+    def fn(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return apply_op("glu", fn, [x])
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(_random.split_key(), tuple(_arr(x).shape), minval=1e-20, maxval=1.0)))
+
+    def fn(a):
+        y = jax.nn.softmax((a + g.astype(a.dtype)) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis)
+            y_hard = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
+            y = y_hard + y - lax.stop_gradient(y)  # straight-through estimator
+        return y
+    return apply_op("gumbel_softmax", fn, [x])
+
+
+# ----------------------------------------------------------------- dropout
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    """Reference: functional/common.py dropout; phi dropout kernel semantics
+    (upscale_in_train = inverted dropout)."""
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply_op("dropout_scale", lambda a: a * (1 - p), [x])
+        return x
+    if p == 1.0:
+        return apply_op("dropout", lambda a: jnp.zeros_like(a), [x])
+    shape = tuple(_arr(x).shape)
+    if axis is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        mshape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
+    else:
+        mshape = shape
+    keep = jax.random.bernoulli(_random.split_key(), 1.0 - p, mshape)
+
+    def fn(a):
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return apply_op("dropout", fn, [x])
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    neg_sat = -alpha * scale
+    keep = jax.random.bernoulli(_random.split_key(), 1.0 - p, tuple(_arr(x).shape))
+    a_coef = (1.0 / math.sqrt((1 - p) * (1 + p * neg_sat ** 2)))
+    b_coef = -a_coef * p * neg_sat
+
+    def fn(a):
+        out = jnp.where(keep, a, neg_sat)
+        return (a_coef * out + b_coef).astype(a.dtype)
+    return apply_op("alpha_dropout", fn, [x])
+
+
+# ----------------------------------------------------------------- norms
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+    axes = tuple(builtins.range(_arr(x).ndim - n_axes, _arr(x).ndim))
+
+    def fn(a, *wb):
+        mu = a.mean(axis=axes, keepdims=True)
+        var = ((a - mu) ** 2).mean(axis=axes, keepdims=True)
+        out = (a - mu) * lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out.astype(a.dtype)
+
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply_op("layer_norm", fn, args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1, name=None):
+    """RMSNorm — beyond-reference op needed by modern LLM families."""
+    def fn(a, *w):
+        dt = a.dtype
+        a32 = a.astype(jnp.float32)
+        ms = jnp.mean(a32 * a32, axis=axis, keepdims=True)
+        out = a32 * lax.rsqrt(ms + epsilon)
+        if w:
+            out = out * w[0].astype(jnp.float32)
+        return out.astype(dt)
+    args = [x] + ([weight] if weight is not None else [])
+    return apply_op("rms_norm", fn, args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None, name=None):
+    """Reference: functional/norm.py batch_norm. In training mode the running
+    stats are updated in place on the provided buffer tensors (host-side
+    assignment, XLA-functional under the hood)."""
+    c_axis = 1 if data_format.startswith("NC") else _arr(x).ndim - 1
+    reduce_axes = tuple(i for i in builtins.range(_arr(x).ndim) if i != c_axis)
+    bshape = [1] * _arr(x).ndim
+    bshape[c_axis] = -1
+
+    use_batch_stats = training and not use_global_stats
+    if use_batch_stats:
+        batch_mean = jnp.mean(_arr(x), axis=reduce_axes)
+        batch_var = jnp.var(_arr(x), axis=reduce_axes)
+        if running_mean is not None:
+            running_mean._data = momentum * running_mean._data + (1 - momentum) * batch_mean
+            running_var._data = momentum * running_var._data + (1 - momentum) * batch_var
+
+    def fn(a, *wb):
+        if use_batch_stats:
+            mu = a.mean(axis=reduce_axes, keepdims=True)
+            var = a.var(axis=reduce_axes, keepdims=True)
+        else:
+            mu = running_mean._data.reshape(bshape)
+            var = running_var._data.reshape(bshape)
+        out = (a - mu) * lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape)
+        return out.astype(a.dtype)
+
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply_op("batch_norm", fn, args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def fn(a, *wb):
+        n, c = a.shape[0], a.shape[1]
+        rest = a.shape[2:]
+        g = a.reshape(n, num_groups, c // num_groups, *rest)
+        axes = tuple(builtins.range(2, g.ndim))
+        mu = g.mean(axis=axes, keepdims=True)
+        var = g.var(axis=axes, keepdims=True)
+        out = ((g - mu) * lax.rsqrt(var + epsilon)).reshape(a.shape)
+        bshape = [1, c] + [1] * len(rest)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape)
+        return out.astype(a.dtype)
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply_op("group_norm", fn, args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    def fn(a, *wb):
+        axes = tuple(builtins.range(2, a.ndim))
+        mu = a.mean(axis=axes, keepdims=True)
+        var = a.var(axis=axes, keepdims=True)
+        out = (a - mu) * lax.rsqrt(var + eps)
+        bshape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape)
+        return out.astype(a.dtype)
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply_op("instance_norm", fn, args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def fn(a):
+        sq = a * a
+        half = size // 2
+        c = a.shape[1]
+        pads = [(0, 0)] * a.ndim
+        pads[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(a)
+        for i in builtins.range(size):
+            acc = acc + lax.dynamic_slice_in_dim(padded, i, c, axis=1)
+        return a / jnp.power(k + alpha * acc / size, beta)
+    return apply_op("local_response_norm", fn, [x])
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(a):
+        nrm = jnp.linalg.norm(a, ord=p, axis=axis, keepdims=True)
+        return a / jnp.maximum(nrm, epsilon)
+    return apply_op("normalize", fn, [x])
+
+
+# ----------------------------------------------------------------- losses
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    """Reference: functional/loss.py cross_entropy → phi
+    softmax_with_cross_entropy kernel. Stable log_softmax + gather; on TPU the
+    whole thing fuses into a couple of VPU passes."""
+    lbl = _arr(label)
+    w = _arr(weight) if weight is not None else None
+
+    def fn(logits):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-30, None))
+        n_classes = logits.shape[axis]
+        if soft_label:
+            tgt = lbl.astype(jnp.float32)
+            if label_smoothing > 0:
+                tgt = tgt * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -(tgt * logp).sum(axis=axis)
+            if reduction == "none":
+                return loss
+            return _reduce_loss(loss, reduction)
+        idx = lbl.astype(jnp.int32)
+        squeeze = False
+        if idx.ndim == logp.ndim:  # [..., 1] labels
+            idx = jnp.squeeze(idx, axis=axis)
+            squeeze = True
+        safe_idx = jnp.where(idx == ignore_index, 0, idx)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe_idx, axis), axis=axis)
+        picked = jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0:
+            smooth = logp.mean(axis=axis)
+            nll = -(1 - label_smoothing) * picked - label_smoothing * smooth
+        else:
+            nll = -picked
+        valid = (idx != ignore_index)
+        nll = jnp.where(valid, nll, 0.0)
+        if w is not None:
+            ww = jnp.take(w, safe_idx)
+            nll = nll * jnp.where(valid, ww, 0.0)
+            if reduction == "mean":
+                denom = jnp.sum(jnp.where(valid, ww, 0.0))
+                return jnp.sum(nll) / jnp.maximum(denom, 1e-12)
+        if reduction == "mean":
+            denom = jnp.maximum(valid.sum(), 1)
+            return jnp.sum(nll) / denom
+        return _reduce_loss(nll, reduction)
+    return apply_op("cross_entropy", fn, [input])
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    from ..core.ops import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):  # noqa: A002
+    lbl = _arr(label)
+    w = _arr(weight) if weight is not None else None
+
+    def fn(logp):
+        idx = lbl.astype(jnp.int32)
+        safe = jnp.where(idx == ignore_index, 0, idx)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0] if logp.ndim == idx.ndim + 1 \
+            else jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+        nll = -picked
+        valid = idx != ignore_index
+        nll = jnp.where(valid, nll, 0.0)
+        if w is not None:
+            ww = jnp.take(w, safe)
+            nll = nll * jnp.where(valid, ww, 0.0)
+            if reduction == "mean":
+                return jnp.sum(nll) / jnp.maximum(jnp.sum(jnp.where(valid, ww, 0.0)), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(valid.sum(), 1)
+        return _reduce_loss(nll, reduction)
+    return apply_op("nll_loss", fn, [input])
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply_op("mse_loss", lambda a, b: _reduce_loss((a - b) ** 2, reduction), [input, label])
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply_op("l1_loss", lambda a, b: _reduce_loss(jnp.abs(a - b), reduction), [input, label])
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    def fn(a, b):
+        d = a - b
+        loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d / delta, jnp.abs(d) - 0.5 * delta)
+        return _reduce_loss(loss, reduction)
+    return apply_op("smooth_l1_loss", fn, [input, label])
+
+
+def kl_div(input, label, reduction="mean", name=None):  # noqa: A002
+    def fn(logp, tgt):
+        loss = tgt * (jnp.log(jnp.clip(tgt, 1e-30, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce_loss(loss, reduction)
+    return apply_op("kl_div", fn, [input, label])
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    def fn(p, t, *w):
+        loss = -(t * jnp.log(jnp.clip(p, 1e-12, None)) +
+                 (1 - t) * jnp.log(jnp.clip(1 - p, 1e-12, None)))
+        if w:
+            loss = loss * w[0]
+        return _reduce_loss(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op("bce", fn, args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(z, t, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+        log_sig = jax.nn.log_sigmoid(z)
+        log_one_minus = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            loss = -(pw * t * log_sig + (1 - t) * log_one_minus)
+        else:
+            loss = -(t * log_sig + (1 - t) * log_one_minus)
+        if w is not None:
+            loss = loss * w
+        return _reduce_loss(loss, reduction)
+    args = [logit, label] + [t for t in (weight, pos_weight) if t is not None]
+    return apply_op("bce_with_logits", fn, args)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):  # noqa: A002
+    def fn(a, b, y):
+        loss = jnp.maximum(0, -y * (a - b) + margin)
+        return _reduce_loss(loss, reduction)
+    return apply_op("margin_ranking_loss", fn, [input, other, label])
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        dot = (a * b).sum(axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+    return apply_op("cosine_similarity", fn, [x1, x2])
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = (a * b).sum(axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0, cos - margin))
+        return _reduce_loss(loss, reduction)
+    return apply_op("cosine_embedding_loss", fn, [input1, input2, label])
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    def fn(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0, margin - a))
+        return _reduce_loss(loss, reduction)
+    return apply_op("hinge_embedding_loss", fn, [input, label])
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,  # noqa: A002
+                        swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce_loss(jnp.maximum(dp - dn + margin, 0), reduction)
+    return apply_op("triplet_margin_loss", fn, [input, positive, negative])
+
+
+def square_error_cost(input, label, name=None):  # noqa: A002
+    return apply_op("square_error_cost", lambda a, b: (a - b) ** 2, [input, label])
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    def fn(p, t):
+        return -t * jnp.log(p + epsilon) - (1 - t) * jnp.log(1 - p + epsilon)
+    return apply_op("log_loss", fn, [input, label])
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, t, *nrm):
+        p = jax.nn.sigmoid(z)
+        ce = -(t * jax.nn.log_sigmoid(z) + (1 - t) * jax.nn.log_sigmoid(-z))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if nrm:
+            loss = loss / nrm[0]
+        return _reduce_loss(loss, reduction)
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply_op("sigmoid_focal_loss", fn, args)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Reference: functional/loss.py ctc_loss (warpctc op). Implemented with
+    the standard alpha-recursion in log space via lax.scan."""
+    lp = _arr(log_probs)  # [T, B, C] paddle layout
+    lab = _arr(labels)    # [B, L]
+    in_len = _arr(input_lengths)
+    lab_len = _arr(label_lengths)
+
+    def fn(lp_):
+        T, B, C = lp_.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        # extended label seq: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, S), blank, dtype=lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        neg_inf = jnp.float32(-1e30)
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp_[0, jnp.arange(B), blank])
+        first_lab = lp_[0, jnp.arange(B), ext[:, 1]]
+        alpha0 = alpha0.at[:, 1].set(jnp.where(L > 0, first_lab, neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a_shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, merged + emit
+
+        alphas_last, alphas = lax.scan(step, alpha0, lp_[1:])
+        all_alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+        t_idx = jnp.clip(in_len - 1, 0, T - 1)
+        final = all_alphas[t_idx, jnp.arange(B)]  # [B, S]
+        end1 = jnp.take_along_axis(final, (2 * lab_len)[:, None], axis=1)[:, 0]
+        end2 = jnp.take_along_axis(final, jnp.clip(2 * lab_len - 1, 0, S - 1)[:, None], axis=1)[:, 0]
+        ll = jnp.logaddexp(end1, jnp.where(lab_len > 0, end2, neg_inf))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len, 1))
+        return _reduce_loss(loss, reduction)
+    return apply_op("ctc_loss", fn, [log_probs])
+
+
+# ----------------------------------------------------------------- attention
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Fused attention entry point. [B, S, H, D] layout (paddle convention).
+
+    Uses the Pallas flash-attention kernel on TPU when shapes allow (see
+    paddle_tpu/ops/pallas/flash_attention.py), else a reference jnp path —
+    beyond the reference snapshot, which has no flash attention (SURVEY §5.7).
+    """
+    from ..ops import attention as _attn
+    return _attn.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training)
+
+
+# ----------------------------------------------------------------- misc
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    from ..core.ops import pad as _pad
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(t, *pd):
+        n = t.shape[-1]
+        if pd:
+            return (1 - epsilon) * t + epsilon * pd[0]
+        return (1 - epsilon) * t + epsilon / n
+    args = [label] + ([prior_dist] if prior_dist is not None else [])
+    return apply_op("label_smooth", fn, args)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    ln = _arr(lengths)
+    m = int(maxlen) if maxlen is not None else int(np.asarray(ln).max())
+    out = (jnp.arange(m)[None, :] < ln[..., None]).astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    """Reference: functional/common.py interpolate (phi interpolate kernels).
+    nearest & (bi)linear supported on NCHW/NCL."""
+    a = _arr(x)
+    spatial_ndim = a.ndim - 2
+    if size is not None:
+        out_size = _norm_tuple(size, spatial_ndim)
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * spatial_ndim
+        out_size = tuple(int(a.shape[2 + i] * sf[i]) for i in builtins.range(spatial_ndim))
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def fn(arr):
+        out_shape = (*arr.shape[:2], *out_size)
+        return jax.image.resize(arr, out_shape, method=jmode)
+    return apply_op("interpolate", fn, [x])
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(a):
+        n, c, h, w = a.shape
+        oc = c // (r * r)
+        out = a.reshape(n, oc, r, r, h, w)
+        out = out.transpose(0, 1, 4, 2, 5, 3)
+        return out.reshape(n, oc, h * r, w * r)
+    return apply_op("pixel_shuffle", fn, [x])
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: functional/common.py unfold)."""
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    p = _norm_tuple(paddings, 2)
+    d = _norm_tuple(dilations, 2)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a_p = jnp.pad(a, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+        oh = (h + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (w + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        cols = []
+        for i in builtins.range(k[0]):
+            for j in builtins.range(k[1]):
+                patch = a_p[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                            j * d[1]: j * d[1] + ow * s[1]: s[1]]
+                cols.append(patch)
+        out = jnp.stack(cols, axis=2)  # [N, C, k*k, oh, ow]
+        return out.reshape(n, c * k[0] * k[1], oh * ow)
+    return apply_op("unfold", fn, [x])
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def fn(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]), v[:, :-1, fold:2 * fold]], axis=1)
+        rest = v[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+    return apply_op("temporal_shift", fn, [x])
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    g = _arr(grid)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        gx = (g[..., 0] + 1) * (w - 1) / 2 if align_corners else ((g[..., 0] + 1) * w - 1) / 2
+        gy = (g[..., 1] + 1) * (h - 1) / 2 if align_corners else ((g[..., 1] + 1) * h - 1) / 2
+        x0 = jnp.floor(gx); y0 = jnp.floor(gy)
+        x1, y1 = x0 + 1, y0 + 1
+        wx1 = gx - x0; wx0 = 1 - wx1
+        wy1 = gy - y0; wy0 = 1 - wy1
+
+        def sample(yy, xx):
+            valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            vals = a[jnp.arange(n)[:, None, None], :, yc, xc]  # [N, gh, gw, C]
+            return jnp.where(valid[..., None], vals, 0.0)
+
+        out = (sample(y0, x0) * (wy0 * wx0)[..., None] +
+               sample(y0, x1) * (wy0 * wx1)[..., None] +
+               sample(y1, x0) * (wy1 * wx0)[..., None] +
+               sample(y1, x1) * (wy1 * wx1)[..., None])
+        return jnp.moveaxis(out, -1, 1)
+    return apply_op("grid_sample", fn, [x])
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def fn(th):
+        n, c, h, w = out_shape
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [h, w, 3]
+        return jnp.einsum("hwk,nok->nhwo", base, th)
+    return apply_op("affine_grid", fn, [theta])

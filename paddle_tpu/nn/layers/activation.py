@@ -1,0 +1,85 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from ..layer import Layer
+from .. import functional as F
+from .. import initializer as I
+
+
+def _act_layer(name, fn, **defaults):
+    class _Act(Layer):
+        def __init__(self, *a, name=None, **kw):
+            super().__init__()
+            merged = dict(defaults)
+            keys = list(defaults.keys())
+            for i, v in enumerate(a):
+                merged[keys[i]] = v
+            merged.update({k: v for k, v in kw.items() if k in merged})
+            self._kw = merged
+
+        def forward(self, x):
+            return fn(x, **self._kw)
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _act_layer("ReLU", lambda x: F.relu(x))
+ReLU6 = _act_layer("ReLU6", lambda x: F.relu6(x))
+GELU = _act_layer("GELU", F.gelu, approximate=False)
+SiLU = _act_layer("SiLU", lambda x: F.silu(x))
+Swish = _act_layer("Swish", lambda x: F.silu(x))
+ELU = _act_layer("ELU", F.elu, alpha=1.0)
+SELU = _act_layer("SELU", lambda x: F.selu(x))
+CELU = _act_layer("CELU", F.celu, alpha=1.0)
+LeakyReLU = _act_layer("LeakyReLU", F.leaky_relu, negative_slope=0.01)
+Sigmoid = _act_layer("Sigmoid", lambda x: F.sigmoid(x))
+Tanh = _act_layer("Tanh", lambda x: F.tanh(x))
+Softmax = _act_layer("Softmax", F.softmax, axis=-1)
+LogSoftmax = _act_layer("LogSoftmax", F.log_softmax, axis=-1)
+Hardtanh = _act_layer("Hardtanh", F.hardtanh, min=-1.0, max=1.0)
+Hardsigmoid = _act_layer("Hardsigmoid", lambda x: F.hardsigmoid(x))
+Hardswish = _act_layer("Hardswish", lambda x: F.hardswish(x))
+Hardshrink = _act_layer("Hardshrink", F.hardshrink, threshold=0.5)
+Softshrink = _act_layer("Softshrink", F.softshrink, threshold=0.5)
+Tanhshrink = _act_layer("Tanhshrink", lambda x: F.tanhshrink(x))
+Mish = _act_layer("Mish", lambda x: F.mish(x))
+Softplus = _act_layer("Softplus", F.softplus, beta=1.0, threshold=20.0)
+Softsign = _act_layer("Softsign", lambda x: F.softsign(x))
+GLU = _act_layer("GLU", F.glu, axis=-1)
+ThresholdedReLU = _act_layer("ThresholdedReLU",
+                             lambda x, threshold=1.0: x * (x > threshold).astype(x.dtype),
+                             threshold=1.0)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups, self.axis = groups, axis
+
+    def forward(self, x):
+        from ...core.tensor import apply_op
+        import jax.numpy as jnp
+        g = self.groups
+        ax = self.axis
+
+        def fn(a):
+            c = a.shape[ax]
+            new_shape = list(a.shape)
+            new_shape[ax] = c // g
+            new_shape.insert(ax + 1, g)
+            return a.reshape(new_shape).max(axis=ax + 1)
+        return apply_op("maxout", fn, [x])

@@ -1,0 +1,280 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+The reference implements RNNs as per-step cells driven by an imperative loop
+(or cudnn fused kernels). TPU-native design: the whole time loop is a single
+`lax.scan` inside one tape op — XLA compiles it to one fused loop, and the
+scan transposes cleanly under vjp for BPTT. Weight naming matches the
+reference (weight_ih_l{k}, weight_hh_l{k}, ...) for state_dict parity.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..layer import Layer
+from .. import initializer as I
+from ...core.tensor import Tensor, apply_op
+from ...core import ops
+
+
+def _cell_params(layer, input_size, hidden_size, gates, suffix):
+    bound = 1.0 / math.sqrt(hidden_size)
+    w_ih = layer.create_parameter([gates * hidden_size, input_size],
+                                  default_initializer=I.Uniform(-bound, bound))
+    w_hh = layer.create_parameter([gates * hidden_size, hidden_size],
+                                  default_initializer=I.Uniform(-bound, bound))
+    b_ih = layer.create_parameter([gates * hidden_size], is_bias=True,
+                                  default_initializer=I.Uniform(-bound, bound))
+    b_hh = layer.create_parameter([gates * hidden_size], is_bias=True,
+                                  default_initializer=I.Uniform(-bound, bound))
+    layer.add_parameter(f"weight_ih_{suffix}", w_ih)
+    layer.add_parameter(f"weight_hh_{suffix}", w_hh)
+    layer.add_parameter(f"bias_ih_{suffix}", b_ih)
+    layer.add_parameter(f"bias_hh_{suffix}", b_hh)
+    return w_ih, w_hh, b_ih, b_hh
+
+
+def _lstm_step(x_t, h, c, w_ih, w_hh, b_ih, b_hh):
+    z = x_t @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh):
+    gi = x_t @ w_ih.T + b_ih
+    gh = h @ w_hh.T + b_hh
+    ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+    hr, hz, hn = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(in_ + r * hn)
+    return (1 - z) * n + z * h
+
+
+def _rnn_step(x_t, h, w_ih, w_hh, b_ih, b_hh, act):
+    out = x_t @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    return jnp.tanh(out) if act == "tanh" else jax.nn.relu(out)
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        _cell_params(self, input_size, hidden_size, 1, "l0")
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = ops.zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+        act = self.activation
+        out = apply_op("rnn_cell",
+                       lambda x, h, wi, wh, bi, bh: _rnn_step(x, h, wi, wh, bi, bh, act),
+                       [inputs, states, self.weight_ih_l0, self.weight_hh_l0,
+                        self.bias_ih_l0, self.bias_hh_l0])
+        return out, out
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        _cell_params(self, input_size, hidden_size, 4, "l0")
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            z = ops.zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+            states = (z, ops.zeros_like(z))
+        h, c = states
+        h_new, c_new = apply_op(
+            "lstm_cell",
+            lambda x, hh, cc, wi, wh, bi, bh: _lstm_step(x, hh, cc, wi, wh, bi, bh),
+            [inputs, h, c, self.weight_ih_l0, self.weight_hh_l0,
+             self.bias_ih_l0, self.bias_hh_l0], n_outputs=2)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        _cell_params(self, input_size, hidden_size, 3, "l0")
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = ops.zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+        out = apply_op("gru_cell",
+                       lambda x, h, wi, wh, bi, bh: _gru_step(x, h, wi, wh, bi, bh),
+                       [inputs, states, self.weight_ih_l0, self.weight_hh_l0,
+                        self.bias_ih_l0, self.bias_hh_l0])
+        return out, out
+
+
+class _RNNBase(Layer):
+    MODE_GATES = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh"):
+        super().__init__()
+        self.mode = mode
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirectional else 1
+        gates = self.MODE_GATES[mode]
+        for layer_i in range(num_layers):
+            in_size = input_size if layer_i == 0 else hidden_size * self.num_directions
+            _cell_params(self, in_size, hidden_size, gates, f"l{layer_i}")
+            if self.bidirectional:
+                _cell_params(self, in_size, hidden_size, gates, f"l{layer_i}_reverse")
+
+    def _params_for(self, layer_i, reverse):
+        sfx = f"l{layer_i}" + ("_reverse" if reverse else "")
+        return (getattr(self, f"weight_ih_{sfx}"), getattr(self, f"weight_hh_{sfx}"),
+                getattr(self, f"bias_ih_{sfx}"), getattr(self, f"bias_hh_{sfx}"))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # normalize to batch-major [B, T, C]
+        x = inputs if not self.time_major else ops.transpose(inputs, [1, 0, 2])
+        b = x.shape[0]
+        mode = self.mode
+        is_lstm = mode == "LSTM"
+        n_states = self.num_layers * self.num_directions
+
+        if initial_states is None:
+            z = ops.zeros([n_states, b, self.hidden_size], dtype=x.dtype)
+            initial_states = (z, ops.zeros_like(z)) if is_lstm else z
+
+        h0 = initial_states[0] if is_lstm else initial_states
+        c0 = initial_states[1] if is_lstm else None
+
+        all_params = []
+        for li in range(self.num_layers):
+            for rev in (False, True) if self.bidirectional else (False,):
+                all_params.extend(self._params_for(li, rev))
+
+        num_layers, num_dirs = self.num_layers, self.num_directions
+        hidden = self.hidden_size
+
+        def fn(xx, hh0, *rest):
+            if is_lstm:
+                cc0 = rest[0]
+                flat = rest[1:]
+            else:
+                cc0 = None
+                flat = rest
+            layer_in = jnp.swapaxes(xx, 0, 1)  # [T, B, C]
+            h_finals, c_finals = [], []
+            pi = 0
+            for li in range(num_layers):
+                dir_outs = []
+                for d in range(num_dirs):
+                    wi, wh, bi_, bh = flat[pi:pi + 4]
+                    pi += 4
+                    idx = li * num_dirs + d
+                    h_init = hh0[idx]
+                    seq = layer_in if d == 0 else jnp.flip(layer_in, axis=0)
+                    if is_lstm:
+                        c_init = cc0[idx]
+
+                        def step(carry, x_t, wi=wi, wh=wh, bi_=bi_, bh=bh):
+                            h, c = carry
+                            h2, c2 = _lstm_step(x_t, h, c, wi, wh, bi_, bh)
+                            return (h2, c2), h2
+                        (h_f, c_f), outs = lax.scan(step, (h_init, c_init), seq)
+                        c_finals.append(c_f)
+                    elif mode == "GRU":
+                        def step(h, x_t, wi=wi, wh=wh, bi_=bi_, bh=bh):
+                            h2 = _gru_step(x_t, h, wi, wh, bi_, bh)
+                            return h2, h2
+                        h_f, outs = lax.scan(step, h_init, seq)
+                    else:
+                        act = "tanh" if mode == "RNN_TANH" else "relu"
+
+                        def step(h, x_t, wi=wi, wh=wh, bi_=bi_, bh=bh, act=act):
+                            h2 = _rnn_step(x_t, h, wi, wh, bi_, bh, act)
+                            return h2, h2
+                        h_f, outs = lax.scan(step, h_init, seq)
+                    h_finals.append(h_f)
+                    if d == 1:
+                        outs = jnp.flip(outs, axis=0)
+                    dir_outs.append(outs)
+                layer_in = jnp.concatenate(dir_outs, axis=-1) if num_dirs == 2 else dir_outs[0]
+            out = jnp.swapaxes(layer_in, 0, 1)  # [B, T, H*dirs]
+            h_stack = jnp.stack(h_finals, axis=0)
+            if is_lstm:
+                return out, h_stack, jnp.stack(c_finals, axis=0)
+            return out, h_stack
+
+        args = [x, h0] + ([c0] if is_lstm else []) + all_params
+        if is_lstm:
+            out, h_n, c_n = apply_op(mode, fn, args, n_outputs=3)
+            final = (h_n, c_n)
+        else:
+            out, h_n = apply_op(mode, fn, args, n_outputs=2)
+            final = h_n
+        if self.time_major:
+            out = ops.transpose(out, [1, 0, 2])
+        return out, final
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kw):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class RNN(Layer):
+    """Generic cell driver (reference: nn/layer/rnn.py RNN) — python loop over
+    time for arbitrary cells; prefer LSTM/GRU classes for compiled scans."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse, self.time_major = is_reverse, time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs if not self.time_major else ops.transpose(inputs, [1, 0, 2])
+        steps = range(x.shape[1])
+        if self.is_reverse:
+            steps = reversed(list(steps))
+        state = initial_states
+        outs = []
+        for tstep in steps:
+            out, state = self.cell(x[:, tstep], state)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = ops.stack(outs, axis=1)
+        if self.time_major:
+            out = ops.transpose(out, [1, 0, 2])
+        return out, state

@@ -1,0 +1,84 @@
+"""Fused attention ops.
+
+The reference snapshot has only non-flash fused attention with O(S^2) memory
+(paddle/fluid/operators/fused/fused_attention_op.cu, SURVEY §5.7) and no
+sequence parallelism. Here attention is a first-class fused op: a Pallas
+flash-attention kernel on TPU (paddle_tpu/ops/pallas/flash_attention.py) with
+an XLA reference path everywhere else, both differentiable. Layout follows
+the paddle convention [batch, seq, num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..core import random as _random
+
+
+def _use_pallas(q_shape, head_dim):
+    try:
+        d = jax.devices()[0].platform
+    except RuntimeError:
+        return False
+    if d not in ("tpu", "axon"):
+        return False
+    # MXU-friendly constraints for the kernel
+    return head_dim % 128 == 0 and q_shape[1] % 128 == 0
+
+
+def attention_reference(q, k, v, mask=None, is_causal=False, scale=None,
+                        dropout_p=0.0, dropout_key=None):
+    """Reference jnp attention on [B, S, H, D]; fp32 softmax accumulation."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    dt = q.dtype
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if is_causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        logits = jnp.where(cmask, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(dt), v)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, scale=None):
+    """Eager entry point on Tensors."""
+    mask_arr = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
+    dk = _random.split_key() if (dropout_p > 0.0 and training) else None
+    use_flash = (mask_arr is None and (dropout_p == 0.0 or not training)
+                 and _use_pallas(tuple(query._data.shape), query._data.shape[-1]))
+
+    if use_flash:
+        from .pallas.flash_attention import flash_attention
+
+        def fn(q, k, v):
+            return flash_attention(q, k, v, causal=is_causal, scale=scale)
+        return apply_op("flash_attention", fn, [query, key, value])
+
+    def fn(q, k, v):
+        return attention_reference(q, k, v, mask=mask_arr, is_causal=is_causal,
+                                   scale=scale, dropout_p=dropout_p if training else 0.0,
+                                   dropout_key=dk)
+    return apply_op("sdpa", fn, [query, key, value])
+
+
+def functional_attention(q, k, v, *, is_causal=False, scale=None):
+    """Pure-array attention for jitted model code: picks flash kernel on TPU,
+    reference path elsewhere. Differentiable in both cases."""
+    if _use_pallas(tuple(q.shape), q.shape[-1]):
+        from .pallas.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=is_causal, scale=scale)
+    return attention_reference(q, k, v, is_causal=is_causal, scale=scale)

@@ -1,0 +1,134 @@
+"""Pallas flash attention for TPU.
+
+Beyond-reference capability (SURVEY §5.7: the reference snapshot has no flash
+attention — its fused_attention_op.cu materializes the full S×S probability
+matrix). This kernel computes attention blockwise with an online softmax so
+HBM traffic is O(S·D) instead of O(S²): Q tiles stay resident in VMEM, K/V
+stream through in BK-sized blocks, and the MXU sees [BQ,D]x[D,BK] matmuls.
+
+Layout: [batch, seq, heads, head_dim] in, same out (paddle convention).
+Forward is the Pallas kernel; backward currently recomputes through the XLA
+reference path under jax.custom_vjp (correct, O(S²) peak in backward —
+a blockwise backward kernel is the planned upgrade).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BQ = 256
+DEFAULT_BK = 256
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bk):
+    """One (batch*head, q_block) program: online-softmax over K/V blocks."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale           # [BQ, D]
+    bq = q.shape[0]
+    s_k = k_ref.shape[1]
+    n_kb = s_k // bk
+
+    m0 = jnp.full((bq, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, q.shape[1]), jnp.float32)
+
+    if causal:
+        # only blocks whose start is <= last query index of this tile
+        upper = lax.div((qi + 1) * bq + bk - 1, bk)
+        upper = jnp.minimum(upper, n_kb)
+    else:
+        upper = n_kb
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(ki * bk, bk), :].astype(jnp.float32)   # [BK, D]
+        v = v_ref[0, pl.ds(ki * bk, bk), :].astype(jnp.float32)   # [BK, D]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)   # [BQ, BK]
+        if causal:
+            q_idx = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_idx = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_idx >= k_idx, s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = corr * l + p.sum(axis=-1, keepdims=True)
+        acc_new = corr * acc + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, scale, causal, bq, bk, interpret):
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    # fold heads into batch; seq-major for contiguous K/V streaming
+    qt = jnp.moveaxis(q, 2, 1).reshape(b * h, s_q, d)
+    kt = jnp.moveaxis(k, 2, 1).reshape(b * h, s_k, d)
+    vt = jnp.moveaxis(v, 2, 1).reshape(b * h, s_k, d)
+
+    grid = (b * h, s_q // bq)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, bk=bk),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.moveaxis(out.reshape(b, h, s_q, d), 1, 2)
+
+
+def _reference(q, k, v, *, scale, causal):
+    from ..attention import attention_reference
+    return attention_reference(q, k, v, is_causal=causal, scale=scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, bq, bk, interpret):
+    return _flash_fwd(q, k, v, scale=scale, causal=causal, bq=bq, bk=bk,
+                      interpret=interpret)
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret):
+    out = _flash_fwd(q, k, v, scale=scale, causal=causal, bq=bq, bk=bk,
+                     interpret=interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(scale, causal, bq, bk, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _reference(a, b, c, scale=scale, causal=causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale=None,
+                    block_q: int = None, block_k: int = None,
+                    interpret: bool = False):
+    """Differentiable flash attention on [B, S, H, D] arrays."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s_q, s_k = q.shape[1], k.shape[1]
+    bq = block_q or min(DEFAULT_BQ, s_q)
+    bk = block_k or min(DEFAULT_BK, s_k)
+    while s_q % bq:
+        bq //= 2
+    while s_k % bk:
+        bk //= 2
+    if bq < 8 or bk < 8:
+        return _reference(q, k, v, scale=scale, causal=causal)
+    return _flash(q, k, v, float(scale), bool(causal), int(bq), int(bk), bool(interpret))

@@ -1,0 +1,7 @@
+"""paddle.optimizer analog (reference: python/paddle/optimizer/__init__.py:27-38
+— Optimizer, Adagrad, Adam, AdamW, Adamax, RMSProp, Adadelta, SGD, Momentum,
+Lamb + lr)."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp, Lamb,
+)

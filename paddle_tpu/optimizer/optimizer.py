@@ -1,0 +1,390 @@
+"""Optimizer base + SGD/Momentum/Adam/AdamW/...
+
+Reference: python/paddle/optimizer/optimizer.py (Optimizer base),
+adam.py/adamw.py/momentum.py etc., lowering to phi kernels (sgd_kernel,
+adam_kernel). TPU-native design: every optimizer is a PURE update rule
+`(param, grad, state, lr, step) -> (param', state')` over jnp arrays, used
+
+1. eagerly by `.step()` (per-parameter, jit-cached by shape), and
+2. functionally by paddle_tpu.jit.TrainStep over whole pytrees — the fused,
+   donated, XLA-compiled path where real training runs.
+
+This removes the reference's duality of C++ optimizer kernels vs python
+wrappers: one rule, two drivers.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import autograd
+from .lr import LRScheduler
+
+
+def _global_norm_clip(grads, clip_norm):
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads))
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(total, 1e-12))
+    return [g * scale.astype(g.dtype) for g in grads], total
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer/optimizer.py Optimizer).
+
+    Subclasses implement `init_state(param) -> dict` and
+    `update(param, grad, state, lr, step) -> (param, state)` as pure fns.
+    """
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else None
+        self._weight_decay = 0.0 if weight_decay is None else weight_decay
+        self._grad_clip = grad_clip
+        self._step_count = 0
+        self._states: Dict[int, dict] = {}
+        self._accumulated_grads: Dict[int, jnp.ndarray] = {}
+
+    # ------------------------------------------------------------- pure rule
+    def init_state(self, param: jnp.ndarray) -> dict:
+        return {}
+
+    def update(self, param, grad, state, lr, step):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ eager API
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr.get_lr())
+        return float(self._lr)
+
+    def set_lr(self, value: float):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("optimizer's lr is an LRScheduler; call scheduler.step()")
+        self._lr = value
+
+    @property
+    def _param_list(self):
+        if self._parameters is None:
+            raise ValueError("Optimizer created without parameters; pass parameters=")
+        return self._parameters
+
+    def step(self):
+        """Apply one eager update from `.grad` fields (reference:
+        Optimizer.step → _apply_optimize)."""
+        lr = self.get_lr()
+        self._step_count += 1
+        params, grads = [], []
+        for p in self._param_list:
+            if p.grad is None or p.stop_gradient:
+                continue
+            params.append(p)
+            grads.append(p.grad._data)
+
+        wd_applicable = [self._wd_for(p) for p in params]
+        if self._grad_clip is not None and grads:
+            cls = type(self._grad_clip).__name__
+            if cls == "ClipGradByGlobalNorm":
+                grads, _ = _global_norm_clip(grads, self._grad_clip.clip_norm)
+            elif cls == "ClipGradByNorm":
+                cn = self._grad_clip.clip_norm
+                grads = [g * jnp.minimum(1.0, cn / jnp.maximum(
+                    jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32)))), 1e-12)).astype(g.dtype)
+                    for g in grads]
+            elif cls == "ClipGradByValue":
+                grads = [jnp.clip(g, self._grad_clip.min, self._grad_clip.max) for g in grads]
+
+        for p, g, wd in zip(params, grads, wd_applicable):
+            st = self._states.get(id(p))
+            if st is None:
+                st = self.init_state(p._data)
+                self._states[id(p)] = st
+            new_p, new_st = self._jit_update(wd)(p._data, g, st, jnp.float32(lr),
+                                                 jnp.int32(self._step_count))
+            p._data = new_p
+            p._node = None
+            self._states[id(p)] = new_st
+
+    def _wd_for(self, p) -> float:
+        return float(self._weight_decay) if self._weight_decay else 0.0
+
+    def _jit_update(self, wd):
+        key = ("u", wd)
+        cache = self.__dict__.setdefault("_jit_cache", {})
+        if key not in cache:
+            def fn(param, grad, state, lr, step, _wd=wd):
+                return self.update(param, grad, state, lr, step, _wd)
+            cache[key] = jax.jit(fn)
+        return cache[key]
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._param_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    # --------------------------------------------------------- state_dict
+    def state_dict(self) -> dict:
+        out = {"master_step": self._step_count}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        for i, p in enumerate(self._param_list):
+            st = self._states.get(id(p))
+            if st:
+                for k, v in st.items():
+                    out[f"{p.name or f'param_{i}'}__{k}"] = Tensor(v)
+        return out
+
+    def set_state_dict(self, state_dict: dict):
+        self._step_count = int(state_dict.get("master_step", 0))
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state_dict:
+            self._lr.set_state_dict(state_dict["LR_Scheduler"])
+        for i, p in enumerate(self._param_list):
+            prefix = f"{p.name or f'param_{i}'}__"
+            st = {}
+            for k, v in state_dict.items():
+                if isinstance(k, str) and k.startswith(prefix):
+                    st[k[len(prefix):]] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            if st:
+                self._states[id(p)] = st
+
+    # lr scheduler hookup
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+
+class SGD(Optimizer):
+    """Reference: optimizer/sgd.py → phi sgd kernel."""
+
+    def update(self, param, grad, state, lr, step, wd=0.0):
+        g = grad.astype(jnp.float32)
+        if wd:
+            g = g + wd * param.astype(jnp.float32)
+        return (param - lr * g.astype(param.dtype)).astype(param.dtype), state
+
+
+class Momentum(Optimizer):
+    """Reference: optimizer/momentum.py (use_nesterov supported)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_state(self, param):
+        return {"velocity": jnp.zeros_like(param, dtype=jnp.float32)}
+
+    def update(self, param, grad, state, lr, step, wd=0.0):
+        g = grad.astype(jnp.float32)
+        if wd:
+            g = g + wd * param.astype(jnp.float32)
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            upd = g + self._momentum * v
+        else:
+            upd = v
+        new_p = (param.astype(jnp.float32) - lr * upd).astype(param.dtype)
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """Reference: optimizer/adam.py → phi adam kernel (bias-corrected)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_state(self, param):
+        return {"moment1": jnp.zeros_like(param, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(param, dtype=jnp.float32)}
+
+    def update(self, param, grad, state, lr, step, wd=0.0):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        if wd:  # L2-regularization semantics (coupled), like reference Adam+L2Decay
+            g = g + wd * p32
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        t = step.astype(jnp.float32)
+        m_hat = m / (1 - jnp.power(b1, t))
+        v_hat = v / (1 - jnp.power(b2, t))
+        new_p = p32 - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+        return new_p.astype(param.dtype), {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Reference: optimizer/adamw.py — decoupled weight decay, with
+    apply_decay_param_fun to exempt bias/norm params."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, name=name)
+        self._wd_coeff = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _wd_for(self, p):
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            return 0.0
+        return float(self._wd_coeff)
+
+    def update(self, param, grad, state, lr, step, wd=0.0):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        t = step.astype(jnp.float32)
+        m_hat = m / (1 - jnp.power(b1, t))
+        v_hat = v / (1 - jnp.power(b2, t))
+        p32 = p32 * (1 - lr * wd)  # decoupled decay
+        new_p = p32 - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+        return new_p.astype(param.dtype), {"moment1": m, "moment2": v}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_state(self, param):
+        return {"moment": jnp.zeros_like(param, dtype=jnp.float32),
+                "inf_norm": jnp.zeros_like(param, dtype=jnp.float32)}
+
+    def update(self, param, grad, state, lr, step, wd=0.0):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        g = grad.astype(jnp.float32)
+        if wd:
+            g = g + wd * param.astype(jnp.float32)
+        m = b1 * state["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(g))
+        t = step.astype(jnp.float32)
+        new_p = param.astype(jnp.float32) - (lr / (1 - jnp.power(b1, t))) * m / (u + eps)
+        return new_p.astype(param.dtype), {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_state(self, param):
+        return {"moment": jnp.full_like(param, self._init_acc, dtype=jnp.float32)}
+
+    def update(self, param, grad, state, lr, step, wd=0.0):
+        g = grad.astype(jnp.float32)
+        if wd:
+            g = g + wd * param.astype(jnp.float32)
+        acc = state["moment"] + g * g
+        new_p = param.astype(jnp.float32) - lr * g / (jnp.sqrt(acc) + self._eps)
+        return new_p.astype(param.dtype), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps, self._rho = epsilon, rho
+
+    def init_state(self, param):
+        return {"avg_squared_grad": jnp.zeros_like(param, dtype=jnp.float32),
+                "avg_squared_update": jnp.zeros_like(param, dtype=jnp.float32)}
+
+    def update(self, param, grad, state, lr, step, wd=0.0):
+        g = grad.astype(jnp.float32)
+        if wd:
+            g = g + wd * param.astype(jnp.float32)
+        e_g = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g * g
+        upd = g * jnp.sqrt(state["avg_squared_update"] + self._eps) / jnp.sqrt(e_g + self._eps)
+        e_u = self._rho * state["avg_squared_update"] + (1 - self._rho) * upd * upd
+        new_p = param.astype(jnp.float32) - lr * upd
+        return new_p.astype(param.dtype), {"avg_squared_grad": e_g, "avg_squared_update": e_u}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def init_state(self, param):
+        st = {"mean_square": jnp.zeros_like(param, dtype=jnp.float32),
+              "momentum": jnp.zeros_like(param, dtype=jnp.float32)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(param, dtype=jnp.float32)
+        return st
+
+    def update(self, param, grad, state, lr, step, wd=0.0):
+        g = grad.astype(jnp.float32)
+        if wd:
+            g = g + wd * param.astype(jnp.float32)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        new_state["momentum"] = mom
+        new_p = param.astype(jnp.float32) - mom
+        return new_p.astype(param.dtype), new_state
+
+
+class Lamb(Optimizer):
+    """Reference: optimizer/lamb.py — layerwise adaptive large-batch opt."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _wd_for(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return float(self._weight_decay)
+
+    def init_state(self, param):
+        return {"moment1": jnp.zeros_like(param, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(param, dtype=jnp.float32)}
+
+    def update(self, param, grad, state, lr, step, wd=0.0):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * g * g
+        t = step.astype(jnp.float32)
+        m_hat = m / (1 - jnp.power(b1, t))
+        v_hat = v / (1 - jnp.power(b2, t))
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = p32 - lr * trust * r
+        return new_p.astype(param.dtype), {"moment1": m, "moment2": v}

@@ -1,0 +1,8 @@
+"""paddle.tensor-style namespace (reference: python/paddle/tensor/__init__.py).
+
+All ops live in core.ops (single lowering to XLA); this module re-exports them
+grouped the way the reference groups math/linalg/manipulation/creation/etc.
+"""
+from ..core import ops as tensor  # noqa: F401
+from ..core.ops import *  # noqa: F401,F403
+from ..core.tensor import Tensor, to_tensor  # noqa: F401

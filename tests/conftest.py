@@ -1,0 +1,32 @@
+"""Test harness configuration.
+
+Mirrors the reference's test strategy (SURVEY §4): numeric checks against a
+CPU reference + a virtual multi-device mesh for distributed logic (the analog
+of TestDistBase's single-host multi-process clusters, test_dist_base.py:899) —
+here an 8-device XLA host platform, so sharding/collective tests run without
+TPU hardware.
+
+MUST run before jax backend initialization: forces CPU with 8 virtual
+devices and 'highest' matmul precision so numpy comparisons are exact-ish
+(the production default keeps the TPU-native bf16-pass matmuls).
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    import paddle_tpu
+    paddle_tpu.seed(0)

@@ -1,0 +1,228 @@
+"""Op-level numeric tests vs numpy — the OpTest analog (SURVEY §4:
+op_test.py:327 check_output pattern: framework result vs numpy reference)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(a, stop_gradient=sg)
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        assert (paddle.full([2, 2], 7).numpy() == 7).all()
+        assert paddle.zeros([2]).dtype == np.dtype("float32")
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype="float32"))
+
+    def test_to_tensor_dtypes(self):
+        assert paddle.to_tensor([1, 2]).dtype == np.dtype("int64") or \
+               paddle.to_tensor([1, 2]).dtype == np.dtype("int32")
+        assert paddle.to_tensor([1.0, 2.0]).dtype == np.dtype("float32")
+        assert paddle.to_tensor(np.float64([1.0])).dtype == np.dtype("float32")
+        assert paddle.to_tensor([1], dtype="float16").dtype == np.dtype("float16")
+
+    def test_rand_shapes(self):
+        assert paddle.rand([3, 4]).shape == [3, 4]
+        assert paddle.randn([2]).shape == [2]
+        r = paddle.randint(0, 10, [100])
+        assert (r.numpy() >= 0).all() and (r.numpy() < 10).all()
+
+
+class TestMath:
+    def test_elementwise_binary(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(3, 4).astype("float32")
+        for name, ref in [("add", np.add), ("subtract", np.subtract),
+                          ("multiply", np.multiply), ("divide", np.divide),
+                          ("maximum", np.maximum), ("minimum", np.minimum)]:
+            out = getattr(paddle, name)(t(a), t(b)).numpy()
+            np.testing.assert_allclose(out, ref(a, b), rtol=1e-6)
+
+    def test_operators(self):
+        a, b = np.random.randn(4).astype("float32"), np.random.randn(4).astype("float32")
+        x, y = t(a), t(b)
+        np.testing.assert_allclose((x + y).numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose((x - 2).numpy(), a - 2, rtol=1e-6)
+        np.testing.assert_allclose((3 * x).numpy(), 3 * a, rtol=1e-6)
+        np.testing.assert_allclose((x / y).numpy(), a / b, rtol=1e-5)
+        np.testing.assert_allclose((-x).numpy(), -a)
+        np.testing.assert_allclose(abs(x).numpy(), np.abs(a))
+
+    def test_unary(self):
+        a = np.random.rand(3, 4).astype("float32") + 0.1
+        for name, ref in [("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+                          ("sin", np.sin), ("cos", np.cos), ("tanh", np.tanh),
+                          ("floor", np.floor), ("ceil", np.ceil),
+                          ("square", np.square), ("sign", np.sign)]:
+            np.testing.assert_allclose(getattr(paddle, name)(t(a)).numpy(), ref(a),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_reductions(self):
+        a = np.random.randn(3, 4, 5).astype("float32")
+        np.testing.assert_allclose(paddle.sum(t(a)).numpy(), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.mean(t(a), axis=1).numpy(), a.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(t(a), axis=[0, 2]).numpy(), a.max((0, 2)))
+        np.testing.assert_allclose(paddle.sum(t(a), axis=1, keepdim=True).numpy(),
+                                   a.sum(1, keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(paddle.std(t(a)).numpy(), a.std(ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(paddle.logsumexp(t(a), axis=-1).numpy(),
+                                   np.log(np.exp(a).sum(-1)), rtol=1e-5)
+
+    def test_argmax_cumsum(self):
+        a = np.random.randn(3, 4).astype("float32")
+        np.testing.assert_array_equal(paddle.argmax(t(a), axis=1).numpy(), a.argmax(1))
+        np.testing.assert_allclose(paddle.cumsum(t(a), axis=0).numpy(), a.cumsum(0), rtol=1e-6)
+
+    def test_matmul(self):
+        a = np.random.randn(2, 3, 4).astype("float32")
+        b = np.random.randn(2, 4, 5).astype("float32")
+        np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matmul(t(a), t(b.transpose(0, 2, 1)), transpose_y=True).numpy(),
+            a @ b, rtol=1e-5)
+
+    def test_einsum(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(4, 5).astype("float32")
+        np.testing.assert_allclose(paddle.einsum("ij,jk->ik", t(a), t(b)).numpy(),
+                                   a @ b, rtol=1e-5)
+
+    def test_clip_where(self):
+        a = np.random.randn(10).astype("float32")
+        np.testing.assert_allclose(paddle.clip(t(a), -0.5, 0.5).numpy(),
+                                   np.clip(a, -0.5, 0.5))
+        cond = a > 0
+        np.testing.assert_allclose(
+            paddle.where(t(cond), t(a), t(-a)).numpy(), np.abs(a))
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.arange(24).reshape(2, 3, 4).astype("float32")
+        assert paddle.reshape(t(a), [4, 6]).shape == [4, 6]
+        np.testing.assert_array_equal(paddle.transpose(t(a), [2, 0, 1]).numpy(),
+                                      a.transpose(2, 0, 1))
+        assert t(a).flatten().shape == [24]
+        assert t(a).flatten(1, 2).shape == [2, 12]
+
+    def test_concat_split_stack(self):
+        a = np.random.randn(2, 3).astype("float32")
+        b = np.random.randn(2, 3).astype("float32")
+        np.testing.assert_array_equal(paddle.concat([t(a), t(b)], axis=0).numpy(),
+                                      np.concatenate([a, b], 0))
+        parts = paddle.split(t(a), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        parts = paddle.split(t(np.random.randn(6, 2).astype("f4")), [1, 2, -1], axis=0)
+        assert [p.shape[0] for p in parts] == [1, 2, 3]
+        np.testing.assert_array_equal(paddle.stack([t(a), t(b)]).numpy(), np.stack([a, b]))
+
+    def test_squeeze_expand(self):
+        a = np.random.randn(1, 3, 1).astype("float32")
+        assert paddle.squeeze(t(a)).shape == [3]
+        assert paddle.squeeze(t(a), axis=0).shape == [3, 1]
+        assert paddle.unsqueeze(t(a), [0]).shape == [1, 1, 3, 1]
+        assert paddle.expand(t(np.zeros((1, 3), "f4")), [4, 3]).shape == [4, 3]
+        assert paddle.tile(t(a), [2, 1, 1]).shape == [2, 3, 1]
+
+    def test_gather_scatter(self):
+        a = np.random.randn(5, 3).astype("float32")
+        idx = np.array([0, 2, 4])
+        np.testing.assert_array_equal(paddle.gather(t(a), t(idx)).numpy(), a[idx])
+        upd = np.ones((3, 3), "float32")
+        out = paddle.scatter(t(a), t(idx), t(upd)).numpy()
+        ref = a.copy(); ref[idx] = 1
+        np.testing.assert_array_equal(out, ref)
+
+    def test_indexing(self):
+        a = np.random.randn(4, 5).astype("float32")
+        x = t(a)
+        np.testing.assert_array_equal(x[1].numpy(), a[1])
+        np.testing.assert_array_equal(x[1:3, ::2].numpy(), a[1:3, ::2])
+        np.testing.assert_array_equal(x[:, -1].numpy(), a[:, -1])
+        x[0] = 0.0
+        assert (x.numpy()[0] == 0).all()
+
+    def test_pad_flip_roll(self):
+        a = np.random.randn(2, 3).astype("float32")
+        # len(pad)==2*ndim: pads first dim -> last dim (paddle semantics)
+        out = paddle.pad(t(a), [1, 1, 2, 2]).numpy()
+        assert out.shape == (4, 7)
+        # 4-element pad on 4-D NCHW input: (left,right,top,bottom) on W,H
+        img = np.zeros((1, 1, 2, 3), "float32")
+        assert paddle.pad(t(img), [1, 1, 2, 2]).numpy().shape == (1, 1, 6, 5)
+        np.testing.assert_array_equal(paddle.flip(t(a), axis=0).numpy(), a[::-1])
+        np.testing.assert_array_equal(paddle.roll(t(a), 1, axis=1).numpy(), np.roll(a, 1, 1))
+
+    def test_sort_topk_unique(self):
+        a = np.random.randn(3, 6).astype("float32")
+        np.testing.assert_allclose(paddle.sort(t(a), axis=1).numpy(), np.sort(a, 1))
+        vals, idx = paddle.topk(t(a), 2, axis=1)
+        np.testing.assert_allclose(vals.numpy(), np.sort(a, 1)[:, -1:-3:-1], rtol=1e-6)
+        u = paddle.unique(t(np.array([3, 1, 2, 1, 3])))
+        np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+
+
+class TestLogic:
+    def test_comparisons(self):
+        a = np.array([1.0, 2.0, 3.0], "float32")
+        b = np.array([2.0, 2.0, 2.0], "float32")
+        np.testing.assert_array_equal((t(a) < t(b)).numpy(), a < b)
+        np.testing.assert_array_equal((t(a) == t(b)).numpy(), a == b)
+        assert bool(paddle.allclose(t(a), t(a)))
+        assert not bool(paddle.equal_all(t(a), t(b)))
+
+    def test_isnan_isinf(self):
+        a = np.array([1.0, np.nan, np.inf], "float32")
+        np.testing.assert_array_equal(paddle.isnan(t(a)).numpy(), np.isnan(a))
+        np.testing.assert_array_equal(paddle.isinf(t(a)).numpy(), np.isinf(a))
+
+
+class TestLinalg:
+    def test_solve_inv_det(self):
+        a = np.random.randn(3, 3).astype("float32") + 3 * np.eye(3, dtype="float32")
+        b = np.random.randn(3, 2).astype("float32")
+        np.testing.assert_allclose(paddle.linalg.solve(t(a), t(b)).numpy(),
+                                   np.linalg.solve(a, b), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(paddle.linalg.inv(t(a)).numpy(), np.linalg.inv(a),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(paddle.linalg.det(t(a)).numpy(), np.linalg.det(a),
+                                   rtol=1e-4)
+
+    def test_svd_qr_cholesky(self):
+        a = np.random.randn(4, 3).astype("float32")
+        u, s, vh_t = paddle.linalg.svd(t(a))
+        np.testing.assert_allclose(s.numpy(), np.linalg.svd(a, compute_uv=False),
+                                   rtol=1e-4, atol=1e-5)
+        q, r = paddle.linalg.qr(t(a))
+        np.testing.assert_allclose((q.numpy() @ r.numpy()), a, rtol=1e-4, atol=1e-5)
+        spd = a.T @ a + np.eye(3, dtype="float32")
+        c = paddle.linalg.cholesky(t(spd)).numpy()
+        np.testing.assert_allclose(c @ c.T, spd, rtol=1e-4, atol=1e-4)
+
+    def test_norm_trace(self):
+        a = np.random.randn(3, 4).astype("float32")
+        np.testing.assert_allclose(paddle.norm(t(a)).numpy(),
+                                   np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.trace(t(a)).numpy(), np.trace(a), rtol=1e-5)
+
+
+class TestDtype:
+    def test_cast(self):
+        a = np.random.randn(3).astype("float32")
+        assert paddle.cast(t(a), "float16").dtype == np.dtype("float16")
+        assert t(a).astype("int32").dtype == np.dtype("int32")
+        assert t(a).astype(paddle.bfloat16).dtype == paddle.bfloat16
+
+    def test_bf16_roundtrip(self):
+        a = np.random.randn(4, 4).astype("float32")
+        x = t(a).astype("bfloat16")
+        y = (x @ x).astype("float32")
+        assert np.isfinite(y.numpy()).all()
