@@ -40,6 +40,7 @@ from . import io  # noqa: F401,E402
 from . import amp  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 from . import framework  # noqa: F401,E402
 from . import device  # noqa: F401,E402

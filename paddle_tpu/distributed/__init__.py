@@ -1,0 +1,71 @@
+"""paddle_tpu.distributed — parallelism over TPU device meshes.
+
+Reference surface: python/paddle/distributed/ (SURVEY §2.2) — collective
+communication API, fleet facade, hybrid topology, sharding, recompute, MoE,
+pipeline. TPU-native substrate: one jax.sharding.Mesh whose named axes are
+the communicator groups; collectives are XLA collectives over ICI; parallel
+strategies are PartitionSpec annotations compiled by pjit (see mesh.py).
+"""
+from __future__ import annotations
+
+from .mesh import (  # noqa: F401
+    build_mesh, get_mesh, set_mesh, mesh_scope, mesh_axis_size,
+    named_sharding, shard_constraint, HYBRID_AXES,
+)
+from .parallel import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, is_initialized, barrier,
+    ParallelEnv,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group,
+    all_reduce, all_gather, broadcast, reduce, reduce_scatter, alltoall,
+    scatter, send, recv, psum, pmean, ppermute, axis_index,
+)
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .sharding import group_sharded_parallel, shard_optimizer_state  # noqa: F401
+from .recompute import recompute, recompute_sequential, recompute_hybrid  # noqa: F401
+from .pipeline import (  # noqa: F401
+    LayerDesc, SharedLayerDesc, PipelineLayer, PipelineParallel, pipeline_scan,
+)
+from . import fleet  # noqa: F401
+from . import mpu  # noqa: F401
+from .mpu import split  # noqa: F401
+
+# meta_parallel namespace parity (reference: fleet/meta_parallel/__init__)
+from . import mpu as meta_parallel  # noqa: F401
+
+ColumnParallelLinear = mpu.ColumnParallelLinear
+RowParallelLinear = mpu.RowParallelLinear
+VocabParallelEmbedding = mpu.VocabParallelEmbedding
+ParallelCrossEntropy = mpu.ParallelCrossEntropy
+
+
+class DataParallel:
+    """Reference: paddle.DataParallel (fluid/dygraph/parallel.py:399) — wraps
+    a layer, syncs params, installs the bucketed EagerReducer (reducer.cc).
+    TPU-native: gradients are reduced by XLA when the batch is dp-sharded
+    (TrainStep data_axes), so this wrapper only preserves the API shape."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size_MB=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        self._layers = layers
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """Reference: distributed/spawn.py:472 — multi-process launch. On TPU the
+    single-controller model replaces process-per-device: run func once with
+    the full mesh initialised."""
+    init_parallel_env()
+    return func(*args)

@@ -1,0 +1,403 @@
+"""Communication API — paddle.distributed.{all_reduce, all_gather, ...}.
+
+Reference surface: python/paddle/distributed/communication/ (+ stream.*
+variants) backed by ProcessGroup tasks (process_group.h:53-368) and the
+c_* collective op set (paddle/fluid/operators/collective/, SURVEY §2.2).
+
+TPU-native semantics (single controller, SPMD):
+- **Inside traced SPMD code** (a `shard_map` region — where mesh axis names
+  are live), these functions lower directly to XLA collectives
+  (`lax.psum/all_gather/all_to_all/ppermute`) over the group's axis. This is
+  the production path: collectives ride ICI, fused and overlapped by XLA.
+- **Eagerly**, a distributed program's per-rank tensors are modeled as a
+  global array whose LEADING dimension is the group size (the "stacked-rank
+  view"): row r is rank r's tensor. Each collective runs the same XLA
+  collective over the mesh via shard_map. This replaces the reference's
+  N-process + NCCL testing model (test_dist_base.py:899) with a
+  deterministic single-process equivalent.
+
+The async `Task` handles of the reference (wait()/synchronize()) have no TPU
+analog — XLA program order already sequences collectives — so sync_op
+arguments are accepted and ignored; a `_FakeTask` is returned for API parity.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ..core.tensor import Tensor
+from . import mesh as _mesh
+
+
+class ReduceOp:
+    """Reference: paddle.distributed.ReduceOp (communication/reduce.py)."""
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lax.psum,
+    ReduceOp.MAX: lax.pmax,
+    ReduceOp.MIN: lax.pmin,
+}
+
+
+class Group:
+    """A communicator = a named mesh axis (reference: communication/group.py
+    Group over a ProcessGroup; here the axis IS the communicator)."""
+
+    _next_id = 0
+
+    def __init__(self, mesh: Mesh, axis: str, gid: Optional[int] = None):
+        self.mesh = mesh
+        self.axis = axis
+        if gid is None:
+            Group._next_id += 1
+            gid = Group._next_id
+        self.id = gid
+
+    @property
+    def nranks(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def rank(self) -> int:
+        return 0  # single controller; per-shard rank = lax.axis_index in-trace
+
+    @property
+    def name(self):
+        return f"mesh_axis:{self.axis}"
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank):
+        return rank
+
+    def __repr__(self):
+        return f"Group(axis={self.axis!r}, nranks={self.nranks}, id={self.id})"
+
+
+def _default_group() -> Group:
+    m = _mesh.get_mesh()
+    if m is None:
+        from .parallel import init_parallel_env
+        init_parallel_env()
+        m = _mesh.get_mesh()
+    # default group spans the whole mesh; use a flattened view
+    if len(m.axis_names) == 1:
+        return Group(m, m.axis_names[0], gid=0)
+    flat = Mesh(np.asarray(m.devices).reshape(-1), ("world",))
+    return Group(flat, "world", gid=0)
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend=None, axis: str = None) -> Group:
+    """Create a group. TPU-native: groups are mesh axes, so `axis=` selects
+    one; an explicit `ranks` list builds a sub-mesh over those devices
+    (reference dynamic new_group → static mesh reconfig, SURVEY §7)."""
+    m = _mesh.get_mesh()
+    if axis is not None:
+        return Group(m, axis)
+    devs = np.asarray(m.devices).reshape(-1) if m is not None else np.asarray(jax.devices())
+    if ranks is not None:
+        devs = devs[list(ranks)]
+    sub = Mesh(devs, ("sub",))
+    return Group(sub, "sub")
+
+
+def get_group(gid: int = 0) -> Group:
+    return _default_group()
+
+
+class _FakeTask:
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+    def synchronize(self):
+        pass
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _require_group(group, opname):
+    if group is None or not hasattr(group, "axis"):
+        raise ValueError(
+            f"{opname} inside shard_map-traced code needs an explicit "
+            f"group= (a Group naming the live mesh axis); the default "
+            f"flattened world group is not an axis of the traced mesh.")
+
+
+def _unwrap(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _rewrap(t, arr):
+    if isinstance(t, Tensor):
+        t._data = arr
+        t._node = None
+        return t
+    return Tensor(arr)
+
+
+def _stacked(fn, group: Group, *arrays, out_specs=None):
+    """Run `fn` (per-rank local view) over the stacked-rank leading dim."""
+    ax = group.axis
+    in_specs = tuple(P(ax) for _ in arrays)
+    out_specs = P(ax) if out_specs is None else out_specs
+    f = shard_map(fn, mesh=group.mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    return jax.jit(f)(*arrays)
+
+
+def _check_group_dim(arr, group, opname):
+    if arr.shape[0] != group.nranks:
+        raise ValueError(
+            f"{opname}: eager stacked-rank view requires leading dim == group "
+            f"size ({group.nranks}), got shape {tuple(arr.shape)}. Inside "
+            f"shard_map-traced code pass the local tensor instead.")
+
+
+# --------------------------------------------------------------------------
+# collectives
+# --------------------------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True):
+    """Reference: paddle.distributed.all_reduce (communication/all_reduce.py)
+    → c_allreduce_* ops / ProcessGroup::AllReduce."""
+    arr = _unwrap(tensor)
+    if _is_traced(arr):
+        ax = group.axis if group is not None else None
+        red = _REDUCERS.get(op, lax.psum)
+        if op == ReduceOp.AVG:
+            return Tensor(lax.pmean(arr, ax)) if isinstance(tensor, Tensor) else lax.pmean(arr, ax)
+        out = red(arr, ax)
+        return _rewrap(tensor, out) if isinstance(tensor, Tensor) else out
+    group = group or _default_group()
+    _check_group_dim(arr, group, "all_reduce")
+
+    def local(x):
+        if op == ReduceOp.AVG:
+            return lax.pmean(x, group.axis)
+        if op == ReduceOp.PROD:
+            # no pprod primitive: log-space for positives is wrong in general;
+            # gather then multiply
+            g = lax.all_gather(x, group.axis)
+            return jnp.prod(g, axis=0)
+        return _REDUCERS[op](x, group.axis)
+
+    out = _stacked(local, group, arr)
+    _rewrap(tensor, out)
+    return _FakeTask()
+
+
+def all_gather(tensor_list: Optional[List], tensor=None, group: Optional[Group] = None,
+               sync_op: bool = True):
+    """Reference: communication/all_gather.py — gathers each rank's tensor.
+    Eager stacked view: the rows already ARE the per-rank tensors, so the
+    gathered list is the unstacked rows (after an all_gather round-trip that
+    validates the collective itself)."""
+    if tensor is None:  # functional style: all_gather(x) -> stacked
+        tensor, tensor_list = tensor_list, None
+    arr = _unwrap(tensor)
+    if _is_traced(arr):
+        _require_group(group, "all_gather")
+        out = lax.all_gather(arr, group.axis)
+        return _rewrap(tensor, out) if isinstance(tensor, Tensor) else out
+    group = group or _default_group()
+    _check_group_dim(arr, group, "all_gather")
+    gathered = _stacked(lambda x: lax.all_gather(x[0], group.axis),
+                        group, arr, out_specs=P())
+    rows = [Tensor(gathered[i]) for i in range(group.nranks)]
+    if tensor_list is not None:
+        tensor_list.extend(rows)
+        return _FakeTask()
+    return Tensor(jnp.stack([r._data for r in rows]))
+
+
+def broadcast(tensor, src: int = 0, group: Optional[Group] = None, sync_op=True):
+    """Reference: communication/broadcast.py → c_broadcast."""
+    arr = _unwrap(tensor)
+    if _is_traced(arr):
+        _require_group(group, "broadcast")
+        g = lax.all_gather(arr, group.axis)
+        return _rewrap(tensor, g[src]) if isinstance(tensor, Tensor) else g[src]
+    group = group or _default_group()
+    _check_group_dim(arr, group, "broadcast")
+    out = _stacked(lambda x: lax.all_gather(x, group.axis, axis=0, tiled=False)[src],
+                   group, arr)
+    _rewrap(tensor, out)
+    return _FakeTask()
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group: Optional[Group] = None,
+           sync_op=True):
+    """Reference: communication/reduce.py — result lands on dst; other rows
+    keep their input (matches NCCL reduce semantics of undefined-but-local
+    buffers; we keep them unchanged)."""
+    arr = _unwrap(tensor)
+    if _is_traced(arr):
+        _require_group(group, "reduce")
+        red = lax.pmean(arr, group.axis) if op == ReduceOp.AVG \
+            else _REDUCERS[op](arr, group.axis)
+        out = jnp.where(lax.axis_index(group.axis) == dst, red, arr)
+        return _rewrap(tensor, out) if isinstance(tensor, Tensor) else out
+    group = group or _default_group()
+    _check_group_dim(arr, group, "reduce")
+
+    def local(x):
+        red = lax.pmean(x, group.axis) if op == ReduceOp.AVG else _REDUCERS[op](x, group.axis)
+        i = lax.axis_index(group.axis)
+        return jnp.where(i == dst, red, x)
+
+    out = _stacked(local, group, arr)
+    _rewrap(tensor, out)
+    return _FakeTask()
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op=True):
+    """Reference: communication/reduce_scatter.py → c_reducescatter.
+    Stacked view: rows are per-rank inputs [G, G*n]; output rows are the
+    scattered reduced chunks [G, n]."""
+    arr = _unwrap(_rank_input(tensor, tensor_list))
+    if _is_traced(arr):
+        _require_group(group, "reduce_scatter")
+        out = lax.psum_scatter(arr, group.axis, tiled=True)
+        return _rewrap(tensor, out) if isinstance(tensor, Tensor) else out
+    group = group or _default_group()
+    _check_group_dim(arr, group, "reduce_scatter")
+    out = _stacked(lambda x: lax.psum_scatter(x, group.axis, scatter_dimension=1,
+                                              tiled=True),
+                   group, arr)
+    _rewrap(tensor, out)
+    return _FakeTask()
+
+
+def _rank_input(tensor, tensor_list):
+    if tensor_list:
+        return Tensor(jnp.stack([_unwrap(t) for t in tensor_list], axis=0))
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group: Optional[Group] = None,
+             sync_op=True):
+    """Reference: communication/all_to_all.py → alltoall op (MoE dispatch
+    global_scatter/global_gather analog). Stacked view in: [G, G, ...]
+    (row r = rank r's G chunks); out row r = chunk r from every rank."""
+    x = _rank_input(None, in_tensor_list) if isinstance(in_tensor_list, (list, tuple)) \
+        else in_tensor_list
+    arr = _unwrap(x)
+    if _is_traced(arr):
+        _require_group(group, "alltoall")
+        return lax.all_to_all(arr, group.axis, split_axis=0, concat_axis=0, tiled=True)
+    group = group or _default_group()
+    _check_group_dim(arr, group, "alltoall")
+    out = _stacked(
+        lambda s: lax.all_to_all(s, group.axis, split_axis=1, concat_axis=1,
+                                 tiled=True),
+        group, arr)
+    if out_tensor_list is not None:
+        for i in range(group.nranks):
+            out_tensor_list.append(Tensor(out[i]))
+        return _FakeTask()
+    return Tensor(out)
+
+
+def scatter(tensor, tensor_list=None, src: int = 0, group: Optional[Group] = None,
+            sync_op=True):
+    """Reference: communication/scatter.py — src's tensor is split into G
+    chunks along its first dim; rank r receives chunk r. Stacked view in:
+    [G, d0, ...]; out: [G, d0//G, ...] (row r = chunk r of row src)."""
+    arr = _unwrap(_rank_input(tensor, tensor_list))
+    if _is_traced(arr):
+        raise NotImplementedError(
+            "scatter inside shard_map: slice by lax.axis_index directly")
+    group = group or _default_group()
+    _check_group_dim(arr, group, "scatter")
+    G = group.nranks
+    if arr.ndim < 2 or arr.shape[1] % G != 0:
+        raise ValueError(f"scatter: dim 1 of stacked view {tuple(arr.shape)} "
+                         f"must be divisible by group size {G}")
+
+    def local(x):  # x: [1, d0, ...]; gather rows, keep src's chunk i
+        g = lax.all_gather(x[0], group.axis)          # [G, d0, ...]
+        i = lax.axis_index(group.axis)
+        chunks = g[src].reshape((G, x.shape[1] // G) + x.shape[2:])
+        return lax.dynamic_index_in_dim(chunks, i, axis=0, keepdims=True)
+
+    out = _stacked(local, group, arr)
+    _rewrap(tensor, out)
+    return _FakeTask()
+
+
+def send(tensor, dst: int, group: Optional[Group] = None, sync_op=True):
+    """P2P send. TPU-native: p2p inside traced code is ppermute; eagerly the
+    single controller stages the value in a per-destination mailbox
+    (reference: send_v2/recv_v2 ops). The receiver identifies itself via
+    recv(..., rank=) when more than one destination has pending sends."""
+    _P2P_BUF.setdefault(dst, []).append(_unwrap(tensor))
+    return _FakeTask()
+
+
+def recv(tensor, src: int = 0, group: Optional[Group] = None, sync_op=True,
+         rank: Optional[int] = None):
+    """Receive a staged send. `rank` = the receiving rank (which mailbox to
+    read); optional only when it is unambiguous (a single pending dst)."""
+    if rank is None:
+        pending = [d for d, box in _P2P_BUF.items() if box]
+        if len(pending) != 1:
+            raise RuntimeError(
+                f"recv: ambiguous mailbox (pending dsts={sorted(pending)}); "
+                f"pass rank= to identify the receiver")
+        rank = pending[0]
+    box = _P2P_BUF.get(rank)
+    if not box:
+        raise RuntimeError(f"recv: no pending send for rank {rank} (eager p2p "
+                           f"is rendezvous within one controller)")
+    _rewrap(tensor, box.pop(0))
+    return _FakeTask()
+
+
+_P2P_BUF: dict = {}
+
+
+def stream_all_reduce(*a, **k):
+    return all_reduce(*a, **k)
+
+
+# in-trace helpers used by parallel layers / shard_map code ------------------
+
+def psum(x, axis: str):
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: str):
+    return lax.pmean(x, axis)
+
+
+def ppermute(x, axis: str, perm):
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)

@@ -1,0 +1,103 @@
+"""Global device-mesh runtime — the substrate of all parallelism.
+
+TPU-native replacement for the reference's process-group world
+(paddle/fluid/distributed/collective/process_group.h:53 + NCCL comm caches,
+process_group_nccl.cc:573): instead of N processes bootstrapping NCCL
+communicators through a TCPStore, a single controller owns a
+`jax.sharding.Mesh` whose named axes ARE the communicator groups. Every
+"process group" of the reference maps to a mesh axis; every collective maps
+to an XLA collective over that axis riding ICI (SURVEY §5.8 TPU-equivalent).
+
+Axis-name conventions (mirrors fleet's 4D hybrid topology order,
+fleet/base/topology.py:53, extended with sp/ep which the reference lacks):
+  dp  — data parallel            (reference: dp degree)
+  pp  — pipeline stages          (reference: pp degree)
+  sdp — sharded data parallel    (reference: sharding degree, ZeRO)
+  mp  — tensor/model parallel    (reference: mp degree)
+  sp  — sequence/context parallel (exceeds reference; SURVEY §5.7)
+  ep  — expert parallel          (reference: MoE global_scatter groups)
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_state = threading.local()
+
+HYBRID_AXES = ("dp", "pp", "sdp", "mp")  # reference 4D order (topology.py:53)
+
+
+def _get(name, default=None):
+    return getattr(_state, name, default)
+
+
+def build_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from {axis_name: degree}; degrees must multiply to ndev.
+
+    Axis order in `axes` is the physical layout order: the LAST axis varies
+    fastest over adjacent devices, so put the heaviest-communication axis
+    (mp/sp) last to keep its collectives on nearest-neighbour ICI — same
+    logic as the reference giving mp the fastest-varying ranks
+    (fleet/base/topology.py hybrid order).
+    """
+    if devices is None:
+        devices = jax.devices()
+    shape = tuple(axes.values())
+    n = int(np.prod(shape)) if shape else 1
+    if n != len(devices):
+        raise ValueError(
+            f"mesh axes {axes} require {n} devices, have {len(devices)}")
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    _state.mesh = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    """The process-global mesh (None until init_parallel_env/fleet.init)."""
+    return _get("mesh")
+
+
+def mesh_axis_size(axis: str) -> int:
+    m = get_mesh()
+    if m is None or axis not in m.axis_names:
+        return 1
+    return m.shape[axis]
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh: Mesh):
+    prev = get_mesh()
+    set_mesh(mesh)
+    try:
+        yield mesh
+    finally:
+        set_mesh(prev)
+
+
+def named_sharding(*spec) -> Optional[NamedSharding]:
+    m = get_mesh()
+    if m is None:
+        return None
+    return NamedSharding(m, P(*spec))
+
+
+def shard_constraint(arr, *spec):
+    """with_sharding_constraint if a mesh is active and we are inside a
+    trace; no-op otherwise. Used by parallel layers to pin activation
+    layouts (the declarative analog of the reference's explicit
+    _c_identity/_mp_allreduce calls in mpu/mp_ops.py:27-219)."""
+    m = get_mesh()
+    if m is None:
+        return arr
+    try:
+        return jax.lax.with_sharding_constraint(arr, NamedSharding(m, P(*spec)))
+    except (ValueError, TypeError):
+        return arr

@@ -1,0 +1,96 @@
+"""Parallel environment bootstrap.
+
+Reference: python/paddle/distributed/parallel.py:108 init_parallel_env —
+TCPStore rendezvous (parallel.py:279) + ProcessGroupNCCL creation. TPU-native:
+`jax.distributed.initialize` is the coordination service (replaces TCPStore,
+SURVEY §5.8), after which every host sees the full global device list and a
+single logical mesh. On one host (or under the CPU virtual-device test
+platform) no rendezvous is needed at all.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from . import mesh as _mesh
+
+
+class ParallelEnv:
+    """Reference: paddle.distributed.ParallelEnv (fluid/dygraph/parallel.py)."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_TPU_LOCAL_RANK", jax.process_index()))
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+
+def init_parallel_env(mesh_axes: Optional[dict] = None):
+    """Initialise the distributed runtime and the global mesh.
+
+    Single-controller semantics: "world size" is the number of addressable
+    devices (chips), not OS processes; on multi-host TPU each host runs the
+    same program and jax.distributed stitches them into one world — the
+    analog of the reference's trainer_id/trainer_endpoints env contract
+    (parallel.py:146-214) with no sockets to manage.
+    """
+    coord = os.environ.get("PADDLE_TPU_COORDINATOR")
+    if coord and jax.process_count() == 1 and not _mesh._get("dist_initialized"):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1")),
+                process_id=int(os.environ.get("PADDLE_TPU_PROCESS_ID", "0")))
+            _mesh._state.dist_initialized = True
+        except Exception:
+            pass
+    if _mesh.get_mesh() is None:
+        axes = mesh_axes or {"dp": len(jax.devices())}
+        _mesh.set_mesh(_mesh.build_mesh(axes))
+    return ParallelEnv()
+
+
+def get_rank(group=None) -> int:
+    """Process (host) index. In single-controller SPMD every host runs the
+    same logical rank-free program; this exists for launcher/API parity
+    (reference: paddle.distributed.get_rank)."""
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    m = _mesh.get_mesh()
+    if m is not None:
+        return m.size
+    return len(jax.devices())
+
+
+def is_initialized() -> bool:
+    return _mesh.get_mesh() is not None
+
+
+def barrier(group=None):
+    """Block until all devices reach this point: a cheap all-device psum.
+    (reference: barrier op, operators/collective/barrier_op.cc)"""
+    import jax.numpy as jnp
+    x = jnp.ones((), jnp.int32)
+    jax.block_until_ready(jax.device_put(x))

@@ -1,0 +1,84 @@
+"""Recompute (activation checkpointing / rematerialisation).
+
+Reference: distributed/fleet/recompute/recompute.py — RecomputeFunction
+PyLayer (:69) re-runs forward under backward with saved RNG state;
+recompute_sequential (:454); hybrid-aware recompute_hybrid.py.
+
+TPU-native: `jax.checkpoint` (remat) IS recompute — XLA rematerialises the
+segment in the backward pass, trading FLOPs for HBM exactly as the reference
+does manually, and the threefry key plumbing makes RNG replay automatic
+(no CUDA RNG state save/restore needed).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor, apply_op
+
+
+def recompute(function, *args, use_reentrant: bool = True, preserve_rng_state: bool = True,
+              **kwargs):
+    """Reference: recompute.py:69 — same call shape. Works both eagerly (the
+    tape records the remat-wrapped fn: its vjp recomputes) and under jit.
+
+    The segment's parameters are lifted to differentiable inputs of the
+    remat region (the analog of RecomputeFunction saving ctx.inputs): the
+    layer's params would otherwise be traced as constants and get no grad.
+    """
+    from ..nn.layer import Layer
+
+    params = []
+    if isinstance(function, Layer):
+        params = [p for p in function.parameters() if not p.stop_gradient]
+    else:
+        self_obj = getattr(function, "__self__", None)
+        if isinstance(self_obj, Layer):
+            params = [p for p in self_obj.parameters() if not p.stop_gradient]
+    n_args = len(args)
+
+    def raw(*arrs):
+        from ..jit.api import _swap_params
+        arg_arrs, param_arrs = arrs[:n_args], arrs[n_args:]
+        # apply_op passes one array per positional arg (non-Tensors were
+        # converted); rebuild Tensor slots from their array, keep original
+        # Python values for non-Tensor slots (they are trace constants).
+        rebuilt = [Tensor(arr, stop_gradient=True) if isinstance(a, Tensor) else a
+                   for a, arr in zip(args, arg_arrs)]
+        with _swap_params(params, list(param_arrs)):
+            out = function(*rebuilt, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    remat_fn = jax.checkpoint(raw)
+    return apply_op("recompute", remat_fn, list(args) + params)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Reference: recompute.py:454 — checkpoint a Sequential in segments."""
+    segments = int(ctx.get("segments", 1)) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    seg = max(1, n // max(1, segments))
+    x = args[0] if len(args) == 1 else args
+
+    def run_span(lo, hi):
+        def f(inp):
+            y = inp
+            for l in layers[lo:hi]:
+                y = l(y)
+            return y
+        return f
+
+    i = 0
+    while i < n:
+        hi = min(n, i + seg)
+        x = recompute(run_span(i, hi), x, **kwargs)
+        i = hi
+    return x
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Reference: recompute_hybrid.py — mp-aware RNG tracker variant; the
+    fold_in tracker makes plain recompute already deterministic per-shard."""
+    return recompute(function, *args, **kwargs)

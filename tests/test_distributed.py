@@ -1,0 +1,348 @@
+"""Distributed layer tests on the 8-virtual-device CPU mesh.
+
+Mirrors the reference's three distributed-test mechanisms (SURVEY §4) in
+single-process form: collective API checks (analog of unittests/collective/
+runner scripts), hybrid-parallel model parity (analog of
+hybrid_parallel_mp_model.py), and sharding-stage parity (analog of
+dygraph_group_sharded_stage2/3.py) — all vs single-device ground truth
+instead of N spawned processes.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+import paddle_tpu.nn as nn
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    dist.set_mesh(None)
+    fleet._fleet_state.update(initialized=False, strategy=None, hcg=None)
+
+
+def _world():
+    dist.init_parallel_env()
+    return dist.get_group()
+
+
+class TestCollectives:
+    def test_all_reduce_sum(self):
+        g = _world()
+        n = g.nranks
+        x = paddle.to_tensor(np.arange(n * 3, dtype=np.float32).reshape(n, 3))
+        expect = x.numpy().sum(0)
+        dist.all_reduce(x)
+        for r in range(n):
+            np.testing.assert_allclose(x.numpy()[r], expect, rtol=1e-6)
+
+    def test_all_reduce_max_avg(self):
+        g = _world()
+        n = g.nranks
+        base = np.random.randn(n, 4).astype(np.float32)
+        x = paddle.to_tensor(base.copy())
+        dist.all_reduce(x, op=dist.ReduceOp.MAX)
+        np.testing.assert_allclose(x.numpy()[0], base.max(0), rtol=1e-6)
+        y = paddle.to_tensor(base.copy())
+        dist.all_reduce(y, op=dist.ReduceOp.AVG)
+        np.testing.assert_allclose(y.numpy()[-1], base.mean(0), rtol=1e-6)
+
+    def test_all_gather(self):
+        g = _world()
+        n = g.nranks
+        base = np.random.randn(n, 2).astype(np.float32)
+        out = []
+        dist.all_gather(out, paddle.to_tensor(base.copy()))
+        assert len(out) == n
+        for r in range(n):
+            np.testing.assert_allclose(out[r].numpy(), base[r], rtol=1e-6)
+
+    def test_broadcast(self):
+        g = _world()
+        n = g.nranks
+        base = np.random.randn(n, 5).astype(np.float32)
+        x = paddle.to_tensor(base.copy())
+        dist.broadcast(x, src=2)
+        for r in range(n):
+            np.testing.assert_allclose(x.numpy()[r], base[2], rtol=1e-6)
+
+    def test_reduce(self):
+        g = _world()
+        n = g.nranks
+        base = np.random.randn(n, 3).astype(np.float32)
+        x = paddle.to_tensor(base.copy())
+        dist.reduce(x, dst=1)
+        np.testing.assert_allclose(x.numpy()[1], base.sum(0), rtol=1e-5)
+        np.testing.assert_allclose(x.numpy()[0], base[0], rtol=1e-6)
+
+    def test_reduce_scatter(self):
+        g = _world()
+        n = g.nranks
+        base = np.random.randn(n, n * 2).astype(np.float32)
+        x = paddle.to_tensor(base.copy())
+        dist.reduce_scatter(x)
+        s = base.sum(0)  # [n*2]
+        for r in range(n):
+            np.testing.assert_allclose(x.numpy()[r], s[r * 2:(r + 1) * 2], rtol=1e-5)
+
+    def test_alltoall(self):
+        g = _world()
+        n = g.nranks
+        base = np.arange(n * n, dtype=np.float32).reshape(n, n)
+        out = dist.alltoall(paddle.to_tensor(base.copy()))
+        np.testing.assert_allclose(out.numpy(), base.T, rtol=1e-6)
+
+    def test_scatter(self):
+        g = _world()
+        n = g.nranks
+        base = np.arange(n * n * 2, dtype=np.float32).reshape(n, n * 2)
+        x = paddle.to_tensor(base.copy())
+        dist.scatter(x, src=1)
+        for r in range(n):
+            np.testing.assert_allclose(x.numpy()[r], base[1, r * 2:(r + 1) * 2])
+
+    def test_send_recv(self):
+        _world()
+        a = paddle.to_tensor(np.float32([1, 2, 3]))
+        out = paddle.to_tensor(np.zeros(3, np.float32))
+        dist.send(a, dst=2)
+        dist.recv(out, src=0, rank=2)
+        np.testing.assert_allclose(out.numpy(), [1, 2, 3])
+
+    def test_recompute_nontensor_args(self):
+        """Non-Tensor positional args must not shift Tensor slots."""
+        x = paddle.to_tensor(np.float32([10.0, 20.0]), stop_gradient=False)
+        y = dist.recompute(lambda s, t: t * s, 3.0, x)
+        np.testing.assert_allclose(y.numpy(), [30.0, 60.0])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+    def test_in_trace_collectives(self):
+        """The production path: collectives inside shard_map-traced code."""
+        from jax import shard_map
+        g = _world()
+        mesh = g.mesh
+
+        def f(x):
+            t = dist.all_reduce(paddle.Tensor(x), group=g)
+            return t._data
+
+        base = np.random.randn(g.nranks, 3).astype(np.float32)
+        out = jax.jit(shard_map(f, mesh=mesh, in_specs=P(g.axis),
+                                out_specs=P(g.axis)))(base)
+        for r in range(g.nranks):
+            np.testing.assert_allclose(np.asarray(out)[r], base.sum(0), rtol=1e-5)
+
+
+class TestTopologyFleet:
+    def test_fleet_init_hybrid(self):
+        st = DistributedStrategy()
+        st.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+        fleet.init(is_collective=True, strategy=st)
+        hcg = fleet.get_hybrid_communicate_group()
+        assert hcg.get_data_parallel_world_size() == 2
+        assert hcg.get_model_parallel_world_size() == 4
+        assert hcg.get_parallel_mode() == "model"
+        assert hcg.get_model_parallel_group().nranks == 4
+        m = dist.get_mesh()
+        assert m.shape["dp"] == 2 and m.shape["mp"] == 4
+
+    def test_mesh_axis_helpers(self):
+        m = dist.build_mesh({"dp": 2, "mp": 4})
+        with dist.mesh_scope(m):
+            assert dist.mesh_axis_size("mp") == 4
+            assert dist.mesh_axis_size("pp") == 1
+
+
+class _TPMLP(nn.Layer):
+    """Megatron-style block: column-parallel then row-parallel."""
+
+    def __init__(self, d, h):
+        super().__init__()
+        self.fc1 = dist.ColumnParallelLinear(d, h, gather_output=False)
+        self.fc2 = dist.RowParallelLinear(h, d, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+class TestTensorParallel:
+    def test_mp_layers_math_single_device(self):
+        """Without a mesh, TP layers are plain dense layers (the correctness
+        reference, like OpTest comparing against numpy)."""
+        paddle.seed(7)
+        m = _TPMLP(8, 16)
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        y = m(x)
+        w1, b1 = m.fc1.weight.numpy(), m.fc1.bias.numpy()
+        w2, b2 = m.fc2.weight.numpy(), m.fc2.bias.numpy()
+        ref = np.maximum(x.numpy() @ w1 + b1, 0) @ w2 + b2
+        np.testing.assert_allclose(y.numpy(), ref, rtol=1e-5)
+
+    def test_tp_training_matches_single_device(self):
+        """hybrid dp2×mp4 TrainStep == single-device training (analog of
+        hybrid_parallel_mp_model.py comparing distributed vs single loss)."""
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.jit.train_step import TrainStep
+
+        def build():
+            paddle.seed(3)
+            return _TPMLP(8, 16)
+
+        x = np.random.randn(8, 8).astype(np.float32)
+        y = np.random.randn(8, 8).astype(np.float32)
+
+        def loss_fn(pred, target):
+            return ((pred - target) ** 2).mean()
+
+        # single device ground truth
+        m1 = build()
+        o1 = opt.SGD(learning_rate=0.1, parameters=m1.parameters())
+        s1 = TrainStep(m1, o1, lambda a, b: loss_fn(m1(a), b))
+        losses1 = [float(s1(paddle.to_tensor(x), paddle.to_tensor(y))) for _ in range(3)]
+
+        # hybrid mesh
+        st = DistributedStrategy()
+        st.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+        fleet.init(strategy=st)
+        m2 = build()
+        o2 = opt.SGD(learning_rate=0.1, parameters=m2.parameters())
+        s2 = TrainStep(m2, o2, lambda a, b: loss_fn(m2(a), b),
+                       mesh=dist.get_mesh(), data_axes=("dp",))
+        losses2 = [float(s2(paddle.to_tensor(x), paddle.to_tensor(y))) for _ in range(3)]
+        np.testing.assert_allclose(losses1, losses2, rtol=2e-4)
+        # weights actually sharded over mp
+        shard = m2.fc1.weight._data.sharding
+        assert shard.spec == P(None, "mp")
+
+    def test_vocab_parallel_embedding_and_ce(self):
+        paddle.seed(0)
+        emb = dist.VocabParallelEmbedding(32, 8)
+        ids = paddle.to_tensor(np.array([[1, 5], [7, 31]], dtype=np.int32))
+        out = emb(ids)
+        np.testing.assert_allclose(out.numpy(), emb.weight.numpy()[ids.numpy()], rtol=1e-6)
+
+        ce = dist.ParallelCrossEntropy()
+        logits = paddle.to_tensor(np.random.randn(4, 10).astype(np.float32))
+        labels = paddle.to_tensor(np.array([1, 2, 3, 4], dtype=np.int64))
+        loss = ce(logits, labels)
+        lg = logits.numpy()
+        ref = (np.log(np.exp(lg).sum(-1)) - lg[np.arange(4), labels.numpy()])
+        np.testing.assert_allclose(loss.numpy().squeeze(-1), ref, rtol=1e-5)
+
+
+class TestSharding:
+    def test_group_sharded_stage3_parity(self):
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.jit.train_step import TrainStep
+
+        def build():
+            paddle.seed(11)
+            return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 16))
+
+        x = np.random.randn(8, 16).astype(np.float32)
+        y = np.random.randn(8, 16).astype(np.float32)
+
+        def mk_loss(m):
+            return lambda a, b: ((m(a) - b) ** 2).mean()
+
+        m1 = build()
+        o1 = opt.AdamW(learning_rate=0.01, parameters=m1.parameters())
+        s1 = TrainStep(m1, o1, mk_loss(m1))
+        ref = [float(s1(paddle.to_tensor(x), paddle.to_tensor(y))) for _ in range(3)]
+
+        st = DistributedStrategy()
+        st.hybrid_configs = {"dp_degree": 2, "sharding_degree": 4}
+        fleet.init(strategy=st)
+        m2 = build()
+        o2 = opt.AdamW(learning_rate=0.01, parameters=m2.parameters())
+        m2, o2, _ = dist.group_sharded_parallel(m2, o2, level="p_g_os")
+        s2 = TrainStep(m2, o2, mk_loss(m2), mesh=dist.get_mesh(), data_axes=("dp",))
+        got = [float(s2(paddle.to_tensor(x), paddle.to_tensor(y))) for _ in range(3)]
+        np.testing.assert_allclose(ref, got, rtol=2e-4)
+        # params sharded over sdp (ZeRO-3)
+        assert any("sdp" in str(p._data.sharding.spec) for p in m2.parameters())
+
+    def test_stage1_opt_state_sharded(self):
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.jit.train_step import TrainStep
+        st = DistributedStrategy()
+        st.hybrid_configs = {"dp_degree": 2, "sharding_degree": 4}
+        fleet.init(strategy=st)
+        paddle.seed(1)
+        m = nn.Linear(16, 32)
+        o = opt.AdamW(learning_rate=0.01, parameters=m.parameters())
+        dist.shard_optimizer_state(o, stage=1)
+        s = TrainStep(m, o, lambda a, b: ((m(a) - b) ** 2).mean(),
+                      mesh=dist.get_mesh(), data_axes=("dp",))
+        x = paddle.to_tensor(np.random.randn(8, 16).astype(np.float32))
+        y = paddle.to_tensor(np.random.randn(8, 32).astype(np.float32))
+        s(x, y)
+        spec = s._opt_state[0]["moment1"].sharding.spec
+        assert "sdp" in str(spec)
+
+
+class TestRecompute:
+    def test_recompute_grads_match(self):
+        paddle.seed(5)
+        m = nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 8))
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+
+        y1 = m(x)
+        y1.sum().backward()
+        g_ref = [p.grad.numpy().copy() for p in m.parameters()]
+        for p in m.parameters():
+            p.clear_grad()
+
+        y2 = dist.recompute(m, x)
+        y2.sum().backward()
+        g_rc = [p.grad.numpy() for p in m.parameters()]
+        np.testing.assert_allclose(y1.numpy(), y2.numpy(), rtol=1e-6)
+        for a, b in zip(g_ref, g_rc):
+            np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+class TestPipeline:
+    def test_pipeline_scan_matches_sequential(self):
+        mesh = dist.build_mesh({"pp": 8})
+        with dist.mesh_scope(mesh):
+            S, M, D = 8, 4, 16
+            rng = np.random.RandomState(0)
+            ws = jnp.asarray(rng.randn(S, D, D).astype(np.float32) * 0.1)
+            xs = jnp.asarray(rng.randn(M, 2, D).astype(np.float32))
+
+            def stage_fn(w, x):
+                return jnp.tanh(x @ w)
+
+            out = dist.pipeline_scan(stage_fn, ws, xs, axis="pp", num_stages=S)
+            ref = xs
+            for s in range(S):
+                ref = jnp.tanh(ref @ ws[s])
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_pipeline_parallel_train_batch(self):
+        st = DistributedStrategy()
+        st.pipeline = True
+        st.pipeline_configs = {"accumulate_steps": 2}
+        st.hybrid_configs = {"dp_degree": 2, "pp_degree": 4}
+        fleet.init(strategy=st)
+        paddle.seed(2)
+        import paddle_tpu.optimizer as opt
+        model = dist.PipelineLayer(
+            layers=[dist.LayerDesc(nn.Linear, 8, 8) for _ in range(4)],
+            loss_fn=nn.MSELoss())
+        pp = fleet.distributed_model(model)
+        o = opt.SGD(learning_rate=0.05, parameters=model.parameters())
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        y = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        l0 = float(pp.train_batch((x, y), o))
+        l1 = float(pp.train_batch((x, y), o))
+        assert np.isfinite(l0) and np.isfinite(l1)
+        assert l1 < l0
