@@ -1,0 +1,93 @@
+"""Benchmark: GPT pretraining step throughput on the available accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol (BASELINE.md): steady-state step time (skip warmup), report
+tokens/sec/chip and achieved MFU; vs_baseline = achieved-MFU / 0.70 — the
+north-star target fraction (BASELINE.json: >=70% per-chip MFU). The reference
+repo publishes no absolute numbers (BASELINE.md), so the target line is the
+baseline.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+# bf16 peak matmul TFLOP/s per chip by TPU generation (public spec sheets)
+_PEAK = {"v2": 22.5e12, "v3": 61.5e12, "v4": 137.5e12, "v5e": 98.5e12,
+         "v5p": 229.5e12, "v6e": 459e12, "v6p": 459e12}
+
+
+def _chip_peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK.items():
+        if key in kind:
+            return val
+    return 137.5e12  # assume v4 if unknown
+
+
+def main():
+    import jax
+    import numpy as np
+
+    devs = jax.devices()
+    on_tpu = devs[0].platform in ("tpu", "axon")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion, gpt_config
+
+    if on_tpu:
+        preset, B, S, warmup, iters = "gpt3-125m", 8, 1024, 3, 10
+    else:  # CPU smoke (driver runs the real thing on TPU)
+        preset, B, S, warmup, iters = "gpt3-125m", 2, 128, 1, 3
+
+    cfg = gpt_config(preset, max_position_embeddings=max(1024, S))
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")  # TPU-native bf16 params+compute
+    crit = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    step = TrainStep(model, opt, lambda ids, lbl: crit(model(ids), lbl))
+
+    ids = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (B, S)).astype("int32"))
+
+    for _ in range(warmup):
+        loss = step(ids, ids)
+    jax.block_until_ready(loss._data)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, ids)
+    jax.block_until_ready(loss._data)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * S * iters / dt
+    n_params = sum(p.size for p in model.parameters())
+    # 6ND model FLOPs + attention term 12*L*H*S^2... use 6ND + 6*L*S*H per
+    # token attention matmul FLOPs (fwd+bwd)
+    L, H = cfg.num_layers, cfg.hidden_size
+    flops_per_token = 6 * n_params + 12 * L * H * S
+    model_flops = flops_per_token * tokens_per_sec
+    peak = _chip_peak_flops(devs[0])
+    mfu = model_flops / peak
+    vs_baseline = mfu / 0.70
+
+    print(json.dumps({
+        "metric": f"tokens/sec/chip ({preset} pretrain, B={B} S={S}, "
+                  f"{'bf16 ' if on_tpu else ''}{devs[0].device_kind})",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs_baseline, 4),
+        "extra": {"mfu": round(mfu, 4), "step_ms": round(dt / iters * 1e3, 2),
+                  "loss": round(float(loss), 4), "params": n_params},
+    }))
+
+
+if __name__ == "__main__":
+    main()

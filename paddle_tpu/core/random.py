@@ -84,13 +84,3 @@ def split_key():
         cell[0] = key
         return sub
     return _original_split_key()
-
-
-def current_key():
-    """Peek the active key (trace scope if inside a jit trace, else global)
-    without advancing it — used by RNG trackers that fold_in a stream name
-    (distributed.mpu.get_rng_state_tracker)."""
-    stack = getattr(_state, "trace_stack", None)
-    if stack:
-        return stack[-1][0]
-    return _get()

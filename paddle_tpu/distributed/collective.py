@@ -51,6 +51,16 @@ _REDUCERS = {
 }
 
 
+def _reduce_traced(arr, op, axis):
+    """Apply a ReduceOp over a live mesh axis (local per-shard view)."""
+    if op == ReduceOp.AVG:
+        return lax.pmean(arr, axis)
+    if op == ReduceOp.PROD:
+        # no pprod primitive (log-space is wrong for <=0): gather + prod
+        return jnp.prod(lax.all_gather(arr, axis), axis=0)
+    return _REDUCERS[op](arr, axis)
+
+
 class Group:
     """A communicator = a named mesh axis (reference: communication/group.py
     Group over a ProcessGroup; here the axis IS the communicator)."""
@@ -186,26 +196,12 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     → c_allreduce_* ops / ProcessGroup::AllReduce."""
     arr = _unwrap(tensor)
     if _is_traced(arr):
-        ax = group.axis if group is not None else None
-        red = _REDUCERS.get(op, lax.psum)
-        if op == ReduceOp.AVG:
-            return Tensor(lax.pmean(arr, ax)) if isinstance(tensor, Tensor) else lax.pmean(arr, ax)
-        out = red(arr, ax)
+        _require_group(group, "all_reduce")
+        out = _reduce_traced(arr, op, group.axis)
         return _rewrap(tensor, out) if isinstance(tensor, Tensor) else out
     group = group or _default_group()
     _check_group_dim(arr, group, "all_reduce")
-
-    def local(x):
-        if op == ReduceOp.AVG:
-            return lax.pmean(x, group.axis)
-        if op == ReduceOp.PROD:
-            # no pprod primitive: log-space for positives is wrong in general;
-            # gather then multiply
-            g = lax.all_gather(x, group.axis)
-            return jnp.prod(g, axis=0)
-        return _REDUCERS[op](x, group.axis)
-
-    out = _stacked(local, group, arr)
+    out = _stacked(lambda x: _reduce_traced(x, op, group.axis), group, arr)
     _rewrap(tensor, out)
     return _FakeTask()
 
@@ -257,15 +253,14 @@ def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group: Optional[Group] = None,
     arr = _unwrap(tensor)
     if _is_traced(arr):
         _require_group(group, "reduce")
-        red = lax.pmean(arr, group.axis) if op == ReduceOp.AVG \
-            else _REDUCERS[op](arr, group.axis)
+        red = _reduce_traced(arr, op, group.axis)
         out = jnp.where(lax.axis_index(group.axis) == dst, red, arr)
         return _rewrap(tensor, out) if isinstance(tensor, Tensor) else out
     group = group or _default_group()
     _check_group_dim(arr, group, "reduce")
 
     def local(x):
-        red = lax.pmean(x, group.axis) if op == ReduceOp.AVG else _REDUCERS[op](x, group.axis)
+        red = _reduce_traced(x, op, group.axis)
         i = lax.axis_index(group.axis)
         return jnp.where(i == dst, red, x)
 
@@ -309,7 +304,8 @@ def alltoall(in_tensor_list, out_tensor_list=None, group: Optional[Group] = None
     arr = _unwrap(x)
     if _is_traced(arr):
         _require_group(group, "alltoall")
-        return lax.all_to_all(arr, group.axis, split_axis=0, concat_axis=0, tiled=True)
+        out = lax.all_to_all(arr, group.axis, split_axis=0, concat_axis=0, tiled=True)
+        return _rewrap(x, out) if isinstance(x, Tensor) else out
     group = group or _default_group()
     _check_group_dim(arr, group, "alltoall")
     out = _stacked(
@@ -350,31 +346,31 @@ def scatter(tensor, tensor_list=None, src: int = 0, group: Optional[Group] = Non
     return _FakeTask()
 
 
-def send(tensor, dst: int, group: Optional[Group] = None, sync_op=True):
+def send(tensor, dst: int, group: Optional[Group] = None, sync_op=True,
+         src_rank: Optional[int] = None):
     """P2P send. TPU-native: p2p inside traced code is ppermute; eagerly the
-    single controller stages the value in a per-destination mailbox
-    (reference: send_v2/recv_v2 ops). The receiver identifies itself via
-    recv(..., rank=) when more than one destination has pending sends."""
-    _P2P_BUF.setdefault(dst, []).append(_unwrap(tensor))
+    single controller stages the value in a mailbox keyed (dst, src)
+    (reference: send_v2/recv_v2 ops). `src_rank` tags the sender — the
+    single controller has no implicit rank identity, so pass it whenever
+    more than one sender targets the same dst."""
+    _P2P_BUF.setdefault((dst, src_rank), []).append(_unwrap(tensor))
     return _FakeTask()
 
 
-def recv(tensor, src: int = 0, group: Optional[Group] = None, sync_op=True,
-         rank: Optional[int] = None):
-    """Receive a staged send. `rank` = the receiving rank (which mailbox to
-    read); optional only when it is unambiguous (a single pending dst)."""
-    if rank is None:
-        pending = [d for d, box in _P2P_BUF.items() if box]
-        if len(pending) != 1:
-            raise RuntimeError(
-                f"recv: ambiguous mailbox (pending dsts={sorted(pending)}); "
-                f"pass rank= to identify the receiver")
-        rank = pending[0]
-    box = _P2P_BUF.get(rank)
-    if not box:
-        raise RuntimeError(f"recv: no pending send for rank {rank} (eager p2p "
-                           f"is rendezvous within one controller)")
-    _rewrap(tensor, box.pop(0))
+def recv(tensor, src: Optional[int] = None, group: Optional[Group] = None,
+         sync_op=True, rank: Optional[int] = None):
+    """Receive a staged send. `rank` = the receiving rank (dst mailbox);
+    `src` matches a tagged sender. Either may be omitted only when the
+    pending sends make the match unambiguous."""
+    keys = [k for k, box in _P2P_BUF.items() if box
+            and (rank is None or k[0] == rank)
+            and (src is None or k[1] is None or k[1] == src)]
+    if len(keys) != 1:
+        raise RuntimeError(
+            f"recv(src={src}, rank={rank}): {'no' if not keys else 'ambiguous'}"
+            f" pending send (pending={sorted(_P2P_BUF)}); tag send(..., "
+            f"src_rank=) and pass rank= to disambiguate")
+    _rewrap(tensor, _P2P_BUF[keys[0]].pop(0))
     return _FakeTask()
 
 

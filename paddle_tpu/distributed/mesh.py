@@ -20,14 +20,16 @@ fleet/base/topology.py:53, extended with sp/ep which the reference lacks):
 from __future__ import annotations
 
 import contextlib
-import threading
+import types
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-_state = threading.local()
+# process-global (NOT thread-local: DataLoader worker threads and the main
+# thread must see the same mesh; fleet.init happens once per process)
+_state = types.SimpleNamespace()
 
 HYBRID_AXES = ("dp", "pp", "sdp", "mp")  # reference 4D order (topology.py:53)
 
@@ -72,6 +74,24 @@ def mesh_axis_size(axis: str) -> int:
     return m.shape[axis]
 
 
+def filter_spec(*entries):
+    """PartitionSpec with axis names not present in the active mesh replaced
+    by None — lets model code write its full sharding intent (dp/mp/sp/...)
+    once and degrade gracefully on smaller meshes."""
+    m = get_mesh()
+    names = set(m.axis_names) if m is not None else set()
+
+    def keep(e):
+        if e is None:
+            return None
+        if isinstance(e, (tuple, list)):
+            kept = tuple(x for x in e if x in names)
+            return kept if kept else None
+        return e if e in names else None
+
+    return P(*[keep(e) for e in entries])
+
+
 @contextlib.contextmanager
 def mesh_scope(mesh: Mesh):
     prev = get_mesh()
@@ -98,6 +118,6 @@ def shard_constraint(arr, *spec):
     if m is None:
         return arr
     try:
-        return jax.lax.with_sharding_constraint(arr, NamedSharding(m, P(*spec)))
+        return jax.lax.with_sharding_constraint(arr, NamedSharding(m, filter_spec(*spec)))
     except (ValueError, TypeError):
         return arr

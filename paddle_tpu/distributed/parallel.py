@@ -89,8 +89,13 @@ def is_initialized() -> bool:
 
 
 def barrier(group=None):
-    """Block until all devices reach this point: a cheap all-device psum.
-    (reference: barrier op, operators/collective/barrier_op.cc)"""
+    """Block until all *hosts* reach this point (reference: barrier op,
+    operators/collective/barrier_op.cc). Single-host: a device drain is
+    enough (one program order). Multi-host: a real cross-host sync via a
+    tiny all-device collective."""
     import jax.numpy as jnp
-    x = jnp.ones((), jnp.int32)
-    jax.block_until_ready(jax.device_put(x))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+        return
+    jax.block_until_ready(jax.device_put(jnp.ones((), jnp.int32)))

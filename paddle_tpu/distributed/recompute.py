@@ -17,23 +17,26 @@ from ..core.tensor import Tensor, apply_op
 
 
 def recompute(function, *args, use_reentrant: bool = True, preserve_rng_state: bool = True,
-              **kwargs):
+              params=None, **kwargs):
     """Reference: recompute.py:69 — same call shape. Works both eagerly (the
     tape records the remat-wrapped fn: its vjp recomputes) and under jit.
 
     The segment's parameters are lifted to differentiable inputs of the
     remat region (the analog of RecomputeFunction saving ctx.inputs): the
     layer's params would otherwise be traced as constants and get no grad.
+    Auto-detected when `function` is a Layer / bound Layer method; pass
+    `params=` explicitly for closures over several layers.
     """
     from ..nn.layer import Layer
 
-    params = []
-    if isinstance(function, Layer):
-        params = [p for p in function.parameters() if not p.stop_gradient]
-    else:
-        self_obj = getattr(function, "__self__", None)
-        if isinstance(self_obj, Layer):
-            params = [p for p in self_obj.parameters() if not p.stop_gradient]
+    if params is None:
+        params = []
+        if isinstance(function, Layer):
+            params = [p for p in function.parameters() if not p.stop_gradient]
+        else:
+            self_obj = getattr(function, "__self__", None)
+            if isinstance(self_obj, Layer):
+                params = [p for p in self_obj.parameters() if not p.stop_gradient]
     n_args = len(args)
 
     def raw(*arrs):
@@ -60,22 +63,26 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
     layers = list(functions)
     n = len(layers)
     seg = max(1, n // max(1, segments))
-    x = args[0] if len(args) == 1 else args
 
     def run_span(lo, hi):
-        def f(inp):
-            y = inp
+        def f(*inp):
+            y = inp if len(inp) > 1 else inp[0]
             for l in layers[lo:hi]:
-                y = l(y)
+                y = l(*y) if isinstance(y, tuple) else l(y)
             return y
         return f
 
+    from ..nn.layer import Layer
+    cur = tuple(args)
     i = 0
     while i < n:
         hi = min(n, i + seg)
-        x = recompute(run_span(i, hi), x, **kwargs)
+        span_params = [p for l in layers[i:hi] if isinstance(l, Layer)
+                       for p in l.parameters() if not p.stop_gradient]
+        out = recompute(run_span(i, hi), *cur, params=span_params, **kwargs)
+        cur = out if isinstance(out, tuple) else (out,)
         i = hi
-    return x
+    return cur if len(cur) > 1 else cur[0]
 
 
 def recompute_hybrid(ctx, function, *args, **kwargs):

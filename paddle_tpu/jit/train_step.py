@@ -90,6 +90,11 @@ class TrainStep:
         return specs
 
     def _placement(self, spec):
+        # drop axis names the mesh doesn't have (a TP-annotated model run on
+        # a dp-only mesh just replicates those dims)
+        from ..distributed import mesh as _dmesh
+        with _dmesh.mesh_scope(self.mesh):
+            spec = _dmesh.filter_spec(*spec) if spec is not None else P()
         return NamedSharding(self.mesh, spec)
 
     def _apply_param_shardings(self):

@@ -1,0 +1,12 @@
+"""Model zoo.
+
+Reference: python/paddle/vision/models (ResNet/VGG/MobileNet/... listing,
+SURVEY §2.3) for vision; PaddleNLP entrypoints (BASELINE.md configs) for the
+language flagship. Everything is built on paddle_tpu.nn layers and the
+distributed mpu layers, so every model is single-chip AND hybrid-parallel
+capable from the same code.
+"""
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
+    gpt_config, PRESETS as GPT_PRESETS,
+)

@@ -1,0 +1,300 @@
+"""GPT model family — the flagship (BASELINE.md: GPT-3 1.3B/6.7B hybrid DP+TP).
+
+Reference capability: PaddleNLP-style GPT built from the reference's
+mpu layers (fleet/layers/mpu/mp_layers.py) + incubate fused transformer
+(incubate/nn/layer/fused_transformer.py:192 FusedMultiHeadAttention, :1021
+FusedMultiTransformer). TPU-native: one implementation serves single-chip and
+hybrid-parallel — parallelism comes from the mpu layers' PartitionSpecs
+(qkv/up = column-parallel over `mp`, out/down = row-parallel), activations
+carry dp/sp constraints, attention routes through the Pallas flash kernel,
+and rematerialisation is per-block `jax.checkpoint` (distributed.recompute).
+
+Sharding map (scaling-book recipe):
+  wte [V, H]        P('mp', None)      vocab-parallel
+  wpe [S, H]        replicated
+  qkv W [H, 3H]     P(None, 'mp')      heads sharded
+  out W [H, H]      P('mp', None)
+  mlp up [H, 4H]    P(None, 'mp')
+  mlp down [4H, H]  P('mp', None)
+  activations [B,S,H] P('dp', 'sp', None); attention heads dim constrained 'mp'
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor, apply_op
+from ..core import ops
+from ..nn.layer import Layer, LayerList
+from ..nn import functional as F
+from ..nn.layers.common import Embedding, Dropout
+from ..nn.layers.norm import LayerNorm
+from ..nn import initializer as I
+from ..distributed.mpu import (ColumnParallelLinear, RowParallelLinear,
+                               VocabParallelEmbedding, ParallelCrossEntropy)
+from ..distributed import mesh as _mesh
+from ..distributed.recompute import recompute
+from ..ops.attention import functional_attention
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 1024
+    hidden_dropout: float = 0.0
+    attention_dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    use_recompute: bool = False
+    tie_word_embeddings: bool = True
+    param_dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+        assert self.hidden_size % self.num_heads == 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+# BASELINE.md configs (sizes follow the GPT-3 paper table the reference's
+# PaddleNLP entrypoints use)
+PRESETS = {
+    "gpt3-125m": dict(hidden_size=768, num_layers=12, num_heads=12),
+    "gpt3-350m": dict(hidden_size=1024, num_layers=24, num_heads=16),
+    "gpt3-1.3b": dict(hidden_size=2048, num_layers=24, num_heads=16),
+    "gpt3-2.7b": dict(hidden_size=2560, num_layers=32, num_heads=32),
+    "gpt3-6.7b": dict(hidden_size=4096, num_layers=32, num_heads=32),
+    "gpt3-13b": dict(hidden_size=5120, num_layers=40, num_heads=40),
+}
+
+
+def gpt_config(preset: str, **overrides) -> GPTConfig:
+    cfg = dict(PRESETS[preset])
+    cfg.update(overrides)
+    return GPTConfig(**cfg)
+
+
+class GPTSelfAttention(Layer):
+    """Fused QKV column-parallel attention block."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.head_dim = config.head_dim
+        h = config.hidden_size
+        w_init = I.Normal(std=config.initializer_range)
+        self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
+        self.qkv.weight.set_value(w_init([h, 3 * h], self.qkv.weight.dtype))
+        self.out = RowParallelLinear(h, h, input_is_parallel=True)
+        self.out.weight.set_value(
+            w_init([h, h], self.out.weight.dtype) /
+            math.sqrt(2 * config.num_layers))
+        self.dropout = Dropout(config.hidden_dropout)
+
+    def forward(self, x, cache=None):
+        nh, hd = self.num_heads, self.head_dim
+        qkv = self.qkv(x)                               # [B,S,3H] (mp-sharded)
+        b, s = qkv.shape[0], qkv.shape[1]
+        qkv = ops.reshape(qkv, [b, s, 3, nh, hd])
+
+        new_cache = None
+        if cache is not None:
+            # incremental decode: append K/V (reference MultiHeadAttention
+            # Cache semantics, nn/layer/transformer.py)
+            k_old, v_old = cache
+            q = qkv[:, :, 0]
+            k = ops.concat([k_old, qkv[:, :, 1]], axis=1)
+            v = ops.concat([v_old, qkv[:, :, 2]], axis=1)
+            new_cache = (k.detach(), v.detach())
+            ctx = _attend(q, k, v, causal=False)  # q is the tail; mask below
+        else:
+            ctx = apply_op(
+                "gpt_attention",
+                lambda a: _qkv_attention(a, nh, hd), [qkv])
+        y = self.out(ops.reshape(ctx, [b, ctx.shape[1], nh * hd]))
+        if self.training and self.dropout.p:
+            y = self.dropout(y)
+        if cache is not None:
+            return y, new_cache
+        return y
+
+
+def _qkv_attention(qkv, nh, hd):
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = _mesh.shard_constraint(q, "dp", "sp", "mp", None)
+    k = _mesh.shard_constraint(k, "dp", "sp", "mp", None)
+    v = _mesh.shard_constraint(v, "dp", "sp", "mp", None)
+    out = functional_attention(q, k, v, is_causal=True)
+    return _mesh.shard_constraint(out, "dp", "sp", "mp", None)
+
+
+def _attend(q, k, v, causal):
+    return apply_op("sdpa_cached",
+                    lambda a, b_, c: functional_attention(a, b_, c, is_causal=causal),
+                    [q, k, v])
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        w_init = I.Normal(std=config.initializer_range)
+        self.up = ColumnParallelLinear(h, m, gather_output=False)
+        self.up.weight.set_value(w_init([h, m], self.up.weight.dtype))
+        self.down = RowParallelLinear(m, h, input_is_parallel=True)
+        self.down.weight.set_value(
+            w_init([m, h], self.down.weight.dtype) /
+            math.sqrt(2 * config.num_layers))
+        self.dropout = Dropout(config.hidden_dropout)
+
+    def forward(self, x):
+        y = self.down(F.gelu(self.up(x), approximate=True))
+        if self.training and self.dropout.p:
+            y = self.dropout(y)
+        return y
+
+
+class GPTBlock(Layer):
+    """Pre-LN transformer block."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTSelfAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x, cache=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln_1(x), cache=cache)
+            x = x + a
+            x = x + self.mlp(self.ln_2(x))
+            return x, new_cache
+        x = x + self.attn(self.ln_1(x))
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTModel(Layer):
+    """Backbone: embeddings + N blocks + final LN."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        self.wte.weight.set_value(
+            I.Normal(std=config.initializer_range)(
+                [config.vocab_size, config.hidden_size], self.wte.weight.dtype))
+        self.wpe = Embedding(config.max_position_embeddings, config.hidden_size)
+        self.drop = Dropout(config.hidden_dropout)
+        self.h = LayerList([GPTBlock(config) for _ in range(config.num_layers)])
+        self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        if config.param_dtype != "float32":
+            self.to(dtype=config.param_dtype)
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        s = input_ids.shape[1]
+        past = caches[0][0].shape[1] if caches else 0
+        if position_ids is None:
+            position_ids = ops.arange(past, past + s, dtype="int64")
+            position_ids = ops.unsqueeze(position_ids, 0)
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = apply_op("act_shard", lambda a: _mesh.shard_constraint(
+            a, "dp", "sp", None), [x])
+        if self.training and self.config.hidden_dropout:
+            x = self.drop(x)
+
+        new_caches = [] if caches is not None else None
+        for i, block in enumerate(self.h):
+            if caches is not None:
+                x, c = block(x, cache=caches[i])
+                new_caches.append(c)
+            elif self.config.use_recompute and self.training:
+                x = recompute(block, x)
+            else:
+                x = block(x)
+        x = self.ln_f(x)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class GPTForCausalLM(Layer):
+    """LM head (tied to wte by default — vocab-parallel logits)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=False)
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        out = self.gpt(input_ids, position_ids, caches=caches)
+        x, new_caches = out if caches is not None else (out, None)
+        if self.config.tie_word_embeddings:
+            logits = apply_op(
+                "tied_lm_head",
+                lambda a, w: _mesh.shard_constraint(
+                    jnp.einsum("bsh,vh->bsv", a, w), "dp", "sp", "mp"),
+                [x, self.gpt.wte.weight])
+        else:
+            logits = self.lm_head(x)
+        if caches is not None:
+            return logits, new_caches
+        return logits
+
+    def generate(self, input_ids, max_new_tokens: int = 16, temperature: float = 0.0):
+        """Greedy/temperature sampling with KV cache (reference:
+        paddlenlp-style generate; cache semantics of MultiHeadAttention)."""
+        b = input_ids.shape[0]
+        caches = [(ops.zeros([b, 0, self.config.num_heads, self.config.head_dim],
+                             dtype="float32"),
+                   ops.zeros([b, 0, self.config.num_heads, self.config.head_dim],
+                             dtype="float32"))
+                  for _ in range(self.config.num_layers)]
+        out = input_ids
+        cur = input_ids
+        for _ in range(max_new_tokens):
+            logits, caches = self.forward(cur, caches=caches)
+            last = logits[:, -1]
+            if temperature > 0:
+                last = last / temperature
+                nxt = ops.multinomial(F.softmax(last, axis=-1), 1)
+            else:
+                nxt = ops.unsqueeze(ops.argmax(last, axis=-1), -1)
+            nxt = ops.cast(nxt, "int64")
+            out = ops.concat([out, nxt], axis=1)
+            cur = nxt
+        return out
+
+
+class GPTPretrainingCriterion(Layer):
+    """Reference: PaddleNLP GPTPretrainingCriterion — masked mean CE over
+    vocab-parallel logits (ParallelCrossEntropy analog)."""
+
+    def __init__(self, config: Optional[GPTConfig] = None):
+        super().__init__()
+        self.ce = ParallelCrossEntropy()
+
+    def forward(self, logits, labels, loss_mask=None):
+        loss = self.ce(logits, labels)           # [B, S, 1]
+        loss = ops.squeeze(loss, -1)
+        if loss_mask is not None:
+            loss = loss * loss_mask
+            return ops.sum(loss) / ops.maximum(
+                ops.sum(loss_mask), ops.full([], 1e-8, loss_mask.dtype))
+        return ops.mean(loss)
