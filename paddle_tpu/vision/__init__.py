@@ -1,0 +1,10 @@
+"""Vision kit (reference: python/paddle/vision/).
+
+Model zoo + transforms + datasets + box ops, TPU-native: NCHW user-facing
+layout (converted once to NHWC-friendly convs inside lax), bf16-ready.
+"""
+from . import models, transforms, datasets, ops
+from .models import *  # noqa: F401,F403
+from .models import __all__ as _models_all
+
+__all__ = ["models", "transforms", "datasets", "ops"] + list(_models_all)

@@ -1,0 +1,211 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/{mnist,cifar,folder}.py).
+
+Zero-egress environment: datasets read from local files (standard archive
+formats); `FakeData` provides synthetic samples for tests/smoke runs.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder", "FakeData"]
+
+
+class MNIST(Dataset):
+    """IDX-format reader (reference mnist.py:24 — download replaced by
+    local-path loading; this env has no egress)."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, backend="cv2", root=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        root = root or os.path.join(os.path.expanduser("~"), ".cache",
+                                    "paddle_tpu", "datasets", self.NAME)
+        tag = "train" if self.mode == "train" else "t10k"
+        image_path = image_path or os.path.join(root, f"{tag}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(root, f"{tag}-labels-idx1-ubyte.gz")
+        for p in (image_path, label_path):
+            if not os.path.exists(p):
+                raise FileNotFoundError(
+                    f"{p} not found; place the {self.NAME} IDX files there "
+                    "(no network downloads in this environment)")
+        self.images = self._read_idx(image_path, 2051)
+        self.labels = self._read_idx(label_path, 2049)
+
+    @staticmethod
+    def _read_idx(path, want_magic):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            data = f.read()
+        magic, = struct.unpack(">i", data[:4])
+        assert magic == want_magic, f"bad IDX magic {magic} in {path}"
+        ndim = magic % 256
+        dims = struct.unpack(f">{ndim}i", data[4:4 + 4 * ndim])
+        arr = np.frombuffer(data, np.uint8, offset=4 + 4 * ndim)
+        return arr.reshape(dims)
+
+    def __getitem__(self, idx):
+        img = self.images[idx][..., None]  # HWC
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """python-pickle CIFAR tarball reader (reference cifar.py:30)."""
+
+    _NAME = "cifar-10-python.tar.gz"
+    _TRAIN_MEMBER = "data_batch"
+    _TEST_MEMBER = "test_batch"
+    _LABEL_KEY = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None, root=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        root = root or os.path.join(os.path.expanduser("~"), ".cache",
+                                    "paddle_tpu", "datasets")
+        data_file = data_file or os.path.join(root, self._NAME)
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{data_file} not found; place the CIFAR archive there "
+                "(no network downloads in this environment)")
+        want = self._TRAIN_MEMBER if self.mode == "train" else self._TEST_MEMBER
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in sorted(tf.getmembers(), key=lambda m: m.name):
+                if want in os.path.basename(member.name):
+                    batch = pickle.load(tf.extractfile(member), encoding="bytes")
+                    images.append(batch[b"data"].reshape(-1, 3, 32, 32))
+                    labels.extend(batch[self._LABEL_KEY])
+        self.images = np.concatenate(images).transpose(0, 2, 3, 1)  # NHWC
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    _NAME = "cifar-100-python.tar.gz"
+    _TRAIN_MEMBER = "train"
+    _TEST_MEMBER = "test"
+    _LABEL_KEY = b"fine_labels"
+
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+def _load_image(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+        return np.asarray(Image.open(path).convert("RGB"))
+    except ImportError as e:
+        raise ImportError("reading encoded images requires PIL; "
+                          "use .npy files or install pillow") from e
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdir layout (reference folder.py:42)."""
+
+    def __init__(self, root, loader=None, extensions=_IMG_EXTS, transform=None,
+                 is_valid_file=None):
+        self.root, self.transform = root, transform
+        self.loader = loader or _load_image
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    ok = is_valid_file(path) if is_valid_file else \
+                        fname.lower().endswith(extensions)
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """flat folder of images, no labels (reference folder.py:215)."""
+
+    def __init__(self, root, loader=None, extensions=_IMG_EXTS, transform=None,
+                 is_valid_file=None):
+        self.root, self.transform = root, transform
+        self.loader = loader or _load_image
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = is_valid_file(path) if is_valid_file else \
+                    fname.lower().endswith(extensions)
+                if ok:
+                    self.samples.append(path)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class FakeData(Dataset):
+    """Synthetic dataset for tests/benchmarks (no reference analog needed:
+    stands in for downloads in the zero-egress environment)."""
+
+    def __init__(self, size=100, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None, seed=0):
+        self.size, self.image_shape = size, tuple(image_shape)
+        self.num_classes, self.transform = num_classes, transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self.seed + idx)
+        img = rng.standard_normal(self.image_shape, np.float32)
+        label = np.int64(rng.integers(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size

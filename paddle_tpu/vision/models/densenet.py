@@ -1,0 +1,425 @@
+"""DenseNet / GoogLeNet / InceptionV3 / ShuffleNetV2 (reference:
+python/paddle/vision/models/{densenet,googlenet,inceptionv3,shufflenetv2}.py)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from ... import nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264", "GoogLeNet", "googlenet",
+           "InceptionV3", "inception_v3", "ShuffleNetV2", "shufflenet_v2_x0_25",
+           "shufflenet_v2_x0_33", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth, bn_size, drop):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.relu = nn.ReLU()
+        self.drop = nn.Dropout(drop) if drop else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        if self.drop:
+            out = self.drop(out)
+        return paddle.concat([x, out], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(in_c)
+        self.conv = nn.Conv2D(in_c, out_c, 1, bias_attr=False)
+        self.relu = nn.ReLU()
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+_DENSE_CFG = {121: (64, 32, [6, 12, 24, 16]), 161: (96, 48, [6, 12, 36, 24]),
+              169: (64, 32, [6, 12, 32, 32]), 201: (64, 32, [6, 12, 48, 32]),
+              264: (64, 32, [6, 12, 64, 48])}
+
+
+class DenseNet(nn.Layer):
+    """reference densenet.py:207."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        num_init, growth, cfg = _DENSE_CFG[layers]
+        self.num_classes, self.with_pool = num_classes, with_pool
+        feats = [nn.Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+                 nn.BatchNorm2D(num_init), nn.ReLU(), nn.MaxPool2D(3, 2, padding=1)]
+        c = num_init
+        for i, n in enumerate(cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth, bn_size, dropout))
+                c += growth
+            if i != len(cfg) - 1:
+                feats.append(_Transition(c, c // 2))
+                c //= 2
+        feats += [nn.BatchNorm2D(c), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def _densenet(layers, pretrained=False, **kw):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return DenseNet(layers, **kw)
+
+
+def densenet121(pretrained=False, **kw):
+    return _densenet(121, pretrained, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return _densenet(161, pretrained, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return _densenet(169, pretrained, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return _densenet(201, pretrained, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return _densenet(264, pretrained, **kw)
+
+
+class _Inception(nn.Layer):
+    """GoogLeNet inception block (reference googlenet.py:36)."""
+
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(in_c, c1, 1), nn.ReLU())
+        self.b2 = nn.Sequential(nn.Conv2D(in_c, c3r, 1), nn.ReLU(),
+                                nn.Conv2D(c3r, c3, 3, padding=1), nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(in_c, c5r, 1), nn.ReLU(),
+                                nn.Conv2D(c5r, c5, 5, padding=2), nn.ReLU())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, padding=1),
+                                nn.Conv2D(in_c, proj, 1), nn.ReLU())
+
+    def forward(self, x):
+        return paddle.concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                             axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """reference googlenet.py:88."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes, self.with_pool = num_classes, with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, 2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, 2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.pool4(self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x))))))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(x.flatten(1)))
+        return x
+
+
+def googlenet(pretrained=False, **kw):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return GoogLeNet(**kw)
+
+
+class _BasicConv(nn.Layer):
+    def __init__(self, in_c, out_c, kernel, **kwargs):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, kernel, bias_attr=False, **kwargs)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_feats):
+        super().__init__()
+        self.b1 = _BasicConv(in_c, 64, 1)
+        self.b5 = nn.Sequential(_BasicConv(in_c, 48, 1),
+                                _BasicConv(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_BasicConv(in_c, 64, 1),
+                                _BasicConv(64, 96, 3, padding=1),
+                                _BasicConv(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _BasicConv(in_c, pool_feats, 1))
+
+    def forward(self, x):
+        return paddle.concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], 1)
+
+
+class _InceptionB(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _BasicConv(in_c, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_BasicConv(in_c, 64, 1),
+                                 _BasicConv(64, 96, 3, padding=1),
+                                 _BasicConv(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b3d(x), self.pool(x)], 1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _BasicConv(in_c, 192, 1)
+        self.b7 = nn.Sequential(_BasicConv(in_c, c7, 1),
+                                _BasicConv(c7, c7, (1, 7), padding=(0, 3)),
+                                _BasicConv(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(_BasicConv(in_c, c7, 1),
+                                 _BasicConv(c7, c7, (7, 1), padding=(3, 0)),
+                                 _BasicConv(c7, c7, (1, 7), padding=(0, 3)),
+                                 _BasicConv(c7, c7, (7, 1), padding=(3, 0)),
+                                 _BasicConv(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _BasicConv(in_c, 192, 1))
+
+    def forward(self, x):
+        return paddle.concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], 1)
+
+
+class _InceptionD(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(_BasicConv(in_c, 192, 1),
+                                _BasicConv(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(_BasicConv(in_c, 192, 1),
+                                _BasicConv(192, 192, (1, 7), padding=(0, 3)),
+                                _BasicConv(192, 192, (7, 1), padding=(3, 0)),
+                                _BasicConv(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b7(x), self.pool(x)], 1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _BasicConv(in_c, 320, 1)
+        self.b3_1 = _BasicConv(in_c, 384, 1)
+        self.b3_2a = _BasicConv(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = _BasicConv(384, 384, (3, 1), padding=(1, 0))
+        self.bd_1 = nn.Sequential(_BasicConv(in_c, 448, 1),
+                                  _BasicConv(448, 384, 3, padding=1))
+        self.bd_2a = _BasicConv(384, 384, (1, 3), padding=(0, 1))
+        self.bd_2b = _BasicConv(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _BasicConv(in_c, 192, 1))
+
+    def forward(self, x):
+        b3 = self.b3_1(x)
+        b3 = paddle.concat([self.b3_2a(b3), self.b3_2b(b3)], 1)
+        bd = self.bd_1(x)
+        bd = paddle.concat([self.bd_2a(bd), self.bd_2b(bd)], 1)
+        return paddle.concat([self.b1(x), b3, bd, self.bp(x)], 1)
+
+
+class InceptionV3(nn.Layer):
+    """reference inceptionv3.py:478."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes, self.with_pool = num_classes, with_pool
+        self.stem = nn.Sequential(
+            _BasicConv(3, 32, 3, stride=2), _BasicConv(32, 32, 3),
+            _BasicConv(32, 64, 3, padding=1), nn.MaxPool2D(3, 2),
+            _BasicConv(64, 80, 1), _BasicConv(80, 192, 3), nn.MaxPool2D(3, 2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160), _InceptionC(768, 160),
+            _InceptionC(768, 192), _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout()
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return InceptionV3(**kw)
+
+
+def _channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = x.reshape([n, groups, c // groups, h, w])
+    x = x.transpose([0, 2, 1, 3, 4])
+    return x.reshape([n, c, h, w])
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=2, padding=1, groups=in_c,
+                          bias_attr=False),
+                nn.BatchNorm2D(in_c), nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), act())
+            b2_in = in_c
+        else:
+            self.branch1 = None
+            b2_in = in_c // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), act(),
+            nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                      groups=branch_c, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), act())
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_SHUFFLE_CFG = {0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+                0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+                1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048]}
+
+
+class ShuffleNetV2(nn.Layer):
+    """reference shufflenetv2.py:109."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes, self.with_pool = num_classes, with_pool
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
+        cfg = _SHUFFLE_CFG[scale]
+        stage_repeats = [4, 8, 4]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, cfg[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(cfg[0]), act_layer())
+        self.maxpool = nn.MaxPool2D(3, 2, padding=1)
+        blocks = []
+        in_c = cfg[0]
+        for stage, reps in enumerate(stage_repeats):
+            out_c = cfg[stage + 1]
+            for i in range(reps):
+                blocks.append(_ShuffleUnit(in_c, out_c, 2 if i == 0 else 1,
+                                           act_layer))
+                in_c = out_c
+        self.blocks = nn.Sequential(*blocks)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_c, cfg[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(cfg[-1]), act_layer())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(cfg[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.blocks(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _shufflenet(scale, act="relu", pretrained=False, **kw):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return ShuffleNetV2(scale=scale, act=act, **kw)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return _shufflenet(0.25, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return _shufflenet(0.33, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return _shufflenet(0.5, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return _shufflenet(1.0, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return _shufflenet(1.5, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return _shufflenet(2.0, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return _shufflenet(1.0, act="swish", pretrained=pretrained, **kw)

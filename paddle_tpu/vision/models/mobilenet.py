@@ -1,0 +1,235 @@
+"""MobileNet V1/V2/V3 (reference: python/paddle/vision/models/mobilenetv{1,2,3}.py).
+
+Depthwise convs lower to grouped lax.conv_general_dilated; XLA maps them to
+the MXU when channel counts are lane-aligned (multiples of 128 ideal).
+"""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV1", "MobileNetV2", "MobileNetV3Small", "MobileNetV3Large",
+           "mobilenet_v1", "mobilenet_v2", "mobilenet_v3_small", "mobilenet_v3_large"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, in_c, out_c, kernel, stride=1, groups=1, act=nn.ReLU):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, kernel, stride=stride,
+                              padding=kernel // 2, groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.act = act() if act else None
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act else x
+
+
+class DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.depthwise = ConvBNLayer(in_c, in_c, 3, stride=stride, groups=in_c)
+        self.pointwise = ConvBNLayer(in_c, out_c, 1)
+
+    def forward(self, x):
+        return self.pointwise(self.depthwise(x))
+
+
+class MobileNetV1(nn.Layer):
+    """reference mobilenetv1.py:103"""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes, self.with_pool = num_classes, with_pool
+        s = lambda c: int(c * scale)
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        self.conv1 = ConvBNLayer(3, s(32), 3, stride=2)
+        self.blocks = nn.Sequential(
+            *[DepthwiseSeparable(s(i), s(o), st) for i, o, st in cfg])
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNLayer(inp, hidden, 1, act=nn.ReLU6))
+        layers += [ConvBNLayer(hidden, hidden, 3, stride=stride, groups=hidden,
+                               act=nn.ReLU6),
+                   ConvBNLayer(hidden, oup, 1, act=None)]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        return x + self.conv(x) if self.use_res else self.conv(x)
+
+
+class MobileNetV2(nn.Layer):
+    """reference mobilenetv2.py:84"""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes, self.with_pool = num_classes, with_pool
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = _make_divisible(32 * scale)
+        last_c = _make_divisible(1280 * max(1.0, scale))
+        feats = [ConvBNLayer(3, in_c, 3, stride=2, act=nn.ReLU6)]
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                feats.append(InvertedResidual(in_c, out_c, s if i == 0 else 1, t))
+                in_c = out_c
+        feats.append(ConvBNLayer(in_c, last_c, 1, act=nn.ReLU6))
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                            nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class SqueezeExcite(nn.Layer):
+    def __init__(self, channels, reduction=4):
+        super().__init__()
+        squeeze = _make_divisible(channels // reduction)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(channels, squeeze, 1)
+        self.fc2 = nn.Conv2D(squeeze, channels, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _V3Block(nn.Layer):
+    def __init__(self, in_c, exp, out_c, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp != in_c:
+            layers.append(ConvBNLayer(in_c, exp, 1, act=act))
+        layers.append(ConvBNLayer(exp, exp, kernel, stride=stride, groups=exp,
+                                  act=act))
+        if use_se:
+            layers.append(SqueezeExcite(exp))
+        layers.append(ConvBNLayer(exp, out_c, 1, act=None))
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        return x + self.conv(x) if self.use_res else self.conv(x)
+
+
+_V3_SMALL = [  # k, exp, c, se, act, s
+    (3, 16, 16, True, nn.ReLU, 2), (3, 72, 24, False, nn.ReLU, 2),
+    (3, 88, 24, False, nn.ReLU, 1), (5, 96, 40, True, nn.Hardswish, 2),
+    (5, 240, 40, True, nn.Hardswish, 1), (5, 240, 40, True, nn.Hardswish, 1),
+    (5, 120, 48, True, nn.Hardswish, 1), (5, 144, 48, True, nn.Hardswish, 1),
+    (5, 288, 96, True, nn.Hardswish, 2), (5, 576, 96, True, nn.Hardswish, 1),
+    (5, 576, 96, True, nn.Hardswish, 1)]
+_V3_LARGE = [
+    (3, 16, 16, False, nn.ReLU, 1), (3, 64, 24, False, nn.ReLU, 2),
+    (3, 72, 24, False, nn.ReLU, 1), (5, 72, 40, True, nn.ReLU, 2),
+    (5, 120, 40, True, nn.ReLU, 1), (5, 120, 40, True, nn.ReLU, 1),
+    (3, 240, 80, False, nn.Hardswish, 2), (3, 200, 80, False, nn.Hardswish, 1),
+    (3, 184, 80, False, nn.Hardswish, 1), (3, 184, 80, False, nn.Hardswish, 1),
+    (3, 480, 112, True, nn.Hardswish, 1), (3, 672, 112, True, nn.Hardswish, 1),
+    (5, 672, 160, True, nn.Hardswish, 2), (5, 960, 160, True, nn.Hardswish, 1),
+    (5, 960, 160, True, nn.Hardswish, 1)]
+
+
+class _MobileNetV3(nn.Layer):
+    """reference mobilenetv3.py:133 (MobileNetV3 base)."""
+
+    def __init__(self, cfg, last_exp, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes, self.with_pool = num_classes, with_pool
+        s = lambda c: _make_divisible(c * scale)
+        in_c = s(16)
+        feats = [ConvBNLayer(3, in_c, 3, stride=2, act=nn.Hardswish)]
+        for k, exp, c, se, act, st in cfg:
+            feats.append(_V3Block(in_c, s(exp), s(c), k, st, se, act))
+            in_c = s(c)
+        feats.append(ConvBNLayer(in_c, s(last_exp), 1, act=nn.Hardswish))
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(s(last_exp), 1280), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(1280, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 576, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 960, scale, num_classes, with_pool)
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    _no_pretrained(pretrained)
+    return MobileNetV1(scale=scale, **kw)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kw):
+    _no_pretrained(pretrained)
+    return MobileNetV2(scale=scale, **kw)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    _no_pretrained(pretrained)
+    return MobileNetV3Small(scale=scale, **kw)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    _no_pretrained(pretrained)
+    return MobileNetV3Large(scale=scale, **kw)

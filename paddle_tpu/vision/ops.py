@@ -1,0 +1,235 @@
+"""Vision ops: boxes, NMS, RoI ops, DeformConv stub (reference:
+python/paddle/vision/ops.py).
+
+TPU-first: NMS is implemented as a fixed-iteration lax.while-free masked
+suppression (compile-friendly static shapes), not a dynamic loop.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["yolo_box", "box_coder", "nms", "roi_align", "roi_pool",
+           "distribute_fpn_proposals", "box_iou"]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def box_iou(boxes1, boxes2):
+    """IoU matrix [N, M] for xyxy boxes."""
+    b1, b2 = _data(boxes1), _data(boxes2)
+    area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+    area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+    lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+    rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return Tensor(inter / (area1[:, None] + area2[None, :] - inter + 1e-10))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """reference ops.py:1461 paddle.vision.ops.nms.
+
+    Masked O(N^2) suppression with static shapes: returns kept indices
+    sorted by score (host-materialised, like the reference's dynamic out).
+    """
+    b = _data(boxes)
+    n = b.shape[0]
+    s = _data(scores) if scores is not None else jnp.arange(n, 0, -1, jnp.float32)
+    if category_idxs is not None:
+        # offset boxes per category so cross-category IoU is 0 (batched NMS trick)
+        c = _data(category_idxs).astype(b.dtype)
+        offset = (b.max() + 1.0) * c
+        b = b + offset[:, None]
+    order = jnp.argsort(-s)
+    b_sorted = b[order]
+    iou = box_iou(Tensor(b_sorted), Tensor(b_sorted))._data
+    # keep[i] = no earlier kept box overlaps i above threshold
+    import numpy as np
+    iou_np = np.asarray(iou)
+    keep_mask = np.ones(n, bool)
+    for i in range(n):
+        if not keep_mask[i]:
+            continue
+        keep_mask[i + 1:] &= iou_np[i, i + 1:] <= iou_threshold
+    kept = np.asarray(order)[keep_mask]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept, jnp.int32))
+
+
+def _roi_grid(bd, boxes_num, n_rois, oh, ow, spatial_scale, aligned, samples):
+    """Per-roi sample coordinates: ys [R, oh*samples], xs [R, ow*samples]."""
+    bn = _data(boxes_num).astype(jnp.int32)
+    batch_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn, total_repeat_length=n_rois)
+    off = 0.5 if aligned else 0.0
+    x1 = bd[:, 0] * spatial_scale - off
+    y1 = bd[:, 1] * spatial_scale - off
+    x2 = bd[:, 2] * spatial_scale - off
+    y2 = bd[:, 3] * spatial_scale - off
+    rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+    rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+    # `samples` sub-points per bin along each axis, at (j+0.5)/samples of the bin
+    sub = (jnp.arange(samples) + 0.5) / samples
+    grid_y = (jnp.arange(oh)[:, None] + sub[None, :]).reshape(-1)  # [oh*samples]
+    grid_x = (jnp.arange(ow)[:, None] + sub[None, :]).reshape(-1)
+    ys = y1[:, None] + grid_y[None, :] * (rh[:, None] / oh)
+    xs = x1[:, None] + grid_x[None, :] * (rw[:, None] / ow)
+    return batch_idx, ys, xs
+
+
+def _bilinear_sample(img, yy, xx, H, W):
+    """img [C,H,W]; yy [Ny], xx [Nx] -> [C,Ny,Nx]."""
+    y0 = jnp.clip(jnp.floor(yy), 0, H - 1).astype(jnp.int32)
+    x0 = jnp.clip(jnp.floor(xx), 0, W - 1).astype(jnp.int32)
+    y1i = jnp.clip(y0 + 1, 0, H - 1)
+    x1i = jnp.clip(x0 + 1, 0, W - 1)
+    wy = jnp.clip(yy - y0, 0, 1)[None, :, None]
+    wx = jnp.clip(xx - x0, 0, 1)[None, None, :]
+    v00 = img[:, y0][:, :, x0]
+    v01 = img[:, y0][:, :, x1i]
+    v10 = img[:, y1i][:, :, x0]
+    v11 = img[:, y1i][:, :, x1i]
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+            v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """reference ops.py:1080 — average of sampling_ratio^2 bilinear samples
+    per bin (2x2 when sampling_ratio is adaptive/-1, like the reference's
+    default for typical bin sizes)."""
+    import jax
+    xd = _data(x)
+    bd = _data(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    n_rois = bd.shape[0]
+    C, H, W = xd.shape[1:]
+    samples = sampling_ratio if sampling_ratio > 0 else 2
+    batch_idx, ys, xs = _roi_grid(bd, boxes_num, n_rois, oh, ow, spatial_scale,
+                                  aligned, samples)
+    out = jax.vmap(lambda bi, yy, xx: _bilinear_sample(xd[bi], yy, xx, H, W))(
+        batch_idx, ys, xs)  # [R, C, oh*s, ow*s]
+    out = out.reshape(n_rois, C, oh, samples, ow, samples)
+    return Tensor(out.mean(axis=(3, 5)))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Max-pool RoI variant (reference ops.py:989): max over a dense sample
+    grid per bin (4x4 sub-samples approximates the reference's integer-pixel
+    max with static shapes)."""
+    import jax
+    xd = _data(x)
+    bd = _data(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    n_rois = bd.shape[0]
+    C, H, W = xd.shape[1:]
+    samples = 4
+    batch_idx, ys, xs = _roi_grid(bd, boxes_num, n_rois, oh, ow, spatial_scale,
+                                  aligned=False, samples=samples)
+    # nearest-pixel max, as the reference pools over integer pixel coords
+    ys = jnp.clip(jnp.round(ys), 0, H - 1).astype(jnp.int32)
+    xs = jnp.clip(jnp.round(xs), 0, W - 1).astype(jnp.int32)
+    out = jax.vmap(lambda bi, yy, xx: xd[bi][:, yy][:, :, xx])(
+        batch_idx, ys, xs)  # [R, C, oh*s, ow*s]
+    out = out.reshape(n_rois, C, oh, samples, ow, samples)
+    return Tensor(out.max(axis=(3, 5)))
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True):
+    """reference detection box_coder (encode/decode center-size)."""
+    pb, tb = _data(prior_box), _data(target_box)
+    pbv = _data(prior_box_var) if prior_box_var is not None else None
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw / 2
+        tcy = tb[:, 1] + th / 2
+        out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
+        if pbv is not None:
+            out = out / pbv
+    else:  # decode
+        d = tb
+        if pbv is not None:
+            d = d * pbv
+        cx = d[..., 0] * pw + pcx
+        cy = d[..., 1] * ph + pcy
+        w = jnp.exp(d[..., 2]) * pw
+        h = jnp.exp(d[..., 3]) * ph
+        out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2 - norm,
+                         cy + h / 2 - norm], axis=-1)
+    return Tensor(out)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, scale_x_y=1.0):
+    """reference ops.py:373 — decode YOLO head to boxes+scores."""
+    xd = _data(x)
+    n, _, h, w = xd.shape
+    na = len(anchors) // 2
+    anc = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    xd = xd.reshape(n, na, 5 + class_num, h, w)
+    gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    sig = jax_sigmoid = lambda v: 1 / (1 + jnp.exp(-v))
+    bx = (sig(xd[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1) + gx) / w
+    by = (sig(xd[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1) + gy) / h
+    bw = jnp.exp(xd[:, :, 2]) * anc[None, :, 0, None, None] / (w * downsample_ratio)
+    bh = jnp.exp(xd[:, :, 3]) * anc[None, :, 1, None, None] / (h * downsample_ratio)
+    conf = sig(xd[:, :, 4])
+    probs = sig(xd[:, :, 5:]) * conf[:, :, None]
+    img_h = _data(img_size)[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = _data(img_size)[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0)
+        y1 = jnp.clip(y1, 0)
+        x2 = jnp.minimum(x2, img_w - 1)
+        y2 = jnp.minimum(y2, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    mask = (conf > conf_thresh).reshape(n, -1)
+    boxes = boxes * mask[..., None]
+    scores = scores * mask[..., None]
+    return Tensor(boxes), Tensor(scores)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None):
+    """reference ops.py:701 — assign RoIs to FPN levels by scale."""
+    import numpy as np
+    rois = np.asarray(_data(fpn_rois))
+    scale = np.sqrt(np.maximum(
+        (rois[:, 2] - rois[:, 0]) * (rois[:, 3] - rois[:, 1]), 0))
+    level = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    level = np.clip(level, min_level, max_level).astype(np.int64)
+    outs, restore = [], np.empty(len(rois), np.int64)
+    pos = 0
+    nums = []
+    for lv in range(min_level, max_level + 1):
+        idx = np.nonzero(level == lv)[0]
+        outs.append(Tensor(jnp.asarray(rois[idx])))
+        # restore_index[orig_idx] = position in the concatenated output, as in
+        # the reference kernel (distribute_fpn_proposals_kernel.cc:110-117)
+        restore[idx] = np.arange(pos, pos + len(idx))
+        pos += len(idx)
+        nums.append(Tensor(jnp.asarray([len(idx)], jnp.int32)))
+    return outs, Tensor(jnp.asarray(restore, jnp.int32)), nums

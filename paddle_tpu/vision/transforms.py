@@ -1,0 +1,358 @@
+"""Image transforms (reference: python/paddle/vision/transforms/transforms.py).
+
+Operate on numpy HWC uint8/float arrays (or Tensors) on the host; device work
+happens after batching via DataLoader. TPU-first: keep per-sample work in
+numpy on host CPU, feed the device large batched arrays.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+__all__ = ["Compose", "BaseTransform", "ToTensor", "Resize", "RandomResizedCrop",
+           "CenterCrop", "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Normalize", "Transpose", "Pad", "RandomRotation", "Grayscale",
+           "BrightnessTransform", "ContrastTransform", "SaturationTransform",
+           "HueTransform", "ColorJitter", "RandomErasing"]
+
+
+def _to_hwc_array(img):
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def _resize_np(img, size, interpolation="bilinear"):
+    """Pure-numpy bilinear/nearest resize (no PIL/cv2 dependency)."""
+    img = _to_hwc_array(img)
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    if (oh, ow) == (h, w):
+        return img
+    if interpolation == "nearest":
+        ys = (np.arange(oh) * h / oh).astype(np.int64).clip(0, h - 1)
+        xs = (np.arange(ow) * w / ow).astype(np.int64).clip(0, w - 1)
+        return img[ys][:, xs]
+    ys = (np.arange(oh) + 0.5) * h / oh - 0.5
+    xs = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.floor(ys).clip(0, h - 1).astype(np.int64)
+    x0 = np.floor(xs).clip(0, w - 1).astype(np.int64)
+    y1 = (y0 + 1).clip(0, h - 1)
+    x1 = (x0 + 1).clip(0, w - 1)
+    wy = (ys - y0).clip(0, 1)[:, None, None]
+    wx = (xs - x0).clip(0, 1)[None, :, None]
+    f = img.astype(np.float64)
+    out = (f[y0][:, x0] * (1 - wy) * (1 - wx) + f[y0][:, x1] * (1 - wy) * wx +
+           f[y1][:, x0] * wy * (1 - wx) + f[y1][:, x1] * wy * wx)
+    return out.astype(img.dtype) if img.dtype != np.uint8 else \
+        np.round(out).clip(0, 255).astype(np.uint8)
+
+
+class BaseTransform:
+    """reference transforms.py:139 BaseTransform."""
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        src = _to_hwc_array(img)
+        arr = src.astype(np.float32)
+        if src.dtype == np.uint8:
+            arr = arr / 255.0
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return arr
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size, self.interpolation = size, interpolation
+
+    def _apply_image(self, img):
+        return _resize_np(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+
+    def _apply_image(self, img):
+        img = _to_hwc_array(img)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i, j = max(0, (h - th) // 2), max(0, (w - tw) // 2)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False):
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+        self.padding, self.pad_if_needed = padding, pad_if_needed
+
+    def _apply_image(self, img):
+        img = _to_hwc_array(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) \
+                else [self.padding] * 4
+            img = np.pad(img, ((p[1], p[3]), (p[0], p[2]), (0, 0)))
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            img = np.pad(img, ((0, max(0, th - h)), (0, max(0, tw - w)), (0, 0)))
+            h, w = img.shape[:2]
+        i = random.randint(0, h - th)
+        j = random.randint(0, w - tw)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+        self.scale, self.ratio, self.interpolation = scale, ratio, interpolation
+
+    def _apply_image(self, img):
+        img = _to_hwc_array(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = random.randint(0, h - ch)
+                j = random.randint(0, w - cw)
+                return _resize_np(img[i:i + ch, j:j + cw], self.size,
+                                  self.interpolation)
+        return _resize_np(CenterCrop(min(h, w))._apply_image(img), self.size,
+                          self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return _to_hwc_array(img)[:, ::-1].copy()
+        return _to_hwc_array(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return _to_hwc_array(img)[::-1].copy()
+        return _to_hwc_array(img)
+
+
+class Normalize(BaseTransform):
+    """(x - mean) / std; accepts CHW or HWC via data_format."""
+
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        return (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        return _to_hwc_array(img).transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 4
+        self.fill, self.padding_mode = fill, padding_mode
+
+    def _apply_image(self, img):
+        img = _to_hwc_array(img)
+        p = self.padding
+        if len(p) == 2:
+            p = [p[0], p[1], p[0], p[1]]
+        mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+                "symmetric": "symmetric"}[self.padding_mode]
+        kw = {"constant_values": self.fill} if mode == "constant" else {}
+        return np.pad(img, ((p[1], p[3]), (p[0], p[2]), (0, 0)), mode, **kw)
+
+
+class RandomRotation(BaseTransform):
+    """Rotation via inverse nearest remap (scipy/PIL-free)."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees, self.expand = degrees, expand
+
+    def _apply_image(self, img):
+        img = _to_hwc_array(img)
+        angle = np.deg2rad(random.uniform(*self.degrees))
+        h, w = img.shape[:2]
+        c, s = np.cos(angle), np.sin(angle)
+        if self.expand:
+            oh = int(np.ceil(abs(h * c) + abs(w * s)))
+            ow = int(np.ceil(abs(w * c) + abs(h * s)))
+        else:
+            oh, ow = h, w
+        cy, cx = (h - 1) / 2, (w - 1) / 2          # source center
+        ocy, ocx = (oh - 1) / 2, (ow - 1) / 2      # output center
+        ys, xs = np.mgrid[0:oh, 0:ow]
+        sy = (c * (ys - ocy) + s * (xs - ocx) + cy).round().astype(np.int64)
+        sx = (-s * (ys - ocy) + c * (xs - ocx) + cx).round().astype(np.int64)
+        valid = (sy >= 0) & (sy < h) & (sx >= 0) & (sx < w)
+        out = np.zeros((oh, ow, img.shape[2]), img.dtype)
+        out[valid] = img[sy[valid], sx[valid]]
+        return out
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        img = _to_hwc_array(img)
+        gray = (img[..., :3].astype(np.float32)
+                @ np.array([0.299, 0.587, 0.114], np.float32))
+        gray = gray.astype(img.dtype)[..., None]
+        return np.repeat(gray, self.num_output_channels, axis=-1)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _to_hwc_array(img)
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        out = img.astype(np.float32) * factor
+        return out.clip(0, 255).astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _to_hwc_array(img)
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = img.astype(np.float32).mean()
+        out = (img.astype(np.float32) - mean) * factor + mean
+        return out.clip(0, 255).astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _to_hwc_array(img)
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = Grayscale(img.shape[-1])._apply_image(img).astype(np.float32)
+        out = img.astype(np.float32) * factor + gray * (1 - factor)
+        return out.clip(0, 255).astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+class HueTransform(BaseTransform):
+    """Hue rotation by a random angle in [-value, value] (value in [0, 0.5],
+    fraction of a full hue circle), via the YIQ-space rotation matrix."""
+
+    def __init__(self, value):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _to_hwc_array(img)
+        theta = random.uniform(-self.value, self.value) * 2 * np.pi
+        c, s = np.cos(theta), np.sin(theta)
+        to_yiq = np.array([[0.299, 0.587, 0.114],
+                           [0.596, -0.274, -0.321],
+                           [0.211, -0.523, 0.311]], np.float32)
+        rot = np.array([[1, 0, 0], [0, c, -s], [0, s, c]], np.float32)
+        m = np.linalg.inv(to_yiq) @ rot @ to_yiq
+        out = img[..., :3].astype(np.float32) @ m.T
+        if img.shape[-1] > 3:
+            out = np.concatenate([out, img[..., 3:].astype(np.float32)], -1)
+        return out.clip(0, 255).astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+        if saturation:
+            self.transforms.append(SaturationTransform(saturation))
+        if hue:
+            self.transforms.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        ts = list(self.transforms)
+        random.shuffle(ts)
+        for t in ts:
+            img = t(img)
+        return img
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3), value=0):
+        self.prob, self.scale, self.ratio, self.value = prob, scale, ratio, value
+
+    def _apply_image(self, img):
+        img = _to_hwc_array(img).copy()
+        if random.random() >= self.prob:
+            return img
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            eh, ew = int(round(np.sqrt(target / ar))), int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i, j = random.randint(0, h - eh), random.randint(0, w - ew)
+                img[i:i + eh, j:j + ew] = self.value
+                break
+        return img

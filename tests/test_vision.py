@@ -1,0 +1,207 @@
+"""Vision kit tests: model zoo forward shapes, transforms, ops, datasets."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models, transforms, ops
+from paddle_tpu.vision.datasets import FakeData
+
+
+SMALL = [  # builder, input size, n_classes
+    (lambda: models.resnet18(num_classes=10), 64),
+    (lambda: models.resnet50(num_classes=10), 64),
+    (lambda: models.mobilenet_v2(num_classes=10), 64),
+    (lambda: models.mobilenet_v3_small(num_classes=10), 64),
+    (lambda: models.shufflenet_v2_x0_25(num_classes=10), 64),
+    (lambda: models.squeezenet1_1(num_classes=10), 64),
+]
+
+
+@pytest.mark.parametrize("builder,size", SMALL)
+def test_model_forward_shape(builder, size):
+    paddle.seed(0)
+    m = builder()
+    m.eval()
+    x = paddle.randn([2, 3, size, size])
+    y = m(x)
+    assert tuple(y.shape) == (2, 10)
+    assert np.isfinite(np.asarray(y._data)).all()
+
+
+def test_lenet_mnist_shape():
+    m = models.LeNet()
+    m.eval()
+    y = m(paddle.randn([2, 1, 28, 28]))
+    assert tuple(y.shape) == (2, 10)
+
+
+def test_more_zoo_constructs():
+    # constructors only (forward is expensive on CPU for the big ones)
+    models.vgg11(num_classes=7)
+    models.densenet121(num_classes=7)
+    models.googlenet(num_classes=7)
+    models.inception_v3(num_classes=7)
+    models.resnext50_32x4d(num_classes=7)
+    models.wide_resnet50_2(num_classes=7)
+    models.alexnet(num_classes=7)
+    models.mobilenet_v1(num_classes=7)
+
+
+def test_vgg_forward():
+    m = models.vgg11(num_classes=5)
+    m.eval()
+    y = m(paddle.randn([1, 3, 224, 224]))
+    assert tuple(y.shape) == (1, 5)
+
+
+def test_train_step_resnet18():
+    paddle.seed(0)
+    m = models.resnet18(num_classes=4)
+    m.train()
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    x = paddle.randn([2, 3, 32, 32])
+    label = paddle.to_tensor(np.array([1, 3]))
+    loss = paddle.nn.CrossEntropyLoss()(m(x), label)
+    loss.backward()
+    opt.step()
+    assert np.isfinite(float(loss))
+
+
+class TestTransforms:
+    def test_compose_pipeline(self):
+        t = transforms.Compose([
+            transforms.Resize(40),
+            transforms.CenterCrop(32),
+            transforms.RandomHorizontalFlip(1.0),
+            transforms.ToTensor(),
+            transforms.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5]),
+        ])
+        img = (np.random.rand(50, 60, 3) * 255).astype(np.uint8)
+        out = t(img)
+        assert out.shape == (3, 32, 32)
+        assert out.dtype == np.float32
+
+    def test_resize_aspect(self):
+        img = np.zeros((100, 200, 3), np.uint8)
+        out = transforms.Resize(50)(img)
+        assert out.shape == (50, 100, 3)
+
+    def test_resize_bilinear_values(self):
+        img = np.array([[0.0, 10.0], [20.0, 30.0]], np.float32)[:, :, None]
+        out = transforms.Resize((4, 4))(img)
+        assert out.shape == (4, 4, 1)
+        assert out.min() >= 0 and out.max() <= 30
+
+    def test_random_resized_crop(self):
+        img = (np.random.rand(64, 64, 3) * 255).astype(np.uint8)
+        out = transforms.RandomResizedCrop(32)(img)
+        assert out.shape == (32, 32, 3)
+
+    def test_color_and_erase(self):
+        img = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+        out = transforms.ColorJitter(0.4, 0.4, 0.4, 0.2)(img)
+        assert out.shape == (32, 32, 3)
+        out = transforms.RandomErasing(prob=1.0)(img)
+        assert out.shape == (32, 32, 3)
+        out = transforms.Grayscale(3)(img)
+        assert out.shape == (32, 32, 3)
+        out = transforms.Pad(2)(img)
+        assert out.shape == (36, 36, 3)
+        out = transforms.RandomRotation(30)(img)
+        assert out.shape == (32, 32, 3)
+
+    def test_hue_rotates_colors(self):
+        img = np.zeros((4, 4, 3), np.float32)
+        img[..., 0] = 200.0  # pure red
+        t = transforms.HueTransform(0.5)
+        t_val = t._apply_image(img)
+        # some rotation must move energy out of the red channel
+        moved = any(np.abs(t._apply_image(img)[..., 1:]).sum() > 1
+                    for _ in range(8))
+        assert moved
+
+    def test_rotation_expand(self):
+        img = (np.random.rand(20, 40, 3) * 255).astype(np.uint8)
+        out = transforms.RandomRotation((90, 90), expand=True)(img)
+        assert out.shape[0] >= 39 and out.shape[1] >= 19
+
+
+class TestOps:
+    def test_box_iou_identity(self):
+        boxes = paddle.to_tensor(np.array([[0, 0, 10, 10], [5, 5, 15, 15]],
+                                          np.float32))
+        iou = ops.box_iou(boxes, boxes)
+        np.testing.assert_allclose(np.diag(np.asarray(iou._data)), 1.0, atol=1e-6)
+
+    def test_nms_suppresses(self):
+        boxes = paddle.to_tensor(np.array(
+            [[0, 0, 10, 10], [1, 1, 11, 11], [100, 100, 110, 110]], np.float32))
+        scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+        keep = ops.nms(boxes, 0.5, scores=scores)
+        kept = np.asarray(keep._data)
+        assert 0 in kept and 2 in kept and 1 not in kept
+
+    def test_roi_align_shape(self):
+        x = paddle.randn([2, 4, 16, 16])
+        boxes = paddle.to_tensor(np.array([[0, 0, 8, 8], [4, 4, 12, 12],
+                                           [0, 0, 16, 16]], np.float32))
+        bn = paddle.to_tensor(np.array([2, 1], np.int32))
+        out = ops.roi_align(x, boxes, bn, 4)
+        assert tuple(out.shape) == (3, 4, 4, 4)
+
+    def test_box_coder_roundtrip(self):
+        prior = np.array([[0, 0, 10, 10], [10, 10, 30, 30]], np.float32)
+        target = np.array([[1, 1, 9, 9], [12, 8, 28, 32]], np.float32)
+        enc = ops.box_coder(paddle.to_tensor(prior), None,
+                            paddle.to_tensor(target))
+        dec = ops.box_coder(paddle.to_tensor(prior), None, enc,
+                            code_type="decode_center_size")
+        np.testing.assert_allclose(np.asarray(dec._data), target, atol=1e-4)
+
+    def test_roi_pool_takes_max(self):
+        x = paddle.zeros([1, 1, 8, 8])
+        xd = np.zeros((1, 1, 8, 8), np.float32)
+        xd[0, 0, 2, 2] = 100.0
+        x = paddle.to_tensor(xd)
+        boxes = paddle.to_tensor(np.array([[0, 0, 8, 8]], np.float32))
+        bn = paddle.to_tensor(np.array([1], np.int32))
+        out = ops.roi_pool(x, boxes, bn, 2)
+        assert np.asarray(out._data).max() == 100.0
+
+    def test_roi_align_sampling_ratio(self):
+        x = paddle.randn([1, 2, 8, 8])
+        boxes = paddle.to_tensor(np.array([[0, 0, 8, 8]], np.float32))
+        bn = paddle.to_tensor(np.array([1], np.int32))
+        o1 = ops.roi_align(x, boxes, bn, 4, sampling_ratio=1)
+        o4 = ops.roi_align(x, boxes, bn, 4, sampling_ratio=4)
+        assert o1.shape == o4.shape == [1, 2, 4, 4]
+        assert not np.allclose(np.asarray(o1._data), np.asarray(o4._data))
+
+    def test_distribute_fpn_restore_index(self):
+        rois = np.array([[0, 0, 300, 300], [0, 0, 10, 10], [0, 0, 60, 60]],
+                        np.float32)
+        outs, restore, nums = ops.distribute_fpn_proposals(
+            paddle.to_tensor(rois), 2, 5, 4, 224)
+        concat = np.concatenate([np.asarray(o._data).reshape(-1, 4)
+                                 for o in outs if o._data.size])
+        r = np.asarray(restore._data)
+        # restore[orig] = concat position: gathering concat rows by inverse
+        # permutation restores original order
+        np.testing.assert_allclose(concat[r], rois)
+
+    def test_yolo_box_shapes(self):
+        x = paddle.randn([2, 3 * 7, 4, 4])  # 3 anchors, 2 classes: 3*(5+2)=21
+        img_size = paddle.to_tensor(np.array([[416, 416], [416, 416]], np.int32))
+        boxes, scores = ops.yolo_box(x, img_size, [10, 13, 16, 30, 33, 23], 2,
+                                     0.01, 32)
+        assert tuple(boxes.shape) == (2, 48, 4)
+        assert tuple(scores.shape) == (2, 48, 2)
+
+
+def test_fake_data_dataloader():
+    ds = FakeData(size=8, image_shape=(3, 8, 8), num_classes=3)
+    loader = paddle.io.DataLoader(ds, batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 2
+    imgs, labels = batches[0]
+    assert tuple(imgs.shape) == (4, 3, 8, 8)
