@@ -1,0 +1,29 @@
+"""paddle.distribution analog (reference: python/paddle/distribution/).
+
+Distributions are thin stateless wrappers over jax.scipy/jax.random:
+sample() draws with the global splittable key (explicit-key overloads for
+jitted code), log_prob/entropy are pure jnp — fully traceable under jit.
+"""
+from .distribution import Distribution
+from .normal import Normal, LogNormal
+from .uniform import Uniform
+from .categorical import Categorical, Multinomial, Bernoulli
+from .beta import Beta, Dirichlet, Gamma
+from .exponential import Exponential, Laplace, Gumbel, ExponentialFamily
+from .transformed import TransformedDistribution
+from . import transform
+from .transform import (AbsTransform, AffineTransform, ChainTransform,
+                        ExpTransform, PowerTransform, SigmoidTransform,
+                        SoftmaxTransform, StickBreakingTransform, TanhTransform,
+                        Transform)
+from .kl import kl_divergence, register_kl
+
+__all__ = [
+    "Distribution", "Normal", "LogNormal", "Uniform", "Categorical",
+    "Multinomial", "Bernoulli", "Beta", "Dirichlet", "Gamma", "Exponential",
+    "Laplace", "Gumbel", "ExponentialFamily", "TransformedDistribution",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "PowerTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StickBreakingTransform", "TanhTransform", "kl_divergence", "register_kl",
+    "transform",
+]

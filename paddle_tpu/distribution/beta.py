@@ -1,0 +1,152 @@
+"""Beta / Dirichlet / Gamma (reference:
+python/paddle/distribution/{beta,dirichlet,gamma}.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from .distribution import Distribution, _as_param, _data, _op
+
+_lgamma = jax.scipy.special.gammaln
+_digamma = jax.scipy.special.digamma
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _as_param(concentration)
+        self.rate = _as_param(rate)
+        shape = jnp.broadcast_shapes(jnp.shape(_data(self.concentration)),
+                                     jnp.shape(_data(self.rate)))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        shp = self._batch_shape
+        return _op("gamma_mean", lambda a, b: jnp.broadcast_to(a / b, shp),
+                   self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        shp = self._batch_shape
+        return _op("gamma_var", lambda a, b: jnp.broadcast_to(a / b ** 2, shp),
+                   self.concentration, self.rate)
+
+    def rsample(self, shape=()):
+        # jax.random.gamma is differentiable w.r.t. concentration (implicit
+        # reparameterisation); route through the tape.
+        key = _random.split_key()
+        shp = self._extend_shape(shape)
+        return _op("gamma_rsample",
+                   lambda a, b: jax.random.gamma(key, a, shp) / b,
+                   self.concentration, self.rate)
+
+    def log_prob(self, value):
+        return _op("gamma_log_prob",
+                   lambda a, b, v: a * jnp.log(b) + (a - 1) * jnp.log(v)
+                   - b * v - _lgamma(a),
+                   self.concentration, self.rate, value)
+
+    def entropy(self):
+        shp = self._batch_shape
+        return _op("gamma_entropy",
+                   lambda a, b: jnp.broadcast_to(
+                       a - jnp.log(b) + _lgamma(a) + (1 - a) * _digamma(a), shp),
+                   self.concentration, self.rate)
+
+
+class Beta(Distribution):
+    """reference beta.py:21 — built on two Gammas."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _as_param(alpha)
+        self.beta = _as_param(beta)
+        shape = jnp.broadcast_shapes(jnp.shape(_data(self.alpha)),
+                                     jnp.shape(_data(self.beta)))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        shp = self._batch_shape
+        return _op("beta_mean", lambda a, b: jnp.broadcast_to(a / (a + b), shp),
+                   self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        shp = self._batch_shape
+        return _op("beta_var",
+                   lambda a, b: jnp.broadcast_to(
+                       a * b / ((a + b) ** 2 * (a + b + 1)), shp),
+                   self.alpha, self.beta)
+
+    def rsample(self, shape=()):
+        k1, k2 = jax.random.split(_random.split_key())
+        shp = self._extend_shape(shape)
+
+        def draw(a, b):
+            ga = jax.random.gamma(k1, a, shp)
+            gb = jax.random.gamma(k2, b, shp)
+            return ga / (ga + gb)
+
+        return _op("beta_rsample", draw, self.alpha, self.beta)
+
+    def log_prob(self, value):
+        return _op("beta_log_prob",
+                   lambda a, b, v: (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                   - (_lgamma(a) + _lgamma(b) - _lgamma(a + b)),
+                   self.alpha, self.beta, value)
+
+    def entropy(self):
+        shp = self._batch_shape
+
+        def ent(a, b):
+            lbeta = _lgamma(a) + _lgamma(b) - _lgamma(a + b)
+            return jnp.broadcast_to(
+                lbeta - (a - 1) * _digamma(a) - (b - 1) * _digamma(b)
+                + (a + b - 2) * _digamma(a + b), shp)
+
+        return _op("beta_entropy", ent, self.alpha, self.beta)
+
+
+class Dirichlet(Distribution):
+    """reference dirichlet.py:20."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _as_param(concentration)
+        shape = jnp.shape(_data(self.concentration))
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def mean(self):
+        return _op("dirichlet_mean",
+                   lambda a: a / a.sum(-1, keepdims=True), self.concentration)
+
+    @property
+    def variance(self):
+        def var(a):
+            a0 = a.sum(-1, keepdims=True)
+            m = a / a0
+            return m * (1 - m) / (a0 + 1)
+        return _op("dirichlet_var", var, self.concentration)
+
+    def rsample(self, shape=()):
+        key = _random.split_key()
+        shp = tuple(shape) + self._batch_shape
+        return _op("dirichlet_rsample",
+                   lambda a: jax.random.dirichlet(key, a, shp),
+                   self.concentration)
+
+    def log_prob(self, value):
+        return _op("dirichlet_log_prob",
+                   lambda a, v: ((a - 1) * jnp.log(v)).sum(-1)
+                   - (_lgamma(a).sum(-1) - _lgamma(a.sum(-1))),
+                   self.concentration, value)
+
+    def entropy(self):
+        def ent(a):
+            a0 = a.sum(-1)
+            k = a.shape[-1]
+            lnorm = _lgamma(a).sum(-1) - _lgamma(a0)
+            return lnorm + (a0 - k) * _digamma(a0) \
+                - ((a - 1) * _digamma(a)).sum(-1)
+        return _op("dirichlet_entropy", ent, self.concentration)

@@ -1,0 +1,167 @@
+"""Categorical / Multinomial / Bernoulli (reference:
+python/paddle/distribution/{categorical,multinomial,bernoulli}.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from .distribution import Distribution, _as_param, _data, _op
+
+
+class Categorical(Distribution):
+    """reference categorical.py:31 — parameterised by unnormalised logits."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if (logits is None) == (probs is None):
+            raise ValueError("pass exactly one of logits/probs")
+        if probs is not None:
+            self.logits = _op("probs_to_logits",
+                              lambda p: jnp.log(p / p.sum(-1, keepdims=True)),
+                              _as_param(probs))
+        else:
+            self.logits = _op(
+                "normalize_logits",
+                lambda l: l - jax.scipy.special.logsumexp(l, -1, keepdims=True),
+                _as_param(logits))
+        super().__init__(batch_shape=jnp.shape(_data(self.logits))[:-1])
+        self.num_events = jnp.shape(_data(self.logits))[-1]
+
+    @property
+    def probs(self):
+        return _op("exp", jnp.exp, self.logits)
+
+    def sample(self, shape=()):
+        from ..core.tensor import Tensor
+        out = jax.random.categorical(_random.split_key(), _data(self.logits),
+                                     shape=tuple(shape) + self._batch_shape)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        idx = _data(value).astype(jnp.int32)
+        return _op("categorical_log_prob",
+                   lambda l: jnp.take_along_axis(l, idx[..., None],
+                                                 axis=-1).squeeze(-1),
+                   self.logits)
+
+    def entropy(self):
+        return _op("categorical_entropy",
+                   lambda l: -(jnp.exp(l) * l).sum(-1), self.logits)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+
+class Bernoulli(Distribution):
+    """reference bernoulli.py:40."""
+
+    def __init__(self, probs=None, logits=None, name=None):
+        if (logits is None) == (probs is None):
+            raise ValueError("pass exactly one of logits/probs")
+        if probs is not None:
+            self._p = _op("clip_probs",
+                          lambda p: jnp.clip(p, 1e-7, 1 - 1e-7),
+                          _as_param(probs))
+            self.logits = _op("probs_to_logits_binary",
+                              lambda p: jnp.log(p) - jnp.log1p(-p), self._p)
+        else:
+            self.logits = _as_param(logits)
+            # clip like the probs path: sigmoid saturates to exactly 0/1 in
+            # f32 for |logits| > ~17, which would make log1p(-p) = -inf
+            self._p = _op("sigmoid_clipped",
+                          lambda l: jnp.clip(jax.nn.sigmoid(l),
+                                             1e-7, 1 - 1e-7), self.logits)
+        super().__init__(batch_shape=jnp.shape(_data(self._p)))
+
+    @property
+    def probs(self):
+        return self._p
+
+    @property
+    def mean(self):
+        return self._p
+
+    @property
+    def variance(self):
+        return _op("bernoulli_var", lambda p: p * (1 - p), self._p)
+
+    def sample(self, shape=()):
+        from ..core.tensor import Tensor
+        out = jax.random.bernoulli(_random.split_key(), _data(self._p),
+                                   self._extend_shape(shape))
+        return Tensor(out.astype(jnp.float32))
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-sigmoid relaxation (reference bernoulli.py rsample)."""
+        u = jax.random.uniform(_random.split_key(), self._extend_shape(shape),
+                               minval=1e-7, maxval=1 - 1e-7)
+        logistic = jnp.log(u) - jnp.log1p(-u)
+        return _op("bernoulli_rsample",
+                   lambda l: jax.nn.sigmoid((l + logistic) / temperature),
+                   self.logits)
+
+    def log_prob(self, value):
+        return _op("bernoulli_log_prob",
+                   lambda p, v: v * jnp.log(p) + (1 - v) * jnp.log1p(-p),
+                   self._p, value)
+
+    def entropy(self):
+        return _op("bernoulli_entropy",
+                   lambda p: -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)),
+                   self._p)
+
+    def cdf(self, value):
+        return _op("bernoulli_cdf",
+                   lambda p, v: jnp.where(v < 0, 0.0,
+                                          jnp.where(v < 1, 1 - p, 1.0)),
+                   self._p, value)
+
+
+class Multinomial(Distribution):
+    """reference multinomial.py:25."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self._p = _op("normalize_probs",
+                      lambda p: p / p.sum(-1, keepdims=True), _as_param(probs))
+        shape = jnp.shape(_data(self._p))
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def probs(self):
+        return self._p
+
+    @property
+    def mean(self):
+        n = self.total_count
+        return _op("multinomial_mean", lambda p: n * p, self._p)
+
+    @property
+    def variance(self):
+        n = self.total_count
+        return _op("multinomial_var", lambda p: n * p * (1 - p), self._p)
+
+    def sample(self, shape=()):
+        from ..core.tensor import Tensor
+        logits = jnp.log(_data(self._p))
+        draws = jax.random.categorical(
+            _random.split_key(), logits,
+            shape=(self.total_count,) + tuple(shape) + self._batch_shape)
+        k = self._event_shape[0]
+        return Tensor(jax.nn.one_hot(draws, k).sum(0))
+
+    def log_prob(self, value):
+        n = self.total_count
+        return _op(
+            "multinomial_log_prob",
+            lambda p, v: jax.scipy.special.gammaln(n + 1.0)
+            - jax.scipy.special.gammaln(v + 1.0).sum(-1)
+            + (v * jnp.log(p)).sum(-1),
+            self._p, value)
+
+    def entropy(self):
+        # exact entropy has no closed form; use the categorical bound n*H(p)
+        n = self.total_count
+        return _op("multinomial_entropy",
+                   lambda p: -n * (p * jnp.log(p)).sum(-1), self._p)

@@ -1,0 +1,80 @@
+"""Distribution ABC (reference: python/paddle/distribution/distribution.py:47).
+
+Design: parameters are stored as passed (Tensor identity preserved) and every
+piece of math runs through `core.tensor.apply_op`, so log_prob/rsample/
+entropy/mean/variance are differentiable w.r.t. the parameters — the
+reference gets this for free from building on paddle ops; here the tape
+records one fused vjp node per method call (cheaper than op-by-op).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+
+
+def _data(x):
+    """Raw jnp array view (for shapes/static decisions only)."""
+    if isinstance(x, Tensor):
+        return x._data
+    return x if isinstance(x, jax.Array) else jnp.asarray(x, jnp.float32)
+
+
+def _as_param(x):
+    """Keep Tensors (differentiable); coerce the rest to jnp constants."""
+    if isinstance(x, Tensor):
+        return x
+    return x if isinstance(x, jax.Array) else jnp.asarray(x, jnp.float32)
+
+
+def _op(name, fn, *args):
+    """Differentiable math bridge: Tensors in args join the tape."""
+    return apply_op(name, fn, list(args))
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        """Non-reparameterised draw (no gradient)."""
+        from ..core import autograd
+        with autograd.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _op("prob", jnp.exp, self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape

@@ -1,0 +1,158 @@
+"""Exponential / Laplace / Gumbel + ExponentialFamily base (reference:
+python/paddle/distribution/{exponential,laplace,gumbel,exponential_family}.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from .distribution import Distribution, _as_param, _data, _op
+
+_EULER = 0.5772156649015329
+
+
+class ExponentialFamily(Distribution):
+    """Natural-parameter family base (reference exponential_family.py:24)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = _as_param(rate)
+        super().__init__(batch_shape=jnp.shape(_data(self.rate)))
+
+    @property
+    def mean(self):
+        return _op("exponential_mean", lambda r: 1.0 / r, self.rate)
+
+    @property
+    def variance(self):
+        return _op("exponential_var", lambda r: 1.0 / r ** 2, self.rate)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_random.split_key(), self._extend_shape(shape),
+                               minval=1e-7, maxval=1.0)
+        return _op("exponential_rsample", lambda r: -jnp.log(u) / r, self.rate)
+
+    def log_prob(self, value):
+        return _op("exponential_log_prob",
+                   lambda r, v: jnp.log(r) - r * v, self.rate, value)
+
+    def entropy(self):
+        return _op("exponential_entropy", lambda r: 1.0 - jnp.log(r), self.rate)
+
+    def cdf(self, value):
+        return _op("exponential_cdf",
+                   lambda r, v: 1 - jnp.exp(-r * v), self.rate, value)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_param(loc)
+        self.scale = _as_param(scale)
+        shape = jnp.broadcast_shapes(jnp.shape(_data(self.loc)),
+                                     jnp.shape(_data(self.scale)))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        shp = self._batch_shape
+        return _op("laplace_mean", lambda l: jnp.broadcast_to(l, shp), self.loc)
+
+    @property
+    def variance(self):
+        shp = self._batch_shape
+        return _op("laplace_var",
+                   lambda s: jnp.broadcast_to(2 * s ** 2, shp), self.scale)
+
+    @property
+    def stddev(self):
+        shp = self._batch_shape
+        return _op("laplace_std",
+                   lambda s: jnp.broadcast_to(math.sqrt(2) * s, shp), self.scale)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_random.split_key(), self._extend_shape(shape),
+                               minval=-0.5 + 1e-7, maxval=0.5)
+        return _op("laplace_rsample",
+                   lambda l, s: l - s * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u)),
+                   self.loc, self.scale)
+
+    def log_prob(self, value):
+        return _op("laplace_log_prob",
+                   lambda l, s, v: -jnp.abs(v - l) / s - jnp.log(2 * s),
+                   self.loc, self.scale, value)
+
+    def entropy(self):
+        shp = self._batch_shape
+        return _op("laplace_entropy",
+                   lambda s: jnp.broadcast_to(1 + jnp.log(2 * s), shp),
+                   self.scale)
+
+    def cdf(self, value):
+        def f(l, s, v):
+            z = (v - l) / s
+            return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+        return _op("laplace_cdf", f, self.loc, self.scale, value)
+
+    def icdf(self, value):
+        def f(l, s, p):
+            term = p - 0.5
+            return l - s * jnp.sign(term) * jnp.log1p(-2 * jnp.abs(term))
+        return _op("laplace_icdf", f, self.loc, self.scale, value)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_param(loc)
+        self.scale = _as_param(scale)
+        shape = jnp.broadcast_shapes(jnp.shape(_data(self.loc)),
+                                     jnp.shape(_data(self.scale)))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        shp = self._batch_shape
+        return _op("gumbel_mean",
+                   lambda l, s: jnp.broadcast_to(l + s * _EULER, shp),
+                   self.loc, self.scale)
+
+    @property
+    def variance(self):
+        shp = self._batch_shape
+        return _op("gumbel_var",
+                   lambda s: jnp.broadcast_to((math.pi ** 2 / 6) * s ** 2, shp),
+                   self.scale)
+
+    @property
+    def stddev(self):
+        return _op("sqrt", jnp.sqrt, self.variance)
+
+    def rsample(self, shape=()):
+        g = jax.random.gumbel(_random.split_key(), self._extend_shape(shape))
+        return _op("gumbel_rsample", lambda l, s: l + s * g, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return _op("gumbel_log_prob", f, self.loc, self.scale, value)
+
+    def entropy(self):
+        shp = self._batch_shape
+        return _op("gumbel_entropy",
+                   lambda s: jnp.broadcast_to(jnp.log(s) + 1 + _EULER, shp),
+                   self.scale)
+
+    def cdf(self, value):
+        return _op("gumbel_cdf",
+                   lambda l, s, v: jnp.exp(-jnp.exp(-(v - l) / s)),
+                   self.loc, self.scale, value)

@@ -1,0 +1,101 @@
+"""Normal / LogNormal (reference: python/paddle/distribution/normal.py:30)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from .distribution import Distribution, _as_param, _data, _op
+
+_LOG_2PI = math.log(2 * math.pi)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_param(loc)
+        self.scale = _as_param(scale)
+        shape = jnp.broadcast_shapes(jnp.shape(_data(self.loc)),
+                                     jnp.shape(_data(self.scale)))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        shp = self._batch_shape
+        return _op("normal_mean", lambda l: jnp.broadcast_to(l, shp), self.loc)
+
+    @property
+    def variance(self):
+        shp = self._batch_shape
+        return _op("normal_var", lambda s: jnp.broadcast_to(s ** 2, shp),
+                   self.scale)
+
+    @property
+    def stddev(self):
+        shp = self._batch_shape
+        return _op("normal_std", lambda s: jnp.broadcast_to(s, shp), self.scale)
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(_random.split_key(), self._extend_shape(shape),
+                                jnp.float32)
+        return _op("normal_rsample", lambda l, s: l + s * eps, self.loc,
+                   self.scale)
+
+    def log_prob(self, value):
+        return _op("normal_log_prob",
+                   lambda l, s, v: -((v - l) ** 2) / (2 * s ** 2) - jnp.log(s)
+                   - 0.5 * _LOG_2PI,
+                   self.loc, self.scale, value)
+
+    def entropy(self):
+        shp = self._batch_shape
+        return _op("normal_entropy",
+                   lambda s: jnp.broadcast_to(0.5 + 0.5 * _LOG_2PI + jnp.log(s),
+                                              shp), self.scale)
+
+    def cdf(self, value):
+        return _op("normal_cdf",
+                   lambda l, s, v: 0.5 * (1 + jax.scipy.special.erf(
+                       (v - l) / (s * math.sqrt(2)))),
+                   self.loc, self.scale, value)
+
+    def icdf(self, value):
+        return _op("normal_icdf",
+                   lambda l, s, v: l + s * math.sqrt(2)
+                   * jax.scipy.special.erfinv(2 * v - 1),
+                   self.loc, self.scale, value)
+
+
+class LogNormal(Distribution):
+    """reference lognormal.py:24 — exp-transform of Normal."""
+
+    def __init__(self, loc, scale, name=None):
+        self._base = Normal(loc, scale)
+        super().__init__(batch_shape=self._base.batch_shape)
+        self.loc, self.scale = self._base.loc, self._base.scale
+
+    @property
+    def mean(self):
+        return _op("lognormal_mean", lambda l, s: jnp.exp(l + s ** 2 / 2),
+                   self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return _op("lognormal_var",
+                   lambda l, s: (jnp.exp(s ** 2) - 1) * jnp.exp(2 * l + s ** 2),
+                   self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        return _op("exp", jnp.exp, self._base.rsample(shape))
+
+    def log_prob(self, value):
+        return _op("lognormal_log_prob",
+                   lambda l, s, v: -((jnp.log(v) - l) ** 2) / (2 * s ** 2)
+                   - jnp.log(s) - 0.5 * _LOG_2PI - jnp.log(v),
+                   self.loc, self.scale, value)
+
+    def entropy(self):
+        return _op("lognormal_entropy",
+                   lambda l, s: 0.5 + 0.5 * _LOG_2PI + jnp.log(s) + l,
+                   self.loc, self.scale)

@@ -1,0 +1,236 @@
+"""Bijective transforms (reference: python/paddle/distribution/transform.py).
+
+Subclasses implement raw-jnp `_forward/_inverse/_forward_log_det_jacobian`;
+the public wrappers route through the autograd tape (differentiable w.r.t.
+the input value; transform parameters passed as Tensors also join the tape).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import _as_param, _data, _op
+
+__all__ = ["Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+           "ExpTransform", "PowerTransform", "SigmoidTransform",
+           "SoftmaxTransform", "StickBreakingTransform", "TanhTransform"]
+
+
+class Transform:
+    """reference transform.py:60 Transform base."""
+
+    _codomain_event_rank = 0
+
+    def forward(self, x):
+        return _op(f"{type(self).__name__}.fwd", self._forward, x)
+
+    def inverse(self, y):
+        return _op(f"{type(self).__name__}.inv", self._inverse, y)
+
+    def forward_log_det_jacobian(self, x):
+        return _op(f"{type(self).__name__}.fldj",
+                   self._forward_log_det_jacobian, x)
+
+    def inverse_log_det_jacobian(self, y):
+        return _op(f"{type(self).__name__}.ildj",
+                   lambda yy: -self._forward_log_det_jacobian(self._inverse(yy)),
+                   y)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _as_param(loc)
+        self.scale = _as_param(scale)
+
+    # params join the tape in the public wrappers
+    def forward(self, x):
+        return _op("affine_fwd", lambda l, s, v: l + s * v,
+                   self.loc, self.scale, x)
+
+    def inverse(self, y):
+        return _op("affine_inv", lambda l, s, v: (v - l) / s,
+                   self.loc, self.scale, y)
+
+    def forward_log_det_jacobian(self, x):
+        return _op("affine_fldj",
+                   lambda s, v: jnp.broadcast_to(jnp.log(jnp.abs(s)),
+                                                 jnp.shape(v)),
+                   self.scale, x)
+
+    def _forward(self, x):
+        return _data(self.loc) + _data(self.scale) * x
+
+    def _inverse(self, y):
+        return (y - _data(self.loc)) / _data(self.scale)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(_data(self.scale))),
+                                jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _as_param(power)
+
+    def _forward(self, x):
+        return jnp.power(x, _data(self.power))
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / _data(self.power))
+
+    def _forward_log_det_jacobian(self, x):
+        p = _data(self.power)
+        return jnp.log(jnp.abs(p * jnp.power(x, p - 1)))
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """Not bijective on R^n; defined on the reference's convention."""
+
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    """reference transform.py StickBreakingTransform: R^{K-1} -> simplex^K."""
+
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zcum = jnp.cumprod(1 - z, axis=-1)
+        pad = jnp.ones_like(z[..., :1])
+        return jnp.concatenate([z, pad], -1) * jnp.concatenate([pad, zcum], -1)
+
+    def _inverse(self, y):
+        ycum = jnp.cumsum(y[..., :-1], axis=-1)
+        rem = 1 - jnp.concatenate([jnp.zeros_like(ycum[..., :1]),
+                                   ycum[..., :-1]], -1)
+        z = y[..., :-1] / rem
+        k = z.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        xo = x - offset
+        z = jax.nn.sigmoid(xo)
+        # triangular Jacobian: det = prod_i z_i(1-z_i) * prod_{j<i}(1-z_j);
+        # the cross term is the sum of all log1p(-z) prefix sums
+        detail = jnp.log(z) + jnp.log1p(-z)
+        if k > 1:
+            zcum = jnp.cumsum(jnp.log1p(-z[..., :-1]), axis=-1)
+            return detail.sum(-1) + zcum.sum(-1)
+        return detail.sum(-1)
+
+
+class ChainTransform(Transform):
+    """Composes via the child transforms' PUBLIC (tape-aware) methods so
+    parameters of member transforms (e.g. a trainable AffineTransform) keep
+    their gradients inside TransformedDistribution."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ldj = t.forward_log_det_jacobian(x)
+            total = ldj if total is None else total + ldj
+            x = t.forward(x)
+        return total
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._forward_log_det_jacobian(x)
+            x = t._forward(x)
+        return total

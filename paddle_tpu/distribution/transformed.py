@@ -1,0 +1,30 @@
+"""TransformedDistribution (reference:
+python/paddle/distribution/transformed_distribution.py:23)."""
+from __future__ import annotations
+
+from .distribution import Distribution
+from .transform import ChainTransform
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        self.transform = ChainTransform(list(transforms))
+        super().__init__(batch_shape=base.batch_shape,
+                         event_shape=base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self.transform.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self.transform.forward(x)
+
+    def log_prob(self, value):
+        # composed from tape-recorded pieces: differentiable w.r.t. value and
+        # the base distribution's parameters
+        x = self.transform.inverse(value)
+        base_lp = self.base.log_prob(x)
+        ldj = self.transform.forward_log_det_jacobian(x)
+        return base_lp - ldj

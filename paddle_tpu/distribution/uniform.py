@@ -1,0 +1,53 @@
+"""Uniform (reference: python/paddle/distribution/uniform.py:31)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from .distribution import Distribution, _as_param, _data, _op
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _as_param(low)
+        self.high = _as_param(high)
+        shape = jnp.broadcast_shapes(jnp.shape(_data(self.low)),
+                                     jnp.shape(_data(self.high)))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        shp = self._batch_shape
+        return _op("uniform_mean",
+                   lambda lo, hi: jnp.broadcast_to((lo + hi) / 2, shp),
+                   self.low, self.high)
+
+    @property
+    def variance(self):
+        shp = self._batch_shape
+        return _op("uniform_var",
+                   lambda lo, hi: jnp.broadcast_to((hi - lo) ** 2 / 12, shp),
+                   self.low, self.high)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_random.split_key(), self._extend_shape(shape))
+        return _op("uniform_rsample", lambda lo, hi: lo + (hi - lo) * u,
+                   self.low, self.high)
+
+    def log_prob(self, value):
+        return _op("uniform_log_prob",
+                   lambda lo, hi, v: jnp.where((v >= lo) & (v < hi),
+                                               -jnp.log(hi - lo), -jnp.inf),
+                   self.low, self.high, value)
+
+    def entropy(self):
+        shp = self._batch_shape
+        return _op("uniform_entropy",
+                   lambda lo, hi: jnp.broadcast_to(jnp.log(hi - lo), shp),
+                   self.low, self.high)
+
+    def cdf(self, value):
+        return _op("uniform_cdf",
+                   lambda lo, hi, v: jnp.clip((v - lo) / (hi - lo), 0, 1),
+                   self.low, self.high, value)

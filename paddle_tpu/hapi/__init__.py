@@ -1,0 +1,6 @@
+"""High-level training API (reference: python/paddle/hapi/)."""
+from .model import Model
+from .summary import summary
+from . import callbacks
+
+__all__ = ["Model", "summary", "callbacks"]

@@ -1,0 +1,290 @@
+"""Training callbacks (reference: python/paddle/hapi/callbacks.py)."""
+from __future__ import annotations
+
+import numbers
+import os
+import time
+
+import numpy as np
+
+__all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRScheduler", "ReduceLROnPlateau", "VisualDL",
+           "config_callbacks"]
+
+
+class Callback:
+    """reference callbacks.py:71."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+    def on_predict_begin(self, logs=None): ...
+    def on_predict_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    def on_eval_batch_begin(self, step, logs=None): ...
+    def on_eval_batch_end(self, step, logs=None): ...
+    def on_predict_batch_begin(self, step, logs=None): ...
+    def on_predict_batch_end(self, step, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks=None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cbk):
+        self.callbacks.append(cbk)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def on_begin(self, mode, logs=None):
+        self._call(f"on_{mode}_begin", logs)
+
+    def on_end(self, mode, logs=None):
+        self._call(f"on_{mode}_end", logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._call("on_epoch_begin", epoch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._call("on_epoch_end", epoch, logs)
+
+    def on_batch_begin(self, mode, step, logs=None):
+        self._call(f"on_{mode}_batch_begin", step, logs)
+
+    def on_batch_end(self, mode, step, logs=None):
+        self._call(f"on_{mode}_batch_end", step, logs)
+
+
+class ProgBarLogger(Callback):
+    """stdout progress logging (reference callbacks.py:278)."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+        self.epoch = 0
+        self._t0 = time.time()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self._t0 = time.time()
+
+    def _fmt(self, logs):
+        parts = []
+        for k, v in (logs or {}).items():
+            if k == "step":
+                continue
+            if isinstance(v, numbers.Number):
+                parts.append(f"{k}: {v:.4f}")
+            elif isinstance(v, (list, np.ndarray)):
+                parts.append(f"{k}: {np.asarray(v).round(4).tolist()}")
+        return " - ".join(parts)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose >= 2 and step % self.log_freq == 0:
+            print(f"epoch {self.epoch} step {step}: {self._fmt(logs)}", flush=True)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose >= 1:
+            dt = time.time() - self._t0
+            print(f"epoch {epoch} done in {dt:.1f}s: {self._fmt(logs)}", flush=True)
+
+    def on_eval_end(self, logs=None):
+        if self.verbose >= 1:
+            print(f"eval: {self._fmt(logs)}", flush=True)
+
+
+class ModelCheckpoint(Callback):
+    """periodic save (reference callbacks.py:531)."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    """reference callbacks.py:625."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True,
+                 save_dir=None):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.save_dir = save_dir
+        self.stopped_epoch = 0
+        if mode == "max" or (mode == "auto" and ("acc" in monitor
+                                                 or monitor.endswith("score"))):
+            self.monitor_op = np.greater
+        else:
+            self.monitor_op = np.less
+        self.best = None
+        self.wait = 0
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.best = self.baseline
+
+    def on_epoch_end(self, epoch, logs=None):
+        current = (logs or {}).get(self.monitor)
+        if current is None:
+            current = (logs or {}).get(f"eval_{self.monitor}")
+        if current is None:
+            return
+        current = float(np.asarray(current).reshape(-1)[0])
+        delta = self.min_delta if self.monitor_op == np.greater else -self.min_delta
+        if self.best is None or self.monitor_op(current - delta, self.best):
+            self.best = current
+            self.wait = 0
+            save_dir = self.save_dir or self.params.get("save_dir")
+            if self.save_best_model and save_dir:
+                self.model.save(os.path.join(save_dir, "best_model"))
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = epoch
+                self.model.stop_training = True
+                if self.verbose:
+                    print(f"early stopping at epoch {epoch}", flush=True)
+
+
+class LRScheduler(Callback):
+    """steps an optimizer.lr.LRScheduler (reference callbacks.py:445)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step and self._sched() is not None:
+            self._sched().step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch and self._sched() is not None:
+            self._sched().step()
+
+
+class ReduceLROnPlateau(Callback):
+    """reference callbacks.py:727."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.monitor_op = np.greater if mode == "max" or \
+            (mode == "auto" and "acc" in monitor) else np.less
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        current = (logs or {}).get(self.monitor)
+        if current is None:
+            return
+        current = float(np.asarray(current).reshape(-1)[0])
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        delta = self.min_delta if self.monitor_op == np.greater \
+            else -self.min_delta
+        if self.best is None or self.monitor_op(current - delta, self.best):
+            self.best = current
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = self.model._optimizer
+                old = opt.get_lr()
+                new = max(old * self.factor, self.min_lr)
+                if old - new > 1e-12:
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"reduce lr to {new:.2e}", flush=True)
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
+class VisualDL(Callback):
+    """scalar logging to a directory of .jsonl files (the reference logs to
+    VisualDL; that dependency isn't in this image, so logs stay greppable)."""
+
+    def __init__(self, log_dir="./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._step = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        import json
+        os.makedirs(self.log_dir, exist_ok=True)
+        rec = {k: float(np.asarray(v).reshape(-1)[0]) for k, v in (logs or {}).items()
+               if isinstance(v, numbers.Number)}
+        rec["step"] = self._step
+        self._step += 1
+        with open(os.path.join(self.log_dir, "train.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
+                     steps=None, log_freq=2, verbose=2, save_freq=1,
+                     save_dir=None, metrics=None, mode="train"):
+    """reference callbacks.py:35."""
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        cbks.append(LRScheduler())
+    cbk_list = CallbackList(cbks)
+    cbk_list.set_model(model)
+    cbk_list.set_params({"batch_size": batch_size, "epochs": epochs,
+                         "steps": steps, "verbose": verbose,
+                         "save_dir": save_dir, "metrics": metrics or []})
+    return cbk_list

@@ -1,0 +1,225 @@
+"""paddle.Model — fit/evaluate/predict facade (reference: hapi/model.py:1004
+Model.fit, :255 DynamicGraphAdapter).
+
+TPU-native single adapter: eager tape steps (the jit.TrainStep fusion path is
+available separately); no static/dygraph duality is needed because everything
+lowers through XLA anyway.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import autograd
+from .. import framework
+from ..io import DataLoader, Dataset
+from . import callbacks as cbks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _item(x):
+    return float(x) if np.ndim(x) == 0 else np.asarray(x)
+
+
+class Model:
+    """Wraps a Layer with train/eval/predict loops, checkpointing, callbacks.
+
+    Mirrors the reference surface: prepare(), fit(), evaluate(), predict(),
+    train_batch(), eval_batch(), predict_batch(), save(), load(), parameters(),
+    summary().
+    """
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    # -- configuration ---------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        return self
+
+    # -- per-batch steps -------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        outs = _to_list(outputs)
+        labs = _to_list(labels)
+        if callable(self._loss):
+            loss = self._loss(*outs, *labs)
+        else:
+            raise ValueError("loss not set; call prepare(loss=...)")
+        return loss
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        outputs = self.network(*_to_list(inputs))
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return ([_item(np.asarray(loss._data))], metrics) if metrics else \
+            [_item(np.asarray(loss._data))]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        with autograd.no_grad():
+            outputs = self.network(*_to_list(inputs))
+            loss = self._compute_loss(outputs, labels) if self._loss else None
+        metrics = self._update_metrics(outputs, labels)
+        out = [_item(np.asarray(loss._data))] if loss is not None else []
+        return (out, metrics) if metrics else out
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        with autograd.no_grad():
+            outputs = self.network(*_to_list(inputs))
+        return [np.asarray(o._data) for o in _to_list(outputs)]
+
+    def _update_metrics(self, outputs, labels):
+        outs = _to_list(outputs)
+        labs = _to_list(labels)
+        results = []
+        for metric in self._metrics:
+            state = metric.compute(*outs, *labs)
+            results.append(metric.update(*_to_list(state)))
+        return results
+
+    # -- loops -----------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, num_workers):
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers)
+        raise TypeError(f"expected Dataset or DataLoader, got {type(data)}")
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        """reference hapi/model.py:1004."""
+        train_loader = self._make_loader(train_data, batch_size, shuffle,
+                                         num_workers)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers) if eval_data is not None \
+            else None
+        cbk_list = cbks.config_callbacks(
+            callbacks, model=self, epochs=epochs, verbose=verbose,
+            log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
+            metrics=["loss"] + [m.name() for m in self._metrics])
+        cbk_list.on_begin("train")
+        self.stop_training = False
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbk_list.on_epoch_begin(epoch)
+            logs = self._run_one_epoch(train_loader, cbk_list, "train")
+            if eval_loader is not None and epoch % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          callbacks=callbacks)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            # epoch_end sees eval_* metrics so EarlyStopping/ReduceLROnPlateau
+            # can monitor validation
+            cbk_list.on_epoch_end(epoch, logs)
+            if save_dir and epoch % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+        if save_dir:
+            self.save(os.path.join(save_dir, "final"))
+        cbk_list.on_end("train")
+        return self
+
+    def _run_one_epoch(self, loader, cbk_list, mode):
+        for metric in self._metrics:
+            metric.reset()
+        logs = {}
+        for step, batch in enumerate(loader):
+            batch = _to_list(batch)
+            # convention: last element is the label(s)
+            ins, labs = (batch[:-1], batch[-1]) if len(batch) > 1 else (batch, None)
+            cbk_list.on_batch_begin(mode, step, logs)
+            if mode == "train":
+                result = self.train_batch(ins, labs)
+            else:
+                result = self.eval_batch(ins, labs)
+            if isinstance(result, tuple):
+                losses, _ = result
+            else:
+                losses = result
+            if losses:
+                logs["loss"] = losses[0]
+            for metric in self._metrics:
+                logs[metric.name()] = metric.accumulate()
+            logs["step"] = step
+            cbk_list.on_batch_end(mode, step, logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        cbk_list = cbks.config_callbacks(
+            callbacks, model=self, verbose=verbose, log_freq=log_freq,
+            metrics=["loss"] + [m.name() for m in self._metrics])
+        cbk_list.on_begin("eval")
+        logs = self._run_one_epoch(loader, cbk_list, "eval")
+        cbk_list.on_end("eval", logs)
+        return {k: v for k, v in logs.items() if k != "step"}
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            batch = _to_list(batch)
+            # like the reference, an (input, label) dataset is allowed for
+            # predict: keep the declared inputs, else drop a trailing label
+            if self._inputs is not None:
+                batch = batch[:len(_to_list(self._inputs))]
+            elif len(batch) > 1 and self._loss is not None:
+                batch = batch[:-1]
+            outputs.append(self.predict_batch(batch))
+        # transpose list-of-batches to per-output lists
+        outs = list(zip(*outputs)) if outputs else []
+        if stack_outputs:
+            outs = [np.concatenate(o) for o in outs]
+        else:
+            outs = [list(o) for o in outs]
+        return outs
+
+    # -- persistence -----------------------------------------------------
+    def save(self, path, training=True):
+        """reference hapi/model.py:1660 — `path + .pdparams/.pdopt`."""
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        framework.io.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            framework.io.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = framework.io.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(framework.io.load(opt_path))
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+        return summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,72 @@
+"""paddle.summary (reference: python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from ..nn.layer import Layer
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params', 'trainable_params'}.
+
+    reference model_summary.py:26 — we run a real forward with hooks-free
+    introspection (pre/post wrappers around each leaf layer's forward).
+    """
+    rows = []
+    handles = []
+
+    def wrap(layer, name):
+        orig = layer.forward
+
+        def wrapped(*a, **kw):
+            out = orig(*a, **kw)
+            n_params = sum(int(np.prod(p.shape)) for p in layer.parameters(
+                include_sublayers=False))
+            out_shape = list(out.shape) if hasattr(out, "shape") else "-"
+            rows.append((name, type(layer).__name__, out_shape, n_params))
+            return out
+
+        layer.forward = wrapped
+        handles.append((layer, orig))
+
+    for name, sub in net.named_sublayers():
+        if not list(sub.sublayers()):  # leaves only
+            wrap(sub, name)
+
+    try:
+        if input is not None:
+            x = input
+        else:
+            if input_size is None:
+                raise ValueError("summary needs input_size or input")
+            sizes = input_size if isinstance(input_size, list) else [input_size]
+            dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+                [dtypes] * len(sizes)
+            xs = [paddle.zeros(list(s), dtype=d or "float32")
+                  for s, d in zip(sizes, dts)]
+            x = xs if len(xs) > 1 else xs[0]
+        was_training = net.training
+        net.eval()
+        net(*x) if isinstance(x, list) else net(x)
+        if was_training:
+            net.train()
+    finally:
+        for layer, orig in handles:
+            layer.forward = orig
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+
+    w_name = max([len(r[0]) for r in rows] + [10])
+    w_type = max([len(r[1]) for r in rows] + [10])
+    print(f"{'Layer':<{w_name}}  {'Type':<{w_type}}  {'Output Shape':<20}  Params")
+    print("-" * (w_name + w_type + 36))
+    for name, tname, shape, n in rows:
+        print(f"{name:<{w_name}}  {tname:<{w_type}}  {str(shape):<20}  {n:,}")
+    print("-" * (w_name + w_type + 36))
+    print(f"Total params: {total:,}\nTrainable params: {trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,259 @@
+"""paddle.distribution tests: moments, log_prob vs scipy-free closed forms,
+sampling statistics, KL registry, transforms."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+class TestNormal:
+    def test_moments_logprob(self):
+        d = D.Normal(1.0, 2.0)
+        assert float(_np(d.mean)) == pytest.approx(1.0)
+        assert float(_np(d.variance)) == pytest.approx(4.0)
+        # N(1,2) logpdf at 1.0 = -log(2*sqrt(2pi))
+        assert float(_np(d.log_prob(1.0))) == pytest.approx(
+            -np.log(2 * np.sqrt(2 * np.pi)), rel=1e-5)
+        assert float(_np(d.entropy())) == pytest.approx(
+            0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0), rel=1e-5)
+
+    def test_sample_stats(self):
+        paddle.seed(0)
+        d = D.Normal(3.0, 0.5)
+        s = _np(d.sample((20000,)))
+        assert s.mean() == pytest.approx(3.0, abs=0.02)
+        assert s.std() == pytest.approx(0.5, abs=0.02)
+
+    def test_cdf_icdf_roundtrip(self):
+        d = D.Normal(0.0, 1.0)
+        x = np.linspace(-2, 2, 9, dtype=np.float32)
+        p = _np(d.cdf(paddle.to_tensor(x)))
+        back = _np(d.icdf(paddle.to_tensor(p)))
+        np.testing.assert_allclose(back, x, atol=1e-4)
+
+    def test_kl_closed_form(self):
+        p = D.Normal(0.0, 1.0)
+        q = D.Normal(1.0, 2.0)
+        kl = float(_np(D.kl_divergence(p, q)))
+        expect = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        assert kl == pytest.approx(expect, rel=1e-5)
+
+
+class TestUniform:
+    def test_basic(self):
+        d = D.Uniform(2.0, 4.0)
+        assert float(_np(d.mean)) == pytest.approx(3.0)
+        assert float(_np(d.log_prob(3.0))) == pytest.approx(-np.log(2.0))
+        assert float(_np(d.log_prob(5.0))) == -np.inf
+        assert float(_np(d.entropy())) == pytest.approx(np.log(2.0))
+        paddle.seed(1)
+        s = _np(d.sample((5000,)))
+        assert s.min() >= 2.0 and s.max() < 4.0
+
+
+class TestCategorical:
+    def test_logits_probs(self):
+        d = D.Categorical(probs=[0.2, 0.3, 0.5])
+        np.testing.assert_allclose(_np(d.probs), [0.2, 0.3, 0.5], atol=1e-6)
+        assert float(_np(d.log_prob(2))) == pytest.approx(np.log(0.5), rel=1e-5)
+        ent = -sum(p * np.log(p) for p in [0.2, 0.3, 0.5])
+        assert float(_np(d.entropy())) == pytest.approx(ent, rel=1e-5)
+
+    def test_sample_distribution(self):
+        paddle.seed(0)
+        d = D.Categorical(probs=[0.1, 0.9])
+        s = _np(d.sample((5000,)))
+        assert (s == 1).mean() == pytest.approx(0.9, abs=0.03)
+
+    def test_kl(self):
+        p = D.Categorical(probs=[0.5, 0.5])
+        q = D.Categorical(probs=[0.9, 0.1])
+        kl = float(_np(D.kl_divergence(p, q)))
+        expect = 0.5 * np.log(0.5 / 0.9) + 0.5 * np.log(0.5 / 0.1)
+        assert kl == pytest.approx(expect, rel=1e-4)
+
+
+class TestBernoulli:
+    def test_basic(self):
+        d = D.Bernoulli(probs=0.7)
+        assert float(_np(d.mean)) == pytest.approx(0.7, rel=1e-5)
+        assert float(_np(d.variance)) == pytest.approx(0.21, rel=1e-4)
+        assert float(_np(d.log_prob(1.0))) == pytest.approx(np.log(0.7), rel=1e-4)
+
+
+class TestBetaGammaDirichlet:
+    def test_beta(self):
+        d = D.Beta(2.0, 3.0)
+        assert float(_np(d.mean)) == pytest.approx(0.4, rel=1e-5)
+        # Beta(2,3) pdf at 0.5: x(1-x)^2/B(2,3), B(2,3)=1/12
+        expect = np.log(0.5 * 0.25 * 12)
+        assert float(_np(d.log_prob(0.5))) == pytest.approx(expect, rel=1e-4)
+        paddle.seed(0)
+        s = _np(d.sample((8000,)))
+        assert s.mean() == pytest.approx(0.4, abs=0.02)
+
+    def test_gamma(self):
+        d = D.Gamma(3.0, 2.0)
+        assert float(_np(d.mean)) == pytest.approx(1.5)
+        paddle.seed(0)
+        s = _np(d.sample((8000,)))
+        assert s.mean() == pytest.approx(1.5, abs=0.05)
+
+    def test_dirichlet(self):
+        d = D.Dirichlet(paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)))
+        np.testing.assert_allclose(_np(d.mean), [1 / 6, 2 / 6, 3 / 6], atol=1e-6)
+        paddle.seed(0)
+        s = _np(d.sample((4000,)))
+        np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+        np.testing.assert_allclose(s.mean(0), [1 / 6, 2 / 6, 3 / 6], atol=0.02)
+
+    def test_multinomial(self):
+        d = D.Multinomial(10, paddle.to_tensor(np.array([0.3, 0.7], np.float32)))
+        paddle.seed(0)
+        s = _np(d.sample((500,)))
+        assert s.shape == (500, 2)
+        np.testing.assert_allclose(s.sum(-1), 10.0)
+        assert s[:, 1].mean() == pytest.approx(7.0, abs=0.3)
+
+
+class TestExpFamilies:
+    def test_exponential(self):
+        d = D.Exponential(2.0)
+        assert float(_np(d.mean)) == pytest.approx(0.5)
+        assert float(_np(d.log_prob(1.0))) == pytest.approx(np.log(2) - 2, rel=1e-5)
+
+    def test_laplace(self):
+        d = D.Laplace(0.0, 1.0)
+        assert float(_np(d.log_prob(0.0))) == pytest.approx(-np.log(2), rel=1e-5)
+        x = np.linspace(-2, 2, 7, dtype=np.float32)
+        p = _np(d.cdf(paddle.to_tensor(x)))
+        back = _np(d.icdf(paddle.to_tensor(p)))
+        np.testing.assert_allclose(back, x, atol=1e-4)
+
+    def test_gumbel(self):
+        d = D.Gumbel(0.0, 1.0)
+        assert float(_np(d.mean)) == pytest.approx(0.5772, abs=1e-3)
+        paddle.seed(0)
+        s = _np(d.sample((20000,)))
+        assert s.mean() == pytest.approx(0.5772, abs=0.03)
+
+    def test_kl_exponential(self):
+        p, q = D.Exponential(1.0), D.Exponential(2.0)
+        kl = float(_np(D.kl_divergence(p, q)))
+        assert kl == pytest.approx(np.log(1 / 2) + 2 / 1 - 1, rel=1e-5)
+
+
+class TestGradients:
+    """Distribution math must be differentiable w.r.t. parameters —
+    the VAE / policy-gradient contract the reference provides by building
+    on paddle ops."""
+
+    def test_kl_grad_wrt_loc(self):
+        mu = paddle.to_tensor(np.array([0.5, -0.5], np.float32))
+        mu.stop_gradient = False
+        p = D.Normal(mu, 1.0)
+        q = D.Normal(0.0, 1.0)
+        kl = D.kl_divergence(p, q).sum()
+        kl.backward()
+        # d/dmu [mu^2/2] = mu
+        np.testing.assert_allclose(_np(mu.grad), [0.5, -0.5], atol=1e-5)
+
+    def test_rsample_reparameterized(self):
+        paddle.seed(0)
+        mu = paddle.to_tensor(np.zeros(3, np.float32))
+        mu.stop_gradient = False
+        d = D.Normal(mu, 1.0)
+        s = d.rsample((5,)).sum()
+        s.backward()
+        # dsum/dmu = 5 per element (broadcast over sample dim)
+        np.testing.assert_allclose(_np(mu.grad), 5.0, atol=1e-5)
+
+    def test_log_prob_grad_categorical(self):
+        logits = paddle.to_tensor(np.zeros(3, np.float32))
+        logits.stop_gradient = False
+        d = D.Categorical(logits=logits)
+        lp = d.log_prob(1)
+        lp.backward()
+        g = _np(logits.grad)
+        # grad of log softmax at uniform: onehot - 1/3
+        np.testing.assert_allclose(g, [-1 / 3, 2 / 3, -1 / 3], atol=1e-5)
+
+    def test_entropy_grad_flows(self):
+        scale = paddle.to_tensor(np.array(2.0, np.float32))
+        scale.stop_gradient = False
+        d = D.Normal(0.0, scale)
+        e = d.entropy()
+        e.backward()
+        np.testing.assert_allclose(_np(scale.grad), 0.5, atol=1e-6)
+
+
+class TestTransforms:
+    def test_affine_roundtrip(self):
+        t = D.AffineTransform(1.0, 3.0)
+        x = paddle.to_tensor(np.array([0.5, -2.0], np.float32))
+        y = t.forward(x)
+        np.testing.assert_allclose(_np(t.inverse(y)), _np(x), atol=1e-6)
+        np.testing.assert_allclose(_np(t.forward_log_det_jacobian(x)),
+                                   np.log(3.0), atol=1e-6)
+
+    def test_exp_sigmoid_tanh(self):
+        for t in [D.ExpTransform(), D.SigmoidTransform(), D.TanhTransform()]:
+            x = paddle.to_tensor(np.array([0.1, -0.3, 0.7], np.float32))
+            y = t.forward(x)
+            np.testing.assert_allclose(_np(t.inverse(y)), _np(x), atol=1e-5)
+
+    def test_stickbreaking_simplex(self):
+        t = D.StickBreakingTransform()
+        x = paddle.to_tensor(np.array([0.2, -0.5, 1.0], np.float32))
+        y = _np(t.forward(x))
+        assert y.shape == (4,)
+        assert y.sum() == pytest.approx(1.0, rel=1e-5)
+        np.testing.assert_allclose(_np(t.inverse(paddle.to_tensor(y))), _np(x),
+                                   atol=1e-4)
+
+    def test_stickbreaking_log_det_numeric(self):
+        import jax
+        t = D.StickBreakingTransform()
+        x = np.array([0.2, -0.5, 1.0], np.float32)
+        # numeric log|det J| of the K-1 x K-1 square part via jacfwd on the
+        # first K-1 outputs
+        jac = jax.jacfwd(lambda v: t._forward(v)[:-1])(x)
+        expect = np.linalg.slogdet(np.asarray(jac))[1]
+        got = float(_np(t.forward_log_det_jacobian(paddle.to_tensor(x))))
+        assert got == pytest.approx(float(expect), rel=1e-4)
+
+    def test_chain_transform_param_grads(self):
+        loc = paddle.to_tensor(np.array(1.0, np.float32))
+        scale = paddle.to_tensor(np.array(2.0, np.float32))
+        loc.stop_gradient = False
+        scale.stop_gradient = False
+        td = D.TransformedDistribution(
+            D.Normal(0.0, 1.0), [D.AffineTransform(loc, scale)])
+        lp = td.log_prob(paddle.to_tensor(np.array(1.5, np.float32)))
+        lp.backward()
+        assert loc.grad is not None and scale.grad is not None
+        # d/dloc log p(y) = (y-loc)/scale^2 = 0.5/4
+        np.testing.assert_allclose(_np(loc.grad), 0.125, atol=1e-5)
+
+    def test_bernoulli_large_logits_finite(self):
+        logits = paddle.to_tensor(np.array([25.0, -25.0], np.float32))
+        logits.stop_gradient = False
+        d = D.Bernoulli(logits=logits)
+        lp = d.log_prob(paddle.to_tensor(np.array([0.0, 1.0], np.float32)))
+        vals = _np(lp)
+        assert np.isfinite(vals).all()
+        lp.sum().backward()
+        assert np.isfinite(_np(logits.grad)).all()
+
+    def test_transformed_lognormal_matches(self):
+        base = D.Normal(0.0, 1.0)
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        ln = D.LogNormal(0.0, 1.0)
+        for v in [0.5, 1.0, 2.5]:
+            assert float(_np(td.log_prob(v))) == pytest.approx(
+                float(_np(ln.log_prob(v))), rel=1e-4)

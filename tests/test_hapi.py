@@ -1,0 +1,149 @@
+"""hapi Model fit/evaluate/predict + callbacks + summary."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import callbacks as cbks
+from paddle_tpu.metric import Accuracy
+
+
+class ToyDS(paddle.io.Dataset):
+    """Linearly separable 2-class blobs."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.standard_normal((n, 4)).astype(np.float32)
+        self.y = (self.x.sum(-1) > 0).astype(np.int64)
+        self.x[self.y == 1] += 1.0
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _model():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+    m = paddle.Model(net)
+    m.prepare(optimizer=paddle.optimizer.Adam(
+        learning_rate=1e-2, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+    return m
+
+
+def test_fit_evaluate_predict(tmp_path):
+    m = _model()
+    train, val = ToyDS(64, 0), ToyDS(32, 1)
+    m.fit(train, val, batch_size=16, epochs=4, verbose=0)
+    res = m.evaluate(val, batch_size=16, verbose=0)
+    assert res["acc"] > 0.8
+    assert "loss" in res
+    outs = m.predict(val, batch_size=16, stack_outputs=True)
+    assert outs[0].shape == (32, 2)
+
+
+def test_save_load_roundtrip(tmp_path):
+    m = _model()
+    m.fit(ToyDS(32), batch_size=16, epochs=1, verbose=0)
+    path = os.path.join(tmp_path, "ck", "model")
+    m.save(path)
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")
+    m2 = _model()
+    m2.load(path)
+    x = paddle.to_tensor(ToyDS(8).x)
+    np.testing.assert_allclose(np.asarray(m.network(x)._data),
+                               np.asarray(m2.network(x)._data), atol=1e-6)
+
+
+def test_early_stopping_stops():
+    m = _model()
+    stopper = cbks.EarlyStopping(monitor="loss", patience=1, verbose=0,
+                                 mode="min")
+    # loss on random labels won't improve forever; force quick stop via
+    # zero lr so loss is flat
+    m._optimizer.set_lr(0.0)
+    m.fit(ToyDS(32), batch_size=16, epochs=10, verbose=0, callbacks=[stopper])
+    assert m.stop_training
+
+
+def test_model_checkpoint_callback(tmp_path):
+    m = _model()
+    ck = cbks.ModelCheckpoint(save_freq=1, save_dir=str(tmp_path))
+    m.fit(ToyDS(32), batch_size=16, epochs=2, verbose=0, callbacks=[ck])
+    assert os.path.exists(os.path.join(tmp_path, "0.pdparams"))
+    assert os.path.exists(os.path.join(tmp_path, "final.pdparams"))
+
+
+def test_lr_scheduler_callback_steps():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 2))
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                          gamma=0.5)
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=net.parameters())
+    m = paddle.Model(net)
+    m.prepare(optimizer=opt, loss=nn.CrossEntropyLoss())
+    m.fit(ToyDS(32), batch_size=16, epochs=1, verbose=0)
+    # 2 batches -> scheduler stepped twice -> lr = 0.1 * 0.5^2
+    assert opt.get_lr() == pytest.approx(0.025)
+
+
+def test_summary_counts_params(capsys):
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+    info = paddle.summary(net, (1, 4))
+    # 4*16+16 + 16*2+2 = 114
+    assert info["total_params"] == 114
+    out = capsys.readouterr().out
+    assert "Total params" in out
+
+
+def test_early_stopping_sees_eval_metrics(tmp_path):
+    """on_epoch_end must receive eval_* keys (regression: ordering bug)."""
+    m = _model()
+    seen = {}
+
+    class Spy(cbks.Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            seen.update(logs or {})
+
+    m.fit(ToyDS(32, 0), ToyDS(16, 1), batch_size=16, epochs=1, verbose=0,
+          callbacks=[Spy()])
+    assert any(k.startswith("eval_") for k in seen), seen
+
+
+def test_early_stopping_saves_best(tmp_path):
+    m = _model()
+    stop = cbks.EarlyStopping(monitor="loss", patience=2, verbose=0,
+                              save_dir=str(tmp_path))
+    m.fit(ToyDS(32), batch_size=16, epochs=2, verbose=0, callbacks=[stop])
+    assert os.path.exists(os.path.join(tmp_path, "best_model.pdparams"))
+
+
+def test_reduce_lr_plateau_min_delta():
+    """tiny (sub-min_delta) improvements must count as plateau."""
+    m = _model()
+    m._optimizer.set_lr(0.1)
+    cb = cbks.ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                                verbose=0, min_delta=1e-2)
+    cb.set_model(m)
+    cb.on_epoch_end(0, {"loss": 1.0})
+    cb.on_epoch_end(1, {"loss": 1.0 - 1e-6})  # below min_delta: wait=1
+    cb.on_epoch_end(2, {"loss": 1.0 - 2e-6})  # still plateau -> reduce
+    assert m._optimizer.get_lr() < 0.1
+
+
+def test_reduce_lr_on_plateau():
+    m = _model()
+    m._optimizer.set_lr(0.1)
+    cb = cbks.ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                                verbose=0)
+    cb.set_model(m)
+    cb.on_epoch_end(0, {"loss": 1.0})
+    cb.on_epoch_end(1, {"loss": 1.0})  # wait=1 -> reduce
+    cb.on_epoch_end(2, {"loss": 1.0})
+    assert m._optimizer.get_lr() < 0.1
