@@ -10,3 +10,10 @@ from .gpt import (  # noqa: F401
     GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
     gpt_config, PRESETS as GPT_PRESETS,
 )
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForMaskedLM, BertForSequenceClassification,
+    BertForPretraining, bert_config,
+)
+from .vit import (  # noqa: F401
+    ViTConfig, VisionTransformer, vit_config,
+)

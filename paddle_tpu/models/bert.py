@@ -1,0 +1,280 @@
+"""BERT model family (capability: the reference's BERT path — dy2static test
+models python/paddle/fluid/tests/unittests/dygraph_to_static/bert_dygraph_model.py
+and the fused-transformer encoder incubate/nn/layer/fused_transformer.py:725).
+
+TPU-native: same mpu-sharded projections as GPT (qkv/up column-parallel over
+`mp`, out/down row-parallel), bf16-ready. Attention takes the Pallas flash
+kernel when unmasked and dropout-free; padding-masked or prob-dropout batches
+use the fp32-softmax reference path (masked flash is a later optimisation).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+from ..core.tensor import apply_op
+from ..core import ops
+from ..nn.layer import Layer, LayerList
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layers.common import Embedding, Dropout, Linear
+from ..nn.layers.norm import LayerNorm
+from ..distributed.mpu import (ColumnParallelLinear, RowParallelLinear,
+                               VocabParallelEmbedding)
+from ..distributed import mesh as _mesh
+from ..ops.attention import functional_attention
+
+__all__ = ["BertConfig", "BertModel", "BertForMaskedLM",
+           "BertForSequenceClassification", "BertForPretraining",
+           "bert_config"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_epsilon: float = 1e-12
+    initializer_range: float = 0.02
+    pad_token_id: int = 0
+    num_labels: int = 2
+    param_dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+        assert self.hidden_size % self.num_heads == 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+PRESETS = {
+    "bert-base": dict(hidden_size=768, num_layers=12, num_heads=12),
+    "bert-large": dict(hidden_size=1024, num_layers=24, num_heads=16),
+}
+
+
+def bert_config(preset: str, **overrides) -> BertConfig:
+    cfg = dict(PRESETS[preset])
+    cfg.update(overrides)
+    return BertConfig(**cfg)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = I.Normal(std=config.initializer_range)
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size)
+        self.word_embeddings.weight.set_value(init(
+            [config.vocab_size, config.hidden_size],
+            self.word_embeddings.weight.dtype))
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.position_embeddings.weight.set_value(init(
+            [config.max_position_embeddings, config.hidden_size],
+            self.position_embeddings.weight.dtype))
+        self.token_type_embeddings = Embedding(
+            config.type_vocab_size, config.hidden_size)
+        self.token_type_embeddings.weight.set_value(init(
+            [config.type_vocab_size, config.hidden_size],
+            self.token_type_embeddings.weight.dtype))
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_epsilon)
+        self.dropout = Dropout(config.hidden_dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = ops.unsqueeze(ops.arange(0, s, dtype="int64"), 0)
+        if token_type_ids is None:
+            token_type_ids = ops.zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        x = self.layer_norm(x)
+        if self.training and self.dropout.p:
+            x = self.dropout(x)
+        return apply_op("act_shard", lambda a: _mesh.shard_constraint(
+            a, "dp", "sp", None), [x])
+
+
+class BertAttention(Layer):
+    """Bidirectional fused-QKV attention with optional padding mask."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.head_dim = config.head_dim
+        h = config.hidden_size
+        init = I.Normal(std=config.initializer_range)
+        self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
+        self.qkv.weight.set_value(init([h, 3 * h], self.qkv.weight.dtype))
+        self.out = RowParallelLinear(h, h, input_is_parallel=True)
+        self.out.weight.set_value(
+            init([h, h], self.out.weight.dtype)
+            / math.sqrt(2 * config.num_layers))
+        self.dropout = Dropout(config.hidden_dropout)
+        self.attn_dropout_p = config.attention_dropout
+
+    def forward(self, x, attention_mask=None):
+        import jax.numpy as jnp
+        from ..core import random as _random
+        from ..ops.attention import attention_reference
+
+        nh, hd = self.num_heads, self.head_dim
+        qkv = self.qkv(x)
+        b, s = qkv.shape[0], qkv.shape[1]
+        qkv = ops.reshape(qkv, [b, s, 3, nh, hd])
+        tensor_args = [qkv] if attention_mask is None else [qkv, attention_mask]
+        attn_p = self.attn_dropout_p if self.training else 0.0
+        dk = _random.split_key() if attn_p > 0.0 else None
+
+        def attend(a, mask=None):
+            q, k, v = a[:, :, 0], a[:, :, 1], a[:, :, 2]
+            q = _mesh.shard_constraint(q, "dp", "sp", "mp", None)
+            k = _mesh.shard_constraint(k, "dp", "sp", "mp", None)
+            v = _mesh.shard_constraint(v, "dp", "sp", "mp", None)
+            if mask is not None and mask.ndim == 2:
+                if jnp.issubdtype(mask.dtype, jnp.floating):
+                    mask = mask[:, None, None, :]          # additive [B,Sk]
+                else:
+                    mask = (mask > 0)[:, None, None, :]    # 0/1 keep [B,Sk]
+            if mask is None and attn_p == 0.0:
+                o = functional_attention(q, k, v, is_causal=False)
+            else:
+                o = attention_reference(q, k, v, mask=mask, dropout_p=attn_p,
+                                        dropout_key=dk)
+            return _mesh.shard_constraint(o, "dp", "sp", "mp", None)
+
+        ctx = apply_op("bert_attention", attend, tensor_args)
+        y = self.out(ops.reshape(ctx, [b, s, nh * hd]))
+        if self.training and self.dropout.p:
+            y = self.dropout(y)
+        return y
+
+
+class BertLayer(Layer):
+    """Post-LN encoder block (original BERT ordering)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        init = I.Normal(std=config.initializer_range)
+        self.attention = BertAttention(config)
+        self.ln_1 = LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.up = ColumnParallelLinear(h, m, gather_output=False)
+        self.up.weight.set_value(init([h, m], self.up.weight.dtype))
+        self.down = RowParallelLinear(m, h, input_is_parallel=True)
+        self.down.weight.set_value(
+            init([m, h], self.down.weight.dtype)
+            / math.sqrt(2 * config.num_layers))
+        self.ln_2 = LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.dropout = Dropout(config.hidden_dropout)
+
+    def forward(self, x, attention_mask=None):
+        x = self.ln_1(x + self.attention(x, attention_mask))
+        y = self.down(F.gelu(self.up(x), approximate=True))
+        if self.training and self.dropout.p:
+            y = self.dropout(y)
+        return self.ln_2(x + y)
+
+
+def _tied_logits(h, wte):
+    """Vocab-parallel logits against the (tied) embedding table, like
+    GPTForCausalLM's tied head."""
+    import jax.numpy as jnp
+    return apply_op(
+        "tied_mlm_head",
+        lambda a, w: _mesh.shard_constraint(
+            jnp.einsum("bsh,vh->bsv", a, w), "dp", "sp", "mp"),
+        [h, wte.weight])
+
+
+class BertPooler(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, x):
+        return ops.tanh(self.dense(x[:, 0]))
+
+
+class BertModel(Layer):
+    """Backbone: embeddings + encoder + pooler."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = LayerList([BertLayer(config)
+                                  for _ in range(config.num_layers)])
+        self.pooler = BertPooler(config)
+        if config.param_dtype != "float32":
+            self.to(dtype=config.param_dtype)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, attention_mask)
+        return x, self.pooler(x)
+
+
+class BertForMaskedLM(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.ln = LayerNorm(config.hidden_size,
+                            epsilon=config.layer_norm_epsilon)
+        # decoder tied to word embeddings (vocab-parallel logits)
+        self.config = config
+
+    def mlm_logits(self, seq):
+        """Shared MLM head: transform -> gelu -> LN -> tied logits."""
+        h = self.ln(F.gelu(self.transform(seq), approximate=True))
+        return _tied_logits(h, self.bert.embeddings.word_embeddings)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids,
+                           attention_mask=attention_mask)
+        return self.mlm_logits(seq)
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout)
+        self.classifier = Linear(config.hidden_size, config.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        if self.training and self.dropout.p:
+            pooled = self.dropout(pooled)
+        return self.classifier(pooled)
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.mlm = BertForMaskedLM(config)
+        self.nsp = Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.mlm.bert(input_ids, token_type_ids,
+                                    attention_mask=attention_mask)
+        return self.mlm.mlm_logits(seq), self.nsp(pooled)

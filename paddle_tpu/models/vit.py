@@ -1,0 +1,157 @@
+"""Vision Transformer (capability: BASELINE.md ViT-L/16 bench config; the
+reference era serves ViT through its generic nn.TransformerEncoder,
+python/paddle/nn/layer/transformer.py).
+
+TPU-native: patch embedding is one strided conv (MXU-friendly), encoder
+re-uses the same mp-sharded projections as GPT/BERT.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import apply_op
+from ..core import ops
+from ..nn.layer import Layer, LayerList
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layers.common import Dropout, Linear
+from ..nn.layers.conv import Conv2D
+from ..nn.layers.norm import LayerNorm
+from ..distributed.mpu import ColumnParallelLinear, RowParallelLinear
+from ..distributed import mesh as _mesh
+from ..ops.attention import functional_attention
+
+__all__ = ["ViTConfig", "VisionTransformer", "vit_config"]
+
+
+@dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None
+    hidden_dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-6
+    initializer_range: float = 0.02
+    num_classes: int = 1000
+    param_dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+        assert self.image_size % self.patch_size == 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_patches(self):
+        return (self.image_size // self.patch_size) ** 2
+
+
+PRESETS = {
+    "vit-b16": dict(hidden_size=768, num_layers=12, num_heads=12),
+    "vit-l16": dict(hidden_size=1024, num_layers=24, num_heads=16),
+    "vit-h14": dict(hidden_size=1280, num_layers=32, num_heads=16,
+                    patch_size=14),
+}
+
+
+def vit_config(preset: str, **overrides) -> ViTConfig:
+    cfg = dict(PRESETS[preset])
+    cfg.update(overrides)
+    return ViTConfig(**cfg)
+
+
+class ViTBlock(Layer):
+    """Pre-LN block, mp-sharded projections."""
+
+    def __init__(self, config: ViTConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        self.num_heads = config.num_heads
+        self.head_dim = config.head_dim
+        init = I.Normal(std=config.initializer_range)
+        self.ln_1 = LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
+        self.qkv.weight.set_value(init([h, 3 * h], self.qkv.weight.dtype))
+        self.out = RowParallelLinear(h, h, input_is_parallel=True)
+        self.out.weight.set_value(
+            init([h, h], self.out.weight.dtype)
+            / math.sqrt(2 * config.num_layers))
+        self.ln_2 = LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.up = ColumnParallelLinear(h, m, gather_output=False)
+        self.up.weight.set_value(init([h, m], self.up.weight.dtype))
+        self.down = RowParallelLinear(m, h, input_is_parallel=True)
+        self.down.weight.set_value(
+            init([m, h], self.down.weight.dtype)
+            / math.sqrt(2 * config.num_layers))
+        self.dropout = Dropout(config.hidden_dropout)
+
+    def forward(self, x):
+        nh, hd = self.num_heads, self.head_dim
+        qkv = self.qkv(self.ln_1(x))
+        b, s = qkv.shape[0], qkv.shape[1]
+        qkv = ops.reshape(qkv, [b, s, 3, nh, hd])
+
+        def attend(a):
+            q, k, v = a[:, :, 0], a[:, :, 1], a[:, :, 2]
+            q = _mesh.shard_constraint(q, "dp", None, "mp", None)
+            k = _mesh.shard_constraint(k, "dp", None, "mp", None)
+            v = _mesh.shard_constraint(v, "dp", None, "mp", None)
+            o = functional_attention(q, k, v, is_causal=False)
+            return _mesh.shard_constraint(o, "dp", None, "mp", None)
+
+        ctx = apply_op("vit_attention", attend, [qkv])
+        x = x + self.out(ops.reshape(ctx, [b, s, nh * hd]))
+        y = self.down(F.gelu(self.up(self.ln_2(x)), approximate=True))
+        if self.training and self.dropout.p:
+            y = self.dropout(y)
+        return x + y
+
+
+class VisionTransformer(Layer):
+    """ViT backbone + classification head (cls-token pooling)."""
+
+    def __init__(self, config: ViTConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        self.patch_embed = Conv2D(config.num_channels, h, config.patch_size,
+                                  stride=config.patch_size)
+        self.cls_token = self.create_parameter(
+            [1, 1, h], default_initializer=I.TruncatedNormal(std=0.02))
+        self.pos_embed = self.create_parameter(
+            [1, config.num_patches + 1, h],
+            default_initializer=I.TruncatedNormal(std=0.02))
+        self.dropout = Dropout(config.hidden_dropout)
+        self.blocks = LayerList([ViTBlock(config)
+                                 for _ in range(config.num_layers)])
+        self.ln = LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        if config.num_classes > 0:
+            self.head = Linear(h, config.num_classes)
+        if config.param_dtype != "float32":
+            self.to(dtype=config.param_dtype)
+
+    def forward(self, pixel_values):
+        x = self.patch_embed(pixel_values)            # [B, H, gh, gw]
+        b, h = x.shape[0], x.shape[1]
+        x = ops.transpose(ops.reshape(x, [b, h, -1]), [0, 2, 1])  # [B, N, H]
+        cls = ops.expand(self.cls_token, [b, 1, h])
+        x = ops.concat([cls, x], axis=1) + self.pos_embed
+        if self.training and self.config.hidden_dropout:
+            x = self.dropout(x)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln(x)
+        if self.config.num_classes > 0:
+            return self.head(x[:, 0])
+        return x

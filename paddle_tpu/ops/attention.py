@@ -75,10 +75,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     return apply_op("sdpa", fn, [query, key, value])
 
 
-def functional_attention(q, k, v, *, is_causal=False, scale=None):
+def functional_attention(q, k, v, *, is_causal=False, scale=None, mask=None):
     """Pure-array attention for jitted model code: picks flash kernel on TPU,
-    reference path elsewhere. Differentiable in both cases."""
-    if _use_pallas(tuple(q.shape), q.shape[-1]):
+    reference path elsewhere. Differentiable in both cases. An explicit mask
+    (bool keep-mask or additive float, broadcastable to [B,H,Sq,Sk]) forces
+    the reference path."""
+    if mask is None and _use_pallas(tuple(q.shape), q.shape[-1]):
         from .pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=is_causal, scale=scale)
-    return attention_reference(q, k, v, is_causal=is_causal, scale=scale)
+    return attention_reference(q, k, v, mask=mask, is_causal=is_causal,
+                               scale=scale)

@@ -1,0 +1,171 @@
+"""BERT + ViT model family tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.models import (BertConfig, BertForMaskedLM,
+                               BertForPretraining,
+                               BertForSequenceClassification, BertModel,
+                               ViTConfig, VisionTransformer)
+
+
+def tiny_bert(**kw):
+    cfg = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+               max_position_embeddings=32, intermediate_size=64,
+               hidden_dropout=0.0)
+    cfg.update(kw)
+    return BertConfig(**cfg)
+
+
+def tiny_vit(**kw):
+    cfg = dict(image_size=16, patch_size=4, hidden_size=32, num_layers=2,
+               num_heads=4, intermediate_size=64, num_classes=5)
+    cfg.update(kw)
+    return ViTConfig(**cfg)
+
+
+class TestBert:
+    def test_backbone_shapes(self):
+        paddle.seed(0)
+        m = BertModel(tiny_bert())
+        m.eval()
+        ids = paddle.to_tensor(np.random.randint(0, 128, (2, 16)).astype("int64"))
+        seq, pooled = m(ids)
+        assert tuple(seq.shape) == (2, 16, 32)
+        assert tuple(pooled.shape) == (2, 32)
+
+    def test_mlm_logits_and_tied_grads(self):
+        paddle.seed(0)
+        m = BertForMaskedLM(tiny_bert())
+        m.train()
+        ids = paddle.to_tensor(np.random.randint(0, 128, (2, 8)).astype("int64"))
+        logits = m(ids)
+        assert tuple(logits.shape) == (2, 8, 128)
+        loss = nn.CrossEntropyLoss()(
+            paddle.reshape(logits, [-1, 128]),
+            paddle.reshape(ids, [-1]))
+        loss.backward()
+        wte = m.bert.embeddings.word_embeddings.weight
+        assert wte.grad is not None  # tied head must flow into embeddings
+
+    def test_cls_learns_toy_task(self):
+        paddle.seed(0)
+        cfg = tiny_bert(num_labels=2)
+        m = BertForSequenceClassification(cfg)
+        m.train()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters())
+        # class = whether first token id > 64
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 128, (32, 8)).astype("int64")
+        labels = (ids[:, 0] > 64).astype("int64")
+        lossf = nn.CrossEntropyLoss()
+        losses = []
+        for _ in range(60):
+            loss = lossf(m(paddle.to_tensor(ids)), paddle.to_tensor(labels))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < 0.3 * losses[0]
+
+    def test_padding_mask_changes_output(self):
+        paddle.seed(0)
+        m = BertModel(tiny_bert())
+        m.eval()
+        ids = paddle.to_tensor(np.random.randint(1, 128, (1, 8)).astype("int64"))
+        mask = paddle.to_tensor(np.array([[1, 1, 1, 1, 0, 0, 0, 0]], np.int32))
+        seq_full, _ = m(ids)
+        seq_masked, _ = m(ids, attention_mask=mask)
+        # masking the tail must change the first token's representation
+        assert not np.allclose(np.asarray(seq_full._data[0, 0]),
+                               np.asarray(seq_masked._data[0, 0]), atol=1e-5)
+
+    def test_additive_float_mask(self):
+        """0/-1e4 additive float masks must behave like the 0/1 keep mask."""
+        paddle.seed(0)
+        m = BertModel(tiny_bert())
+        m.eval()
+        ids = paddle.to_tensor(np.random.randint(1, 128, (1, 8)).astype("int64"))
+        keep = np.array([[1, 1, 1, 1, 0, 0, 0, 0]], np.int32)
+        additive = np.where(keep > 0, 0.0, -1e9).astype(np.float32)
+        a, _ = m(ids, attention_mask=paddle.to_tensor(keep))
+        b, _ = m(ids, attention_mask=paddle.to_tensor(additive))
+        np.testing.assert_allclose(np.asarray(a._data), np.asarray(b._data),
+                                   atol=1e-5)
+
+    def test_attention_dropout_active_in_train(self):
+        paddle.seed(0)
+        m = BertModel(tiny_bert(attention_dropout=0.5))
+        m.train()
+        ids = paddle.to_tensor(np.random.randint(1, 128, (1, 8)).astype("int64"))
+        a, _ = m(ids)
+        b, _ = m(ids)
+        assert not np.allclose(np.asarray(a._data), np.asarray(b._data))
+        m.eval()
+        c, _ = m(ids)
+        d, _ = m(ids)
+        np.testing.assert_allclose(np.asarray(c._data), np.asarray(d._data))
+
+    def test_embedding_init_scale(self):
+        m = BertModel(tiny_bert())
+        for w in (m.embeddings.position_embeddings.weight,
+                  m.embeddings.token_type_embeddings.weight):
+            assert np.asarray(w._data).std() < 0.05  # initializer_range=0.02
+
+    def test_pretraining_heads(self):
+        paddle.seed(0)
+        m = BertForPretraining(tiny_bert())
+        m.eval()
+        ids = paddle.to_tensor(np.random.randint(0, 128, (2, 8)).astype("int64"))
+        mlm_logits, nsp_logits = m(ids)
+        assert tuple(mlm_logits.shape) == (2, 8, 128)
+        assert tuple(nsp_logits.shape) == (2, 2)
+
+    def test_token_type_embeddings_used(self):
+        paddle.seed(0)
+        m = BertModel(tiny_bert())
+        m.eval()
+        ids = paddle.to_tensor(np.random.randint(0, 128, (1, 8)).astype("int64"))
+        tt = paddle.to_tensor(np.ones((1, 8), np.int64))
+        a, _ = m(ids)
+        b, _ = m(ids, token_type_ids=tt)
+        assert not np.allclose(np.asarray(a._data), np.asarray(b._data))
+
+
+class TestViT:
+    def test_forward_shape(self):
+        paddle.seed(0)
+        m = VisionTransformer(tiny_vit())
+        m.eval()
+        x = paddle.randn([2, 3, 16, 16])
+        y = m(x)
+        assert tuple(y.shape) == (2, 5)
+        assert np.isfinite(np.asarray(y._data)).all()
+
+    def test_feature_mode(self):
+        m = VisionTransformer(tiny_vit(num_classes=0))
+        m.eval()
+        y = m(paddle.randn([1, 3, 16, 16]))
+        assert tuple(y.shape) == (1, 17, 32)  # 16 patches + cls
+
+    def test_learns_toy_task(self):
+        paddle.seed(0)
+        m = VisionTransformer(tiny_vit(num_classes=2))
+        m.train()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters())
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 3, 16, 16)).astype(np.float32)
+        labels = (np.arange(16) % 2).astype("int64")
+        x[labels == 1] += 2.0
+        lossf = nn.CrossEntropyLoss()
+        losses = []
+        for _ in range(25):
+            loss = lossf(m(paddle.to_tensor(x)), paddle.to_tensor(labels))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < 0.3 * losses[0]
