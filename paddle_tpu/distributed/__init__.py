@@ -27,6 +27,9 @@ from .recompute import recompute, recompute_sequential, recompute_hybrid  # noqa
 from .pipeline import (  # noqa: F401
     LayerDesc, SharedLayerDesc, PipelineLayer, PipelineParallel, pipeline_scan,
 )
+from ..ops.ring_attention import (  # noqa: F401
+    ring_attention, ulysses_attention, sequence_parallel_attention,
+)
 from . import fleet  # noqa: F401
 from . import mpu  # noqa: F401
 from .mpu import split  # noqa: F401

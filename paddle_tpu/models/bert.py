@@ -149,8 +149,8 @@ class BertAttention(Layer):
                     mask = mask[:, None, None, :]          # additive [B,Sk]
                 else:
                     mask = (mask > 0)[:, None, None, :]    # 0/1 keep [B,Sk]
-            if mask is None and attn_p == 0.0:
-                o = functional_attention(q, k, v, is_causal=False)
+            if attn_p == 0.0:
+                o = functional_attention(q, k, v, is_causal=False, mask=mask)
             else:
                 o = attention_reference(q, k, v, mask=mask, dropout_p=attn_p,
                                         dropout_key=dk)

@@ -56,6 +56,9 @@ class GPTConfig:
     use_recompute: bool = False
     tie_word_embeddings: bool = True
     param_dtype: str = "float32"
+    # "ring" | "ulysses" | None — schedule used when the mesh has sp > 1
+    # (exceeds reference: SURVEY §5.7 — no sequence parallelism in snapshot)
+    sequence_parallel: str = "ring"
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -92,6 +95,7 @@ class GPTSelfAttention(Layer):
         super().__init__()
         self.num_heads = config.num_heads
         self.head_dim = config.head_dim
+        self._sequence_parallel = config.sequence_parallel
         h = config.hidden_size
         w_init = I.Normal(std=config.initializer_range)
         self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
@@ -119,9 +123,10 @@ class GPTSelfAttention(Layer):
             new_cache = (k.detach(), v.detach())
             ctx = _attend(q, k, v, causal=False)  # q is the tail; mask below
         else:
+            sp = self._sequence_parallel
             ctx = apply_op(
                 "gpt_attention",
-                lambda a: _qkv_attention(a, nh, hd), [qkv])
+                lambda a: _qkv_attention(a, nh, hd, sp), [qkv])
         y = self.out(ops.reshape(ctx, [b, ctx.shape[1], nh * hd]))
         if self.training and self.dropout.p:
             y = self.dropout(y)
@@ -130,12 +135,19 @@ class GPTSelfAttention(Layer):
         return y
 
 
-def _qkv_attention(qkv, nh, hd):
+def _qkv_attention(qkv, nh, hd, sequence_parallel="ring"):
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     q = _mesh.shard_constraint(q, "dp", "sp", "mp", None)
     k = _mesh.shard_constraint(k, "dp", "sp", "mp", None)
     v = _mesh.shard_constraint(v, "dp", "sp", "mp", None)
-    out = functional_attention(q, k, v, is_causal=True)
+    if sequence_parallel and _mesh.mesh_axis_size("sp") > 1:
+        # sp>1: keep S sharded end-to-end — ring/ulysses schedule instead of
+        # letting XLA all-gather K/V for the dense product (SURVEY §5.7).
+        from ..ops.ring_attention import sequence_parallel_attention
+        out = sequence_parallel_attention(q, k, v, is_causal=True,
+                                          schedule=sequence_parallel)
+    else:
+        out = functional_attention(q, k, v, is_causal=True)
     return _mesh.shard_constraint(out, "dp", "sp", "mp", None)
 
 
