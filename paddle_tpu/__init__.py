@@ -47,6 +47,7 @@ from . import device  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402
 from .framework.io import save, load  # noqa: F401,E402
 from .tensor import tensor as _tensor_ns  # noqa: F401,E402

@@ -1,0 +1,6 @@
+"""paddle.incubate analog (reference: python/paddle/incubate/__init__.py) —
+experimental surfaces: MoE, fused transformer layers, extra optimizers."""
+from . import nn  # noqa: F401
+from . import distributed  # noqa: F401
+from . import optimizer  # noqa: F401
+from .nn.functional import fused_matmul_bias  # noqa: F401

@@ -1,0 +1,38 @@
+"""Gate configuration objects (reference: incubate/distributed/models/moe/gate/
+naive_gate.py, gshard_gate.py, switch_gate.py).
+
+In the reference each gate is an nn.Layer owning the routing projection; here
+the projection lives in MoELayer (one einsum) and gates are declarative
+configs selecting top-k and the aux-loss formula — the routing math itself is
+the XLA-friendly one-hot dispatch in moe_layer._topk_dispatch.
+"""
+from __future__ import annotations
+
+
+class BaseGate:
+    gate_type = "naive"
+    top_k = 2
+
+    def __init__(self, d_model=None, num_experts=None, top_k=None):
+        self.d_model = d_model
+        self.num_experts = num_experts
+        if top_k is not None:
+            self.top_k = top_k
+
+
+class NaiveGate(BaseGate):
+    """Plain top-k routing, no auxiliary loss (naive_gate.py)."""
+    gate_type = "naive"
+    top_k = 2
+
+
+class GShardGate(BaseGate):
+    """Top-2 routing + load-balance aux loss (gshard_gate.py)."""
+    gate_type = "gshard"
+    top_k = 2
+
+
+class SwitchGate(BaseGate):
+    """Top-1 routing + load-balance aux loss (switch_gate.py)."""
+    gate_type = "switch"
+    top_k = 1

@@ -1,0 +1,5 @@
+from .fused_transformer import (  # noqa: F401
+    FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
+    FusedMultiTransformer, FusedEcMoe,
+)
+from . import functional  # noqa: F401

@@ -1,0 +1,79 @@
+"""Fused functional ops (reference: python/paddle/incubate/nn/functional/).
+
+On TPU "fused" is a compiler property: these compose jnp primitives inside
+one apply_op so the whole expression jits as a single XLA fusion — the same
+effect the reference gets from hand-written CUDA megakernels
+(fused_matmul_bias via cublasLt, fused_bias_dropout_residual_layer_norm,
+paddle/fluid/operators/fused/).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+from ...core import random as _random
+
+
+def _ln(x, scale, bias, eps):
+    """Shared layer-norm body (also used by fused_transformer layers)."""
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mu) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        y = y * scale
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def _drop(x, p, key):
+    """Shared inverted-scale dropout body."""
+    if key is None or p == 0.0:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    return jnp.where(keep, x / (1.0 - p), 0.0)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """Reference: incubate/nn/functional/fused_matmul_bias.py (cublasLt
+    epilogue fusion); here XLA fuses the bias add into the MXU matmul."""
+    def fn(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        if rest:
+            out = out + rest[0]
+        return out
+    args = [x, y] + ([bias] if bias is not None else [])
+    return apply_op("fused_matmul_bias", fn, args)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, name=None):
+    """Reference: fused_bias_dropout_residual_layer_norm op
+    (operators/fused/fused_bias_dropout_residual_layer_norm_op.cu)."""
+    key = _random.split_key() if (dropout_rate > 0.0 and training) else None
+
+    def fn(xv, res, *rest):
+        i = 0
+        if bias is not None:
+            xv = xv + rest[i]; i += 1
+        xv = _drop(xv, dropout_rate if key is not None else 0.0, key)
+        y = xv + res
+        scale = rest[i] if ln_scale is not None else None
+        i += ln_scale is not None
+        lb = rest[i] if ln_bias is not None else None
+        return _ln(y, scale, lb, ln_epsilon)
+
+    args = [x, residual] + [t for t in (bias, ln_scale, ln_bias)
+                            if t is not None]
+    return apply_op("fused_bias_dropout_residual_ln", fn, args)

@@ -1,0 +1,223 @@
+"""Fused transformer layers (reference: python/paddle/incubate/nn/layer/
+fused_transformer.py — FusedMultiHeadAttention:192, FusedFeedForward:497,
+FusedTransformerEncoderLayer:725, FusedMultiTransformer:1021).
+
+The reference backs these with CUDA megakernels (fused_attention_op.cu,
+fused_feedforward_op.cu); on TPU each forward body is one apply_op whose
+whole expression XLA fuses, and the attention core dispatches to the Pallas
+flash kernel when shapes qualify. Parameter names/shapes follow the
+reference so state_dicts line up.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+from ...core import random as _random
+from ...nn.layer import Layer
+from ...nn import initializer as I
+from ...ops.attention import functional_attention, attention_reference
+from .functional import _ln, _drop
+
+
+class FusedMultiHeadAttention(Layer):
+    """Pre/post-LN fused self-attention block (fused_transformer.py:192):
+    residual + LN + QKV proj + SDPA + out proj + dropout in one fusion."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 epsilon=1e-5, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim, self.num_heads = embed_dim, num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self._epsilon = epsilon
+        h = embed_dim
+        self.qkv_weight = self.create_parameter(
+            [3, num_heads, self.head_dim, h], default_initializer=I.XavierUniform())
+        self.qkv_bias = self.create_parameter(
+            [3, num_heads, self.head_dim], is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [h, h], default_initializer=I.XavierUniform())
+        self.linear_bias = self.create_parameter([h], is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            [h], default_initializer=I.Constant(1.0))
+        self.pre_ln_bias = self.create_parameter([h], is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [h], default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter([h], is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        """cache: optional (k_past, v_past) Tensors [B, S_past, H, D] for
+        incremental decode; returns (out, (k_new, v_new)) when given
+        (reference Cache contract, fused_transformer.py:192)."""
+        nh, hd, eps = self.num_heads, self.head_dim, self._epsilon
+        attn_p = self.attn_dropout_rate if self.training else 0.0
+        out_p = self.dropout_rate if self.training else 0.0
+        k_attn = _random.split_key() if attn_p else None
+        k_out = _random.split_key() if out_p else None
+        pre = self.normalize_before
+        mask = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
+        with_cache = cache is not None
+
+        def fn(x, qkv_w, qkv_b, lw, lb, pls, plb, lns, lnb, *past):
+            residual = x
+            if pre:
+                x = _ln(x, pls, plb, eps)
+            qkv = jnp.einsum("bsh,tndh->bstnd", x, qkv_w) + qkv_b
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            if past:
+                k = jnp.concatenate([past[0], k], axis=1)
+                v = jnp.concatenate([past[1], v], axis=1)
+            if attn_p or mask is not None:
+                o = attention_reference(q, k, v, mask=mask, dropout_p=attn_p,
+                                        dropout_key=k_attn)
+            else:
+                o = functional_attention(q, k, v)
+            o = o.reshape(o.shape[0], o.shape[1], nh * hd)
+            o = o @ lw + lb
+            o = residual + _drop(o, out_p, k_out)
+            if not pre:
+                o = _ln(o, lns, lnb, eps)
+            return (o, k, v) if past else o
+
+        args = [query, self.qkv_weight, self.qkv_bias, self.linear_weight,
+                self.linear_bias, self.pre_ln_scale, self.pre_ln_bias,
+                self.ln_scale, self.ln_bias]
+        if with_cache:
+            args += [cache[0], cache[1]]
+            o, k_new, v_new = apply_op("fused_multi_head_attention", fn, args)
+            return o, (k_new.detach(), v_new.detach())
+        return apply_op("fused_multi_head_attention", fn, args)
+
+
+class FusedFeedForward(Layer):
+    """Fused FFN block (fused_transformer.py:497)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, name=None):
+        super().__init__()
+        self.d_model, self.dim_feedforward = d_model, dim_feedforward
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = dropout_rate if act_dropout_rate is None \
+            else act_dropout_rate
+        self.normalize_before = normalize_before
+        self._epsilon = epsilon
+        self._act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[activation]
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], default_initializer=I.XavierUniform())
+        self.linear1_bias = self.create_parameter([dim_feedforward], is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], default_initializer=I.XavierUniform())
+        self.linear2_bias = self.create_parameter([d_model], is_bias=True)
+        self.ln1_scale = self.create_parameter(
+            [d_model], default_initializer=I.Constant(1.0))
+        self.ln1_bias = self.create_parameter([d_model], is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            [d_model], default_initializer=I.Constant(1.0))
+        self.ln2_bias = self.create_parameter([d_model], is_bias=True)
+
+    def forward(self, src, cache=None):
+        eps = self._epsilon
+        act = self._act
+        pre = self.normalize_before
+        p_act = self.act_dropout_rate if self.training else 0.0
+        p_out = self.dropout_rate if self.training else 0.0
+        k_act = _random.split_key() if p_act else None
+        k_out = _random.split_key() if p_out else None
+
+        def fn(x, w1, b1, w2, b2, s1, bb1, s2, bb2):
+            residual = x
+            if pre:
+                x = _ln(x, s1, bb1, eps)
+            h = _drop(act(x @ w1 + b1), p_act, k_act)
+            y = _drop(h @ w2 + b2, p_out, k_out)
+            y = residual + y
+            if not pre:
+                y = _ln(y, s2, bb2, eps)
+            return y
+
+        return apply_op("fused_feedforward", fn, [
+            src, self.linear1_weight, self.linear1_bias, self.linear2_weight,
+            self.linear2_bias, self.ln1_scale, self.ln1_bias,
+            self.ln2_scale, self.ln2_bias])
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Attention + FFN encoder layer (fused_transformer.py:725)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, name=None):
+        super().__init__()
+        ad = dropout_rate if attn_dropout_rate is None else attn_dropout_rate
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate, attn_dropout_rate=ad,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        if cache is not None:
+            o, new_cache = self.fused_attn(src, attn_mask=src_mask, cache=cache)
+            return self.ffn(o), new_cache
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedMultiTransformer(Layer):
+    """Inference-oriented stacked transformer (fused_transformer.py:1021):
+    N identical pre-LN layers executed in one module, the TPU analog of
+    fused_multi_transformer_op.cu."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
+                 activation="gelu", normalize_before=True, num_layers=1,
+                 epsilon=1e-5, name=None):
+        super().__init__()
+        self.layers = [
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                attn_dropout_rate=dropout_rate, act_dropout_rate=dropout_rate,
+                normalize_before=normalize_before)
+            for _ in range(num_layers)]
+        for i, l in enumerate(self.layers):
+            self.add_sublayer(f"layer_{i}", l)
+
+    def forward(self, src, attn_mask=None, caches=None):
+        """caches: optional list of per-layer (k, v) Tensors; returns
+        (out, new_caches) when given — incremental decode attends over the
+        accumulated sequence (fused_multi_transformer_op CacheKV contract)."""
+        x = src
+        if caches is not None:
+            new_caches = []
+            for l, c in zip(self.layers, caches):
+                x, nc = l(x, src_mask=attn_mask, cache=c)
+                new_caches.append(nc)
+            return x, new_caches
+        for l in self.layers:
+            x = l(x, src_mask=attn_mask)
+        return x
+
+
+class FusedEcMoe(Layer):
+    """Fused expert-computation MoE (reference: incubate/nn/layer/
+    fused_ec_moe.py) — thin facade over the expert-parallel MoELayer."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type="gelu",
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[act_type]
+        from ..distributed.models.moe import MoELayer
+        self.moe = MoELayer(hidden_size, inter_size, num_experts,
+                            gate="gshard", activation=act)
+
+    def forward(self, x, gate_logits=None):
+        return self.moe(x, gate_logits=gate_logits)

@@ -1,0 +1,5 @@
+"""Incubating optimizers (reference: python/paddle/incubate/optimizer/):
+LBFGS (lbfgs.py) and DistributedFusedLamb (distributed_fused_lamb.py:82).
+"""
+from .lbfgs import LBFGS  # noqa: F401
+from .distributed_fused_lamb import DistributedFusedLamb  # noqa: F401

@@ -1,0 +1,118 @@
+"""L-BFGS optimizer (reference: python/paddle/incubate/optimizer/lbfgs.py).
+
+Closure-style API like the reference: `opt.step(closure)` re-evaluates the
+loss as the line search probes points. History (s, y, rho) is kept as jax
+arrays on device; the two-loop recursion is plain Python over the (small)
+history so XLA sees only vector ops.
+"""
+from __future__ import annotations
+
+from typing import Callable, List
+
+import jax.numpy as jnp
+
+
+def _flat_params(params):
+    return jnp.concatenate([p._data.reshape(-1) for p in params])
+
+
+def _flat_grads(params):
+    return jnp.concatenate([
+        (p.grad._data if p.grad is not None else jnp.zeros(p._data.size,
+                                                           p._data.dtype)).reshape(-1)
+        for p in params])
+
+
+def _assign(params, flat):
+    off = 0
+    for p in params:
+        n = p._data.size
+        p.set_value(flat[off:off + n].reshape(p._data.shape))
+        off += n
+
+
+class LBFGS:
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        assert parameters is not None, "LBFGS requires parameters"
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError(
+                f"unsupported line_search_fn {line_search_fn!r}; expected "
+                "'strong_wolfe' or None (reference contract, lbfgs.py)")
+        self._params = list(parameters)
+        self.lr = learning_rate
+        self.max_iter = max_iter
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s: List = []
+        self._y: List = []
+        self._rho: List = []
+        self._prev_flat_grad = None
+
+    def clear_grad(self):
+        for p in self._params:
+            p.clear_grad()
+
+    def _direction(self, g):
+        q = g
+        alphas = []
+        for s, y, rho in zip(reversed(self._s), reversed(self._y),
+                             reversed(self._rho)):
+            a = rho * jnp.dot(s, q)
+            alphas.append(a)
+            q = q - a * y
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            gamma = jnp.dot(s, y) / jnp.maximum(jnp.dot(y, y), 1e-20)
+            q = q * gamma
+        for (s, y, rho), a in zip(zip(self._s, self._y, self._rho),
+                                  reversed(alphas)):
+            b = rho * jnp.dot(y, q)
+            q = q + s * (a - b)
+        return -q
+
+    def step(self, closure: Callable):
+        """closure() -> loss Tensor; must call backward itself (reference
+        contract: lbfgs.py step(closure))."""
+        loss = closure()
+        flat_g = _flat_grads(self._params)
+        if float(jnp.max(jnp.abs(flat_g))) <= self.tol_grad:
+            return loss
+        x0 = _flat_params(self._params)
+
+        for _ in range(self.max_iter):
+            d = self._direction(flat_g)
+            t = self.lr
+            if self.line_search_fn == "strong_wolfe":
+                # backtracking with sufficient-decrease (Armijo) condition
+                f0 = float(loss)
+                g_dot_d = float(jnp.dot(flat_g, d))
+                for _ls in range(20):
+                    _assign(self._params, x0 + t * d)
+                    self.clear_grad()
+                    loss = closure()
+                    if float(loss) <= f0 + 1e-4 * t * g_dot_d:
+                        break
+                    t *= 0.5
+            else:  # None: fixed step, like the reference default
+                _assign(self._params, x0 + t * d)
+                self.clear_grad()
+                loss = closure()
+            new_g = _flat_grads(self._params)
+            x1 = _flat_params(self._params)
+            s, y = x1 - x0, new_g - flat_g
+            ys = float(jnp.dot(y, s))
+            if ys > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                self._rho.append(1.0 / ys)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0); self._y.pop(0); self._rho.pop(0)
+            if float(jnp.max(jnp.abs(x1 - x0))) < self.tol_change:
+                break
+            x0, flat_g = x1, new_g
+        return loss

@@ -1,0 +1,253 @@
+"""MoE expert parallelism + incubate fused layers / optimizers.
+
+Reference surfaces: incubate/distributed/models/moe/moe_layer.py:260 (gates
+naive/gshard/switch), incubate/nn/layer/fused_transformer.py, lbfgs.py.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.incubate.distributed.models.moe import (
+    MoELayer, NaiveGate, GShardGate, SwitchGate)
+from paddle_tpu.incubate.nn import (
+    FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
+    FusedMultiTransformer)
+from paddle_tpu.incubate.nn.functional import (
+    fused_matmul_bias, fused_bias_dropout_residual_layer_norm)
+from paddle_tpu.incubate.optimizer import LBFGS, DistributedFusedLamb
+
+
+def _x(b=2, s=8, m=16, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randn(b, s, m).astype("float32"))
+
+
+class TestMoE:
+    @pytest.mark.parametrize("gate", ["naive", "gshard", "switch"])
+    def test_forward_shape_and_aux(self, gate):
+        layer = MoELayer(16, 32, num_experts=4, gate=gate)
+        y = layer(_x())
+        assert tuple(y.shape) == (2, 8, 16)
+        assert layer.aux_loss is not None
+        aux = float(layer.aux_loss)
+        assert np.isfinite(aux)
+        if gate == "naive":
+            assert aux == 0.0
+        else:
+            assert aux > 0.0
+
+    def test_gate_objects(self):
+        for g in (NaiveGate(), GShardGate(), SwitchGate()):
+            layer = MoELayer(8, 16, num_experts=2, gate=g)
+            assert layer.gate_type == g.gate_type
+            assert layer.top_k == g.top_k
+
+    def test_backward_flows_to_experts_and_gate(self):
+        layer = MoELayer(16, 32, num_experts=4, gate="gshard",
+                         capacity_factor=4.0)
+        y = layer(_x())
+        loss = paddle.mean(y * y) + 0.01 * layer.aux_loss
+        loss.backward()
+        assert layer.w1.grad is not None
+        assert float(paddle.abs(layer.gate_weight.grad).sum()) > 0.0
+
+    def test_switch_router_learns_from_task_loss(self):
+        # top-1 combine weight must carry the raw router prob, so the task
+        # loss (no aux term) reaches gate_weight
+        layer = MoELayer(16, 32, num_experts=4, gate="switch",
+                         capacity_factor=4.0)
+        y = layer(_x())
+        paddle.mean(y * y).backward()
+        assert float(paddle.abs(layer.gate_weight.grad).sum()) > 0.0
+
+    def test_external_gate_logits_change_routing(self):
+        paddle.seed(0)
+        layer = MoELayer(8, 16, num_experts=4, gate="switch",
+                         capacity_factor=4.0)
+        x = _x(m=8)
+        base = np.asarray(layer(x)._data)
+        # force all tokens to expert 2
+        gl = np.full((2, 8, 4), -1e9, np.float32)
+        gl[:, :, 2] = 0.0
+        forced = np.asarray(layer(x, gate_logits=paddle.to_tensor(gl))._data)
+        assert not np.allclose(base, forced)
+
+    def test_ep_mesh_parity_with_single_device(self):
+        paddle.seed(0)
+        layer = MoELayer(16, 32, num_experts=4, gate="gshard",
+                         capacity_factor=4.0)
+        x = _x()
+        want = np.asarray(layer(x)._data)
+
+        from paddle_tpu.jit.api import _trace_guard, _swap_params
+        from paddle_tpu.core import autograd as ag
+        params = [p for _, p in layer.named_parameters()]
+
+        def fn(arrs, xv):
+            with _trace_guard(), _swap_params(params, list(arrs)), ag.no_grad():
+                return layer(paddle.Tensor(xv))._data
+
+        mesh = dist.build_mesh({"dp": 2, "ep": 4})
+        dist.set_mesh(mesh)
+        try:
+            with mesh:
+                got = np.asarray(jax.jit(fn)(
+                    tuple(p._data for p in params), x._data))
+        finally:
+            dist.set_mesh(None)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    def test_moe_model_trains_under_trainstep(self):
+        from paddle_tpu.jit.train_step import TrainStep
+
+        paddle.seed(0)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.inp = nn.Linear(8, 16)
+                self.moe = MoELayer(16, 32, num_experts=4, gate="switch",
+                                    capacity_factor=4.0)
+                self.out = nn.Linear(16, 1)
+
+            def forward(self, x):
+                return self.out(self.moe(self.inp(x)))
+
+        net = Net()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=net.parameters())
+
+        def loss_fn(x, y):
+            pred = net(x)
+            return nn.MSELoss()(pred, y) + 0.01 * net.moe.aux_loss
+
+        step = TrainStep(net, opt, loss_fn)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 8, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randn(4, 8, 1).astype("float32"))
+        losses = [float(step(x, y)) for _ in range(8)]
+        assert losses[-1] < losses[0]
+
+
+class TestFusedLayers:
+    def test_fused_mha_shape_and_eval_determinism(self):
+        m = FusedMultiHeadAttention(16, 4, dropout_rate=0.1,
+                                    attn_dropout_rate=0.1)
+        m.eval()
+        x = _x()
+        a = np.asarray(m(x)._data)
+        b = np.asarray(m(x)._data)
+        assert a.shape == (2, 8, 16)
+        np.testing.assert_array_equal(a, b)
+
+    def test_fused_ffn_matches_manual(self):
+        m = FusedFeedForward(16, 32, dropout_rate=0.0)
+        m.eval()
+        x = _x()
+        got = np.asarray(m(x)._data)
+        xv = x._data
+        h = jax.nn.relu(xv @ m.linear1_weight._data + m.linear1_bias._data)
+        y = h @ m.linear2_weight._data + m.linear2_bias._data
+        y = xv + y
+        mu = jnp.mean(y, -1, keepdims=True)
+        var = jnp.var(y, -1, keepdims=True)
+        want = (y - mu) * jax.lax.rsqrt(var + 1e-5) * m.ln2_scale._data \
+            + m.ln2_bias._data
+        np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5, atol=1e-5)
+
+    def test_encoder_layer_and_stack_train(self):
+        enc = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+        y = enc(_x())
+        loss = paddle.mean(y * y)
+        loss.backward()
+        assert enc.fused_attn.qkv_weight.grad is not None
+
+        stack = FusedMultiTransformer(16, 4, 32, num_layers=2)
+        stack.eval()
+        assert tuple(stack(_x()).shape) == (2, 8, 16)
+
+    def test_multi_transformer_cached_decode_matches_full(self):
+        paddle.seed(0)
+        stack = FusedMultiTransformer(16, 4, 32, num_layers=2)
+        stack.eval()
+        x = _x(s=6)
+        full = np.asarray(stack(x)._data)
+        # decode chunk-by-chunk with caches; last chunk must match the
+        # full forward's tail (non-causal attention over the accumulated seq
+        # differs from full bidirectional attention, so compare via a causal
+        # equivalence: feed the whole prefix as the first chunk)
+        caches = [(paddle.to_tensor(np.zeros((2, 0, 4, 4), np.float32)),
+                   paddle.to_tensor(np.zeros((2, 0, 4, 4), np.float32)))
+                  for _ in range(2)]
+        out, caches = stack(x, caches=caches)
+        np.testing.assert_allclose(np.asarray(out._data), full,
+                                   rtol=1e-5, atol=1e-5)
+        assert caches[0][0].shape[1] == 6  # cache accumulated
+
+    def test_lbfgs_rejects_bad_line_search(self):
+        p = paddle.Parameter(jnp.zeros((2,), jnp.float32))
+        with pytest.raises(ValueError):
+            LBFGS(parameters=[p], line_search_fn="wolfe")
+
+    def test_fused_matmul_bias(self):
+        rng = np.random.RandomState(0)
+        a = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+        w = paddle.to_tensor(rng.randn(8, 3).astype("float32"))
+        b = paddle.to_tensor(rng.randn(3).astype("float32"))
+        got = np.asarray(fused_matmul_bias(a, w, b)._data)
+        want = np.asarray(a._data @ w._data + b._data)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_fused_bias_dropout_residual_ln(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 4, 8).astype("float32"))
+        r = paddle.to_tensor(rng.randn(2, 4, 8).astype("float32"))
+        out = fused_bias_dropout_residual_layer_norm(
+            x, r, dropout_rate=0.0, training=False)
+        y = x._data + r._data
+        mu = jnp.mean(y, -1, keepdims=True)
+        var = jnp.var(y, -1, keepdims=True)
+        want = (y - mu) * jax.lax.rsqrt(var + 1e-5)
+        np.testing.assert_allclose(np.asarray(out._data), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestIncubateOptimizers:
+    def test_lbfgs_minimizes_quadratic(self):
+        paddle.seed(0)
+        w = paddle.to_tensor(np.array([3.0, -2.0], np.float32))
+        w.stop_gradient = False
+        p = paddle.Parameter(w._data)
+        target = jnp.asarray([1.0, 1.0], jnp.float32)
+        opt = LBFGS(learning_rate=1.0, max_iter=10, parameters=[p],
+                    line_search_fn="strong_wolfe")
+
+        def closure():
+            opt.clear_grad()
+            diff = p - paddle.Tensor(target)
+            loss = paddle.sum(diff * diff)
+            loss.backward()
+            return loss
+
+        loss = opt.step(closure)
+        assert float(loss) < 1e-6
+        np.testing.assert_allclose(np.asarray(p._data), [1.0, 1.0], atol=1e-3)
+
+    def test_distributed_fused_lamb_trains(self):
+        paddle.seed(0)
+        model = nn.Linear(4, 2)
+        opt = DistributedFusedLamb(learning_rate=0.1,
+                                   parameters=model.parameters())
+        x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4).astype("float32"))
+        losses = []
+        for _ in range(5):
+            loss = paddle.mean(model(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
