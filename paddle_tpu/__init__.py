@@ -51,6 +51,8 @@ from . import incubate  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402
 from .framework.io import save, load  # noqa: F401,E402
 from .tensor import tensor as _tensor_ns  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from .static.program import enable_static, disable_static  # noqa: F401,E402
 
 
 def is_compiled_with_cuda() -> bool:
@@ -71,7 +73,8 @@ def is_compiled_with_tpu() -> bool:
 
 def in_dynamic_mode() -> bool:
     from .jit.api import _in_jit_trace
-    return not _in_jit_trace()
+    from .static.program import in_static_mode
+    return not _in_jit_trace() and not in_static_mode()
 
 
 def set_device(device: str):

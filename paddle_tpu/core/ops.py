@@ -36,6 +36,11 @@ def _arr(x):
 
 def _nodiff(fn, *args, **kw):
     """Run a non-differentiable op without tape recording."""
+    from .tensor import _static_record
+    if _static_record is not None:
+        res = _static_record(getattr(fn, "__name__", "op"), fn, list(args), kw, None)
+        if res is not NotImplemented:
+            return res
     out = fn(*[_arr(a) for a in args], **kw)
     if isinstance(out, (tuple, list)):
         return tuple(Tensor(o) for o in out)

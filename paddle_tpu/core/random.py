@@ -74,6 +74,24 @@ def _original_split_key():
     return sub
 
 
+# installed by paddle_tpu.static: returns a symbolic per-run key Variable
+# while a static Program is recording, else None
+_op_key_hook = None
+
+
+def op_key():
+    """Key for randomness *inside op implementations* that thread the key
+    through apply_op as an input (dropout et al). In static graph mode this
+    yields a symbolic key Variable fed fresh by the Executor every run — the
+    analog of the reference plumbing a seed tensor into dropout kernels — so
+    recorded programs don't freeze their masks at build time."""
+    if _op_key_hook is not None:
+        k = _op_key_hook()
+        if k is not None:
+            return k
+    return split_key()
+
+
 def split_key():
     """Return a fresh subkey — from the trace scope if active, else the
     global eager stream."""

@@ -199,6 +199,10 @@ def _wrap_scalar(x, like=None):
 
 
 _amp_cast = None  # installed lazily by paddle_tpu.amp to avoid an import cycle
+# installed by paddle_tpu.static: diverts op dispatch into Program recording
+# when static mode is on and an input is a static Variable (returns
+# NotImplemented to fall through to eager execution)
+_static_record = None
 
 
 def _install_amp_hook():
@@ -220,6 +224,10 @@ def apply_op(name, fn, tensor_args, static_kwargs=None, n_outputs=None):
     policies instead of per-op rewrite).
     """
     static_kwargs = static_kwargs or {}
+    if _static_record is not None:
+        res = _static_record(name, fn, tensor_args, static_kwargs, n_outputs)
+        if res is not NotImplemented:
+            return res
     arrays = []
     diff_mask = []
     for a in tensor_args:

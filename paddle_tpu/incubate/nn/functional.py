@@ -61,13 +61,15 @@ def fused_bias_dropout_residual_layer_norm(
         dropout_rate=0.5, ln_epsilon=1e-5, training=True, name=None):
     """Reference: fused_bias_dropout_residual_layer_norm op
     (operators/fused/fused_bias_dropout_residual_layer_norm_op.cu)."""
-    key = _random.split_key() if (dropout_rate > 0.0 and training) else None
+    has_key = dropout_rate > 0.0 and training
 
     def fn(xv, res, *rest):
+        rest = list(rest)
+        key = rest.pop() if has_key else None
         i = 0
         if bias is not None:
             xv = xv + rest[i]; i += 1
-        xv = _drop(xv, dropout_rate if key is not None else 0.0, key)
+        xv = _drop(xv, dropout_rate if has_key else 0.0, key)
         y = xv + res
         scale = rest[i] if ln_scale is not None else None
         i += ln_scale is not None
@@ -76,4 +78,6 @@ def fused_bias_dropout_residual_layer_norm(
 
     args = [x, residual] + [t for t in (bias, ln_scale, ln_bias)
                             if t is not None]
+    if has_key:
+        args.append(_random.op_key())
     return apply_op("fused_bias_dropout_residual_ln", fn, args)

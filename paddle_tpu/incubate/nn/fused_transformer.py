@@ -56,16 +56,28 @@ class FusedMultiHeadAttention(Layer):
         """cache: optional (k_past, v_past) Tensors [B, S_past, H, D] for
         incremental decode; returns (out, (k_new, v_new)) when given
         (reference Cache contract, fused_transformer.py:192)."""
+        if (key is not None and key is not query) or \
+                (value is not None and value is not query):
+            raise NotImplementedError(
+                "FusedMultiHeadAttention computes self-attention from the "
+                "fused qkv projection (reference fused_attention_op semantics); "
+                "cross-attention with distinct key/value is not supported — "
+                "use nn.MultiHeadAttention")
         nh, hd, eps = self.num_heads, self.head_dim, self._epsilon
         attn_p = self.attn_dropout_rate if self.training else 0.0
         out_p = self.dropout_rate if self.training else 0.0
-        k_attn = _random.split_key() if attn_p else None
-        k_out = _random.split_key() if out_p else None
         pre = self.normalize_before
         mask = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
         with_cache = cache is not None
+        # dropout keys ride through apply_op as inputs (op_key → symbolic
+        # under static recording: fresh mask every Executor.run)
+        has_ka, has_ko = bool(attn_p), bool(out_p)
 
-        def fn(x, qkv_w, qkv_b, lw, lb, pls, plb, lns, lnb, *past):
+        def fn(x, qkv_w, qkv_b, lw, lb, pls, plb, lns, lnb, *rest):
+            rest = list(rest)
+            k_attn = rest.pop(0) if has_ka else None
+            k_out = rest.pop(0) if has_ko else None
+            past = rest
             residual = x
             if pre:
                 x = _ln(x, pls, plb, eps)
@@ -89,6 +101,10 @@ class FusedMultiHeadAttention(Layer):
         args = [query, self.qkv_weight, self.qkv_bias, self.linear_weight,
                 self.linear_bias, self.pre_ln_scale, self.pre_ln_bias,
                 self.ln_scale, self.ln_bias]
+        if has_ka:
+            args.append(_random.op_key())
+        if has_ko:
+            args.append(_random.op_key())
         if with_cache:
             args += [cache[0], cache[1]]
             o, k_new, v_new = apply_op("fused_multi_head_attention", fn, args)
@@ -129,10 +145,12 @@ class FusedFeedForward(Layer):
         pre = self.normalize_before
         p_act = self.act_dropout_rate if self.training else 0.0
         p_out = self.dropout_rate if self.training else 0.0
-        k_act = _random.split_key() if p_act else None
-        k_out = _random.split_key() if p_out else None
+        has_ka, has_ko = bool(p_act), bool(p_out)
 
-        def fn(x, w1, b1, w2, b2, s1, bb1, s2, bb2):
+        def fn(x, w1, b1, w2, b2, s1, bb1, s2, bb2, *keys):
+            keys = list(keys)
+            k_act = keys.pop(0) if has_ka else None
+            k_out = keys.pop(0) if has_ko else None
             residual = x
             if pre:
                 x = _ln(x, s1, bb1, eps)
@@ -143,10 +161,14 @@ class FusedFeedForward(Layer):
                 y = _ln(y, s2, bb2, eps)
             return y
 
-        return apply_op("fused_feedforward", fn, [
-            src, self.linear1_weight, self.linear1_bias, self.linear2_weight,
-            self.linear2_bias, self.ln1_scale, self.ln1_bias,
-            self.ln2_scale, self.ln2_bias])
+        args = [src, self.linear1_weight, self.linear1_bias, self.linear2_weight,
+                self.linear2_bias, self.ln1_scale, self.ln1_bias,
+                self.ln2_scale, self.ln2_bias]
+        if has_ka:
+            args.append(_random.op_key())
+        if has_ko:
+            args.append(_random.op_key())
+        return apply_op("fused_feedforward", fn, args)
 
 
 class FusedTransformerEncoderLayer(Layer):

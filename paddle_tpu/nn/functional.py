@@ -437,19 +437,20 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
         return x
     if p == 1.0:
         return apply_op("dropout", lambda a: jnp.zeros_like(a), [x])
-    shape = tuple(_arr(x).shape)
-    if axis is not None:
-        axes = (axis,) if isinstance(axis, int) else tuple(axis)
-        mshape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
-    else:
-        mshape = shape
-    keep = jax.random.bernoulli(_random.split_key(), 1.0 - p, mshape)
+    axes = None if axis is None else ((axis,) if isinstance(axis, int) else tuple(axis))
+    key = _random.op_key()  # symbolic per-run key under static recording
 
-    def fn(a):
+    def fn(a, k):
+        # mask shape derived from the runtime array (not the build-time
+        # shape): under static mode with a -1 batch dim the recorded shape is
+        # a placeholder, and the mask must still be per-row independent
+        mshape = (a.shape if axes is None
+                  else tuple(s if i in axes else 1 for i, s in enumerate(a.shape)))
+        keep = jax.random.bernoulli(k, 1.0 - p, mshape)
         if mode == "upscale_in_train":
             return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
         return jnp.where(keep, a, 0.0).astype(a.dtype)
-    return apply_op("dropout", fn, [x])
+    return apply_op("dropout", fn, [x, key])
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -468,14 +469,15 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     neg_sat = -alpha * scale
-    keep = jax.random.bernoulli(_random.split_key(), 1.0 - p, tuple(_arr(x).shape))
     a_coef = (1.0 / math.sqrt((1 - p) * (1 + p * neg_sat ** 2)))
     b_coef = -a_coef * p * neg_sat
+    key = _random.op_key()
 
-    def fn(a):
+    def fn(a, k):
+        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
         out = jnp.where(keep, a, neg_sat)
         return (a_coef * out + b_coef).astype(a.dtype)
-    return apply_op("alpha_dropout", fn, [x])
+    return apply_op("alpha_dropout", fn, [x, key])
 
 
 # ----------------------------------------------------------------- norms

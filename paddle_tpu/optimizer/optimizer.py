@@ -129,6 +129,17 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static.program import Variable as _StaticVar
+        if isinstance(loss, _StaticVar):
+            # static-graph path (reference: Optimizer.minimize appends
+            # backward + update ops to the program; here the Executor fuses
+            # grads + this optimizer's pure `update` rule into the jitted
+            # replay — see static/executor.py)
+            from ..static.program import append_backward, default_main_program
+            pairs = append_backward(loss, parameter_list=parameters)
+            prog = loss.program or default_main_program()
+            prog._optimizer = self
+            return None, pairs
         loss.backward()
         self.step()
         self.clear_grad()

@@ -1,0 +1,52 @@
+"""paddle.static analog — static graph capability, TPU-native.
+
+Reference surface: python/paddle/static/ (SURVEY §2.3: Executor,
+CompiledProgram, Program/program_guard, data, append_backward/gradients,
+save/load_inference_model, static nn layers). Design per SURVEY §7: the
+Program is a recorded op-DAG replayed as ONE jitted XLA computation — the
+InterpreterCore/instruction machinery of the reference
+(new_executor/interpretercore.cc) is replaced by the XLA scheduler.
+"""
+from .program import (  # noqa: F401
+    Program, Variable, program_guard, default_main_program,
+    default_startup_program, data, append_backward, gradients,
+    in_static_mode, enable_static, disable_static,
+)
+from .executor import Executor, CompiledProgram, Scope, global_scope  # noqa: F401
+from .io import (  # noqa: F401
+    save_inference_model, load_inference_model, save, load, normalize_program,
+)
+from . import nn  # noqa: F401
+
+InputSpec = None  # populated lazily below to avoid import cycle
+
+
+def _late_imports():
+    global InputSpec
+    from ..jit.api import InputSpec as _I
+    InputSpec = _I
+
+
+try:
+    _late_imports()
+except Exception:
+    pass
+
+
+class BuildStrategy:
+    """Compat shim (reference: fluid/compiler.py BuildStrategy): every knob it
+    exposes (fusion, memory reuse, reduce strategy) is an XLA decision here."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+
+
+def name_scope(prefix=None):
+    import contextlib
+    return contextlib.nullcontext()

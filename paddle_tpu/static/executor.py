@@ -1,0 +1,280 @@
+"""Static Executor — replays a recorded Program as one jitted XLA program.
+
+TPU-native redesign of the reference's executor stack (SURVEY §3.2):
+Executor.run → _ExecutorCache → StandaloneExecutor → InterpreterCore
+(python/paddle/fluid/executor.py:921,1387,750; interpretercore.cc). The
+reference builds instruction lists, a dependency graph, stream-event
+insertion and an async workqueue to extract cross-op parallelism at run time;
+under XLA all of that is the compiler's job — the whole program (forward,
+backward via jax.value_and_grad, optimizer update) lowers to ONE fused HLO
+module with buffer donation, and the "executor cache" is a dict keyed by
+(program version, feed shapes, fetch list), mirroring _ExecutorCache
+(executor.py:750) keyed on (program, feed, fetch).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import random as random_mod
+from ..core.tensor import Tensor, Parameter
+from .program import Program, Variable, default_main_program
+
+
+class _ScopeVar:
+    def __init__(self, name, ref):
+        self.name = name
+        self._ref = ref
+
+    def get_tensor(self):
+        return self._ref
+
+
+class Scope:
+    """Name → persistent tensor map (reference: framework/scope.h:49; here
+    parameters already live on-device as jax.Arrays inside Parameter objects,
+    so the scope is a name index, not an owner)."""
+
+    def __init__(self):
+        self._vars: Dict[str, Tensor] = {}
+
+    def find_var(self, name) -> Optional[_ScopeVar]:
+        t = self._vars.get(name)
+        return _ScopeVar(name, t) if t is not None else None
+
+    def var_names(self):
+        return list(self._vars)
+
+    def _register(self, name, t):
+        if name:
+            self._vars[name] = t
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+class CompiledProgram:
+    """API-parity shim (reference: fluid/compiler.py CompiledProgram). All
+    programs compile through XLA here, so this only tags fetch/build options."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self.program = program_or_graph
+        self.build_strategy = build_strategy
+
+    def with_data_parallel(self, *a, **kw):  # legacy PE path: XLA shards instead
+        return self
+
+
+class Executor:
+    """reference: paddle.static.Executor (fluid/executor.py:921)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+        self._opt_state: Dict[int, list] = {}
+        self._step_i: Dict[int, int] = {}
+
+    # ------------------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True,
+            scope=None, **kw):
+        if isinstance(program, CompiledProgram):
+            program = program.program
+        prog: Program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+
+        for p in prog._params:
+            _global_scope._register(p.name, p)
+
+        # startup programs / empty mains: initializers already ran eagerly
+        if not prog._nodes:
+            return []
+
+        fetch_vids = tuple(self._fetch_vid(prog, f) for f in fetch_list)
+        train = prog._optimizer is not None and prog._loss_vid is not None
+
+        feed_arrays = []
+        feed_sig = []
+        for v in prog._feed_vars:
+            if v.feed_name not in feed:
+                raise KeyError(f"missing feed {v.feed_name!r}")
+            arr = feed[v.feed_name]
+            arr = arr._data if isinstance(arr, Tensor) else jnp.asarray(
+                np.asarray(arr), dtype=v._data.dtype)
+            feed_arrays.append(arr)
+            feed_sig.append((tuple(arr.shape), str(arr.dtype)))
+
+        diff_params = [p for p in prog._params if not p.stop_gradient
+                       and np.issubdtype(np.dtype(p._data.dtype), np.floating)]
+        _diff_ids = {id(p) for p in diff_params}
+        const_params = [p for p in prog._params if id(p) not in _diff_ids]
+
+        # cache key includes the trainable partition: freezing a parameter
+        # between runs must trigger a rebuild, not bind wrong slots
+        part_sig = tuple(id(p) in _diff_ids for p in prog._params)
+        key = (prog.id, prog._version, tuple(feed_sig), fetch_vids, train, part_sig)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build(prog, fetch_vids, train)
+            self._cache[key] = fn
+        keys = tuple(random_mod.split_key() for _ in prog._key_vars)
+
+        if train:
+            opt = prog._optimizer
+            if prog.id not in self._opt_state:
+                self._opt_state[prog.id] = [opt.init_state(p._data) for p in diff_params]
+            self._step_i[prog.id] = self._step_i.get(prog.id, 0) + 1
+            fetches, new_params, new_state = fn(
+                tuple(p._data for p in diff_params),
+                tuple(p._data for p in const_params),
+                tuple(self._opt_state[prog.id]),
+                jnp.float32(opt.get_lr()), jnp.int32(self._step_i[prog.id]),
+                keys, *feed_arrays)
+            for p, na in zip(diff_params, new_params):
+                p._data = na
+                p._node = None
+            self._opt_state[prog.id] = list(new_state)
+        else:
+            fetches = fn(tuple(p._data for p in diff_params),
+                         tuple(p._data for p in const_params),
+                         keys, *feed_arrays)
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    # ------------------------------------------------------------------
+    def _fetch_vid(self, prog, f):
+        if isinstance(f, Variable):
+            return f.vid
+        if isinstance(f, str):
+            return prog.global_block().var(f).vid
+        raise TypeError(f"fetch_list entries must be Variable or name, got {type(f)}")
+
+    def _build(self, prog, fetch_vids, train, feed_vars=None):
+        # backward-slice the op list to the ancestors of what we actually
+        # compute (the reference's Prune pass over ProgramDesc —
+        # framework/prune.cc — done here as a reverse walk over the DAG)
+        targets = set(fetch_vids)
+        if train and prog._loss_vid is not None:
+            targets.add(prog._loss_vid)
+        for tvid, xvid, _ in prog._var_grads:
+            targets.add(tvid)
+            targets.add(xvid)  # grad point may be an intermediate; keep its producer
+        needed = set(targets)
+        kept = []
+        for node in reversed(prog._nodes):
+            if any(v in needed for v in node.out_vids):
+                kept.append(node)
+                for kind, ref in node.inputs:
+                    if kind == "v":
+                        needed.add(ref)
+        nodes = list(reversed(kept))
+        # the compiled fn accepts feeds positionally in this exact order;
+        # callers (run / save_inference_model) pass the same list
+        feed_list = list(feed_vars) if feed_vars is not None else list(prog._feed_vars)
+        missing = needed - {v.vid for v in feed_list} - {
+            vid for n in nodes for vid in n.out_vids} - {
+            v.vid for v in prog._key_vars} - set(
+            prog._grad_of.values()) - {g for _, _, g in prog._var_grads}
+        if missing:
+            names = [prog._vars[m].name for m in sorted(missing) if m in prog._vars]
+            raise KeyError(f"program needs feeds not provided: {names}")
+        feed_vids = [v.vid for v in feed_list]
+        key_vids = [v.vid for v in prog._key_vars]
+        diff_params = [p for p in prog._params if not p.stop_gradient
+                       and np.issubdtype(np.dtype(p._data.dtype), np.floating)]
+        diff_idx = {id(p): i for i, p in enumerate(diff_params)}
+        const_params = [p for p in prog._params if id(p) not in diff_idx]
+        const_idx = {id(p): i for i, p in enumerate(const_params)}
+        param_slot = []  # program param index -> ("d"/"k", position)
+        for p in prog._params:
+            if id(p) in diff_idx:
+                param_slot.append(("d", diff_idx[id(p)]))
+            else:
+                param_slot.append(("k", const_idx[id(p)]))
+        loss_vid = prog._loss_vid
+        grad_of = dict(prog._grad_of)   # program param index -> grad vid
+        var_grads = list(prog._var_grads)
+        opt = prog._optimizer
+        wds = [opt._wd_for(p) for p in diff_params] if opt is not None else None
+        grad_clip = getattr(opt, "_grad_clip", None) if opt is not None else None
+
+        def replay(dpa, kpa, keys, feeds, var_override=None):
+            # var_override: {vid: array} — value substituted for that
+            # variable wherever it would be bound (feed or op output); used
+            # to differentiate a target wrt an arbitrary graph variable
+            env = {}
+            var_override = var_override or {}
+            for vid, a in zip(feed_vids, feeds):
+                env[vid] = var_override.get(vid, a)
+            for vid, k in zip(key_vids, keys):
+                env[vid] = k
+            for node in nodes:
+                args = []
+                for kind, ref in node.inputs:
+                    if kind == "v":
+                        args.append(env[ref])
+                    elif kind == "p":
+                        tag, pos = param_slot[ref]
+                        args.append(dpa[pos] if tag == "d" else kpa[pos])
+                    else:
+                        args.append(ref)
+                out = node.fn(*args, **node.kwargs)
+                if node.multi:
+                    for ov, o in zip(node.out_vids, out):
+                        env[ov] = var_override.get(ov, o)
+                else:
+                    ov = node.out_vids[0]
+                    env[ov] = var_override.get(ov, out)
+            return env
+
+        def eval_var_grads(env, dpa, kpa, keys, feeds):
+            # static.gradients() outputs: d(sum target)/d(var), computed by
+            # re-replaying with the variable's value as the point of
+            # differentiation (works for feeds and intermediates alike)
+            for tvid, xvid, gvid in var_grads:
+                def tgt(xa, _x=xvid, _t=tvid):
+                    env2 = replay(dpa, kpa, keys, feeds, var_override={_x: xa})
+                    return jnp.sum(env2[_t].astype(jnp.float32))
+                env[gvid] = jax.grad(tgt)(env[xvid])
+
+        if train:
+            def step(dpa, kpa, opt_state, lr, step_i, keys, *feeds):
+                def loss_fn(pa):
+                    env = replay(pa, kpa, keys, feeds)
+                    return env[loss_vid].astype(jnp.float32), env
+                (_, env), grads = jax.value_and_grad(loss_fn, has_aux=True)(list(dpa))
+                for pidx, gvid in grad_of.items():
+                    tag, pos = param_slot[pidx]
+                    if tag == "d":
+                        env[gvid] = grads[pos]
+                if grad_clip is not None and type(grad_clip).__name__ == "ClipGradByGlobalNorm":
+                    total = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                                         for g in grads))
+                    scale = jnp.minimum(1.0, grad_clip.clip_norm / jnp.maximum(total, 1e-12))
+                    grads = [g * scale.astype(g.dtype) for g in grads]
+                new_params, new_state = [], []
+                for pa, g, st, wd in zip(dpa, grads, opt_state, wds):
+                    np_, ns_ = opt.update(pa, g, st, lr, step_i, wd)
+                    new_params.append(np_)
+                    new_state.append(ns_)
+                eval_var_grads(env, dpa, kpa, keys, feeds)
+                fetches = tuple(env[v] for v in fetch_vids)
+                return fetches, tuple(new_params), tuple(new_state)
+
+            return jax.jit(step, donate_argnums=(0, 2))
+
+        def run_fn(dpa, kpa, keys, *feeds):
+            env = replay(dpa, kpa, keys, feeds)
+            eval_var_grads(env, dpa, kpa, keys, feeds)
+            return tuple(env[v] for v in fetch_vids)
+
+        return jax.jit(run_fn)

@@ -1,0 +1,91 @@
+"""paddle.static.nn — graph-building layer functions.
+
+The reference keeps a separate static layer API (python/paddle/static/nn/,
+fluid/layers/) that appends ops + creates parameters on the default program.
+Here the dynamic `paddle_tpu.nn` layers already split cleanly into eager
+parameter creation (the implicit startup program) + recordable ops, so these
+functions simply construct a layer and call it on the symbolic input — one
+layer implementation serves both modes, the way PHI infermeta/kernels are
+shared between the reference's two modes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn as dyn_nn
+from .program import Variable, default_main_program
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference: paddle.static.nn.fc (static/nn/common.py)."""
+    in_shape = list(x._data.shape)
+    in_features = _prod(in_shape[num_flatten_dims:])
+    if num_flatten_dims != len(in_shape) - 1 or in_features != in_shape[-1]:
+        from ..core import ops as _ops
+        x = _ops.reshape(x, in_shape[:num_flatten_dims] + [in_features])
+    layer = dyn_nn.Linear(in_features, size,
+                          bias_attr=bias_attr if bias_attr is not None else None)
+    out = layer(x)
+    if activation:
+        out = getattr(dyn_nn.functional, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, weight_attr=None,
+              name=None):
+    """reference: paddle.static.nn.embedding."""
+    layer = dyn_nn.Embedding(size[0], size[1], padding_idx=padding_idx)
+    return layer(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, bias_attr=None, name=None, data_format="NCHW"):
+    in_ch = input._data.shape[1 if data_format == "NCHW" else -1]
+    layer = dyn_nn.Conv2D(in_ch, num_filters, filter_size, stride=stride,
+                          padding=padding, dilation=dilation, groups=groups,
+                          data_format=data_format)
+    return layer(input)
+
+
+def batch_norm(input, epsilon=1e-5, momentum=0.9, data_layout="NCHW",
+               is_test=False, name=None):
+    ch = input._data.shape[1 if data_layout == "NCHW" else -1]
+    layer = dyn_nn.BatchNorm(ch, momentum=momentum, epsilon=epsilon,
+                             data_layout=data_layout)
+    if is_test:
+        layer.eval()
+    return layer(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, name=None):
+    shape = list(input._data.shape)[begin_norm_axis:]
+    layer = dyn_nn.LayerNorm(shape, epsilon=epsilon)
+    return layer(input)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, name=None):
+    return dyn_nn.functional.dropout(x, p=dropout_prob, training=not is_test)
+
+
+def cond(pred, true_fn, false_fn):
+    """Static conditional (reference: paddle.static.nn.cond → conditional
+    block ops). On TPU this is lax.cond over the recorded branches — both
+    branches must be recordable pure functions of closed-over Variables."""
+    import jax
+    from ..core.tensor import Tensor, apply_op
+
+    t_out = true_fn()
+    f_out = false_fn()
+
+    def fn(p, t, f):
+        return jax.lax.cond(p.reshape(()).astype(bool), lambda: t, lambda: f)
+    return apply_op("cond", fn, [pred, t_out, f_out])

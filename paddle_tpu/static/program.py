@@ -1,0 +1,398 @@
+"""Static graph Program — lazy op recording compiled to one XLA computation.
+
+TPU-native redesign of the reference's static core (SURVEY §1 L2b): the
+reference represents programs as ProgramDesc protobuf (framework.proto,
+program_desc.h) interpreted op-by-op by InterpreterCore
+(new_executor/interpretercore.cc). Here a Program is a recorded op-DAG over
+symbolic `Variable`s that the Executor replays *inside one jax.jit* — the
+"Program" **is** the jaxpr/HLO (SURVEY §7 design mapping: "InterpreterCore /
+static Program → XLA computation; executor = compiled executable").
+
+Recording happens at the single eager dispatch gate (`core.tensor.apply_op`):
+when static mode is on and any op input is a `Variable`, the op is appended
+to the current Program instead of executing, with output shapes/dtypes
+derived by `jax.eval_shape` (the analog of the reference's infermeta/
+functions, which exist precisely to share shape inference between static and
+dynamic modes — here jax abstract eval is that shared path for free).
+
+Concrete tensors created during build (parameter initializers, constants)
+stay eager: the reference runs those in a separate "startup program"
+(fluid/framework.py default_startup_program); here eager init IS the startup
+program, so `exe.run(startup_program)` is a no-op kept for API parity.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import tensor as tensor_mod
+from ..core import random as random_mod
+from ..core.tensor import Tensor, Parameter
+from ..core.dtype import convert_dtype
+
+
+class Variable(Tensor):
+    """Symbolic graph variable (reference: fluid/framework.py Variable over a
+    VarDesc). `_data` holds a jax.ShapeDtypeStruct — shape/dtype introspection
+    works at build time; host reads (`numpy()`, `item()`) do not, exactly as
+    in the reference's static mode."""
+
+    __slots__ = ("vid", "is_feed", "feed_name", "declared_shape", "is_key",
+                 "program")
+
+    def __init__(self, aval, name=None, vid=None):
+        # bypass Tensor.__init__'s jnp.asarray: store the aval directly
+        self._data = aval
+        self.stop_gradient = True
+        self.grad = None
+        self._node = None
+        self._out_idx = 0
+        self.name = name
+        self.persistable = False
+        self._hooks = []
+        self.pspec = None
+        self.vid = vid
+        self.is_feed = False
+        self.feed_name = None
+        self.declared_shape = None
+        self.is_key = False
+        self.program = None  # owning Program (reference: Variable.block.program)
+
+    def numpy(self):
+        raise RuntimeError(
+            "Variable has no data at graph-build time; run it through "
+            "paddle.static.Executor (reference static-mode semantics).")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={list(self._data.shape)}, "
+                f"dtype={self._data.dtype})")
+
+
+class OpNode:
+    """One recorded op: replayed as `fn(*inputs, **kwargs)` at run time.
+
+    Inputs are tagged: ("v", vid) graph edge / ("c", array) build-time
+    constant / ("p", index-into-program.params) parameter reference —
+    the analog of OpDesc input names resolved against Scope variables
+    (operator.h:154 Run(scope, place))."""
+
+    __slots__ = ("name", "fn", "kwargs", "inputs", "out_vids", "multi")
+
+    def __init__(self, name, fn, kwargs, inputs, out_vids, multi):
+        self.name = name
+        self.fn = fn
+        self.kwargs = kwargs
+        self.inputs = inputs
+        self.out_vids = out_vids
+        self.multi = multi
+
+
+class Block:
+    """Facade over the program's single global block (reference BlockDesc;
+    nested control-flow blocks are unnecessary here — lax.cond/while close
+    over values, so sub-blocks never materialize)."""
+
+    def __init__(self, program):
+        self.program = program
+
+    @property
+    def ops(self):
+        return self.program._nodes
+
+    @property
+    def vars(self):
+        return {v.name: v for v in self.program._vars.values() if v.name}
+
+    def var(self, name):
+        for v in self.program._vars.values():
+            if v.name == name:
+                return v
+        raise ValueError(f"no variable named {name!r} in block")
+
+
+class Program:
+    """Recorded op-DAG (reference: fluid/framework.py Program / ProgramDesc)."""
+
+    _ids = itertools.count()
+
+    def __init__(self):
+        self._nodes: List[OpNode] = []
+        self._vars: Dict[int, Variable] = {}
+        self._feed_vars: List[Variable] = []
+        self._key_vars: List[Variable] = []
+        self._params: List[Parameter] = []   # ordered unique parameter refs
+        self._param_ids: Dict[int, int] = {}  # id(param) -> index
+        self._vid = itertools.count()
+        self._version = 0
+        self._loss_vid: Optional[int] = None
+        self._grad_of: Dict[int, int] = {}    # param index -> grad vid
+        self._var_grads: List[Tuple[int, int]] = []  # (target vid, wrt vid)
+        self._optimizer = None
+        self.random_seed = 0
+        self.id = next(Program._ids)
+
+    # ---- build helpers ---------------------------------------------------
+    def _new_var(self, aval, name=None) -> Variable:
+        vid = next(self._vid)
+        v = Variable(aval, name=name or f"tmp_{self.id}_{vid}", vid=vid)
+        v.program = self
+        self._vars[vid] = v
+        self._version += 1
+        return v
+
+    def _param_index(self, p: Parameter) -> int:
+        idx = self._param_ids.get(id(p))
+        if idx is None:
+            idx = len(self._params)
+            self._params.append(p)
+            self._param_ids[id(p)] = idx
+            if not p.name:
+                p.name = f"param_{self.id}_{idx}"
+        return idx
+
+    def global_block(self) -> Block:
+        return Block(self)
+
+    def list_vars(self):
+        return list(self._vars.values())
+
+    def all_parameters(self):
+        return list(self._params)
+
+    def clone(self, for_test: bool = False):
+        """Shallow structural clone (reference Program.clone). The recorded
+        graph is immutable-by-append, so clones share nodes up to the clone
+        point; `for_test` drops the attached optimizer/backward section."""
+        p = Program()
+        p._nodes = list(self._nodes)
+        p._vars = dict(self._vars)
+        p._feed_vars = list(self._feed_vars)
+        p._key_vars = list(self._key_vars)
+        p._params = list(self._params)
+        p._param_ids = dict(self._param_ids)
+        p._vid = itertools.count(self._version + len(self._vars) + 1000)
+        p._version = self._version
+        if not for_test:
+            p._loss_vid = self._loss_vid
+            p._grad_of = dict(self._grad_of)
+            p._optimizer = self._optimizer
+        else:
+            # strip train-only ops (reference: clone(for_test=True) flips
+            # is_test attrs / removes dropout ops): dropout becomes identity
+            # on its data input (upscale_in_train semantics → inference is a
+            # pass-through)
+            def _identity_first(a, *rest):
+                return a
+            p._nodes = [
+                OpNode(n.name, _identity_first, {}, [n.inputs[0]], n.out_vids, n.multi)
+                if n.name in ("dropout", "alpha_dropout") else n
+                for n in p._nodes
+            ]
+            p._version += 1
+        p.random_seed = self.random_seed
+        return p
+
+    def to_readable_code(self) -> str:
+        lines = [f"Program(id={self.id}, ops={len(self._nodes)})"]
+        for v in self._feed_vars:
+            lines.append(f"  feed {v.feed_name}: shape={list(v._data.shape)} "
+                         f"dtype={v._data.dtype}")
+        for n in self._nodes:
+            ins = ", ".join(
+                f"v{ref}" if kind == "v" else ("param%d" % ref if kind == "p" else "const")
+                for kind, ref in n.inputs)
+            lines.append(f"  {n.name}({ins}) -> {n.out_vids}")
+        return "\n".join(lines)
+
+    __str__ = to_readable_code
+
+
+# ---------------------------------------------------------------- mode state
+_static_mode = False
+_program_stack: List[Tuple[Program, Program]] = []  # (main, startup)
+_default_main = Program()
+_default_startup = Program()
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+def enable_static():
+    """Switch to graph-building mode (reference: paddle.enable_static)."""
+    global _static_mode
+    _static_mode = True
+    _install_hooks()
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def default_main_program() -> Program:
+    return _program_stack[-1][0] if _program_stack else _default_main
+
+
+def default_startup_program() -> Program:
+    return _program_stack[-1][1] if _program_stack else _default_startup
+
+
+def reset_default_programs():
+    global _default_main, _default_startup
+    _default_main = Program()
+    _default_startup = Program()
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    """Route subsequent recording into `main_program` (reference:
+    fluid/framework.py program_guard)."""
+    _program_stack.append((main_program, startup_program or Program()))
+    try:
+        yield
+    finally:
+        _program_stack.pop()
+
+
+def data(name: str, shape: Sequence[int], dtype="float32", lod_level=0) -> Variable:
+    """Declare a feed placeholder (reference: paddle.static.data,
+    fluid/data.py). Dims given as None/-1 are placeholders for the batch
+    dimension; actual shapes flow in at Executor.run time (the replay is
+    shape-polymorphic — each distinct feed shape compiles once, mirroring
+    the reference's _ExecutorCache keyed on feed)."""
+    del lod_level
+    prog = default_main_program()
+    dt = convert_dtype(dtype) or jnp.float32
+    build_shape = tuple(1 if (s is None or s < 0) else int(s) for s in shape)
+    v = prog._new_var(jax.ShapeDtypeStruct(build_shape, dt), name=name)
+    v.is_feed = True
+    v.feed_name = name
+    v.declared_shape = tuple(-1 if (s is None or s < 0) else int(s) for s in shape)
+    prog._feed_vars.append(v)
+    return v
+
+
+# ---------------------------------------------------------------- recording
+def _key_aval():
+    return jax.eval_shape(lambda: jax.random.key(0))
+
+
+def _symbolic_key():
+    """Fresh symbolic RNG key Variable, fed a new key every Executor.run —
+    this is how static-mode dropout gets per-step randomness (the reference
+    plumbs a seed tensor into dropout kernels; we plumb a threefry key)."""
+    prog = default_main_program()
+    v = prog._new_var(_key_aval(), name=f"rng_key_{len(prog._key_vars)}")
+    v.is_key = True
+    prog._key_vars.append(v)
+    return v
+
+
+def _recording_active() -> bool:
+    return _static_mode
+
+
+def _record_apply(name, fn, tensor_args, static_kwargs, n_outputs):
+    """The static-mode branch of core.tensor.apply_op: append an OpNode when
+    any input is symbolic; otherwise fall through to eager (returns
+    NotImplemented)."""
+    if not _static_mode or not any(isinstance(a, Variable) for a in tensor_args):
+        return NotImplemented
+    prog = default_main_program()
+    inputs = []
+    avals = []
+    for a in tensor_args:
+        if isinstance(a, Variable):
+            inputs.append(("v", a.vid))
+            avals.append(a._data)
+        elif isinstance(a, Parameter):
+            inputs.append(("p", prog._param_index(a)))
+            avals.append(jax.ShapeDtypeStruct(a._data.shape, a._data.dtype))
+        elif isinstance(a, Tensor):
+            inputs.append(("c", a._data))
+            avals.append(a._data)
+        else:
+            arr = a if isinstance(a, jax.Array) else jnp.asarray(a)
+            inputs.append(("c", arr))
+            avals.append(arr)
+
+    out_avals = jax.eval_shape(partial(fn, **static_kwargs), *avals)
+    multi = isinstance(out_avals, (tuple, list))
+    outs_t = tuple(out_avals) if multi else (out_avals,)
+    out_vars = tuple(prog._new_var(o, name=f"{name}_{prog._version}") for o in outs_t)
+    prog._nodes.append(OpNode(name, fn, static_kwargs, inputs,
+                              tuple(v.vid for v in out_vars),
+                              multi or n_outputs is not None))
+    if len(out_vars) == 1 and n_outputs is None:
+        return out_vars[0]
+    return out_vars
+
+
+def _op_key_hook():
+    if _recording_active():
+        return _symbolic_key()
+    return None
+
+
+def _install_hooks():
+    tensor_mod._static_record = _record_apply
+    random_mod._op_key_hook = _op_key_hook
+
+
+# ---------------------------------------------------------------- backward
+def append_backward(loss: Variable, parameter_list=None, no_grad_set=None):
+    """Mark the loss and materialize grad Variables for every trainable
+    parameter the program references (reference: fluid/backward.py
+    append_backward). The actual differentiation is jax.value_and_grad over
+    the replayed program at Executor build time — no per-op grad graph needs
+    constructing (SURVEY §7: autodiff comes from the functional substrate)."""
+    if not isinstance(loss, Variable):
+        raise TypeError("append_backward expects a static Variable loss")
+    # resolve the program that owns the loss (reference: loss.block.program),
+    # not the default — minimize() may be called outside the program_guard
+    prog = loss.program or default_main_program()
+    prog._loss_vid = loss.vid
+    if parameter_list is not None:
+        wanted = {id(p) for p in parameter_list}
+        params = [p for p in prog._params if id(p) in wanted]
+    else:
+        params = [p for p in prog._params if not p.stop_gradient]
+    pairs = []
+    for p in params:
+        idx = prog._param_index(p)
+        gv = prog._grad_of.get(idx)
+        if gv is None:
+            g = prog._new_var(jax.ShapeDtypeStruct(p._data.shape, p._data.dtype),
+                              name=(p.name or f"param{idx}") + "@GRAD")
+            prog._grad_of[idx] = g.vid
+            gv = g.vid
+        pairs.append((p, prog._vars[gv]))
+    return pairs
+
+
+def gradients(targets, inputs, target_gradients=None):
+    """d(sum targets)/d(inputs) as new graph Variables (reference:
+    paddle.static.gradients)."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if len(targets) != 1:
+        raise NotImplementedError("gradients: single target supported")
+    t = targets[0]
+    prog = (t.program if isinstance(t, Variable) and t.program is not None
+            else default_main_program())
+    outs = []
+    for x in inputs:
+        if not isinstance(x, Variable):
+            raise TypeError("gradients inputs must be Variables")
+        g = prog._new_var(jax.ShapeDtypeStruct(x._data.shape, x._data.dtype),
+                          name=(x.name or "x") + "@GRAD")
+        prog._var_grads.append((t.vid, x.vid, g.vid))
+        outs.append(g)
+    return outs
