@@ -53,6 +53,8 @@ from .framework.io import save, load  # noqa: F401,E402
 from .tensor import tensor as _tensor_ns  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from .static.program import enable_static, disable_static  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
 
 
 def is_compiled_with_cuda() -> bool:

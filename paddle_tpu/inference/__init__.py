@@ -1,0 +1,216 @@
+"""paddle_tpu.inference — deployment predictor API.
+
+TPU-native redesign of the reference inference stack (SURVEY §2.4:
+paddle/fluid/inference/ AnalysisPredictor, analysis_predictor.cc:253 Init,
+:885 ZeroCopyRun, paddle_analysis_config.h). The reference needs 98k LoC of
+IR passes, subgraph capture and per-engine op converters (TensorRT: 131
+converters, op_teller.h:68) because optimization happens op-by-op at load
+time; here the artifact IS an AOT-compiled StableHLO module produced by
+`static.save_inference_model` or `jit.save(..., input_spec=...)` — XLA did
+all fusion/layout work at export, so the predictor is: deserialize, bind
+buffers, call. Zero-copy semantics come from jax device arrays (handles hold
+device buffers; copy_to_cpu is the only host transfer).
+
+API shape mirrors paddle.inference: Config → create_predictor → named
+input/output handles → run().
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+
+
+class Config:
+    """reference: paddle_analysis_config.h AnalysisConfig. Knobs that steer
+    CUDA/TRT/MKLDNN engine selection in the reference are accepted and
+    recorded (summary() shows them) but are no-ops: XLA owns optimization."""
+
+    def __init__(self, prog_file: str = None, params_file: str = None):
+        # accept either a path prefix (our native artifact) or the
+        # reference's (model, params) file pair pointing at the same prefix
+        self._prefix = None
+        if prog_file is not None:
+            self._prefix = prog_file[:-8] if prog_file.endswith(".pdmodel") else prog_file
+        self._params_file = params_file
+        self._use_device = "tpu"
+        self._memory_optim = True
+        self._ir_optim = True
+        self._glog_info = True
+        self._profile = False
+        self._cpu_math_threads = 1
+
+    # --- device selection (reference: enable_use_gpu / disable_gpu) ---
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_device = "tpu"  # accelerator = the TPU on this platform
+
+    def disable_gpu(self):
+        self._use_device = "cpu"
+
+    def use_gpu(self):
+        return self._use_device != "cpu"
+
+    def enable_xpu(self, *a, **kw):
+        self._use_device = "tpu"
+
+    # --- optimization toggles (XLA always optimizes; recorded for summary) ---
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = bool(x)
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def enable_memory_optim(self, x=True):
+        self._memory_optim = bool(x)
+
+    def enable_tensorrt_engine(self, *a, **kw):
+        pass  # engine dispatch does not exist: one compiler path
+
+    def enable_mkldnn(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = int(n)
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def enable_profile(self):
+        self._profile = True
+
+    def model_dir(self):
+        return os.path.dirname(self._prefix or "")
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return self._params_file or ((self._prefix or "") + ".pdiparams.npz")
+
+    def summary(self) -> str:
+        rows = [("model_prefix", self._prefix), ("device", self._use_device),
+                ("ir_optim", self._ir_optim), ("memory_optim", self._memory_optim),
+                ("cpu_math_threads", self._cpu_math_threads)]
+        return "\n".join(f"{k:>20}: {v}" for k, v in rows)
+
+
+class Tensor:
+    """Named I/O handle (reference: paddle_infer::Tensor / ZeroCopyTensor).
+    Holds a device buffer; copy_from_cpu stages host data, copy_to_cpu is the
+    only device→host transfer."""
+
+    def __init__(self, name, aval=None):
+        self.name = name
+        self._aval = aval
+        self._buf = None
+
+    def reshape(self, shape):
+        pass  # shapes bind at copy_from_cpu; symbolic-batch artifacts adapt
+
+    def copy_from_cpu(self, data: np.ndarray):
+        arr = np.asarray(data)
+        if self._aval is not None and arr.dtype != self._aval.dtype:
+            arr = arr.astype(self._aval.dtype)
+        self._buf = jnp.asarray(arr)
+
+    def share_external_data(self, data):
+        self.copy_from_cpu(data)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._buf is None:
+            raise RuntimeError(f"handle {self.name!r} has no data; run() first")
+        return np.asarray(self._buf)
+
+    def shape(self):
+        if self._buf is not None:
+            return list(self._buf.shape)
+        return list(self._aval.shape) if self._aval is not None else None
+
+    def type(self):
+        if self._buf is not None:
+            return np.dtype(self._buf.dtype)
+        return np.dtype(self._aval.dtype) if self._aval is not None else None
+
+
+class Predictor:
+    """reference: paddle_infer::Predictor over AnalysisPredictor."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        prefix = config._prefix
+        if prefix is None:
+            raise ValueError("Config needs a model path prefix")
+        with open(prefix + ".pdmodel", "rb") as f:
+            self._exported = jax_export.deserialize(f.read())
+        with open(prefix + ".pdmeta") as f:
+            self._meta = json.load(f)
+        self._inputs = {
+            n: Tensor(n, jax.ShapeDtypeStruct(tuple(s), np.dtype(d)))
+            for n, s, d in zip(self._meta["feed_names"],
+                               self._meta["feed_shapes"],
+                               self._meta["feed_dtypes"])}
+        self._outputs = {n: Tensor(n) for n in self._meta["fetch_names"]}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._meta["feed_names"])
+
+    def get_input_handle(self, name) -> Tensor:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._meta["fetch_names"])
+
+    def get_output_handle(self, name) -> Tensor:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """ZeroCopyRun (analysis_predictor.cc:885): executes the AOT module
+        on the bound input buffers. With `inputs` given, behaves like the
+        legacy run(feeds)->fetches API."""
+        if inputs is not None:
+            for n, a in zip(self._meta["feed_names"], inputs):
+                self._inputs[n].copy_from_cpu(a)
+        feeds = []
+        for n in self._meta["feed_names"]:
+            h = self._inputs[n]
+            if h._buf is None:
+                raise RuntimeError(f"input {n!r} not set; copy_from_cpu first")
+            feeds.append(h._buf)
+        outs = self._exported.call(*feeds)
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        for n, o in zip(self._meta["fetch_names"], outs):
+            self._outputs[n]._buf = o
+        if inputs is not None:
+            return [np.asarray(o) for o in outs]
+        return True
+
+    def clone(self):
+        """Share-weights clone (reference AnalysisPredictor::Clone): the
+        exported module is immutable, so a shallow copy suffices."""
+        p = Predictor.__new__(Predictor)
+        p.config = self.config
+        p._exported = self._exported
+        p._meta = self._meta
+        p._inputs = {n: Tensor(n, t._aval) for n, t in self._inputs.items()}
+        p._outputs = {n: Tensor(n) for n in self._outputs}
+        return p
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference: paddle_infer::CreatePredictor (analysis_predictor.cc:1387)."""
+    return Predictor(config)
+
+
+def get_version() -> str:
+    from .. import __version__
+    return __version__
+
+
+PrecisionType = type("PrecisionType", (), {"Float32": 0, "Half": 1, "Int8": 2,
+                                           "Bfloat16": 3})
+PlaceType = type("PlaceType", (), {"CPU": 0, "GPU": 1, "XPU": 2, "CUSTOM": 3})

@@ -31,6 +31,55 @@ def save(layer, path, input_spec=None, **configs):
     _save_obj({"state_dict": state, "manifest": manifest}, path + ".pdparams")
     with open(path + ".pdmodel.json", "w") as f:
         json.dump(manifest, f)
+    if input_spec:
+        _export_aot(layer, path, input_spec)
+
+
+def _export_aot(layer, path, input_spec):
+    """AOT artifact: trace layer.forward under the given specs and serialize
+    the StableHLO module (+ .pdmeta), the same format as
+    static.save_inference_model — consumable by paddle_tpu.inference
+    (reference: jit.save produces the __model__ the AnalysisPredictor loads)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+    from ..core.tensor import Tensor
+    from ..core import autograd
+    from .api import _trace_guard, _swap_params, InputSpec
+
+    params = [p for _, p in layer.named_parameters()]
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+
+    def serving(*inputs):
+        with _trace_guard(), autograd.no_grad():
+            out = layer(*[Tensor(i) for i in inputs])
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return tuple(o._data if isinstance(o, Tensor) else o for o in outs)
+
+    specs = [s if isinstance(s, InputSpec) else InputSpec(*s) for s in input_spec]
+    avals = [jax.ShapeDtypeStruct(tuple(1 if (d is None or d < 0) else int(d)
+                                        for d in s.shape), s.dtype) for s in specs]
+    try:
+        from ..static.io import _export_platforms
+        exported = jax_export.export(jax.jit(serving),
+                                     platforms=_export_platforms())(*avals)
+    except Exception:
+        exported = jax_export.export(jax.jit(serving))(*avals)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    meta = {
+        "feed_names": [s.name or f"x{i}" for i, s in enumerate(specs)],
+        "feed_shapes": [list(a.shape) for a in avals],
+        "feed_dtypes": [str(np.dtype(a.dtype)) for a in avals],
+        "fetch_names": ["out_%d" % i
+                        for i in range(len(jax.eval_shape(serving, *avals)))],
+    }
+    with open(path + ".pdmeta", "w") as f:
+        json.dump(meta, f)
+    if was_training and hasattr(layer, "train"):
+        layer.train()
 
 
 class TranslatedLayer:

@@ -1,0 +1,249 @@
+"""paddle_tpu.profiler — tracing/profiling with the paddle.profiler API shape.
+
+TPU-native redesign of the reference profiler (SURVEY §5.1): the reference
+composes HostTracer + CUPTI CudaTracer into an event tree exported as chrome
+tracing (platform/profiler/profiler.h:47, chrometracing_logger.cc), driven
+from python by paddle.profiler.Profiler with a step scheduler
+(profiler/profiler.py:344, make_scheduler:117). Here the device-side tracer
+is jax.profiler (XLA XPlane → TensorBoard/perfetto, which subsumes CUPTI),
+and the host-side RecordEvent maps to jax.profiler.TraceAnnotation so user
+annotations appear inside the XLA trace. Step scheduling, the state machine
+(CLOSED/READY/RECORD/RECORD_AND_RETURN), on_trace_ready callbacks and the
+op-level summary surface keep the reference semantics.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import json
+import os
+import time
+from collections import defaultdict
+from typing import Callable, Iterable, Optional
+
+import jax
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1      # accepted for API parity
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+class ProfilerState(enum.Enum):
+    """reference: profiler/profiler.py ProfilerState."""
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """reference: profiler/profiler.py:117 make_scheduler — cycle through
+    CLOSED*closed → READY*ready → RECORD*record, repeated `repeat` times."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat > 0 and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def _default_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str = None) -> Callable:
+    """reference: profiler/profiler.py:215 — on_trace_ready callback writing
+    chrome-tracing JSON of host RecordEvents (the XLA device trace lands in
+    `dir_name` as an XPlane/TensorBoard trace alongside)."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_{int(time.time())}.pt.trace.json")
+        events = [{
+            "name": e["name"], "ph": "X", "pid": os.getpid(), "tid": 0,
+            "ts": e["start"] * 1e6, "dur": (e["end"] - e["start"]) * 1e6,
+            "cat": "host",
+        } for e in prof._host_events]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        prof._last_export = path
+    return handler
+
+
+def export_protobuf(dir_name: str, worker_name: str = None) -> Callable:
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+class RecordEvent:
+    """User-scope annotation (reference: paddle.profiler.RecordEvent backed
+    by platform/profiler RecordEvent instrumentation). Shows up in the XLA
+    trace via TraceAnnotation AND in the host-side event list for
+    summary()."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+        self._start = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._start = time.perf_counter()
+        return self
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        if self._start is not None and _active_profiler is not None \
+                and _active_profiler._recording:
+            _active_profiler._host_events.append({
+                "name": self.name, "start": self._start,
+                "end": time.perf_counter()})
+        self._start = None
+
+    def __enter__(self):
+        return self.begin()
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+_active_profiler: Optional["Profiler"] = None
+
+
+class Profiler:
+    """reference: paddle.profiler.Profiler (profiler/profiler.py:344)."""
+
+    def __init__(self, *, targets: Iterable[ProfilerTarget] = None,
+                 scheduler=None, on_trace_ready: Callable = None,
+                 timer_only: bool = False, record_shapes: bool = False,
+                 profile_memory: bool = False, trace_dir: str = None):
+        self.targets = list(targets) if targets else [ProfilerTarget.TPU]
+        if scheduler is None:
+            self._scheduler = _default_scheduler
+        elif callable(scheduler):
+            self._scheduler = scheduler
+        else:  # (start, end) tuple form
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(closed=lo, ready=0, record=hi - lo,
+                                             repeat=1)
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self._trace_dir = trace_dir or "./profiler_log"
+        self.step_num = 0
+        self._state = ProfilerState.CLOSED
+        self._recording = False
+        self._device_tracing = False
+        self._host_events = []
+        self._step_times = []
+        self._step_t0 = None
+        self._last_export = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self):
+        global _active_profiler
+        _active_profiler = self
+        self._state = self._scheduler(self.step_num)
+        self._apply_state()
+        self._step_t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        global _active_profiler
+        if self._device_tracing:
+            jax.profiler.stop_trace()
+            self._device_tracing = False
+        if self._recording and self.on_trace_ready:
+            self.on_trace_ready(self)
+        self._recording = False
+        self._state = ProfilerState.CLOSED
+        _active_profiler = None
+
+    def step(self, num_steps: int = 1):
+        now = time.perf_counter()
+        if self._step_t0 is not None:
+            self._step_times.append(now - self._step_t0)
+        self._step_t0 = now
+        self.step_num += num_steps
+        new_state = self._scheduler(self.step_num)
+        if new_state != self._state:
+            if self._state == ProfilerState.RECORD_AND_RETURN and self.on_trace_ready:
+                self.on_trace_ready(self)
+            self._state = new_state
+            self._apply_state()
+
+    def _apply_state(self):
+        want_record = self._state in (ProfilerState.RECORD,
+                                      ProfilerState.RECORD_AND_RETURN)
+        if want_record and not self._recording:
+            self._recording = True
+            if not self.timer_only:
+                try:
+                    os.makedirs(self._trace_dir, exist_ok=True)
+                    jax.profiler.start_trace(self._trace_dir)
+                    self._device_tracing = True
+                except Exception:
+                    self._device_tracing = False
+        elif not want_record and self._recording:
+            self._recording = False
+            if self._device_tracing:
+                jax.profiler.stop_trace()
+                self._device_tracing = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- reporting ------------------------------------------------------
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms") -> str:
+        """Host-event summary table (reference: profiler_statistic.py
+        summaries; device-op breakdown lives in the exported XLA trace)."""
+        unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
+        agg = defaultdict(lambda: [0, 0.0])
+        for e in self._host_events:
+            a = agg[e["name"]]
+            a[0] += 1
+            a[1] += e["end"] - e["start"]
+        lines = [f"{'Event':<40}{'Calls':>8}{'Total(' + time_unit + ')':>16}"
+                 f"{'Avg(' + time_unit + ')':>16}"]
+        for name, (calls, total) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append(f"{name:<40}{calls:>8}{total * unit:>16.3f}"
+                         f"{total / calls * unit:>16.3f}")
+        if self._step_times:
+            tot = sum(self._step_times)
+            lines.append(f"{'[steps] ' + str(len(self._step_times)):<40}"
+                         f"{len(self._step_times):>8}{tot * unit:>16.3f}"
+                         f"{tot / len(self._step_times) * unit:>16.3f}")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profile(**kwargs):
+    p = Profiler(**kwargs)
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()

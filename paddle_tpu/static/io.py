@@ -63,13 +63,33 @@ def save_inference_model(path_prefix: str, feed_vars: Sequence[Variable],
         return fn(tuple(p._data for p in diff_params),
                   tuple(p._data for p in const_params), keys, *feeds)
 
-    feed_avals = [jax.ShapeDtypeStruct(tuple(v._data.shape), v._data.dtype)
-                  for v in feed_vars]
-    try:
-        exported = jax_export.export(jax.jit(serving),
-                                     platforms=_export_platforms())(*feed_avals)
-    except Exception:
-        exported = jax_export.export(jax.jit(serving))(*feed_avals)
+    # feed dims declared -1/None export as symbolic dims (jax shape
+    # polymorphism) — the artifact then serves any batch size, the analog of
+    # the reference predictor's dynamic-shape support (TRT dynamic shapes)
+    def _avals(symbolic):
+        out = []
+        scope = jax_export.SymbolicScope() if symbolic else None
+        for i, v in enumerate(feed_vars):
+            decl = v.declared_shape or tuple(v._data.shape)
+            if symbolic and any(d == -1 for d in decl):
+                spec = ",".join(f"d{i}_{j}" if d == -1 else str(d)
+                                for j, d in enumerate(decl))
+                shape = jax_export.symbolic_shape(spec, scope=scope)
+            else:
+                shape = tuple(v._data.shape)
+            out.append(jax.ShapeDtypeStruct(shape, v._data.dtype))
+        return out
+
+    exported = None
+    for symbolic in (True, False):
+        try:
+            exported = jax_export.export(jax.jit(serving),
+                                         platforms=_export_platforms())(*_avals(symbolic))
+            break
+        except Exception:
+            continue
+    if exported is None:
+        exported = jax_export.export(jax.jit(serving))(*_avals(False))
 
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
     with open(path_prefix + ".pdmodel", "wb") as f:

@@ -1,0 +1,104 @@
+"""Inference predictor + profiler tests (SURVEY §2.4 / §5.1 parity)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static, inference, profiler
+import paddle_tpu.nn as nn
+
+
+def _export_model(tmp_path):
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [-1, 8], "float32")
+            y = static.nn.fc(x, 4)
+        exe = static.Executor()
+        prefix = str(tmp_path / "model")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+        w, b = main.all_parameters()[:2]
+        return prefix, np.asarray(w._data), np.asarray(b._data)
+    finally:
+        paddle.disable_static()
+
+
+def test_predictor_named_handles(tmp_path):
+    prefix, w, b = _export_model(tmp_path)
+    config = inference.Config(prefix)
+    config.disable_gpu()
+    predictor = inference.create_predictor(config)
+    assert predictor.get_input_names() == ["x"]
+    xv = np.random.randn(3, 8).astype(np.float32)
+    h = predictor.get_input_handle("x")
+    h.copy_from_cpu(xv)
+    assert predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, xv @ w + b, rtol=1e-4, atol=1e-5)
+
+
+def test_predictor_symbolic_batch(tmp_path):
+    prefix, w, b = _export_model(tmp_path)
+    predictor = inference.create_predictor(inference.Config(prefix))
+    for bs in (1, 7):
+        xv = np.random.randn(bs, 8).astype(np.float32)
+        (out,) = predictor.run([xv])
+        np.testing.assert_allclose(out, xv @ w + b, rtol=1e-4, atol=1e-5)
+
+
+def test_predictor_clone_independent_buffers(tmp_path):
+    prefix, w, b = _export_model(tmp_path)
+    p1 = inference.create_predictor(inference.Config(prefix))
+    p2 = p1.clone()
+    x1 = np.ones((2, 8), np.float32)
+    x2 = np.zeros((2, 8), np.float32)
+    (o1,) = p1.run([x1])
+    (o2,) = p2.run([x2])
+    np.testing.assert_allclose(o1, x1 @ w + b, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(o2, np.tile(b, (2, 1)), rtol=1e-4, atol=1e-5)
+
+
+def test_jit_save_aot_artifact(tmp_path):
+    model = nn.Sequential(nn.Linear(6, 3), nn.Tanh())
+    prefix = str(tmp_path / "jit_model")
+    paddle.jit.save(model, prefix,
+                    input_spec=[paddle.jit.InputSpec([2, 6], "float32")])
+    predictor = inference.create_predictor(inference.Config(prefix))
+    xv = np.random.randn(2, 6).astype(np.float32)
+    (out,) = predictor.run([xv])
+    model.eval()
+    want = model(paddle.to_tensor(xv)).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_scheduler_state_machine():
+    sched = profiler.make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                                    skip_first=1)
+    states = [sched(i) for i in range(6)]
+    S = profiler.ProfilerState
+    assert states == [S.CLOSED, S.CLOSED, S.READY, S.RECORD,
+                      S.RECORD_AND_RETURN, S.CLOSED]
+
+
+def test_profiler_records_and_exports(tmp_path):
+    done = {}
+
+    def ready(prof):
+        done["summary"] = prof.summary()
+        profiler.export_chrome_tracing(str(tmp_path))(prof)
+
+    p = profiler.Profiler(scheduler=profiler.make_scheduler(
+        closed=0, ready=0, record=2, repeat=1), on_trace_ready=ready,
+        timer_only=True)
+    p.start()
+    for _ in range(2):
+        with profiler.RecordEvent("my_step"):
+            _ = paddle.to_tensor(np.ones(4)) * 2
+        p.step()
+    p.stop()
+    assert "my_step" in done["summary"]
+    assert p._last_export is not None
+    import json
+    with open(p._last_export) as f:
+        trace = json.load(f)
+    assert any(e["name"] == "my_step" for e in trace["traceEvents"])
