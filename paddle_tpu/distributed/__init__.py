@@ -66,9 +66,6 @@ class DataParallel:
         return self._layers.set_state_dict(*a, **k)
 
 
-def spawn(func, args=(), nprocs=-1, **kwargs):
-    """Reference: distributed/spawn.py:472 — multi-process launch. On TPU the
-    single-controller model replaces process-per-device: run func once with
-    the full mesh initialised."""
-    init_parallel_env()
-    return func(*args)
+from .spawn import spawn  # noqa: E402,F401  (reference: distributed/spawn.py:472)
+from .store import TCPStore, MasterDaemon  # noqa: E402,F401
+from . import launch  # noqa: E402,F401

@@ -1,0 +1,121 @@
+"""Elastic training manager — node health + membership over the TCPStore.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py (SURVEY §5.3):
+etcd heartbeats with TTL (~60s), node join/leave triggers rank-table rebuild
+and a global restart; two levels — FAULT_TOLERANCE (fixed nproc, restart on
+failure) and ELASTIC (min:max nproc, scale in/out). TPU-native: the
+"cluster" is host-granular (one process per host) and the store is our
+TCPStore rather than etcd; on a restart the launcher reassigns
+jax.distributed process ids and the coordination service rebuilds the world
+(replacing the reference's rank-table env rewrite).
+"""
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import List, Optional
+
+from ..store import TCPStore
+
+
+class ElasticLevel:
+    """reference: manager.py:41."""
+    FAULT_TOLERANCE = 1
+    ELASTIC = 2
+
+
+class ElasticStatus(enum.Enum):
+    """reference: manager.py:46."""
+    COMPLETED = 0
+    HOLD = 1
+    RESTART = 2
+    EXIT = 3
+    ERROR = 4
+
+
+class ElasticManager:
+    """Heartbeat + membership watcher for one node.
+
+    node key: `{job}/hb/{node_id}` = last-beat timestamp; a node is dead if
+    its beat is older than `ttl`. `watch()` compares live membership to the
+    membership at (re)start and returns RESTART/HOLD/COMPLETED decisions the
+    launcher acts on."""
+
+    def __init__(self, store: TCPStore, job_id: str, node_id: str,
+                 np_min: int, np_max: Optional[int] = None,
+                 ttl: float = 60.0, beat_interval: float = 10.0):
+        self.store = store
+        self.job_id = job_id
+        self.node_id = node_id
+        self.np_min = np_min
+        self.np_max = np_max or np_min
+        self.ttl = ttl
+        self.beat_interval = beat_interval
+        self.level = (ElasticLevel.ELASTIC if self.np_max > self.np_min
+                      else ElasticLevel.FAULT_TOLERANCE)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._epoch_members: List[str] = []
+
+    # -- heartbeats ----------------------------------------------------
+    def _beat(self):
+        self.store.set(f"{self.job_id}/hb/{self.node_id}", str(time.time()))
+
+    def start(self):
+        self._beat()
+        self._thread = threading.Thread(target=self._beat_loop, daemon=True)
+        self._thread.start()
+        self._epoch_members = self.live_nodes()
+
+    def _beat_loop(self):
+        while not self._stop.wait(self.beat_interval):
+            try:
+                self._beat()
+            except Exception:
+                pass  # transient store outage; next beat retries
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        try:
+            self.store.delete(f"{self.job_id}/hb/{self.node_id}")
+        except Exception:
+            pass
+
+    # -- membership ----------------------------------------------------
+    def live_nodes(self) -> List[str]:
+        now = time.time()
+        nodes = []
+        for key in self.store.keys(f"{self.job_id}/hb/"):
+            ts = self.store.get(key)
+            if ts and now - float(ts) < self.ttl:
+                nodes.append(key.rsplit("/", 1)[1])
+        return sorted(nodes)
+
+    def mark_epoch(self):
+        """Record current membership as the running configuration."""
+        self._epoch_members = self.live_nodes()
+
+    def watch(self) -> ElasticStatus:
+        """One membership check (reference manager.py watch loop body)."""
+        live = self.live_nodes()
+        n = len(live)
+        if n < self.np_min:
+            # below quorum: hold for rejoin, the launcher escalates to EXIT
+            # after its own patience window
+            return ElasticStatus.HOLD
+        if live != self._epoch_members:
+            if self.level == ElasticLevel.FAULT_TOLERANCE and \
+                    set(self._epoch_members) <= set(live):
+                # a node came back / extra joins are ignored at fixed size
+                return ElasticStatus.HOLD
+            return ElasticStatus.RESTART
+        return ElasticStatus.COMPLETED
+
+
+def rank_table(manager: ElasticManager) -> dict:
+    """node_id -> rank for the current live membership (the reference writes
+    this into etcd for trainers to re-read after a RESTART)."""
+    return {nid: i for i, nid in enumerate(manager.live_nodes())}

@@ -1,0 +1,4 @@
+"""Launch CLI package (reference: python/paddle/distributed/launch/)."""
+from .__main__ import launch, parse_args  # noqa: F401
+from .controllers import CollectiveController  # noqa: F401
+from .job import Container, Pod  # noqa: F401

@@ -1,0 +1,58 @@
+"""`python -m paddle_tpu.distributed.launch` — the cluster launch CLI.
+
+Reference: python/paddle/distributed/launch/main.py (SURVEY §2.2 Launch CLI):
+elastic multi-node process manager with HTTPMaster/ETCDMaster rendezvous.
+Usage mirrors the reference:
+
+    python -m paddle_tpu.distributed.launch \
+        --nnodes 2 --master 10.0.0.1:6070 --nproc_per_node 1 train.py --args
+
+On TPU pods, run one process per host (the default nproc_per_node=1); each
+process claims all local chips and jax.distributed stitches the pod into one
+world. `--devices_per_proc N` runs CPU-emulated hosts for testing (virtual
+XLA devices), the analog of the reference's 2-GPU CI harness
+(test_parallel_dygraph_dataparallel.py:157).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .controllers import CollectiveController
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a distributed SPMD training job")
+    p.add_argument("--nnodes", default="1",
+                   help="node count, or elastic range 'min:max'")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per node (1 per TPU host)")
+    p.add_argument("--master", default=None,
+                   help="rendezvous store address host:port (rank-0 node)")
+    p.add_argument("--rank", type=int, default=-1,
+                   help="this node's rank; -1 = assigned by the master")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help="0=off, 1=fault-tolerant restart (reference "
+                        "FAULT_TOLERANCE), 2=elastic scale (ELASTIC)")
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--start_port", type=int, default=6170)
+    p.add_argument("--coordinator_port", type=int, default=6171)
+    p.add_argument("--devices_per_proc", type=int, default=0,
+                   help="emulate N CPU devices per process (testing)")
+    p.add_argument("--poll_interval", type=float, default=0.5)
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None) -> int:
+    args = parse_args(argv)
+    return CollectiveController(args).run()
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -1,0 +1,150 @@
+"""Launch controllers — process orchestration for SPMD jobs.
+
+Reference: python/paddle/distributed/launch/controllers/{collective,master,
+watcher}.py — CollectiveController builds the rank env for each trainer,
+HTTPMaster/ETCDMaster assign node ranks, the watcher restarts on failure per
+elastic level (fleet/elastic/manager.py:41 FAULT_TOLERANCE vs ELASTIC).
+
+TPU-native deltas: the per-process env contract is jax.distributed's
+(coordinator address + process id + process count) rather than
+PADDLE_TRAINER_ENDPOINTS socket lists (both are set, for compat); rendezvous
+is our TCPStore (store.py) standing in for HTTPMaster/etcd.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+import uuid
+from typing import List, Optional
+
+from ..store import TCPStore, MasterDaemon
+from .job import Container, Pod
+
+
+class CollectiveController:
+    """One instance runs per node; rank-0's also hosts the master store."""
+
+    def __init__(self, args):
+        self.args = args
+        self.pod = Pod()
+        self.store: Optional[TCPStore] = None
+        self.job_id = args.job_id or "default"
+        self.node_rank = 0
+        self.nnodes = 1
+        self.restarts = 0
+
+        nn = str(args.nnodes)
+        if ":" in nn:   # elastic range min:max
+            self.nnodes_min, self.nnodes_max = map(int, nn.split(":"))
+            self.elastic = True
+        else:
+            self.nnodes_min = self.nnodes_max = int(nn)
+            self.elastic = False
+        self.nnodes = self.nnodes_min
+
+    # ------------------------------------------------------------- rendezvous
+    def _rendezvous(self):
+        """Sign in at the master store and obtain this node's rank."""
+        master = self.args.master
+        if self.nnodes_max <= 1 and not master:
+            return  # single node: no store needed
+        if master:
+            host, port = master.rsplit(":", 1)
+            is_master = self.args.rank == 0 or host in (
+                "127.0.0.1", "localhost", os.environ.get("POD_IP", ""))
+            self.store = TCPStore(host, int(port),
+                                  is_master=is_master and self.args.rank in (0, -1),
+                                  world_size=self.nnodes)
+        else:
+            self.store = TCPStore(is_master=True, world_size=self.nnodes)
+        if self.args.rank >= 0:
+            self.node_rank = self.args.rank
+            self.store.set(f"{self.job_id}/node/{self.node_rank}", _hostname())
+        else:
+            self.node_rank = self.store.add(f"{self.job_id}/nodes", 1) - 1
+            self.store.set(f"{self.job_id}/node/{self.node_rank}", _hostname())
+        # wait for quorum
+        self.store.barrier(f"signin_{self.restarts}", self.nnodes)
+
+    # ------------------------------------------------------------- build pod
+    def build_pod(self):
+        args = self.args
+        nproc = args.nproc_per_node
+        world = self.nnodes * nproc
+        coordinator = self._coordinator_addr()
+        base_port = args.start_port
+        endpoints = [f"127.0.0.1:{base_port + i}" for i in range(world)]
+
+        self.pod.clear()
+        for local_rank in range(nproc):
+            global_rank = self.node_rank * nproc + local_rank
+            env = {
+                # TPU-native contract (consumed by init_parallel_env)
+                "PADDLE_TPU_COORDINATOR": coordinator,
+                "PADDLE_TPU_NUM_PROCESSES": str(world),
+                "PADDLE_TPU_PROCESS_ID": str(global_rank),
+                "PADDLE_TPU_LOCAL_RANK": str(local_rank),
+                # reference compat env (test_dist_base.py:899 contract)
+                "PADDLE_TRAINER_ID": str(global_rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_CURRENT_ENDPOINT": endpoints[global_rank] if global_rank < len(endpoints) else "",
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+                "FLAGS_selected_devices": str(local_rank),
+            }
+            if args.devices_per_proc:
+                env["JAX_PLATFORMS"] = "cpu"
+                env["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                    f" --xla_force_host_platform_device_count={args.devices_per_proc}")
+            log = os.path.join(args.log_dir,
+                               f"workerlog.{global_rank}") if args.log_dir else None
+            cmd = [sys.executable, "-u", args.script] + list(args.script_args)
+            self.pod.add(Container(cmd, env, log))
+
+    def _coordinator_addr(self) -> str:
+        if self.args.master and self.nnodes > 1:
+            host, _ = self.args.master.rsplit(":", 1)
+            return f"{host}:{self.args.coordinator_port}"
+        return f"127.0.0.1:{self.args.coordinator_port}"
+
+    # ------------------------------------------------------------- run loop
+    def run(self) -> int:
+        self._rendezvous()
+        while True:
+            self.build_pod()
+            self.pod.start()
+            code = self._watch()
+            if code == 0:
+                return 0
+            # failure: restart per elastic level (reference ElasticStatus
+            # RESTART path, fleet/elastic/manager.py:46)
+            if self.args.elastic_level <= 0 or \
+                    self.restarts >= self.args.max_restarts:
+                self.pod.terminate()
+                return code
+            self.restarts += 1
+            sys.stderr.write(
+                f"[launch] worker failed (exit {code}); restart "
+                f"{self.restarts}/{self.args.max_restarts}\n")
+            self.pod.terminate()
+            if self.store:
+                self.store.barrier(f"restart_{self.restarts}", self.nnodes)
+
+    def _watch(self) -> int:
+        while True:
+            if self.pod.done():
+                return self.pod.exit_code()
+            failed = self.pod.failed()
+            if failed is not None:
+                tail = failed.tail_log()
+                if tail:
+                    sys.stderr.write(f"[launch] failed worker log tail:\n{tail}\n")
+                self.pod.terminate()
+                return failed.exit_code or 1
+            time.sleep(self.args.poll_interval)
+
+
+def _hostname() -> str:
+    import socket
+    return socket.gethostname()

@@ -1,0 +1,107 @@
+"""Process model for the launcher: Container (one trainer process) and Pod
+(this node's set of containers).
+
+Reference: python/paddle/distributed/launch/job/{container,pod}.py — the
+launcher there manages GPU trainer subprocesses; here each container is one
+host-process of the SPMD program (on TPU pods: exactly one per host, owning
+all local chips; in CPU tests: N emulated hosts on one machine).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+class Container:
+    def __init__(self, entrypoint: List[str], env: Dict[str, str],
+                 log_path: Optional[str] = None):
+        self.entrypoint = entrypoint
+        self.env = env
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self._log_f = None
+
+    def start(self):
+        full_env = dict(os.environ)
+        full_env.update(self.env)
+        out = None
+        if self.log_path:
+            os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+            self._log_f = open(self.log_path, "ab")
+            out = self._log_f
+        self.proc = subprocess.Popen(self.entrypoint, env=full_env,
+                                     stdout=out, stderr=out)
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def exit_code(self):
+        return None if self.proc is None else self.proc.poll()
+
+    def terminate(self, grace: float = 10.0):
+        if self.proc is None or self.proc.poll() is not None:
+            self._close_log()
+            return
+        self.proc.send_signal(signal.SIGTERM)
+        deadline = time.time() + grace
+        while time.time() < deadline and self.proc.poll() is None:
+            time.sleep(0.1)
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+        self._close_log()
+
+    def _close_log(self):
+        if self._log_f:
+            self._log_f.close()
+            self._log_f = None
+
+    def tail_log(self, n: int = 20) -> str:
+        if not self.log_path or not os.path.exists(self.log_path):
+            return ""
+        with open(self.log_path, "rb") as f:
+            return b"\n".join(f.read().splitlines()[-n:]).decode(
+                "utf-8", "replace")
+
+
+class Pod:
+    def __init__(self):
+        self.containers: List[Container] = []
+
+    def add(self, c: Container):
+        self.containers.append(c)
+
+    def start(self):
+        for c in self.containers:
+            c.start()
+
+    def alive(self) -> bool:
+        return any(c.alive() for c in self.containers)
+
+    def all_alive(self) -> bool:
+        return all(c.alive() for c in self.containers)
+
+    def failed(self) -> Optional[Container]:
+        for c in self.containers:
+            if not c.alive() and c.exit_code not in (None, 0):
+                return c
+        return None
+
+    def done(self) -> bool:
+        return all(not c.alive() for c in self.containers)
+
+    def exit_code(self) -> int:
+        codes = [c.exit_code or 0 for c in self.containers]
+        return max(codes) if codes else 0
+
+    def terminate(self):
+        for c in self.containers:
+            c.terminate()
+
+    def clear(self):
+        self.containers = []

@@ -1,0 +1,59 @@
+"""paddle.distributed.spawn analog — multiprocess SPMD entry for tests/dev.
+
+Reference: python/paddle/distributed/spawn.py:472 — forks nprocs trainer
+processes with the rank env set and joins them. Here each process becomes
+one jax.distributed participant (CPU backend in tests; one per host on real
+pods — where `launch` is the production path and spawn is the
+single-machine convenience).
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+from typing import Optional, Sequence
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker(fn, rank, nprocs, coordinator, devices_per_proc, args):
+    os.environ["PADDLE_TPU_COORDINATOR"] = coordinator
+    os.environ["PADDLE_TPU_NUM_PROCESSES"] = str(nprocs)
+    os.environ["PADDLE_TPU_PROCESS_ID"] = str(rank)
+    os.environ["PADDLE_TPU_LOCAL_RANK"] = str(rank)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    if devices_per_proc:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   f" --xla_force_host_platform_device_count={devices_per_proc}")
+    fn(*args)
+
+
+def spawn(func, args: Sequence = (), nprocs: int = -1, join: bool = True,
+          daemon: bool = False, devices_per_proc: int = 0, timeout: Optional[float] = 300):
+    """reference: paddle.distributed.spawn(func, args, nprocs, join)."""
+    if nprocs < 1:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    coordinator = f"127.0.0.1:{_free_port()}"
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, coordinator,
+                              devices_per_proc, tuple(args)),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    for p in procs:
+        p.join(timeout)
+    codes = [p.exitcode for p in procs]
+    if any(c not in (0, None) for c in codes):
+        raise RuntimeError(f"spawned processes failed with exit codes {codes}")
+    return procs

@@ -1,0 +1,198 @@
+"""TCPStore — rendezvous key-value store for multi-host bootstrap.
+
+Reference: paddle/phi/core/distributed/store/tcp_store.cc (MasterDaemon :45,
+TCPStore client :117), used by init_parallel_env (parallel.py:279) to
+exchange comm ids. On TPU the *collective* bootstrap is jax.distributed's
+coordination service (SURVEY §5.8) — this store exists for the
+orchestration layer: the launch CLI's node sign-in, elastic heartbeats, and
+user-level barriers (the role HTTPMaster/ETCDMaster play in
+launch/controllers/master.py:65,177).
+
+Wire protocol: newline-delimited UTF-8 — `CMD key [value]\n` → `OK [value]`.
+Commands: SET/GET/ADD/WAIT/DEL/KEYS/PING. WAIT blocks until the key exists
+(long-poll server side), the analog of tcp_store's wait().
+"""
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Optional
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        store = self.server._kv
+        cond = self.server._cond
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            parts = line.decode("utf-8").rstrip("\n").split(" ", 2)
+            cmd = parts[0].upper()
+            try:
+                if cmd == "SET":
+                    key, val = parts[1], parts[2] if len(parts) > 2 else ""
+                    with cond:
+                        store[key] = val
+                        cond.notify_all()
+                    self._reply("OK")
+                elif cmd == "GET":
+                    with cond:
+                        val = store.get(parts[1])
+                    self._reply("OK " + val if val is not None else "MISSING")
+                elif cmd == "ADD":
+                    key, n = parts[1], int(parts[2]) if len(parts) > 2 else 1
+                    with cond:
+                        cur = int(store.get(key, "0")) + n
+                        store[key] = str(cur)
+                        cond.notify_all()
+                    self._reply(f"OK {cur}")
+                elif cmd == "WAIT":
+                    key = parts[1]
+                    timeout = float(parts[2]) if len(parts) > 2 else 300.0
+                    deadline = time.time() + timeout
+                    with cond:
+                        while key not in store:
+                            remaining = deadline - time.time()
+                            if remaining <= 0 or not cond.wait(min(remaining, 1.0)):
+                                if time.time() >= deadline:
+                                    break
+                        ok = key in store
+                    self._reply("OK " + store[key] if ok else "TIMEOUT")
+                elif cmd == "DEL":
+                    with cond:
+                        store.pop(parts[1], None)
+                        cond.notify_all()
+                    self._reply("OK")
+                elif cmd == "KEYS":
+                    prefix = parts[1] if len(parts) > 1 else ""
+                    with cond:
+                        keys = [k for k in store if k.startswith(prefix)]
+                    self._reply("OK " + ",".join(keys))
+                elif cmd == "PING":
+                    self._reply("OK PONG")
+                else:
+                    self._reply("ERR unknown")
+            except (BrokenPipeError, ConnectionResetError):
+                return
+            except Exception as e:  # keep the daemon alive on bad input
+                try:
+                    self._reply(f"ERR {type(e).__name__}")
+                except OSError:
+                    return
+
+    def _reply(self, s: str):
+        self.wfile.write((s + "\n").encode("utf-8"))
+        self.wfile.flush()
+
+
+class MasterDaemon:
+    """The store server (reference: tcp_store.h:45 MasterDaemon). Runs in a
+    daemon thread inside the rank-0 launcher/trainer process."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+        socketserver.ThreadingTCPServer.allow_reuse_address = True
+        # handler threads must not block interpreter shutdown: a client that
+        # never disconnects (or a long-poll WAIT) would otherwise hang the
+        # process at exit
+        socketserver.ThreadingTCPServer.daemon_threads = True
+        self._server = socketserver.ThreadingTCPServer((host, port), _Handler)
+        self._server._kv = {}
+        self._server._cond = threading.Condition()
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class TCPStore:
+    """Client (reference: tcp_store.h:117). `is_master=True` spawns the
+    daemon in-process, matching `core.TCPStore(host, port, is_master, size)`
+    as used by init_parallel_env (parallel.py:279)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 300.0):
+        self._daemon = None
+        if is_master:
+            self._daemon = MasterDaemon(port=port)
+            port = self._daemon.port
+            host = "127.0.0.1" if host in ("0.0.0.0", "") else host
+        self.host, self.port = host, port
+        self.world_size = world_size
+        self.timeout = timeout
+        self._sock = None
+        self._lock = threading.Lock()
+        self._connect()
+
+    def _connect(self):
+        deadline = time.time() + self.timeout
+        last = None
+        while time.time() < deadline:
+            try:
+                self._sock = socket.create_connection((self.host, self.port),
+                                                      timeout=self.timeout)
+                self._f = self._sock.makefile("rwb")
+                return
+            except OSError as e:
+                last = e
+                time.sleep(0.2)
+        raise TimeoutError(f"TCPStore connect to {self.host}:{self.port}: {last}")
+
+    def _cmd(self, line: str) -> str:
+        with self._lock:
+            self._f.write((line + "\n").encode("utf-8"))
+            self._f.flush()
+            resp = self._f.readline().decode("utf-8").rstrip("\n")
+        if resp.startswith("ERR"):
+            raise RuntimeError(f"TCPStore: {resp}")
+        return resp
+
+    def set(self, key: str, value: str):
+        self._cmd(f"SET {key} {value}")
+
+    def get(self, key: str) -> Optional[str]:
+        resp = self._cmd(f"GET {key}")
+        return resp[3:] if resp.startswith("OK ") else (
+            "" if resp == "OK" else None)
+
+    def add(self, key: str, n: int = 1) -> int:
+        return int(self._cmd(f"ADD {key} {n}").split(" ", 1)[1])
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> str:
+        resp = self._cmd(f"WAIT {key} {timeout or self.timeout}")
+        if resp == "TIMEOUT":
+            raise TimeoutError(f"TCPStore.wait({key!r})")
+        return resp[3:] if resp.startswith("OK ") else ""
+
+    def delete(self, key: str):
+        self._cmd(f"DEL {key}")
+
+    def keys(self, prefix: str = "") -> list:
+        resp = self._cmd(f"KEYS {prefix}")
+        body = resp[3:] if resp.startswith("OK ") else ""
+        return [k for k in body.split(",") if k]
+
+    def barrier(self, name: str, world_size: Optional[int] = None,
+                timeout: Optional[float] = None):
+        """All `world_size` participants block until everyone arrives."""
+        n = world_size or self.world_size
+        arrived = self.add(f"__barrier__/{name}", 1)
+        if arrived >= n:
+            self.set(f"__barrier_done__/{name}", "1")
+        self.wait(f"__barrier_done__/{name}", timeout)
+
+    def close(self):
+        try:
+            if self._sock:
+                self._sock.close()
+        finally:
+            if self._daemon:
+                self._daemon.stop()

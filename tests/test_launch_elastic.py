@@ -1,0 +1,144 @@
+"""Launcher / TCPStore / elastic tests.
+
+Mirrors the reference's distributed-test mechanism (SURVEY §4): single-host
+multi-process subprocess clusters (test_dist_base.py:899) — here driven
+through the actual `paddle_tpu.distributed.launch` CLI on CPU workers."""
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore, MasterDaemon
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, ElasticStatus, rank_table)
+
+
+# --------------------------------------------------------------- TCPStore
+def test_store_set_get_add():
+    s = TCPStore(is_master=True)
+    s.set("k", "v1")
+    assert s.get("k") == "v1"
+    assert s.add("ctr", 2) == 2
+    assert s.add("ctr", 3) == 5
+    assert s.get("missing") is None
+    s.close()
+
+
+def test_store_wait_blocks_until_set():
+    master = TCPStore(is_master=True)
+    client = TCPStore("127.0.0.1", master.port)
+    result = {}
+
+    def waiter():
+        result["v"] = client.wait("late_key", timeout=10)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.3)
+    assert "v" not in result
+    master.set("late_key", "arrived")
+    t.join(timeout=10)
+    assert result["v"] == "arrived"
+    client.close()
+    master.close()
+
+
+def test_store_barrier_two_clients():
+    master = TCPStore(is_master=True, world_size=2)
+    c2 = TCPStore("127.0.0.1", master.port, world_size=2)
+    order = []
+
+    def side(store, tag):
+        store.barrier("b1", 2, timeout=10)
+        order.append(tag)
+
+    t1 = threading.Thread(target=side, args=(master, "a"))
+    t2 = threading.Thread(target=side, args=(c2, "b"))
+    t1.start()
+    time.sleep(0.2)
+    t2.start()
+    t1.join(10), t2.join(10)
+    assert sorted(order) == ["a", "b"]
+    c2.close()
+    master.close()
+
+
+# --------------------------------------------------------------- elastic
+def test_elastic_detects_membership_change():
+    store = TCPStore(is_master=True)
+    m1 = ElasticManager(store, "job", "n0", np_min=1, np_max=3,
+                        ttl=5.0, beat_interval=0.1)
+    m1.start()
+    assert m1.watch() == ElasticStatus.COMPLETED
+    # node joins → scale event under ELASTIC level
+    store.set("job/hb/n1", str(time.time()))
+    assert m1.watch() == ElasticStatus.RESTART
+    m1.mark_epoch()
+    assert m1.watch() == ElasticStatus.COMPLETED
+    assert rank_table(m1) == {"n0": 0, "n1": 1}
+    # node dies (stale beat) → RESTART
+    store.set("job/hb/n1", str(time.time() - 100))
+    assert m1.watch() == ElasticStatus.RESTART
+    m1.stop()
+    store.close()
+
+
+def test_elastic_below_quorum_holds():
+    store = TCPStore(is_master=True)
+    m = ElasticManager(store, "j2", "a", np_min=2, np_max=4,
+                       ttl=5.0, beat_interval=0.1)
+    m.start()
+    assert m.watch() == ElasticStatus.HOLD  # only 1 of min 2 nodes
+    m.stop()
+    store.close()
+
+
+# --------------------------------------------------------------- launch CLI
+WORKER = textwrap.dedent("""
+    import os, sys
+    rank = os.environ["PADDLE_TPU_PROCESS_ID"]
+    world = os.environ["PADDLE_TPU_NUM_PROCESSES"]
+    out_dir = sys.argv[1]
+    with open(os.path.join(out_dir, f"rank_{rank}.txt"), "w") as f:
+        f.write(f"{rank}/{world}")
+    if len(sys.argv) > 2 and sys.argv[2] == "fail" and rank == "1" \
+            and not os.path.exists(os.path.join(out_dir, "restarted")):
+        open(os.path.join(out_dir, "restarted"), "w").write("1")
+        sys.exit(7)
+""")
+
+
+def _run_launch(tmp_path, extra_args, script_args):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           *extra_args, str(script), str(tmp_path), *script_args]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=120, cwd="/root/repo")
+
+
+def test_launch_two_procs_single_node(tmp_path):
+    r = _run_launch(tmp_path, ["--nproc_per_node", "2"], [])
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "rank_0.txt").read_text() == "0/2"
+    assert (tmp_path / "rank_1.txt").read_text() == "1/2"
+
+
+def test_launch_restarts_on_failure(tmp_path):
+    r = _run_launch(tmp_path, ["--nproc_per_node", "2", "--elastic_level", "1",
+                               "--max_restarts", "2"], ["fail"])
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "restarted").exists()
+    assert "restart 1/2" in r.stderr
+
+
+def test_launch_fails_without_elastic(tmp_path):
+    r = _run_launch(tmp_path, ["--nproc_per_node", "2"], ["fail"])
+    assert r.returncode == 7
