@@ -69,3 +69,7 @@ class DataParallel:
 from .spawn import spawn  # noqa: E402,F401  (reference: distributed/spawn.py:472)
 from .store import TCPStore, MasterDaemon  # noqa: E402,F401
 from . import launch  # noqa: E402,F401
+from . import auto_parallel  # noqa: E402,F401
+from .auto_parallel import (  # noqa: E402,F401
+    ProcessMesh, shard_tensor, shard_op, reshard,
+)
