@@ -55,6 +55,11 @@ from . import static  # noqa: F401,E402
 from .static.program import enable_static, disable_static  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
+from . import text  # noqa: F401,E402
+from . import audio  # noqa: F401,E402
+from .core.flags import set_flags, get_flags  # noqa: F401,E402
 
 
 def is_compiled_with_cuda() -> bool:

@@ -1,0 +1,235 @@
+"""paddle.audio analog — audio features and functional DSP.
+
+Reference (SURVEY §2.3): python/paddle/audio/ — features (Spectrogram,
+MelSpectrogram, LogMelSpectrogram, MFCC layers) and functional
+(get_window, compute_fbank_matrix, hz↔mel, power_to_db, create_dct).
+TPU-native: STFT as frame+window+rfft in pure jnp — framing lowers to one
+gather and the FFT batch runs on-device; no torchaudio-style C++ kernels.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..nn.layer import Layer
+
+
+# ---------------------------------------------------------------- functional
+def hz_to_mel(freq, htk=False):
+    """reference: audio/functional/functional.py hz_to_mel."""
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+    freq = np.asarray(freq, dtype=np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(freq >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(freq, 1e-10) / min_log_hz) / logstep,
+                    mels)
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+    mel = np.asarray(mel, dtype=np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(mel >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (mel - min_log_mel)), freqs)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return np.linspace(0, sr / 2, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    """Mel filterbank [n_mels, 1+n_fft//2] (reference:
+    audio/functional/functional.py compute_fbank_matrix)."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return weights.astype(np.float32)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """DCT-II matrix [n_mels, n_mfcc] (reference: functional.py create_dct)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[:, None]
+    dct = np.cos(math.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct[0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return dct.T.astype(np.float32)
+
+
+def get_window(window: str, win_length: int, fftbins=True):
+    """hann/hamming/blackman/ones (reference: functional/window.py)."""
+    N = win_length + (0 if fftbins else -1)
+    n = np.arange(win_length, dtype=np.float64)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * n / max(N, 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * n / max(N, 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * n / max(N, 1))
+             + 0.08 * np.cos(4 * math.pi * n / max(N, 1)))
+    elif window in ("ones", "rectangular", "boxcar"):
+        w = np.ones(win_length)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return w.astype(np.float32)
+
+
+def power_to_db(magnitude, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """reference: functional.py power_to_db."""
+    def fn(x):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+        log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+    if isinstance(magnitude, Tensor):
+        return apply_op("power_to_db", fn, [magnitude])
+    return np.asarray(fn(jnp.asarray(magnitude)))
+
+
+def _stft(x, n_fft, hop_length, win, center=True, power=2.0):
+    """[B, T] → [B, 1+n_fft//2, frames] magnitude^power."""
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)], mode="reflect")
+    T = x.shape[-1]
+    n_frames = 1 + (T - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length +
+           jnp.arange(n_fft)[None, :])
+    frames = x[..., idx] * win  # [B, frames, n_fft]
+    spec = jnp.fft.rfft(frames, axis=-1)
+    mag = jnp.abs(spec) ** power
+    return jnp.swapaxes(mag, -1, -2)
+
+
+# ---------------------------------------------------------------- features
+class Spectrogram(Layer):
+    """reference: audio/features/layers.py Spectrogram."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        w = get_window(window, self.win_length)
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            w = np.pad(w, (lpad, n_fft - self.win_length - lpad))
+        self._win = jnp.asarray(w)
+        self.power = power
+        self.center = center
+
+    def forward(self, x):
+        n_fft, hop, win, center, power = (self.n_fft, self.hop_length,
+                                          self._win, self.center, self.power)
+
+        def fn(a):
+            return _stft(a, n_fft, hop, win, center, power)
+        return apply_op("spectrogram", fn, [x])
+
+
+class MelSpectrogram(Layer):
+    """reference: features/layers.py MelSpectrogram."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, n_mels=64,
+                 f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spec = Spectrogram(n_fft, hop_length, win_length, window,
+                                 power, center)
+        self._fbank = jnp.asarray(compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm))
+
+    def forward(self, x):
+        spec = self._spec(x)
+        fbank = self._fbank
+
+        def fn(s):
+            return jnp.einsum("mf,...ft->...mt", fbank, s)
+        return apply_op("mel_spectrogram", fn, [spec])
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, n_mels=64,
+                 f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                   power, center, n_mels, f_min, f_max, htk, norm)
+        self._ref, self._amin, self._top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        return power_to_db(self._mel(x), self._ref, self._amin, self._top_db)
+
+
+class MFCC(Layer):
+    """reference: features/layers.py MFCC."""
+
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._logmel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
+                                         window, power, center, n_mels, f_min,
+                                         f_max, htk, norm, top_db=top_db)
+        self._dct = jnp.asarray(create_dct(n_mfcc, n_mels))
+
+    def forward(self, x):
+        lm = self._logmel(x)
+        dct = self._dct
+
+        def fn(s):
+            return jnp.einsum("mk,...mt->...kt", dct, s)
+        return apply_op("mfcc", fn, [lm])
+
+
+functional = type("functional", (), {
+    "hz_to_mel": staticmethod(hz_to_mel), "mel_to_hz": staticmethod(mel_to_hz),
+    "mel_frequencies": staticmethod(mel_frequencies),
+    "fft_frequencies": staticmethod(fft_frequencies),
+    "compute_fbank_matrix": staticmethod(compute_fbank_matrix),
+    "create_dct": staticmethod(create_dct),
+    "get_window": staticmethod(get_window),
+    "power_to_db": staticmethod(power_to_db),
+})
+features = type("features", (), {
+    "Spectrogram": Spectrogram, "MelSpectrogram": MelSpectrogram,
+    "LogMelSpectrogram": LogMelSpectrogram, "MFCC": MFCC,
+})

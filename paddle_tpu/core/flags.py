@@ -1,0 +1,83 @@
+"""Global flag registry — paddle.set_flags/get_flags analog.
+
+Reference (SURVEY §5.6): gflags exported through PADDLE_DEFINE_EXPORTED_*
+(phi/core/flags.h:43-95, 89 flags in phi/core/flags.cc), readable/settable
+from Python via paddle.set_flags / FLAGS_* env. Here one typed registry —
+the reference's dual fluid/phi registries collapse (SURVEY §5.6 explicitly
+calls for that). Flags that map to XLA/jax controls apply them on set.
+
+NaN/Inf checking (SURVEY §5.2): FLAGS_check_nan_inf scans every op output on
+the eager path (reference: eager/nan_inf_utils.cc per-op output scans) and
+raises with the op name — on the jit path, use jax's debug_nans which this
+flag also toggles.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Any] = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_benchmark": False,
+    "FLAGS_cudnn_deterministic": True,   # TPU: deterministic by construction
+    "FLAGS_use_autotune": True,          # XLA autotuning on by default
+    "FLAGS_allocator_strategy": "xla",   # no custom allocator on TPU
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.0,
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_max_inplace_grad_add": 0,
+    "FLAGS_embedding_deterministic": 1,
+    "FLAGS_sync_nccl_allreduce": False,  # XLA collectives are ordered
+    "FLAGS_stop_check_timeout": 300,
+}
+
+# fast-path mirror consumed by apply_op (bool lookup, no dict churn)
+check_nan_inf: bool = False
+benchmark: bool = False
+
+
+def _apply_side_effects(name: str, value):
+    global check_nan_inf, benchmark
+    if name == "FLAGS_check_nan_inf":
+        check_nan_inf = bool(int(value)) if not isinstance(value, bool) else value
+        try:
+            import jax
+            jax.config.update("jax_debug_nans", check_nan_inf)
+        except Exception:
+            pass
+    elif name == "FLAGS_benchmark":
+        benchmark = bool(int(value)) if not isinstance(value, bool) else value
+
+
+def set_flags(flags: Dict[str, Any]):
+    """reference: paddle.set_flags (pybind global_value_getter_setter.cc)."""
+    for name, value in flags.items():
+        if name not in _REGISTRY:
+            _REGISTRY[name] = value  # accept unknown for fwd-compat, like env
+        else:
+            _REGISTRY[name] = value
+        _apply_side_effects(name, value)
+
+
+def get_flags(flags) -> Dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    return {name: _REGISTRY.get(name) for name in flags}
+
+
+def _init_from_env():
+    for key, val in os.environ.items():
+        if key.startswith("FLAGS_"):
+            cur = _REGISTRY.get(key)
+            if isinstance(cur, bool):
+                parsed = val.lower() in ("1", "true", "yes")
+            elif isinstance(cur, int):
+                parsed = int(val)
+            elif isinstance(cur, float):
+                parsed = float(val)
+            else:
+                parsed = val
+            _REGISTRY[key] = parsed
+            _apply_side_effects(key, parsed)
+
+
+_init_from_env()

@@ -22,7 +22,22 @@ import jax
 import jax.numpy as jnp
 
 from . import autograd
+from . import flags
 from .dtype import convert_dtype, get_default_dtype, is_floating_point
+
+
+def _scan_nan_inf(name, outs):
+    """FLAGS_check_nan_inf eager scan (reference: eager/nan_inf_utils.cc
+    CheckTensorHasNanOrInf called from generated forwards). Tracer-safe: the
+    check is skipped inside jit traces, where jax_debug_nans covers it."""
+    for o in outs:
+        if isinstance(o, jax.core.Tracer) or not jnp.issubdtype(
+                o.dtype, jnp.floating):
+            continue
+        if bool(jnp.any(~jnp.isfinite(o))):
+            raise FloatingPointError(
+                f"Operator {name} output contains NaN/Inf "
+                f"(FLAGS_check_nan_inf is set)")
 
 _PRINT_OPTS = {"precision": 8, "threshold": 1000, "edgeitems": 3, "linewidth": 80}
 
@@ -247,6 +262,8 @@ def apply_op(name, fn, tensor_args, static_kwargs=None, n_outputs=None):
         out = fn(*arrays, **static_kwargs)
         multi = isinstance(out, (tuple, list))
         outs = tuple(out) if multi else (out,)
+        if flags.check_nan_inf:
+            _scan_nan_inf(name, outs)
         ts = tuple(Tensor(o, stop_gradient=True) for o in outs)
         return ts if multi else ts[0]
 
@@ -255,6 +272,8 @@ def apply_op(name, fn, tensor_args, static_kwargs=None, n_outputs=None):
         return tuple(res) if isinstance(res, (tuple, list)) else (res,)
 
     outs, vjp_fn = jax.vjp(pure, *arrays)
+    if flags.check_nan_inf:
+        _scan_nan_inf(name, outs)
     multi_out = n_outputs is not None or len(outs) > 1
     avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
 

@@ -1,0 +1,201 @@
+"""paddle.quantization analog — QAT fake-quant + PTQ calibration.
+
+Reference (SURVEY §2.3): python/paddle/quantization/ — imperative QAT
+(imperative/qat.py ImperativeQuantAware swaps Linear/Conv2D for quantized
+twins with FakeQuant layers), PTQ with absmax observers, quanter configs.
+TPU-native: fake-quant is a pure jnp round/clip with a straight-through
+estimator (identity gradient) expressed as `x + stop_gradient(q(x) - x)` —
+no custom C++ fake_quantize kernels (reference:
+operators/fake_quantize_op.cu); XLA fuses the quant sim into adjacent ops.
+int8 *execution* is not simulated — on TPU the deploy dtype is int8/bf16 via
+XLA, and this module produces the scales for that conversion.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..nn.layer import Layer
+from .. import nn as _nn
+
+
+# ------------------------------------------------------------- fake quant
+def fake_quant(x, scale, bit_length=8):
+    """Symmetric per-tensor fake quantization with STE gradient
+    (reference: FakeQuantizeAbsMax, operators/fake_quantize_op.cc)."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    def fn(a, s):
+        s = jnp.maximum(s, 1e-9)
+        q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax) * s / qmax
+        return a + jax.lax.stop_gradient(q - a)  # STE
+    return apply_op("fake_quant", fn, [x, scale])
+
+
+def fake_channel_wise_quant(x, scales, bit_length=8, quant_axis=0):
+    """Per-channel weight fake quant (reference:
+    FakeChannelWiseQuantizeAbsMax)."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    def fn(a, s):
+        s = jnp.maximum(s, 1e-9)
+        shape = [1] * a.ndim
+        shape[quant_axis] = -1
+        s = s.reshape(shape)
+        q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax) * s / qmax
+        return a + jax.lax.stop_gradient(q - a)
+    return apply_op("fake_channel_quant", fn, [x, scales])
+
+
+def absmax_scale(x, quant_axis: Optional[int] = None):
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if quant_axis is None:
+        return jnp.max(jnp.abs(arr))
+    axes = tuple(i for i in range(arr.ndim) if i != quant_axis)
+    return jnp.max(jnp.abs(arr), axis=axes)
+
+
+# ------------------------------------------------------------- quanters
+class BaseQuanter(Layer):
+    def scales(self):
+        raise NotImplementedError
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """Moving-average absmax activation quanter (reference:
+    quantization/quanters/abs_max.py FakeQuanterWithAbsMaxObserver)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32"):
+        super().__init__()
+        self._rate = moving_rate
+        self._bits = bit_length
+        self._scale = None
+
+    def forward(self, x):
+        cur = absmax_scale(x)
+        if self.training:
+            if self._scale is None:
+                self._scale = cur
+            else:
+                self._scale = self._rate * self._scale + (1 - self._rate) * cur
+        s = self._scale if self._scale is not None else cur
+        return fake_quant(x, Tensor(s), self._bits)
+
+    def scales(self):
+        return Tensor(self._scale) if self._scale is not None else None
+
+
+class AbsMaxChannelWiseWeightQuanter(BaseQuanter):
+    def __init__(self, bit_length=8, quant_axis=1):
+        super().__init__()
+        self._bits = bit_length
+        self._axis = quant_axis
+        self._scale = None
+
+    def forward(self, w):
+        s = absmax_scale(w, self._axis)
+        self._scale = s
+        return fake_channel_wise_quant(w, Tensor(s), self._bits, self._axis)
+
+    def scales(self):
+        return Tensor(self._scale) if self._scale is not None else None
+
+
+# ------------------------------------------------------------- config
+class QuantConfig:
+    """reference: quantization/config.py QuantConfig."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation or FakeQuanterWithAbsMaxObserver
+        self.weight = weight or AbsMaxChannelWiseWeightQuanter
+        self._type_configs: Dict[type, dict] = {}
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        for t in layer_types:
+            self._type_configs[t] = {"activation": activation or self.activation,
+                                     "weight": weight or self.weight}
+
+    def _config_for(self, layer):
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        if isinstance(layer, (_nn.Linear, _nn.Conv2D)):
+            return {"activation": self.activation, "weight": self.weight}
+        return None
+
+
+# ------------------------------------------------------------- quant layers
+class QuantedLayer(Layer):
+    """Wraps a Linear/Conv2D: fake-quant activations + weights around the
+    original forward (reference: nn/quant wrappers in imperative qat)."""
+
+    def __init__(self, inner, act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = inner
+        self.act_quanter = act_quanter() if isinstance(act_quanter, type) else act_quanter
+        self.weight_quanter = weight_quanter() if isinstance(weight_quanter, type) else weight_quanter
+
+    def forward(self, x):
+        x = self.act_quanter(x)
+        w = self.inner.weight
+        qw = self.weight_quanter(w)
+        orig = w._data
+        w._data = qw._data
+        try:
+            out = self.inner(x)
+        finally:
+            w._data = orig
+        return out
+
+
+class QAT:
+    """Quantization-aware training driver (reference: quantization/qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace=False) -> Layer:
+        return _swap_layers(model, self.config, observe_only=False)
+
+    def convert(self, model: Layer, inplace=False) -> Layer:
+        """Fold quanters away for deployment: bake fake-quantized weights."""
+        for name, sub in list(model.named_children()):
+            if isinstance(sub, QuantedLayer):
+                inner = sub.inner
+                qw = sub.weight_quanter(inner.weight)
+                inner.weight.set_value(qw.detach())
+                setattr(model, name, inner)
+            else:
+                self.convert(sub, inplace=True)
+        return model
+
+
+class PTQ:
+    """Post-training quantization: observe absmax during calibration runs,
+    then convert (reference: quantization/ptq.py)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace=False) -> Layer:
+        return _swap_layers(model, self.config, observe_only=True)
+
+    def convert(self, model: Layer, inplace=False) -> Layer:
+        return QAT(self.config).convert(model)
+
+
+def _swap_layers(model: Layer, config: QuantConfig, observe_only: bool) -> Layer:
+    for name, sub in list(model.named_children()):
+        cfg = config._config_for(sub)
+        if cfg is not None and not isinstance(sub, QuantedLayer):
+            setattr(model, name, QuantedLayer(sub, cfg["activation"],
+                                              cfg["weight"]))
+        else:
+            _swap_layers(sub, config, observe_only)
+    return model

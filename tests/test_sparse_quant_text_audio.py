@@ -1,0 +1,165 @@
+"""Sparse / quantization / text / audio / flags coverage (SURVEY §2.3)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import sparse, quantization as Q, text, audio
+
+
+# ----------------------------------------------------------------- sparse
+def test_coo_roundtrip_and_values_grad():
+    dense = np.array([[1., 0., 2.], [0., 3., 0.]], np.float32)
+    x = paddle.to_tensor(dense)
+    x.stop_gradient = False
+    coo = x.to_sparse_coo()
+    assert coo.is_sparse_coo() and coo.nnz() == 3
+    np.testing.assert_allclose(coo.to_dense().numpy(), dense)
+    # grad flows dense -> sparse -> dense
+    y = sparse.relu(coo).to_dense().sum()
+    y.backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(x.grad.numpy(), (dense > 0).astype(np.float32))
+
+
+def test_csr_roundtrip():
+    dense = np.array([[1., 0., 2.], [0., 3., 0.]], np.float32)
+    csr = paddle.to_tensor(dense).to_sparse_csr()
+    assert csr.is_sparse_csr()
+    np.testing.assert_allclose(np.asarray(csr.crows_), [0, 2, 3])
+    np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+    coo = csr.to_sparse_coo()
+    np.testing.assert_allclose(coo.to_dense().numpy(), dense)
+
+
+def test_sparse_matmul_and_masked_matmul():
+    rng = np.random.RandomState(0)
+    dense = (rng.rand(4, 6) > 0.5).astype(np.float32) * rng.randn(4, 6).astype(np.float32)
+    y = rng.randn(6, 3).astype(np.float32)
+    coo = paddle.to_tensor(dense).to_sparse_coo()
+    out = sparse.matmul(coo, paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5, atol=1e-6)
+
+    a = rng.randn(4, 5).astype(np.float32)
+    b = rng.randn(5, 6).astype(np.float32)
+    mm = sparse.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b), coo)
+    full = a @ b
+    mask = (dense != 0)
+    np.testing.assert_allclose(mm.to_dense().numpy(), full * mask, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_sparse_add_same_pattern():
+    d = np.array([[1., 0.], [0., 2.]], np.float32)
+    a = paddle.to_tensor(d).to_sparse_coo()
+    b = paddle.to_tensor(d * 3).to_sparse_coo()
+    np.testing.assert_allclose(sparse.add(a, b).to_dense().numpy(), d * 4)
+
+
+def test_sparse_softmax_rows():
+    d = np.array([[1., 0., 2.], [0., 5., 0.]], np.float32)
+    coo = paddle.to_tensor(d).to_sparse_coo()
+    sm = sparse.nn.Softmax()(coo)
+    out = sm.to_dense().numpy()
+    # row 0: softmax over [1,2]; row 1: single entry -> 1.0
+    e = np.exp([1., 2.])
+    np.testing.assert_allclose(out[0, [0, 2]], e / e.sum(), rtol=1e-5)
+    np.testing.assert_allclose(out[1, 1], 1.0, rtol=1e-6)
+
+
+# ----------------------------------------------------------- quantization
+def test_fake_quant_ste_grad():
+    x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32))
+    x.stop_gradient = False
+    y = Q.fake_quant(x, paddle.to_tensor(1.0), bit_length=8)
+    err = np.abs(y.numpy() - x.numpy()).max()
+    assert err < 1 / 127 + 1e-6  # quantized to ~1/127 grid
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(11), rtol=1e-6)  # STE
+
+
+def test_qat_quantize_and_convert():
+    model = nn.Sequential(nn.Linear(8, 4), nn.ReLU(), nn.Linear(4, 2))
+    q = Q.QAT(Q.QuantConfig())
+    qmodel = q.quantize(model)
+    x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+    out = qmodel(x)
+    assert list(out.shape) == [2, 2]
+    # training still works through fake-quant
+    loss = out.sum()
+    loss.backward()
+    deployed = q.convert(qmodel)
+    out2 = deployed(x)
+    assert list(out2.shape) == [2, 2]
+
+
+def test_ptq_observe():
+    model = nn.Sequential(nn.Linear(4, 4))
+    p = Q.PTQ()
+    qm = p.quantize(model)
+    for _ in range(3):
+        qm(paddle.to_tensor(np.random.randn(2, 4).astype(np.float32)))
+    p.convert(qm)
+
+
+# ------------------------------------------------------------------- text
+def test_viterbi_decode_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    B, T, N = 2, 4, 5
+    emis = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans),
+        include_bos_eos_tag=False)
+    # brute force
+    import itertools
+    for b in range(B):
+        best, best_path = -1e9, None
+        for path in itertools.product(range(N), repeat=T):
+            s = emis[b, 0, path[0]]
+            for t in range(1, T):
+                s += trans[path[t - 1], path[t]] + emis[b, t, path[t]]
+            if s > best:
+                best, best_path = s, path
+        np.testing.assert_allclose(scores.numpy()[b], best, rtol=1e-5)
+        assert tuple(paths.numpy()[b]) == best_path
+
+
+# ------------------------------------------------------------------ audio
+def test_mel_spectrogram_shapes_and_energy():
+    sr = 16000
+    t = np.linspace(0, 1, sr, endpoint=False)
+    wav = np.sin(2 * np.pi * 440 * t).astype(np.float32)[None, :]
+    mel = audio.features.MelSpectrogram(sr=sr, n_fft=512, n_mels=40)
+    out = mel(paddle.to_tensor(wav))
+    assert out.shape[0] == 1 and out.shape[1] == 40
+    m = out.numpy()[0]
+    # energy concentrates near 440 Hz's mel bin
+    peak_bin = m.sum(axis=1).argmax()
+    freqs = audio.mel_frequencies(42, 50.0, sr / 2)
+    assert 300 < freqs[peak_bin + 1] < 700
+
+
+def test_mfcc_runs():
+    wav = np.random.randn(2, 8000).astype(np.float32)
+    mfcc = audio.features.MFCC(sr=8000, n_mfcc=13, n_fft=256)
+    out = mfcc(paddle.to_tensor(wav))
+    assert out.shape[0] == 2 and out.shape[1] == 13
+
+
+def test_fbank_matrix_rows_normalized():
+    fb = audio.compute_fbank_matrix(16000, 512, n_mels=26)
+    assert fb.shape == (26, 257)
+    assert (fb >= 0).all() and fb.sum(axis=1).min() > 0
+
+
+# ------------------------------------------------------------------ flags
+def test_flags_nan_inf_check():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError):
+            _ = paddle.log(x * 0 - 1)  # log(-1) = nan
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+    assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is False
