@@ -1,0 +1,228 @@
+// Native data-pipeline core: blocking batch queue + mmap record readers.
+//
+// TPU-native counterpart of the reference's C++ data layer (SURVEY §2.1
+// "Data pipeline (C++)"): framework/data_feed.cc (file readers feeding
+// training threads through a BlockingQueue<std::vector<Record>>),
+// framework/blocking_queue.h, and imperative/data_loader.cc (the
+// multiprocess DataLoader's C++ side). On TPU the consumer is the host
+// input pipeline that keeps jax.device_put fed between steps; the hot
+// properties are the same as the reference's: no GIL on the producer side,
+// bounded memory, many reader threads per file shard.
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
+// Memory protocol: the queue owns copies of pushed payloads; pop hands the
+// consumer a malloc'd buffer it must free via pt_buffer_free (the Python
+// wrapper copies into numpy then frees immediately).
+//
+// Record file format ("PTR1"): magic(4) | u64 count | count x (u64 len |
+// bytes). Writers live in Python (paddle_tpu/io/native.py); readers here
+// mmap the file, so record payloads are served zero-copy from page cache.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------- queue
+struct PtBuffer {
+  uint8_t* data;
+  uint64_t size;
+};
+
+struct PtQueue {
+  std::deque<PtBuffer> items;
+  std::mutex mu;
+  std::condition_variable not_empty;
+  std::condition_variable not_full;
+  size_t capacity;
+  std::atomic<bool> closed{false};
+};
+
+PtQueue* pt_queue_create(uint64_t capacity) {
+  auto* q = new PtQueue();
+  q->capacity = capacity ? capacity : 1;
+  return q;
+}
+
+// push copies [data, data+size); blocks while full; returns 0 ok, -1 closed
+int pt_queue_push(PtQueue* q, const uint8_t* data, uint64_t size) {
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_full.wait(lk, [q] { return q->items.size() < q->capacity ||
+                                    q->closed.load(); });
+  if (q->closed.load()) return -1;
+  uint8_t* copy = static_cast<uint8_t*>(std::malloc(size));
+  if (!copy && size) return -2;
+  std::memcpy(copy, data, size);
+  q->items.push_back(PtBuffer{copy, size});
+  lk.unlock();
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// pop blocks until an item or close+drained; returns 0 ok, -1 drained-closed
+int pt_queue_pop(PtQueue* q, uint8_t** out_data, uint64_t* out_size) {
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_empty.wait(lk, [q] { return !q->items.empty() || q->closed.load(); });
+  if (q->items.empty()) return -1;
+  PtBuffer b = q->items.front();
+  q->items.pop_front();
+  lk.unlock();
+  q->not_full.notify_one();
+  *out_data = b.data;
+  *out_size = b.size;
+  return 0;
+}
+
+uint64_t pt_queue_size(PtQueue* q) {
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->items.size();
+}
+
+void pt_queue_close(PtQueue* q) {
+  q->closed.store(true);
+  q->not_empty.notify_all();
+  q->not_full.notify_all();
+}
+
+// contract: destroy only after readers joined (pt_reader_stop)
+void pt_queue_destroy(PtQueue* q) {
+  pt_queue_close(q);
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    for (auto& b : q->items) std::free(b.data);
+    q->items.clear();
+  }
+  delete q;
+}
+
+void pt_buffer_free(uint8_t* data) { std::free(data); }
+
+// ---------------------------------------------------------------- reader
+struct PtRecordFile {
+  int fd = -1;
+  uint8_t* map = nullptr;
+  uint64_t map_size = 0;
+  uint64_t count = 0;
+  std::vector<std::pair<const uint8_t*, uint64_t>> records;
+};
+
+// open + index a PTR1 file; returns nullptr on failure
+PtRecordFile* pt_records_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 12) { ::close(fd); return nullptr; }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (map == MAP_FAILED) { ::close(fd); return nullptr; }
+  auto* f = new PtRecordFile();
+  f->fd = fd;
+  f->map = static_cast<uint8_t*>(map);
+  f->map_size = st.st_size;
+  if (std::memcmp(f->map, "PTR1", 4) != 0) {
+    munmap(map, st.st_size); ::close(fd); delete f; return nullptr;
+  }
+  uint64_t count;
+  std::memcpy(&count, f->map + 4, 8);
+  const uint8_t* p = f->map + 12;
+  const uint8_t* end = f->map + f->map_size;
+  f->records.reserve(count);
+  for (uint64_t i = 0; i < count && p + 8 <= end; ++i) {
+    uint64_t len;
+    std::memcpy(&len, p, 8);
+    p += 8;
+    if (p + len > end) break;
+    f->records.emplace_back(p, len);
+    p += len;
+  }
+  f->count = f->records.size();
+  return f;
+}
+
+uint64_t pt_records_count(PtRecordFile* f) { return f->count; }
+
+// zero-copy view of record i (valid while file open)
+int pt_records_get(PtRecordFile* f, uint64_t i, const uint8_t** data,
+                   uint64_t* size) {
+  if (i >= f->count) return -1;
+  *data = f->records[i].first;
+  *size = f->records[i].second;
+  return 0;
+}
+
+void pt_records_close(PtRecordFile* f) {
+  if (!f) return;
+  if (f->map) munmap(f->map, f->map_size);
+  if (f->fd >= 0) ::close(f->fd);
+  delete f;
+}
+
+// --------------------------------------------------- threaded prefetcher
+// Readers stride the record index space (rank/world sharding like the
+// reference's DataFeed file-list split) and push payloads into the queue.
+struct PtReader {
+  PtRecordFile* file;
+  PtQueue* queue;
+  std::vector<std::thread> threads;
+  std::atomic<uint64_t> cursor{0};
+  std::atomic<bool> stop{false};
+  uint64_t begin = 0, end = 0, epochs = 1;
+};
+
+static void reader_loop(PtReader* r) {
+  // one shared index space of epochs*span items; threads race on the atomic
+  // cursor, so records interleave across threads (order is not preserved —
+  // same contract as the reference's multi-thread DataFeed)
+  const uint64_t span = r->end - r->begin;
+  const uint64_t total = r->epochs * span;
+  while (!r->stop.load()) {
+    uint64_t i = r->cursor.fetch_add(1);
+    if (i >= total) break;
+    uint64_t idx = r->begin + (i % span);
+    const uint8_t* data; uint64_t size;
+    if (pt_records_get(r->file, idx, &data, &size) != 0) break;
+    if (pt_queue_push(r->queue, data, size) != 0) return;  // queue closed
+  }
+}
+
+// begin/end: this worker's shard [begin, end); n_threads readers share it
+PtReader* pt_reader_start(PtRecordFile* f, PtQueue* q, uint64_t begin,
+                          uint64_t end, uint64_t n_threads, uint64_t epochs) {
+  auto* r = new PtReader();
+  r->file = f;
+  r->queue = q;
+  r->begin = begin;
+  r->end = end > f->count ? f->count : end;
+  r->epochs = epochs ? epochs : 1;
+  if (n_threads == 0) n_threads = 1;
+  for (uint64_t t = 0; t < n_threads; ++t)
+    r->threads.emplace_back(reader_loop, r);
+  return r;
+}
+
+void pt_reader_stop(PtReader* r) {
+  r->stop.store(true);
+  pt_queue_close(r->queue);
+  for (auto& t : r->threads)
+    if (t.joinable()) t.join();
+  delete r;
+}
+
+// done when all records of all epochs pushed (cursor past total span)
+int pt_reader_done(PtReader* r) {
+  uint64_t span = r->end - r->begin;
+  return r->cursor.load() >= r->epochs * span ? 1 : 0;
+}
+
+}  // extern "C"

@@ -17,3 +17,8 @@ from .sampler import (  # noqa: F401
     BatchSampler, DistributedBatchSampler,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+
+from .native import (  # noqa: E402,F401
+    native_available, write_records, write_sample_records,
+    RecordDataset, NativeRecordReader, BlockingQueue,
+)

@@ -1,0 +1,280 @@
+"""ctypes bindings for the native data-pipeline core (native/src/
+data_pipeline.cc) — C++ blocking queue + mmap record readers.
+
+Reference analog (SURVEY §2.1 "Data pipeline (C++)"): framework/
+data_feed.cc readers + BlockingQueue feeding training threads without
+holding the GIL, and imperative/data_loader.cc. The .so builds on first use
+with g++ (no pybind11 in this image — plain C ABI via ctypes); everything
+degrades gracefully to the pure-Python DataLoader when a toolchain is
+unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import struct
+import subprocess
+import threading
+from typing import Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "src", "data_pipeline.cc")
+_LIB_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_LIB = os.path.join(_LIB_DIR, "libptnative.so")
+
+_lib = None
+_lib_err: Optional[str] = None
+_build_lock = threading.Lock()
+
+
+def _build() -> Optional[str]:
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", _LIB]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"toolchain unavailable: {e}"
+    if r.returncode != 0:
+        return f"g++ failed: {r.stderr[-2000:]}"
+    return None
+
+
+def load_native():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        if not os.path.exists(_LIB) or (
+                os.path.exists(_SRC) and
+                os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+            err = _build()
+            if err:
+                _lib_err = err
+                return None
+        lib = ctypes.CDLL(_LIB)
+        u64, p8 = ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8)
+        lib.pt_queue_create.restype = ctypes.c_void_p
+        lib.pt_queue_create.argtypes = [u64]
+        lib.pt_queue_push.restype = ctypes.c_int
+        lib.pt_queue_push.argtypes = [ctypes.c_void_p, p8, u64]
+        lib.pt_queue_pop.restype = ctypes.c_int
+        lib.pt_queue_pop.argtypes = [ctypes.c_void_p, ctypes.POINTER(p8),
+                                     ctypes.POINTER(u64)]
+        lib.pt_queue_size.restype = u64
+        lib.pt_queue_size.argtypes = [ctypes.c_void_p]
+        lib.pt_queue_close.argtypes = [ctypes.c_void_p]
+        lib.pt_queue_destroy.argtypes = [ctypes.c_void_p]
+        lib.pt_buffer_free.argtypes = [p8]
+        lib.pt_records_open.restype = ctypes.c_void_p
+        lib.pt_records_open.argtypes = [ctypes.c_char_p]
+        lib.pt_records_count.restype = u64
+        lib.pt_records_count.argtypes = [ctypes.c_void_p]
+        lib.pt_records_get.restype = ctypes.c_int
+        lib.pt_records_get.argtypes = [ctypes.c_void_p, u64,
+                                       ctypes.POINTER(p8), ctypes.POINTER(u64)]
+        lib.pt_records_close.argtypes = [ctypes.c_void_p]
+        lib.pt_reader_start.restype = ctypes.c_void_p
+        lib.pt_reader_start.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        u64, u64, u64, u64]
+        lib.pt_reader_stop.argtypes = [ctypes.c_void_p]
+        lib.pt_reader_done.restype = ctypes.c_int
+        lib.pt_reader_done.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+def native_error() -> Optional[str]:
+    load_native()
+    return _lib_err
+
+
+# ------------------------------------------------------------- file format
+def write_records(path: str, payloads: Iterable[bytes]):
+    """Write a PTR1 record file (magic | u64 count | (u64 len | bytes)*)."""
+    payloads = list(payloads)
+    with open(path, "wb") as f:
+        f.write(b"PTR1")
+        f.write(struct.pack("<Q", len(payloads)))
+        for p in payloads:
+            f.write(struct.pack("<Q", len(p)))
+            f.write(p)
+    return path
+
+
+def write_sample_records(path: str, samples: Iterable) -> str:
+    """Pickle each sample into a record (numpy arrays stay raw-buffer)."""
+    return write_records(path, (pickle.dumps(s, protocol=4) for s in samples))
+
+
+# ------------------------------------------------------------- dataset view
+class RecordFile:
+    """mmap-indexed record file (zero-copy reads via the C++ core)."""
+
+    def __init__(self, path: str):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError(f"native pipeline unavailable: {_lib_err}")
+        self._lib = lib
+        self._h = lib.pt_records_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open record file {path}")
+        self.path = path
+
+    def __len__(self):
+        return self._lib.pt_records_count(self._h)
+
+    def get_bytes(self, i: int) -> bytes:
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        size = ctypes.c_uint64()
+        if self._lib.pt_records_get(self._h, i, ctypes.byref(data),
+                                    ctypes.byref(size)) != 0:
+            raise IndexError(i)
+        return ctypes.string_at(data, size.value)
+
+    def close(self):
+        if self._h:
+            self._lib.pt_records_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class RecordDataset:
+    """Map-style Dataset over a PTR1 file (decodes pickle by default)."""
+
+    def __init__(self, path: str, decode: Optional[Callable] = pickle.loads):
+        self._file = RecordFile(path)
+        self._decode = decode
+
+    def __len__(self):
+        return len(self._file)
+
+    def __getitem__(self, i):
+        b = self._file.get_bytes(i)
+        return self._decode(b) if self._decode else b
+
+
+class NativeRecordReader:
+    """Threaded prefetching iterator: C++ reader threads fill a C++ blocking
+    queue off-GIL; Python pops decoded samples.
+
+    rank/world_size shard the record space (the reference's file-list split
+    across trainers, data_feed.cc SetFileList), n_threads readers share the
+    shard, `epochs` repeats it.
+    """
+
+    def __init__(self, path: str, queue_capacity: int = 64, n_threads: int = 2,
+                 rank: int = 0, world_size: int = 1, epochs: int = 1,
+                 decode: Optional[Callable] = pickle.loads):
+        self._lib = load_native()
+        if self._lib is None:
+            raise RuntimeError(f"native pipeline unavailable: {_lib_err}")
+        self._file = RecordFile(path)
+        n = len(self._file)
+        per = (n + world_size - 1) // world_size
+        self._begin = min(rank * per, n)
+        self._end = min(self._begin + per, n)
+        self._total = (self._end - self._begin) * epochs
+        self._decode = decode
+        self._q = self._lib.pt_queue_create(queue_capacity)
+        self._r = self._lib.pt_reader_start(self._file._h, self._q,
+                                            self._begin, self._end,
+                                            n_threads, epochs)
+        self._popped = 0
+        self._closed = False
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._popped >= self._total:
+            self.close()
+            raise StopIteration
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        size = ctypes.c_uint64()
+        rc = self._lib.pt_queue_pop(self._q, ctypes.byref(data),
+                                    ctypes.byref(size))
+        if rc != 0:
+            self.close()
+            raise StopIteration
+        try:
+            raw = ctypes.string_at(data, size.value)
+        finally:
+            self._lib.pt_buffer_free(data)
+        self._popped += 1
+        return self._decode(raw) if self._decode else raw
+
+    def qsize(self) -> int:
+        return self._lib.pt_queue_size(self._q)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._lib.pt_reader_stop(self._r)
+        self._lib.pt_queue_destroy(self._q)
+        self._file.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class BlockingQueue:
+    """Python handle on the C++ blocking queue (reference:
+    framework/blocking_queue.h exposed via reader ops). Useful as a bounded
+    hand-off between producer threads/processes and the host feed loop."""
+
+    def __init__(self, capacity: int = 64):
+        self._lib = load_native()
+        if self._lib is None:
+            raise RuntimeError(f"native pipeline unavailable: {_lib_err}")
+        self._q = self._lib.pt_queue_create(capacity)
+        self._destroyed = False
+
+    def push(self, payload: bytes) -> bool:
+        buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        return self._lib.pt_queue_push(self._q, buf, len(payload)) == 0
+
+    def pop(self) -> Optional[bytes]:
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        size = ctypes.c_uint64()
+        if self._lib.pt_queue_pop(self._q, ctypes.byref(data),
+                                  ctypes.byref(size)) != 0:
+            return None
+        try:
+            return ctypes.string_at(data, size.value)
+        finally:
+            self._lib.pt_buffer_free(data)
+
+    def size(self) -> int:
+        return self._lib.pt_queue_size(self._q)
+
+    def close(self):
+        self._lib.pt_queue_close(self._q)
+
+    def __del__(self):
+        try:
+            if not self._destroyed:
+                self._destroyed = True
+                self._lib.pt_queue_destroy(self._q)
+        except Exception:
+            pass
