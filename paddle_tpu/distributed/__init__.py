@@ -73,3 +73,5 @@ from . import auto_parallel  # noqa: E402,F401
 from .auto_parallel import (  # noqa: E402,F401
     ProcessMesh, shard_tensor, shard_op, reshard,
 )
+from . import checkpoint  # noqa: E402,F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: E402,F401
