@@ -75,3 +75,5 @@ from .auto_parallel import (  # noqa: E402,F401
 )
 from . import checkpoint  # noqa: E402,F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: E402,F401
+from . import ps  # noqa: E402,F401
+from . import rpc  # noqa: E402,F401

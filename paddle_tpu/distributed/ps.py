@@ -1,0 +1,196 @@
+"""PS-style sparse embedding — host-RAM tables with row-sparse optimizers.
+
+Reference (SURVEY §2.2): the brpc parameter server (fluid/distributed/ps/,
+31.9k LoC — MemorySparseTable with insert-on-push rows, CTR accessors,
+GeoSGD) and HeterPS GPU hashtables (framework/fleet/heter_ps/). SURVEY §7
+prescribes the TPU redesign: *don't* port brpc — giant embedding tables live
+in host RAM next to the chips, steps pull only the touched rows to device,
+and gradients push back row-wise with a sparse optimizer. The dense model
+trains on-device as usual; this module supplies the sparse half of the CTR
+workflow.
+
+Sharding: ids hash across `num_shards` tables (MemorySparseTable's shard
+layout, memory_sparse_table.cc); multi-host deployments place shards on
+their owning host (id % world == rank) and batch cross-host pulls through
+paddle_tpu.distributed.rpc.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..autograd import PyLayer
+from ..nn.layer import Layer
+
+
+class SparseTable:
+    """One shard: growing row store with insert-on-first-touch semantics
+    (reference: MemorySparseTable — rows materialize when first pulled,
+    ctr_accessor.cc creates feature values lazily)."""
+
+    def __init__(self, dim: int, optimizer: str = "adagrad", lr: float = 0.05,
+                 init_scale: float = 0.01,
+                 initializer: Optional[Callable] = None, seed: int = 0):
+        self.dim = dim
+        self.lr = lr
+        self.optimizer = optimizer
+        self._init_scale = init_scale
+        self._initializer = initializer
+        self._rng = np.random.RandomState(seed)
+        self._slot_of: Dict[int, int] = {}
+        cap = 1024
+        self._rows = np.zeros((cap, dim), np.float32)
+        self._g2 = np.zeros((cap, dim), np.float32) if optimizer == "adagrad" \
+            else None
+        self._n = 0
+
+    def __len__(self):
+        return self._n
+
+    def _grow(self, need: int):
+        cap = self._rows.shape[0]
+        if self._n + need <= cap:
+            return
+        new_cap = max(cap * 2, self._n + need)
+        self._rows = np.resize(self._rows, (new_cap, self.dim))
+        if self._g2 is not None:
+            self._g2 = np.resize(self._g2, (new_cap, self.dim))
+
+    def _slots(self, ids: np.ndarray, create: bool) -> np.ndarray:
+        out = np.empty(len(ids), np.int64)
+        for i, key in enumerate(ids.tolist()):
+            slot = self._slot_of.get(key, -1)
+            if slot < 0 and create:
+                self._grow(1)
+                slot = self._n
+                self._slot_of[key] = slot
+                if self._initializer is not None:
+                    self._rows[slot] = self._initializer(self.dim)
+                else:
+                    self._rows[slot] = self._rng.uniform(
+                        -self._init_scale, self._init_scale, self.dim)
+                if self._g2 is not None:
+                    self._g2[slot] = 0.0
+                self._n += 1
+            out[i] = slot
+        return out
+
+    # -- PS ops --------------------------------------------------------
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        """Fetch rows (creating them CTR-style on first touch)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        slots = self._slots(ids, create=True)
+        return self._rows[slots].copy()
+
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        """Apply row-sparse update; duplicate ids accumulate
+        (reference: sparse table push with gradient merge)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        g = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(g, inv, grads)
+        slots = self._slots(uniq, create=True)
+        if self.optimizer == "adagrad":
+            self._g2[slots] += g * g
+            self._rows[slots] -= self.lr * g / (np.sqrt(self._g2[slots]) + 1e-6)
+        else:  # sgd
+            self._rows[slots] -= self.lr * g
+
+    # -- persistence (reference: table Save/Load shard files) ----------
+    def save(self, path: str):
+        keys = np.fromiter(self._slot_of.keys(), np.int64, len(self._slot_of))
+        slots = np.fromiter(self._slot_of.values(), np.int64, len(self._slot_of))
+        blob = {"keys": keys, "rows": self._rows[slots],
+                "dim": self.dim, "optimizer": self.optimizer, "lr": self.lr}
+        if self._g2 is not None:
+            blob["g2"] = self._g2[slots]
+        np.savez(path, **blob)
+
+    def load(self, path: str):
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        keys = data["keys"]
+        self.__init__(int(data["dim"]), str(data["optimizer"]),
+                      float(data["lr"]), self._init_scale)
+        slots = self._slots(keys, create=True)
+        self._rows[slots] = data["rows"]
+        if self._g2 is not None and "g2" in data:
+            self._g2[slots] = data["g2"]
+
+
+class _Lookup(PyLayer):
+    """Tape bridge: forward pulls host rows to device; backward pushes grads
+    back to the host table (the pull/push RPC pair of the reference PS,
+    ps_client.h:64 PullSparse/PushSparse)."""
+
+    @staticmethod
+    def forward(ctx, anchor, embedding, ids_np, out_shape):
+        ctx.embedding = embedding
+        ctx.ids = ids_np
+        rows = embedding._pull(ids_np)
+        return Tensor(jnp.asarray(rows.reshape(out_shape)))
+
+    @staticmethod
+    def backward(ctx, dy):
+        g = np.asarray(dy._data, np.float32).reshape(len(ctx.ids), -1)
+        ctx.embedding._push(ctx.ids, g)
+        return Tensor(jnp.zeros((), jnp.float32))
+
+
+class DistributedEmbedding(Layer):
+    """Sparse embedding layer over sharded host tables.
+
+    reference: the distributed lookup_table path (fleet PS embedding;
+    the_one_ps.py sparse table config). forward(ids[int]) -> [..., dim]."""
+
+    def __init__(self, dim: int, num_shards: int = 1, optimizer: str = "adagrad",
+                 lr: float = 0.05, init_scale: float = 0.01, seed: int = 0,
+                 name=None):
+        super().__init__()
+        self.dim = dim
+        self.num_shards = num_shards
+        self.tables = [SparseTable(dim, optimizer, lr, init_scale, seed=seed + s)
+                       for s in range(num_shards)]
+        # anchor joins lookups to the autograd tape (host tables are not
+        # jax arrays, so the tape needs a differentiable input to traverse)
+        self._anchor = self.create_parameter([1])
+
+    # shard router (reference: id % shard_num, memory_sparse_table.cc)
+    def _route(self, ids: np.ndarray):
+        return (ids % self.num_shards).astype(np.int64)
+
+    def _pull(self, ids: np.ndarray) -> np.ndarray:
+        shard = self._route(ids)
+        out = np.empty((len(ids), self.dim), np.float32)
+        for s in range(self.num_shards):
+            m = shard == s
+            if m.any():
+                out[m] = self.tables[s].pull(ids[m])
+        return out
+
+    def _push(self, ids: np.ndarray, grads: np.ndarray):
+        shard = self._route(ids)
+        for s in range(self.num_shards):
+            m = shard == s
+            if m.any():
+                self.tables[s].push(ids[m], grads[m])
+
+    def forward(self, ids):
+        ids_np = np.asarray(ids._data if isinstance(ids, Tensor) else ids,
+                            np.int64)
+        out_shape = tuple(ids_np.shape) + (self.dim,)
+        return _Lookup.apply(self._anchor, self, ids_np.reshape(-1), out_shape)
+
+    def state_size(self) -> int:
+        return sum(len(t) for t in self.tables)
+
+    def save(self, prefix: str):
+        for s, t in enumerate(self.tables):
+            t.save(f"{prefix}.shard{s}")
+
+    def load(self, prefix: str):
+        for s, t in enumerate(self.tables):
+            t.load(f"{prefix}.shard{s}.npz")

@@ -1,0 +1,155 @@
+"""paddle.distributed.rpc analog — worker-to-worker remote calls.
+
+Reference (SURVEY §2.2 RPC): python/paddle/distributed/rpc/rpc.py over a C++
+brpc agent (fluid/distributed/rpc/) — init_rpc/rpc_sync/rpc_async/shutdown
+with WorkerInfo registry. Here the transport is a per-process socket server
+(pickle payloads — same trust model as the reference, which pickles python
+callables over brpc) with the TCPStore as the worker registry. On TPU pods
+this drives *control-plane* coordination (PS pulls, eval fan-out); the data
+plane stays on XLA collectives.
+"""
+from __future__ import annotations
+
+import concurrent.futures as futures
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, List, NamedTuple, Optional
+
+from .store import TCPStore
+
+
+class WorkerInfo(NamedTuple):
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_state: Dict[str, Any] = {"workers": {}, "server": None, "self": None,
+                          "store": None, "pool": None}
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+class _RpcHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            (n,) = struct.unpack("<Q", _recv_exact(self.request, 8))
+            fn, args, kwargs = pickle.loads(_recv_exact(self.request, n))
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # ship the exception back
+                result = (False, e)
+            payload = pickle.dumps(result, protocol=4)
+            self.request.sendall(struct.pack("<Q", len(payload)) + payload)
+        except ConnectionError:
+            pass
+
+
+def init_rpc(name: str, rank: int = None, world_size: int = None,
+             master_endpoint: str = None):
+    """reference: paddle.distributed.rpc.init_rpc (rpc.py). Starts this
+    worker's server, registers in the store, waits for the full world."""
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:0")
+
+    socketserver.ThreadingTCPServer.allow_reuse_address = True
+    socketserver.ThreadingTCPServer.daemon_threads = True
+    server = socketserver.ThreadingTCPServer(("0.0.0.0", 0), _RpcHandler)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+
+    host, mport = master_endpoint.rsplit(":", 1)
+    store = TCPStore(host if host else "127.0.0.1", int(mport),
+                     is_master=(rank == 0), world_size=world_size)
+    ip = "127.0.0.1" if host in ("127.0.0.1", "localhost", "") else _local_ip()
+    store.set(f"rpc/worker/{rank}", f"{name}|{ip}|{port}")
+    workers = {}
+    for r in range(world_size):
+        val = store.wait(f"rpc/worker/{r}")
+        wname, wip, wport = val.split("|")
+        workers[wname] = WorkerInfo(wname, r, wip, int(wport))
+    _state.update(server=server, store=store, workers=workers,
+                  self=workers[name] if name in workers else None,
+                  pool=futures.ThreadPoolExecutor(max_workers=8))
+    return workers[name]
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _state["workers"][name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    return sorted(_state["workers"].values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return _state["self"]
+
+
+def _call(to: str, fn, args, kwargs, timeout):
+    w = _state["workers"][to]
+    payload = pickle.dumps((fn, args or (), kwargs or {}), protocol=4)
+    with socket.create_connection((w.ip, w.port), timeout=timeout) as s:
+        s.sendall(struct.pack("<Q", len(payload)) + payload)
+        (n,) = struct.unpack("<Q", _recv_exact(s, 8))
+        ok, result = pickle.loads(_recv_exact(s, n))
+    if not ok:
+        raise result
+    return result
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=180):
+    """reference: rpc.py rpc_sync — blocking remote call."""
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout=180):
+    """reference: rpc.py rpc_async — returns a Future (.wait() alias)."""
+    fut = _state["pool"].submit(_call, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # paddle Future API compat
+    return fut
+
+
+def shutdown():
+    """reference: rpc.py shutdown — barrier then stop serving."""
+    store = _state.get("store")
+    if store is not None:
+        try:
+            store.barrier("rpc_shutdown", len(_state["workers"]), timeout=30)
+        except Exception:
+            pass
+    server = _state.get("server")
+    if server is not None:
+        server.shutdown()
+        server.server_close()
+    if _state.get("pool") is not None:
+        _state["pool"].shutdown(wait=False)
+    if store is not None:
+        store.close()
+    _state.update(server=None, store=None, workers={}, self=None, pool=None)
+
+
+def _local_ip() -> str:
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
