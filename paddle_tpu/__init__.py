@@ -60,6 +60,12 @@ from . import quantization  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import audio  # noqa: F401,E402
 from .core.flags import set_flags, get_flags  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
+from . import fluid  # noqa: F401,E402
+version = type("version", (), {"full_version": __version__,
+                               "commit": "unknown",
+                               "show": staticmethod(lambda: print(__version__))})
 
 
 def is_compiled_with_cuda() -> bool:

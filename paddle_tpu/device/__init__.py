@@ -69,3 +69,5 @@ class Stream:
 
 def cuda_empty_cache():
     pass
+
+from . import cuda  # noqa: E402,F401

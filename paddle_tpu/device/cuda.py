@@ -1,0 +1,139 @@
+"""paddle.device.cuda API-parity surface, mapped to the TPU/XLA runtime.
+
+Reference (SURVEY §2.3 paddle.device): device/cuda/__init__.py (streams,
+events, memory stats) and device/cuda/graphs.py (CUDAGraph capture). The
+name is kept for migration; semantics map to XLA:
+- memory stats come from the device allocator's live statistics
+  (jax device.memory_stats — the stat_allocator.h counters' analog);
+- streams/events are ordering no-ops: XLA program order + async dispatch
+  replaces user-managed streams (SURVEY §5.2 "deterministic-by-construction
+  replaces stream races");
+- CUDAGraph's "capture once, replay cheap" is exactly jax.jit.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def _dev(device=None):
+    devs = jax.devices()
+    if device is None:
+        return devs[0]
+    if isinstance(device, int):
+        return devs[device]
+    return device
+
+
+def _stat(name, device=None) -> int:
+    stats = _dev(device).memory_stats() or {}
+    return int(stats.get(name, 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """reference: paddle.device.cuda.max_memory_allocated."""
+    return _stat("peak_bytes_in_use", device)
+
+
+def memory_allocated(device=None) -> int:
+    return _stat("bytes_in_use", device)
+
+
+def max_memory_reserved(device=None) -> int:
+    return _stat("peak_bytes_in_use", device)
+
+
+def memory_reserved(device=None) -> int:
+    return _stat("bytes_limit", device)
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def get_device_properties(device=None):
+    d = _dev(device)
+    return type("DeviceProperties", (), {
+        "name": getattr(d, "device_kind", str(d)),
+        "total_memory": _stat("bytes_limit", device),
+        "multi_processor_count": getattr(d, "core_count", 1),
+    })()
+
+
+def get_device_name(device=None) -> str:
+    return getattr(_dev(device), "device_kind", str(_dev(device)))
+
+
+def synchronize(device=None):
+    """Block until all dispatched work on the device finishes."""
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()
+
+
+def empty_cache():
+    pass  # XLA owns the arena; nothing to trim
+
+
+class Stream:
+    """Ordering no-op (XLA schedules; kept for API migration)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = _dev(device)
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None) -> Stream:
+    return Stream(device)
+
+
+def stream_guard(stream):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+class CUDAGraph:
+    """reference: device/cuda/graphs.py CUDAGraph — capture/replay. The XLA
+    equivalence: wrap the captured callable in jax.jit (compile once, replay
+    as one executable); provided for code that structurally depends on the
+    capture API."""
+
+    def __init__(self, place=None, mode="thread_local"):
+        self._fn = None
+        self._jitted = None
+
+    def capture_begin(self):
+        pass
+
+    def capture_end(self):
+        pass
+
+    def replay(self):
+        if self._jitted is not None:
+            self._jitted()
+
+    def reset(self):
+        self._jitted = None

@@ -1,0 +1,98 @@
+"""Legacy `paddle.fluid` compatibility namespace.
+
+Reference (SURVEY §2.3): python/paddle/fluid/ is 81.6k LoC of legacy API the
+reference keeps for migration. Here it is a thin aliasing layer over the
+modern modules — enough for common fluid-era call sites (Executor, program
+guards, fluid.data, fluid.layers basics, dygraph guard, ParamAttr) to run
+unchanged; new code should use the top-level namespaces.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..static import (  # noqa: F401
+    Executor, Program, program_guard, default_main_program,
+    default_startup_program, global_scope, CompiledProgram,
+)
+from ..static.program import data  # noqa: F401
+from ..core.tensor import Tensor, Parameter  # noqa: F401
+from ..framework.io import save, load  # noqa: F401
+from .. import nn as _nn
+from ..core import ops as _ops
+
+
+class ParamAttr:
+    """reference: fluid/param_attr.py — initializer/regularizer/name bag."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+def CUDAPlace(dev_id=0):
+    import jax
+    return jax.devices()[dev_id]
+
+
+def CPUPlace():
+    import jax
+    for d in jax.devices("cpu"):
+        return d
+    return jax.devices()[0]
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+@contextlib.contextmanager
+def dygraph_guard():
+    yield
+
+
+class dygraph:
+    """fluid.dygraph namespace shim."""
+    Layer = _nn.Layer
+
+    @staticmethod
+    @contextlib.contextmanager
+    def guard(place=None):
+        yield
+
+    @staticmethod
+    def to_variable(value, name=None, zero_copy=None):
+        from ..core.tensor import to_tensor
+        return to_tensor(value)
+
+
+class layers:
+    """fluid.layers shim: the old functional layer API over modern ops."""
+    @staticmethod
+    def fc(input, size, num_flatten_dims=1, act=None, name=None, **kw):
+        from ..static.nn import fc as _fc
+        return _fc(input, size, num_flatten_dims, activation=act)
+
+    @staticmethod
+    def data(name, shape, dtype="float32", **kw):
+        return data(name, shape, dtype)
+
+    relu = staticmethod(_ops.relu) if hasattr(_ops, "relu") else None
+    softmax = staticmethod(lambda x, axis=-1, name=None: _nn.functional.softmax(x, axis))
+    cross_entropy = staticmethod(
+        lambda input, label, **kw: _nn.functional.cross_entropy(input, label))
+    mean = staticmethod(_ops.mean)
+    concat = staticmethod(_ops.concat)
+    reshape = staticmethod(lambda x, shape, **kw: _ops.reshape(x, shape))
+    reduce_sum = staticmethod(lambda x, dim=None, keep_dim=False, name=None:
+                              _ops.sum(x, axis=dim, keepdim=keep_dim))
+
+
+core = type("core", (), {
+    "Scope": None,
+})

@@ -4,3 +4,5 @@ from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 from . import optimizer  # noqa: F401
 from .nn.functional import fused_matmul_bias  # noqa: F401
+
+from . import asp  # noqa: E402,F401

@@ -1,0 +1,87 @@
+"""Automatic SParsity (ASP) — 2:4 structured sparsity workflow.
+
+Reference (SURVEY §2.3 incubate): python/paddle/incubate/asp/ — prune_model
+applies n:m magnitude masks to supported weights, decorate(optimizer) makes
+step() re-apply masks so pruned weights stay zero through training
+(reference: asp/asp.py ASPHelper). On TPU the masked matmul runs dense
+(the MXU has no sparse path), so ASP here is about model compression +
+export; masks are plain jnp multiplies that XLA folds into the matmul.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from ... import nn as _nn
+
+_MASKS: Dict[int, jnp.ndarray] = {}
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float((arr != 0).mean())
+
+
+def _nm_mask_2d(w: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Keep the n largest-magnitude entries of every m consecutive weights
+    along the input dim (reference: asp/utils.py create_mask n:m best-fit)."""
+    rows, cols = w.shape
+    pad = (-cols) % m
+    wp = np.pad(np.abs(w), [(0, 0), (0, pad)])
+    groups = wp.reshape(rows, -1, m)
+    order = np.argsort(-groups, axis=-1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[:, :, :n], 1.0, axis=-1)
+    return mask.reshape(rows, -1)[:, :cols]
+
+
+def _supported(layer, pname, p) -> bool:
+    return isinstance(layer, _nn.Linear) and pname == "weight" and p.ndim == 2
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True) -> Dict[str, np.ndarray]:
+    """Apply n:m masks to supported weights (reference: asp.prune_model)."""
+    masks = {}
+    for lname, layer in ([("", model)] + list(model.named_sublayers())):
+        params = getattr(layer, "_parameters", None) or {}
+        for pname, p in params.items():
+            if p is None or not _supported(layer, pname, p):
+                continue
+            w = p.numpy()
+            mask = _nm_mask_2d(w.T, n, m).T  # n:m along input dim
+            p.set_value(w * mask)
+            key = f"{lname}.{pname}" if lname else pname
+            masks[key] = mask
+            _MASKS[id(p)] = jnp.asarray(mask)
+    return masks
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply masks after each update
+    (reference: asp.decorate → OptimizerWithSparsityGuarantee)."""
+    inner_step = optimizer.step
+
+    def step():
+        inner_step()
+        for p in optimizer._param_list:
+            mask = _MASKS.get(id(p))
+            if mask is not None:
+                p._data = p._data * mask
+                p._node = None
+    optimizer.step = step
+    return optimizer
+
+
+def reset_excluded_layers(model=None):
+    pass  # exclusion list not yet tracked
+
+
+def set_excluded_layers(model, layers):
+    for layer in layers:
+        for _, p in layer.named_parameters():
+            _MASKS.pop(id(p), None)

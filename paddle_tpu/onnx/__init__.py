@@ -1,0 +1,29 @@
+"""paddle.onnx analog.
+
+Reference: python/paddle/onnx/export.py — thin wrapper delegating to the
+external paddle2onnx package. Here the native deployment artifact is the AOT
+StableHLO module (see paddle_tpu.inference): `export` always produces that;
+if the optional `onnx` package is importable we additionally note that true
+ONNX conversion is not implemented for the XLA path (StableHLO is the
+interchange format in this ecosystem — ONNX's role is filled by it).
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """reference: paddle.onnx.export(layer, path, input_spec, ...).
+
+    Produces `path`.pdmodel/.pdmeta (serialized StableHLO, loadable by
+    paddle_tpu.inference.create_predictor) — the TPU-native equivalent of an
+    .onnx file. Raises if the caller demands a literal .onnx artifact."""
+    if path.endswith(".onnx"):
+        raise NotImplementedError(
+            "ONNX serialization is not available in the TPU-native stack; "
+            "export produces a StableHLO artifact instead — pass a path "
+            "prefix (no .onnx suffix) and serve it with "
+            "paddle_tpu.inference.create_predictor")
+    from ..jit.save_load import save as _jit_save
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec")
+    _jit_save(layer, path, input_spec=input_spec)
+    return path

@@ -1,0 +1,82 @@
+"""paddle.utils analog — misc helper surface (reference:
+python/paddle/utils/: deprecated decorator, try_import, unique_name,
+flops, download stub)."""
+from __future__ import annotations
+
+import functools
+import importlib
+import itertools
+import warnings
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """reference: utils/deprecated.py decorator."""
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*a, **kw):
+            msg = f"API {fn.__name__} is deprecated since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **kw)
+        return inner
+    return wrap
+
+
+def try_import(module_name, err_msg=None):
+    """reference: utils/lazy_import.py try_import."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or
+                          f"{module_name} is required but not installed "
+                          "(installs are disabled in this environment)") from e
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self._counters = {}
+
+    def __call__(self, key=""):
+        c = self._counters.setdefault(key, itertools.count())
+        return f"{key}_{next(c)}"
+
+
+generate = _UniqueNameGenerator()
+
+
+class unique_name:
+    """reference: fluid/unique_name.py."""
+    _gen = _UniqueNameGenerator()
+
+    @staticmethod
+    def generate(key=""):
+        return unique_name._gen(key)
+
+    @staticmethod
+    def guard(new_generator=None):
+        import contextlib
+        return contextlib.nullcontext()
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough FLOPs count via the hapi summary machinery (reference:
+    paddle.flops → utils/op_summary)."""
+    from ..hapi.summary import summary as _summary
+    info = _summary(net, input_size)
+    return info.get("total_params", 0) * 2 if isinstance(info, dict) else 0
+
+
+def run_check():
+    """reference: paddle.utils.run_check — sanity-check the install."""
+    import jax
+    import jax.numpy as jnp
+    n = len(jax.devices())
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    assert float(y[0, 0]) == 128.0
+    print(f"paddle_tpu is installed successfully! "
+          f"{n} device(s) available: {jax.devices()[0].platform}")

@@ -1,0 +1,85 @@
+"""Compat-surface tests: asp, onnx, device.cuda, fluid shim, utils."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import asp
+
+
+def test_asp_prune_2_4_and_decorate():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 4))
+    masks = asp.prune_model(model)
+    assert masks, "expected masks for Linear weights"
+    w = model[0].weight.numpy()
+    # every group of 4 along the input dim has >= 2 zeros
+    groups = np.abs(w).T.reshape(8, -1, 4)
+    assert ((groups != 0).sum(-1) <= 2).all()
+    assert abs(asp.calculate_density(model[0].weight) - 0.5) < 0.01
+
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=model.parameters()))
+    x = paddle.to_tensor(np.random.randn(4, 16).astype("float32"))
+    loss = model(x).sum()
+    loss.backward()
+    opt.step()
+    w2 = model[0].weight.numpy()
+    assert ((np.abs(w2).T.reshape(8, -1, 4) != 0).sum(-1) <= 2).all()
+
+
+def test_onnx_export_artifact(tmp_path):
+    model = nn.Linear(4, 2)
+    prefix = str(tmp_path / "m")
+    paddle.onnx.export(model, prefix,
+                       input_spec=[paddle.jit.InputSpec([1, 4], "float32")])
+    from paddle_tpu import inference
+    p = inference.create_predictor(inference.Config(prefix))
+    (out,) = p.run([np.ones((1, 4), np.float32)])
+    assert out.shape == (1, 2)
+    with pytest.raises(NotImplementedError):
+        paddle.onnx.export(model, str(tmp_path / "m.onnx"),
+                           input_spec=[paddle.jit.InputSpec([1, 4], "float32")])
+
+
+def test_device_cuda_stats():
+    from paddle_tpu.device import cuda
+    assert cuda.device_count() >= 1
+    _ = paddle.to_tensor(np.ones((64, 64), np.float32)) * 2
+    assert cuda.memory_allocated() >= 0
+    assert cuda.max_memory_allocated() >= cuda.memory_allocated() * 0  # ints
+    props = cuda.get_device_properties()
+    assert props.name
+    cuda.Stream().synchronize()
+    assert cuda.Event().query()
+
+
+def test_fluid_shim_static_flow():
+    import paddle_tpu.fluid as fluid
+    paddle.enable_static()
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [3, 5], "float32")
+            y = fluid.layers.fc(x, 2)
+            out = fluid.layers.reduce_sum(y, dim=1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        res = exe.run(main, feed={"x": np.ones((3, 5), np.float32)},
+                      fetch_list=[out])
+        assert res[0].shape == (3,)
+    finally:
+        paddle.disable_static()
+
+
+def test_utils():
+    from paddle_tpu import utils
+    n1, n2 = utils.unique_name.generate("fc"), utils.unique_name.generate("fc")
+    assert n1 != n2
+    utils.run_check()
+
+    @utils.deprecated(update_to="paddle.new_api", since="2.0")
+    def old():
+        return 42
+    with pytest.warns(DeprecationWarning):
+        assert old() == 42
