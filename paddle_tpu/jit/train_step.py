@@ -169,6 +169,94 @@ class TrainStep:
         return jax.jit(pure_step, donate_argnums=donate, **kwargs)
 
     # ------------------------------------------------------------------
+    def _build_scan(self, treedef, n_steps):
+        """N optimizer steps in ONE executable via lax.scan over stacked
+        batches [n, ...]. Amortizes host dispatch (one launch per N steps)
+        and lets XLA overlap step boundaries — the analog of the reference's
+        gradient_merge/program-level multi-batch execution, and the honest
+        way to benchmark on remote-dispatch runtimes."""
+        single = self._build_pure(treedef)
+
+        def multi(param_arrays, opt_state, step0, lr, key, *flat_batches):
+            def body(carry, xs):
+                params, state, i = carry
+                ks, batch_leaves = xs[0], xs[1:]
+                loss, new_p, new_s = single(params, state, i, lr, ks,
+                                            *batch_leaves)
+                return (new_p, new_s, i + 1), loss
+
+            keys = jax.random.split(key, n_steps)
+            (pa, st, _), losses = jax.lax.scan(
+                body, (tuple(param_arrays), tuple(opt_state), step0),
+                (keys, *flat_batches))
+            return losses, pa, st
+
+        kwargs = {}
+        if self.mesh is not None:
+            # parameter/state shardings as in _build; batches add a leading
+            # scan dim with the data axes on dim 1
+            pass  # shardings propagate from the donated param arrays
+        return jax.jit(multi, donate_argnums=(0, 1))
+
+    def _build_pure(self, treedef):
+        """The single-step pure function (shared by __call__ and scan)."""
+        opt = self.optimizer
+        params = self._params
+        loss_fn = self.loss_fn
+        wds = [opt._wd_for(p) for p in params]
+        grad_clip = opt._grad_clip
+
+        def pure_step(param_arrays, opt_state, step_i, lr, key, *flat_batch):
+            batch = jax.tree.unflatten(treedef, flat_batch)
+
+            def loss_of(pa):
+                with _trace_guard(), _swap_params(params, list(pa)), \
+                        _random.trace_key_scope(key), autograd.no_grad():
+                    out = loss_fn(*_tree_wrap(batch))
+                loss_arr = out._data if isinstance(out, Tensor) else out
+                return loss_arr.astype(jnp.float32)
+
+            loss, grads = jax.value_and_grad(loss_of)(list(param_arrays))
+            if grad_clip is not None and type(grad_clip).__name__ == "ClipGradByGlobalNorm":
+                total = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                                     for g in grads))
+                scale = jnp.minimum(1.0, grad_clip.clip_norm / jnp.maximum(total, 1e-12))
+                grads = [g * scale.astype(g.dtype) for g in grads]
+            new_params, new_state = [], []
+            for pa, g, st, wd in zip(param_arrays, grads, opt_state, wds):
+                np_, ns_ = opt.update(pa, g, st, lr, step_i, wd)
+                new_params.append(np_)
+                new_state.append(ns_)
+            return loss, tuple(new_params), tuple(new_state)
+
+        return pure_step
+
+    def run_steps(self, n_steps: int, *stacked_batch):
+        """Run `n_steps` steps from batches stacked on dim 0 ([n, ...] per
+        leaf), one compiled launch. Returns the per-step losses Tensor."""
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state()
+            self._apply_param_shardings()
+        arrays = _tree_unwrap(stacked_batch)
+        flat, treedef = jax.tree.flatten(arrays)
+        key_sig = ("scan", n_steps,
+                   tuple((tuple(a.shape), str(a.dtype)) for a in flat))
+        compiled = self._compiled.get((treedef, key_sig))
+        if compiled is None:
+            compiled = self._build_scan(treedef, n_steps)
+            self._compiled[(treedef, key_sig)] = compiled
+        lr = jnp.float32(self.optimizer.get_lr())
+        key = _random.split_key()
+        losses, new_params, new_state = compiled(
+            tuple(p._data for p in self._params), tuple(self._opt_state),
+            jnp.int32(self._step_i + 1), lr, key, *flat)
+        self._step_i += n_steps
+        for p, na in zip(self._params, new_params):
+            p._data = na
+            p._node = None
+        self._opt_state = list(new_state)
+        return Tensor(losses)
+
     def __call__(self, *batch):
         if self._opt_state is None:
             self._opt_state = self._init_opt_state()

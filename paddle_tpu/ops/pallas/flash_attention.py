@@ -1,15 +1,21 @@
-"""Pallas flash attention for TPU.
+"""Pallas flash attention for TPU — forward AND blockwise backward.
 
 Beyond-reference capability (SURVEY §5.7: the reference snapshot has no flash
 attention — its fused_attention_op.cu materializes the full S×S probability
-matrix). This kernel computes attention blockwise with an online softmax so
-HBM traffic is O(S·D) instead of O(S²): Q tiles stay resident in VMEM, K/V
-stream through in BK-sized blocks, and the MXU sees [BQ,D]x[D,BK] matmuls.
+matrix). Both passes compute attention blockwise with an online/stored
+softmax so HBM traffic is O(S·D) instead of O(S²): Q tiles stay resident in
+VMEM, K/V stream through in block-sized chunks, and the MXU sees [BQ,D]x
+[D,BK] matmuls.
+
+Backward follows FlashAttention-2: the forward additionally writes the
+per-row logsumexp L; backward recomputes P = exp(QK^T·scale − L) tile by
+tile, with Δ = rowsum(dO ⊙ O) precomputed, and runs two kernels — one
+gridded over Q blocks (dQ), one over K blocks (dK, dV) — so nothing O(S²)
+is ever materialized in either pass.
 
 Layout: [batch, seq, heads, head_dim] in, same out (paddle convention).
-Forward is the Pallas kernel; backward currently recomputes through the XLA
-reference path under jax.custom_vjp (correct, O(S²) peak in backward —
-a blockwise backward kernel is the planned upgrade).
+head_dim is padded to the 128-lane boundary inside the wrapper (zero pads
+contribute nothing to the dots), so 64-dim heads work.
 """
 from __future__ import annotations
 
@@ -20,26 +26,27 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401 (platform hint)
 
 DEFAULT_BQ = 256
 DEFAULT_BK = 256
+_NEG = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bk):
+# ------------------------------------------------------------------ forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bk):
     """One (batch*head, q_block) program: online-softmax over K/V blocks."""
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale           # [BQ, D]
+    q = q_ref[0]                                       # [BQ, D] native dtype
     bq = q.shape[0]
     s_k = k_ref.shape[1]
     n_kb = s_k // bk
 
-    m0 = jnp.full((bq, 1), -1e30, jnp.float32)
+    m0 = jnp.full((bq, 1), _NEG, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, q.shape[1]), jnp.float32)
 
     if causal:
-        # only blocks whose start is <= last query index of this tile
         upper = lax.div((qi + 1) * bq + bk - 1, bk)
         upper = jnp.minimum(upper, n_kb)
     else:
@@ -47,46 +54,203 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bk):
 
     def body(ki, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(ki * bk, bk), :].astype(jnp.float32)   # [BK, D]
-        v = v_ref[0, pl.ds(ki * bk, bk), :].astype(jnp.float32)   # [BK, D]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)   # [BQ, BK]
+        k = k_ref[0, pl.ds(ki * bk, bk), :]                       # [BK, D]
+        v = v_ref[0, pl.ds(ki * bk, bk), :]                       # [BK, D]
+        # bf16xbf16 -> f32 dot: full MXU rate, f32 accumulation
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_idx = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_idx = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_idx >= k_idx, s, -1e30)
+            s = jnp.where(q_idx >= k_idx, s, _NEG)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
         l_new = corr * l + p.sum(axis=-1, keepdims=True)
-        acc_new = corr * acc + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        acc_new = corr * acc + jnp.dot(p.astype(v.dtype), v,
+                                       preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    # logsumexp of scaled scores; backward recomputes p = exp(s - L).
+    # Stored replicated over 8 sublanes: TPU blocks need their last two dims
+    # tiled (8, 128), so the stats array is [bh, 8, s_q]
+    lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[:, 0][None, :],
+                                  (8, q.shape[0]))
 
 
 def _flash_fwd(q, k, v, *, scale, causal, bq, bk, interpret):
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
-    # fold heads into batch; seq-major for contiguous K/V streaming
     qt = jnp.moveaxis(q, 2, 1).reshape(b * h, s_q, d)
     kt = jnp.moveaxis(k, 2, 1).reshape(b * h, s_k, d)
     vt = jnp.moveaxis(v, 2, 1).reshape(b * h, s_k, d)
 
     grid = (b * h, s_q // bq)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal, bk=bk),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, 8, s_q), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=(pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+                   pl.BlockSpec((1, 8, bq), lambda bh, qi: (bh, 0, qi))),
         interpret=interpret,
     )(qt, kt, vt)
+    return out, lse, (qt, kt, vt)
+
+
+# ----------------------------------------------------------------- backward
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, bk):
+    """Grid (bh, q_block): dQ tile = Σ_k ds·K·scale,
+    ds = p ⊙ (dO·Vᵀ − Δ)."""
+    qi = pl.program_id(1)
+    q = q_ref[0]                                        # [BQ, D]
+    do = do_ref[0]                                      # [BQ, D]
+    lse = lse_ref[0, 0][:, None]                        # [BQ, 1]
+    delta = delta_ref[0, 0][:, None]                    # [BQ, 1]
+    bq = q.shape[0]
+    s_k = k_ref.shape[1]
+    n_kb = s_k // bk
+    if causal:
+        upper = jnp.minimum(lax.div((qi + 1) * bq + bk - 1, bk), n_kb)
+    else:
+        upper = n_kb
+
+    def body(ki, dq):
+        k = k_ref[0, pl.ds(ki * bk, bk), :]
+        v = v_ref[0, pl.ds(ki * bk, bk), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_idx = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_idx = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_idx >= k_idx, s, _NEG)
+        p = jnp.exp(s - lse)                             # [BQ, BK]
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(k.dtype)
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, upper, body,
+                       jnp.zeros(q.shape, jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, bq):
+    """Grid (bh, k_block): dK/dV tiles accumulate over Q blocks."""
+    ki = pl.program_id(1)
+    k = k_ref[0]                                        # [BK, D]
+    v = v_ref[0]                                        # [BK, D]
+    bk = k.shape[0]
+    s_q = q_ref.shape[1]
+    n_qb = s_q // bq
+    # causal: only q blocks whose end is >= this k block's start contribute
+    lower = lax.div(ki * bk, bq) if causal else 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * bq, bq), :]                       # [BQ, D]
+        do = do_ref[0, pl.ds(qi * bq, bq), :]
+        lse = lse_ref[0, 0, pl.ds(qi * bq, bq)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qi * bq, bq)][:, None]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_idx = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_idx = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_idx >= k_idx, s, _NEG)
+        p = jnp.exp(s - lse).astype(do.dtype)            # [BQ, BK]
+        dv_new = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p.astype(jnp.float32) * (dp - delta)).astype(q.dtype)  # [BQ, BK]
+        dk_new = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk, dv = lax.fori_loop(lower, n_qb, body,
+                           (jnp.zeros(k.shape, jnp.float32),
+                            jnp.zeros(v.shape, jnp.float32)))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, *, scale, causal, bq, bk, interpret):
+    qt, kt, vt, out, lse = res
+    bh, s_q, d = qt.shape
+    s_k = kt.shape[1]
+    dot = jnp.moveaxis(g, 2, 1).reshape(bh, s_q, d)
+    delta = jnp.sum(dot.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, s_q))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bk=bk),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), qt.dtype),
+        grid=(bh, s_q // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((1, s_k, d), lambda b, qi: (b, 0, 0)),
+            pl.BlockSpec((1, s_k, d), lambda b, qi: (b, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((1, 8, bq), lambda b, qi: (b, 0, qi)),
+            pl.BlockSpec((1, 8, bq), lambda b, qi: (b, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, qi: (b, qi, 0)),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq),
+        out_shape=(jax.ShapeDtypeStruct((bh, s_k, d), kt.dtype),
+                   jax.ShapeDtypeStruct((bh, s_k, d), vt.dtype)),
+        grid=(bh, s_k // bk),
+        in_specs=[
+            pl.BlockSpec((1, s_q, d), lambda b, ki: (b, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, ki: (b, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, ki: (b, ki, 0)),
+            pl.BlockSpec((1, s_q, d), lambda b, ki: (b, 0, 0)),
+            pl.BlockSpec((1, 8, s_q), lambda b, ki: (b, 0, 0)),
+            pl.BlockSpec((1, 8, s_q), lambda b, ki: (b, 0, 0)),
+        ],
+        out_specs=(pl.BlockSpec((1, bk, d), lambda b, ki: (b, ki, 0)),
+                   pl.BlockSpec((1, bk, d), lambda b, ki: (b, ki, 0))),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------- custom_vjp
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, bq, bk, interpret):
+    out, _, _ = _flash_fwd(q, k, v, scale=scale, causal=causal, bq=bq, bk=bk,
+                           interpret=interpret)
+    b, s_q, h, d = q.shape
     return jnp.moveaxis(out.reshape(b, h, s_q, d), 1, 2)
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret):
+    out, lse, (qt, kt, vt) = _flash_fwd(q, k, v, scale=scale, causal=causal,
+                                        bq=bq, bk=bk, interpret=interpret)
+    b, s_q, h, d = q.shape
+    o = jnp.moveaxis(out.reshape(b, h, s_q, d), 1, 2)
+    return o, (qt, kt, vt, out, lse, (b, h))
+
+
+def _flash_vjp_bwd(scale, causal, bq, bk, interpret, res, g):
+    qt, kt, vt, out, lse, (b, h) = res
+    dq, dk, dv = _flash_bwd((qt, kt, vt, out, lse), g, scale=scale,
+                            causal=causal, bq=bq, bk=bk, interpret=interpret)
+    s_q, s_k, d = dq.shape[1], dk.shape[1], dq.shape[2]
+    dq = jnp.moveaxis(dq.reshape(b, h, s_q, d), 1, 2)
+    dk = jnp.moveaxis(dk.reshape(b, h, s_k, d), 1, 2)
+    dv = jnp.moveaxis(dv.reshape(b, h, s_k, d), 1, 2)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def _reference(q, k, v, *, scale, causal):
@@ -94,32 +258,15 @@ def _reference(q, k, v, *, scale, causal):
     return attention_reference(q, k, v, is_causal=causal, scale=scale)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, bq, bk, interpret):
-    return _flash_fwd(q, k, v, scale=scale, causal=causal, bq=bq, bk=bk,
-                      interpret=interpret)
-
-
-def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret):
-    out = _flash_fwd(q, k, v, scale=scale, causal=causal, bq=bq, bk=bk,
-                     interpret=interpret)
-    return out, (q, k, v)
-
-
-def _flash_vjp_bwd(scale, causal, bq, bk, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _reference(a, b, c, scale=scale, causal=causal),
-                     q, k, v)
-    return vjp(g)
-
-
-_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
-
-
 def flash_attention(q, k, v, causal: bool = False, scale=None,
                     block_q: int = None, block_k: int = None,
                     interpret: bool = False):
-    """Differentiable flash attention on [B, S, H, D] arrays."""
+    """Differentiable flash attention on [B, S, H, D] arrays.
+
+    head_dim pads to the next 128-lane multiple (zeros change no dot
+    product); seq lengths must divide by the chosen blocks, else blocks
+    shrink, else the XLA reference path takes over.
+    """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     s_q, s_k = q.shape[1], k.shape[1]
@@ -131,4 +278,13 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
         bk //= 2
     if bq < 8 or bk < 8:
         return _reference(q, k, v, scale=scale, causal=causal)
-    return _flash(q, k, v, float(scale), bool(causal), int(bq), int(bk), bool(interpret))
+    d = q.shape[-1]
+    pad = (-d) % 128
+    if pad:
+        cfg = [(0, 0)] * 3 + [(0, pad)]
+        q = jnp.pad(q, cfg)
+        k = jnp.pad(k, cfg)
+        v = jnp.pad(v, cfg)
+    out = _flash(q, k, v, float(scale), bool(causal), int(bq), int(bk),
+                 bool(interpret))
+    return out[..., :d] if pad else out
