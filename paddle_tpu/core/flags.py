@@ -51,10 +51,8 @@ def _apply_side_effects(name: str, value):
 def set_flags(flags: Dict[str, Any]):
     """reference: paddle.set_flags (pybind global_value_getter_setter.cc)."""
     for name, value in flags.items():
-        if name not in _REGISTRY:
-            _REGISTRY[name] = value  # accept unknown for fwd-compat, like env
-        else:
-            _REGISTRY[name] = value
+        # unknown names accepted for fwd-compat (env vars behave the same)
+        _REGISTRY[name] = value
         _apply_side_effects(name, value)
 
 

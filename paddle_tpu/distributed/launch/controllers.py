@@ -51,11 +51,7 @@ class CollectiveController:
             return  # single node: no store needed
         if master:
             host, port = master.rsplit(":", 1)
-            is_master = self.args.rank == 0 or host in (
-                "127.0.0.1", "localhost", os.environ.get("POD_IP", ""))
-            self.store = TCPStore(host, int(port),
-                                  is_master=is_master and self.args.rank in (0, -1),
-                                  world_size=self.nnodes)
+            self.store = self._connect_or_host(host, int(port))
         else:
             self.store = TCPStore(is_master=True, world_size=self.nnodes)
         if self.args.rank >= 0:
@@ -66,6 +62,24 @@ class CollectiveController:
             self.store.set(f"{self.job_id}/node/{self.node_rank}", _hostname())
         # wait for quorum
         self.store.barrier(f"signin_{self.restarts}", self.nnodes)
+
+    def _connect_or_host(self, host: str, port: int) -> TCPStore:
+        """Join the master store, hosting it if nobody has yet.
+
+        --rank 0 always hosts. With auto-assigned ranks (-1), every node
+        first tries to connect; the one that finds no server binds it — a
+        bind race loser just falls back to connecting (reference:
+        controllers/master.py HTTPMaster 'start on rank0 else poll')."""
+        if self.args.rank == 0:
+            return TCPStore(host, port, is_master=True, world_size=self.nnodes)
+        try:
+            return TCPStore(host, port, world_size=self.nnodes, timeout=5)
+        except TimeoutError:
+            pass
+        try:
+            return TCPStore(host, port, is_master=True, world_size=self.nnodes)
+        except OSError:  # lost the bind race: a peer is hosting now
+            return TCPStore(host, port, world_size=self.nnodes)
 
     # ------------------------------------------------------------- build pod
     def build_pod(self):

@@ -54,6 +54,13 @@ def spawn(func, args: Sequence = (), nprocs: int = -1, join: bool = True,
     for p in procs:
         p.join(timeout)
     codes = [p.exitcode for p in procs]
-    if any(c not in (0, None) for c in codes):
+    if any(c is None for c in codes):  # hung worker: kill and report
+        for p in procs:
+            if p.exitcode is None:
+                p.terminate()
+                p.join(5)
+        raise RuntimeError(
+            f"spawned processes timed out after {timeout}s (exit codes {codes})")
+    if any(c != 0 for c in codes):
         raise RuntimeError(f"spawned processes failed with exit codes {codes}")
     return procs

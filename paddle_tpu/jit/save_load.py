@@ -59,14 +59,37 @@ def _export_aot(layer, path, input_spec):
         return tuple(o._data if isinstance(o, Tensor) else o for o in outs)
 
     specs = [s if isinstance(s, InputSpec) else InputSpec(*s) for s in input_spec]
-    avals = [jax.ShapeDtypeStruct(tuple(1 if (d is None or d < 0) else int(d)
-                                        for d in s.shape), s.dtype) for s in specs]
-    try:
-        from ..static.io import _export_platforms
-        exported = jax_export.export(jax.jit(serving),
-                                     platforms=_export_platforms())(*avals)
-    except Exception:
-        exported = jax_export.export(jax.jit(serving))(*avals)
+
+    # dims declared None/-1 export as symbolic (shape-polymorphic) like
+    # static.save_inference_model; fall back to concrete batch=1 only if
+    # symbolic export fails for this model
+    def _avals(symbolic):
+        scope = jax_export.SymbolicScope() if symbolic else None
+        out = []
+        for i, s in enumerate(specs):
+            decl = tuple(-1 if (d is None or (isinstance(d, int) and d < 0))
+                         else int(d) for d in s.shape)
+            if symbolic and any(d == -1 for d in decl):
+                spec = ",".join(f"d{i}_{j}" if d == -1 else str(d)
+                                for j, d in enumerate(decl))
+                shape = jax_export.symbolic_shape(spec, scope=scope)
+            else:
+                shape = tuple(1 if d == -1 else d for d in decl)
+            out.append(jax.ShapeDtypeStruct(shape, s.dtype))
+        return out
+
+    from ..static.io import _export_platforms
+    exported = None
+    for symbolic in (True, False):
+        try:
+            exported = jax_export.export(jax.jit(serving),
+                                         platforms=_export_platforms())(*_avals(symbolic))
+            break
+        except Exception:
+            continue
+    if exported is None:
+        exported = jax_export.export(jax.jit(serving))(*_avals(False))
+    avals = _avals(False)  # concrete shapes for the metadata header
     with open(path + ".pdmodel", "wb") as f:
         f.write(exported.serialize())
     meta = {

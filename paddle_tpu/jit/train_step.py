@@ -114,36 +114,7 @@ class TrainStep:
     def _build(self, treedef, ndims):
         opt = self.optimizer
         params = self._params
-        buffers = self._buffers
-        loss_fn = self.loss_fn
-        wds = [opt._wd_for(p) for p in params]
-        grad_clip = opt._grad_clip
-        model = self.model
-
-        def pure_step(param_arrays, opt_state, step_i, lr, key, *flat_batch):
-            batch = jax.tree.unflatten(treedef, flat_batch)
-
-            def loss_of(pa):
-                with _trace_guard(), _swap_params(params, list(pa)), \
-                        _random.trace_key_scope(key), autograd.no_grad():
-                    out = loss_fn(*_tree_wrap(batch))
-                loss_arr = out._data if isinstance(out, Tensor) else out
-                return loss_arr.astype(jnp.float32)
-
-            loss, grads = jax.value_and_grad(loss_of)(list(param_arrays))
-
-            if grad_clip is not None and type(grad_clip).__name__ == "ClipGradByGlobalNorm":
-                total = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                                     for g in grads))
-                scale = jnp.minimum(1.0, grad_clip.clip_norm / jnp.maximum(total, 1e-12))
-                grads = [g * scale.astype(g.dtype) for g in grads]
-
-            new_params, new_state = [], []
-            for pa, g, st, wd in zip(param_arrays, grads, opt_state, wds):
-                np_, ns_ = opt.update(pa, g, st, lr, step_i, wd)
-                new_params.append(np_)
-                new_state.append(ns_)
-            return loss, tuple(new_params), tuple(new_state)
+        pure_step = self._build_pure(treedef)
 
         kwargs = {}
         if self.mesh is not None:
@@ -191,11 +162,6 @@ class TrainStep:
                 (keys, *flat_batches))
             return losses, pa, st
 
-        kwargs = {}
-        if self.mesh is not None:
-            # parameter/state shardings as in _build; batches add a leading
-            # scan dim with the data axes on dim 1
-            pass  # shardings propagate from the donated param arrays
         return jax.jit(multi, donate_argnums=(0, 1))
 
     def _build_pure(self, treedef):
@@ -247,6 +213,10 @@ class TrainStep:
             self._compiled[(treedef, key_sig)] = compiled
         lr = jnp.float32(self.optimizer.get_lr())
         key = _random.split_key()
+        if self.mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            flat = [jax.device_put(a, self._placement(P(None, *self.data_axes)))
+                    if a.ndim > 1 else a for a in flat]
         losses, new_params, new_state = compiled(
             tuple(p._data for p in self._params), tuple(self._opt_state),
             jnp.int32(self._step_i + 1), lr, key, *flat)

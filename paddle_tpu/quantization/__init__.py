@@ -75,14 +75,18 @@ class FakeQuanterWithAbsMaxObserver(BaseQuanter):
         self._bits = bit_length
         self._scale = None
 
-    def forward(self, x):
+    def observe(self, x):
+        """Update the moving absmax without touching x (PTQ calibration)."""
         cur = absmax_scale(x)
+        if self._scale is None:
+            self._scale = cur
+        else:
+            self._scale = self._rate * self._scale + (1 - self._rate) * cur
+
+    def forward(self, x):
         if self.training:
-            if self._scale is None:
-                self._scale = cur
-            else:
-                self._scale = self._rate * self._scale + (1 - self._rate) * cur
-        s = self._scale if self._scale is not None else cur
+            self.observe(x)
+        s = self._scale if self._scale is not None else absmax_scale(x)
         return fake_quant(x, Tensor(s), self._bits)
 
     def scales(self):
@@ -135,13 +139,21 @@ class QuantedLayer(Layer):
     """Wraps a Linear/Conv2D: fake-quant activations + weights around the
     original forward (reference: nn/quant wrappers in imperative qat)."""
 
-    def __init__(self, inner, act_quanter, weight_quanter):
+    def __init__(self, inner, act_quanter, weight_quanter, observe_only=False):
         super().__init__()
         self.inner = inner
         self.act_quanter = act_quanter() if isinstance(act_quanter, type) else act_quanter
         self.weight_quanter = weight_quanter() if isinstance(weight_quanter, type) else weight_quanter
+        # PTQ calibration: record activation statistics on the raw values,
+        # run the original forward unmodified (reference PTQ observers);
+        # QAT (False): simulate quantization in the forward
+        self.observe_only = observe_only
 
     def forward(self, x):
+        if self.observe_only:
+            if hasattr(self.act_quanter, "observe"):
+                self.act_quanter.observe(x)
+            return self.inner(x)
         x = self.act_quanter(x)
         w = self.inner.weight
         qw = self.weight_quanter(w)
@@ -195,7 +207,8 @@ def _swap_layers(model: Layer, config: QuantConfig, observe_only: bool) -> Layer
         cfg = config._config_for(sub)
         if cfg is not None and not isinstance(sub, QuantedLayer):
             setattr(model, name, QuantedLayer(sub, cfg["activation"],
-                                              cfg["weight"]))
+                                              cfg["weight"],
+                                              observe_only=observe_only))
         else:
             _swap_layers(sub, config, observe_only)
     return model

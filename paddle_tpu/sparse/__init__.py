@@ -316,7 +316,13 @@ sin = _value_unary("sin", jnp.sin)
 tanh = _value_unary("tanh", jnp.tanh)
 sqrt = _value_unary("sqrt", jnp.sqrt)
 abs = _value_unary("abs", jnp.abs)  # noqa: A001
-pow = _value_unary("pow", jnp.square)  # noqa: A001  (2-arg form via functional)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    """Elementwise power on sparse values (reference: paddle.sparse.pow)."""
+    return _value_unary("pow", lambda a: jnp.power(a, factor))(x)
+
+
 cast = None  # assigned below
 
 
