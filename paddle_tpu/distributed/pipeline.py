@@ -252,3 +252,73 @@ def pipeline_scan(stage_fn: Callable, stacked_params, x_microbatches,
                   in_specs=(pspec, P()), out_specs=P(),
                   check_vma=False)
     return f(stacked_params, x_microbatches)
+
+
+def pipeline_scan_interleaved(stage_fn: Callable, stacked_params,
+                              x_microbatches, axis: str = "pp",
+                              num_virtual: int = 2):
+    """Interleaved virtual-stage pipeline (reference:
+    PipelineParallelWithInterleave, pipeline_parallel.py:461-761).
+
+    The model's L = S·V stages are dealt round-robin: device d owns virtual
+    chunks {v·S + d}, so the activation ring visits every device V times per
+    sweep. Versus the plain scan's bubble of (S-1)/(M+S-1) ticks, the
+    interleaved ring keeps devices busy on other chunks while a microbatch
+    transits — the same bubble-shrinking trade (more, smaller p2p messages)
+    the reference's schedule makes, expressed as one lax.scan over ticks
+    with a [V, ...] activation buffer per device and one ppermute per tick.
+
+    `stacked_params` leaves have leading dim L = S·num_virtual ordered by
+    logical stage, sharded P(axis) → each device holds its V chunks.
+    Returns outputs stacked [M, ...].
+    """
+    S = _mesh.mesh_axis_size(axis)
+    V = num_virtual
+    L = S * V
+    M = x_microbatches.shape[0]
+
+    def per_device(params, xs):
+        # params leaves: [V, ...] — this device's chunks, logical stage of
+        # chunk v being v*S + sid
+        sid = lax.axis_index(axis)
+        T = M + L - 1
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        buf = jnp.zeros((V,) + xs.shape[1:], xs.dtype)
+        outs = jnp.zeros((M,) + xs.shape[1:], xs.dtype)
+
+        def tick(carry, t):
+            buf, outs = carry
+            # device 0 chunk 0 consumes a fresh microbatch each tick
+            mb_idx = jnp.clip(t, 0, M - 1)
+            inp0 = jnp.where(sid == 0, xs[mb_idx], buf[0])
+            inp = buf.at[0].set(inp0)
+            acts = []
+            for v in range(V):
+                pv = jax.tree.map(lambda a: a[v], params)
+                acts.append(stage_fn(pv, inp[v]))
+            acts = jnp.stack(acts)
+            # the microbatch leaving logical stage L-1 (device S-1, chunk
+            # V-1) at tick t is t-(L-1)
+            done_idx = t - (L - 1)
+            is_done = jnp.logical_and(sid == S - 1, done_idx >= 0)
+            outs = lax.cond(
+                is_done,
+                lambda o: o.at[jnp.clip(done_idx, 0, M - 1)].set(acts[V - 1]),
+                lambda o: o, outs)
+            rotated = lax.ppermute(acts, axis, perm)
+            # crossing S-1 -> 0 promotes an activation to the next chunk
+            promoted = jnp.roll(rotated, 1, axis=0)
+            new_buf = jnp.where(sid == 0, promoted, rotated)
+            return (new_buf, outs), None
+
+        (buf, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(T))
+        contrib = jnp.where(sid == S - 1, outs, jnp.zeros_like(outs))
+        return lax.psum(contrib, axis)
+
+    mesh = _mesh.get_mesh()
+    from jax import shard_map
+    pspec = jax.tree.map(lambda _: P(axis), stacked_params)
+    f = shard_map(per_device, mesh=mesh,
+                  in_specs=(pspec, P()), out_specs=P(),
+                  check_vma=False)
+    return f(stacked_params, x_microbatches)
