@@ -1,0 +1,185 @@
+"""Op-correctness suite through the OpTest harness (SURVEY §4: dual-executor
+output checks + numeric-vs-analytic gradient checks, the reference's main
+correctness net). Covers a representative op from each kernel family —
+elementwise, reduction, matmul, activation, shape, softmax/норм, indexing."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import OpTest
+
+
+def _f32(*shape, seed=0, scale=1.0, positive=False):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(*shape).astype(np.float32) * scale
+    return np.abs(a) + 0.5 if positive else a
+
+
+class ExpCase(OpTest):
+    def config(self):
+        self.op = paddle.exp
+        self.inputs = {"x": _f32(3, 4)}
+        self.ref = np.exp
+
+
+class LogCase(OpTest):
+    def config(self):
+        self.op = paddle.log
+        self.inputs = {"x": _f32(3, 4, positive=True)}
+        self.ref = np.log
+
+
+class TanhCase(OpTest):
+    def config(self):
+        self.op = paddle.tanh
+        self.inputs = {"x": _f32(2, 5)}
+        self.ref = np.tanh
+
+
+class AddCase(OpTest):
+    def config(self):
+        self.op = paddle.add
+        self.inputs = {"x": _f32(3, 4), "y": _f32(1, 4, seed=1)}  # broadcast
+        self.ref = np.add
+
+
+class MultiplyCase(OpTest):
+    def config(self):
+        self.op = paddle.multiply
+        self.inputs = {"x": _f32(3, 4), "y": _f32(3, 4, seed=2)}
+        self.ref = np.multiply
+
+
+class MatmulCase(OpTest):
+    def config(self):
+        self.op = paddle.matmul
+        self.inputs = {"x": _f32(4, 6), "y": _f32(6, 3, seed=3)}
+        self.ref = np.matmul
+        self.rtol = 1e-4
+        self.atol = 1e-5
+
+
+class MatmulTransYCase(OpTest):
+    def config(self):
+        self.op = paddle.matmul
+        self.attrs = {"transpose_y": True}
+        self.inputs = {"x": _f32(4, 6), "y": _f32(3, 6, seed=4)}
+        self.ref = lambda x, y, transpose_y: x @ y.T
+        self.rtol = 1e-4
+        self.atol = 1e-5
+
+
+class MeanAxisCase(OpTest):
+    def config(self):
+        self.op = paddle.mean
+        self.attrs = {"axis": 1}
+        self.inputs = {"x": _f32(3, 5)}
+        self.ref = lambda x, axis: x.mean(axis)
+
+
+class SumKeepdimCase(OpTest):
+    def config(self):
+        self.op = paddle.sum
+        self.attrs = {"axis": 0, "keepdim": True}
+        self.inputs = {"x": _f32(4, 3)}
+        self.ref = lambda x, axis, keepdim: x.sum(axis, keepdims=True)
+
+
+class SoftmaxCase(OpTest):
+    def config(self):
+        self.op = F.softmax
+        self.attrs = {"axis": -1}
+        self.inputs = {"x": _f32(3, 7)}
+
+        def ref(x, axis):
+            e = np.exp(x - x.max(axis, keepdims=True))
+            return e / e.sum(axis, keepdims=True)
+        self.ref = ref
+
+
+class SigmoidCase(OpTest):
+    def config(self):
+        self.op = F.sigmoid
+        self.inputs = {"x": _f32(4, 4)}
+        self.ref = lambda x: 1 / (1 + np.exp(-x))
+
+
+class GeluCase(OpTest):
+    def config(self):
+        self.op = F.gelu
+        self.inputs = {"x": _f32(3, 4)}
+
+        def ref(x):
+            import math
+            return x * 0.5 * (1.0 + np.vectorize(math.erf)(x / math.sqrt(2)))
+        self.ref = ref
+        self.rtol = 1e-4
+        self.atol = 1e-5
+
+
+class TransposeCase(OpTest):
+    def config(self):
+        self.op = paddle.transpose
+        self.attrs = {"perm": [1, 0, 2]}
+        self.inputs = {"x": _f32(2, 3, 4)}
+        self.ref = lambda x, perm: x.transpose(perm)
+
+
+class ReshapeCase(OpTest):
+    def config(self):
+        self.op = paddle.reshape
+        self.attrs = {"shape": [6, 2]}
+        self.inputs = {"x": _f32(3, 4)}
+        self.ref = lambda x, shape: x.reshape(shape)
+
+
+class ConcatCase(OpTest):
+    def config(self):
+        self.op = lambda x, y, axis: paddle.concat([x, y], axis=axis)
+        self.attrs = {"axis": 1}
+        self.inputs = {"x": _f32(2, 3), "y": _f32(2, 4, seed=5)}
+        self.ref = lambda x, y, axis: np.concatenate([x, y], axis)
+
+
+class PowCase(OpTest):
+    def config(self):
+        self.op = paddle.pow
+        self.attrs = {"y": 3.0}
+        self.inputs = {"x": _f32(3, 3, positive=True)}
+        self.ref = lambda x, y: np.power(x, y)
+        self.grad_rtol = 3e-2
+
+
+class MaximumCase(OpTest):
+    def config(self):
+        self.op = paddle.maximum
+        self.inputs = {"x": _f32(4, 4), "y": _f32(4, 4, seed=6)}
+        self.ref = np.maximum
+
+
+class WhereGradFreeCase(OpTest):
+    def config(self):
+        c = _f32(3, 3) > 0
+        self.op = lambda x, y: paddle.where(paddle.to_tensor(c), x, y)
+        self.inputs = {"x": _f32(3, 3), "y": _f32(3, 3, seed=7)}
+        self.ref = lambda x, y: np.where(c, x, y)
+
+
+_OUTPUT_ONLY = (WhereGradFreeCase,)
+_ALL = [ExpCase, LogCase, TanhCase, AddCase, MultiplyCase, MatmulCase,
+        MatmulTransYCase, MeanAxisCase, SumKeepdimCase, SoftmaxCase,
+        SigmoidCase, GeluCase, TransposeCase, ReshapeCase, ConcatCase,
+        PowCase, MaximumCase, WhereGradFreeCase]
+
+
+@pytest.mark.parametrize("case", _ALL, ids=lambda c: c.__name__)
+def test_output(case):
+    case().check_output()
+
+
+@pytest.mark.parametrize("case", [c for c in _ALL if c not in _OUTPUT_ONLY],
+                         ids=lambda c: c.__name__)
+def test_grad(case):
+    t = case()
+    t.check_grad(list(t.inputs.keys()))
