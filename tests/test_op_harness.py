@@ -1,7 +1,7 @@
 """Op-correctness suite through the OpTest harness (SURVEY §4: dual-executor
 output checks + numeric-vs-analytic gradient checks, the reference's main
 correctness net). Covers a representative op from each kernel family —
-elementwise, reduction, matmul, activation, shape, softmax/норм, indexing."""
+elementwise, reduction, matmul, activation, shape, softmax/norm, indexing."""
 import numpy as np
 import pytest
 
