@@ -17,3 +17,7 @@ from .bert import (  # noqa: F401
 from .vit import (  # noqa: F401
     ViTConfig, VisionTransformer, vit_config,
 )
+from .ernie import (  # noqa: F401
+    ErnieConfig, ErnieModel, ErnieForSequenceClassification,
+    ErnieForMaskedLM, ernie_config,
+)

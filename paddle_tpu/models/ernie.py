@@ -1,0 +1,104 @@
+"""ERNIE model family (reference entrypoint class: ERNIE pretraining /
+fine-tuning configs listed in BASELINE.md; architecture = BERT-style encoder
+with task-id embeddings, per the original ERNIE 1.0/2.0 papers).
+
+TPU-native: reuses the mpu-sharded BERT encoder stack (models/bert.py) —
+ERNIE's delta over BERT is the extra `task_type_embeddings` table and its
+knowledge-masking *data* strategy (a masking policy, not an architecture
+change), so the module adds exactly that and keeps every sharding/fusion
+property of the BERT path.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core import ops
+from ..nn.layer import Layer
+from ..nn.layers.common import Embedding, Dropout, Linear
+from ..nn import functional as F
+from .bert import (BertConfig, BertEmbeddings, BertLayer, BertPooler,
+                   _tied_logits)
+from ..nn.layer import LayerList
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForSequenceClassification",
+           "ErnieForMaskedLM", "ernie_config"]
+
+
+@dataclass
+class ErnieConfig(BertConfig):
+    vocab_size: int = 18000
+    use_task_id: bool = True
+    task_type_vocab_size: int = 3
+
+
+_PRESETS = {
+    "ernie-1.0": dict(vocab_size=18000, hidden_size=768, num_layers=12,
+                      num_heads=12, max_position_embeddings=513),
+    "ernie-3.0-medium": dict(vocab_size=40000, hidden_size=768, num_layers=6,
+                             num_heads=12, max_position_embeddings=2048),
+    "ernie-tiny": dict(vocab_size=18000, hidden_size=312, num_layers=4,
+                       num_heads=12, max_position_embeddings=512,
+                       intermediate_size=1248),
+}
+
+
+def ernie_config(preset: str, **overrides) -> ErnieConfig:
+    cfg = dict(_PRESETS[preset])
+    cfg.update(overrides)
+    return ErnieConfig(**cfg)
+
+
+class ErnieModel(Layer):
+    """Encoder trunk: BERT embeddings + task-type embeddings + N sharded
+    transformer layers + pooler."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        if config.use_task_id:
+            self.task_type_embeddings = Embedding(
+                config.task_type_vocab_size, config.hidden_size)
+        self.layers = LayerList([BertLayer(config)
+                                 for _ in range(config.num_layers)])
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        if self.config.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = ops.zeros_like(input_ids)
+            h = h + self.task_type_embeddings(task_type_ids)
+        for layer in self.layers:
+            h = layer(h, attention_mask)
+        return h, self.pooler(h)
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2,
+                 dropout: Optional[float] = None):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = Dropout(config.hidden_dropout
+                               if dropout is None else dropout)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                               attention_mask, task_type_ids)
+        return self.classifier(self.dropout(pooled))
+
+
+class ErnieForMaskedLM(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        h, _ = self.ernie(input_ids, token_type_ids, position_ids,
+                          attention_mask, task_type_ids)
+        return _tied_logits(h, self.ernie.embeddings.word_embeddings)

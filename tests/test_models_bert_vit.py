@@ -169,3 +169,31 @@ class TestViT:
             opt.clear_grad()
             losses.append(float(loss))
         assert losses[-1] < 0.3 * losses[0]
+
+
+def test_ernie_classification_and_mlm():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (ernie_config, ErnieForSequenceClassification,
+                                   ErnieForMaskedLM)
+    paddle.seed(0)
+    cfg = ernie_config("ernie-tiny", vocab_size=128,
+                       max_position_embeddings=32, num_layers=2)
+    ids = paddle.to_tensor(np.random.randint(0, 128, (2, 16)).astype("int64"))
+    task = paddle.to_tensor(np.ones((2, 16), np.int64))
+
+    clf = ErnieForSequenceClassification(cfg, num_classes=3)
+    logits = clf(ids, task_type_ids=task)
+    assert list(logits.shape) == [2, 3]
+    # trains one step
+    loss = paddle.nn.functional.cross_entropy(
+        logits, paddle.to_tensor(np.array([0, 2], np.int64)))
+    loss.backward()
+    assert clf.classifier.weight.grad is not None
+    # task embedding changes the output (vs task 0)
+    logits0 = clf(ids)
+    assert not np.allclose(logits.numpy(), logits0.numpy())
+
+    mlm = ErnieForMaskedLM(cfg)
+    out = mlm(ids)
+    assert list(out.shape) == [2, 16, 128]
