@@ -89,3 +89,117 @@ def cond(pred, true_fn, false_fn):
     def fn(p, t, f):
         return jax.lax.cond(p.reshape(()).astype(bool), lambda: t, lambda: f)
     return apply_op("cond", fn, [pred, t_out, f_out])
+
+
+def while_loop(cond_fn, body_fn, loop_vars):
+    """Static while loop (reference: paddle.static.nn.while_loop → the While
+    op over a sub-block, framework/operators/controlflow/while_op). The
+    WHOLE loop records as one op whose replay is lax.while_loop
+    (compiler-friendly control flow, SURVEY §7).
+
+    Closures over outer Variables (the reference's sub-block reading parent-
+    block vars) are supported: a probe trace discovers which outer Variables
+    the body/cond read; they become extra inputs of the recorded op, and at
+    replay their values are swapped in while recording is suppressed.
+    """
+    import jax
+    from ..core.tensor import Tensor, apply_op
+    from .program import (Variable, Program, program_guard, in_static_mode,
+                          suppress_recording)
+
+    loop_vars = list(loop_vars)
+    n = len(loop_vars)
+
+    captures = []
+    if in_static_mode():
+        # probe: run cond/body on fresh Variables inside a throwaway program;
+        # any ("v", vid) input NOT created by the probe is an outer capture
+        probe = Program()
+        with program_guard(probe):
+            pv = [probe._new_var(jax.ShapeDtypeStruct(
+                tuple(v._data.shape), v._data.dtype)) for v in loop_vars]
+            cond_fn(*pv)
+            body_fn(*pv)
+        probe_vids = {v.vid for v in probe._vars.values()}
+        seen = {}
+        for node in probe._nodes:
+            for kind, ref in node.inputs:
+                if kind == "v" and ref not in probe_vids:
+                    seen[ref] = True
+        # resolve capture vids back to live Variable objects
+        from .program import default_main_program
+        outer = default_main_program()
+        captures = [outer._vars[vid] for vid in seen if vid in outer._vars]
+
+    def fn(*arrays):
+        loop_arrs, cap_arrs = arrays[:n], arrays[n:]
+
+        def run_with_captures(f, vs):
+            saved = [c._data for c in captures]
+            for c, a in zip(captures, cap_arrs):
+                c._data = a
+            try:
+                with suppress_recording():
+                    return f(*[Tensor(v) for v in vs])
+            finally:
+                for c, s in zip(captures, saved):
+                    c._data = s
+
+        def c(vs):
+            t = run_with_captures(cond_fn, vs)
+            t = t._data if isinstance(t, Tensor) else t
+            return t.reshape(()).astype(bool)
+
+        def b(vs):
+            out = run_with_captures(body_fn, vs)
+            out = out if isinstance(out, (tuple, list)) else (out,)
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+
+        return jax.lax.while_loop(c, b, tuple(loop_arrs))
+
+    out = apply_op("while_loop", fn, loop_vars + captures, n_outputs=n)
+    out = out[:n] if isinstance(out, tuple) else (out,)
+    return list(out)
+
+
+def case(pred_fn_pairs, default=None):
+    """reference: paddle.static.nn.case — first true predicate wins."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if not rest:
+        if default is None:
+            return fn()
+        return cond(pred, fn, default)
+    return cond(pred, fn, lambda: case(rest, default))
+
+
+def switch_case(branch_index, branch_fns, default=None):
+    """reference: paddle.static.nn.switch_case — dispatch on an int index.
+    Replays as lax.switch (one compiled branch table)."""
+    import jax
+    from ..core.tensor import Tensor, apply_op
+
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+        keys = [k for k, _ in items]
+        fns = [f for _, f in items]
+    else:
+        keys = list(range(len(branch_fns)))
+        fns = list(branch_fns)
+    outs = [f() for f in fns]
+    if default is not None:
+        outs.append(default())
+    keys_arr = keys
+
+    def fn(idx, *branch_vals):
+        import jax.numpy as jnp
+        idx = idx.reshape(()).astype(jnp.int32)
+        # map branch_index -> position; unmatched -> default (last) if given
+        pos = len(branch_vals) - 1 if default is not None else 0
+        sel = jnp.int32(pos)
+        for i, k in enumerate(keys_arr):
+            sel = jnp.where(idx == k, jnp.int32(i), sel)
+        return jax.lax.switch(sel, [lambda v=v: v for v in branch_vals])
+    return apply_op("switch_case", fn, [branch_index] + outs)

@@ -116,6 +116,10 @@ class Block:
         raise ValueError(f"no variable named {name!r} in block")
 
 
+_GLOBAL_VID = itertools.count()  # vids unique across ALL programs so
+# cross-program references (control-flow capture probes) are unambiguous
+
+
 class Program:
     """Recorded op-DAG (reference: fluid/framework.py Program / ProgramDesc)."""
 
@@ -128,7 +132,7 @@ class Program:
         self._key_vars: List[Variable] = []
         self._params: List[Parameter] = []   # ordered unique parameter refs
         self._param_ids: Dict[int, int] = {}  # id(param) -> index
-        self._vid = itertools.count()
+        self._vid = _GLOBAL_VID
         self._version = 0
         self._loss_vid: Optional[int] = None
         self._grad_of: Dict[int, int] = {}    # param index -> grad vid
@@ -176,7 +180,7 @@ class Program:
         p._key_vars = list(self._key_vars)
         p._params = list(self._params)
         p._param_ids = dict(self._param_ids)
-        p._vid = itertools.count(self._version + len(self._vars) + 1000)
+        p._vid = _GLOBAL_VID
         p._version = self._version
         if not for_test:
             p._loss_vid = self._loss_vid
@@ -295,15 +299,33 @@ def _symbolic_key():
     return v
 
 
+_record_suppressed = False
+
+
+@contextlib.contextmanager
+def suppress_recording():
+    """Run ops eagerly even in static mode — used while REPLAYING recorded
+    control-flow bodies (while_loop), where captured Variables temporarily
+    hold real/traced arrays."""
+    global _record_suppressed
+    prev = _record_suppressed
+    _record_suppressed = True
+    try:
+        yield
+    finally:
+        _record_suppressed = prev
+
+
 def _recording_active() -> bool:
-    return _static_mode
+    return _static_mode and not _record_suppressed
 
 
 def _record_apply(name, fn, tensor_args, static_kwargs, n_outputs):
     """The static-mode branch of core.tensor.apply_op: append an OpNode when
     any input is symbolic; otherwise fall through to eager (returns
     NotImplemented)."""
-    if not _static_mode or not any(isinstance(a, Variable) for a in tensor_args):
+    if not _recording_active() or not any(
+            isinstance(a, Variable) for a in tensor_args):
         return NotImplemented
     prog = default_main_program()
     inputs = []

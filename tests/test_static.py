@@ -230,3 +230,66 @@ def test_dropout_batch_independent_with_dynamic_batch():
     masks = (out == 0)
     # rows must not all share one mask (build-time shape was batch=1)
     assert not all(np.array_equal(masks[0], masks[i]) for i in range(1, 32))
+
+
+def test_while_loop_static():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [1], "float32")
+        i = paddle.full([1], 0.0)
+        s = paddle.full([1], 0.0)
+
+        def cond_fn(i, s):
+            return i < 5.0
+
+        def body_fn(i, s):
+            return i + 1.0, s + x * i
+
+        i_out, s_out = static.nn.while_loop(cond_fn, body_fn, [i, s])
+    exe = static.Executor()
+    (sv,) = exe.run(main, feed={"x": np.array([2.0], np.float32)},
+                    fetch_list=[s_out])
+    # sum over i=0..4 of 2*i = 2*(0+1+2+3+4) = 20
+    np.testing.assert_allclose(sv, [20.0])
+
+
+def test_while_loop_eager():
+    paddle.disable_static()
+    try:
+        i = paddle.full([1], 0.0)
+        s = paddle.full([1], 1.0)
+        i_out, s_out = static.nn.while_loop(
+            lambda i, s: i < 3.0, lambda i, s: (i + 1.0, s * 2.0), [i, s])
+        np.testing.assert_allclose(s_out.numpy(), [8.0])
+    finally:
+        paddle.enable_static()
+
+
+def test_switch_case_static():
+    main = static.Program()
+    with static.program_guard(main):
+        idx = static.data("idx", [1], "int32")
+        x = static.data("x", [3], "float32")
+        out = static.nn.switch_case(idx, [
+            lambda: x * 1.0, lambda: x * 10.0],
+            default=lambda: x * 0.0)
+    exe = static.Executor()
+    xv = np.array([1., 2., 3.], np.float32)
+    for i, mult in ((0, 1.0), (1, 10.0), (7, 0.0)):
+        (ov,) = exe.run(main, feed={"idx": np.array([i], np.int32), "x": xv},
+                        fetch_list=[out])
+        np.testing.assert_allclose(ov, xv * mult)
+
+
+def test_case_static():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [1], "float32")
+        out = static.nn.case(
+            [(x > 2.0, lambda: x * 100.0), (x > 0.0, lambda: x * 10.0)],
+            default=lambda: x)
+    exe = static.Executor()
+    for v, want in ((3.0, 300.0), (1.0, 10.0), (-1.0, -1.0)):
+        (ov,) = exe.run(main, feed={"x": np.array([v], np.float32)},
+                        fetch_list=[out])
+        np.testing.assert_allclose(ov, [want])
