@@ -4,4 +4,5 @@ Lamb + lr)."""
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp, Lamb,
+    LarsMomentum, DGCMomentum,
 )

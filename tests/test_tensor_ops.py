@@ -226,3 +226,32 @@ class TestDtype:
         x = t(a).astype("bfloat16")
         y = (x @ x).astype("float32")
         assert np.isfinite(y.numpy()).all()
+
+
+def test_lars_and_dgc_optimizers_train():
+    """LarsMomentum / DGCMomentum converge on a linear problem (reference:
+    LarsMomentumOptimizer, DGCMomentumOptimizer meta strategies)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    def train(opt_cls, **kw):
+        paddle.seed(0)
+        np.random.seed(0)
+        X = np.random.randn(128, 4).astype("float32")
+        Y = X @ np.array([[1.], [-2.], [0.5], [3.]], np.float32)
+        m = nn.Linear(4, 1)
+        opt = opt_cls(parameters=m.parameters(), **kw)
+        losses = []
+        for _ in range(80):
+            loss = ((m(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        return losses
+
+    l = train(paddle.optimizer.LarsMomentum, learning_rate=0.5, lars_coeff=0.1)
+    assert l[-1] < l[0] * 0.1
+    l = train(paddle.optimizer.DGCMomentum, learning_rate=0.05, sparsity=0.5)
+    assert l[-1] < l[0] * 0.2
