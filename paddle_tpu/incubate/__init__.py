@@ -6,3 +6,4 @@ from . import optimizer  # noqa: F401
 from .nn.functional import fused_matmul_bias  # noqa: F401
 
 from . import asp  # noqa: E402,F401
+from . import autograd  # noqa: E402,F401

@@ -1,0 +1,123 @@
+"""paddle.incubate.autograd — functional/higher-order autodiff.
+
+Reference (SURVEY §2.1 "Prim/composite autodiff"): incubate/autograd/
+primx.py builds a primitive-op graph so static programs can take 2nd-order
+derivatives; paddle.incubate.autograd exposes jvp/vjp/Jacobian/Hessian.
+TPU-native: the substrate is already functional — these are direct
+projections of jax.jvp/vjp/jacfwd/jacrev/hessian onto the Tensor API, and
+they compose to any order (the whole reason the reference needed the prim
+rewrite is structural here)."""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core import autograd as _eager_autograd
+
+
+def _unwrap(xs):
+    if isinstance(xs, (tuple, list)):
+        return tuple(_unwrap(x) for x in xs)
+    return xs._data if isinstance(xs, Tensor) else jnp.asarray(xs)
+
+
+def _wrap(xs):
+    if isinstance(xs, (tuple, list)):
+        return tuple(_wrap(x) for x in xs)
+    return Tensor(xs)
+
+
+def _as_pure(func: Callable) -> Callable:
+    def pure(*arrays):
+        out = func(*_wrap(arrays))
+        return _unwrap(out)
+    return pure
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode: (outputs, J·v). reference: incubate/autograd/functional.py."""
+    xs = xs if isinstance(xs, (tuple, list)) else (xs,)
+    v = v if v is not None else tuple(
+        Tensor(jnp.ones_like(_unwrap(x))) for x in xs)
+    v = v if isinstance(v, (tuple, list)) else (v,)
+    out, tangent = jax.jvp(_as_pure(func), _unwrap(xs), _unwrap(v))
+    return _wrap(out), _wrap(tangent)
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode: (outputs, vᵀ·J). reference: functional.py vjp."""
+    xs = xs if isinstance(xs, (tuple, list)) else (xs,)
+    out, vjp_fn = jax.vjp(_as_pure(func), *_unwrap(xs))
+    if v is None:
+        v = jax.tree.map(jnp.ones_like, out)
+    else:
+        v = _unwrap(v if isinstance(v, (tuple, list)) else (v,))
+        if not isinstance(out, tuple):
+            v = v[0]
+    grads = vjp_fn(v)
+    return _wrap(out), _wrap(grads)
+
+
+class Jacobian:
+    """Lazy full Jacobian (reference: incubate/autograd/functional.py
+    Jacobian — row-wise lazy evaluation; here jacrev, computed on access)."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._func = func
+        self._xs = xs if isinstance(xs, (tuple, list)) else (xs,)
+        self._val = None
+
+    def _compute(self):
+        if self._val is None:
+            jac = jax.jacrev(_as_pure(self._func),
+                             argnums=tuple(range(len(self._xs))))(
+                *_unwrap(self._xs))
+            self._val = jac[0] if len(self._xs) == 1 else jac
+        return self._val
+
+    def __getitem__(self, idx):
+        return _wrap(self._compute()[idx] if not isinstance(self._compute(), tuple)
+                     else tuple(j[idx] for j in self._compute()))
+
+    @property
+    def shape(self):
+        v = self._compute()
+        v = v[0] if isinstance(v, tuple) else v
+        return list(v.shape)
+
+    def numpy(self):
+        import numpy as np
+        v = self._compute()
+        return np.asarray(v if not isinstance(v, tuple) else v[0])
+
+
+class Hessian(Jacobian):
+    """Lazy Hessian of a scalar function (reference: functional.py Hessian)."""
+
+    def _compute(self):
+        if self._val is None:
+            h = jax.hessian(lambda *a: _as_pure(self._func)(*a).reshape(()),
+                            argnums=tuple(range(len(self._xs))))(
+                *_unwrap(self._xs))
+            if len(self._xs) == 1:
+                h = h[0][0] if isinstance(h, tuple) else h
+            self._val = h
+        return self._val
+
+
+def grad(func: Callable, xs, order: int = 1):
+    """n-th order gradient of a scalar function (the capability the
+    reference's prim/composite-grad machinery exists to provide)."""
+    pure = lambda *a: _as_pure(func)(*a).reshape(())  # noqa: E731
+    g = pure
+    for _ in range(order):
+        g = jax.grad(g)
+    xs = xs if isinstance(xs, (tuple, list)) else (xs,)
+    return _wrap(g(*_unwrap(xs)))
+
+
+def forward_grad(func, xs, v=None):
+    return jvp(func, xs, v)[1]

@@ -209,3 +209,35 @@ class TestPyLayer:
         (s.sum() + m.sum()).backward()
         np.testing.assert_allclose(x.grad.numpy(), 1 + b)
         np.testing.assert_allclose(y.grad.numpy(), 1 + a)
+
+
+def test_incubate_functional_autodiff():
+    """jvp/vjp/Jacobian/Hessian + higher-order grad (reference:
+    paddle.incubate.autograd functional API over the prim machinery)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate import autograd as iag
+
+    def f(x):
+        return (x ** 3).sum()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    out, tangent = iag.jvp(f, x)
+    np.testing.assert_allclose(float(tangent), 3 * 1 + 3 * 4, rtol=1e-5)
+
+    out, (gx,) = iag.vjp(f, x)
+    np.testing.assert_allclose(gx.numpy(), [3.0, 12.0], rtol=1e-5)
+
+    def g(x):
+        return paddle.stack([x[0] * x[1], x[0] + x[1]])
+
+    J = iag.Jacobian(g, x)
+    np.testing.assert_allclose(J.numpy(), [[2.0, 1.0], [1.0, 1.0]], rtol=1e-5)
+
+    H = iag.Hessian(f, x)
+    np.testing.assert_allclose(H.numpy(), [[6.0, 0.0], [0.0, 12.0]], rtol=1e-5)
+
+    # third-order derivative of sum(x^3) wrt scalar-summed input: 6
+    g3 = iag.grad(lambda x: (x ** 3).sum(), paddle.to_tensor(np.float32(2.0)),
+                  order=3)
+    np.testing.assert_allclose(float(g3), 6.0, rtol=1e-5)
