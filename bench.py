@@ -30,12 +30,54 @@ def _chip_peak_flops(device) -> float:
     return 275e12  # assume v4 if unknown
 
 
+def bench_resnet50(on_tpu):
+    """ResNet-50 ImageNet-shape training throughput (BASELINE.md config).
+    Same honest protocol as the GPT bench: N steps fused in one scan
+    executable, host-read fence."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.vision.models import resnet50
+    import paddle_tpu.nn as nn
+
+    B, hw, iters = (64, 224, 8) if on_tpu else (4, 64, 2)
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    ce = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                    parameters=model.parameters())
+    step = TrainStep(model, opt, lambda x, y: ce(model(x), y))
+    imgs = paddle.to_tensor(np.random.randn(iters, B, 3, hw, hw).astype(
+        "bfloat16" if on_tpu else "float32"))
+    lbls = paddle.to_tensor(np.random.randint(0, 1000, (iters, B)).astype("int64"))
+    losses = step.run_steps(iters, imgs, lbls)
+    _ = float(losses.numpy()[-1])
+    t0 = time.perf_counter()
+    losses = step.run_steps(iters, imgs, lbls)
+    final = float(losses.numpy()[-1])
+    dt = time.perf_counter() - t0
+    ips = B * iters / dt
+    print(json.dumps({
+        "metric": f"images/sec/chip (resnet50 train, B={B} {hw}x{hw})",
+        "value": round(ips, 1), "unit": "images/s",
+        "vs_baseline": None,
+        "extra": {"step_ms": round(dt / iters * 1e3, 2),
+                  "loss": round(final, 4)},
+    }))
+
+
 def main():
     import jax
     import numpy as np
 
     devs = jax.devices()
     on_tpu = devs[0].platform in ("tpu", "axon")
+
+    if os.environ.get("PADDLE_TPU_BENCH_MODEL") == "resnet50":
+        return bench_resnet50(on_tpu)
 
     import paddle_tpu as paddle
     from paddle_tpu.jit.train_step import TrainStep

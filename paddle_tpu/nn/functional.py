@@ -118,10 +118,13 @@ def _convnd(x, weight, bias, stride, padding, dilation, groups, n, data_format):
     pad_cfg = _conv_padding(padding, n)
 
     def fn(a, w, *b):
+        # NOTE: no preferred_element_type upcast — the TPU MXU accumulates
+        # bf16 convs in f32 internally, and an explicit f32 preference makes
+        # jax's conv vjp emit an f32-cotangent × bf16-weight transposed conv,
+        # which lax rejects (dtype mismatch in the backward pass)
         out = lax.conv_general_dilated(
             a, w, window_strides=strides, padding=pad_cfg,
-            rhs_dilation=dil, dimension_numbers=dn, feature_group_count=groups,
-            preferred_element_type=jnp.float32 if a.dtype in (jnp.bfloat16, jnp.float16) else None)
+            rhs_dilation=dil, dimension_numbers=dn, feature_group_count=groups)
         out = out.astype(a.dtype)
         if b:
             bshape = [1] * out.ndim
