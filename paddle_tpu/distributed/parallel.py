@@ -51,15 +51,21 @@ def init_parallel_env(mesh_axes: Optional[dict] = None):
     (parallel.py:146-214) with no sockets to manage.
     """
     coord = os.environ.get("PADDLE_TPU_COORDINATOR")
-    if coord and jax.process_count() == 1 and not _mesh._get("dist_initialized"):
+    nproc = int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1"))
+    # IMPORTANT: don't touch jax.devices()/process_count() before
+    # initialize — any backend query initializes the runtime and makes a
+    # later jax.distributed.initialize a no-op (the classic ordering trap)
+    if coord and nproc > 1 and not _mesh._get("dist_initialized"):
         try:
             jax.distributed.initialize(
                 coordinator_address=coord,
-                num_processes=int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1")),
+                num_processes=nproc,
                 process_id=int(os.environ.get("PADDLE_TPU_PROCESS_ID", "0")))
             _mesh._state.dist_initialized = True
-        except Exception:
-            pass
+        except Exception as e:
+            import warnings
+            warnings.warn(f"jax.distributed.initialize failed: {e}; "
+                          "continuing single-process")
     if _mesh.get_mesh() is None:
         axes = mesh_axes or {"dp": len(jax.devices())}
         _mesh.set_mesh(_mesh.build_mesh(axes))

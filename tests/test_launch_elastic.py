@@ -142,3 +142,36 @@ def test_launch_restarts_on_failure(tmp_path):
 def test_launch_fails_without_elastic(tmp_path):
     r = _run_launch(tmp_path, ["--nproc_per_node", "2"], ["fail"])
     assert r.returncode == 7
+
+
+JAX_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.distributed as dist
+    env = dist.init_parallel_env()
+    n = len(jax.devices())
+    pc = jax.process_count()
+    out_dir = sys.argv[1]
+    with open(os.path.join(out_dir, f"world_{jax.process_index()}.txt"), "w") as f:
+        f.write(f"{pc}:{n}")
+""")
+
+
+def test_launch_jax_distributed_two_procs(tmp_path):
+    """The launcher's coordinator env contract actually stitches two
+    processes into one jax.distributed world (the analog of the reference's
+    2-proc NCCL tests, SURVEY §4 mechanism 2)."""
+    script = tmp_path / "jaxworker.py"
+    script.write_text(JAX_WORKER)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", "2", "--devices_per_proc", "1",
+           str(script), str(tmp_path)]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=180, cwd="/root/repo")
+    assert r.returncode == 0, r.stderr[-2000:]
+    w0 = (tmp_path / "world_0.txt").read_text()
+    w1 = (tmp_path / "world_1.txt").read_text()
+    assert w0 == "2:2" and w1 == "2:2", (w0, w1)
