@@ -12,3 +12,4 @@ from .small import __all__ as _s
 from .densenet import __all__ as _d
 
 __all__ = list(_r) + list(_v) + list(_m) + list(_s) + list(_d)
+from .yolo import YOLOConfig, YOLODetector, yolo_lite, yolo_loss  # noqa: F401
