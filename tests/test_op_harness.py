@@ -183,3 +183,199 @@ def test_output(case):
 def test_grad(case):
     t = case()
     t.check_grad(list(t.inputs.keys()))
+
+
+# ---- second wave: indexing / normalization / comparison / trig families
+class SqrtCase(OpTest):
+    def config(self):
+        self.op = paddle.sqrt
+        self.inputs = {"x": _f32(3, 4, positive=True)}
+        self.ref = np.sqrt
+
+
+class RsqrtCase(OpTest):
+    def config(self):
+        self.op = paddle.rsqrt
+        self.inputs = {"x": _f32(3, 4, positive=True)}
+        self.ref = lambda x: 1.0 / np.sqrt(x)
+
+
+class SinCosCase(OpTest):
+    def config(self):
+        self.op = paddle.sin
+        self.inputs = {"x": _f32(4, 4)}
+        self.ref = np.sin
+
+
+class AtanCase(OpTest):
+    def config(self):
+        self.op = paddle.atan
+        self.inputs = {"x": _f32(3, 3)}
+        self.ref = np.arctan
+
+
+class SubtractCase(OpTest):
+    def config(self):
+        self.op = paddle.subtract
+        self.inputs = {"x": _f32(2, 5), "y": _f32(2, 5, seed=8)}
+        self.ref = np.subtract
+
+
+class DivideCase(OpTest):
+    def config(self):
+        self.op = paddle.divide
+        self.inputs = {"x": _f32(3, 3), "y": _f32(3, 3, seed=9, positive=True)}
+        self.ref = np.divide
+        self.grad_rtol = 3e-2
+
+
+class MinimumCase(OpTest):
+    def config(self):
+        self.op = paddle.minimum
+        self.inputs = {"x": _f32(4, 4), "y": _f32(4, 4, seed=10)}
+        self.ref = np.minimum
+
+
+class AbsCase(OpTest):
+    def config(self):
+        self.op = paddle.abs
+        self.inputs = {"x": _f32(3, 4) + 0.3}  # keep away from 0 kink
+        self.ref = np.abs
+
+
+class ClipCase(OpTest):
+    def config(self):
+        self.op = paddle.clip
+        self.attrs = {"min": -0.5, "max": 0.5}
+        self.inputs = {"x": _f32(4, 4)}
+        self.ref = lambda x, min, max: np.clip(x, min, max)  # noqa: A002
+
+
+class SquareCase(OpTest):
+    def config(self):
+        self.op = paddle.square
+        self.inputs = {"x": _f32(3, 3)}
+        self.ref = np.square
+
+
+class MaxReduceCase(OpTest):
+    def config(self):
+        self.op = paddle.max
+        self.attrs = {"axis": 1}
+        self.inputs = {"x": _f32(3, 5)}
+        self.ref = lambda x, axis: x.max(axis)
+
+
+class ProdCase(OpTest):
+    def config(self):
+        self.op = paddle.prod
+        self.attrs = {"axis": 1}
+        self.inputs = {"x": _f32(3, 4, positive=True)}
+        self.ref = lambda x, axis: x.prod(axis)
+        self.grad_rtol = 3e-2
+
+
+class LogSumExpCase(OpTest):
+    def config(self):
+        self.op = paddle.logsumexp
+        self.attrs = {"axis": 1}
+        self.inputs = {"x": _f32(3, 6)}
+
+        def ref(x, axis):
+            m = x.max(axis, keepdims=True)
+            return (np.log(np.exp(x - m).sum(axis)) + m.squeeze(axis))
+        self.ref = ref
+
+
+class StackCase(OpTest):
+    def config(self):
+        self.op = lambda x, y, axis: paddle.stack([x, y], axis=axis)
+        self.attrs = {"axis": 1}
+        self.inputs = {"x": _f32(2, 3), "y": _f32(2, 3, seed=11)}
+        self.ref = lambda x, y, axis: np.stack([x, y], axis)
+
+
+class SplitFirstCase(OpTest):
+    def config(self):
+        self.op = lambda x: paddle.split(x, 2, axis=1)[0]
+        self.inputs = {"x": _f32(2, 6)}
+        self.ref = lambda x: np.split(x, 2, axis=1)[0]
+
+
+class GatherCase(OpTest):
+    idx = np.array([2, 0, 1], np.int64)
+
+    def config(self):
+        self.op = lambda x: paddle.gather(x, paddle.to_tensor(self.idx), axis=0)
+        self.inputs = {"x": _f32(4, 3)}
+        self.ref = lambda x: x[self.idx]
+
+
+class TileCase(OpTest):
+    def config(self):
+        self.op = paddle.tile
+        self.attrs = {"repeat_times": [2, 3]}
+        self.inputs = {"x": _f32(2, 2)}
+        self.ref = lambda x, repeat_times: np.tile(x, repeat_times)
+
+
+class PadCase(OpTest):
+    def config(self):
+        self.op = paddle.pad
+        self.attrs = {"pad": [1, 1, 2, 2]}
+        self.inputs = {"x": _f32(2, 3)}
+        self.ref = lambda x, pad: np.pad(x, [(1, 1), (2, 2)])
+
+
+class CumsumCase(OpTest):
+    def config(self):
+        self.op = paddle.cumsum
+        self.attrs = {"axis": 1}
+        self.inputs = {"x": _f32(3, 4)}
+        self.ref = lambda x, axis: np.cumsum(x, axis)
+
+
+class LogSoftmaxCase(OpTest):
+    def config(self):
+        self.op = F.log_softmax
+        self.attrs = {"axis": -1}
+        self.inputs = {"x": _f32(3, 5)}
+
+        def ref(x, axis):
+            m = x.max(axis, keepdims=True)
+            e = np.exp(x - m)
+            return x - m - np.log(e.sum(axis, keepdims=True))
+        self.ref = ref
+
+
+class LeakyReluCase(OpTest):
+    def config(self):
+        self.op = F.leaky_relu
+        self.inputs = {"x": _f32(4, 4) + 0.3}
+        self.ref = lambda x: np.where(x >= 0, x, 0.01 * x)
+
+
+class MishCase(OpTest):
+    def config(self):
+        self.op = F.mish
+        self.inputs = {"x": _f32(3, 4)}
+        self.ref = lambda x: x * np.tanh(np.log1p(np.exp(x)))
+        self.rtol = 1e-4
+        self.atol = 1e-5
+
+
+_WAVE2 = [SqrtCase, RsqrtCase, SinCosCase, AtanCase, SubtractCase, DivideCase,
+          MinimumCase, AbsCase, ClipCase, SquareCase, MaxReduceCase, ProdCase,
+          LogSumExpCase, StackCase, SplitFirstCase, GatherCase, TileCase,
+          PadCase, CumsumCase, LogSoftmaxCase, LeakyReluCase, MishCase]
+
+
+@pytest.mark.parametrize("case", _WAVE2, ids=lambda c: c.__name__)
+def test_output_wave2(case):
+    case().check_output()
+
+
+@pytest.mark.parametrize("case", _WAVE2, ids=lambda c: c.__name__)
+def test_grad_wave2(case):
+    t = case()
+    t.check_grad(list(t.inputs.keys()))
