@@ -1,21 +1,25 @@
-"""Pallas flash attention for TPU — forward AND blockwise backward.
+"""Pallas flash attention for TPU — streaming forward AND blockwise backward.
 
 Beyond-reference capability (SURVEY §5.7: the reference snapshot has no flash
 attention — its fused_attention_op.cu materializes the full S×S probability
 matrix). Both passes compute attention blockwise with an online/stored
-softmax so HBM traffic is O(S·D) instead of O(S²): Q tiles stay resident in
-VMEM, K/V stream through in block-sized chunks, and the MXU sees [BQ,D]x
-[D,BK] matmuls.
+softmax so HBM traffic is O(S·D) instead of O(S²).
 
-Backward follows FlashAttention-2: the forward additionally writes the
-per-row logsumexp L; backward recomputes P = exp(QK^T·scale − L) tile by
-tile, with Δ = rowsum(dO ⊙ O) precomputed, and runs two kernels — one
-gridded over Q blocks (dQ), one over K blocks (dK, dV) — so nothing O(S²)
-is ever materialized in either pass.
+Kernel shape: 3-D sequential grids — (batch·head, q_block, k_block) for the
+forward and dQ, (batch·head, k_block, q_block) for dK/dV — with the running
+accumulators (m, l, acc / dq / dk,dv) living in VMEM scratch that persists
+across the innermost grid dimension. Only one (bq,d) + one (bk,d) tile is
+resident per step, so sequence length is bounded by HBM, not VMEM (the
+previous full-K/V-block design hit the 16M scoped-vmem limit at S=16k).
+
+Backward follows FlashAttention-2: forward stores per-row logsumexp L
+(replicated over 8 sublanes — TPU blocks tile (8,128)); backward recomputes
+P = exp(QKᵀ·scale − L) tile by tile with Δ = rowsum(dO ⊙ O) precomputed.
 
 Layout: [batch, seq, heads, head_dim] in, same out (paddle convention).
-head_dim is padded to the 128-lane boundary inside the wrapper (zero pads
-contribute nothing to the dots), so 64-dim heads work.
+head_dim pads to the 128-lane boundary in the wrapper (zero pads change no
+dot product), so 64-dim heads work. Matmuls run on bf16 inputs with f32
+accumulation (preferred_element_type) — full MXU rate.
 """
 from __future__ import annotations
 
@@ -26,58 +30,58 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu  # noqa: F401 (platform hint)
+from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BQ = 256
 DEFAULT_BK = 256
 _NEG = -1e30
 
 
+def _causal_mask(s, qi, ki, bq, bk):
+    q_idx = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_idx = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(q_idx >= k_idx, s, _NEG)
+
+
 # ------------------------------------------------------------------ forward
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bk):
-    """One (batch*head, q_block) program: online-softmax over K/V blocks."""
-    qi = pl.program_id(1)
-    q = q_ref[0]                                       # [BQ, D] native dtype
-    bq = q.shape[0]
-    s_k = k_ref.shape[1]
-    n_kb = s_k // bk
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
+                *, scale, causal, n_kb):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
 
-    m0 = jnp.full((bq, 1), _NEG, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc0 = jnp.zeros((bq, q.shape[1]), jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
 
-    if causal:
-        upper = lax.div((qi + 1) * bq + bk - 1, bk)
-        upper = jnp.minimum(upper, n_kb)
-    else:
-        upper = n_kb
+    # causal: blocks fully above the diagonal contribute nothing
+    needed = True if not causal else (ki * bk <= (qi + 1) * bq - 1)
 
-    def body(ki, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(ki * bk, bk), :]                       # [BK, D]
-        v = v_ref[0, pl.ds(ki * bk, bk), :]                       # [BK, D]
-        # bf16xbf16 -> f32 dot: full MXU rate, f32 accumulation
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            q_idx = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_idx = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_idx >= k_idx, s, _NEG)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+            s = _causal_mask(s, qi, ki, bq, bk)
+        m_prev, l_prev = m_sc[...], l_sc[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = corr * l + p.sum(axis=-1, keepdims=True)
-        acc_new = corr * acc + jnp.dot(p.astype(v.dtype), v,
-                                       preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        corr = jnp.exp(m_prev - m_new)
+        m_sc[...] = m_new
+        l_sc[...] = corr * l_prev + p.sum(axis=-1, keepdims=True)
+        acc_sc[...] = corr * acc_sc[...] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
-    m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    # logsumexp of scaled scores; backward recomputes p = exp(s - L).
-    # Stored replicated over 8 sublanes: TPU blocks need their last two dims
-    # tiled (8, 128), so the stats array is [bh, 8, s_q]
-    lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[:, 0][None, :],
-                                  (8, q.shape[0]))
+    @pl.when(ki == n_kb - 1)
+    def _finish():
+        l = jnp.maximum(l_sc[...], 1e-30)
+        o_ref[0] = (acc_sc[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to((m_sc[...] + jnp.log(l))[:, 0][None, :],
+                                      (8, bq))
 
 
 def _flash_fwd(q, k, v, *, scale, causal, bq, bk, interpret):
@@ -86,20 +90,23 @@ def _flash_fwd(q, k, v, *, scale, causal, bq, bk, interpret):
     qt = jnp.moveaxis(q, 2, 1).reshape(b * h, s_q, d)
     kt = jnp.moveaxis(k, 2, 1).reshape(b * h, s_k, d)
     vt = jnp.moveaxis(v, 2, 1).reshape(b * h, s_k, d)
+    n_kb = s_k // bk
 
-    grid = (b * h, s_q // bq)
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal, bk=bk),
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, n_kb=n_kb),
         out_shape=(jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
                    jax.ShapeDtypeStruct((b * h, 8, s_q), jnp.float32)),
-        grid=grid,
+        grid=(b * h, s_q // bq, n_kb),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, s_k, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=(pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
-                   pl.BlockSpec((1, 8, bq), lambda bh, qi: (bh, 0, qi))),
+        out_specs=(pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+                   pl.BlockSpec((1, 8, bq), lambda bh, qi, ki: (bh, 0, qi))),
+        scratch_shapes=[pltpu.VMEM((bq, 1), jnp.float32),
+                        pltpu.VMEM((bq, 1), jnp.float32),
+                        pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt)
     return out, lse, (qt, kt, vt)
@@ -107,75 +114,73 @@ def _flash_fwd(q, k, v, *, scale, causal, bq, bk, interpret):
 
 # ----------------------------------------------------------------- backward
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, bk):
-    """Grid (bh, q_block): dQ tile = Σ_k ds·K·scale,
-    ds = p ⊙ (dO·Vᵀ − Δ)."""
-    qi = pl.program_id(1)
-    q = q_ref[0]                                        # [BQ, D]
-    do = do_ref[0]                                      # [BQ, D]
-    lse = lse_ref[0, 0][:, None]                        # [BQ, 1]
-    delta = delta_ref[0, 0][:, None]                    # [BQ, 1]
-    bq = q.shape[0]
-    s_k = k_ref.shape[1]
-    n_kb = s_k // bk
-    if causal:
-        upper = jnp.minimum(lax.div((qi + 1) * bq + bk - 1, bk), n_kb)
-    else:
-        upper = n_kb
+                   dq_sc, *, scale, causal, n_kb):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
 
-    def body(ki, dq):
-        k = k_ref[0, pl.ds(ki * bk, bk), :]
-        v = v_ref[0, pl.ds(ki * bk, bk), :]
+    @pl.when(ki == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    needed = True if not causal else (ki * bk <= (qi + 1) * bq - 1)
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            q_idx = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_idx = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_idx >= k_idx, s, _NEG)
-        p = jnp.exp(s - lse)                             # [BQ, BK]
+            s = _causal_mask(s, qi, ki, bq, bk)
+        p = jnp.exp(s - lse)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(k.dtype)
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        dq_sc[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
-    dq = lax.fori_loop(0, upper, body,
-                       jnp.zeros(q.shape, jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(ki == n_kb - 1)
+    def _finish():
+        dq_ref[0] = (dq_sc[...] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, bq):
-    """Grid (bh, k_block): dK/dV tiles accumulate over Q blocks."""
-    ki = pl.program_id(1)
-    k = k_ref[0]                                        # [BK, D]
-    v = v_ref[0]                                        # [BK, D]
-    bk = k.shape[0]
-    s_q = q_ref.shape[1]
-    n_qb = s_q // bq
-    # causal: only q blocks whose end is >= this k block's start contribute
-    lower = lax.div(ki * bk, bq) if causal else 0
+                    dk_ref, dv_ref, dk_sc, dv_sc, *, scale, causal, n_qb):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    bk = k_ref.shape[1]
+    bq = q_ref.shape[1]
 
-    def body(qi, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qi * bq, bq), :]                       # [BQ, D]
-        do = do_ref[0, pl.ds(qi * bq, bq), :]
-        lse = lse_ref[0, 0, pl.ds(qi * bq, bq)][:, None]
-        delta = delta_ref[0, 0, pl.ds(qi * bq, bq)][:, None]
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    needed = True if not causal else ((qi + 1) * bq - 1 >= ki * bk)
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            q_idx = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_idx = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_idx >= k_idx, s, _NEG)
-        p = jnp.exp(s - lse).astype(do.dtype)            # [BQ, BK]
-        dv_new = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+            s = _causal_mask(s, qi, ki, bq, bk)
+        p = jnp.exp(s - lse)
+        pt = p.astype(do.dtype)
+        dv_sc[...] += jnp.dot(pt.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = (p.astype(jnp.float32) * (dp - delta)).astype(q.dtype)  # [BQ, BK]
-        dk_new = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_sc[...] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
 
-    dk, dv = lax.fori_loop(lower, n_qb, body,
-                           (jnp.zeros(k.shape, jnp.float32),
-                            jnp.zeros(v.shape, jnp.float32)))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == n_qb - 1)
+    def _finish():
+        dk_ref[0] = (dk_sc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(res, g, *, scale, causal, bq, bk, interpret):
@@ -185,38 +190,45 @@ def _flash_bwd(res, g, *, scale, causal, bq, bk, interpret):
     dot = jnp.moveaxis(g, 2, 1).reshape(bh, s_q, d)
     delta = jnp.sum(dot.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, s_q))
+    n_kb = s_k // bk
+    n_qb = s_q // bq
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bk=bk),
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          n_kb=n_kb),
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), qt.dtype),
-        grid=(bh, s_q // bq),
+        grid=(bh, n_qb, n_kb),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, qi: (b, qi, 0)),
-            pl.BlockSpec((1, s_k, d), lambda b, qi: (b, 0, 0)),
-            pl.BlockSpec((1, s_k, d), lambda b, qi: (b, 0, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, qi: (b, qi, 0)),
-            pl.BlockSpec((1, 8, bq), lambda b, qi: (b, 0, qi)),
-            pl.BlockSpec((1, 8, bq), lambda b, qi: (b, 0, qi)),
+            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, 8, bq), lambda b, qi, ki: (b, 0, qi)),
+            pl.BlockSpec((1, 8, bq), lambda b, qi, ki: (b, 0, qi)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, qi: (b, qi, 0)),
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, dot, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq),
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          n_qb=n_qb),
         out_shape=(jax.ShapeDtypeStruct((bh, s_k, d), kt.dtype),
                    jax.ShapeDtypeStruct((bh, s_k, d), vt.dtype)),
-        grid=(bh, s_k // bk),
+        grid=(bh, n_kb, n_qb),
         in_specs=[
-            pl.BlockSpec((1, s_q, d), lambda b, ki: (b, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, ki: (b, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, ki: (b, ki, 0)),
-            pl.BlockSpec((1, s_q, d), lambda b, ki: (b, 0, 0)),
-            pl.BlockSpec((1, 8, s_q), lambda b, ki: (b, 0, 0)),
-            pl.BlockSpec((1, 8, s_q), lambda b, ki: (b, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, 8, bq), lambda b, ki, qi: (b, 0, qi)),
+            pl.BlockSpec((1, 8, bq), lambda b, ki, qi: (b, 0, qi)),
         ],
-        out_specs=(pl.BlockSpec((1, bk, d), lambda b, ki: (b, ki, 0)),
-                   pl.BlockSpec((1, bk, d), lambda b, ki: (b, ki, 0))),
+        out_specs=(pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0)),
+                   pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0))),
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, dot, lse, delta)
     return dq, dk, dv
@@ -261,12 +273,7 @@ def _reference(q, k, v, *, scale, causal):
 def flash_attention(q, k, v, causal: bool = False, scale=None,
                     block_q: int = None, block_k: int = None,
                     interpret: bool = False):
-    """Differentiable flash attention on [B, S, H, D] arrays.
-
-    head_dim pads to the next 128-lane multiple (zeros change no dot
-    product); seq lengths must divide by the chosen blocks, else blocks
-    shrink, else the XLA reference path takes over.
-    """
+    """Differentiable flash attention on [B, S, H, D] arrays."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     s_q, s_k = q.shape[1], k.shape[1]
