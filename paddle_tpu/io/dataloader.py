@@ -36,6 +36,52 @@ def default_collate_fn(batch):
     return to_tensor(np.asarray(batch))
 
 
+def _numpy_collate(batch):
+    """Worker-side collate: numpy only (no jax in worker processes)."""
+    sample = batch[0]
+    if isinstance(sample, (tuple, list)):
+        transposed = list(zip(*batch))
+        return [_numpy_collate(list(items)) for items in transposed]
+    if isinstance(sample, dict):
+        return {k: _numpy_collate([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return batch
+    return np.asarray(batch)
+
+
+def _tree_to_tensor(tree):
+    if isinstance(tree, list):
+        return [_tree_to_tensor(t) for t in tree]
+    if isinstance(tree, dict):
+        return {k: _tree_to_tensor(v) for k, v in tree.items()}
+    if isinstance(tree, np.ndarray):
+        return to_tensor(tree)
+    return tree
+
+
+_worker_state = {}
+
+
+def _worker_init(dataset, collate_in_worker, worker_init_fn):
+    _worker_state["dataset"] = dataset
+    _worker_state["collate"] = collate_in_worker
+    if worker_init_fn is not None:
+        import os
+        worker_init_fn(os.getpid() % 10**6)
+
+
+def _worker_fetch(indices):
+    ds = _worker_state["dataset"]
+    samples = [ds[i] for i in indices]
+    if _worker_state["collate"]:
+        return _numpy_collate(samples)
+    return samples
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
@@ -43,7 +89,9 @@ class DataLoader:
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
                  worker_init_fn=None, persistent_workers=False):
         self.dataset = dataset
+        self._custom_collate = collate_fn is not None
         self.collate_fn = collate_fn or default_collate_fn
+        self.worker_init_fn = worker_init_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
         self._iterable_mode = isinstance(dataset, IterableDataset)
@@ -81,6 +129,16 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._batches()
             return
+        if not self._iterable_mode:
+            # true multi-process path (reference: dataloader_iter.py:370
+            # _DataLoaderIterMultiProcess with shared-memory workers): worker
+            # processes run __getitem__+collate off the GIL; pool.imap keeps
+            # batch order. Falls back to the thread path if the dataset
+            # doesn't pickle.
+            gen = self._process_worker_iter()
+            if gen is not None:
+                yield from gen
+                return
         # background prefetch thread (pipeline host work with device compute)
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor * max(self.num_workers, 1))
         _END = object()
@@ -119,3 +177,38 @@ class DataLoader:
             stop.set()  # unblock the producer if the consumer broke early
         if err:
             raise err[0]
+
+    def _process_worker_iter(self):
+        """Build the process-pool batch iterator, or None if unpicklable."""
+        import multiprocessing as mp
+        import pickle
+        try:
+            pickle.dumps(self.dataset)
+            pickle.dumps(self.collate_fn)
+        except Exception:
+            return None
+        ctx = mp.get_context("spawn")
+        # workers must NOT touch jax (each would claim the device): they
+        # fetch samples and collate to NUMPY; the parent converts to Tensor
+        # (default collate) or runs the user's collate_fn on raw samples
+        collate_in_worker = not self._custom_collate
+        try:
+            pool = ctx.Pool(self.num_workers, initializer=_worker_init,
+                            initargs=(self.dataset, collate_in_worker,
+                                      self.worker_init_fn))
+        except Exception:
+            return None
+
+        def gen():
+            try:
+                indices_list = list(self.batch_sampler)
+                for payload in pool.imap(_worker_fetch, indices_list,
+                                         chunksize=1):
+                    if collate_in_worker:
+                        yield _tree_to_tensor(payload)
+                    else:
+                        yield self.collate_fn(payload)
+            finally:
+                pool.terminate()
+                pool.join()
+        return gen()

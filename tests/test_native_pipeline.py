@@ -81,3 +81,39 @@ def test_blocking_queue_close_unblocks_pop():
     t.join(timeout=10)
     assert not t.is_alive()
     assert out["v"] is None
+
+
+class _SquareDataset:
+    """Top-level (picklable) map-style dataset for worker processes."""
+
+    def __len__(self):
+        return 20
+
+    def __getitem__(self, i):
+        import numpy as np
+        return np.full((3,), float(i), np.float32), i
+
+
+def test_dataloader_process_workers():
+    import numpy as np
+    from paddle_tpu.io import DataLoader
+    loader = DataLoader(_SquareDataset(), batch_size=4, num_workers=2)
+    seen = []
+    for xb, yb in loader:
+        assert list(xb.shape) == [4, 3]
+        seen.extend(yb.numpy().tolist())
+    assert sorted(seen) == list(range(20))
+
+
+def test_dataloader_process_workers_custom_collate():
+    import numpy as np
+    from paddle_tpu.io import DataLoader
+
+    def collate(samples):
+        xs = np.stack([s[0] for s in samples]).sum()
+        return float(xs)
+
+    loader = DataLoader(_SquareDataset(), batch_size=5, num_workers=2,
+                        collate_fn=collate)
+    out = list(loader)
+    assert len(out) == 4 and abs(sum(out) - 3 * sum(range(20))) < 1e-5
