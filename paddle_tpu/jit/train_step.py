@@ -97,18 +97,34 @@ class TrainStep:
             spec = _dmesh.filter_spec(*spec) if spec is not None else P()
         return NamedSharding(self.mesh, spec)
 
+    def _to_global(self, arr, spec):
+        """Place a host array onto the (possibly multi-host) mesh.
+
+        Multi-process: jax.device_put cannot target non-addressable devices;
+        host_local_array_to_global_array assembles the global array from each
+        process's local piece — for axes sharded ACROSS hosts (e.g. dp over
+        processes) the caller passes its local shard; for host-local axes
+        (mp within a host) and replicated specs, the full array."""
+        from ..distributed import mesh as _dmesh
+        with _dmesh.mesh_scope(self.mesh):
+            fspec = _dmesh.filter_spec(*spec) if spec is not None else P()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            return multihost_utils.host_local_array_to_global_array(
+                arr, self.mesh, fspec)
+        return jax.device_put(arr, NamedSharding(self.mesh, fspec))
+
     def _apply_param_shardings(self):
-        """device_put params/opt state by their pspec (once)."""
+        """place params/opt state by their pspec (once)."""
         if self.mesh is None:
             return
         for p in self._params:
-            s = self._placement(_spec_or_replicated(p))
-            p._data = jax.device_put(p._data, s)
+            p._data = self._to_global(p._data, _spec_or_replicated(p))
         if self._opt_state is not None:
             for p, st in zip(self._params, self._opt_state):
-                s = self._placement(_opt_state_spec(p, self.optimizer))
+                spec = _opt_state_spec(p, self.optimizer)
                 for k in st:
-                    st[k] = jax.device_put(st[k], s)
+                    st[k] = self._to_global(st[k], spec)
 
     # ------------------------------------------------------------------
     def _build(self, treedef, ndims):
@@ -214,8 +230,7 @@ class TrainStep:
         lr = jnp.float32(self.optimizer.get_lr())
         key = _random.split_key()
         if self.mesh is not None:
-            from jax.sharding import PartitionSpec as P
-            flat = [jax.device_put(a, self._placement(P(None, *self.data_axes)))
+            flat = [self._to_global(a, P(None, *self.data_axes))
                     if a.ndim > 1 else a for a in flat]
         losses, new_params, new_state = compiled(
             tuple(p._data for p in self._params), tuple(self._opt_state),
@@ -243,7 +258,7 @@ class TrainStep:
         lr = jnp.float32(self.optimizer.get_lr())
         key = _random.split_key()
         if self.mesh is not None:
-            flat = [jax.device_put(a, self._placement(P(*self.data_axes)))
+            flat = [self._to_global(a, P(*self.data_axes))
                     if a.ndim > 0 else a for a in flat]
         loss, new_params, new_state = compiled(
             tuple(p._data for p in self._params), tuple(self._opt_state),
