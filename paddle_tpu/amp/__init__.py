@@ -33,3 +33,5 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
     if optimizers is None:
         return models
     return models, optimizers
+
+from . import debugging  # noqa: E402,F401

@@ -1,0 +1,50 @@
+"""paddle.amp.debugging — numeric debugging helpers.
+
+Reference: python/paddle/amp/debugging.py (check_numerics,
+enable_operator_stats_collection, TensorCheckerConfig) over the C++
+check_numerics kernels. Here check_numerics is an eager scan (the
+FLAGS_check_nan_inf machinery, SURVEY §5.2) and the collection toggles
+flip the same flag.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import flags as _flags
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode=None):
+    """Raise on NaN/Inf in `tensor` (reference: amp/debugging.py
+    check_numerics)."""
+    arr = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
+    if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+        n_nan = int(np.isnan(arr).sum())
+        n_inf = int(np.isinf(arr).sum())
+        raise FloatingPointError(
+            f"check_numerics: {op_type or 'tensor'} {var_name} contains "
+            f"{n_nan} NaN and {n_inf} Inf values")
+    return tensor
+
+
+def enable_tensor_checker(config=None):
+    _flags.set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    _flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def enable_operator_stats_collection():
+    _flags.set_flags({"FLAGS_benchmark": True})
+
+
+def disable_operator_stats_collection():
+    _flags.set_flags({"FLAGS_benchmark": False})
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=None, checked_op_list=None,
+                 skipped_op_list=None, **kw):
+        self.enable = enable

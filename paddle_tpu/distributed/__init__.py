@@ -77,3 +77,4 @@ from . import checkpoint  # noqa: E402,F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: E402,F401
 from . import ps  # noqa: E402,F401
 from . import rpc  # noqa: E402,F401
+from . import stream  # noqa: E402,F401

@@ -1,0 +1,32 @@
+"""paddle.distributed.stream — stream-variant collective API.
+
+Reference (SURVEY §2.2): communication/stream/*.py — the same collectives
+with `use_calc_stream` control for manual comm/compute overlap. On TPU
+there are no user streams: XLA schedules collectives asynchronously
+(start/done pairs) and overlaps them with compute on its own, so the
+stream variants alias the plain ops; `sync_op`/`use_calc_stream` are
+accepted and ignored (the reason they exist is solved by the compiler).
+"""
+from __future__ import annotations
+
+from functools import wraps
+
+from . import collective as _c
+
+
+def _alias(fn):
+    @wraps(fn)
+    def inner(*args, sync_op=True, use_calc_stream=False, **kw):
+        return fn(*args, **kw)
+    return inner
+
+
+all_reduce = _alias(_c.all_reduce)
+all_gather = _alias(_c.all_gather)
+reduce = _alias(_c.reduce)  # noqa: A001
+reduce_scatter = _alias(_c.reduce_scatter)
+broadcast = _alias(_c.broadcast)
+alltoall = _alias(_c.alltoall)
+scatter = _alias(_c.scatter) if hasattr(_c, "scatter") else None
+send = _alias(_c.send) if hasattr(_c, "send") else None
+recv = _alias(_c.recv) if hasattr(_c, "recv") else None
