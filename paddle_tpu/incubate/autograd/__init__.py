@@ -68,13 +68,20 @@ class Jacobian:
     def __init__(self, func: Callable, xs, is_batched: bool = False):
         self._func = func
         self._xs = xs if isinstance(xs, (tuple, list)) else (xs,)
+        self._batched = is_batched
         self._val = None
 
     def _compute(self):
         if self._val is None:
-            jac = jax.jacrev(_as_pure(self._func),
-                             argnums=tuple(range(len(self._xs))))(
-                *_unwrap(self._xs))
+            f = _as_pure(self._func)
+            argnums = tuple(range(len(self._xs)))
+            if self._batched:
+                # per-example Jacobians [B, out, in] (reference Jacobian
+                # is_batched contract) — vmap over the leading batch dim
+                jac_fn = jax.vmap(jax.jacrev(f, argnums=argnums))
+            else:
+                jac_fn = jax.jacrev(f, argnums=argnums)
+            jac = jac_fn(*_unwrap(self._xs))
             self._val = jac[0] if len(self._xs) == 1 else jac
         return self._val
 
@@ -110,12 +117,17 @@ class Hessian(Jacobian):
 
 def grad(func: Callable, xs, order: int = 1):
     """n-th order gradient of a scalar function (the capability the
-    reference's prim/composite-grad machinery exists to provide)."""
+    reference's prim/composite-grad machinery exists to provide). With
+    multiple inputs, returns a tuple of gradients matching xs."""
+    single = not isinstance(xs, (tuple, list))
+    xs = (xs,) if single else tuple(xs)
     pure = lambda *a: _as_pure(func)(*a).reshape(())  # noqa: E731
+    argnums = tuple(range(len(xs)))
     g = pure
     for _ in range(order):
-        g = jax.grad(g)
-    xs = xs if isinstance(xs, (tuple, list)) else (xs,)
+        # re-scalarize between orders for the single-input case only; with
+        # multiple inputs higher order returns nested tuples like jax does
+        g = jax.grad(g, argnums=argnums if len(xs) > 1 else 0)
     return _wrap(g(*_unwrap(xs)))
 
 

@@ -66,12 +66,16 @@ def _tree_to_tensor(tree):
 _worker_state = {}
 
 
-def _worker_init(dataset, collate_in_worker, worker_init_fn):
+def _worker_init(dataset, collate_in_worker, worker_init_fn, counter):
     _worker_state["dataset"] = dataset
     _worker_state["collate"] = collate_in_worker
+    # worker id contract: 0..num_workers-1 (reference worker_init_fn(worker_id))
+    with counter.get_lock():
+        wid = counter.value
+        counter.value += 1
+    _worker_state["worker_id"] = wid
     if worker_init_fn is not None:
-        import os
-        worker_init_fn(os.getpid() % 10**6)
+        worker_init_fn(wid)
 
 
 def _worker_fetch(indices):
@@ -193,9 +197,10 @@ class DataLoader:
         # (default collate) or runs the user's collate_fn on raw samples
         collate_in_worker = not self._custom_collate
         try:
+            counter = ctx.Value("i", 0)
             pool = ctx.Pool(self.num_workers, initializer=_worker_init,
                             initargs=(self.dataset, collate_in_worker,
-                                      self.worker_init_fn))
+                                      self.worker_init_fn, counter))
         except Exception:
             return None
 

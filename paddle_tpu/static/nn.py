@@ -196,9 +196,9 @@ def switch_case(branch_index, branch_fns, default=None):
     def fn(idx, *branch_vals):
         import jax.numpy as jnp
         idx = idx.reshape(()).astype(jnp.int32)
-        # map branch_index -> position; unmatched -> default (last) if given
-        pos = len(branch_vals) - 1 if default is not None else 0
-        sel = jnp.int32(pos)
+        # unmatched index -> default if given, else the LAST branch
+        # (reference switch_case semantics, static/nn/control_flow.py)
+        sel = jnp.int32(len(branch_vals) - 1)
         for i, k in enumerate(keys_arr):
             sel = jnp.where(idx == k, jnp.int32(i), sel)
         return jax.lax.switch(sel, [lambda v=v: v for v in branch_vals])

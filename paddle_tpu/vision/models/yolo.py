@@ -160,8 +160,8 @@ class YOLODetector(Layer):
         """Host-side decode: returns per-image (boxes[N,4] xyxy, scores[N],
         classes[N]) after NMS (reference: yolo_box op + multiclass_nms)."""
         cfg = self.config
-        score_thresh = score_thresh or cfg.score_thresh
-        nms_iou = nms_iou or cfg.nms_iou
+        score_thresh = cfg.score_thresh if score_thresh is None else score_thresh
+        nms_iou = cfg.nms_iou if nms_iou is None else nms_iou
         outs = self.forward(images)
         B = images.shape[0]
         results = []
