@@ -96,4 +96,5 @@ def barrier_worker():
     barrier()
 
 
-utils = None
+from . import utils  # noqa: E402,F401
+from . import elastic  # noqa: E402,F401

@@ -1,0 +1,48 @@
+"""fleet.utils — common fleet-side helpers (reference:
+python/paddle/distributed/fleet/utils/__init__.py: recompute re-export,
+fs.py HDFSClient/LocalFS for PS checkpoints)."""
+from __future__ import annotations
+
+import os
+import shutil
+
+from ..recompute import recompute, recompute_sequential  # noqa: F401
+
+
+class LocalFS:
+    """reference: fleet/utils/fs.py LocalFS."""
+
+    def ls_dir(self, path):
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name)) else files).append(name)
+        return dirs, files
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def upload(self, local, remote):
+        shutil.copy(local, remote)
+
+    def download(self, remote, local):
+        shutil.copy(remote, local)
+
+
+class HDFSClient:
+    """reference: fleet/utils/fs.py HDFSClient — requires an hadoop
+    deployment; unavailable in this environment (no egress). Instantiating
+    raises with guidance rather than failing deep in a save path."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        raise RuntimeError(
+            "HDFSClient needs a local hadoop installation; use LocalFS or "
+            "distributed.checkpoint (orbax) for shared-filesystem saves")

@@ -117,3 +117,19 @@ def test_dataloader_process_workers_custom_collate():
                         collate_fn=collate)
     out = list(loader)
     assert len(out) == 4 and abs(sum(out) - 3 * sum(range(20))) < 1e-5
+
+
+_WORKER_IDS = []
+
+
+def _record_wid(wid):
+    # runs inside the worker process; assert the contract there
+    assert 0 <= wid < 2, wid
+
+
+def test_dataloader_worker_init_fn_ids():
+    from paddle_tpu.io import DataLoader
+    loader = DataLoader(_SquareDataset(), batch_size=4, num_workers=2,
+                        worker_init_fn=_record_wid)
+    n = sum(1 for _ in loader)
+    assert n == 5
