@@ -59,3 +59,13 @@ class ClipGradByValue:
     def __init__(self, max, min=None):  # noqa: A002
         self.max = max
         self.min = -max if min is None else min
+
+from .layers.extras import (  # noqa: E402,F401
+    MaxPool3D, AvgPool3D, AdaptiveMaxPool1D, AdaptiveMaxPool3D,
+    AdaptiveAvgPool3D, Conv1DTranspose, Conv3DTranspose, SpectralNorm,
+    RReLU, LogSigmoid, Silu, RNNCellBase, BiRNN, HuberLoss, SoftMarginLoss,
+    MultiLabelSoftMarginLoss, PoissonNLLLoss, GaussianNLLLoss,
+    PairwiseDistance, TripletMarginWithDistanceLoss, ZeroPad2D,
+    PixelUnshuffle, ChannelShuffle, Fold, Unflatten, MaxUnPool1D,
+    MaxUnPool2D, MaxUnPool3D,
+)

@@ -177,7 +177,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
         if groups == 1:
             out = lax.conv_transpose(
                 a, w, strides=strides, padding=padding_cfg,
-                rhs_dilation=dil, dimension_numbers=("NCHW", "IOHW", "NCHW"),
+                rhs_dilation=dil, dimension_numbers=("NCHW", "OIHW", "NCHW"),
                 transpose_kernel=True)
         else:
             xs = jnp.split(a, groups, axis=1)
@@ -185,7 +185,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
             out = jnp.concatenate([
                 lax.conv_transpose(xi, wi, strides=strides, padding=padding_cfg,
                                    rhs_dilation=dil,
-                                   dimension_numbers=("NCHW", "IOHW", "NCHW"),
+                                   dimension_numbers=("NCHW", "OIHW", "NCHW"),
                                    transpose_kernel=True)
                 for xi, wi in zip(xs, ws)], axis=1)
         if b:

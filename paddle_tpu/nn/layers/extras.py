@@ -1,0 +1,563 @@
+"""Remaining paddle.nn layer symbols (reference: python/paddle/nn/__init__.py
+exports 137 layer classes; this module supplies the tail not covered by the
+core layer files — 3-D pooling, transposed 1/3-D convs, spectral norm,
+shuffle/fold utilities, unpooling, and the remaining loss formulas).
+
+All are thin compositions over jnp/lax (one XLA lowering each); shapes
+follow paddle conventions (NCHW-family)."""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, apply_op
+from ...core import ops
+from ...core import random as _random
+from ..layer import Layer
+from .. import functional as F
+from .conv import Conv2DTranspose
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _pair(v, n):
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v,) * n
+
+
+# ------------------------------------------------------------ 3-D pooling
+def _pool_nd(x, ksize, strides, padding, n, reducer, init, avg=False):
+    k = (1, 1) + _pair(ksize, n)
+    s = (1, 1) + _pair(strides, n)
+    pads = [(0, 0), (0, 0)] + [(p, p) for p in _pair(padding, n)]
+
+    def fn(a):
+        out = lax.reduce_window(a, init, reducer, k, s,
+                                [(lo, hi) for lo, hi in pads])
+        if avg:
+            ones = jnp.ones_like(a)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, k, s,
+                                    [(lo, hi) for lo, hi in pads])
+            out = out / cnt
+        return out
+    return apply_op("pool%dd" % n, fn, [x])
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self.k, self.s = kernel_size, stride or kernel_size
+        self.p = padding
+
+    def forward(self, x):
+        return _pool_nd(x, self.k, self.s, self.p, 3, lax.max, -jnp.inf)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self.k, self.s = kernel_size, stride or kernel_size
+        self.p = padding
+
+    def forward(self, x):
+        return _pool_nd(x, self.k, self.s, self.p, 3, lax.add, 0.0, avg=True)
+
+
+def _adaptive_pool(x, out_sizes, nd, mode):
+    """Adaptive pooling via integral bins (paddle adaptive semantics)."""
+    shape = tuple(_arr(x).shape)
+    spatial = shape[2:2 + nd]
+    outs = _pair(out_sizes, nd)
+
+    def fn(a):
+        y = a
+        for d, (in_s, out_s) in enumerate(zip(spatial, outs)):
+            axis = 2 + d
+            starts = (np.arange(out_s) * in_s) // out_s
+            ends = -(-((np.arange(out_s) + 1) * in_s) // out_s)
+            segs = []
+            for st, en in zip(starts, ends):
+                sl = [slice(None)] * y.ndim
+                sl[axis] = slice(int(st), int(en))
+                seg = y[tuple(sl)]
+                seg = (jnp.max(seg, axis=axis, keepdims=True) if mode == "max"
+                       else jnp.mean(seg, axis=axis, keepdims=True))
+                segs.append(seg)
+            y = jnp.concatenate(segs, axis=axis)
+        return y
+    return apply_op(f"adaptive_{mode}_pool{nd}d", fn, [x])
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, **kw):
+        super().__init__()
+        self.out = output_size
+
+    def forward(self, x):
+        return _adaptive_pool(x, self.out, 1, "max")
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, **kw):
+        super().__init__()
+        self.out = output_size
+
+    def forward(self, x):
+        return _adaptive_pool(x, self.out, 3, "max")
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, **kw):
+        super().__init__()
+        self.out = output_size
+
+    def forward(self, x):
+        return _adaptive_pool(x, self.out, 3, "avg")
+
+
+# ----------------------------------------------------- transposed convs 1/3D
+class Conv1DTranspose(Layer):
+    """1-D transposed conv via the 2-D kernel on a dummy height dim."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 bias_attr=None, data_format="NCL", **kw):
+        super().__init__()
+        self._c2 = Conv2DTranspose(
+            in_channels, out_channels, (1, kernel_size), stride=(1, stride),
+            padding=(0, padding), output_padding=(0, output_padding),
+            groups=groups, dilation=(1, dilation), bias_attr=bias_attr)
+
+    @property
+    def weight(self):
+        return self._c2.weight
+
+    def forward(self, x):
+        y = ops.unsqueeze(x, 2)          # NCL -> NC1L
+        y = self._c2(y)
+        return ops.squeeze(y, 2)
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 bias_attr=None, data_format="NCDHW", **kw):
+        super().__init__()
+        from ..initializer import XavierUniform, Constant
+        k = _pair(kernel_size, 3)
+        self._stride = _pair(stride, 3)
+        self._pad = _pair(padding, 3)
+        self._out_pad = _pair(output_padding, 3)
+        self._dil = _pair(dilation, 3)
+        self._groups = groups
+        init = XavierUniform()
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, *k],
+            default_initializer=init)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], is_bias=True)
+
+    def forward(self, x):
+        stride, pad, dil, out_pad = (self._stride, self._pad, self._dil,
+                                     self._out_pad)
+        groups = self._groups
+
+        def fn(a, w, *b):
+            kd, kh, kw = w.shape[2:]
+            padding_cfg = [
+                (dil[i] * (k - 1) - pad[i], dil[i] * (k - 1) - pad[i] + out_pad[i])
+                for i, k in enumerate((kd, kh, kw))]
+            out = lax.conv_transpose(
+                a, w, strides=stride, padding=padding_cfg, rhs_dilation=dil,
+                dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+                transpose_kernel=True)
+            if b:
+                out = out + b[0].reshape(1, -1, 1, 1, 1)
+            return out
+        args = [x, self.weight] + ([self.bias] if self.bias is not None else [])
+        return apply_op("conv3d_transpose", fn, args)
+
+
+# ----------------------------------------------------------- spectral norm
+class SpectralNorm(Layer):
+    """Power-iteration spectral normalization of a weight (reference:
+    nn/layer/norm.py SpectralNorm — normalizes the layer's weight tensor;
+    used through paddle.nn.utils.spectral_norm in practice)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12, **kw):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = weight_shape[dim]
+        w = int(np.prod([s for i, s in enumerate(weight_shape) if i != dim]))
+        self.weight_u = self.create_parameter([h])
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter([w])
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        dim, iters, eps = self.dim, self.power_iters, self.eps
+
+        def fn(w, u, v):
+            wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            for _ in range(iters):
+                v = wm.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ wm @ v
+            return w / sigma
+        return apply_op("spectral_norm", fn,
+                        [weight, self.weight_u, self.weight_v])
+
+
+# ------------------------------------------------------------- activations
+class RReLU(Layer):
+    """Randomized leaky ReLU (reference: nn/layer/activation.py RReLU)."""
+
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, **kw):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        lo, hi = self.lower, self.upper
+        if self.training:
+            key = _random.op_key()
+
+            def fn(a, k):
+                slope = jax.random.uniform(k, a.shape, minval=lo, maxval=hi)
+                return jnp.where(a >= 0, a, a * slope).astype(a.dtype)
+            return apply_op("rrelu", fn, [x, key])
+        mid = (lo + hi) / 2.0
+        return apply_op("rrelu_eval",
+                        lambda a: jnp.where(a >= 0, a, a * mid), [x])
+
+
+class LogSigmoid(Layer):
+    def forward(self, x):
+        return apply_op("log_sigmoid", jax.nn.log_sigmoid, [x])
+
+
+class Silu(Layer):
+    def forward(self, x):
+        return F.silu(x)
+
+
+# ------------------------------------------------------------------- RNN
+from .rnn import RNN as _RNN  # noqa: E402
+
+
+class RNNCellBase(Layer):
+    """Base for user-defined recurrent cells (reference: nn/layer/rnn.py
+    RNNCellBase) — subclass with forward(inputs, states) -> (out, states)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        hidden = shape or [getattr(self, "hidden_size", 1)]
+        return ops.full([b] + list(hidden), init_value, dtype=dtype)
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper over two cells (reference: nn/layer/rnn.py
+    BiRNN): concatenates forward and reversed-backward features."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False, **kw):
+        super().__init__()
+        self.rnn_fw = _RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = _RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        fw, sf = self.rnn_fw(inputs, None if initial_states is None
+                             else initial_states[0])
+        bw, sb = self.rnn_bw(inputs, None if initial_states is None
+                             else initial_states[1])
+        return ops.concat([fw, bw], axis=-1), (sf, sb)
+
+
+# ------------------------------------------------------------------ losses
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+class HuberLoss(Layer):
+    def __init__(self, reduction="mean", delta=1.0, **kw):
+        super().__init__()
+        self.reduction, self.delta = reduction, delta
+
+    def forward(self, input, label):  # noqa: A002
+        d = self.delta
+
+        def fn(x, y):
+            r = jnp.abs(x - y)
+            return jnp.where(r <= d, 0.5 * r * r, d * (r - 0.5 * d))
+        return _reduce_loss(apply_op("huber", fn, [input, label]),
+                            self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", **kw):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        out = apply_op("soft_margin",
+                       lambda x, y: jnp.log1p(jnp.exp(-y * x)), [input, label])
+        return _reduce_loss(out, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", **kw):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        def fn(x, y):
+            return -(y * jax.nn.log_sigmoid(x)
+                     + (1 - y) * jax.nn.log_sigmoid(-x)).mean(axis=-1)
+        return _reduce_loss(apply_op("ml_soft_margin", fn, [input, label]),
+                            self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", **kw):
+        super().__init__()
+        self.log_input, self.full = log_input, full
+        self.eps, self.reduction = epsilon, reduction
+
+    def forward(self, input, label):  # noqa: A002
+        log_input, full, eps = self.log_input, self.full, self.eps
+
+        def fn(x, y):
+            if log_input:
+                loss = jnp.exp(x) - y * x
+            else:
+                loss = x - y * jnp.log(x + eps)
+            if full:
+                stirling = y * jnp.log(jnp.maximum(y, 1.0)) - y + \
+                    0.5 * jnp.log(2 * math.pi * jnp.maximum(y, 1.0))
+                loss = loss + jnp.where(y > 1, stirling, 0.0)
+            return loss
+        return _reduce_loss(apply_op("poisson_nll", fn, [input, label]),
+                            self.reduction)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", **kw):
+        super().__init__()
+        self.full, self.eps, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):  # noqa: A002
+        full, eps = self.full, self.eps
+
+        def fn(x, y, var):
+            var = jnp.maximum(var, eps)
+            loss = 0.5 * (jnp.log(var) + (x - y) ** 2 / var)
+            if full:
+                loss = loss + 0.5 * math.log(2 * math.pi)
+            return loss
+        return _reduce_loss(apply_op("gaussian_nll", fn,
+                                     [input, label, variance]), self.reduction)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, **kw):
+        super().__init__()
+        self.p, self.eps, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        p, eps, keep = self.p, self.eps, self.keepdim
+
+        def fn(a, b):
+            d = jnp.abs(a - b) + eps
+            return jnp.sum(d ** p, axis=-1, keepdims=keep) ** (1.0 / p)
+        return apply_op("pairwise_distance", fn, [x, y])
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", **kw):
+        super().__init__()
+        self.dist = distance_function or (
+            lambda a, b: PairwiseDistance()(a, b))
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):  # noqa: A002
+        d_pos = self.dist(input, positive)
+        d_neg = self.dist(input, negative)
+        if self.swap:
+            d_pn = self.dist(positive, negative)
+            d_neg = ops.minimum(d_neg, d_pn)
+        loss = ops.clip(d_pos - d_neg + self.margin, min=0.0)
+        return _reduce_loss(loss, self.reduction)
+
+
+# -------------------------------------------------------------- reshuffles
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", **kw):
+        super().__init__()
+        self.padding = _pair(padding, 4) if isinstance(padding, (list, tuple)) \
+            else (padding,) * 4
+
+    def forward(self, x):
+        l, r, t, b = self.padding
+        return apply_op("zeropad2d",
+                        lambda a: jnp.pad(a, [(0, 0), (0, 0), (t, b), (l, r)]),
+                        [x])
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", **kw):
+        super().__init__()
+        self.r = downscale_factor
+
+    def forward(self, x):
+        r = self.r
+
+        def fn(a):
+            B, C, H, W = a.shape
+            a = a.reshape(B, C, H // r, r, W // r, r)
+            return a.transpose(0, 1, 3, 5, 2, 4).reshape(
+                B, C * r * r, H // r, W // r)
+        return apply_op("pixel_unshuffle", fn, [x])
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", **kw):
+        super().__init__()
+        self.groups = groups
+
+    def forward(self, x):
+        g = self.groups
+
+        def fn(a):
+            B, C, H, W = a.shape
+            return a.reshape(B, g, C // g, H, W).transpose(0, 2, 1, 3, 4) \
+                    .reshape(B, C, H, W)
+        return apply_op("channel_shuffle", fn, [x])
+
+
+class Fold(Layer):
+    """col2im (reference: nn/layer/common.py Fold): inverse of Unfold."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, **kw):
+        super().__init__()
+        self.out_hw = _pair(output_sizes, 2)
+        self.k = _pair(kernel_sizes, 2)
+        self.s = _pair(strides, 2)
+        self.p = _pair(paddings, 2)
+        self.d = _pair(dilations, 2)
+
+    def forward(self, x):
+        OH, OW = self.out_hw
+        kh, kw = self.k
+        sh, sw = self.s
+        ph, pw = self.p
+        dh, dw = self.d
+
+        def fn(a):
+            B, CKK, L = a.shape
+            C = CKK // (kh * kw)
+            lh = (OH + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+            lw = (OW + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+            cols = a.reshape(B, C, kh, kw, lh, lw)
+            out = jnp.zeros((B, C, OH + 2 * ph, OW + 2 * pw), a.dtype)
+            for i in range(kh):
+                for j in range(kw):
+                    hi = i * dh
+                    wj = j * dw
+                    out = out.at[:, :, hi:hi + lh * sh:sh,
+                                 wj:wj + lw * sw:sw].add(cols[:, :, i, j])
+            return out[:, :, ph:ph + OH, pw:pw + OW]
+        return apply_op("fold", fn, [x])
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, **kw):
+        super().__init__()
+        self.axis, self.shape = axis, list(shape)
+
+    def forward(self, x):
+        cur = list(x.shape)
+        new = cur[:self.axis] + self.shape + cur[self.axis + 1:]
+        return ops.reshape(x, new)
+
+
+# ------------------------------------------------------------- unpooling
+def _max_unpool_nd(x, indices, ksize, stride, padding, output_size, nd):
+    def fn(a, idx):
+        B, C = a.shape[:2]
+        spatial_out = output_size
+        flat_out = int(np.prod(spatial_out))
+        a2 = a.reshape(B, C, -1)
+        idx2 = idx.reshape(B, C, -1).astype(jnp.int32)
+        out = jnp.zeros((B, C, flat_out), a.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda o, v, i: o.at[i].set(v)))(out, a2, idx2)
+        return out.reshape((B, C) + tuple(spatial_out))
+    return apply_op("max_unpool%dd" % nd, fn, [x, indices])
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self.k = kernel_size
+        self.s = stride or kernel_size
+        self.p = padding
+
+    def forward(self, x, indices, output_size=None):
+        L = x.shape[-1]
+        out_l = output_size[-1] if output_size else (L - 1) * self.s + self.k \
+            - 2 * self.p
+        return _max_unpool_nd(x, indices, self.k, self.s, self.p, (out_l,), 1)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self.k = _pair(kernel_size, 2)
+        self.s = _pair(stride or kernel_size, 2)
+        self.p = _pair(padding, 2)
+
+    def forward(self, x, indices, output_size=None):
+        H, W = x.shape[-2:]
+        if output_size:
+            oh, ow = output_size[-2:]
+        else:
+            oh = (H - 1) * self.s[0] + self.k[0] - 2 * self.p[0]
+            ow = (W - 1) * self.s[1] + self.k[1] - 2 * self.p[1]
+        return _max_unpool_nd(x, indices, self.k, self.s, self.p, (oh, ow), 2)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self.k = _pair(kernel_size, 3)
+        self.s = _pair(stride or kernel_size, 3)
+        self.p = _pair(padding, 3)
+
+    def forward(self, x, indices, output_size=None):
+        D, H, W = x.shape[-3:]
+        if output_size:
+            od, oh, ow = output_size[-3:]
+        else:
+            od = (D - 1) * self.s[0] + self.k[0] - 2 * self.p[0]
+            oh = (H - 1) * self.s[1] + self.k[1] - 2 * self.p[1]
+            ow = (W - 1) * self.s[2] + self.k[2] - 2 * self.p[2]
+        return _max_unpool_nd(x, indices, self.k, self.s, self.p,
+                              (od, oh, ow), 3)

@@ -1,0 +1,121 @@
+"""Tail nn symbols (SURVEY §2.3: the 137-layer surface) + transposed-conv
+numeric regression (the IOHW spec bug made in!=out channel counts crash and
+silently channel-transposed square cases)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_conv2d_transpose_matches_numpy_scatter():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 3, 5, 5).astype(np.float32)
+    w = rng.randn(3, 4, 3, 3).astype(np.float32)   # [in, out, kh, kw]
+    stride, pad = 2, 1
+    IH = IW = 5
+    OH = OW = (IH - 1) * stride + 3 - 2 * pad
+    out = np.zeros((1, 4, OH + 2 * pad, OW + 2 * pad), np.float32)
+    for i in range(IH):
+        for j in range(IW):
+            for o in range(4):
+                out[0, o, i * stride:i * stride + 3,
+                    j * stride:j * stride + 3] += (
+                    x[0, :, i, j][:, None, None] * w[:, o]).sum(0)
+    want = out[:, :, pad:pad + OH, pad:pad + OW]
+    got = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                             stride=stride, padding=pad).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_transpose_1d_3d_shapes_and_grad():
+    x1 = paddle.to_tensor(np.random.randn(2, 4, 10).astype("float32"))
+    ct1 = nn.Conv1DTranspose(4, 6, 3, stride=2)
+    y = ct1(x1)
+    assert list(y.shape) == [2, 6, 21]
+    xd = paddle.to_tensor(np.random.randn(1, 2, 4, 4, 4).astype("float32"))
+    ct3 = nn.Conv3DTranspose(2, 3, 3, stride=2)
+    y3 = ct3(xd)
+    assert list(y3.shape) == [1, 3, 9, 9, 9]
+    y3.sum().backward()
+    assert ct3.weight.grad is not None
+
+
+def test_pool3d_and_adaptive():
+    x = paddle.to_tensor(np.random.randn(2, 3, 8, 8, 8).astype("float32"))
+    assert list(nn.MaxPool3D(2)(x).shape) == [2, 3, 4, 4, 4]
+    assert list(nn.AvgPool3D(2)(x).shape) == [2, 3, 4, 4, 4]
+    assert list(nn.AdaptiveAvgPool3D(3)(x).shape) == [2, 3, 3, 3, 3]
+    assert list(nn.AdaptiveMaxPool3D((2, 3, 4))(x).shape) == [2, 3, 2, 3, 4]
+    x1 = paddle.to_tensor(np.random.randn(2, 4, 10).astype("float32"))
+    got = nn.AdaptiveMaxPool1D(5)(x1).numpy()
+    want = np.asarray(x1._data).reshape(2, 4, 5, 2).max(-1)
+    np.testing.assert_allclose(got, want)
+
+
+def test_shuffles_fold_unflatten():
+    x = paddle.to_tensor(np.random.randn(1, 4, 4, 4).astype("float32"))
+    assert list(nn.ZeroPad2D(1)(x).shape) == [1, 4, 6, 6]
+    assert list(nn.PixelUnshuffle(2)(x).shape) == [1, 16, 2, 2]
+    np.testing.assert_allclose(nn.ChannelShuffle(2)(x).numpy().sum(),
+                               x.numpy().sum(), rtol=1e-5)
+    xi = paddle.to_tensor(np.random.randn(1, 2, 4, 4).astype("float32"))
+    cols = F.unfold(xi, [2, 2], strides=2)
+    rec = nn.Fold((4, 4), (2, 2), strides=2)(cols)
+    np.testing.assert_allclose(rec.numpy(), xi.numpy(), rtol=1e-5)
+    uf = nn.Unflatten(1, [2, 2])
+    assert list(uf(paddle.to_tensor(np.zeros((3, 4), np.float32))).shape) == [3, 2, 2]
+
+
+def test_losses_and_misc():
+    rng = np.random.RandomState(0)
+    a = paddle.to_tensor(rng.randn(4, 3).astype("float32"))
+    b = paddle.to_tensor(rng.randn(4, 3).astype("float32"))
+    assert float(nn.HuberLoss()(a, b)) > 0
+    sign = paddle.to_tensor(np.sign(rng.randn(4, 3)).astype("float32"))
+    assert float(nn.SoftMarginLoss()(a, sign)) > 0
+    lbl = paddle.to_tensor((rng.rand(4, 3) > 0.5).astype("float32"))
+    assert float(nn.MultiLabelSoftMarginLoss()(a, lbl)) > 0
+    pos = paddle.to_tensor(np.abs(rng.randn(4, 3)).astype("float32"))
+    assert np.isfinite(float(nn.PoissonNLLLoss()(a, pos)))
+    var = paddle.to_tensor(np.ones((4, 3), np.float32))
+    assert np.isfinite(float(nn.GaussianNLLLoss()(a, b, var)))
+    assert list(nn.PairwiseDistance()(a, b).shape) == [4]
+    assert float(nn.TripletMarginWithDistanceLoss()(a, b, a)) >= 0
+    # distance(a,a)=~0 so loss ~= margin
+    m = float(nn.TripletMarginWithDistanceLoss(margin=0.7)(a, b, b))
+    d = float(nn.PairwiseDistance()(a, b).mean())
+    assert m >= 0
+
+
+def test_activations_and_rnn_extras():
+    act = nn.RReLU()
+    act.train()
+    o = act(paddle.to_tensor(np.array([-1.0, 2.0], np.float32)))
+    assert float(o.numpy()[1]) == 2.0
+    assert -1 / 3 - 1e-6 <= float(o.numpy()[0]) <= -1 / 8 + 1e-6
+    act.eval()
+    o2 = act(paddle.to_tensor(np.array([-1.0], np.float32)))
+    np.testing.assert_allclose(o2.numpy(), [-(1/8 + 1/3) / 2], rtol=1e-5)
+    assert float(nn.LogSigmoid()(paddle.to_tensor(
+        np.zeros(1, np.float32))).numpy()) == pytest.approx(np.log(0.5), rel=1e-5)
+    bi = nn.BiRNN(nn.GRUCell(4, 8), nn.GRUCell(4, 8))
+    out, _ = bi(paddle.to_tensor(np.random.randn(2, 5, 4).astype("float32")))
+    assert list(out.shape) == [2, 5, 16]
+
+
+def test_spectral_norm_unit_sigma():
+    w = paddle.to_tensor(np.random.RandomState(0).randn(6, 4).astype("float32"))
+    sn = nn.SpectralNorm([6, 4], power_iters=20)
+    s = np.linalg.svd(sn(w).numpy(), compute_uv=False)[0]
+    assert abs(s - 1.0) < 1e-3
+
+
+def test_max_unpool2d_scatter():
+    pooled = np.array([[[[5., 7.], [13., 15.]]]], np.float32)
+    idx = np.array([[[[5, 7], [13, 15]]]], np.int64)
+    up = nn.MaxUnPool2D(2)(paddle.to_tensor(pooled), paddle.to_tensor(idx))
+    assert list(up.shape) == [1, 1, 4, 4]
+    flat = up.numpy().reshape(-1)
+    assert flat[5] == 5.0 and flat[15] == 15.0 and flat.sum() == pooled.sum()
