@@ -32,8 +32,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BQ = 256
-DEFAULT_BK = 256
+DEFAULT_BQ = 1024
+DEFAULT_BK = 1024
 _NEG = -1e30
 
 
@@ -277,8 +277,13 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     s_q, s_k = q.shape[1], k.shape[1]
-    bq = block_q or min(DEFAULT_BQ, s_q)
-    bk = block_k or min(DEFAULT_BK, s_k)
+    import os
+    env_bq = os.environ.get("PADDLE_TPU_FLASH_BQ")  # tuning knobs
+    env_bk = os.environ.get("PADDLE_TPU_FLASH_BK")
+    bq = block_q or int(env_bq) if (block_q or env_bq) else min(DEFAULT_BQ, s_q)
+    bk = block_k or int(env_bk) if (block_k or env_bk) else min(DEFAULT_BK, s_k)
+    bq = min(bq, s_q)
+    bk = min(bk, s_k)
     while s_q % bq:
         bq //= 2
     while s_k % bk:
