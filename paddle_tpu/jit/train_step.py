@@ -64,6 +64,7 @@ class TrainStep:
         self.mesh = mesh
         self.data_axes = data_axes
         self.donate = donate
+        self.grad_accum_steps = grad_accum_steps
         self._step_i = 0
         self._compiled = {}
 
@@ -187,18 +188,45 @@ class TrainStep:
         loss_fn = self.loss_fn
         wds = [opt._wd_for(p) for p in params]
         grad_clip = opt._grad_clip
+        accum = max(1, int(self.grad_accum_steps))
 
         def pure_step(param_arrays, opt_state, step_i, lr, key, *flat_batch):
             batch = jax.tree.unflatten(treedef, flat_batch)
 
-            def loss_of(pa):
+            def loss_of(pa, microbatch, k):
                 with _trace_guard(), _swap_params(params, list(pa)), \
-                        _random.trace_key_scope(key), autograd.no_grad():
-                    out = loss_fn(*_tree_wrap(batch))
+                        _random.trace_key_scope(k), autograd.no_grad():
+                    out = loss_fn(*_tree_wrap(microbatch))
                 loss_arr = out._data if isinstance(out, Tensor) else out
                 return loss_arr.astype(jnp.float32)
 
-            loss, grads = jax.value_and_grad(loss_of)(list(param_arrays))
+            if accum == 1:
+                loss, grads = jax.value_and_grad(loss_of)(
+                    list(param_arrays), batch, key)
+            else:
+                # gradient accumulation (reference: gradient_merge /
+                # GradientMergeOptimizer): split the batch dim into `accum`
+                # microbatches, scan fwd+bwd accumulating mean grads, ONE
+                # optimizer update — same memory as a 1/accum-size batch
+                micro = jax.tree.map(
+                    lambda a: a.reshape((accum, a.shape[0] // accum)
+                                        + a.shape[1:]), batch)
+                keys = jax.random.split(key, accum)
+
+                def acc_body(carry, xs):
+                    loss_acc, g_acc = carry
+                    mb, k = xs
+                    l, g = jax.value_and_grad(loss_of)(
+                        list(param_arrays), mb, k)
+                    return (loss_acc + l / accum,
+                            [ga + gi / accum for ga, gi in zip(g_acc, g)]), None
+
+                zeros = [jnp.zeros(p.shape, jnp.float32)
+                         for p in param_arrays]
+                (loss, grads), _ = jax.lax.scan(
+                    acc_body, (jnp.float32(0.0), zeros), (micro, keys))
+                grads = [g.astype(p.dtype)
+                         for g, p in zip(grads, param_arrays)]
             if grad_clip is not None and type(grad_clip).__name__ == "ClipGradByGlobalNorm":
                 total = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                                      for g in grads))

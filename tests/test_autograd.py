@@ -241,3 +241,36 @@ def test_incubate_functional_autodiff():
     g3 = iag.grad(lambda x: (x ** 3).sum(), paddle.to_tensor(np.float32(2.0)),
                   order=3)
     np.testing.assert_allclose(float(g3), 6.0, rtol=1e-5)
+
+
+def test_train_step_grad_accumulation_matches_full_batch():
+    """grad_accum_steps=A over a batch == one full-batch step (reference
+    gradient_merge semantics: same update, 1/A activation memory)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit.train_step import TrainStep
+
+    def make():
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 1))
+        o = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        return m, o
+
+    np.random.seed(0)
+    X = np.random.randn(8, 6).astype("float32")
+    Y = np.random.randn(8, 1).astype("float32")
+
+    m1, o1 = make()
+    s1 = TrainStep(m1, o1, lambda x, y: nn.MSELoss()(m1(x), y))
+    l1 = float(s1(paddle.to_tensor(X), paddle.to_tensor(Y)))
+
+    m2, o2 = make()
+    s2 = TrainStep(m2, o2, lambda x, y: nn.MSELoss()(m2(x), y),
+                   grad_accum_steps=4)
+    l2 = float(s2(paddle.to_tensor(X), paddle.to_tensor(Y)))
+
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-5,
+                                   atol=1e-6)
