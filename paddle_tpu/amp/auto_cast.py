@@ -52,19 +52,35 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
 amp_guard = auto_cast
 
 
-def amp_cast_inputs(op_name, arrays):
-    """Called from the eager op path: cast inputs per active policy."""
+def amp_dest_dtype(op_name, st=None):
+    """The policy decision alone: target dtype for op inputs, or None.
+    Shared by the eager cast and the static-mode record/replay cast."""
     import jax.numpy as jnp
-    st = get_amp_state()
+    st = st or get_amp_state()
     if not st.enabled:
-        return arrays
+        return None
     wl = (white_list | st.custom_white) - st.custom_black
     bl = (black_list | st.custom_black) - st.custom_white
-    low = st.dtype
     if op_name in wl or (st.level == "O2" and op_name not in bl):
-        return [a.astype(low) if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
-                and a.dtype != jnp.float64 else a for a in arrays]
+        return st.dtype
     if op_name in bl:
-        return [a.astype(jnp.float32) if hasattr(a, "dtype") and a.dtype in (jnp.bfloat16, jnp.float16)
-                else a for a in arrays]
-    return arrays
+        return jnp.float32
+    return None
+
+
+def _should_cast(dtype, dest):
+    import jax.numpy as jnp
+    if dest is None or not jnp.issubdtype(dtype, jnp.floating):
+        return False
+    if dest == jnp.float32:
+        return dtype in (jnp.bfloat16, jnp.float16)
+    return dtype != jnp.float64
+
+
+def amp_cast_inputs(op_name, arrays, st=None):
+    """Called from the eager op path: cast inputs per active policy."""
+    dest = amp_dest_dtype(op_name, st)
+    if dest is None:
+        return arrays
+    return [a.astype(dest) if hasattr(a, "dtype") and _should_cast(a.dtype, dest)
+            else a for a in arrays]

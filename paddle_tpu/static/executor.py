@@ -56,6 +56,16 @@ class Scope:
 _global_scope = Scope()
 
 
+def _amp_replay_cast(node, args):
+    """Re-apply the amp policy captured at record time (static AMP — the
+    reference rewrites programs with cast ops via the AMP meta-optimizer;
+    here the recorded policy casts at replay inside the jitted program)."""
+    # note: `from ..amp import auto_cast` would grab the auto_cast FUNCTION
+    # re-exported by the package, not the module
+    from ..amp.auto_cast import amp_cast_inputs
+    return amp_cast_inputs(node.name, args, st=node.amp_state)
+
+
 def global_scope() -> Scope:
     return _global_scope
 
@@ -227,6 +237,8 @@ class Executor:
                         args.append(dpa[pos] if tag == "d" else kpa[pos])
                     else:
                         args.append(ref)
+                if node.amp_state is not None:
+                    args = _amp_replay_cast(node, args)
                 out = node.fn(*args, **node.kwargs)
                 if node.multi:
                     for ov, o in zip(node.out_vids, out):

@@ -82,15 +82,22 @@ class OpNode:
     the analog of OpDesc input names resolved against Scope variables
     (operator.h:154 Run(scope, place))."""
 
-    __slots__ = ("name", "fn", "kwargs", "inputs", "out_vids", "multi")
+    __slots__ = ("name", "fn", "kwargs", "inputs", "out_vids", "multi",
+                 "amp_state")
 
-    def __init__(self, name, fn, kwargs, inputs, out_vids, multi):
+    def __init__(self, name, fn, kwargs, inputs, out_vids, multi,
+                 amp_state=None):
         self.name = name
         self.fn = fn
         self.kwargs = kwargs
         self.inputs = inputs
         self.out_vids = out_vids
         self.multi = multi
+        # amp policy active when this op was recorded (paddle.amp.auto_cast
+        # around graph-building code, the static analog of the reference's
+        # AMP meta-optimizer op-rewriting pass); the Executor re-applies the
+        # cast at replay
+        self.amp_state = amp_state
 
 
 class Block:
@@ -345,13 +352,25 @@ def _record_apply(name, fn, tensor_args, static_kwargs, n_outputs):
             inputs.append(("c", arr))
             avals.append(arr)
 
+    amp_state = None
+    from ..amp.auto_cast import get_amp_state, amp_dest_dtype, _should_cast
+    st = get_amp_state()
+    if st.enabled:
+        amp_state = st
+        dest = amp_dest_dtype(name, st)
+        if dest is not None:
+            avals = [jax.ShapeDtypeStruct(a.shape, dest)
+                     if hasattr(a, "dtype") and _should_cast(a.dtype, dest)
+                     else a for a in avals]
+
     out_avals = jax.eval_shape(partial(fn, **static_kwargs), *avals)
     multi = isinstance(out_avals, (tuple, list))
     outs_t = tuple(out_avals) if multi else (out_avals,)
     out_vars = tuple(prog._new_var(o, name=f"{name}_{prog._version}") for o in outs_t)
     prog._nodes.append(OpNode(name, fn, static_kwargs, inputs,
                               tuple(v.vid for v in out_vars),
-                              multi or n_outputs is not None))
+                              multi or n_outputs is not None,
+                              amp_state=amp_state))
     if len(out_vars) == 1 and n_outputs is None:
         return out_vars[0]
     return out_vars

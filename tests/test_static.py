@@ -293,3 +293,23 @@ def test_case_static():
         (ov,) = exe.run(main, feed={"x": np.array([v], np.float32)},
                         fetch_list=[out])
         np.testing.assert_allclose(ov, [want])
+
+
+def test_static_amp_autocast_records_policy():
+    """paddle.amp.auto_cast around graph building makes whitelisted ops run
+    in bf16 at replay (the reference's AMP meta-optimizer pass, recorded as
+    per-node policy here)."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 8], "float32")
+        w = static.data("w", [8, 4], "float32")
+        with paddle.amp.auto_cast(enable=True, dtype="bfloat16", level="O1"):
+            y = paddle.matmul(x, w)     # whitelisted -> bf16 at replay
+        z = paddle.sum(y)
+    exe = static.Executor()
+    xv = np.random.randn(4, 8).astype(np.float32)
+    wv = np.random.randn(8, 4).astype(np.float32)
+    yv, zv = exe.run(main, feed={"x": xv, "w": wv}, fetch_list=[y, z])
+    assert yv.dtype.name == "bfloat16", yv.dtype
+    np.testing.assert_allclose(zv.astype(np.float32), (xv @ wv).sum(),
+                               rtol=2e-2)
