@@ -6,10 +6,9 @@ the same code uses the real chips. Usage:
 """
 import os
 
-os.environ.setdefault("XLA_FLAGS",
-                      os.environ.get("XLA_FLAGS", "")
-                      + " --xla_force_host_platform_device_count=8")
-import os
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 import jax
 
 # examples default to CPU so they run anywhere; set PADDLE_TPU_EXAMPLE_TPU=1

@@ -121,12 +121,14 @@ def grad(func: Callable, xs, order: int = 1):
     multiple inputs, returns a tuple of gradients matching xs."""
     single = not isinstance(xs, (tuple, list))
     xs = (xs,) if single else tuple(xs)
+    if len(xs) > 1 and order > 1:
+        raise NotImplementedError(
+            "grad(order>1) supports a single input; for second derivatives "
+            "over multiple inputs use incubate.autograd.Hessian")
     pure = lambda *a: _as_pure(func)(*a).reshape(())  # noqa: E731
     argnums = tuple(range(len(xs)))
     g = pure
     for _ in range(order):
-        # re-scalarize between orders for the single-input case only; with
-        # multiple inputs higher order returns nested tuples like jax does
         g = jax.grad(g, argnums=argnums if len(xs) > 1 else 0)
     return _wrap(g(*_unwrap(xs)))
 

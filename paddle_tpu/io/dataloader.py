@@ -66,12 +66,15 @@ def _tree_to_tensor(tree):
 _worker_state = {}
 
 
-def _worker_init(dataset, collate_in_worker, worker_init_fn, counter):
+def _worker_init(dataset, collate_in_worker, worker_init_fn, counter,
+                 num_workers):
     _worker_state["dataset"] = dataset
     _worker_state["collate"] = collate_in_worker
-    # worker id contract: 0..num_workers-1 (reference worker_init_fn(worker_id))
+    # worker id contract: 0..num_workers-1 (reference worker_init_fn(worker_id)).
+    # modulo keeps respawned replacements (Pool repopulates after a worker
+    # death) inside the contract range
     with counter.get_lock():
-        wid = counter.value
+        wid = counter.value % num_workers
         counter.value += 1
     _worker_state["worker_id"] = wid
     if worker_init_fn is not None:
@@ -97,6 +100,8 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.worker_init_fn = worker_init_fn
         self.num_workers = num_workers
+        self.persistent_workers = persistent_workers
+        self._pool = None
         self.prefetch_factor = max(2, prefetch_factor)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         self.return_list = return_list
@@ -114,6 +119,15 @@ class DataLoader:
         if self._iterable_mode:
             raise TypeError("IterableDataset has no len()")
         return len(self.batch_sampler)
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            try:
+                pool.terminate()
+                pool.join()
+            except Exception:
+                pass
 
     def _batches(self):
         if self._iterable_mode:
@@ -186,23 +200,28 @@ class DataLoader:
         """Build the process-pool batch iterator, or None if unpicklable."""
         import multiprocessing as mp
         import pickle
-        try:
-            pickle.dumps(self.dataset)
-            pickle.dumps(self.collate_fn)
-        except Exception:
-            return None
-        ctx = mp.get_context("spawn")
         # workers must NOT touch jax (each would claim the device): they
         # fetch samples and collate to NUMPY; the parent converts to Tensor
-        # (default collate) or runs the user's collate_fn on raw samples
+        # (default collate) or runs the user's collate_fn on raw samples —
+        # so a custom collate_fn never needs to pickle
         collate_in_worker = not self._custom_collate
         try:
-            counter = ctx.Value("i", 0)
-            pool = ctx.Pool(self.num_workers, initializer=_worker_init,
-                            initargs=(self.dataset, collate_in_worker,
-                                      self.worker_init_fn, counter))
+            pickle.dumps(self.dataset)
         except Exception:
             return None
+        pool = getattr(self, "_pool", None)
+        if pool is None:
+            ctx = mp.get_context("spawn")
+            try:
+                counter = ctx.Value("i", 0)
+                pool = ctx.Pool(self.num_workers, initializer=_worker_init,
+                                initargs=(self.dataset, collate_in_worker,
+                                          self.worker_init_fn, counter,
+                                          self.num_workers))
+            except Exception:
+                return None
+            if self.persistent_workers:
+                self._pool = pool
 
         def gen():
             try:
@@ -214,6 +233,7 @@ class DataLoader:
                     else:
                         yield self.collate_fn(payload)
             finally:
-                pool.terminate()
-                pool.join()
+                if not self.persistent_workers:
+                    pool.terminate()
+                    pool.join()
         return gen()

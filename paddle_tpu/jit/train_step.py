@@ -208,9 +208,18 @@ class TrainStep:
                 # GradientMergeOptimizer): split the batch dim into `accum`
                 # microbatches, scan fwd+bwd accumulating mean grads, ONE
                 # optimizer update — same memory as a 1/accum-size batch
-                micro = jax.tree.map(
-                    lambda a: a.reshape((accum, a.shape[0] // accum)
-                                        + a.shape[1:]), batch)
+                def to_micro(a):
+                    if a.ndim == 0:
+                        raise ValueError(
+                            "grad_accum_steps requires batched inputs; got a "
+                            "scalar batch leaf")
+                    if a.shape[0] % accum:
+                        raise ValueError(
+                            f"batch size {a.shape[0]} is not divisible by "
+                            f"grad_accum_steps={accum}")
+                    return a.reshape((accum, a.shape[0] // accum) + a.shape[1:])
+
+                micro = jax.tree.map(to_micro, batch)
                 keys = jax.random.split(key, accum)
 
                 def acc_body(carry, xs):

@@ -314,8 +314,9 @@ class SoftMarginLoss(Layer):
         self.reduction = reduction
 
     def forward(self, input, label):  # noqa: A002
+        # softplus form: stable for large |x| (log1p(exp(z)) overflows f32)
         out = apply_op("soft_margin",
-                       lambda x, y: jnp.log1p(jnp.exp(-y * x)), [input, label])
+                       lambda x, y: jax.nn.softplus(-y * x), [input, label])
         return _reduce_loss(out, self.reduction)
 
 

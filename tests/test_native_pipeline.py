@@ -133,3 +133,14 @@ def test_dataloader_worker_init_fn_ids():
                         worker_init_fn=_record_wid)
     n = sum(1 for _ in loader)
     assert n == 5
+
+
+def test_dataloader_persistent_workers_reused():
+    from paddle_tpu.io import DataLoader
+    loader = DataLoader(_SquareDataset(), batch_size=4, num_workers=2,
+                        persistent_workers=True)
+    n1 = sum(1 for _ in loader)
+    pool1 = loader._pool
+    n2 = sum(1 for _ in loader)
+    assert n1 == n2 == 5
+    assert loader._pool is pool1 and pool1 is not None  # reused across epochs
