@@ -43,13 +43,23 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics = []
+        self._use_fused = None
+        self._fused_step = None
         self.stop_training = False
 
     # -- configuration ---------------------------------------------------
-    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
+                use_fused_step=None):
+        """reference: hapi/model.py Model.prepare. `use_fused_step`: True
+        compiles fwd+bwd+update into one XLA program per step
+        (jit.TrainStep); None (default) enables it automatically when no
+        per-batch metrics need the network outputs; False keeps the eager
+        tape loop."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
+        self._use_fused = use_fused_step
+        self._fused_step = None
         return self
 
     # -- per-batch steps -------------------------------------------------
@@ -62,9 +72,29 @@ class Model:
             raise ValueError("loss not set; call prepare(loss=...)")
         return loss
 
+    def _fused_eligible(self, update):
+        if not update or self._metrics:
+            return False
+        use = getattr(self, "_use_fused", None)
+        return use is None or use
+
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
-        outputs = self.network(*_to_list(inputs))
+        ins, labs = _to_list(inputs), _to_list(labels)
+        if self._fused_eligible(update):
+            if self._fused_step is None:
+                from ..jit.train_step import TrainStep
+                n_in = len(ins)
+                net, loss_fn = self.network, self._loss
+
+                def fused_loss(*batch):
+                    outs = net(*batch[:n_in])
+                    return loss_fn(*_to_list(outs), *batch[n_in:])
+
+                self._fused_step = TrainStep(net, self._optimizer, fused_loss)
+            loss = self._fused_step(*ins, *labs)
+            return [_item(np.asarray(loss._data))]
+        outputs = self.network(*ins)
         loss = self._compute_loss(outputs, labels)
         loss.backward()
         if update:
