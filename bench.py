@@ -69,6 +69,56 @@ def bench_resnet50(on_tpu):
     }))
 
 
+def bench_bert(on_tpu):
+    """BERT-base MLM pretraining throughput (BASELINE.md config)."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.models import BertForMaskedLM, bert_config
+    import paddle_tpu.nn as nn
+
+    B, S, iters = (32, 512, 8) if on_tpu else (2, 64, 2)
+    cfg = bert_config("bert-base", max_position_embeddings=max(512, S))
+    paddle.seed(0)
+    model = BertForMaskedLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    ce = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    def loss_fn(ids, lbl):
+        logits = model(ids)
+        return ce(logits.reshape([-1, cfg.vocab_size]), lbl.reshape([-1]))
+
+    step = TrainStep(model, opt, loss_fn)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                       (iters, B, S)).astype("int32"))
+    lbl = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                       (iters, B, S)).astype("int64"))
+    losses = step.run_steps(iters, ids, lbl)
+    _ = float(losses.numpy()[-1])
+    t0 = time.perf_counter()
+    losses = step.run_steps(iters, ids, lbl)
+    final = float(losses.numpy()[-1])
+    dt = time.perf_counter() - t0
+    tps = B * S * iters / dt
+    n = sum(p.size for p in model.parameters())
+    fpt = 6 * n + 12 * cfg.num_layers * cfg.hidden_size * S
+    import jax as _jax
+    peak = _chip_peak_flops(_jax.devices()[0])
+    print(json.dumps({
+        "metric": f"tokens/sec/chip (bert-base MLM + dropout, B={B} S={S})",
+        "value": round(tps, 1), "unit": "tokens/s",
+        "vs_baseline": round(fpt * tps / peak / 0.70, 4),
+        "extra": {"mfu": round(fpt * tps / peak, 4),
+                  "step_ms": round(dt / iters * 1e3, 2),
+                  "loss": round(final, 4), "params": n},
+    }))
+
+
 def main():
     import jax
     import numpy as np
@@ -76,8 +126,11 @@ def main():
     devs = jax.devices()
     on_tpu = devs[0].platform in ("tpu", "axon")
 
-    if os.environ.get("PADDLE_TPU_BENCH_MODEL") == "resnet50":
+    which = os.environ.get("PADDLE_TPU_BENCH_MODEL")
+    if which == "resnet50":
         return bench_resnet50(on_tpu)
+    if which == "bert":
+        return bench_bert(on_tpu)
 
     import paddle_tpu as paddle
     from paddle_tpu.jit.train_step import TrainStep
