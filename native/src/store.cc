@@ -1,0 +1,346 @@
+// Native TCP key-value store master daemon.
+//
+// Reference analog: paddle/phi/core/distributed/store/tcp_store.cc
+// (MasterDaemon :45) — the rendezvous KV server used for multi-host
+// bootstrap, launch sign-in, elastic heartbeats and user barriers. The
+// Python client (paddle_tpu/distributed/store.py TCPStore) speaks the same
+// newline protocol to this daemon; the daemon itself runs GIL-free so
+// hundreds of clients (big pods signing in) never contend with the trainer
+// process's Python threads.
+//
+// Design: ONE poll(2)-driven event-loop thread, no thread-per-connection.
+// WAIT long-polls are parked connections with a deadline; every mutation
+// (SET/ADD/DEL) re-scans parked waiters. A self-pipe wakes the loop for
+// shutdown.
+//
+// Protocol (UTF-8 lines):  CMD key [value]\n
+//   SET k v -> OK            GET k  -> OK v | MISSING
+//   ADD k n -> OK total      WAIT k t -> OK v | TIMEOUT
+//   DEL k   -> OK            KEYS p -> OK k1,k2,...
+//   PING    -> OK PONG       else   -> ERR unknown
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cctype>
+#include <cerrno>
+#include <chrono>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Conn {
+  int fd = -1;
+  std::string inbuf;
+  std::string outbuf;
+  bool waiting = false;
+  std::string wait_key;
+  Clock::time_point wait_deadline;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int wake_r = -1, wake_w = -1;  // self-pipe
+  int port = 0;
+  std::thread loop;
+  std::atomic<bool> stop{false};
+  std::unordered_map<int, std::unique_ptr<Conn>> conns;
+  std::map<std::string, std::string> kv;  // ordered: prefix scans for KEYS
+
+  ~Server() { shutdown(); }
+
+  void shutdown() {
+    if (loop.joinable()) {
+      stop.store(true);
+      char b = 1;
+      (void)!write(wake_w, &b, 1);
+      loop.join();
+    }
+    for (auto& [fd, c] : conns) close(fd);
+    conns.clear();
+    if (listen_fd >= 0) close(listen_fd), listen_fd = -1;
+    if (wake_r >= 0) close(wake_r), wake_r = -1;
+    if (wake_w >= 0) close(wake_w), wake_w = -1;
+  }
+
+  static void set_nonblock(int fd) {
+    int fl = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+  }
+
+  bool listen_on(const char* host, int port_in) {
+    listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port_in));
+    if (!host || !*host) {
+      addr.sin_addr.s_addr = INADDR_ANY;
+    } else if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      // hostname, not a dotted quad: resolve it — NEVER widen to INADDR_ANY
+      // on failure (a 'localhost' store must not listen on every interface)
+      addrinfo hints{}, *res = nullptr;
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      if (getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) return false;
+      addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+      freeaddrinfo(res);
+    }
+    if (bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+      return false;
+    if (listen(listen_fd, 512) < 0) return false;
+    socklen_t len = sizeof(addr);
+    getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+    port = ntohs(addr.sin_port);
+    set_nonblock(listen_fd);
+    int pfd[2];
+    if (pipe(pfd) < 0) return false;
+    wake_r = pfd[0];
+    wake_w = pfd[1];
+    set_nonblock(wake_r);
+    return true;
+  }
+
+  void reply(Conn* c, const std::string& s) {
+    c->outbuf += s;
+    c->outbuf += '\n';
+  }
+
+  // Serve a parked WAIT if its key now exists. Returns true when unparked.
+  bool try_serve_wait(Conn* c) {
+    auto it = kv.find(c->wait_key);
+    if (it != kv.end()) {
+      c->waiting = false;
+      reply(c, "OK " + it->second);
+      return true;
+    }
+    if (Clock::now() >= c->wait_deadline) {
+      c->waiting = false;
+      reply(c, "TIMEOUT");
+      return true;
+    }
+    return false;
+  }
+
+  void on_mutation() {
+    for (auto& [fd, c] : conns)
+      if (c->waiting) try_serve_wait(c.get());
+  }
+
+  void handle_line(Conn* c, const std::string& line) {
+    // split into at most 3 fields
+    std::string f[3];
+    size_t start = 0;
+    for (int i = 0; i < 3; ++i) {
+      if (start > line.size()) break;
+      size_t sp = (i < 2) ? line.find(' ', start) : std::string::npos;
+      f[i] = line.substr(start, sp == std::string::npos ? std::string::npos
+                                                        : sp - start);
+      if (sp == std::string::npos) { start = line.size() + 1; break; }
+      start = sp + 1;
+    }
+    std::string& cmd = f[0];
+    for (auto& ch : cmd) ch = static_cast<char>(toupper(ch));
+
+    if (cmd == "SET") {
+      kv[f[1]] = f[2];
+      reply(c, "OK");
+      on_mutation();
+    } else if (cmd == "GET") {
+      auto it = kv.find(f[1]);
+      reply(c, it == kv.end() ? "MISSING" : "OK " + it->second);
+    } else if (cmd == "ADD") {
+      long n = 1;
+      if (!f[2].empty()) n = strtol(f[2].c_str(), nullptr, 10);
+      long cur = 0;
+      auto it = kv.find(f[1]);
+      if (it != kv.end()) cur = strtol(it->second.c_str(), nullptr, 10);
+      cur += n;
+      kv[f[1]] = std::to_string(cur);
+      reply(c, "OK " + std::to_string(cur));
+      on_mutation();
+    } else if (cmd == "WAIT") {
+      double timeout = 300.0;
+      if (!f[2].empty()) timeout = strtod(f[2].c_str(), nullptr);
+      c->waiting = true;
+      c->wait_key = f[1];
+      c->wait_deadline =
+          Clock::now() + std::chrono::milliseconds(
+                             static_cast<long>(timeout * 1000.0));
+      try_serve_wait(c);  // answer immediately when the key already exists
+    } else if (cmd == "DEL") {
+      kv.erase(f[1]);
+      reply(c, "OK");
+      on_mutation();
+    } else if (cmd == "KEYS") {
+      std::string out = "OK ";
+      bool first = true;
+      for (auto it = kv.lower_bound(f[1]); it != kv.end(); ++it) {
+        if (it->first.compare(0, f[1].size(), f[1]) != 0) break;
+        if (!first) out += ',';
+        out += it->first;
+        first = false;
+      }
+      reply(c, out);
+    } else if (cmd == "PING") {
+      reply(c, "OK PONG");
+    } else {
+      reply(c, "ERR unknown");
+    }
+  }
+
+  void drop(int fd) {
+    close(fd);
+    conns.erase(fd);
+  }
+
+  void run() {
+    std::vector<pollfd> pfds;
+    while (!stop.load()) {
+      pfds.clear();
+      pfds.push_back({listen_fd, POLLIN, 0});
+      pfds.push_back({wake_r, POLLIN, 0});
+      Clock::time_point nearest = Clock::time_point::max();
+      for (auto& [fd, c] : conns) {
+        short ev = POLLIN;
+        if (!c->outbuf.empty()) ev |= POLLOUT;
+        pfds.push_back({fd, ev, 0});
+        if (c->waiting && c->wait_deadline < nearest)
+          nearest = c->wait_deadline;
+      }
+      int timeout_ms = 500;
+      if (nearest != Clock::time_point::max()) {
+        auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      nearest - Clock::now())
+                      .count();
+        timeout_ms = static_cast<int>(std::max<long long>(
+            0, std::min<long long>(ms, 500)));
+      }
+      int rc = poll(pfds.data(), pfds.size(), timeout_ms);
+      if (stop.load()) break;
+      // expire parked WAITs even when poll timed out
+      for (auto& [fd, c] : conns)
+        if (c->waiting) try_serve_wait(c.get());
+      if (rc <= 0) continue;
+
+      if (pfds[0].revents & POLLIN) {
+        for (;;) {
+          int cfd = accept(listen_fd, nullptr, nullptr);
+          if (cfd < 0) break;
+          set_nonblock(cfd);
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          auto conn = std::make_unique<Conn>();
+          conn->fd = cfd;
+          conns.emplace(cfd, std::move(conn));
+        }
+      }
+      if (pfds[1].revents & POLLIN) {
+        char buf[64];
+        while (read(wake_r, buf, sizeof(buf)) > 0) {
+        }
+      }
+      for (size_t i = 2; i < pfds.size(); ++i) {
+        int fd = pfds[i].fd;
+        auto it = conns.find(fd);
+        if (it == conns.end()) continue;
+        Conn* c = it->second.get();
+        if (pfds[i].revents & (POLLERR | POLLHUP | POLLNVAL)) {
+          drop(fd);
+          continue;
+        }
+        if (pfds[i].revents & POLLIN) {
+          char buf[4096];
+          bool closed = false;
+          for (;;) {
+            ssize_t n = read(fd, buf, sizeof(buf));
+            if (n > 0) {
+              c->inbuf.append(buf, static_cast<size_t>(n));
+            } else if (n == 0) {
+              closed = true;
+              break;
+            } else {
+              break;  // EAGAIN
+            }
+          }
+          size_t pos;
+          while ((pos = c->inbuf.find('\n')) != std::string::npos) {
+            std::string line = c->inbuf.substr(0, pos);
+            if (!line.empty() && line.back() == '\r') line.pop_back();
+            c->inbuf.erase(0, pos + 1);
+            if (!line.empty()) handle_line(c, line);
+          }
+          if (closed) {
+            drop(fd);
+            continue;
+          }
+        }
+        if (!c->outbuf.empty()) {
+          ssize_t n = write(fd, c->outbuf.data(), c->outbuf.size());
+          if (n > 0) c->outbuf.erase(0, static_cast<size_t>(n));
+          else if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) drop(fd);
+        }
+      }
+    }
+  }
+};
+
+std::mutex g_mu;
+std::unordered_map<int, std::unique_ptr<Server>> g_servers;
+int g_next_id = 1;
+
+}  // namespace
+
+extern "C" {
+
+// Start a store daemon; returns handle id >= 0 (or -1). *out_port gets the
+// bound port (useful with port=0).
+int pt_store_start(const char* host, int port, int* out_port) {
+  auto srv = std::make_unique<Server>();
+  if (!srv->listen_on(host, port)) return -1;
+  if (out_port) *out_port = srv->port;
+  srv->loop = std::thread([s = srv.get()] { s->run(); });
+  std::lock_guard<std::mutex> lk(g_mu);
+  int id = g_next_id++;
+  g_servers.emplace(id, std::move(srv));
+  return id;
+}
+
+int pt_store_port(int id) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_servers.find(id);
+  return it == g_servers.end() ? -1 : it->second->port;
+}
+
+void pt_store_stop(int id) {
+  std::unique_ptr<Server> srv;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_servers.find(id);
+    if (it == g_servers.end()) return;
+    srv = std::move(it->second);
+    g_servers.erase(it);
+  }
+  srv->shutdown();
+}
+
+}  // extern "C"

@@ -90,10 +90,31 @@ class _Handler(socketserver.StreamRequestHandler):
 
 
 class MasterDaemon:
-    """The store server (reference: tcp_store.h:45 MasterDaemon). Runs in a
-    daemon thread inside the rank-0 launcher/trainer process."""
+    """The store server (reference: tcp_store.h:45 MasterDaemon — native
+    C++ there, native C++ here: native/src/store.cc, a poll(2) event loop
+    serving the same wire protocol GIL-free). Falls back to the in-process
+    Python ThreadingTCPServer when no toolchain is available."""
 
-    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+    def __init__(self, port: int = 0, host: str = "0.0.0.0",
+                 use_native: bool = True):
+        self._server = None
+        self._native_id = None
+        if use_native:
+            try:
+                from ..io.native import load_native
+                lib = load_native()
+            except Exception:
+                lib = None
+            if lib is not None:
+                import ctypes
+                out_port = ctypes.c_int(0)
+                sid = lib.pt_store_start(host.encode(), int(port),
+                                         ctypes.byref(out_port))
+                if sid >= 0:
+                    self._native_id = sid
+                    self._native_lib = lib
+                    self.port = out_port.value
+                    return
         socketserver.ThreadingTCPServer.allow_reuse_address = True
         # handler threads must not block interpreter shutdown: a client that
         # never disconnects (or a long-poll WAIT) would otherwise hang the
@@ -107,9 +128,19 @@ class MasterDaemon:
                                         daemon=True)
         self._thread.start()
 
+    @property
+    def is_native(self) -> bool:
+        return self._native_id is not None
+
     def stop(self):
-        self._server.shutdown()
-        self._server.server_close()
+        if self._native_id is not None:
+            self._native_lib.pt_store_stop(self._native_id)
+            self._native_id = None
+            return
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
 
 
 class TCPStore:

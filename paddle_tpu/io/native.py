@@ -31,10 +31,19 @@ _lib_err: Optional[str] = None
 _build_lock = threading.Lock()
 
 
+def _sources():
+    src_dir = os.path.dirname(_SRC)
+    try:
+        return sorted(os.path.join(src_dir, f) for f in os.listdir(src_dir)
+                      if f.endswith(".cc"))
+    except OSError:
+        return [_SRC]
+
+
 def _build() -> Optional[str]:
     os.makedirs(_LIB_DIR, exist_ok=True)
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", _LIB]
+           *_sources(), "-o", _LIB]
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:
@@ -52,9 +61,9 @@ def load_native():
     with _build_lock:
         if _lib is not None or _lib_err is not None:
             return _lib
-        if not os.path.exists(_LIB) or (
-                os.path.exists(_SRC) and
-                os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+        if not os.path.exists(_LIB) or any(
+                os.path.getmtime(s) > os.path.getmtime(_LIB)
+                for s in _sources() if os.path.exists(s)):
             err = _build()
             if err:
                 _lib_err = err
@@ -87,6 +96,13 @@ def load_native():
         lib.pt_reader_stop.argtypes = [ctypes.c_void_p]
         lib.pt_reader_done.restype = ctypes.c_int
         lib.pt_reader_done.argtypes = [ctypes.c_void_p]
+        # rendezvous store daemon (native/src/store.cc)
+        lib.pt_store_start.restype = ctypes.c_int
+        lib.pt_store_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.POINTER(ctypes.c_int)]
+        lib.pt_store_port.restype = ctypes.c_int
+        lib.pt_store_port.argtypes = [ctypes.c_int]
+        lib.pt_store_stop.argtypes = [ctypes.c_int]
         _lib = lib
     return _lib
 

@@ -1,4 +1,5 @@
 """paddle.jit analog (reference: python/paddle/jit/) — to_static over XLA."""
 from .api import to_static, not_to_static, StaticFunction, InputSpec, ignore_module  # noqa: F401
+from . import dy2static  # noqa: F401
 from .train_step import TrainStep  # noqa: F401
 from .save_load import save, load, TranslatedLayer  # noqa: F401

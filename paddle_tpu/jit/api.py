@@ -109,7 +109,12 @@ def _collect_layers(fn):
 
 class StaticFunction:
     def __init__(self, function, input_spec=None, layer=None, **kwargs):
-        self._fn = function
+        # dy2static: rewrite data-dependent Python if/while into
+        # lax.cond/while_loop convert_* calls (jit/dy2static.py). Falls back
+        # to the original function when source is unavailable.
+        from .dy2static import ast_transform
+        self._original_fn = function
+        self._fn = ast_transform(function)
         self._input_spec = input_spec
         self._layer = layer
         self._cache = {}
@@ -189,8 +194,8 @@ class StaticFunction:
     def code(self):
         import inspect
         try:
-            return inspect.getsource(self._fn)
-        except OSError:
+            return inspect.getsource(self._original_fn)
+        except (OSError, TypeError):
             return "<source unavailable>"
 
 

@@ -1,0 +1,576 @@
+"""dy2static — AST rewrite of Python control flow for @to_static.
+
+Reference: python/paddle/jit/dy2static/ (ast_transformer.py pipeline:
+IfElseTransformer, LoopTransformer, LogicalTransformer, …) rewrites user
+Python into convert_* runtime calls so data-dependent `if`/`while` become
+graph ops (conditional_block / while ops executed by InterpreterCore,
+call stack SURVEY §3.4).
+
+TPU-native: the same source rewrite, but the convert_* runtime dispatches
+on the predicate at trace time —
+  * concrete (eager, or shape-static under trace): plain Python control flow;
+  * a traced jax tracer: `lax.cond` / `lax.while_loop`, keeping the whole
+    function ONE compiled XLA program with structured control flow instead
+    of trace-time unrolling or a Python-side interpreter loop.
+
+Only the control-flow subset that is data-dependent needs rewriting; all
+other Python executes natively under the jax trace (closures, calls,
+containers), so the transformer is deliberately small: If / While /
+BoolOp(and,or) / UnaryOp(not) / ternary IfExp.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import re
+import textwrap
+import types
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "convert_ifelse", "convert_while_loop", "convert_logical_and",
+    "convert_logical_or", "convert_logical_not", "convert_bool",
+    "ast_transform", "Dy2StaticTransformer", "UNDEFINED",
+]
+
+
+class _Undefined:
+    """Placeholder for names not assigned on one branch (the reference's
+    UndefinedVar, dy2static/utils.py). Reading it outside a converted
+    region is an error surfaced lazily."""
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def __bool__(self):
+        raise NameError(
+            "variable is undefined on one branch of a converted `if`; "
+            "assign it on both branches (dy2static)")
+
+
+UNDEFINED = _Undefined()
+
+
+def _is_traced(x) -> bool:
+    arr = x._data if isinstance(x, Tensor) else x
+    return isinstance(arr, jax.core.Tracer)
+
+
+def _to_bool(x) -> bool:
+    if isinstance(x, Tensor):
+        return bool(x._data)
+    return bool(x)
+
+
+def _unwrap(v):
+    return v._data if isinstance(v, Tensor) else v
+
+
+def _carry_encode(vals: Sequence[Any]):
+    """Split carries into traced-array payload + static python template.
+
+    lax.cond/while_loop carries must be arrays; python scalars ride as
+    weak-typed arrays, anything else must be identical across branches /
+    loop-invariant (kept static)."""
+    payload, template = [], []
+    for v in vals:
+        if isinstance(v, Tensor):
+            payload.append(v._data)
+            template.append(("tensor", None))
+        elif isinstance(v, jax.Array) or isinstance(v, jax.core.Tracer):
+            payload.append(v)
+            template.append(("array", None))
+        elif isinstance(v, bool):
+            payload.append(jnp.asarray(v))
+            template.append(("bool", None))
+        elif isinstance(v, (int, float)):
+            payload.append(jnp.asarray(v))
+            template.append((type(v).__name__, None))
+        else:
+            payload.append(None)
+            template.append(("static", v))
+    return payload, template
+
+
+def _carry_decode(payload, template):
+    """payload is ALIGNED with template (None at static positions)."""
+    out = []
+    for (kind, static), pv in zip(template, payload):
+        if kind == "static":
+            out.append(static)
+        elif kind == "tensor":
+            out.append(Tensor(pv))
+        else:
+            out.append(pv)
+    return out
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, args=()):
+    """Runtime for rewritten `if`. Branch fns receive the pre-branch values
+    of every name either branch assigns and return their post-branch values
+    (reference: convert_operators.py convert_ifelse)."""
+    if not _is_traced(pred):
+        return true_fn(*args) if _to_bool(pred) else false_fn(*args)
+
+    t_out = true_fn(*args)
+    f_out = false_fn(*args)
+    t_tuple = t_out if isinstance(t_out, tuple) else (t_out,)
+    f_tuple = f_out if isinstance(f_out, tuple) else (f_out,)
+    if len(t_tuple) != len(f_tuple):
+        raise ValueError(
+            "dy2static `if`: branches produced different numbers of "
+            f"outputs ({len(t_tuple)} vs {len(f_tuple)})")
+    t_pay, t_tmpl = _carry_encode(t_tuple)
+    f_pay, f_tmpl = _carry_encode(f_tuple)
+    # Reconcile the branches position-wise (lax.cond needs one structure):
+    #  * both arrays: promote dtypes;
+    #  * one side UNDEFINED (name assigned on the other branch only): fill
+    #    the undefined side with zeros — the name is semantically undefined
+    #    on that path, any read of the garbage is a user bug (the
+    #    reference's UndefinedVar contract, dy2static/utils.py);
+    #  * both static: must agree.
+    t_arrays, f_arrays, merged_tmpl = [], [], []
+    for (tk, tv), (fk, fv), tp, fp in zip(t_tmpl, f_tmpl, t_pay, f_pay):
+        if tk != "static" and fk != "static":
+            ta, fa = jnp.asarray(tp), jnp.asarray(fp)
+            dt = jnp.result_type(ta, fa)
+            t_arrays.append(ta.astype(dt))
+            f_arrays.append(fa.astype(dt))
+            merged_tmpl.append(("tensor" if "tensor" in (tk, fk) else tk, None))
+        elif tk != "static" and fv is UNDEFINED:
+            ta = jnp.asarray(tp)
+            t_arrays.append(ta)
+            f_arrays.append(jnp.zeros_like(ta))
+            merged_tmpl.append((tk, None))
+        elif fk != "static" and tv is UNDEFINED:
+            fa = jnp.asarray(fp)
+            t_arrays.append(jnp.zeros_like(fa))
+            f_arrays.append(fa)
+            merged_tmpl.append((fk, None))
+        elif tk == "static" and fk == "static":
+            if tv is not fv and tv != fv:
+                raise ValueError(
+                    "dy2static `if` on a traced predicate: non-tensor output "
+                    f"differs between branches ({tv!r} vs {fv!r}); make it a "
+                    "tensor or move it out of the `if`")
+            merged_tmpl.append((tk, tv))
+        else:
+            raise ValueError(
+                "dy2static `if` on a traced predicate: output is a tensor on "
+                f"one branch but {tv if tk=='static' else fv!r} on the other")
+    p = _unwrap(pred)
+    res = jax.lax.cond(jnp.reshape(p, ()).astype(bool),
+                       lambda: tuple(t_arrays), lambda: tuple(f_arrays))
+    it = iter(res)
+    aligned = [next(it) if kind != "static" else None
+               for kind, _ in merged_tmpl]
+    out = tuple(_carry_decode(aligned, merged_tmpl))
+    return out if isinstance(t_out, tuple) else out[0]
+
+
+def convert_while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: tuple):
+    """Runtime for rewritten `while`. `cond_fn(*vars)`, `body_fn(*vars) ->
+    tuple(vars)`."""
+    pred = cond_fn(*loop_vars)
+    if not _is_traced(pred):
+        vals = tuple(loop_vars)
+        while _to_bool(cond_fn(*vals)):
+            vals = body_fn(*vals)
+            if not isinstance(vals, tuple):
+                vals = (vals,)
+        return vals
+
+    payload, template = _carry_encode(list(loop_vars))
+    live_idx = [i for i, p in enumerate(payload) if p is not None]
+
+    def lift(arrays):
+        full = []
+        it = iter(arrays)
+        for i, p in enumerate(payload):
+            full.append(next(it) if p is not None else None)
+        return tuple(_carry_decode(full, template))
+
+    def lax_cond(carry):
+        return jnp.reshape(_unwrap(cond_fn(*lift(carry))), ()).astype(bool)
+
+    def lax_body(carry):
+        outs = body_fn(*lift(carry))
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        new_pay, _ = _carry_encode(list(outs))
+        return tuple(jnp.asarray(new_pay[i]).astype(carry[j].dtype)
+                     for j, i in enumerate(live_idx))
+
+    # Promote the initial carry to the dtype one body pass produces (an
+    # int32 x with `x = x / 2` must iterate in float like eager would; the
+    # speculative trace is dead code for XLA). The loop itself then keeps
+    # the promoted dtype fixed, as lax.while_loop requires.
+    init = [jnp.asarray(payload[i]) for i in live_idx]
+    probe = body_fn(*lift(init))
+    if not isinstance(probe, tuple):
+        probe = (probe,)
+    probe_pay, _ = _carry_encode(list(probe))
+    init = tuple(
+        a if probe_pay[i] is None
+        else a.astype(jnp.result_type(a, jnp.asarray(probe_pay[i])))
+        for a, i in zip(init, live_idx))
+    final = jax.lax.while_loop(lax_cond, lax_body, init)
+    return lift(final)
+
+
+def convert_logical_and(lhs_fn: Callable, rhs_fn: Callable):
+    lhs = lhs_fn()
+    if not _is_traced(lhs):
+        return rhs_fn() if _to_bool(lhs) else lhs
+    rhs = rhs_fn()
+    from ..core import ops
+    return ops.logical_and(_as_tensor(lhs), _as_tensor(rhs))
+
+
+def convert_logical_or(lhs_fn: Callable, rhs_fn: Callable):
+    lhs = lhs_fn()
+    if not _is_traced(lhs):
+        return lhs if _to_bool(lhs) else rhs_fn()
+    rhs = rhs_fn()
+    from ..core import ops
+    return ops.logical_or(_as_tensor(lhs), _as_tensor(rhs))
+
+
+def convert_logical_not(x):
+    if not _is_traced(x):
+        return not _to_bool(x)
+    from ..core import ops
+    return ops.logical_not(_as_tensor(x))
+
+
+def convert_bool(x):
+    """`bool(x)` in rewritten predicates: stays a tensor when traced."""
+    if _is_traced(x):
+        return x
+    return _to_bool(x)
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+# ---------------------------------------------------------------------------
+# AST analysis + rewrite
+# ---------------------------------------------------------------------------
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by statements (Assign/AugAssign/For targets/With/...)."""
+
+    def __init__(self):
+        self.names = []
+
+    def _add(self, target):
+        if isinstance(target, ast.Name):
+            if target.id not in self.names:
+                self.names.append(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._add(e)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._add(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):  # don't descend into nested scopes
+        self.names.append(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+
+_SYNTHETIC = re.compile(r"^__(true_fn|false_fn|loop_cond|loop_body)_\d+$")
+
+
+def _assigned(stmts: Sequence[ast.stmt]) -> List[str]:
+    """Names bound by `stmts`, excluding the helper functions an earlier
+    (nested) rewrite emitted — they are scaffolding, not user state."""
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return [n for n in v.names if not _SYNTHETIC.match(n)]
+
+
+def _has_return(stmts: Sequence[ast.stmt]) -> bool:
+    """A `return` at THIS function's level (not inside a nested def — the
+    synthetic branch/loop helpers of an inner rewrite end in return)."""
+    def scan(n) -> bool:
+        if isinstance(n, ast.Return):
+            return True
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return False
+        return any(scan(c) for c in ast.iter_child_nodes(n))
+    return any(scan(s) for s in stmts or [])
+
+
+def _breaks_scope(stmts: Sequence[ast.stmt]) -> bool:
+    """True if a break/continue at this level would escape a nested fn
+    (not enclosed in a loop within `stmts`)."""
+    def scan(stmt) -> bool:
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(stmt, (ast.For, ast.While, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.Lambda)):
+            return False  # enclosed by its own loop/scope
+        return any(scan(c) for c in ast.iter_child_nodes(stmt)
+                   if isinstance(c, (ast.stmt, ast.excepthandler)))
+    return any(scan(s) for s in stmts or [])
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _call(func_attr: str, args, keywords=None):
+    return ast.Call(
+        func=ast.Attribute(value=_name("_jst"), attr=func_attr, ctx=ast.Load()),
+        args=list(args), keywords=keywords or [])
+
+
+class Dy2StaticTransformer(ast.NodeTransformer):
+    """The rewrite pipeline (reference: ast_transformer.py transformers
+    collapsed into one pass)."""
+
+    def __init__(self):
+        self._counter = 0
+
+    def _fresh(self, base):
+        self._counter += 1
+        return f"__{base}_{self._counter}"
+
+    # --- logical operators keep short-circuit semantics via thunks --------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        expr = node.values[-1]
+        for prev in reversed(node.values[:-1]):
+            expr = _call(fn, [
+                ast.Lambda(args=_no_args(), body=prev),
+                ast.Lambda(args=_no_args(), body=expr)])
+        return ast.copy_location(expr, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.copy_location(
+                _call("convert_logical_not", [node.operand]), node)
+        return node
+
+    def visit_IfExp(self, node):
+        self.generic_visit(node)
+        return ast.copy_location(_call("convert_ifelse", [
+            node.test,
+            ast.Lambda(args=_no_args(), body=node.body),
+            ast.Lambda(args=_no_args(), body=node.orelse)]), node)
+
+    # --- statements -------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        body_ret = _has_return(node.body)
+        else_ret = _has_return(node.orelse)
+        if body_ret or else_ret:
+            return self._rewrite_if_with_return(node)
+
+        if _breaks_scope(node.body) or _breaks_scope(node.orelse):
+            return node  # break/continue escape a nested fn: leave to python
+
+        out_names = sorted(set(_assigned(node.body)) | set(_assigned(node.orelse)))
+        if not out_names:
+            # branch bodies are pure side-effect python (e.g. appends);
+            # only safe when the predicate is concrete — keep as-is
+            return node
+
+        true_name, false_name = self._fresh("true_fn"), self._fresh("false_fn")
+        guards = [_define_guard(n) for n in out_names]
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(n) for n in out_names], ctx=ast.Load()))
+        t_def = _fn_def(true_name, node.body + [ret], arg_names=out_names)
+        f_def = _fn_def(false_name, (node.orelse or []) + [ret],
+                        arg_names=out_names)
+        assign = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(n, ast.Store()) for n in out_names],
+                               ctx=ast.Store())],
+            value=_call("convert_ifelse",
+                        [node.test, _name(true_name), _name(false_name),
+                         ast.Tuple(elts=[_name(n) for n in out_names],
+                                   ctx=ast.Load())]))
+        return [ast.copy_location(ast.fix_missing_locations(s), node)
+                for s in (*guards, t_def, f_def, assign)]
+
+    def _rewrite_if_with_return(self, node):
+        """`if` where BOTH branches end in return and contain nothing after:
+        rewrite to `return convert_ifelse(...)`. Anything more complex is
+        left to Python (works for concrete predicates, clear error for
+        traced ones)."""
+        def only_return(stmts):
+            return (len(stmts) >= 1 and isinstance(stmts[-1], ast.Return)
+                    and not any(_has_return([s]) for s in stmts[:-1]))
+
+        if not (only_return(node.body) and node.orelse
+                and only_return(node.orelse)):
+            return node
+        t_name, f_name = self._fresh("true_fn"), self._fresh("false_fn")
+        # pre-state of names either branch assigns rides in as parameters
+        # (so `x += 1; return x` patterns see the outer value)
+        arg_names = sorted(set(_assigned(node.body)) | set(_assigned(node.orelse)))
+        guards = [_define_guard(n) for n in arg_names]
+        t_def = _fn_def(t_name, node.body, arg_names=arg_names)
+        f_def = _fn_def(f_name, node.orelse, arg_names=arg_names)
+        ret = ast.Return(value=_call(
+            "convert_ifelse", [node.test, _name(t_name), _name(f_name),
+                               ast.Tuple(elts=[_name(n) for n in arg_names],
+                                         ctx=ast.Load())]))
+        return [ast.copy_location(ast.fix_missing_locations(s), node)
+                for s in (*guards, t_def, f_def, ret)]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_return(node.body) or _breaks_scope(node.body):
+            return node  # while/else, return/break/continue: python only
+        # conservative carry set: every name the body assigns
+        carry = sorted(set(_assigned(node.body)))
+        if not carry:
+            return node
+        cond_name, body_name = self._fresh("loop_cond"), self._fresh("loop_body")
+        guards = [_define_guard(n) for n in carry]
+        cond_def = _fn_def(cond_name, [ast.Return(value=node.test)],
+                           arg_names=carry)
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(n) for n in carry], ctx=ast.Load()))
+        body_def = _fn_def(body_name, list(node.body) + [ret],
+                           arg_names=carry)
+        assign = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(n, ast.Store()) for n in carry],
+                               ctx=ast.Store())],
+            value=_call("convert_while_loop", [
+                _name(cond_name), _name(body_name),
+                ast.Tuple(elts=[_name(n) for n in carry], ctx=ast.Load())]))
+        return [ast.copy_location(ast.fix_missing_locations(s), node)
+                for s in (*guards, cond_def, body_def, assign)]
+
+
+def _no_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                         kw_defaults=[], kwarg=None, defaults=[])
+
+
+def _arg_list(names):
+    return ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=n, annotation=None) for n in names],
+        vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+
+
+def _fn_def(name, body, arg_names=()):
+    """Version-safe FunctionDef: parse a template so fields new to the
+    running Python (e.g. 3.12 type_params) are present, then splice body."""
+    tmpl = ast.parse(f"def {name}({', '.join(arg_names)}):\n    pass").body[0]
+    tmpl.body = list(body)
+    return ast.fix_missing_locations(tmpl)
+
+
+def _define_guard(name_id: str):
+    """`try: name \n except NameError: name = _jst.UNDEFINED` — makes a name
+    that is only assigned inside the converted region referenceable (the
+    reference's UndefinedVar pre-declaration, dy2static/utils.py)."""
+    g = ast.parse(
+        f"try:\n    {name_id}\nexcept NameError:\n"
+        f"    {name_id} = _jst.UNDEFINED").body[0]
+    return ast.fix_missing_locations(g)
+
+
+def ast_transform(fn: Callable) -> Callable:
+    """Rewrite fn's source through Dy2StaticTransformer and return the new
+    function bound to fn's globals+closure. Returns fn unchanged when the
+    source is unavailable or the rewrite does not apply (builtins, lambdas,
+    already-converted functions)."""
+    if getattr(fn, "_not_to_static", False) or isinstance(fn, functools.partial):
+        return fn
+    inner = fn.__func__ if inspect.ismethod(fn) else fn
+    if not isinstance(inner, types.FunctionType):
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(inner))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+
+    func_node = tree.body[0]
+    if not isinstance(func_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    func_node.decorator_list = []  # run undecorated; to_static re-wraps
+    new_tree = Dy2StaticTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+
+    namespace = dict(inner.__globals__)
+    namespace["_jst"] = _runtime_namespace()
+    # rebind the closure: compile inside a wrapper that re-declares freevars
+    freevars = inner.__code__.co_freevars
+    if freevars:
+        # Closure cells are snapshotted BY VALUE here; a freevar the outer
+        # scope has not bound yet (mutual recursion at decoration time), or
+        # one rebound after decoration, cannot be honored — fall back to the
+        # untransformed function rather than crash.
+        try:
+            cell_values = [c.cell_contents for c in inner.__closure__]
+        except ValueError:
+            return fn
+        wrapper_name = "__dy2static_closure_wrapper"
+        wrap = ast.parse(f"def {wrapper_name}({', '.join(freevars)}):\n    pass")
+        wrap_fn = wrap.body[0]
+        wrap_fn.body = [new_tree.body[0],
+                        ast.Return(value=_name(func_node.name))]
+        ast.fix_missing_locations(wrap)
+        code = compile(wrap, filename=f"<dy2static {inner.__name__}>",
+                       mode="exec")
+        exec(code, namespace)
+        new_fn = namespace[wrapper_name](*cell_values)
+    else:
+        code = compile(new_tree, filename=f"<dy2static {inner.__name__}>",
+                       mode="exec")
+        exec(code, namespace)
+        new_fn = namespace[func_node.name]
+
+    new_fn.__defaults__ = inner.__defaults__
+    new_fn.__kwdefaults__ = inner.__kwdefaults__
+    new_fn._dy2static_original = fn
+    if inspect.ismethod(fn):
+        return types.MethodType(new_fn, fn.__self__)
+    return new_fn
+
+
+class _RuntimeNS:
+    convert_ifelse = staticmethod(convert_ifelse)
+    convert_while_loop = staticmethod(convert_while_loop)
+    convert_logical_and = staticmethod(convert_logical_and)
+    convert_logical_or = staticmethod(convert_logical_or)
+    convert_logical_not = staticmethod(convert_logical_not)
+    convert_bool = staticmethod(convert_bool)
+    UNDEFINED = UNDEFINED
+
+
+def _runtime_namespace():
+    return _RuntimeNS

@@ -4,12 +4,14 @@ from .vgg import *  # noqa: F401,F403
 from .mobilenet import *  # noqa: F401,F403
 from .small import *  # noqa: F401,F403
 from .densenet import *  # noqa: F401,F403
+from .swin import *  # noqa: F401,F403
 
 from .resnet import __all__ as _r
 from .vgg import __all__ as _v
 from .mobilenet import __all__ as _m
 from .small import __all__ as _s
 from .densenet import __all__ as _d
+from .swin import __all__ as _sw
 
-__all__ = list(_r) + list(_v) + list(_m) + list(_s) + list(_d)
+__all__ = list(_r) + list(_v) + list(_m) + list(_s) + list(_d) + list(_sw)
 from .yolo import YOLOConfig, YOLODetector, yolo_lite, yolo_loss  # noqa: F401

@@ -1,0 +1,257 @@
+"""Swin Transformer (BASELINE.md bench config; beyond the reference zoo —
+the reference era serves hierarchical ViTs through generic nn layers only,
+python/paddle/nn/layer/transformer.py).
+
+TPU mapping: window attention is a *batched small-sequence* attention —
+[B*num_windows, 49, heads, hd] — which XLA lowers to one batched MXU matmul
+chain; the parallel axis is the window count, not sequence length, so the
+right sharding is dp over images (windows ride along). Shifted windows use
+jnp.roll (a cheap HBM-local rotate on TPU); the shift attention mask and the
+relative-position-bias index table are static per stage and precomputed on
+host at build time, so the traced computation stays shape-static.
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import apply_op
+from ...core import ops
+from ...core import random as _random
+from ...nn.layer import Layer, LayerList
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layers.common import Dropout, Linear
+from ...nn.layers.norm import LayerNorm
+
+__all__ = ["SwinTransformer", "swin_t", "swin_s", "swin_b", "swin_l"]
+
+
+def _rel_pos_index(ws: int) -> np.ndarray:
+    """Static [ws*ws, ws*ws] index into the (2ws-1)^2 relative-bias table."""
+    coords = np.stack(np.meshgrid(np.arange(ws), np.arange(ws),
+                                  indexing="ij"))              # [2, ws, ws]
+    flat = coords.reshape(2, -1)                               # [2, N]
+    rel = flat[:, :, None] - flat[:, None, :]                  # [2, N, N]
+    rel = rel.transpose(1, 2, 0) + (ws - 1)                    # [N, N, 2]
+    return (rel[..., 0] * (2 * ws - 1) + rel[..., 1]).astype(np.int32)
+
+
+def _shift_mask(H: int, W: int, ws: int, shift: int) -> np.ndarray:
+    """Static additive mask [nW, N, N] forbidding attention across the
+    wrap-around seam of a shifted window partition."""
+    img = np.zeros((H, W), np.int32)
+    cnt = 0
+    for hs in (slice(0, -ws), slice(-ws, -shift), slice(-shift, None)):
+        for wsl in (slice(0, -ws), slice(-ws, -shift), slice(-shift, None)):
+            img[hs, wsl] = cnt
+            cnt += 1
+    win = img.reshape(H // ws, ws, W // ws, ws).transpose(0, 2, 1, 3)
+    win = win.reshape(-1, ws * ws)                             # [nW, N]
+    diff = win[:, :, None] != win[:, None, :]
+    return np.where(diff, -1e9, 0.0).astype(np.float32)
+
+
+class WindowAttention(Layer):
+    """Multi-head attention inside ws×ws windows with learned relative
+    position bias (one table per block, indexed by the static table)."""
+
+    def __init__(self, dim: int, num_heads: int, window_size: int,
+                 attn_drop: float = 0.0, proj_drop: float = 0.0):
+        super().__init__()
+        self.dim, self.num_heads, self.ws = dim, num_heads, window_size
+        self.head_dim = dim // num_heads
+        self.scale = self.head_dim ** -0.5
+        self.qkv = Linear(dim, 3 * dim)
+        self.proj = Linear(dim, dim)
+        self.attn_drop = Dropout(attn_drop)
+        self.proj_drop = Dropout(proj_drop)
+        n_rel = (2 * window_size - 1) ** 2
+        self.rel_bias_table = self.create_parameter(
+            [n_rel, num_heads], default_initializer=I.TruncatedNormal(std=0.02))
+        self._rel_index = _rel_pos_index(window_size)          # static numpy
+
+    def forward(self, xw, mask: np.ndarray | None):
+        """xw: [B*nW, N, C]; mask: static numpy [nW, N, N] or None."""
+        nh, hd, scale = self.num_heads, self.head_dim, self.scale
+        n = self.ws * self.ws
+        rel_index = self._rel_index
+        qkv = self.qkv(xw)                                     # [BnW, N, 3C]
+        p_drop = self.attn_drop.p if self.training else 0.0
+        drop_key = _random.split_key() if p_drop > 0.0 else None
+
+        def attend(a, table):
+            bnw = a.shape[0]
+            a = a.reshape(bnw, n, 3, nh, hd)
+            q, k, v = a[:, :, 0], a[:, :, 1], a[:, :, 2]
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+            logits = logits * scale
+            bias = table[rel_index.reshape(-1)].reshape(n, n, nh)
+            logits = logits + bias.transpose(2, 0, 1).astype(jnp.float32)[None]
+            if mask is not None:
+                nw = mask.shape[0]
+                m = jnp.asarray(mask)[None, :, None]           # [1, nW, 1, N, N]
+                logits = (logits.reshape(bnw // nw, nw, nh, n, n) + m
+                          ).reshape(bnw, nh, n, n)
+            probs = jax.nn.softmax(logits, axis=-1)
+            if drop_key is not None:
+                keep = jax.random.bernoulli(drop_key, 1.0 - p_drop, probs.shape)
+                probs = jnp.where(keep, probs / (1.0 - p_drop), 0.0)
+            return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v
+                              ).reshape(bnw, n, nh * hd)
+
+        ctx = apply_op("swin_window_attention", attend,
+                       [qkv, self.rel_bias_table])
+        out = self.proj(ctx)
+        if self.training and self.proj_drop.p:
+            out = self.proj_drop(out)
+        return out
+
+
+class SwinBlock(Layer):
+    def __init__(self, dim, input_resolution, num_heads, window_size=7,
+                 shift_size=0, mlp_ratio=4.0, dropout=0.0):
+        super().__init__()
+        self.dim = dim
+        self.H, self.W = input_resolution
+        self.ws = min(window_size, self.H, self.W)
+        # a window covering the whole map needs no shifted pass
+        self.shift = 0 if self.ws >= min(self.H, self.W) else shift_size
+        self.norm1 = LayerNorm(dim)
+        self.attn = WindowAttention(dim, num_heads, self.ws, proj_drop=dropout)
+        self.norm2 = LayerNorm(dim)
+        hidden = int(dim * mlp_ratio)
+        self.fc1 = Linear(dim, hidden)
+        self.fc2 = Linear(hidden, dim)
+        self.drop = Dropout(dropout)
+        self._mask = (_shift_mask(self.H, self.W, self.ws, self.shift)
+                      if self.shift > 0 else None)
+
+    def _windows(self, x):
+        """[B, H*W, C] -> [B*nW, ws*ws, C] (with cyclic shift)."""
+        H, W, ws, shift = self.H, self.W, self.ws, self.shift
+        b = x.shape[0]
+        x = ops.reshape(x, [b, H, W, self.dim])
+        if shift:
+            x = ops.roll(x, shifts=[-shift, -shift], axis=[1, 2])
+        x = ops.reshape(x, [b, H // ws, ws, W // ws, ws, self.dim])
+        x = ops.transpose(x, [0, 1, 3, 2, 4, 5])
+        return ops.reshape(x, [-1, ws * ws, self.dim])
+
+    def _unwindows(self, xw, b):
+        H, W, ws, shift = self.H, self.W, self.ws, self.shift
+        x = ops.reshape(xw, [b, H // ws, W // ws, ws, ws, self.dim])
+        x = ops.transpose(x, [0, 1, 3, 2, 4, 5])
+        x = ops.reshape(x, [b, H, W, self.dim])
+        if shift:
+            x = ops.roll(x, shifts=[shift, shift], axis=[1, 2])
+        return ops.reshape(x, [b, H * W, self.dim])
+
+    def forward(self, x):
+        b = x.shape[0]
+        shortcut = x
+        xw = self._windows(self.norm1(x))
+        aw = self.attn(xw, self._mask)
+        x = shortcut + self._unwindows(aw, b)
+        y = self.fc2(F.gelu(self.fc1(self.norm2(x)), approximate=True))
+        if self.training and self.drop.p:
+            y = self.drop(y)
+        return x + y
+
+
+class PatchMerging(Layer):
+    """Downsample 2x: concat 2x2 neighbors -> LN -> Linear(4C, 2C)."""
+
+    def __init__(self, input_resolution, dim):
+        super().__init__()
+        self.H, self.W = input_resolution
+        self.dim = dim
+        self.norm = LayerNorm(4 * dim)
+        self.reduction = Linear(4 * dim, 2 * dim, bias_attr=False)
+
+    def forward(self, x):
+        b = x.shape[0]
+        x = ops.reshape(x, [b, self.H // 2, 2, self.W // 2, 2, self.dim])
+        x = ops.transpose(x, [0, 1, 3, 2, 4, 5])
+        x = ops.reshape(x, [b, (self.H // 2) * (self.W // 2), 4 * self.dim])
+        return self.reduction(self.norm(x))
+
+
+class SwinTransformer(Layer):
+    """Hierarchical windowed transformer; 4 stages, patch-merging between."""
+
+    def __init__(self, image_size=224, patch_size=4, num_channels=3,
+                 embed_dim=96, depths: Sequence[int] = (2, 2, 6, 2),
+                 num_heads: Sequence[int] = (3, 6, 12, 24), window_size=7,
+                 mlp_ratio=4.0, dropout=0.0, num_classes=1000):
+        super().__init__()
+        assert image_size % patch_size == 0
+        self.embed_dim = embed_dim
+        self.num_classes = num_classes
+        from ...nn.layers.conv import Conv2D
+        self.patch_embed = Conv2D(num_channels, embed_dim, patch_size,
+                                  stride=patch_size)
+        self.patch_norm = LayerNorm(embed_dim)
+        res = image_size // patch_size
+        self.stages = LayerList()
+        self.merges = LayerList()
+        dim = embed_dim
+        for i, (depth, heads) in enumerate(zip(depths, num_heads)):
+            blocks = LayerList([
+                SwinBlock(dim, (res, res), heads, window_size,
+                          shift_size=0 if j % 2 == 0 else window_size // 2,
+                          mlp_ratio=mlp_ratio, dropout=dropout)
+                for j in range(depth)])
+            self.stages.append(blocks)
+            if i < len(depths) - 1:
+                self.merges.append(PatchMerging((res, res), dim))
+                dim *= 2
+                res //= 2
+        self.norm = LayerNorm(dim)
+        self.final_dim = dim
+        if num_classes > 0:
+            self.head = Linear(dim, num_classes)
+
+    def forward(self, pixel_values):
+        x = self.patch_embed(pixel_values)                     # [B, C, h, w]
+        b, c = x.shape[0], x.shape[1]
+        x = ops.transpose(ops.reshape(x, [b, c, -1]), [0, 2, 1])
+        x = self.patch_norm(x)
+        for i, blocks in enumerate(self.stages):
+            for blk in blocks:
+                x = blk(x)
+            if i < len(self.merges):
+                x = self.merges[i](x)
+        x = self.norm(x)
+        x = ops.mean(x, axis=1)                                # global pool
+        if self.num_classes > 0:
+            return self.head(x)
+        return x
+
+
+def swin_t(pretrained=False, **kw):
+    assert not pretrained, "no pretrained weights in this environment"
+    return SwinTransformer(embed_dim=96, depths=(2, 2, 6, 2),
+                           num_heads=(3, 6, 12, 24), **kw)
+
+
+def swin_s(pretrained=False, **kw):
+    assert not pretrained, "no pretrained weights in this environment"
+    return SwinTransformer(embed_dim=96, depths=(2, 2, 18, 2),
+                           num_heads=(3, 6, 12, 24), **kw)
+
+
+def swin_b(pretrained=False, **kw):
+    assert not pretrained, "no pretrained weights in this environment"
+    return SwinTransformer(embed_dim=128, depths=(2, 2, 18, 2),
+                           num_heads=(4, 8, 16, 32), **kw)
+
+
+def swin_l(pretrained=False, **kw):
+    assert not pretrained, "no pretrained weights in this environment"
+    return SwinTransformer(embed_dim=192, depths=(2, 2, 18, 2),
+                           num_heads=(6, 12, 24, 48), **kw)

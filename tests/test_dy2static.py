@@ -1,0 +1,214 @@
+"""dy2static AST-transform tests (reference test model:
+dygraph_to_static/ suite — run control-flow functions through @to_static and
+compare against eager; SURVEY §4 "API/layer level").
+
+The decisive property: ONE compiled signature serves BOTH branches / a
+data-dependent trip count — trace-time unrolling would bake in the branch
+taken by the first call.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import (ast_transform, convert_ifelse,
+                                      convert_while_loop)
+
+
+def _eager_and_static(fn, *argsets):
+    sf = paddle.jit.to_static(fn)
+    for args in argsets:
+        want = fn(*[paddle.to_tensor(a) for a in args])
+        got = sf(*[paddle.to_tensor(a) for a in args])
+        np.testing.assert_allclose(np.asarray(got._data), np.asarray(want._data),
+                                   rtol=1e-5, atol=1e-6)
+    return sf
+
+
+class TestIfElse:
+    def test_tensor_if_both_branches_one_compile(self):
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        pos = np.ones((2, 3), np.float32)
+        neg = -np.ones((2, 3), np.float32)
+        sf = _eager_and_static(f, (pos,), (neg,))
+        assert len(sf._cache) == 1  # same signature: lax.cond, not unroll
+
+    def test_if_return_style(self):
+        def f(x):
+            if x.mean() > 0.5:
+                return x * 10.0
+            else:
+                return x * 0.1
+
+        hi = np.full((4,), 0.9, np.float32)
+        lo = np.full((4,), 0.1, np.float32)
+        _eager_and_static(f, (hi,), (lo,))
+
+    def test_if_var_defined_single_branch(self):
+        def f(x):
+            y = x
+            if x.sum() > 0:
+                z = x * 3.0
+                y = z
+            return y + 0.0
+
+        _eager_and_static(f, (np.ones(3, np.float32),),
+                          (-np.ones(3, np.float32),))
+
+    def test_concrete_predicate_untouched(self):
+        def f(x, flag=True):
+            if flag:
+                return x + 1.0
+            else:
+                return x - 1.0
+
+        sf = paddle.jit.to_static(f)
+        out = sf(paddle.to_tensor(np.zeros(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(out._data), 1.0)
+
+    def test_ternary(self):
+        def f(x):
+            y = x * 2.0 if x.sum() > 0 else x * -2.0
+            return y
+
+        _eager_and_static(f, (np.ones(3, np.float32),),
+                          (-np.ones(3, np.float32),))
+
+    def test_nested_tensor_if(self):
+        def f(x):
+            if x.sum() > 0:
+                if x.max() > 1.0:
+                    y = x * 2.0
+                else:
+                    y = x * 3.0
+            else:
+                y = -x
+            return y
+
+        _eager_and_static(f, (np.full(3, 2.0, np.float32),),
+                          (np.full(3, 0.1, np.float32),),
+                          (-np.ones(3, np.float32),))
+
+    def test_int_promotes_to_float_in_while(self):
+        def f(x):
+            while x.sum() > 1.0:
+                x = x / 2.0
+            return x
+
+        # int32 input: eager promotes to float via /, static must match
+        got = paddle.jit.to_static(f)(paddle.to_tensor(np.array([8], np.int32)))
+        want = f(paddle.to_tensor(np.array([8], np.int32)))
+        np.testing.assert_allclose(np.asarray(got._data),
+                                   np.asarray(want._data))
+
+    def test_augassign_in_branch(self):
+        def f(x):
+            acc = x * 0.0
+            if x.sum() > 0:
+                acc += x
+            else:
+                acc -= x
+            return acc
+
+        _eager_and_static(f, (np.ones(3, np.float32),),
+                          (-np.ones(3, np.float32),))
+
+
+class TestWhile:
+    def test_data_dependent_trip_count(self):
+        def f(x):
+            while x.sum() > 1.0:
+                x = x / 2.0
+            return x
+
+        _eager_and_static(f, (np.full((4,), 8.0, np.float32),),
+                          (np.full((4,), 0.1, np.float32),))
+
+    def test_counter_loop(self):
+        def f(x, n):
+            i = paddle.to_tensor(np.int32(0))
+            while i < n:
+                x = x + 1.0
+                i = i + 1
+            return x
+
+        _eager_and_static(f, (np.zeros(2, np.float32), np.int32(5)),
+                          (np.zeros(2, np.float32), np.int32(0)))
+
+
+class TestLogical:
+    def test_and_or_not(self):
+        def f(x):
+            if (x.sum() > 0) and (x.max() < 10.0):
+                return x + 1.0
+            else:
+                return x - 1.0
+
+        _eager_and_static(f, (np.ones(3, np.float32),),
+                          (np.full(3, 20.0, np.float32),),
+                          (-np.ones(3, np.float32),))
+
+    def test_short_circuit_python(self):
+        # concrete lhs False must NOT evaluate rhs (python semantics)
+        calls = []
+
+        def rhs():
+            calls.append(1)
+            return True
+
+        from paddle_tpu.jit.dy2static import convert_logical_and
+        out = convert_logical_and(lambda: False, rhs)
+        assert out is False and not calls
+
+
+class TestRuntimeDirect:
+    def test_convert_ifelse_concrete(self):
+        assert convert_ifelse(True, lambda: 1, lambda: 2) == 1
+        assert convert_ifelse(False, lambda: 1, lambda: 2) == 2
+
+    def test_convert_while_concrete(self):
+        out = convert_while_loop(lambda i: i < 3, lambda i: (i + 1,), (0,))
+        assert out == (3,)
+
+    def test_transform_preserves_plain_functions(self):
+        def g(a, b):
+            return a + b
+
+        tg = ast_transform(g)
+        assert tg(1, 2) == 3
+
+
+class TestLayerControlFlow:
+    def test_layer_with_tensor_branch(self):
+        import paddle_tpu.nn as nn
+
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.lin(x)
+                if h.sum() > 0:
+                    out = h * 2.0
+                else:
+                    out = -h
+                return out
+
+        paddle.seed(0)
+        m = Gate()
+        m.eval()
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        want = m(x)
+        m2 = Gate()
+        m2.set_state_dict(m.state_dict())
+        m2.eval()
+        sm2 = paddle.jit.to_static(m2)
+        got = sm2(x)
+        np.testing.assert_allclose(np.asarray(got._data),
+                                   np.asarray(want._data), rtol=1e-5)

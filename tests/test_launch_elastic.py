@@ -48,6 +48,48 @@ def test_store_wait_blocks_until_set():
     master.close()
 
 
+def test_native_daemon_preferred_and_correct():
+    """The C++ poll-loop daemon (native/src/store.cc) serves the same
+    protocol; WAIT long-poll, timeout, DEL, KEYS, many-client barrier."""
+    from paddle_tpu.io.native import native_available
+    if not native_available():
+        pytest.skip("no native toolchain")
+    d = MasterDaemon()
+    assert d.is_native
+    c = TCPStore("127.0.0.1", d.port, world_size=3)
+    c.set("a/x", "1")
+    c.set("a/y", "with spaces ok")
+    assert c.get("a/y") == "with spaces ok"
+    assert sorted(c.keys("a/")) == ["a/x", "a/y"]
+    assert c.add("n", 5) == 5 and c.add("n") == 6
+    c.delete("a/x")
+    assert c.get("a/x") is None
+    with pytest.raises(TimeoutError):
+        c.wait("never", timeout=0.3)
+    # long-poll served on later SET from another client
+    c2 = TCPStore("127.0.0.1", d.port)
+    got = {}
+    t = threading.Thread(target=lambda: got.setdefault(
+        "v", c.wait("late", timeout=10)))
+    t.start()
+    time.sleep(0.2)
+    c2.set("late", "done")
+    t.join(10)
+    assert got["v"] == "done"
+    c.close(), c2.close()
+    d.stop()
+
+
+def test_python_fallback_daemon_still_works():
+    d = MasterDaemon(use_native=False)
+    assert not d.is_native
+    c = TCPStore("127.0.0.1", d.port)
+    c.set("k", "v")
+    assert c.get("k") == "v"
+    c.close()
+    d.stop()
+
+
 def test_store_barrier_two_clients():
     master = TCPStore(is_master=True, world_size=2)
     c2 = TCPStore("127.0.0.1", master.port, world_size=2)
