@@ -17,7 +17,7 @@ from ..core.tensor import Tensor, apply_op
 
 
 def recompute(function, *args, use_reentrant: bool = True, preserve_rng_state: bool = True,
-              params=None, **kwargs):
+              params=None, policy=None, **kwargs):
     """Reference: recompute.py:69 — same call shape. Works both eagerly (the
     tape records the remat-wrapped fn: its vjp recomputes) and under jit.
 
@@ -53,7 +53,13 @@ def recompute(function, *args, use_reentrant: bool = True, preserve_rng_state: b
             return tuple(o._data if isinstance(o, Tensor) else o for o in out)
         return out._data if isinstance(out, Tensor) else out
 
-    remat_fn = jax.checkpoint(raw)
+    # policy: None = save nothing (reference semantics — recompute the whole
+    # segment); "dots" = save MXU matmul outputs, recompute only the
+    # bandwidth-bound elementwise work (much cheaper backward, smaller
+    # memory win); or any jax.checkpoint_policies callable.
+    if policy == "dots":
+        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    remat_fn = jax.checkpoint(raw, policy=policy)
     return apply_op("recompute", remat_fn, list(args) + params)
 
 

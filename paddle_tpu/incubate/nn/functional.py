@@ -81,3 +81,52 @@ def fused_bias_dropout_residual_layer_norm(
     if has_key:
         args.append(_random.op_key())
     return apply_op("fused_bias_dropout_residual_ln", fn, args)
+
+
+def fused_linear_cross_entropy_array(x, weight, labels, *, chunk_size=128,
+                                     transpose_weight=False):
+    """Array-level fused LM-head + softmax cross-entropy, chunked over the
+    sequence so the [B, S, vocab] logits are NEVER materialized.
+
+    Beyond the reference: its closest op is the TP-sharded
+    c_softmax_with_cross_entropy (operators/collective/
+    c_softmax_with_cross_entropy_op.cu), which still takes full logits as
+    input. Here the head matmul itself is inside the loss: a lax.map over
+    sequence chunks computes per-chunk f32 logits -> logsumexp -> gold
+    logit, and jax.checkpoint recomputes them in the backward, so peak HBM
+    holds ONE chunk of logits (B*chunk*V) instead of the whole tensor —
+    the difference between fitting B=16 and OOM at 1.3B/50k-vocab on a
+    15.75G chip.
+
+    x: [B, S, H]; weight: [V, H] ([H, V] with transpose_weight); labels
+    [B, S] int. Returns per-token loss [B, S] float32.
+    """
+    if transpose_weight:
+        weight = weight.T
+    B, S, H = x.shape
+    C = min(chunk_size, S)
+    while S % C:
+        C -= 1
+    nc = S // C
+    xs = x.reshape(B, nc, C, H).transpose(1, 0, 2, 3)
+    ls = labels.reshape(B, nc, C).transpose(1, 0, 2).astype(jnp.int32)
+
+    def chunk_loss(xc, lc):
+        logits = jnp.einsum("bch,vh->bcv", xc, weight).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
+        return lse - gold
+
+    losses = jax.lax.map(
+        lambda args: jax.checkpoint(chunk_loss)(*args), (xs, ls))  # [nc,B,C]
+    return losses.transpose(1, 0, 2).reshape(B, S)
+
+
+def fused_linear_cross_entropy(x, weight, labels, chunk_size=128,
+                               transpose_weight=False, name=None):
+    """Tensor-level wrapper of fused_linear_cross_entropy_array."""
+    def fn(xa, wa, la):
+        return fused_linear_cross_entropy_array(
+            xa, wa, la, chunk_size=chunk_size,
+            transpose_weight=transpose_weight)
+    return apply_op("fused_linear_cross_entropy", fn, [x, weight, labels])

@@ -114,64 +114,111 @@ def _carry_decode(payload, template):
 def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, args=()):
     """Runtime for rewritten `if`. Branch fns receive the pre-branch values
     of every name either branch assigns and return their post-branch values
-    (reference: convert_operators.py convert_ifelse)."""
+    (reference: convert_operators.py convert_ifelse).
+
+    Traced path: the branches run INSIDE lax.cond's callables, so the
+    backward pass differentiates only the branch that was taken — guarded
+    math like `if ok: y = sqrt(h) else: y = ...` must not leak NaN
+    cotangents from the untaken branch. Structure discovery uses an
+    abstract jax.eval_shape probe (no FLOPs, no gradients)."""
     if not _is_traced(pred):
         return true_fn(*args) if _to_bool(pred) else false_fn(*args)
 
-    t_out = true_fn(*args)
-    f_out = false_fn(*args)
-    t_tuple = t_out if isinstance(t_out, tuple) else (t_out,)
-    f_tuple = f_out if isinstance(f_out, tuple) else (f_out,)
-    if len(t_tuple) != len(f_tuple):
+    a_pay, a_tmpl = _carry_encode(list(args))
+    a_live = [i for i, p in enumerate(a_pay) if p is not None]
+    live_args = tuple(jnp.asarray(a_pay[i]) for i in a_live)
+
+    def _lift_args(arrays):
+        full = list(a_pay)
+        for i, a in zip(a_live, arrays):
+            full[i] = a
+        return tuple(_carry_decode(full, a_tmpl))
+
+    boxes = {}
+
+    def _runner(fn, tag):
+        """Run a branch on operand arrays; record (template, was_tuple) in
+        boxes[tag]; return the payload arrays only."""
+        def run(arrays):
+            out = fn(*_lift_args(arrays))
+            tup = out if isinstance(out, tuple) else (out,)
+            pay, tmpl = _carry_encode(list(tup))
+            boxes[tag] = (tmpl, isinstance(out, tuple))
+            return tuple(jnp.asarray(p) for p in pay if p is not None)
+        return run
+
+    run_t, run_f = _runner(true_fn, "t"), _runner(false_fn, "f")
+    # abstract probe: fills boxes and yields shapes/dtypes for reconciliation
+    t_shapes = jax.eval_shape(run_t, live_args)
+    f_shapes = jax.eval_shape(run_f, live_args)
+    t_tmpl, t_is_tuple = boxes["t"]
+    f_tmpl, _ = boxes["f"]
+    if len(t_tmpl) != len(f_tmpl):
         raise ValueError(
             "dy2static `if`: branches produced different numbers of "
-            f"outputs ({len(t_tuple)} vs {len(f_tuple)})")
-    t_pay, t_tmpl = _carry_encode(t_tuple)
-    f_pay, f_tmpl = _carry_encode(f_tuple)
-    # Reconcile the branches position-wise (lax.cond needs one structure):
+            f"outputs ({len(t_tmpl)} vs {len(f_tmpl)})")
+
+    # Reconcile position-wise (lax.cond needs one output structure):
     #  * both arrays: promote dtypes;
     #  * one side UNDEFINED (name assigned on the other branch only): fill
-    #    the undefined side with zeros — the name is semantically undefined
-    #    on that path, any read of the garbage is a user bug (the
-    #    reference's UndefinedVar contract, dy2static/utils.py);
+    #    with zeros — the name is semantically undefined on that path, any
+    #    read of the garbage is a user bug (the reference's UndefinedVar
+    #    contract, dy2static/utils.py);
     #  * both static: must agree.
-    t_arrays, f_arrays, merged_tmpl = [], [], []
-    for (tk, tv), (fk, fv), tp, fp in zip(t_tmpl, f_tmpl, t_pay, f_pay):
+    t_sh, f_sh = list(t_shapes), list(f_shapes)
+    merged_tmpl, slots = [], []   # slots: (dtype, fill_shape) or None=static
+    ti = fi = 0
+    for (tk, tv), (fk, fv) in zip(t_tmpl, f_tmpl):
         if tk != "static" and fk != "static":
-            ta, fa = jnp.asarray(tp), jnp.asarray(fp)
-            dt = jnp.result_type(ta, fa)
-            t_arrays.append(ta.astype(dt))
-            f_arrays.append(fa.astype(dt))
+            dt = jnp.result_type(t_sh[ti].dtype, f_sh[fi].dtype)
+            slots.append((dt, None))
             merged_tmpl.append(("tensor" if "tensor" in (tk, fk) else tk, None))
+            ti += 1
+            fi += 1
         elif tk != "static" and fv is UNDEFINED:
-            ta = jnp.asarray(tp)
-            t_arrays.append(ta)
-            f_arrays.append(jnp.zeros_like(ta))
+            slots.append((t_sh[ti].dtype, ("f", t_sh[ti].shape)))
             merged_tmpl.append((tk, None))
+            ti += 1
         elif fk != "static" and tv is UNDEFINED:
-            fa = jnp.asarray(fp)
-            t_arrays.append(jnp.zeros_like(fa))
-            f_arrays.append(fa)
+            slots.append((f_sh[fi].dtype, ("t", f_sh[fi].shape)))
             merged_tmpl.append((fk, None))
+            fi += 1
         elif tk == "static" and fk == "static":
             if tv is not fv and tv != fv:
                 raise ValueError(
                     "dy2static `if` on a traced predicate: non-tensor output "
                     f"differs between branches ({tv!r} vs {fv!r}); make it a "
                     "tensor or move it out of the `if`")
+            slots.append(None)
             merged_tmpl.append((tk, tv))
         else:
             raise ValueError(
                 "dy2static `if` on a traced predicate: output is a tensor on "
-                f"one branch but {tv if tk=='static' else fv!r} on the other")
+                f"one branch but {tv if tk == 'static' else fv!r} on the other")
+
+    def _branch(run, side):
+        def callable_(arrays):
+            pay = iter(run(arrays))
+            outs = []
+            for slot in slots:
+                if slot is None:
+                    continue
+                dt, fill = slot
+                if fill is not None and fill[0] == side:
+                    outs.append(jnp.zeros(fill[1], dt))  # undefined here
+                else:
+                    outs.append(next(pay).astype(dt))
+            return tuple(outs)
+        return callable_
+
     p = _unwrap(pred)
     res = jax.lax.cond(jnp.reshape(p, ()).astype(bool),
-                       lambda: tuple(t_arrays), lambda: tuple(f_arrays))
+                       _branch(run_t, "t"), _branch(run_f, "f"), live_args)
     it = iter(res)
     aligned = [next(it) if kind != "static" else None
                for kind, _ in merged_tmpl]
     out = tuple(_carry_decode(aligned, merged_tmpl))
-    return out if isinstance(t_out, tuple) else out[0]
+    return out if t_is_tuple else out[0]
 
 
 def convert_while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: tuple):
@@ -340,13 +387,16 @@ def _breaks_scope(stmts: Sequence[ast.stmt]) -> bool:
     return any(scan(s) for s in stmts or [])
 
 
+_RT_NAME = "__paddle_tpu_dy2static_rt__"
+
+
 def _name(id_, ctx=None):
     return ast.Name(id=id_, ctx=ctx or ast.Load())
 
 
 def _call(func_attr: str, args, keywords=None):
     return ast.Call(
-        func=ast.Attribute(value=_name("_jst"), attr=func_attr, ctx=ast.Load()),
+        func=ast.Attribute(value=_name(_RT_NAME), attr=func_attr, ctx=ast.Load()),
         args=list(args), keywords=keywords or [])
 
 
@@ -498,7 +548,7 @@ def _define_guard(name_id: str):
     reference's UndefinedVar pre-declaration, dy2static/utils.py)."""
     g = ast.parse(
         f"try:\n    {name_id}\nexcept NameError:\n"
-        f"    {name_id} = _jst.UNDEFINED").body[0]
+        f"    {name_id} = {_RT_NAME}.UNDEFINED").body[0]
     return ast.fix_missing_locations(g)
 
 
@@ -525,15 +575,19 @@ def ast_transform(fn: Callable) -> Callable:
     new_tree = Dy2StaticTransformer().visit(tree)
     ast.fix_missing_locations(new_tree)
 
-    namespace = dict(inner.__globals__)
-    namespace["_jst"] = _runtime_namespace()
-    # rebind the closure: compile inside a wrapper that re-declares freevars
+    # The rewritten function must see the module's LIVE globals (a name
+    # defined later in the module, recursion, monkeypatched helpers), so we
+    # exec into a scratch namespace only to harvest the code object, then
+    # rebuild the function on inner.__globals__ itself. The `_jst` runtime
+    # is injected into the live module globals under its private name.
+    scratch = {_RT_NAME: _runtime_namespace()}
+    inner.__globals__[_RT_NAME] = _runtime_namespace()
     freevars = inner.__code__.co_freevars
     if freevars:
-        # Closure cells are snapshotted BY VALUE here; a freevar the outer
-        # scope has not bound yet (mutual recursion at decoration time), or
-        # one rebound after decoration, cannot be honored — fall back to the
-        # untransformed function rather than crash.
+        # the wrapper re-declares freevars so the transformed def closes
+        # over real cells; the cells are snapshotted from the current
+        # closure. A freevar the outer scope has not bound yet (mutual
+        # recursion at decoration time) cannot be honored — fall back.
         try:
             cell_values = [c.cell_contents for c in inner.__closure__]
         except ValueError:
@@ -546,15 +600,20 @@ def ast_transform(fn: Callable) -> Callable:
         ast.fix_missing_locations(wrap)
         code = compile(wrap, filename=f"<dy2static {inner.__name__}>",
                        mode="exec")
-        exec(code, namespace)
-        new_fn = namespace[wrapper_name](*cell_values)
+        exec(code, scratch)
+        harvested = scratch[wrapper_name](*cell_values)
+        new_fn = types.FunctionType(
+            harvested.__code__, inner.__globals__, inner.__name__,
+            inner.__defaults__, harvested.__closure__)
     else:
         code = compile(new_tree, filename=f"<dy2static {inner.__name__}>",
                        mode="exec")
-        exec(code, namespace)
-        new_fn = namespace[func_node.name]
+        exec(code, scratch)
+        harvested = scratch[func_node.name]
+        new_fn = types.FunctionType(
+            harvested.__code__, inner.__globals__, inner.__name__,
+            inner.__defaults__, None)
 
-    new_fn.__defaults__ = inner.__defaults__
     new_fn.__kwdefaults__ = inner.__kwdefaults__
     new_fn._dy2static_original = fn
     if inspect.ismethod(fn):

@@ -54,6 +54,9 @@ class GPTConfig:
     layer_norm_epsilon: float = 1e-5
     initializer_range: float = 0.02
     use_recompute: bool = False
+    # None = full-segment remat; "dots" = keep MXU outputs, recompute
+    # elementwise only (see distributed/recompute.py)
+    recompute_policy: Optional[str] = None
     tie_word_embeddings: bool = True
     param_dtype: str = "float32"
     # "ring" | "ulysses" | None — schedule used when the mesh has sp > 1
@@ -233,7 +236,7 @@ class GPTModel(Layer):
                 x, c = block(x, cache=caches[i])
                 new_caches.append(c)
             elif self.config.use_recompute and self.training:
-                x = recompute(block, x)
+                x = recompute(block, x, policy=self.config.recompute_policy)
             else:
                 x = block(x)
         x = self.ln_f(x)
@@ -269,6 +272,21 @@ class GPTForCausalLM(Layer):
             return logits, new_caches
         return logits
 
+    def loss(self, input_ids, labels, loss_mask=None, position_ids=None,
+             chunk_size: int = 128):
+        """Fused-LM-head training loss: hidden states go straight into the
+        chunked linear+softmax-CE (incubate.nn.functional.
+        fused_linear_cross_entropy), so [B,S,vocab] logits never exist in
+        HBM. Numerically identical to forward()+GPTPretrainingCriterion."""
+        from ..incubate.nn.functional import fused_linear_cross_entropy
+        x = self.gpt(input_ids, position_ids)
+        w = (self.gpt.wte.weight if self.config.tie_word_embeddings
+             else self.lm_head.weight)
+        per_tok = fused_linear_cross_entropy(
+            x, w, labels, chunk_size=chunk_size,
+            transpose_weight=not self.config.tie_word_embeddings)
+        return _masked_mean(per_tok, loss_mask)
+
     def generate(self, input_ids, max_new_tokens: int = 16, temperature: float = 0.0):
         """Greedy/temperature sampling with KV cache (reference:
         paddlenlp-style generate; cache semantics of MultiHeadAttention)."""
@@ -294,6 +312,16 @@ class GPTForCausalLM(Layer):
         return out
 
 
+def _masked_mean(per_tok, loss_mask):
+    """Shared masked-mean reduction for both CE paths (criterion and the
+    fused model.loss) — one definition, one epsilon convention."""
+    if loss_mask is None:
+        return ops.mean(per_tok)
+    per_tok = per_tok * loss_mask
+    return ops.sum(per_tok) / ops.maximum(
+        ops.sum(loss_mask), ops.full([], 1e-8, loss_mask.dtype))
+
+
 class GPTPretrainingCriterion(Layer):
     """Reference: PaddleNLP GPTPretrainingCriterion — masked mean CE over
     vocab-parallel logits (ParallelCrossEntropy analog)."""
@@ -304,9 +332,4 @@ class GPTPretrainingCriterion(Layer):
 
     def forward(self, logits, labels, loss_mask=None):
         loss = self.ce(logits, labels)           # [B, S, 1]
-        loss = ops.squeeze(loss, -1)
-        if loss_mask is not None:
-            loss = loss * loss_mask
-            return ops.sum(loss) / ops.maximum(
-                ops.sum(loss_mask), ops.full([], 1e-8, loss_mask.dtype))
-        return ops.mean(loss)
+        return _masked_mean(ops.squeeze(loss, -1), loss_mask)

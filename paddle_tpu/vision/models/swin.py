@@ -190,6 +190,17 @@ class SwinTransformer(Layer):
                  mlp_ratio=4.0, dropout=0.0, num_classes=1000):
         super().__init__()
         assert image_size % patch_size == 0
+        # every stage's feature map must tile into windows (no padding path)
+        res_check = image_size // patch_size
+        for i in range(len(depths)):
+            ws_eff = min(window_size, res_check)
+            if res_check % ws_eff:
+                raise ValueError(
+                    f"stage {i}: feature map {res_check}x{res_check} is not "
+                    f"divisible by window_size {ws_eff} — choose image_size/"
+                    f"patch_size/window_size so every stage tiles exactly "
+                    f"(e.g. 224/4/7 or 256/4/8)")
+            res_check //= 2
         self.embed_dim = embed_dim
         self.num_classes = num_classes
         from ...nn.layers.conv import Conv2D

@@ -119,6 +119,71 @@ class TestIfElse:
                           (-np.ones(3, np.float32),))
 
 
+class TestGradThroughBranch:
+    def test_untaken_branch_cannot_poison_grads(self):
+        """Backward must differentiate only the taken branch: the untaken
+        sqrt(negative) would contribute NaN if branches were traced outside
+        lax.cond."""
+        import paddle_tpu.nn as nn
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(3, 3)
+
+            def forward(self, x):
+                h = self.lin(x)
+                if h.sum() > 0:
+                    y = paddle.sqrt(h)
+                else:
+                    y = h * 2.0
+                return y.sum()
+
+        paddle.seed(3)
+        m = M()
+        # force h strictly negative: positive weights, zero bias, x < 0
+        m.lin.weight.set_value(np.abs(m.lin.weight.numpy()) + 0.1)
+        m.lin.bias.set_value(np.zeros(3, np.float32))
+        x = paddle.to_tensor(-np.ones((1, 3), np.float32) * 5.0)
+        h = m.lin(x)
+        assert float(h.sum()) <= 0  # make sure we're on the *2 branch
+        want = m(x)
+        want.backward()
+        ref = m.lin.weight.grad.numpy().copy()
+        assert np.isfinite(ref).all()
+        m.clear_gradients()
+
+        sm = paddle.jit.to_static(M())
+        sm.set_state_dict(m.state_dict())
+        out = sm(x)
+        out.backward()
+        got = sm.lin.weight.grad.numpy()
+        assert np.isfinite(got).all(), "NaN leaked from the untaken branch"
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def _late_helper(x):
+    return x * 0.0  # overwritten below — ast_transform must see live globals
+
+
+@paddle.jit.to_static
+def _uses_late_global(x):
+    if x.sum() > 0:
+        return _late_helper(x)
+    else:
+        return x
+
+
+def _late_helper(x):  # noqa: F811 — the live binding
+    return x + 10.0
+
+
+class TestLiveGlobals:
+    def test_transformed_fn_sees_rebound_global(self):
+        out = _uses_late_global(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(out._data), 11.0)
+
+
 class TestWhile:
     def test_data_dependent_trip_count(self):
         def f(x):

@@ -44,6 +44,50 @@ def test_forward_backward_and_train():
     assert l < l0
 
 
+def test_fused_lm_head_ce_matches_unfused():
+    """model.loss (chunked fused linear+CE, no logits materialization) must
+    equal forward()+criterion in value AND parameter gradients."""
+    paddle.seed(0)
+    cfg = _tiny()
+    m = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    ids = paddle.to_tensor(np.random.randint(0, 128, (2, 16)).astype("int64"))
+
+    ref = crit(m(ids), ids)
+    ref.backward()
+    ref_grad = m.gpt.wte.weight.grad.numpy().copy()
+    ref_val = float(ref)
+    m.clear_gradients()
+
+    fused = m.loss(ids, ids, chunk_size=8)
+    fused.backward()
+    np.testing.assert_allclose(float(fused), ref_val, rtol=1e-5)
+    np.testing.assert_allclose(m.gpt.wte.weight.grad.numpy(), ref_grad,
+                               rtol=2e-4, atol=2e-5)
+
+    # masked variant + non-divisible chunk size falls back to a divisor
+    mask = paddle.to_tensor(np.random.randint(0, 2, (2, 16)).astype("float32"))
+    lm = m.loss(ids, ids, loss_mask=mask, chunk_size=7)
+    assert np.isfinite(float(lm))
+
+
+def test_adam_bf16_moments_train_and_dtype():
+    import jax.numpy as jnp
+    paddle.seed(0)
+    cfg = _tiny()
+    m = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters(),
+                                 moment_dtype="bfloat16")
+    ids = paddle.to_tensor(np.random.randint(0, 128, (2, 16)).astype("int64"))
+    step = paddle.jit.TrainStep(m, opt, lambda a, b: m.loss(a, b, chunk_size=8))
+    l0 = float(step(ids, ids))
+    for _ in range(5):
+        l = float(step(ids, ids))
+    assert l < l0
+    assert step._opt_state[0]["moment1"].dtype == jnp.bfloat16
+
+
 def test_generate_kv_cache_matches_full_forward():
     """Incremental decode with cache == argmax over full forward logits."""
     paddle.seed(1)
