@@ -233,3 +233,7 @@ features = type("features", (), {
     "Spectrogram": Spectrogram, "MelSpectrogram": MelSpectrogram,
     "LogMelSpectrogram": LogMelSpectrogram, "MFCC": MFCC,
 })
+
+from . import backends  # noqa: E402,F401
+from . import datasets  # noqa: E402,F401
+from .backends import load, save, info  # noqa: E402,F401

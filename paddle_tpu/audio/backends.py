@@ -1,0 +1,98 @@
+"""Audio IO backend (reference: python/paddle/audio/backends — wave_backend
+load/save/info built on the stdlib wave module; soundfile is optional there
+and absent here).
+
+Integer PCM WAV only (8/16/32-bit) — stdlib wave cannot read IEEE-float
+WAVs; that matches the reference's default wave_backend without soundfile.
+"""
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str
+
+
+_PCM = {1: np.uint8, 2: np.int16, 4: np.int32}
+
+
+def info(filepath: str) -> AudioInfo:
+    """reference: wave_backend.info."""
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8,
+                         encoding=f"PCM_{f.getsampwidth() * 8}")
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """reference: wave_backend.load → (waveform, sample_rate). With
+    `normalize` the result is float32 in [-1, 1]."""
+    with wave.open(filepath, "rb") as f:
+        sr, nch, width = f.getframerate(), f.getnchannels(), f.getsampwidth()
+        f.setpos(min(frame_offset, f.getnframes()))
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(max(0, n))
+    dt = _PCM.get(width)
+    if dt is None:
+        raise ValueError(f"unsupported PCM width {width}")
+    data = np.frombuffer(raw, dtype=dt).reshape(-1, nch)
+    if width == 1:  # unsigned 8-bit: center
+        data = data.astype(np.float32) - 128.0
+        scale = 128.0
+    else:
+        scale = float(2 ** (width * 8 - 1))
+        data = data.astype(np.float32)
+    if normalize:
+        data = data / scale
+    out = data.T if channels_first else data
+    return out, sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         bits_per_sample: int = 16):
+    """reference: wave_backend.save — float input in [-1, 1] → PCM."""
+    data = np.asarray(src, np.float32)
+    if data.ndim == 1:
+        data = data[None, :] if channels_first else data[:, None]
+    if channels_first:
+        data = data.T                                  # [n, ch]
+    if bits_per_sample != 16:
+        raise ValueError("wave backend writes PCM_16 only (like the "
+                         "reference without soundfile)")
+    pcm = np.clip(data, -1.0, 1.0)
+    pcm = (pcm * 32767.0).astype(np.int16)
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name: str):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            "only wave_backend is available (no soundfile in this "
+            "environment); reference parity: audio/backends/init_backend.py")

@@ -1,0 +1,122 @@
+"""Audio datasets (reference: python/paddle/audio/datasets — TESS, ESC50
+over AudioClassificationDataset).
+
+Zero-egress environment: datasets read a LOCAL directory laid out like the
+published archives (pass `data_dir=`); there is no downloader. Feature modes
+mirror the reference: 'raw' waveforms or on-the-fly mel features via
+paddle_tpu.audio feature layers.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..io.dataset import Dataset
+from . import backends
+
+__all__ = ["AudioClassificationDataset", "TESS", "ESC50"]
+
+
+class AudioClassificationDataset(Dataset):
+    """reference: audio/datasets/dataset.py — files + labels, optional
+    feature extraction per __getitem__."""
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: str = "raw", sample_rate: Optional[int] = None,
+                 **feat_kwargs):
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self._feat_kwargs = feat_kwargs
+        self._feat_layers = {}  # sr -> constructed feature layer
+        if feat_type not in ("raw", "melspectrogram", "mfcc"):
+            raise ValueError(f"unknown feat_type {feat_type!r}")
+
+    def _features(self, wav: np.ndarray, sr: int) -> np.ndarray:
+        if self.feat_type == "raw":
+            return wav
+        import paddle_tpu as paddle
+        from . import MelSpectrogram, MFCC
+        layer = self._feat_layers.get(sr)
+        if layer is None:  # fbank/DCT matrices are per-sr; build once
+            layer = (MelSpectrogram if self.feat_type == "melspectrogram"
+                     else MFCC)(sr=sr, **self._feat_kwargs)
+            self._feat_layers[sr] = layer
+        x = paddle.to_tensor(wav[None, :].astype("float32"))
+        return np.asarray(layer(x)._data)[0]
+
+    def __getitem__(self, idx) -> Tuple[np.ndarray, int]:
+        wav, sr = backends.load(self.files[idx], channels_first=True)
+        if self.sample_rate is not None and sr != self.sample_rate:
+            raise ValueError(
+                f"{self.files[idx]}: sample rate {sr} != expected "
+                f"{self.sample_rate} (no resampler in wave backend)")
+        return self._features(wav[0], sr), self.labels[idx]
+
+    def __len__(self):
+        return len(self.files)
+
+
+def _scan_wavs(data_dir: str, what: str) -> List[str]:
+    if not data_dir or not os.path.isdir(data_dir):
+        raise RuntimeError(
+            f"{what} needs a local archive: pass data_dir= pointing at the "
+            "extracted dataset (this environment has no network downloader; "
+            "reference downloads via paddle.dataset.common)")
+    out = []
+    for root, _, names in os.walk(data_dir):
+        out.extend(os.path.join(root, n) for n in names
+                   if n.lower().endswith(".wav"))
+    if not out:
+        raise RuntimeError(f"no .wav files under {data_dir}")
+    # full-path sort: os.walk directory order is filesystem-dependent, and
+    # fold assignment must be reproducible across machines
+    return sorted(out)
+
+
+class TESS(AudioClassificationDataset):
+    """Toronto emotional speech set (reference: audio/datasets/tess.py).
+    Label = emotion, parsed from `..._<emotion>.wav` filenames."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, mode: str = "train", n_folds: int = 5,
+                 split: int = 1, feat_type: str = "raw", data_dir: str = None,
+                 **kwargs):
+        if not 1 <= split <= n_folds:
+            raise ValueError("split must be in [1, n_folds]")
+        files = _scan_wavs(data_dir, "TESS")
+        labels = []
+        for f in files:
+            emo = os.path.basename(f).rsplit("_", 1)[-1][:-4].lower()
+            labels.append(self.EMOTIONS.index(emo)
+                          if emo in self.EMOTIONS else 0)
+        fold = np.arange(len(files)) % n_folds + 1
+        keep = (fold != split) if mode == "train" else (fold == split)
+        super().__init__([f for f, k in zip(files, keep) if k],
+                         [l for l, k in zip(labels, keep) if k],
+                         feat_type=feat_type, **kwargs)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference: audio/datasets/esc50.py).
+    Label + fold parsed from `<fold>-<src>-<take>-<target>.wav` names."""
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", data_dir: str = None, **kwargs):
+        files = _scan_wavs(data_dir, "ESC50")
+        keep_files, labels = [], []
+        for f in files:
+            parts = os.path.basename(f)[:-4].split("-")
+            try:
+                fold, target = int(parts[0]), int(parts[-1])
+            except (ValueError, IndexError):
+                continue
+            is_train = fold != split
+            if (mode == "train") == is_train:
+                keep_files.append(f)
+                labels.append(target)
+        super().__init__(keep_files, labels, feat_type=feat_type, **kwargs)

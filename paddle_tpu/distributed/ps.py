@@ -100,6 +100,13 @@ class SparseTable:
         else:  # sgd
             self._rows[slots] -= self.lr * g
 
+    def merge_delta(self, ids: np.ndarray, delta: np.ndarray):
+        """Additive delta merge (GeoSGD server op: rows += delta;
+        reference: memory_sparse_geo_table.cc)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        slots = self._slots(ids, create=True)
+        self._rows[slots] += delta.reshape(len(ids), self.dim)
+
     # -- persistence (reference: table Save/Load shard files) ----------
     def save(self, path: str):
         keys = np.fromiter(self._slot_of.keys(), np.int64, len(self._slot_of))
@@ -194,3 +201,217 @@ class DistributedEmbedding(Layer):
     def load(self, prefix: str):
         for s, t in enumerate(self.tables):
             t.load(f"{prefix}.shard{s}.npz")
+
+
+class GeoSGDEmbedding(DistributedEmbedding):
+    """GeoSGD async mode (reference: memory_sparse_geo_table.cc +
+    GeoCommunicator in ps/service/communicator/): the trainer updates a
+    LOCAL dense copy of the touched rows every step, and only every
+    `geo_step` steps exchanges state with the global table — pushing the
+    accumulated DELTA (local - pulled base) additively and re-pulling fresh
+    rows. Staleness is tolerated by design; that is the GeoSGD contract
+    (async CTR training over slow networks).
+
+    Here the "global table" is the sharded host table and the local copy is
+    a per-trainer row cache, so single-process semantics match the
+    reference's trainer-side GeoCommunicator exactly; multi-trainer
+    deployments give each trainer its own GeoSGDEmbedding over a shared
+    rpc-backed table.
+    """
+
+    def __init__(self, dim: int, geo_step: int = 10, num_shards: int = 1,
+                 lr: float = 0.05, init_scale: float = 0.01, seed: int = 0,
+                 name=None):
+        # global tables hold plain rows; the *local* optimizer is SGD — the
+        # geo push is an additive delta merge, not a gradient step
+        super().__init__(dim, num_shards, optimizer="sgd", lr=lr,
+                         init_scale=init_scale, seed=seed, name=name)
+        self.geo_step = int(geo_step)
+        self._step = 0
+        self._local: Dict[int, np.ndarray] = {}   # id -> local row
+        self._base: Dict[int, np.ndarray] = {}    # id -> row at last sync
+        self._dirty: set = set()                  # ids touched since sync
+
+    # -- local train-side ----------------------------------------------
+    def _pull(self, ids: np.ndarray) -> np.ndarray:
+        out = np.empty((len(ids), self.dim), np.float32)
+        missing = [i for i, key in enumerate(ids.tolist())
+                   if key not in self._local]
+        if missing:
+            fetched = super()._pull(ids[missing])
+            for j, i in enumerate(missing):
+                key = int(ids[i])
+                self._local[key] = fetched[j].copy()
+                self._base[key] = fetched[j].copy()
+        for i, key in enumerate(ids.tolist()):
+            out[i] = self._local[key]
+        return out
+
+    def _push(self, ids: np.ndarray, grads: np.ndarray):
+        # local SGD on the cached rows; NO global traffic here
+        uniq, inv = np.unique(ids, return_inverse=True)
+        missing = np.array([k not in self._local for k in uniq.tolist()])
+        if missing.any():  # push without prior pull: materialize rows first
+            self._pull(uniq[missing])
+        g = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(g, inv, grads.reshape(len(ids), self.dim))
+        for i, key in enumerate(uniq.tolist()):
+            self._local[key] = self._local[key] - self.lr_value * g[i]
+            self._dirty.add(key)
+        self._step += 1
+        if self._step % self.geo_step == 0:
+            self.sync()
+
+    @property
+    def lr_value(self):
+        return self.tables[0].lr
+
+    # -- geo exchange ---------------------------------------------------
+    def sync(self):
+        """Push deltas for rows dirtied since the last sync, then re-pull
+        fresh state for them (other trainers' merged deltas become visible).
+        Un-dirtied cached rows stay stale until touched — the GeoSGD
+        staleness contract; syncing only the dirty set keeps the exchange
+        proportional to recent work (reference GeoCommunicator sends only
+        ids touched in the interval)."""
+        if not self._dirty:
+            return
+        keys = np.fromiter(self._dirty, np.int64, len(self._dirty))
+        delta = np.stack([self._local[int(k)] - self._base[int(k)]
+                          for k in keys])
+        shard = self._route(keys)
+        for s in range(self.num_shards):
+            m = shard == s
+            if m.any():
+                self.tables[s].merge_delta(keys[m], delta[m])
+        fresh = super()._pull(keys)
+        for i, key in enumerate(keys.tolist()):
+            self._local[key] = fresh[i].copy()
+            self._base[key] = fresh[i].copy()
+        self._dirty.clear()
+
+    # -- persistence: reconcile the local cache with the global tables --
+    def save(self, prefix: str):
+        self.sync()  # unsynced local deltas must not be dropped
+        super().save(prefix)
+
+    def load(self, prefix: str):
+        super().load(prefix)
+        self._local.clear()
+        self._base.clear()
+        self._dirty.clear()
+        self._step = 0
+
+
+class GraphTable:
+    """In-memory graph store with neighbor sampling (reference:
+    ps/table/common_graph_table.cc — GNN graph engine: add_graph,
+    random_sample_neighbors, node features; and the GPU sampling twin
+    framework/fleet/heter_ps/graph_gpu_ps_table.h).
+
+    CSR-compacted on first sample; uniform or weight-proportional sampling
+    per node; optional per-node feature rows; random walks for
+    deepwalk-style pipelines.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._src, self._dst, self._w = [], [], []
+        self._feat: Dict[int, np.ndarray] = {}
+        self._rng = np.random.RandomState(seed)
+        self._csr = None
+
+    # -- construction ---------------------------------------------------
+    def add_edges(self, src, dst, weight=None):
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        self._src.append(src)
+        self._dst.append(dst)
+        self._w.append(np.ones(len(src), np.float32) if weight is None
+                       else np.asarray(weight, np.float32).reshape(-1))
+        self._csr = None
+
+    def set_node_feat(self, ids, feat):
+        feat = np.asarray(feat, np.float32)
+        for i, key in enumerate(np.asarray(ids, np.int64).reshape(-1).tolist()):
+            self._feat[key] = feat[i]
+
+    def get_node_feat(self, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        dim = len(next(iter(self._feat.values()))) if self._feat else 0
+        out = np.zeros((len(ids), dim), np.float32)
+        for i, key in enumerate(ids.tolist()):
+            if key in self._feat:
+                out[i] = self._feat[key]
+        return out
+
+    # -- sampling -------------------------------------------------------
+    def _build(self):
+        if self._csr is not None:
+            return
+        src = np.concatenate(self._src) if self._src else np.empty(0, np.int64)
+        dst = np.concatenate(self._dst) if self._dst else np.empty(0, np.int64)
+        w = np.concatenate(self._w) if self._w else np.empty(0, np.float32)
+        order = np.argsort(src, kind="stable")
+        src, dst, w = src[order], dst[order], w[order]
+        uniq, starts = np.unique(src, return_index=True)
+        self._csr = {
+            "index": {int(u): (int(s), int(e)) for u, s, e in zip(
+                uniq, starts, np.append(starts[1:], len(src)))},
+            "dst": dst, "w": w}
+
+    def degree(self, ids) -> np.ndarray:
+        self._build()
+        idx = self._csr["index"]
+        return np.array([idx[k][1] - idx[k][0] if k in idx else 0
+                         for k in np.asarray(ids, np.int64).reshape(-1).tolist()],
+                        np.int64)
+
+    def sample_neighbors(self, ids, sample_size: int,
+                         return_weights: bool = False):
+        """Per-node neighbor sample (uniform, or weighted when edge weights
+        were given). Nodes with no out-edges return empty lists — same
+        contract as the reference's actual_sample_size output."""
+        self._build()
+        idx, dst, w = self._csr["index"], self._csr["dst"], self._csr["w"]
+        neigh, weights = [], []
+        for key in np.asarray(ids, np.int64).reshape(-1).tolist():
+            if key not in idx:
+                neigh.append(np.empty(0, np.int64))
+                weights.append(np.empty(0, np.float32))
+                continue
+            s, e = idx[key]
+            cand, cw = dst[s:e], w[s:e]
+            if e - s <= sample_size:
+                take = np.arange(e - s)
+            else:
+                tot = cw.sum()
+                if tot <= 0:  # all-zero weights: fall back to uniform
+                    p = None
+                else:
+                    p = cw / tot
+                    if np.allclose(p, p[0]):
+                        p = None          # uniform fast path
+                    elif np.count_nonzero(p) < sample_size:
+                        p = None  # not enough weighted support: uniform
+                take = self._rng.choice(e - s, sample_size, replace=False,
+                                        p=p)
+            neigh.append(cand[take])
+            weights.append(cw[take])
+        return (neigh, weights) if return_weights else neigh
+
+    def random_walk(self, ids, walk_len: int) -> np.ndarray:
+        """Uniform random walks [n, walk_len+1]; walks stop (repeat the
+        node) at sinks — deepwalk-style corpus generation."""
+        self._build()
+        idx, dst = self._csr["index"], self._csr["dst"]
+        starts = np.asarray(ids, np.int64).reshape(-1)
+        out = np.empty((len(starts), walk_len + 1), np.int64)
+        out[:, 0] = starts
+        for i, key in enumerate(starts.tolist()):
+            cur = key
+            for t in range(1, walk_len + 1):
+                if cur in idx:
+                    s, e = idx[cur]
+                    cur = int(dst[s + self._rng.randint(e - s)])
+                out[i, t] = cur
+        return out

@@ -6,7 +6,8 @@ import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
-from paddle_tpu.distributed.ps import SparseTable, DistributedEmbedding
+from paddle_tpu.distributed.ps import (SparseTable, DistributedEmbedding,
+                                       GeoSGDEmbedding, GraphTable)
 from paddle_tpu.distributed import rpc
 
 
@@ -86,6 +87,112 @@ def _rpc_worker(rank, port, results):
         infos = rpc.get_all_worker_infos()
         results["names"] = [w.name for w in infos]
     rpc.shutdown()
+
+
+def test_geo_sgd_defers_global_updates_until_sync():
+    """GeoSGD contract: local rows move every step, the GLOBAL table only
+    moves at geo_step boundaries — and then by the accumulated delta."""
+    paddle.seed(0)
+    emb = GeoSGDEmbedding(dim=4, geo_step=3, lr=0.1)
+    ids = np.array([5, 9], np.int64)
+    base = emb._pull(ids).copy()          # creates rows, caches local=base
+    global_before = emb.tables[0].pull(ids).copy()
+    np.testing.assert_array_equal(base, global_before)
+
+    g = np.ones((2, 4), np.float32)
+    emb._push(ids, g)                     # step 1: local only
+    emb._push(ids, g)                     # step 2: local only
+    np.testing.assert_array_equal(emb.tables[0].pull(ids), global_before)
+    local_mid = emb._pull(ids)
+    assert np.allclose(local_mid, base - 0.2), "local SGD must advance"
+
+    emb._push(ids, g)                     # step 3: triggers sync
+    global_after = emb.tables[0].pull(ids)
+    np.testing.assert_allclose(global_after, base - 0.3, atol=1e-6)
+    # local re-based on fresh global
+    np.testing.assert_allclose(emb._pull(ids), global_after, atol=1e-6)
+
+
+def test_geo_sgd_merges_deltas_from_two_trainers():
+    paddle.seed(0)
+    shared = DistributedEmbedding(dim=2, optimizer="sgd", lr=1.0)
+    t1 = GeoSGDEmbedding(dim=2, geo_step=100, lr=1.0)
+    t2 = GeoSGDEmbedding(dim=2, geo_step=100, lr=1.0)
+    t1.tables = t2.tables = shared.tables  # same global table
+    ids = np.array([7], np.int64)
+    t1._pull(ids), t2._pull(ids)
+    base = shared.tables[0].pull(ids).copy()
+    t1._push(ids, np.full((1, 2), 1.0, np.float32))
+    t2._push(ids, np.full((1, 2), 2.0, np.float32))
+    t1.sync()
+    t2.sync()
+    # both deltas land additively: base - 1 - 2
+    np.testing.assert_allclose(shared.tables[0].pull(ids), base - 3.0,
+                               atol=1e-6)
+
+
+def test_geo_sgd_push_without_pull_and_save_load(tmp_path):
+    emb = GeoSGDEmbedding(dim=2, geo_step=100, lr=1.0)
+    ids = np.array([3], np.int64)
+    emb._push(ids, np.ones((1, 2), np.float32))  # no prior pull: must work
+    local = emb._pull(ids)
+    # save must flush the unsynced local delta into the global table
+    prefix = str(tmp_path / "geo")
+    emb.save(prefix)
+    np.testing.assert_allclose(emb.tables[0].pull(ids), local, atol=1e-6)
+    # load must drop the stale cache
+    emb2 = GeoSGDEmbedding(dim=2, geo_step=100, lr=1.0)
+    emb2._pull(ids)  # populate a cache that load() must invalidate
+    emb2.load(prefix)
+    np.testing.assert_allclose(emb2._pull(ids), local, atol=1e-6)
+
+
+class TestGraphTable:
+    def _line_graph(self):
+        g = GraphTable(seed=0)
+        g.add_edges([0, 1, 2], [1, 2, 3])
+        return g
+
+    def test_sample_neighbors_and_degree(self):
+        g = GraphTable(seed=0)
+        g.add_edges([0, 0, 0, 1], [10, 11, 12, 20])
+        assert list(g.degree([0, 1, 99])) == [3, 1, 0]
+        n = g.sample_neighbors([0, 1, 99], sample_size=2)
+        assert len(n[0]) == 2 and set(n[0]) <= {10, 11, 12}
+        assert list(n[1]) == [20]
+        assert len(n[2]) == 0
+
+    def test_weighted_sampling_prefers_heavy_edges(self):
+        g = GraphTable(seed=0)
+        g.add_edges([0] * 3, [1, 2, 3], weight=[100.0, 1e-6, 1e-6])
+        hits = 0
+        for _ in range(50):
+            (nb,) = g.sample_neighbors([0], sample_size=1)
+            hits += int(nb[0] == 1)
+        assert hits >= 45
+
+    def test_zero_weight_edges_fall_back_to_uniform(self):
+        g = GraphTable(seed=0)
+        g.add_edges([0] * 5, [1, 2, 3, 4, 5], weight=[1.0, 0, 0, 0, 0])
+        (nb,) = g.sample_neighbors([0], sample_size=3)
+        assert len(nb) == 3 and len(set(nb)) == 3
+        g2 = GraphTable(seed=0)
+        g2.add_edges([0] * 4, [1, 2, 3, 4], weight=[0.0] * 4)
+        (nb2,) = g2.sample_neighbors([0], sample_size=2)
+        assert len(nb2) == 2
+
+    def test_random_walk_follows_edges_and_stops_at_sink(self):
+        g = self._line_graph()
+        walks = g.random_walk([0], walk_len=5)
+        assert walks.shape == (1, 6)
+        np.testing.assert_array_equal(walks[0, :4], [0, 1, 2, 3])
+        np.testing.assert_array_equal(walks[0, 4:], [3, 3])  # sink repeats
+
+    def test_node_features_roundtrip(self):
+        g = self._line_graph()
+        g.set_node_feat([1, 2], np.array([[1, 2], [3, 4]], np.float32))
+        out = g.get_node_feat([2, 1, 5])
+        np.testing.assert_array_equal(out, [[3, 4], [1, 2], [0, 0]])
 
 
 def test_rpc_sync_async_threads():

@@ -1,4 +1,5 @@
 """Sparse / quantization / text / audio / flags coverage (SURVEY §2.3)."""
+import os
 import numpy as np
 import pytest
 
@@ -163,3 +164,54 @@ def test_flags_nan_inf_check():
     finally:
         paddle.set_flags({"FLAGS_check_nan_inf": False})
     assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is False
+
+
+class TestAudioBackendAndDatasets:
+    def _write_wavs(self, tmp, names, sr=16000, n=1600):
+        import paddle_tpu.audio as audio
+        paths = []
+        rng = np.random.RandomState(0)
+        for name in names:
+            p = os.path.join(tmp, name)
+            audio.save(p, rng.uniform(-0.5, 0.5, n).astype("float32"), sr)
+            paths.append(p)
+        return paths
+
+    def test_wav_save_load_info_roundtrip(self, tmp_path):
+        import paddle_tpu.audio as audio
+        sr, n = 8000, 800
+        x = np.sin(np.linspace(0, 40 * np.pi, n)).astype("float32") * 0.7
+        p = str(tmp_path / "tone.wav")
+        audio.save(p, x, sr)
+        meta = audio.info(p)
+        assert (meta.sample_rate, meta.num_samples, meta.num_channels) == \
+            (sr, n, 1)
+        y, sr2 = audio.load(p)
+        assert sr2 == sr and y.shape == (1, n)
+        np.testing.assert_allclose(y[0], x, atol=1e-3)
+
+    def test_esc50_fold_split_and_labels(self, tmp_path):
+        from paddle_tpu.audio.datasets import ESC50
+        names = ["1-100-A-0.wav", "1-101-A-7.wav", "2-200-B-3.wav",
+                 "3-300-C-49.wav"]
+        self._write_wavs(str(tmp_path), names)
+        train = ESC50(mode="train", split=1, data_dir=str(tmp_path))
+        dev = ESC50(mode="dev", split=1, data_dir=str(tmp_path))
+        assert len(train) == 2 and len(dev) == 2
+        wav, label = dev[0]
+        assert label in (0, 7) and wav.ndim == 1
+
+    def test_tess_emotion_labels_and_features(self, tmp_path):
+        from paddle_tpu.audio.datasets import TESS
+        names = ["OAF_back_angry.wav", "OAF_back_happy.wav",
+                 "YAF_dog_sad.wav", "YAF_dog_neutral.wav", "OAF_bite_fear.wav"]
+        self._write_wavs(str(tmp_path), names)
+        ds = TESS(mode="train", n_folds=5, split=5, data_dir=str(tmp_path),
+                  feat_type="melspectrogram", n_fft=256, n_mels=8)
+        feats, label = ds[0]
+        assert feats.shape[0] == 8 and 0 <= label < len(TESS.EMOTIONS)
+
+    def test_missing_dir_clear_error(self):
+        from paddle_tpu.audio.datasets import ESC50
+        with pytest.raises(RuntimeError, match="data_dir"):
+            ESC50(data_dir="/nonexistent/path")
