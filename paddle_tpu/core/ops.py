@@ -1180,6 +1180,33 @@ class linalg:
         return lu_, piv
 
     @staticmethod
+    def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+        """reference: lu_unpack op — split packed LU into P, L, U.
+        Batched: pivots are applied as sequential row swaps per batch."""
+        def fn(lu_, piv):
+            n = lu_.shape[-2]
+            L = jnp.tril(lu_, -1) + jnp.eye(n, lu_.shape[-1], dtype=lu_.dtype)
+            U = jnp.triu(lu_)
+            lead = piv.shape[:-1]
+            perm = jnp.broadcast_to(jnp.arange(n), lead + (n,))
+
+            def body(p, i):
+                j = piv[..., i].astype(jnp.int32) - 1          # [...] batched
+                pi = p[..., i]
+                pj = jnp.take_along_axis(p, j[..., None], axis=-1)[..., 0]
+                p = p.at[..., i].set(pj)
+                oh = jax.nn.one_hot(j, n, dtype=bool)
+                p = jnp.where(oh, pi[..., None], p)
+                return p, None
+            perm, _ = jax.lax.scan(body, perm, jnp.arange(piv.shape[-1]))
+            # rows of P: P[perm[r], r] = 1  (swap-applied row order)
+            P = jnp.swapaxes(jax.nn.one_hot(perm, n, dtype=lu_.dtype), -1, -2)
+            return P, L[..., :, :builtins.min(lu_.shape[-2:])], \
+                U[..., :builtins.min(lu_.shape[-2:]), :]
+        P, L, U = apply_op("lu_unpack", fn, [x, y], n_outputs=3)
+        return P, L, U
+
+    @staticmethod
     def corrcoef(x, rowvar=True, name=None):
         return apply_op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), [x])
 
@@ -1235,6 +1262,76 @@ class fft:
     @staticmethod
     def ifftn(x, s=None, axes=None, norm="backward", name=None):
         return apply_op("ifftn", lambda a: jnp.fft.ifftn(a, s=s, axes=axes, norm=norm), [x])
+
+    @staticmethod
+    def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply_op("rfft2", lambda a: jnp.fft.rfft2(a, s=s, axes=axes, norm=norm), [x])
+
+    @staticmethod
+    def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply_op("irfft2", lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=norm), [x])
+
+    @staticmethod
+    def rfftn(x, s=None, axes=None, norm="backward", name=None):
+        return apply_op("rfftn", lambda a: jnp.fft.rfftn(a, s=s, axes=axes, norm=norm), [x])
+
+    @staticmethod
+    def irfftn(x, s=None, axes=None, norm="backward", name=None):
+        return apply_op("irfftn", lambda a: jnp.fft.irfftn(a, s=s, axes=axes, norm=norm), [x])
+
+    @staticmethod
+    def hfft(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op("hfft", lambda a: jnp.fft.hfft(a, n=n, axis=axis, norm=norm), [x])
+
+    @staticmethod
+    def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op("ihfft", lambda a: jnp.fft.ihfft(a, n=n, axis=axis, norm=norm), [x])
+
+    @staticmethod
+    def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        """Hermitian 2-D fft (scipy semantics: forward fft over the leading
+        axes FIRST, hermitian fft over the last axis LAST)."""
+        def f(a):
+            out = jnp.fft.fft(a, n=None if s is None else s[0],
+                              axis=axes[0], norm=norm)
+            return jnp.fft.hfft(out, n=None if s is None else s[-1],
+                                axis=axes[-1], norm=norm)
+        return apply_op("hfft2", f, [x])
+
+    @staticmethod
+    def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        """Inverse hermitian 2-D fft: ihfft over the last (real input)
+        axis FIRST, then ifft over the leading axes."""
+        def f(a):
+            out = jnp.fft.ihfft(a, n=None if s is None else s[-1],
+                                axis=axes[-1], norm=norm)
+            return jnp.fft.ifft(out, n=None if s is None else s[0],
+                                axis=axes[0], norm=norm)
+        return apply_op("ihfft2", f, [x])
+
+    @staticmethod
+    def hfftn(x, s=None, axes=None, norm="backward", name=None):
+        def f(a):
+            axs = list(range(a.ndim)) if axes is None else list(axes)
+            out = a
+            for i, ax in enumerate(axs[:-1]):
+                out = jnp.fft.fft(out, n=None if s is None else s[i],
+                                  axis=ax, norm=norm)
+            return jnp.fft.hfft(out, n=None if s is None else s[-1],
+                                axis=axs[-1], norm=norm)
+        return apply_op("hfftn", f, [x])
+
+    @staticmethod
+    def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+        def f(a):
+            axs = list(range(a.ndim)) if axes is None else list(axes)
+            out = jnp.fft.ihfft(a, n=None if s is None else s[-1],
+                                axis=axs[-1], norm=norm)
+            for i, ax in enumerate(axs[:-1]):
+                out = jnp.fft.ifft(out, n=None if s is None else s[i],
+                                   axis=ax, norm=norm)
+            return out
+        return apply_op("ihfftn", f, [x])
 
     @staticmethod
     def fftshift(x, axes=None, name=None):
@@ -1417,3 +1514,255 @@ def increment(x, value=1.0, name=None):
 
 
 _attach_methods()
+
+
+# --------------------------------------------------------------------------
+# Surface-completion batch (reference python/paddle/__init__.py __all__
+# parity): math/manipulation stragglers, predicates, and top-level forms of
+# the inplace methods.
+
+def add_n(inputs, name=None):
+    """reference: paddle.add_n (sum_op) — elementwise sum of a tensor list."""
+    if isinstance(inputs, Tensor):
+        return clone(inputs)
+    def fn(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+    return apply_op("add_n", fn, list(inputs))
+
+
+def deg2rad(x, name=None):
+    return apply_op("deg2rad", jnp.deg2rad, [x])
+
+
+def rad2deg(x, name=None):
+    return apply_op("rad2deg", jnp.rad2deg, [x])
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op("diagflat", lambda a: jnp.diagflat(a, k=offset), [x])
+
+
+def floor_mod(x, y, name=None):
+    return mod(x, y)
+
+
+def frexp(x, name=None):
+    return apply_op("frexp", jnp.frexp, [x], n_outputs=2)
+
+
+def gcd(x, y, name=None):
+    return apply_op("gcd", jnp.gcd, [x, y])
+
+
+def lcm(x, y, name=None):
+    return apply_op("lcm", jnp.lcm, [x, y])
+
+
+def logit(x, eps=None, name=None):
+    def fn(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a) - jnp.log1p(-a)
+    return apply_op("logit", fn, [x])
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op("nanmedian",
+                    lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), [x])
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op("nanquantile",
+                    lambda a: jnp.nanquantile(a, q, axis=axis, keepdims=keepdim),
+                    [x])
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """reference: renorm_op — per-slice p-norm clamp along `axis`."""
+    def fn(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.linalg.norm(flat.astype(jnp.float32), ord=p, axis=1)
+        scale_f = jnp.where(norms > max_norm,
+                            max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale_f[:, None].astype(a.dtype)
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+    return apply_op("renorm", fn, [x])
+
+
+def sgn(x, name=None):
+    def fn(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0.0 + 0.0j, a / jnp.maximum(mag, 1e-38))
+        return jnp.sign(a)
+    return apply_op("sgn", fn, [x])
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op("stanh",
+                    lambda a: scale_b * jnp.tanh(scale_a * a), [x])
+
+
+def take(x, index, mode="raise", name=None):
+    """reference: paddle.take — flat-index gather with raise/wrap/clip."""
+    def fn(a, idx):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        ii = idx.astype(jnp.int64)
+        if mode == "wrap":
+            ii = ((ii % n) + n) % n
+        else:  # raise/clip both clamp under jit (no python raise in XLA)
+            ii = jnp.clip(jnp.where(ii < 0, ii + n, ii), 0, n - 1)
+        return flat[ii]
+    return apply_op("take", fn, [x, index])
+
+
+def tensordot(x, y, axes=2, name=None):
+    def to_spec(ax):
+        if isinstance(ax, Tensor):
+            ax = np.asarray(ax._data)
+        if isinstance(ax, np.ndarray):
+            ax = ax.tolist()
+        if isinstance(ax, (list, tuple)) and len(ax) == 2 and all(
+                isinstance(a, (list, tuple)) for a in ax):
+            return tuple(tuple(a) for a in ax)
+        return ax
+    spec = to_spec(axes)
+    return apply_op("tensordot",
+                    lambda a, b: jnp.tensordot(a, b, axes=spec), [x, y])
+
+
+def vsplit(x, num_or_sections, name=None):
+    if x.ndim < 2:
+        raise ValueError("vsplit expects ndim >= 2")
+    return split(x, num_or_sections, axis=0)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1, name=None):
+    """reference: shard_index_op (PS vocab sharding)."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(f"shard_id {shard_id} out of range [0, {nshards})")
+    size = (index_num + nshards - 1) // nshards
+    def fn(a):
+        belongs = (a // size) == shard_id
+        return jnp.where(belongs, a % size, ignore_value).astype(a.dtype)
+    return apply_op("shard_index", fn, [input])
+
+
+def slice(input, axes, starts, ends, name=None):  # noqa: A001
+    """reference: slice_op — python-semantics slice along `axes`."""
+    def fn(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(int(s), int(e))
+        return a[tuple(idx)]
+    return apply_op("slice", fn, [input])
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def fn(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(int(s), int(e), int(st))
+        return a[tuple(idx)]
+    return apply_op("strided_slice", fn, [x])
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """reference: crop_tensor_op."""
+    def fn(a):
+        offs = [0] * a.ndim if offsets is None else [int(o) for o in offsets]
+        shp = list(a.shape) if shape is None else [
+            a.shape[i] - offs[i] if int(s) == -1 else int(s)
+            for i, s in enumerate(shape)]
+        idx = tuple(builtins.slice(o, o + s) for o, s in zip(offs, shp))
+        return a[idx]
+    return apply_op("crop", fn, [x])
+
+
+def reverse(x, axis, name=None):
+    return flip(x, axis)
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    from . import random as _random
+    shp = tuple(x.shape)
+    dt = convert_dtype(dtype) if dtype is not None else np.dtype(x.dtype)
+    out = jax.random.randint(_random.split_key(), shp, int(low), int(high))
+    return Tensor(out.astype(dt), stop_gradient=True)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_floating_point(x):
+    from .dtype import is_floating_point as _f
+    return _f(x.dtype if isinstance(x, Tensor) else x)
+
+
+def is_integer(x):
+    from .dtype import is_integer as _f
+    return _f(x.dtype if isinstance(x, Tensor) else x)
+
+
+def is_complex(x):
+    from .dtype import is_complex as _f
+    return _f(x.dtype if isinstance(x, Tensor) else x)
+
+
+def rank(input, name=None):
+    return Tensor(jnp.asarray(input.ndim, jnp.int32), stop_gradient=True)
+
+
+def shape(input, name=None):
+    """reference: paddle.shape returns an int Tensor of the shape."""
+    return Tensor(jnp.asarray(tuple(input.shape), jnp.int32),
+                  stop_gradient=True)
+
+
+def tolist(x, name=None):
+    return np.asarray(x._data).tolist()
+
+
+# top-level forms of the inplace Tensor methods (reference exports these)
+def squeeze_(x, axis=None, name=None):
+    return x._replace(squeeze(x, axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._replace(unsqueeze(x, axis))
+
+
+def tanh_(x, name=None):
+    return x._replace(tanh(x))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._replace(scatter(x, index, updates, overwrite=overwrite))
+
+
+def _attach_surface_batch():
+    T = Tensor
+    this = globals()
+    for nm in ["add_n", "deg2rad", "rad2deg", "diagflat", "floor_mod",
+               "frexp", "gcd", "lcm", "logit", "nanmedian", "nanquantile",
+               "renorm", "sgn", "stanh", "take", "tensordot", "vsplit",
+               "tolist", "squeeze_", "unsqueeze_", "tanh_", "scatter_"]:
+        setattr(T, nm, this[nm])
+    T.is_floating_point = lambda s: is_floating_point(s)
+    T.is_integer = lambda s: is_integer(s)
+    T.is_complex = lambda s: is_complex(s)
+
+
+_attach_surface_batch()

@@ -1,0 +1,9 @@
+"""paddle.fft namespace as an importable module (reference:
+python/paddle/fft/__init__.py); implementations on core.ops.fft."""
+from .core.ops import fft as _fft
+
+_names = [n for n in dir(_fft) if not n.startswith("_")]
+for _n in _names:
+    globals()[_n] = getattr(_fft, _n)
+__all__ = list(_names)
+del _n, _names, _fft

@@ -47,6 +47,22 @@ def CPUPlace():
     return jax.devices()[0]
 
 
+def CUDAPinnedPlace():
+    """Host-pinned memory place — on TPU runtimes host staging is managed
+    by the transfer engine, so this is the host (CPU) device."""
+    return CPUPlace()
+
+
+def NPUPlace(dev_id=0):
+    """Reference NPU backend place; maps to the accelerator device here
+    (we ARE the single-accelerator backend, SURVEY §7 custom-device row)."""
+    return CUDAPlace(dev_id)
+
+
+def XPUPlace(dev_id=0):
+    return CUDAPlace(dev_id)
+
+
 def is_compiled_with_cuda():
     return False
 

@@ -1,0 +1,11 @@
+"""paddle.linalg namespace as an importable module (reference:
+python/paddle/linalg/__init__.py). The implementations live on
+core.ops.linalg; this module mirrors them so both `paddle.linalg.svd` and
+`import paddle_tpu.linalg` work."""
+from .core.ops import linalg as _la
+
+_names = [n for n in dir(_la) if not n.startswith("_")]
+for _n in _names:
+    globals()[_n] = getattr(_la, _n)
+__all__ = list(_names)
+del _n, _names, _la

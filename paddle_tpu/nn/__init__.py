@@ -3,7 +3,7 @@
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from .layer import (  # noqa: F401
-    Layer, Sequential, LayerList, ParameterList, Identity, ParamAttr,
+    Layer, Sequential, LayerList, LayerDict, ParameterList, Identity, ParamAttr,
 )
 from .layers.common import (  # noqa: F401
     Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
@@ -20,6 +20,7 @@ from .layers.activation import (  # noqa: F401
     ReLU, ReLU6, GELU, SiLU, Swish, ELU, SELU, CELU, LeakyReLU, PReLU, Sigmoid,
     Tanh, Softmax, LogSoftmax, Hardtanh, Hardsigmoid, Hardswish, Hardshrink,
     Softshrink, Tanhshrink, Mish, Softplus, Softsign, GLU, ThresholdedReLU, Maxout,
+    Softmax2D,
 )
 from .layers.pooling import (  # noqa: F401
     MaxPool1D, MaxPool2D, AvgPool1D, AvgPool2D, AdaptiveAvgPool1D,
@@ -29,6 +30,8 @@ from .layers.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     KLDivLoss, SmoothL1Loss, MarginRankingLoss, CTCLoss, CosineSimilarity,
     CosineEmbeddingLoss, TripletMarginLoss, HingeEmbeddingLoss,
+    MultiMarginLoss, SoftMarginLoss, MultiLabelSoftMarginLoss, RNNTLoss,
+    HSigmoidLoss,
 )
 from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
@@ -37,6 +40,7 @@ from .layers.transformer import (  # noqa: F401
 from .layers.rnn import (  # noqa: F401
     SimpleRNN, LSTM, GRU, RNN, SimpleRNNCell, LSTMCell, GRUCell,
 )
+from .layers.decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 
 from ..core.tensor import Parameter  # noqa: F401
 

@@ -228,13 +228,20 @@ def _pool(x, kernel, stride, padding, n, reducer, init, data_format="NCHW",
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
+    if return_mask:
+        # segnet-style pool/unpool pair: non-overlapping windows
+        st = stride if stride is not None else kernel_size
+        if _norm_tuple(st, 2) != _norm_tuple(kernel_size, 2) or padding != 0:
+            raise NotImplementedError(
+                "return_mask supports the unpool case: stride == "
+                "kernel_size, padding 0")
+        return apply_op("max_pool2d_with_index",
+                        _max_pool_with_index(x, kernel_size, 2), [x],
+                        n_outputs=2)
     fn, *_ = _pool(x, kernel_size, stride, padding, 2, lax.max,
                    lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).min,
                    data_format)
-    out = apply_op("max_pool2d", fn, [x])
-    if return_mask:
-        raise NotImplementedError("return_mask not yet supported")
-    return out
+    return apply_op("max_pool2d", fn, [x])
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -1042,3 +1049,693 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
         base = jnp.stack([gx, gy, ones], axis=-1)  # [h, w, 3]
         return jnp.einsum("hwk,nok->nhwo", base, th)
     return apply_op("affine_grid", fn, [theta])
+
+
+# ---------------------------------------------------------------------------
+# Surface-completion batch (reference: python/paddle/nn/functional/__init__.py
+# parity). Activations / paddings / shape ops.
+
+def log_sigmoid(x, name=None):
+    return apply_op("log_sigmoid", jax.nn.log_sigmoid, [x])
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply_op("thresholded_relu",
+                    lambda a: jnp.where(a > threshold, a, 0.0).astype(a.dtype),
+                    [x])
+
+
+def maxout(x, groups, axis=1, name=None):
+    """reference: maxout_op — max over `groups` channel sub-bands."""
+    def fn(a):
+        ax = axis if axis >= 0 else a.ndim + axis
+        c = a.shape[ax]
+        if c % groups:
+            raise ValueError(f"channels {c} not divisible by groups {groups}")
+        shp = a.shape[:ax] + (groups, c // groups) + a.shape[ax + 1:]
+        return a.reshape(shp).max(axis=ax)
+    return apply_op("maxout", fn, [x])
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    """reference: rrelu_op — random leaky slope in train, mean slope in eval."""
+    if training:
+        key = _random.split_key()
+
+        def fn(a):
+            slope = jax.random.uniform(key, a.shape, jnp.float32, lower, upper)
+            return jnp.where(a >= 0, a, a * slope.astype(a.dtype))
+    else:
+        mid = (lower + upper) / 2.0
+
+        def fn(a):
+            return jnp.where(a >= 0, a, a * mid).astype(a.dtype)
+    return apply_op("rrelu", fn, [x])
+
+
+def relu_(x, name=None):
+    return x._replace(relu(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._replace(elu(x, alpha))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._replace(softmax(x, axis=axis, dtype=dtype))
+
+
+def tanh_(x, name=None):
+    from ..core.ops import tanh as _tanh
+    return x._replace(_tanh(x))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    p = _norm_tuple(padding, 4)  # [left, right, top, bottom]
+
+    def fn(a):
+        if data_format == "NCHW":
+            pads = [(0, 0), (0, 0), (p[2], p[3]), (p[0], p[1])]
+        else:
+            pads = [(0, 0), (p[2], p[3]), (p[0], p[1]), (0, 0)]
+        return jnp.pad(a, pads)
+    return apply_op("zeropad2d", fn, [x])
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    """reference: pixel_unshuffle_op — space-to-depth (inverse of
+    pixel_shuffle)."""
+    r = int(downscale_factor)
+
+    def fn(a):
+        if data_format != "NCHW":
+            a = jnp.moveaxis(a, -1, 1)
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = a.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r, w // r)
+        if data_format != "NCHW":
+            a = jnp.moveaxis(a, 1, -1)
+        return a
+    return apply_op("pixel_unshuffle", fn, [x])
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(a):
+        if data_format != "NCHW":
+            a = jnp.moveaxis(a, -1, 1)
+        n, c, h, w = a.shape
+        a = a.reshape(n, groups, c // groups, h, w)
+        a = a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        if data_format != "NCHW":
+            a = jnp.moveaxis(a, 1, -1)
+        return a
+    return apply_op("channel_shuffle", fn, [x])
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """reference: diag_embed op — batched vector -> diagonal matrices."""
+    def fn(a):
+        n = a.shape[-1]
+        m = n + builtins.abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (m, m), a.dtype)
+        idx = jnp.arange(n)
+        rows = idx if offset >= 0 else idx - offset
+        cols = idx + offset if offset >= 0 else idx
+        base = base.at[..., rows, cols].set(a)
+        d1 = dim1 if dim1 >= 0 else base.ndim + dim1
+        d2 = dim2 if dim2 >= 0 else base.ndim + dim2
+        nd = base.ndim
+        return jnp.moveaxis(base, (nd - 2, nd - 1),
+                            (d1, d2) if d1 < d2 else (d2, d1))
+    return apply_op("diag_embed", fn, [input])
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """reference: bilinear op — out[n,o] = x1[n,:] W[o] x2[n,:] + b."""
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+
+    def fn(a, b, w, *bb):
+        out = jnp.einsum("ni,oij,nj->no", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+    return apply_op("bilinear", fn, args)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d.astype(jnp.float32), ord=p, axis=-1,
+                               keepdims=keepdim).astype(a.dtype)
+    return apply_op("pairwise_distance", fn, [x, y])
+
+
+# ----------------------------------------------------------------- losses
+# (_reduce_loss shared with the earlier loss section)
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def fn(x, y):
+        # softplus(-y*x): overflow-stable form of log(1 + exp(-y*x))
+        return _reduce_loss(jax.nn.softplus(-y.astype(x.dtype) * x),
+                            reduction)
+    return apply_op("soft_margin_loss", fn, [input, label])
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    args = [input, label] + ([weight] if weight is not None else [])
+
+    def fn(x, y, *w):
+        y = y.astype(x.dtype)
+        loss = y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x)
+        loss = -loss
+        if w:
+            loss = loss * w[0]
+        return _reduce_loss(loss.mean(axis=-1), reduction)
+    return apply_op("multi_label_soft_margin_loss", fn, args)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    args = [input, label] + ([weight] if weight is not None else [])
+
+    def fn(x, y, *w):
+        n, c = x.shape
+        gold = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), axis=1)
+        diff = jnp.maximum(0.0, margin - gold + x)
+        if p != 1:
+            diff = diff ** p
+        if w:
+            diff = diff * jnp.take(w[0], y.astype(jnp.int32))[:, None]
+        mask = jnp.arange(c)[None, :] != y[:, None]
+        return _reduce_loss(jnp.where(mask, diff, 0.0).sum(axis=1) / c,
+                            reduction)
+    return apply_op("multi_margin_loss", fn, [input, label] +
+                    ([weight] if weight is not None else []))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """reference: npair_loss (metric learning)."""
+    def fn(a, p, y):
+        sim = a @ p.T                                     # [n, n]
+        y = y.reshape(-1)
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = same / same.sum(axis=1, keepdims=True)
+        xent = jnp.mean(jax.nn.logsumexp(sim, axis=1) -
+                        jnp.sum(sim * tgt, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1)) +
+                        jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return xent + reg
+    return apply_op("npair_loss", fn, [anchor, positive, labels])
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference: dice_loss (segmentation) — input prob [N,...,C], label
+    int [N,...,1]."""
+    def fn(x, y):
+        nc = x.shape[-1]
+        oh = jax.nn.one_hot(y.reshape(y.shape[:-1]).astype(jnp.int32), nc,
+                            dtype=x.dtype)
+        x2 = x.reshape(x.shape[0], -1)
+        y2 = oh.reshape(oh.shape[0], -1)
+        inter = (x2 * y2).sum(axis=1)
+        union = x2.sum(axis=1) + y2.sum(axis=1)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply_op("dice_loss", fn, [input, label])
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    dist = distance_function or (lambda a, b: pairwise_distance(a, b))
+    d_ap = dist(input, positive)
+    d_an = dist(input, negative)
+    if swap:
+        d_pn = dist(positive, negative)
+        from ..core.ops import minimum as _min
+        d_an = _min(d_an, d_pn)
+
+    def fn(ap, an):
+        return _reduce_loss(jnp.maximum(0.0, ap - an + margin), reduction)
+    return apply_op("triplet_margin_distance", fn, [d_ap, d_an])
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """reference: hierarchical_sigmoid op. Default (complete binary tree)
+    path encoding over `num_classes` leaves."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom-tree hsigmoid (path_table/path_code) is not supported; "
+            "the default complete-tree mode matches the reference default")
+    depth = builtins.max(1, int(np.ceil(np.log2(builtins.max(2, num_classes)))))
+    # host-computed static code tables; leaves at uneven depth (num_classes
+    # not a power of two) get shorter paths — valid[] masks padded steps
+    codes = np.zeros((num_classes, depth), np.int64)     # inner-node index
+    signs = np.zeros((num_classes, depth), np.float32)   # 0/1 branch bit
+    valid = np.zeros((num_classes, depth), np.float32)
+    for c in builtins.range(num_classes):
+        node = c + num_classes  # leaf position in implicit heap
+        d = 0
+        while node > 1 and d < depth:
+            parent = node // 2
+            signs[c, depth - 1 - d] = float(node % 2)
+            codes[c, depth - 1 - d] = parent - 1
+            valid[c, depth - 1 - d] = 1.0
+            node = parent
+            d += 1
+    args = [input, label, weight] + ([bias] if bias is not None else [])
+
+    def fn(x, y, w, *b):
+        yy = y.reshape(-1).astype(jnp.int32)
+        node_idx = jnp.asarray(codes)[yy]                # [n, depth]
+        bits = jnp.asarray(signs)[yy]                    # [n, depth]
+        vmask = jnp.asarray(valid)[yy]
+        wv = w[node_idx]                                 # [n, depth, dim]
+        logits = jnp.einsum("nd,nkd->nk", x, wv)
+        if b:
+            logits = logits + b[0].reshape(-1)[node_idx]
+        # P(bit) via sigmoid; loss = -sum log P over REAL path steps
+        lp = bits * jax.nn.log_sigmoid(logits) + \
+            (1 - bits) * jax.nn.log_sigmoid(-logits)
+        return -(lp * vmask).sum(axis=1, keepdims=True)
+    return apply_op("hsigmoid_loss", fn, args)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """reference: warprnnt_op — RNN-T transducer loss. Forward-variable
+    (alpha) dynamic program over the [T, U] lattice as nested lax.scans,
+    fully on-device and differentiable by jax AD (the reference backprops
+    hand-written gradients; autodiff of the DP is the XLA-native way)."""
+    def fn(logits, labels, t_len, u_len):
+        # logits [B, T, U+1, V] log-probs expected (reference applies
+        # log_softmax internally when needed)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        B, T, U1, V = lp.shape
+        U = U1 - 1
+        labels = labels.astype(jnp.int32)
+        blank_lp = lp[..., blank]                               # [B,T,U+1]
+        emit_lp = jnp.take_along_axis(
+            lp[:, :, :U, :], labels[:, None, :, None], axis=3)[..., 0]
+        # alpha recursion:
+        #   alpha[t, u] = logaddexp(alpha[t-1, u] + blank(t-1, u),
+        #                           alpha[t, u-1] + emit(t, u-1))
+        # outer scan over t; inner scan builds the row left-to-right (the
+        # u-1 dependency is sequential within a row)
+        neg = jnp.float32(-1e30)
+        bi_ = jnp.arange(B)
+
+        def scan_t(alpha_prev, t):
+            fb = alpha_prev + blank_lp[:, t - 1, :]             # [B, U+1]
+
+            def scan_u(carry, u):
+                v = jnp.where(u == 0, fb[:, 0],
+                              jnp.logaddexp(fb[bi_, u],
+                                            carry + emit_lp[bi_, t, u - 1]))
+                return v, v
+            _, cols = jax.lax.scan(scan_u, jnp.full((B,), neg),
+                                   jnp.arange(U1))
+            alpha_t = cols.T                                    # [B, U+1]
+            return alpha_t, alpha_t
+
+        # alpha[0, u]: pure emission chain at t=0
+        def scan_u0(carry, u):
+            v = jnp.where(u == 0, jnp.zeros((B,), jnp.float32),
+                          carry + emit_lp[bi_, 0, u - 1])
+            return v, v
+        _, cols0 = jax.lax.scan(scan_u0, jnp.full((B,), neg), jnp.arange(U1))
+        alpha0 = cols0.T
+
+        _, alphas = jax.lax.scan(scan_t, alpha0, jnp.arange(1, T))
+        all_alpha = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T,B,U+1]
+        # ll = alpha[t_len-1, u_len] + blank(t_len-1, u_len)
+        bi = jnp.arange(B)
+        tl = t_len.astype(jnp.int32) - 1
+        ul = u_len.astype(jnp.int32)
+        final_alpha = all_alpha[tl, bi, ul]
+        ll = final_alpha + blank_lp[bi, tl, ul]
+        loss = -ll
+        return _reduce_loss(loss, reduction)
+    return apply_op("rnnt_loss", fn, [input, label, input_lengths,
+                                      label_lengths])
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean", name=None):
+    """reference: margin_cross_entropy op (ArcFace/CosFace family):
+    cos(m1*theta + m2) - m3 margin on the gold logit, then scaled CE."""
+    def fn(x, y):
+        yy = y.reshape(-1).astype(jnp.int32)
+        x32 = jnp.clip(x.astype(jnp.float32), -1.0, 1.0)
+        theta = jnp.arccos(jnp.take_along_axis(x32, yy[:, None], axis=1))
+        marg = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(yy, x.shape[-1], dtype=jnp.float32)
+        logits_m = (x32 * (1 - onehot) + marg * onehot) * scale
+        logp = jax.nn.log_softmax(logits_m, axis=-1)
+        loss = -jnp.take_along_axis(logp, yy[:, None], axis=1)
+        sm = jnp.exp(logp)
+        return _reduce_loss(loss, reduction), sm
+    loss, sm = apply_op("margin_cross_entropy", fn, [logits, label],
+                        n_outputs=2)
+    return (loss, sm) if return_softmax else loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None, name=None):
+    """reference: class_center_sample op (PartialFC) — sample the positive
+    class centers plus random negatives; remap labels into the sampled set."""
+    lab = np.asarray(label._data if isinstance(label, Tensor) else label,
+                     np.int64).reshape(-1)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos,
+                            assume_unique=True)
+        # negatives drawn from the package RNG stream: fresh per call,
+        # reproducible under paddle.seed
+        seed = int(jax.random.randint(_random.split_key(), (), 0, 2**31 - 1))
+        extra = np.random.RandomState(seed).choice(
+            rest, num_samples - len(pos), replace=False)
+        sampled = np.concatenate([pos, np.sort(extra)])
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    remapped = remap[lab]
+    return (Tensor(jnp.asarray(remapped), stop_gradient=True),
+            Tensor(jnp.asarray(sampled), stop_gradient=True))
+
+
+def gather_tree(ids, parents, name=None):
+    """reference: gather_tree op — backtrace beam-search ancestry.
+    ids/parents: [max_time, batch, beam]."""
+    def fn(idv, par):
+        tmax = idv.shape[0]
+        beam = idv.shape[2]
+
+        def step(carry, t):
+            # carry: beam indices to follow at time t+1  [batch, beam]
+            sel = carry
+            out_t = jnp.take_along_axis(idv[t], sel, axis=1)
+            nxt = jnp.take_along_axis(par[t], sel, axis=1)
+            return nxt, out_t
+        init = jnp.tile(jnp.arange(beam)[None, :], (idv.shape[1], 1))
+        _, outs = jax.lax.scan(step, init, jnp.arange(tmax - 1, -1, -1))
+        return outs[::-1]
+    return apply_op("gather_tree", fn, [ids, parents])
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """reference: sparse_attention_op (block-sparse CSR attention). On TPU
+    the MXU wants dense tiles; the CSR pattern is honored as a mask over a
+    dense flash-style computation (XLA fuses the masked softmax), which is
+    the TPU-idiomatic equivalent for the shapes this op targets."""
+    def fn(q, k, v, offs, cols, *masks):
+        b, h, s, d = q.shape
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        nnz = cols.shape[-1]
+
+        def one_mask(off_bh, col_bh):
+            # CSR row of each nnz slot: searchsorted over the offset vector
+            row = jnp.searchsorted(off_bh.astype(jnp.int32),
+                                   jnp.arange(nnz), side="right") - 1
+            return jnp.zeros((s, s), bool).at[row, col_bh].set(True)
+        mask = jax.vmap(jax.vmap(one_mask))(offs, cols)      # [b, h, s, s]
+        logits = jnp.where(mask, logits, -1e30)
+        mi = 0
+        if key_padding_mask is not None:
+            kpm = masks[mi]; mi += 1                          # [b, s]
+            logits = jnp.where(kpm[:, None, None, :] != 0, logits, -1e30)
+        if attn_mask is not None:
+            am = masks[mi]; mi += 1                           # [s, s]-ish
+            logits = jnp.where(jnp.broadcast_to(am != 0, logits.shape),
+                               logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    extra = [m for m in (key_padding_mask, attn_mask) if m is not None]
+    return apply_op("sparse_attention", fn,
+                    [query, key, value, sparse_csr_offset,
+                     sparse_csr_columns] + extra)
+
+
+# --------------------------------------------- 3-D pools, unpool, fold, convT
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    fn, *_ = _pool(x, kernel_size, stride, padding, 3, lax.max,
+                   lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating)
+                   else jnp.iinfo(dt).min, data_format)
+    out = apply_op("max_pool3d", fn, [x])
+    if return_mask:
+        raise NotImplementedError("return_mask: use max_pool2d for unpool")
+    return out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    k = _norm_tuple(kernel_size, 3)
+    _, dims, strides, pads, _ = _pool(
+        x, kernel_size, stride, padding, 3, lax.add,
+        lambda dt: jnp.array(0, dt), data_format)
+
+    def fn(a):
+        ssum = lax.reduce_window(a, jnp.array(0, a.dtype), lax.add, dims,
+                                 strides, pads)
+        if divisor_override:
+            return ssum / divisor_override
+        if exclusive and not isinstance(pads, str):
+            ones = jnp.ones(a.shape, a.dtype)
+            cnt = lax.reduce_window(ones, jnp.array(0, a.dtype), lax.add,
+                                    dims, strides, pads)
+            return ssum / cnt
+        return ssum / math.prod(k)
+    return apply_op("avg_pool3d", fn, [x])
+
+
+def _adaptive_pool(x, output_size, n, op_name, reduce_fn):
+    outs = _norm_tuple(output_size, n)
+
+    def fn(a):
+        sp = a.shape[-n:]
+        if all(s % o == 0 for s, o in zip(sp, outs)):
+            shp = list(a.shape[:-n])
+            red_axes = []
+            for i, (s, o) in enumerate(zip(sp, outs)):
+                shp.extend([o, s // o])
+                red_axes.append(len(shp) - 1)
+            return reduce_fn(a.reshape(shp), tuple(red_axes))
+        # general bins (python loops over the static output size)
+        def bins(s, o):
+            return [(int(np.floor(i * s / o)), int(np.ceil((i + 1) * s / o)))
+                    for i in builtins.range(o)]
+        grids = [bins(s, o) for s, o in zip(sp, outs)]
+        import itertools
+        parts = jnp.stack([
+            reduce_fn(a[(...,) + tuple(builtins.slice(b0, b1)
+                                       for b0, b1 in combo)],
+                      tuple(builtins.range(a.ndim - n, a.ndim)))
+            for combo in itertools.product(*grids)], axis=-1)
+        return parts.reshape(a.shape[:-n] + tuple(outs))
+    return apply_op(op_name, fn, [x])
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "adaptive_avg_pool3d",
+                          lambda a, ax: a.mean(axis=ax))
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "adaptive_max_pool3d",
+                          lambda a, ax: a.max(axis=ax))
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "adaptive_max_pool1d",
+                          lambda a, ax: a.max(axis=ax))
+
+
+def _max_pool_with_index(x, kernel, n):
+    """Non-overlapping window max + flat argmax indices (the segnet-style
+    pool/unpool pair; reference max_pool{2,3}d return_mask + max_unpool).
+    Requires stride == kernel_size and divisible spatial dims — the case
+    the reference unpool targets."""
+    k = _norm_tuple(kernel, n)
+
+    def fn(a):
+        sp = a.shape[-n:]
+        if any(s % kk for s, kk in zip(sp, k)):
+            raise ValueError(
+                f"max_unpool path needs spatial {sp} divisible by kernel {k}")
+        lead = a.shape[:-n]
+        # reshape into window blocks: [..., o1, k1, o2, k2, ...]
+        shp = list(lead)
+        for s, kk in zip(sp, k):
+            shp.extend([s // kk, kk])
+        blocks = a.reshape(shp)
+        # move window dims last
+        nd = len(shp)
+        win_axes = [len(lead) + 2 * i + 1 for i in builtins.range(n)]
+        out_axes = [len(lead) + 2 * i for i in builtins.range(n)]
+        perm = list(builtins.range(len(lead))) + out_axes + win_axes
+        blk = blocks.transpose(perm)
+        flat_w = math.prod(k)
+        blk2 = blk.reshape(blk.shape[:len(lead) + n] + (flat_w,))
+        local = jnp.argmax(blk2, axis=-1)
+        vals = jnp.max(blk2, axis=-1)
+        # local window idx -> flat spatial idx of the input
+        outs = [s // kk for s, kk in zip(sp, k)]
+        local_coords = []
+        rem = local
+        for kk in reversed(k):
+            local_coords.append(rem % kk)
+            rem = rem // kk
+        local_coords = local_coords[::-1]
+        grids = jnp.meshgrid(*[jnp.arange(o) for o in outs], indexing="ij")
+        flat = jnp.zeros_like(local)
+        for i in builtins.range(n):
+            coord = grids[i] * k[i] + local_coords[i]
+            stride_i = math.prod(sp[i + 1:]) if i + 1 < n else 1
+            flat = flat + coord * stride_i
+        return vals, flat.astype(jnp.int32)
+    return fn
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """reference: max_unpool2d — scatter pooled values back to their argmax
+    positions (indices flat over H*W, as produced by max_pool2d
+    return_mask)."""
+    k = _norm_tuple(kernel_size, 2)
+    s = _norm_tuple(stride if stride is not None else kernel_size, 2)
+
+    def fn(a, idx):
+        n, c, oh, ow = a.shape
+        H = output_size[-2] if output_size else oh * s[0]
+        W = output_size[-1] if output_size else ow * s[1]
+        out = jnp.zeros((n, c, H * W), a.dtype)
+        flat_idx = idx.reshape(n, c, -1)
+        out = out.at[jnp.arange(n)[:, None, None],
+                     jnp.arange(c)[None, :, None], flat_idx].set(
+            a.reshape(n, c, -1))
+        return out.reshape(n, c, H, W)
+    return apply_op("max_unpool2d", fn, [x, indices])
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    st = k if stride is None else (stride if isinstance(stride, int) else stride[0])
+
+    def fn(a, idx):
+        n, c, ol = a.shape
+        L = output_size[-1] if output_size else ol * st
+        out = jnp.zeros((n, c, L), a.dtype)
+        return out.at[jnp.arange(n)[:, None, None],
+                      jnp.arange(c)[None, :, None], idx].set(a)
+    return apply_op("max_unpool1d", fn, [x, indices])
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    k = _norm_tuple(kernel_size, 3)
+    s = _norm_tuple(stride if stride is not None else kernel_size, 3)
+
+    def fn(a, idx):
+        n, c = a.shape[:2]
+        osp = a.shape[2:]
+        sp = (tuple(output_size[-3:]) if output_size
+              else tuple(o * ss for o, ss in zip(osp, s)))
+        out = jnp.zeros((n, c, math.prod(sp)), a.dtype)
+        out = out.at[jnp.arange(n)[:, None, None],
+                     jnp.arange(c)[None, :, None],
+                     idx.reshape(n, c, -1)].set(a.reshape(n, c, -1))
+        return out.reshape((n, c) + sp)
+    return apply_op("max_unpool3d", fn, [x, indices])
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im (reference: functional/common.py fold) — inverse of unfold,
+    overlaps sum."""
+    out_hw = _norm_tuple(output_sizes, 2)
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    p = _norm_tuple(paddings, 2)
+    d = _norm_tuple(dilations, 2)
+
+    def fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        oh = (out_hw[0] + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (out_hw[1] + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        cols = a.reshape(n, c, k[0], k[1], oh, ow)
+        H = out_hw[0] + 2 * p[0]
+        W = out_hw[1] + 2 * p[1]
+        out = jnp.zeros((n, c, H, W), a.dtype)
+        for i in builtins.range(k[0]):
+            for j in builtins.range(k[1]):
+                hi = i * d[0]
+                wj = j * d[1]
+                out = out.at[:, :, hi: hi + oh * s[0]: s[0],
+                             wj: wj + ow * s[1]: s[1]].add(cols[:, :, i, j])
+        return out[:, :, p[0]: H - p[0], p[1]: W - p[1]]
+    return apply_op("fold", fn, [x])
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    """1-D transposed conv via the 2-D path on a unit height axis."""
+    from ..core.ops import squeeze, unsqueeze
+    x4 = unsqueeze(x, 2)
+    w4 = apply_op("unsq_w", lambda w: w[:, :, None, :], [weight])
+    st = stride if isinstance(stride, int) else stride[0]
+    pd = padding if isinstance(padding, (int, str)) else padding[0]
+    op = output_padding if isinstance(output_padding, int) else output_padding[0]
+    dl = dilation if isinstance(dilation, int) else dilation[0]
+    out = conv2d_transpose(x4, w4, bias, stride=(1, st),
+                           padding=(0, pd) if not isinstance(pd, str) else pd,
+                           output_padding=(0, op), groups=groups,
+                           dilation=(1, dl))
+    return squeeze(out, 2)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    strides = _norm_tuple(stride, 3)
+    dil = _norm_tuple(dilation, 3)
+    pad = _norm_tuple(padding, 3) if not isinstance(padding, str) else padding
+    out_pad = _norm_tuple(output_padding, 3)
+
+    def fn(a, w, *b):
+        ks = w.shape[2:]
+        if isinstance(pad, str):
+            padding_cfg = pad.upper()
+        else:
+            padding_cfg = [
+                (dil[i] * (kk - 1) - pad[i],
+                 dil[i] * (kk - 1) - pad[i] + out_pad[i])
+                for i, kk in enumerate(ks)]
+        dn = ("NCDHW", "OIDHW", "NCDHW")
+        if groups == 1:
+            out = lax.conv_transpose(a, w, strides=strides,
+                                     padding=padding_cfg, rhs_dilation=dil,
+                                     dimension_numbers=dn,
+                                     transpose_kernel=True)
+        else:
+            xs = jnp.split(a, groups, axis=1)
+            ws = jnp.split(w, groups, axis=0)
+            out = jnp.concatenate(
+                [lax.conv_transpose(xi, wi, strides=strides,
+                                    padding=padding_cfg, rhs_dilation=dil,
+                                    dimension_numbers=dn,
+                                    transpose_kernel=True)
+                 for xi, wi in zip(xs, ws)], axis=1)
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1, 1)
+        return out
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply_op("conv3d_transpose", fn, args)

@@ -83,3 +83,16 @@ class Maxout(Layer):
             new_shape.insert(ax + 1, g)
             return a.reshape(new_shape).max(axis=ax + 1)
         return apply_op("maxout", fn, [x])
+
+
+class Softmax2D(Layer):
+    """reference: nn/layer/activation.py Softmax2D — softmax over the
+    channel axis of NCHW / CHW inputs."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        assert x.ndim in (3, 4), "Softmax2D expects CHW or NCHW"
+        from .. import functional as F
+        return F.softmax(x, axis=-3)

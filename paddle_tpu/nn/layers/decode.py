@@ -1,0 +1,148 @@
+"""Beam-search decoding (reference: python/paddle/nn/layer/rnn.py
+BeamSearchDecoder + python/paddle/nn/decode.py dynamic_decode).
+
+TPU note: decoding is a python-driven loop over steps (the reference's
+dynamic_decode while-loop); each step's cell call + beam bookkeeping is
+jnp/XLA work, so under @to_static the whole rollout traces into one program
+with a fixed max_step_num, the compiler-friendly form of the reference's
+dynamic while op.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+from ...core import ops
+from ..layer import Layer
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+class BeamSearchDecoder:
+    """reference: rnn.py BeamSearchDecoder (cell + embedding + projection)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- decoder protocol (initialize / step / finalize) ----------------
+    def initialize(self, initial_cell_states):
+        """Tile encoder states across beams; beam 0 live, others muted."""
+        k = self.beam_size
+
+        def tile(t):
+            return apply_op(
+                "beam_tile",
+                lambda a: jnp.repeat(a, k, axis=0), [t])
+        states = _map_structure(tile, initial_cell_states)
+        batch = _first_leaf(states).shape[0] // k
+        ids = ops.full([batch * k], self.start_token, "int64")
+        # log-prob 0 for beam 0, -inf for the rest: first expansion seeds
+        # distinct hypotheses instead of k copies
+        lp0 = np.full((batch, k), -1e9, np.float32)
+        lp0[:, 0] = 0.0
+        log_probs = Tensor(jnp.asarray(lp0.reshape(-1)))
+        finished = ops.zeros([batch * k], dtype="bool")
+        return ids, states, log_probs, finished
+
+    def step(self, inputs, states):
+        x = self.embedding_fn(inputs) if self.embedding_fn else inputs
+        cell_out, new_states = self.cell(x, states)
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+        return logits, new_states
+
+
+def _map_structure(fn, obj):
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_map_structure(fn, o) for o in obj)
+    return fn(obj)
+
+
+def _first_leaf(obj):
+    while isinstance(obj, (list, tuple)):
+        obj = obj[0]
+    return obj
+
+
+def _gather_beams(obj, beam_idx, batch, k):
+    """Reindex [batch*k, ...] structures by per-batch beam choices."""
+    def g(t):
+        def fn(a, bi):
+            a2 = a.reshape((batch, k) + a.shape[1:])
+            out = jnp.take_along_axis(
+                a2, bi.reshape(batch, k).astype(jnp.int32).reshape(
+                    (batch, k) + (1,) * (a2.ndim - 2)), axis=1)
+            return out.reshape((batch * k,) + a.shape[1:])
+        return apply_op("beam_gather", fn, [t, beam_idx])
+    return _map_structure(g, obj)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """reference: decode.py dynamic_decode — drive a decoder until all beams
+    finish or max_step_num. Returns (ids [batch, beam, T], final_log_probs)."""
+    assert max_step_num is not None, "max_step_num is required"
+    k = decoder.beam_size
+    end = decoder.end_token
+    ids, states, log_probs, finished = decoder.initialize(inits)
+    batch = ids.shape[0] // k
+    step_ids = []
+    lengths = ops.zeros([batch * k], dtype="int64")
+
+    for _ in range(int(max_step_num)):
+        logits, new_states = decoder.step(ids, states)
+
+        def expand(lg, lp, fin):
+            v = lg.shape[-1]
+            logp = jnp.log(jnp.maximum(1e-30, jnp.exp(
+                lg - jnp.max(lg, -1, keepdims=True)) /
+                jnp.sum(jnp.exp(lg - jnp.max(lg, -1, keepdims=True)),
+                        -1, keepdims=True)))
+            # finished beams only extend with end_token at no cost
+            mask = jnp.full((v,), -1e9).at[end].set(0.0)
+            logp = jnp.where(fin[:, None], mask[None, :], logp)
+            total = lp[:, None] + logp                      # [batch*k, v]
+            t2 = total.reshape(batch, k * v)
+            top_lp, top_idx = jax.lax.top_k(t2, k)           # one O(kV) pass
+            beam_idx = top_idx // v                          # [batch, k]
+            tok = (top_idx % v).astype(jnp.int64)
+            return (tok.reshape(-1), top_lp.reshape(-1),
+                    beam_idx.reshape(-1))
+
+        tok, log_probs, beam_idx = apply_op(
+            "beam_expand", expand, [logits, log_probs, finished],
+            n_outputs=3)
+        states = _gather_beams(new_states, beam_idx, batch, k)
+        finished = _gather_beams(finished, beam_idx, batch, k)
+        lengths = _gather_beams(lengths, beam_idx, batch, k)
+        prev_fin = finished
+
+        def update(fin, ln, tk):
+            now_end = tk.reshape(-1) == end
+            new_fin = jnp.logical_or(fin, now_end)
+            new_len = jnp.where(fin, ln, ln + 1)
+            return new_fin, new_len
+        finished, lengths = apply_op("beam_update", update,
+                                     [finished, lengths, tok], n_outputs=2)
+        step_ids = [_gather_beams(s, beam_idx, batch, k) for s in step_ids]
+        step_ids.append(tok)
+        ids = tok
+        if bool(np.all(np.asarray(finished._data))):
+            break
+
+    out = ops.stack(step_ids, axis=-1)                      # [batch*k, T]
+    out = ops.reshape(out, [batch, k, -1])
+    if output_time_major:
+        out = ops.transpose(out, [2, 0, 1])
+    lp = ops.reshape(log_probs, [batch, k])
+    if return_length:
+        return out, lp, ops.reshape(lengths, [batch, k])
+    return out, lp

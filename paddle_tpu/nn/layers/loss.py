@@ -143,3 +143,80 @@ class HingeEmbeddingLoss(Layer):
 
     def forward(self, input, label):  # noqa: A002
         return F.hinge_embedding_loss(input, label, self.margin, self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    """reference: nn/layer/loss.py MultiMarginLoss."""
+
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin, self.weight = p, margin, weight
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.multi_margin_loss(input, label, p=self.p, margin=self.margin,
+                                   weight=self.weight,
+                                   reduction=self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class RNNTLoss(Layer):
+    """reference: nn/layer/loss.py RNNTLoss (warprnnt)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank, self.fastemit_lambda = blank, fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):  # noqa: A002
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank,
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """reference: nn/layer/loss.py HSigmoidLoss — owns the inner-node
+    weight table [num_classes-1, feature_size]."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError("custom-tree hsigmoid not supported")
+        self.num_classes = num_classes
+        from .. import initializer as I
+        import math as _m
+        std = 1.0 / _m.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size],
+            default_initializer=I.Uniform(-std, std))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [num_classes - 1], is_bias=True,
+                default_initializer=I.Uniform(-std, std))
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)

@@ -1,0 +1,94 @@
+"""paddle.signal namespace (reference: python/paddle/signal.py — stft/istft
+built on frame/overlap_add ops). TPU-native: expressed as jnp strided
+framing + rfft; XLA lowers both to fused gathers + batched FFT custom calls.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, apply_op
+
+__all__ = ["stft", "istft"]
+
+
+def _frame(a, frame_length, hop):
+    n_frames = 1 + (a.shape[-1] - frame_length) // hop
+    idx = (np.arange(frame_length)[None, :] +
+           hop * np.arange(n_frames)[:, None])
+    return a[..., idx]          # [..., n_frames, frame_length]
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """reference: signal.py stft — returns [..., n_fft//2+1, n_frames]
+    complex (onesided) like the reference."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    warr = None if window is None else (
+        window._data if isinstance(window, Tensor) else jnp.asarray(window))
+
+    def fn(a, *w):
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        frames = _frame(a, n_fft, hop)              # [..., T, n_fft]
+        if w:
+            win = w[0]
+            if wl < n_fft:   # center-pad window to n_fft
+                lp = (n_fft - wl) // 2
+                win = jnp.pad(win, (lp, n_fft - wl - lp))
+            frames = frames * win
+        sp = jnp.fft.rfft(frames, axis=-1) if onesided else \
+            jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            sp = sp / np.sqrt(n_fft)
+        return jnp.swapaxes(sp, -1, -2)             # [..., freq, T]
+    args = [x] + ([Tensor(warr)] if warr is not None else [])
+    return apply_op("stft", fn, args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """reference: signal.py istft — overlap-add inverse with window
+    normalization (COLA)."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    warr = None if window is None else (
+        window._data if isinstance(window, Tensor) else jnp.asarray(window))
+
+    def fn(sp, *w):
+        sp = jnp.swapaxes(sp, -1, -2)               # [..., T, freq]
+        if normalized:
+            sp = sp * np.sqrt(n_fft)
+        frames = jnp.fft.irfft(sp, n=n_fft, axis=-1) if onesided else \
+            jnp.fft.ifft(sp, axis=-1).real
+        if w:
+            win = w[0]
+            if wl < n_fft:
+                lp = (n_fft - wl) // 2
+                win = jnp.pad(win, (lp, n_fft - wl - lp))
+        else:
+            win = jnp.ones((n_fft,), frames.dtype)
+        frames = frames * win
+        T = frames.shape[-2]
+        out_len = n_fft + hop * (T - 1)
+        lead = frames.shape[:-2]
+        out = jnp.zeros(lead + (out_len,), frames.dtype)
+        wsum = jnp.zeros((out_len,), frames.dtype)
+        for t in range(T):     # static unroll: T known at trace time
+            sl = slice(t * hop, t * hop + n_fft)
+            out = out.at[..., sl].add(frames[..., t, :])
+            wsum = wsum.at[sl].add(win * win)
+        out = out / jnp.maximum(wsum, 1e-11)
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:out_len - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    args = [x] + ([Tensor(warr)] if warr is not None else [])
+    return apply_op("istft", fn, args)

