@@ -85,3 +85,28 @@ class PyLayer(metaclass=PyLayerMeta):
                 o._out_idx = i
                 o.stop_gradient = False
         return tuple(out_list) if multi else out_list[0]
+
+
+class saved_tensors_hooks:  # noqa: N801 — reference name
+    """reference: autograd/saved_tensors_hooks — pack/unpack hooks around
+    tensors saved for backward. The tape saves activations inside vjp
+    closures; hooks fire around PyLayer ctx.save_for_backward and are the
+    user-visible contract (e.g. offload-to-host packs)."""
+
+    _stack = []
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        saved_tensors_hooks._stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._stack.pop()
+        return False
+
+    @classmethod
+    def current(cls):
+        return cls._stack[-1] if cls._stack else None

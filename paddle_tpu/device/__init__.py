@@ -71,3 +71,100 @@ def cuda_empty_cache():
     pass
 
 from . import cuda  # noqa: E402,F401
+
+
+# -------- surface completion (reference: python/paddle/device/__init__.py)
+
+class Event:
+    """reference: device.Event — cross-stream sync marker. XLA owns
+    scheduling (SURVEY §7 StreamSafe row): record/query/synchronize map to
+    program-order completion."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream()
+
+
+def set_stream(stream):
+    return stream
+
+
+def stream_guard(stream):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+def XPUPlace(dev_id=0):  # noqa: N802
+    from ..fluid import XPUPlace as _x
+    return _x(dev_id)
+
+
+def IPUPlace():  # noqa: N802
+    raise RuntimeError("IPU backend is not available in paddle_tpu")
+
+
+def MLUPlace(dev_id=0):  # noqa: N802
+    raise RuntimeError("MLU backend is not available in paddle_tpu")
+
+
+def get_cudnn_version():
+    return None  # no cuDNN in the TPU stack
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    return False
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []

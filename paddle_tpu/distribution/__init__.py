@@ -27,3 +27,56 @@ __all__ = [
     "StickBreakingTransform", "TanhTransform", "kl_divergence", "register_kl",
     "transform",
 ]
+
+from .distribution import Distribution as _D
+
+
+class Independent(_D):
+    """reference: distribution/independent.py — reinterprets `n` rightmost
+    batch dims of a base distribution as event dims (sums log_prob over
+    them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+        batch = tuple(getattr(base, "batch_shape", ()) or ())
+        if self._rank > len(batch):
+            raise ValueError(
+                f"reinterpreted_batch_rank {self._rank} exceeds base batch "
+                f"rank {len(batch)}")
+        split = len(batch) - self._rank
+        super().__init__(batch_shape=batch[:split],
+                         event_shape=batch[split:] + tuple(
+                             getattr(base, "event_shape", ()) or ()))
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def log_prob(self, value):
+        from ..core import ops
+        lp = self._base.log_prob(value)
+        for _ in range(self._rank):
+            lp = ops.sum(lp, axis=-1)
+        return lp
+
+    def entropy(self):
+        from ..core import ops
+        e = self._base.entropy()
+        for _ in range(self._rank):
+            e = ops.sum(e, axis=-1)
+        return e
+
+    def prob(self, value):
+        from ..core import ops
+        return ops.exp(self.log_prob(value))

@@ -7,3 +7,9 @@ from .nn.functional import fused_matmul_bias  # noqa: F401
 
 from . import asp  # noqa: E402,F401
 from . import autograd  # noqa: E402,F401
+from .ops import (  # noqa: E402,F401
+    segment_sum, segment_mean, segment_max, segment_min, graph_send_recv,
+    graph_sample_neighbors, graph_khop_sampler, graph_reindex,
+    softmax_mask_fuse, softmax_mask_fuse_upper_triangle, identity_loss, unzip,
+)
+from .optimizer.lookahead import LookAhead, ModelAverage  # noqa: E402,F401

@@ -3,3 +3,52 @@ from .fused_transformer import (  # noqa: F401
     FusedMultiTransformer, FusedEcMoe,
 )
 from . import functional  # noqa: F401
+from ...nn.layer import Layer as _Layer
+
+
+class FusedLinear(_Layer):
+    """reference: incubate/nn/layer/fused_linear.py — Linear through the
+    fused matmul+bias op."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        from ...nn import initializer as I
+        self.transpose_weight = transpose_weight
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = self.create_parameter(
+            shape, default_initializer=I.XavierNormal())
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_features], is_bias=True,
+                default_initializer=I.Constant(0.0))
+
+    def forward(self, x):
+        from .functional import fused_linear
+        return fused_linear(x, self.weight, self.bias,
+                            transpose_weight=self.transpose_weight)
+
+
+class FusedBiasDropoutResidualLayerNorm(_Layer):
+    """reference: incubate/nn/layer/fused_dropout_add.py analog — owns the
+    LN scale/shift for the fused bias+dropout+residual+layernorm op."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ...nn import initializer as I
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], is_bias=True, default_initializer=I.Constant(0.0))
+
+    def forward(self, x, residual, bias=None):
+        from .functional import fused_bias_dropout_residual_layer_norm
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, bias, self.ln_scale, self.ln_bias,
+            dropout_rate=self.dropout_rate if self.training else 0.0,
+            ln_epsilon=self.epsilon)

@@ -1,0 +1,193 @@
+"""incubate op surface (reference: python/paddle/incubate/__init__.py —
+segment ops, graph message-passing ops, fused softmax-mask, misc)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "graph_send_recv", "graph_sample_neighbors", "graph_khop_sampler",
+    "graph_reindex", "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+    "identity_loss", "unzip",
+]
+
+
+def _segment(op_label, jax_fn):
+    def op(data, segment_ids, name=None):
+        ids_np = np.asarray(segment_ids._data if isinstance(segment_ids, Tensor)
+                            else segment_ids)
+        n = int(ids_np.max()) + 1 if ids_np.size else 0
+
+        def fn(d, ids):
+            return jax_fn(d, ids, num_segments=n)
+        return apply_op(op_label, fn, [data, segment_ids])
+    op.__name__ = op_label
+    return op
+
+
+segment_sum = _segment("segment_sum", jax.ops.segment_sum)
+segment_mean = _segment(
+    "segment_mean",
+    lambda d, ids, num_segments: jax.ops.segment_sum(d, ids, num_segments) /
+    jnp.maximum(jax.ops.segment_sum(jnp.ones(d.shape[:1], d.dtype), ids,
+                                    num_segments), 1.0).reshape(
+        (-1,) + (1,) * (d.ndim - 1)))
+segment_max = _segment("segment_max", jax.ops.segment_max)
+segment_min = _segment("segment_min", jax.ops.segment_min)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """reference: incubate.graph_send_recv — gather x rows at src, scatter-
+    reduce to dst (GNN message passing). pool: sum|mean|max|min."""
+    ids_np = np.asarray(dst_index._data if isinstance(dst_index, Tensor)
+                        else dst_index)
+    n = out_size or (int(np.asarray(
+        x._data if isinstance(x, Tensor) else x).shape[0]))
+    red = {"sum": jax.ops.segment_sum, "mean": None,
+           "max": jax.ops.segment_max, "min": jax.ops.segment_min}[pool_type]
+
+    def fn(xa, si, di):
+        msgs = xa[si]
+        if pool_type == "mean":
+            s = jax.ops.segment_sum(msgs, di, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones(msgs.shape[:1], xa.dtype), di,
+                                      num_segments=n)
+            return s / jnp.maximum(cnt, 1.0).reshape(
+                (-1,) + (1,) * (s.ndim - 1))
+        out = red(msgs, di, num_segments=n)
+        if pool_type in ("max", "min"):
+            out = jnp.where(jnp.isfinite(out), out, 0.0)
+        return out
+    return apply_op("graph_send_recv", fn, [x, src_index, dst_index])
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           eids=None, return_eids=False, perm_buffer=None,
+                           flag_perm_buffer=False, name=None):
+    """reference: incubate.graph_sample_neighbors over CSC (colptr/row).
+    Host-side sampling (the reference's CPU kernel path); returns
+    (out_neighbors, out_count)."""
+    rown = np.asarray(row._data if isinstance(row, Tensor) else row)
+    cp = np.asarray(colptr._data if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes._data if isinstance(input_nodes, Tensor)
+                       else input_nodes).reshape(-1)
+    rng = np.random.RandomState(
+        int(jax.random.randint(_split_key(), (), 0, 2**31 - 1)))
+    neigh, counts = [], []
+    for nd in nodes.tolist():
+        s, e = int(cp[nd]), int(cp[nd + 1])
+        cand = rown[s:e]
+        if sample_size >= 0 and len(cand) > sample_size:
+            cand = rng.choice(cand, sample_size, replace=False)
+        neigh.append(cand)
+        counts.append(len(cand))
+    out = np.concatenate(neigh) if neigh else np.empty(0, rown.dtype)
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """reference: incubate.graph_khop_sampler — multi-hop expansion.
+    Returns (edge_src, edge_dst, sample_index, reindex_nodes)."""
+    frontier = np.asarray(input_nodes._data if isinstance(input_nodes, Tensor)
+                          else input_nodes).reshape(-1)
+    all_src, all_dst = [], []
+    seen = list(frontier.tolist())
+    for k in sample_sizes:
+        nb, cnt = graph_sample_neighbors(row, colptr,
+                                         Tensor(jnp.asarray(frontier)), k)
+        nb_np = np.asarray(nb._data)
+        cnt_np = np.asarray(cnt._data)
+        dst = np.repeat(frontier, cnt_np)
+        all_src.append(nb_np)
+        all_dst.append(dst)
+        frontier = np.unique(nb_np)
+        seen.extend(frontier.tolist())
+    src = np.concatenate(all_src) if all_src else np.empty(0, np.int64)
+    dst = np.concatenate(all_dst) if all_dst else np.empty(0, np.int64)
+    uniq = np.asarray(sorted(set(seen)), np.int64)
+    remap = {int(v): i for i, v in enumerate(uniq)}
+    r_src = np.asarray([remap[int(v)] for v in src], np.int64)
+    r_dst = np.asarray([remap[int(v)] for v in dst], np.int64)
+    return (Tensor(jnp.asarray(r_src)), Tensor(jnp.asarray(r_dst)),
+            Tensor(jnp.asarray(uniq)),
+            Tensor(jnp.asarray(np.arange(len(uniq), dtype=np.int64))))
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """reference: incubate.graph_reindex — contiguous relabeling of
+    (x, neighbors) ids. Returns (reindexed_src, reindexed_dst, out_nodes)."""
+    xa = np.asarray(x._data if isinstance(x, Tensor) else x).reshape(-1)
+    nb = np.asarray(neighbors._data if isinstance(neighbors, Tensor)
+                    else neighbors).reshape(-1)
+    cnt = np.asarray(count._data if isinstance(count, Tensor)
+                     else count).reshape(-1)
+    order = []
+    seen = set()
+    for v in np.concatenate([xa, nb]).tolist():
+        if v not in seen:
+            seen.add(v)
+            order.append(v)
+    remap = {v: i for i, v in enumerate(order)}
+    r_nb = np.asarray([remap[int(v)] for v in nb], np.int64)
+    dst = np.repeat(xa, cnt)
+    r_dst = np.asarray([remap[int(v)] for v in dst], np.int64)
+    out_nodes = np.asarray(order, np.int64)
+    return (Tensor(jnp.asarray(r_nb)), Tensor(jnp.asarray(r_dst)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference: incubate.softmax_mask_fuse (fused_softmax_mask op,
+    SURVEY §5.7) — softmax(x + mask) in one fusion."""
+    def fn(a, m):
+        return jax.nn.softmax(a.astype(jnp.float32) + m.astype(jnp.float32),
+                              axis=-1).astype(a.dtype)
+    return apply_op("softmax_mask_fuse", fn, [x, mask])
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """reference: fused_softmax_mask_upper_triangle — causal-masked softmax
+    (the attention-score path of the reference's fused attention)."""
+    def fn(a):
+        s_q, s_k = a.shape[-2], a.shape[-1]
+        cmask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        logits = jnp.where(cmask, a.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(logits, axis=-1).astype(a.dtype)
+    return apply_op("softmax_mask_fuse_upper_triangle", fn, [x])
+
+
+def identity_loss(x, reduction="none", name=None):
+    """reference: incubate.identity_loss (IPU-era loss marker)."""
+    from ..core import ops as _ops
+    if reduction in (0, "sum"):
+        return _ops.sum(x)
+    if reduction in (1, "mean"):
+        return _ops.mean(x)
+    return x
+
+
+def unzip(input, lod, len_=None, name=None):  # noqa: A002
+    """reference: incubate.operators.unzip — scatter rows back to lod
+    offsets (sparse-feature widening)."""
+    arr = np.asarray(input._data if isinstance(input, Tensor) else input)
+    lod_np = np.asarray(lod._data if isinstance(lod, Tensor) else lod)
+    n = int(lod_np[-1])
+    out = np.zeros((n,) + arr.shape[1:], arr.dtype)
+    for i in range(len(lod_np) - 1):
+        s, e = int(lod_np[i]), int(lod_np[i + 1])
+        if e > s:
+            out[s:e] = arr[i]
+    return Tensor(jnp.asarray(out))
+
+
+def _split_key():
+    from ..core import random as _r
+    return _r.split_key()

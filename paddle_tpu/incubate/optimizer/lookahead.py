@@ -1,0 +1,125 @@
+"""LookAhead and ModelAverage wrapper optimizers (reference:
+python/paddle/incubate/optimizer/{lookahead.py,modelaverage.py}).
+
+Both wrap an inner optimizer: LookAhead keeps slow weights updated every k
+steps toward the fast weights; ModelAverage maintains a running average of
+parameters applied at eval time.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    """reference: lookahead.py — slow = slow + alpha * (fast - slow) every
+    k inner steps."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = {}
+        # delegate bookkeeping to the inner optimizer
+        self._parameters = inner_optimizer._parameters
+        self._grad_clip = inner_optimizer._grad_clip
+        self._weight_decay = inner_optimizer._weight_decay
+        self._lr = inner_optimizer._lr
+        self._states = {}
+        self._accumulated_grads = {}
+
+    def get_lr(self):
+        return self.inner.get_lr()
+
+    def set_lr(self, lr):
+        return self.inner.set_lr(lr)
+
+    def _wd_for(self, p):
+        return self.inner._wd_for(p)
+
+    def init_state(self, param):
+        st = self.inner.init_state(param)
+        st = dict(st)
+        st["slow"] = param.astype(jnp.float32)
+        st["la_count"] = jnp.zeros((), jnp.int32)
+        return st
+
+    def update(self, param, grad, state, lr, step, wd=0.0):
+        inner_state = {k: v for k, v in state.items()
+                       if k not in ("slow", "la_count")}
+        new_p, new_inner = self.inner.update(param, grad, inner_state, lr,
+                                             step, wd)
+        cnt = state["la_count"] + 1
+        sync = (cnt % self.k) == 0
+        slow = state["slow"]
+        merged = slow + self.alpha * (new_p.astype(jnp.float32) - slow)
+        new_slow = jnp.where(sync, merged, slow)
+        new_p = jnp.where(sync, merged.astype(new_p.dtype), new_p)
+        out = dict(new_inner)
+        out["slow"] = new_slow
+        out["la_count"] = cnt
+        return new_p, out
+
+    def step(self):
+        return Optimizer.step(self)
+
+    def clear_grad(self, set_to_zero=True):
+        return self.inner.clear_grad(set_to_zero)
+
+
+class ModelAverage(Optimizer):
+    """reference: modelaverage.py — running parameter average; apply()/
+    restore() swap averaged weights in for evaluation."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self.rate = average_window_rate
+        self._sums = {}
+        self._counts = {}
+        self._backup = {}
+
+    def init_state(self, param):
+        return {}
+
+    def update(self, param, grad, state, lr, step, wd=0.0):
+        return param, state
+
+    def step(self):
+        """Accumulate the current parameter values into the average."""
+        for p in self._param_list:
+            s = self._sums.get(id(p))
+            arr = np.asarray(p._data, np.float32)
+            self._sums[id(p)] = arr if s is None else s + arr
+            self._counts[id(p)] = self._counts.get(id(p), 0) + 1
+
+    def minimize(self, loss=None, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            for p in self._param_list:
+                if id(p) in self._sums and self._counts.get(id(p)):
+                    self._backup[id(p)] = p._data
+                    avg = self._sums[id(p)] / self._counts[id(p)]
+                    p._data = jnp.asarray(avg.astype(
+                        np.asarray(p._data).dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+        return _ctx()
+
+    def restore(self, executor=None):
+        for p in self._param_list:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))

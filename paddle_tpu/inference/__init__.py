@@ -214,3 +214,82 @@ def get_version() -> str:
 PrecisionType = type("PrecisionType", (), {"Float32": 0, "Half": 1, "Int8": 2,
                                            "Bfloat16": 3})
 PlaceType = type("PlaceType", (), {"CPU": 0, "GPU": 1, "XPU": 2, "CUSTOM": 3})
+
+
+# ---- surface completion (reference: paddle/inference/__init__.py) ----
+
+class DataType:
+    """reference: paddle_infer.DataType enum."""
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    INT32 = "int32"
+    INT64 = "int64"
+    UINT8 = "uint8"
+    INT8 = "int8"
+    BOOL = "bool"
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    import numpy as np
+    return int(np.dtype(str(dtype).replace("DataType.", "").lower()).itemsize)
+
+
+class PredictorPool:
+    """reference: paddle_infer.PredictorPool — N predictors sharing one
+    loaded artifact (clone() shares weights here)."""
+
+    def __init__(self, config, size: int = 1):
+        first = create_predictor(config)
+        self._preds = [first] + [first.clone() for _ in range(size - 1)]
+
+    def retrive(self, idx: int):  # reference spells it 'retrive'
+        return self._preds[idx]
+
+    retrieve = retrive
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision="bfloat16",
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """reference: convert_to_mixed_precision — rewrite a saved artifact's
+    params to a lower precision (bf16-native here; the XLA artifact recompiles
+    at load with the narrow dtype)."""
+    import numpy as np
+    from ..framework.io import load as _load, save as _save
+    state = _load(params_file)
+    dt = np.dtype("bfloat16" if mixed_precision in ("bfloat16", "bf16")
+                  else mixed_precision)
+    try:
+        import ml_dtypes  # numpy bf16 support ships with jax
+        if dt == np.dtype("bfloat16"):
+            dt = ml_dtypes.bfloat16
+    except ImportError:
+        pass
+    black = set(black_list or ())
+    out = {}
+    for k, v in state.items():
+        arr = np.asarray(v)
+        if k not in black and arr.dtype in (np.float32, np.float64):
+            arr = arr.astype(dt)
+        out[k] = arr
+    import shutil
+    if model_file != mixed_model_file:
+        shutil.copy(model_file, mixed_model_file)
+    _save(out, mixed_params_file)
+
+
+def get_trt_compile_version():
+    """No TensorRT in the TPU stack (XLA owns codegen; SURVEY §2.4
+    N/A-by-design row)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name: str) -> str:
+    """reference: internal helper mapping fluid op names to phi kernels;
+    here ops ARE their kernel (one XLA lowering per op)."""
+    return op_name

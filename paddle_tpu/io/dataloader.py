@@ -77,6 +77,7 @@ def _worker_init(dataset, collate_in_worker, worker_init_fn, counter,
         wid = counter.value % num_workers
         counter.value += 1
     _worker_state["worker_id"] = wid
+    _worker_state["num_workers"] = num_workers
     if worker_init_fn is not None:
         worker_init_fn(wid)
 
@@ -237,3 +238,24 @@ class DataLoader:
                     pool.terminate()
                     pool.join()
         return gen()
+
+
+class WorkerInfo:
+    """reference: io.get_worker_info — id/num_workers/dataset of the calling
+    worker; None in the main process."""
+
+    def __init__(self, id, num_workers, dataset):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, num_workers={self.num_workers})")
+
+
+def get_worker_info():
+    if "worker_id" not in _worker_state:
+        return None
+    return WorkerInfo(_worker_state["worker_id"],
+                      _worker_state.get("num_workers", 1),
+                      _worker_state.get("dataset"))

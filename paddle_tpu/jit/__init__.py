@@ -3,3 +3,25 @@ from .api import to_static, not_to_static, StaticFunction, InputSpec, ignore_mod
 from . import dy2static  # noqa: F401
 from .train_step import TrainStep  # noqa: F401
 from .save_load import save, load, TranslatedLayer  # noqa: F401
+
+_to_static_enabled = True
+_code_level = 0
+_verbosity = 0
+
+
+def enable_to_static(enable: bool = True):
+    """reference: jit.enable_to_static — global on/off switch; StaticFunction
+    falls through to eager when disabled."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference: dy2static debug — level>0 prints transformed code."""
+    global _code_level
+    _code_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _verbosity
+    _verbosity = level

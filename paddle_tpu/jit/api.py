@@ -136,6 +136,9 @@ class StaticFunction:
         return params, buffers
 
     def __call__(self, *args, **kwargs):
+        from . import _to_static_enabled
+        if not _to_static_enabled:
+            return self._original_fn(*args, **kwargs)
         params, buffers = self._params_and_buffers()
         arg_arrays = _tree_unwrap(args)
         kw_arrays = _tree_unwrap(kwargs)

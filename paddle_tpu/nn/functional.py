@@ -231,6 +231,8 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     if return_mask:
         # segnet-style pool/unpool pair: non-overlapping windows
         st = stride if stride is not None else kernel_size
+        if data_format != "NCHW":
+            raise NotImplementedError("return_mask supports NCHW only")
         if _norm_tuple(st, 2) != _norm_tuple(kernel_size, 2) or padding != 0:
             raise NotImplementedError(
                 "return_mask supports the unpool case: stride == "
@@ -1230,8 +1232,7 @@ def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
         mask = jnp.arange(c)[None, :] != y[:, None]
         return _reduce_loss(jnp.where(mask, diff, 0.0).sum(axis=1) / c,
                             reduction)
-    return apply_op("multi_margin_loss", fn, [input, label] +
-                    ([weight] if weight is not None else []))
+    return apply_op("multi_margin_loss", fn, args)
 
 
 def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
@@ -1323,7 +1324,7 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
 
 
 def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
-              fastemit_lambda=0.001, reduction="mean", name=None):
+              fastemit_lambda=0.0, reduction="mean", name=None):
     """reference: warprnnt_op — RNN-T transducer loss. Forward-variable
     (alpha) dynamic program over the [T, U] lattice as nested lax.scans,
     fully on-device and differentiable by jax AD (the reference backprops

@@ -247,3 +247,37 @@ def profile(**kwargs):
         yield p
     finally:
         p.stop()
+
+
+class SortedKeys(enum.Enum):
+    """reference: profiler/profiler_statistic.py SortedKeys — summary sort
+    orders."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(enum.Enum):
+    """reference: profiler SummaryView — which table summary() prints."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def load_profiler_result(filename: str):
+    """reference: profiler.load_profiler_result — reload an exported trace
+    (the chrome-tracing JSON this profiler writes)."""
+    import json
+    with open(filename) as f:
+        return json.load(f)

@@ -310,6 +310,18 @@ def _value_unary(name, fn):
 
 
 relu = _value_unary("relu", jax.nn.relu)
+sinh = _value_unary("sinh", jnp.sinh)
+asin = _value_unary("asin", jnp.arcsin)
+asinh = _value_unary("asinh", jnp.arcsinh)
+atan = _value_unary("atan", jnp.arctan)
+atanh = _value_unary("atanh", jnp.arctanh)
+tan = _value_unary("tan", jnp.tan)
+expm1 = _value_unary("expm1", jnp.expm1)
+log1p = _value_unary("log1p", jnp.log1p)
+square = _value_unary("square", jnp.square)
+neg = _value_unary("neg", jnp.negative)
+deg2rad = _value_unary("deg2rad", jnp.deg2rad)
+rad2deg = _value_unary("rad2deg", jnp.rad2deg)
 relu6 = _value_unary("relu6", lambda a: jnp.clip(a, 0, 6))
 leaky_relu = _value_unary("leaky_relu", lambda a: jax.nn.leaky_relu(a, 0.01))
 sin = _value_unary("sin", jnp.sin)
@@ -353,3 +365,59 @@ def _as_t(x):
 
 
 from . import nn  # noqa: E402,F401
+
+
+def is_same_shape(x, y) -> bool:
+    """reference: sparse.is_same_shape."""
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def coalesce(x, name=None):
+    """reference: sparse.coalesce — merge duplicate COO indices (sum
+    values), sort lexicographically."""
+    import numpy as np
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("coalesce expects a SparseCooTensor")
+    idx = np.asarray(x.indices()._data)
+    vals = x.values()._data
+    nd, nnz = idx.shape
+    dims = tuple(int(s) for s in x.shape[:nd])
+    flat = np.ravel_multi_index(tuple(idx), dims)
+    uniq, inv = np.unique(flat, return_inverse=True)
+
+    def merge(v):
+        import jax
+        seg = jax.ops.segment_sum(v, jnp.asarray(inv), num_segments=len(uniq))
+        return seg
+    merged = apply_op("coalesce_values", merge, [x.values()])
+    new_idx = np.stack(np.unravel_index(uniq, dims)).astype(idx.dtype)
+    return sparse_coo_tensor(new_idx, merged, shape=tuple(x.shape))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """reference: sparse.addmm — beta*input + alpha*(x @ y), sparse x."""
+    out = matmul(x, y)
+    from ..core import ops as _ops
+    return _ops.add(_ops.scale(input, beta), _ops.scale(_as_plain(out), alpha))
+
+
+def reshape(x, shape, name=None):
+    """reference: sparse.reshape — COO index remap through flat offsets."""
+    import numpy as np
+    if isinstance(x, SparseCsrTensor):
+        raise NotImplementedError("sparse.reshape supports COO")
+    old = tuple(int(s) for s in x.shape)
+    new = []
+    neg = -1
+    total = int(np.prod(old))
+    for i, s in enumerate(shape):
+        new.append(int(s))
+        if int(s) == -1:
+            neg = i
+    if neg >= 0:
+        known = -int(np.prod(new))
+        new[neg] = total // known
+    idx = np.asarray(x.indices()._data)
+    flat = np.ravel_multi_index(tuple(idx), old)
+    new_idx = np.stack(np.unravel_index(flat, tuple(new))).astype(idx.dtype)
+    return sparse_coo_tensor(new_idx, x.values(), shape=tuple(new))

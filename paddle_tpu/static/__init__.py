@@ -183,9 +183,13 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,  # noqa: A002
 
 
 def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
-    """reference: fluid layers exponential_decay -> lr scheduler."""
+    """reference: fluid layers exponential_decay — lr * decay_rate^(t/N).
+    The per-scheduler-step gamma is decay_rate^(1/decay_steps) so the rate
+    drops by decay_rate exactly every decay_steps steps (the smooth,
+    non-staircase form)."""
     from ..optimizer.lr import ExponentialDecay
-    return ExponentialDecay(gamma=decay_rate, learning_rate=learning_rate)
+    return ExponentialDecay(gamma=float(decay_rate) ** (1.0 / decay_steps),
+                            learning_rate=learning_rate)
 
 
 class ExponentialMovingAverage:

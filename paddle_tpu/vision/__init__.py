@@ -8,3 +8,34 @@ from .models import *  # noqa: F401,F403
 from .models import __all__ as _models_all
 
 __all__ = ["models", "transforms", "datasets", "ops"] + list(_models_all)
+
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str):
+    """reference: vision/image.py — 'pil' | 'cv2' | 'tensor'; only pil/
+    numpy paths exist in this environment."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """reference: vision/image.py image_load."""
+    b = backend or _image_backend
+    if b == "cv2":
+        raise RuntimeError("cv2 is not available in this environment")
+    from PIL import Image
+    img = Image.open(path)
+    if b == "tensor":
+        import numpy as np
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(np.asarray(img)))
+    return img

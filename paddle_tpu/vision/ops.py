@@ -6,9 +6,11 @@ suppression (compile-friendly static shapes), not a dynamic loop.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
-from ..core.tensor import Tensor
+from ..core.tensor import Tensor, apply_op
+from ..nn.layer import Layer
 
 __all__ = ["yolo_box", "box_coder", "nms", "roi_align", "roi_pool",
            "distribute_fpn_proposals", "box_iou"]
@@ -233,3 +235,429 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
         pos += len(idx)
         nums.append(Tensor(jnp.asarray([len(idx)], jnp.int32)))
     return outs, Tensor(jnp.asarray(restore, jnp.int32)), nums
+
+
+# ---------------------------------------------------------------------------
+# Surface completion (reference: python/paddle/vision/ops.py __all__).
+
+class RoIAlign(Layer):
+    """reference: vision/ops.py RoIAlign layer over roi_align."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """reference: psroi_pool op (position-sensitive RoI pooling, R-FCN):
+    input channels C = out_c * oh * ow; bin (i, j) pools its OWN channel
+    group — avg pooled."""
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else output_size)
+    import jax.numpy as jnp
+
+    def fn(xa, ba, bn):
+        n, c, H, W = xa.shape
+        out_c = c // (oh * ow)
+        n_rois = ba.shape[0]
+        img_of_roi = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                                total_repeat_length=n_rois)
+        outs = []
+        ys = jnp.arange(oh)
+        xs = jnp.arange(ow)
+
+        def one_roi(r):
+            img = xa[img_of_roi[r]]
+            x1, y1, x2, y2 = [ba[r, k] * spatial_scale for k in range(4)]
+            rh = jnp.maximum(y2 - y1, 1.0) / oh
+            rw = jnp.maximum(x2 - x1, 1.0) / ow
+            def one_bin(i, j):
+                grp = img.reshape(out_c, oh * ow, H, W)[:, i * ow + j]
+                ys0 = jnp.clip(jnp.floor(y1 + i * rh).astype(jnp.int32), 0, H - 1)
+                ys1 = jnp.clip(jnp.ceil(y1 + (i + 1) * rh).astype(jnp.int32), 1, H)
+                xs0 = jnp.clip(jnp.floor(x1 + j * rw).astype(jnp.int32), 0, W - 1)
+                xs1 = jnp.clip(jnp.ceil(x1 + (j + 1) * rw).astype(jnp.int32), 1, W)
+                # dynamic region avg via masked mean (static shapes for XLA)
+                yy = jnp.arange(H)[:, None]
+                xx = jnp.arange(W)[None, :]
+                m = ((yy >= ys0) & (yy < ys1) & (xx >= xs0) & (xx < xs1))
+                s = (grp * m[None]).sum(axis=(1, 2))
+                cnt = jnp.maximum(m.sum(), 1)
+                return s / cnt
+            bins = jnp.stack([jnp.stack([one_bin(i, j) for j in range(ow)], -1)
+                              for i in range(oh)], -2)   # [out_c, oh, ow]
+            return bins
+        return jax.vmap(one_roi)(jnp.arange(n_rois))
+    return apply_op("psroi_pool", fn, [x, boxes, boxes_num])
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """reference: deform_conv2d (DCNv1; DCNv2 with mask) — bilinear sampling
+    at offset-shifted taps, then a dense 1x1-style contraction. TPU mapping:
+    the gather+interp is jnp vectorized; the contraction is one einsum on
+    the MXU."""
+    import jax.numpy as jnp
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    args = [x, offset, weight] + ([bias] if bias is not None else []) + \
+        ([mask] if mask is not None else [])
+    has_bias = bias is not None
+    has_mask = mask is not None
+
+    def fn(xa, off, w, *rest):
+        b = 0
+        bias_a = rest[0] if has_bias else None
+        mask_a = rest[-1] if has_mask else None
+        n, cin, H, W = xa.shape
+        cout, cin_g, kh, kw = w.shape
+        oh = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        ow = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        xp = jnp.pad(xa, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+        Hp, Wp = xp.shape[2], xp.shape[3]
+        # base sampling grid per output position and tap
+        ys = jnp.arange(oh) * s[0]
+        xs = jnp.arange(ow) * s[1]
+        ky = jnp.arange(kh) * d[0]
+        kx = jnp.arange(kw) * d[1]
+        base_y = ys[:, None, None, None] + ky[None, None, :, None]  # oh,1,kh,1
+        base_x = xs[None, :, None, None] + kx[None, None, None, :]  # 1,ow,1,kw
+        off = off.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+        dy = off[:, :, :, 0].reshape(n, deformable_groups, kh, kw, oh, ow)
+        dx = off[:, :, :, 1].reshape(n, deformable_groups, kh, kw, oh, ow)
+        sy = base_y.transpose(2, 3, 0, 1)[None, None] + dy.transpose(0, 1, 2, 3, 4, 5)
+        # shapes: [n, dg, kh, kw, oh, ow]
+        sx = base_x.transpose(2, 3, 0, 1)[None, None] + dx
+
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        wy = sy - y0
+        wx = sx - x0
+
+        def gather(img_c, yy, xx):
+            yc = jnp.clip(yy.astype(jnp.int32), 0, Hp - 1)
+            xc = jnp.clip(xx.astype(jnp.int32), 0, Wp - 1)
+            valid = ((yy >= 0) & (yy <= Hp - 1) & (xx >= 0) & (xx <= Wp - 1))
+            return img_c[yc, xc] * valid
+        cg = cin // deformable_groups
+
+        def per_image(img, syi, sxi, y0i, x0i, wyi, wxi, mi):
+            # img [cin, Hp, Wp]; channels within a deformable group share
+            # grids, so gather whole groups at once (one vectorized gather
+            # per corner per group, not cin unrolled subgraphs)
+            img_g = img.reshape(deformable_groups, cg, Hp, Wp)
+
+            def per_group(img_c, y0g, x0g, wyg, wxg, mg):
+                def g4(yy, xx):
+                    yc = jnp.clip(yy.astype(jnp.int32), 0, Hp - 1)
+                    xc = jnp.clip(xx.astype(jnp.int32), 0, Wp - 1)
+                    valid = ((yy >= 0) & (yy <= Hp - 1) &
+                             (xx >= 0) & (xx <= Wp - 1))
+                    return img_c[:, yc, xc] * valid[None]
+                val = (g4(y0g, x0g) * (1 - wyg) * (1 - wxg) +
+                       g4(y0g, x0g + 1) * (1 - wyg) * wxg +
+                       g4(y0g + 1, x0g) * wyg * (1 - wxg) +
+                       g4(y0g + 1, x0g + 1) * wyg * wxg)
+                return val * mg[None]          # [cg, kh, kw, oh, ow]
+            vals = jax.vmap(per_group)(img_g, y0i, x0i, wyi, wxi, mi)
+            return vals.reshape(cin, *vals.shape[2:])
+        m6 = None
+        if mask_a is not None:
+            m6 = mask_a.reshape(n, deformable_groups, kh, kw, oh, ow)
+        cols = jax.vmap(per_image)(
+            xp, sy, sx, y0, x0, wy, wx,
+            m6 if m6 is not None else jnp.ones((n, deformable_groups, kh, kw,
+                                                oh, ow), xa.dtype))
+        # cols [n, cin, kh, kw, oh, ow] x w [cout, cin/g, kh, kw]
+        if groups == 1:
+            out = jnp.einsum("nijkab,oijk->noab", cols, w)
+        else:
+            xs_ = jnp.split(cols, groups, axis=1)
+            ws_ = jnp.split(w, groups, axis=0)
+            out = jnp.concatenate(
+                [jnp.einsum("nijkab,oijk->noab", xi, wi)
+                 for xi, wi in zip(xs_, ws_)], axis=1)
+        if bias_a is not None:
+            out = out + bias_a.reshape(1, -1, 1, 1)
+        return out
+    return apply_op("deform_conv2d", fn, args)
+
+
+class DeformConv2D(Layer):
+    """reference: vision/ops.py DeformConv2D — owns weight/bias; offsets
+    (and DCNv2 mask) come in at forward."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+        k = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+             else tuple(kernel_size))
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
+        import math as _m
+        std = 1.0 / _m.sqrt(in_channels * k[0] * k[1])
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k[0], k[1]],
+            default_initializer=I.Uniform(-std, std))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], is_bias=True,
+                default_initializer=I.Constant(0.0))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._cfg)
+
+
+def read_file(filename, name=None):
+    """reference: vision/ops.py read_file — file bytes as a uint8 tensor."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ..core.tensor import Tensor
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """reference: decode_jpeg (nvjpeg) — here via PIL on host (the data
+    pipeline runs host-side; the decoded tensor feeds the device)."""
+    import io as _io
+    import numpy as np
+    import jax.numpy as jnp
+    from PIL import Image
+    from ..core.tensor import Tensor
+    raw = bytes(np.asarray(_data(x), np.uint8).tobytes())
+    img = Image.open(_io.BytesIO(raw))
+    if mode.lower() == "gray":
+        img = img.convert("L")
+    elif mode.lower() in ("rgb",):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),  # noqa: A002
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """reference: prior_box op (SSD anchors): per feature-map cell, boxes of
+    each (size, ratio), normalized [x1,y1,x2,y2]."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ratios = list(aspect_ratios)
+    if flip:
+        ratios += [1.0 / r for r in ratios if r != 1.0]
+    boxes = []
+    for i in range(fh):
+        for j in range(fw):
+            cx = (j + offset) * step_w
+            cy = (i + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                for r in ratios:
+                    bw = ms * np.sqrt(r) / 2
+                    bh = ms / np.sqrt(r) / 2
+                    cell.append([(cx - bw) / iw, (cy - bh) / ih,
+                                 (cx + bw) / iw, (cy + bh) / ih])
+                if max_sizes:
+                    ms2 = np.sqrt(ms * max_sizes[k])
+                    cell.append([(cx - ms2 / 2) / iw, (cy - ms2 / 2) / ih,
+                                 (cx + ms2 / 2) / iw, (cy + ms2 / 2) / ih])
+            boxes.append(cell)
+    out = np.asarray(boxes, np.float32).reshape(fh, fw, -1, 4)
+    if clip:
+        out = out.clip(0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """reference: matrix_nms op (SOLOv2) — soft suppression via the decay
+    matrix min over higher-scored same-class boxes."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    bb = np.asarray(_data(bboxes), np.float32)   # [N, M, 4]
+    sc = np.asarray(_data(scores), np.float32)   # [N, C, M]
+    outs, idxs, nums = [], [], []
+    for n in range(bb.shape[0]):
+        dets = []
+        det_idx = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            keep = np.where(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep])][:nms_top_k]
+            boxes_c = bb[n, order]
+            s_c = s[order]
+            m = len(order)
+            # IoU matrix
+            x1 = np.maximum(boxes_c[:, None, 0], boxes_c[None, :, 0])
+            y1 = np.maximum(boxes_c[:, None, 1], boxes_c[None, :, 1])
+            x2 = np.minimum(boxes_c[:, None, 2], boxes_c[None, :, 2])
+            y2 = np.minimum(boxes_c[:, None, 3], boxes_c[None, :, 3])
+            inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+            area = ((boxes_c[:, 2] - boxes_c[:, 0]) *
+                    (boxes_c[:, 3] - boxes_c[:, 1]))
+            iou = inter / np.maximum(area[:, None] + area[None] - inter, 1e-9)
+            iou = np.triu(iou, 1)
+            comp = iou.max(axis=0)  # comp[i]: suppressor i's own max IoU
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - comp[:, None] ** 2)
+                               / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - iou) /
+                         np.maximum(1 - comp[:, None], 1e-9)).min(axis=0)
+            s_new = s_c * decay
+            ok = s_new > post_threshold
+            for t in np.where(ok)[0]:
+                dets.append([c, s_new[t], *boxes_c[t]])
+                det_idx.append(order[t])
+        dets = np.asarray(dets, np.float32).reshape(-1, 6)
+        det_idx = np.asarray(det_idx, np.int64)
+        if len(dets) > keep_top_k >= 0:
+            top = np.argsort(-dets[:, 1])[:keep_top_k]
+            dets, det_idx = dets[top], det_idx[top]
+        outs.append(dets)
+        idxs.append(det_idx)
+        nums.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(outs, 0) if outs
+                             else np.zeros((0, 6), np.float32)))
+    rois_num = Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    index = Tensor(jnp.asarray(np.concatenate(idxs, 0) if idxs
+                               else np.zeros((0,), np.int64)))
+    res = [out]
+    if return_index:
+        res.append(index)
+    if return_rois_num:
+        res.append(rois_num)
+    return tuple(res) if len(res) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """reference: generate_proposals op (RPN): decode deltas on anchors,
+    clip, filter small, NMS top-k."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    sc = np.asarray(_data(scores), np.float32)        # [N, A, H, W]
+    bd = np.asarray(_data(bbox_deltas), np.float32)   # [N, 4A, H, W]
+    ims = np.asarray(_data(img_size), np.float32)     # [N, 2]
+    an = np.asarray(_data(anchors), np.float32).reshape(-1, 4)
+    va = np.asarray(_data(variances), np.float32).reshape(-1, 4)
+    N = sc.shape[0]
+    all_rois, all_scores, nums = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)
+        d = bd[n].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order % len(an)], va[order % len(va)]
+        aw = a[:, 2] - a[:, 0]
+        ah = a[:, 3] - a[:, 1]
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = aw * np.exp(np.clip(v[:, 2] * d[:, 2], None, 10))
+        h = ah * np.exp(np.clip(v[:, 3] * d[:, 3], None, 10))
+        boxes = np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], 1)
+        H, W = ims[n]
+        boxes[:, 0::2] = boxes[:, 0::2].clip(0, W)
+        boxes[:, 1::2] = boxes[:, 1::2].clip(0, H)
+        keep = ((boxes[:, 2] - boxes[:, 0] >= min_size) &
+                (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        # plain NMS
+        order2 = np.argsort(-s)
+        sel = []
+        while order2.size and len(sel) < post_nms_top_n:
+            i = order2[0]
+            sel.append(i)
+            if order2.size == 1:
+                break
+            rest = order2[1:]
+            xx1 = np.maximum(boxes[i, 0], boxes[rest, 0])
+            yy1 = np.maximum(boxes[i, 1], boxes[rest, 1])
+            xx2 = np.minimum(boxes[i, 2], boxes[rest, 2])
+            yy2 = np.minimum(boxes[i, 3], boxes[rest, 3])
+            inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+            a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a2 = ((boxes[rest, 2] - boxes[rest, 0]) *
+                  (boxes[rest, 3] - boxes[rest, 1]))
+            iou = inter / np.maximum(a1 + a2 - inter, 1e-9)
+            order2 = rest[iou <= nms_thresh]
+        all_rois.append(boxes[sel])
+        all_scores.append(s[sel])
+        nums.append(len(sel))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0) if all_rois
+                              else np.zeros((0, 4), np.float32)))
+    rscores = Tensor(jnp.asarray(np.concatenate(all_scores, 0) if all_scores
+                                 else np.zeros((0,), np.float32)))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois, rscores
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """reference: yolov3_loss op — thin delegate to the model-zoo YOLO loss
+    (vision/models/yolo.py implements the anchor-free capability class;
+    grid-anchor YOLOv3 loss composes box-IoU + BCE terms here)."""
+    raise NotImplementedError(
+        "grid-anchor yolov3 loss: use paddle_tpu.vision.models.yolo_loss "
+        "(the zoo's detector criterion) — kept separate because this build's "
+        "detector family is anchor-free (vision/models/yolo.py docstring)")

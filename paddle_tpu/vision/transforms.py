@@ -356,3 +356,243 @@ class RandomErasing(BaseTransform):
                 img[i:i + eh, j:j + ew] = self.value
                 break
         return img
+
+
+# ---------------------------------------------------------------------------
+# Functional API (reference: python/paddle/vision/transforms/functional.py).
+# All work on HWC numpy arrays / PIL images; Tensor passthrough where noted.
+
+def to_tensor(pic, data_format="CHW"):
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    was_uint8 = np.asarray(pic).dtype == np.uint8
+    arr = _to_hwc_array(pic).astype(np.float32)
+    if was_uint8:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+def hflip(img):
+    return _to_hwc_array(img)[:, ::-1]
+
+
+def vflip(img):
+    return _to_hwc_array(img)[::-1]
+
+
+def resize(img, size, interpolation="bilinear"):
+    return _resize_np(img, size, interpolation)
+
+
+def crop(img, top, left, height, width):
+    return _to_hwc_array(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)._apply_image(img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _to_hwc_array(img)
+    p = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if padding_mode == "constant" else {}
+    return np.pad(img, ((p[1], p[3]), (p[0], p[2]), (0, 0)), mode=mode, **kw)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (arr - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    return (arr - mean) / std
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)._apply_image(img)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _to_hwc_array(img)
+    out = arr.astype(np.float32) * brightness_factor
+    return (np.clip(out, 0, 255).astype(np.uint8) if arr.dtype == np.uint8
+            else out.astype(arr.dtype))
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _to_hwc_array(img)
+    f = arr.astype(np.float32)
+    mean = f.mean()
+    out = (f - mean) * contrast_factor + mean
+    return (np.clip(out, 0, 255).astype(np.uint8) if arr.dtype == np.uint8
+            else out.astype(arr.dtype))
+
+
+def adjust_hue(img, hue_factor):
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    return _hue_shift(_to_hwc_array(img), hue_factor)
+
+
+def _hue_shift(arr, hue_factor):
+    f = arr.astype(np.float32) / (255.0 if arr.dtype == np.uint8 else 1.0)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    mx, mn = f[..., :3].max(-1), f[..., :3].min(-1)
+    d = mx - mn + 1e-12
+    h = np.where(mx == r, ((g - b) / d) % 6,
+                 np.where(mx == g, (b - r) / d + 2, (r - g) / d + 4)) / 6.0
+    s = np.where(mx > 0, d / (mx + 1e-12), 0.0)
+    v = mx
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6).astype(np.int64) % 6
+    fr = h * 6 - np.floor(h * 6)
+    p, q, t = v * (1 - s), v * (1 - fr * s), v * (1 - (1 - fr) * s)
+    rgb = np.select(
+        [(i == k)[..., None] for k in range(6)],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    out = rgb
+    if arr.shape[-1] > 3:
+        out = np.concatenate([rgb, f[..., 3:]], -1)
+    return (np.round(out * 255).clip(0, 255).astype(np.uint8)
+            if arr.dtype == np.uint8 else out.astype(arr.dtype))
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    t = RandomRotation((angle, angle), interpolation, expand)
+    return t._apply_image(img)
+
+
+def _inverse_map_sample(img, inv):
+    """Sample img at inverse-mapped integer coords; inv(ys, xs)->(sy, sx)."""
+    h, w = img.shape[:2]
+    ys, xs = np.mgrid[0:h, 0:w]
+    sy, sx = inv(ys, xs)
+    sy = np.round(sy).astype(np.int64)
+    sx = np.round(sx).astype(np.int64)
+    valid = (sy >= 0) & (sy < h) & (sx >= 0) & (sx < w)
+    out = np.zeros_like(img)
+    out[valid] = img[sy[valid], sx[valid]]
+    return out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """reference: functional.affine — inverse-warp with the affine matrix."""
+    img = _to_hwc_array(img)
+    h, w = img.shape[:2]
+    cy, cx = ((h - 1) / 2, (w - 1) / 2) if center is None else \
+        (center[1], center[0])
+    a = np.deg2rad(angle)
+    sx_, sy_ = [np.deg2rad(s) for s in (shear if isinstance(shear, (list, tuple))
+                                        else (shear, 0.0))]
+    # forward matrix: T(center) R S Shear T(-center) + translate
+    m = np.array([[np.cos(a + sy_), -np.sin(a + sx_)],
+                  [np.sin(a + sy_), np.cos(a + sx_)]]) * scale
+    minv = np.linalg.inv(m)
+    ty, tx = translate[1], translate[0]
+
+    def inv(ys, xs):
+        y = ys - cy - ty
+        x = xs - cx - tx
+        sy = minv[0, 0] * y + minv[0, 1] * x + cy
+        sx = minv[1, 0] * y + minv[1, 1] * x + cx
+        return sy, sx
+    return _inverse_map_sample(img, inv)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """reference: functional.perspective — 4-point homography inverse warp."""
+    img = _to_hwc_array(img)
+    src = np.asarray(endpoints, np.float64)   # output quad
+    dst = np.asarray(startpoints, np.float64)  # input quad
+    A = []
+    for (x, y), (u, v) in zip(src, dst):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+    A = np.asarray(A)
+    b = dst.reshape(-1)
+    coef, *_ = np.linalg.lstsq(A, b, rcond=None)
+    Hm = np.append(coef, 1.0).reshape(3, 3)
+
+    def inv(ys, xs):
+        denom = Hm[2, 0] * xs + Hm[2, 1] * ys + Hm[2, 2]
+        sx = (Hm[0, 0] * xs + Hm[0, 1] * ys + Hm[0, 2]) / denom
+        sy = (Hm[1, 0] * xs + Hm[1, 1] * ys + Hm[1, 2]) / denom
+        return sy, sx
+    return _inverse_map_sample(img, inv)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    from ..core.tensor import Tensor
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+        arr = np.asarray(img._data).copy()
+        if arr.ndim == 3:  # CHW
+            arr[:, i:i + h, j:j + w] = v
+        else:
+            arr[..., :, i:i + h, j:j + w] = v
+        out = Tensor(jnp.asarray(arr))
+        if inplace:
+            img._data = out._data
+            return img
+        return out
+    arr = _to_hwc_array(img).copy()
+    arr[i:i + h, j:j + w] = v
+    return arr
+
+
+class RandomAffine(BaseTransform):
+    """reference: transforms.RandomAffine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale_range = scale
+        self.shear = shear
+        self.center = center
+
+    def _apply_image(self, img):
+        img = _to_hwc_array(img)
+        h, w = img.shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale_range) if self.scale_range else 1.0
+        sh = random.uniform(*self.shear) if self.shear else 0.0
+        return affine(img, angle, (tx, ty), sc, (sh, 0.0), center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """reference: transforms.RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+
+    def _apply_image(self, img):
+        img = _to_hwc_array(img)
+        if random.random() > self.prob:
+            return img
+        h, w = img.shape[:2]
+        d = self.distortion_scale
+        def jitter(x, y):
+            return (x + random.uniform(-d, d) * w / 2,
+                    y + random.uniform(-d, d) * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [jitter(*p) for p in start]
+        return perspective(img, start, end)
