@@ -21,10 +21,26 @@ class PyLayerContext:
         self._saved = ()
 
     def save_for_backward(self, *tensors):
+        hooks = saved_tensors_hooks.current()
+        if hooks is not None:
+            tensors = tuple(hooks.pack_hook(t) for t in tensors)
+            self._packed = True
+            self.__dict__["_unpack_fn"] = hooks.unpack_hook
         self._saved = tensors
 
     def saved_tensor(self):
+        if getattr(self, "_packed", False):
+            hooks = saved_tensors_hooks.current()
+            unpack = (hooks.unpack_hook if hooks is not None
+                      else self._unpack_fallback)
+            return tuple(unpack(t) for t in self._saved)
         return self._saved
+
+    # the hook context may have exited before backward runs; remember the
+    # unpack fn that matches the pack that ran
+    @property
+    def _unpack_fallback(self):
+        return self.__dict__.get("_unpack_fn", lambda t: t)
 
 
 class PyLayerMeta(type):

@@ -115,9 +115,13 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     remap = {int(v): i for i, v in enumerate(uniq)}
     r_src = np.asarray([remap[int(v)] for v in src], np.int64)
     r_dst = np.asarray([remap[int(v)] for v in dst], np.int64)
+    seeds = np.asarray(input_nodes._data if isinstance(input_nodes, Tensor)
+                       else input_nodes).reshape(-1)
+    # reindex of the INPUT nodes: where each seed landed in sample_index
+    reindex_nodes = np.asarray([remap[int(v)] for v in seeds], np.int64)
     return (Tensor(jnp.asarray(r_src)), Tensor(jnp.asarray(r_dst)),
             Tensor(jnp.asarray(uniq)),
-            Tensor(jnp.asarray(np.arange(len(uniq), dtype=np.int64))))
+            Tensor(jnp.asarray(reindex_nodes)))
 
 
 def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,

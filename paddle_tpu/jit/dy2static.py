@@ -16,7 +16,7 @@ on the predicate at trace time —
 Only the control-flow subset that is data-dependent needs rewriting; all
 other Python executes natively under the jax trace (closures, calls,
 containers), so the transformer is deliberately small: If / While /
-BoolOp(and,or) / UnaryOp(not) / ternary IfExp.
+For-over-range / BoolOp(and,or) / UnaryOp(not) / ternary IfExp.
 """
 from __future__ import annotations
 
@@ -296,6 +296,34 @@ def convert_logical_not(x):
     return ops.logical_not(_as_tensor(x))
 
 
+def normalize_range(*args):
+    """Runtime for rewritten `for i in range(...)`: (start, stop, step)."""
+    if len(args) == 1:
+        return 0, args[0], 1
+    if len(args) == 2:
+        return args[0], args[1], 1
+    return args
+
+
+def range_cond(i, stop, step):
+    """Loop-continue predicate honoring negative steps. A traced step's
+    SIGN cannot be branched on at trace time — fail loudly rather than
+    silently assuming positive."""
+    if _is_traced(step):
+        raise NotImplementedError(
+            "dy2static for-range: the step must be a python int (its sign "
+            "selects the loop predicate); got a traced tensor step")
+    if step < 0:
+        if _is_traced(i) or _is_traced(stop):
+            from ..core import ops
+            return ops.greater_than(_as_tensor(i), _as_tensor(stop))
+        return i > stop
+    if _is_traced(i) or _is_traced(stop):
+        from ..core import ops
+        return ops.less_than(_as_tensor(i), _as_tensor(stop))
+    return i < stop
+
+
 def convert_bool(x):
     """`bool(x)` in rewritten predicates: stays a tensor when traced."""
     if _is_traced(x):
@@ -349,7 +377,8 @@ class _AssignedNames(ast.NodeVisitor):
         pass
 
 
-_SYNTHETIC = re.compile(r"^__(true_fn|false_fn|loop_cond|loop_body)_\d+$")
+_SYNTHETIC = re.compile(
+    r"^__(true_fn|false_fn|loop_cond|loop_body|for_i|for_stop|for_step)_\d+$")
 
 
 def _assigned(stmts: Sequence[ast.stmt]) -> List[str]:
@@ -522,6 +551,60 @@ class Dy2StaticTransformer(ast.NodeTransformer):
         return [ast.copy_location(ast.fix_missing_locations(s), node)
                 for s in (*guards, cond_def, body_def, assign)]
 
+    def visit_For(self, node):
+        """`for i in range(...)` -> while-style convert_while_loop (the
+        reference LoopTransformer's for path); a traced trip count becomes
+        lax.while_loop instead of raising on range(tracer). Non-range
+        iterables keep Python semantics (trace-time unroll).
+
+        The loop variable is assigned from an INTERNAL counter at the top
+        of each iteration, so after the loop it holds the last iterated
+        value (Python semantics), not the overshoot; zero iterations leave
+        the prior binding untouched."""
+        self.generic_visit(node)
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3
+                and isinstance(node.target, ast.Name)
+                and not node.orelse and not _has_return(node.body)
+                and not _breaks_scope(node.body)):
+            return node
+        tgt = node.target.id
+        ctr = self._fresh("for_i")
+        stop_n, step_n = self._fresh("for_stop"), self._fresh("for_step")
+        norm = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(ctr, ast.Store()),
+                                     _name(stop_n, ast.Store()),
+                                     _name(step_n, ast.Store())],
+                               ctx=ast.Store())],
+            value=_call("normalize_range", list(it.args)))
+        carry = sorted((set(_assigned(node.body)) | {tgt, ctr})
+                       - {stop_n, step_n})
+        guards = [_define_guard(n) for n in carry if n != ctr]
+        cond_name, body_name = self._fresh("loop_cond"), self._fresh("loop_body")
+        cond_def = _fn_def(cond_name, [ast.Return(value=_call(
+            "range_cond", [_name(ctr), _name(stop_n), _name(step_n)]))],
+            arg_names=carry)
+        set_tgt = ast.Assign(targets=[_name(tgt, ast.Store())],
+                             value=_name(ctr))
+        inc = ast.Assign(
+            targets=[_name(ctr, ast.Store())],
+            value=ast.BinOp(left=_name(ctr), op=ast.Add(),
+                            right=_name(step_n)))
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(n) for n in carry], ctx=ast.Load()))
+        body_def = _fn_def(body_name, [set_tgt] + list(node.body) + [inc, ret],
+                           arg_names=carry)
+        assign = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(n, ast.Store()) for n in carry],
+                               ctx=ast.Store())],
+            value=_call("convert_while_loop", [
+                _name(cond_name), _name(body_name),
+                ast.Tuple(elts=[_name(n) for n in carry], ctx=ast.Load())]))
+        return [ast.copy_location(ast.fix_missing_locations(s), node)
+                for s in (norm, *guards, cond_def, body_def, assign)]
+
 
 def _no_args():
     return ast.arguments(posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
@@ -622,6 +705,8 @@ def ast_transform(fn: Callable) -> Callable:
 
 
 class _RuntimeNS:
+    normalize_range = staticmethod(normalize_range)
+    range_cond = staticmethod(range_cond)
     convert_ifelse = staticmethod(convert_ifelse)
     convert_while_loop = staticmethod(convert_while_loop)
     convert_logical_and = staticmethod(convert_logical_and)

@@ -1324,7 +1324,7 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
 
 
 def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
-              fastemit_lambda=0.0, reduction="mean", name=None):
+              fastemit_lambda=0.001, reduction="mean", name=None):
     """reference: warprnnt_op — RNN-T transducer loss. Forward-variable
     (alpha) dynamic program over the [T, U] lattice as nested lax.scans,
     fully on-device and differentiable by jax AD (the reference backprops

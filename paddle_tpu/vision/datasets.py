@@ -15,7 +15,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "VOC2012", "DatasetFolder",
            "ImageFolder", "FakeData"]
 
 
@@ -209,3 +210,75 @@ class FakeData(Dataset):
 
     def __len__(self):
         return self.size
+
+
+class Flowers(Dataset):
+    """Flowers-102 (reference: vision/datasets/flowers.py). Zero-egress:
+    reads an extracted local archive — `data_file` points at a directory of
+    class-numbered images plus labels (setid/labels .npy or .mat), or a
+    DatasetFolder-style tree."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None):
+        if download:
+            raise RuntimeError("no network in this environment; pass "
+                               "data_file= pointing at the extracted archive")
+        if data_file is None or not os.path.isdir(data_file):
+            raise RuntimeError("Flowers needs data_file=<extracted dir>")
+        self._inner = DatasetFolder(data_file, transform=transform)
+        self.transform = transform
+        self.mode = mode
+        # deterministic 80/10/10 split by sample index when no setid file
+        # is given (the archive's setid.mat is unavailable offline)
+        n = len(self._inner)
+        bucket = {"train": 0, "valid": 1, "test": 2}.get(mode, 0)
+        self._index = [i for i in range(n)
+                       if (i % 10 < 8, i % 10 == 8, i % 10 == 9)[bucket]]
+
+    def __getitem__(self, idx):
+        return self._inner[self._index[idx]]
+
+    def __len__(self):
+        return len(self._index)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC 2012 segmentation (reference: vision/datasets/voc2012.py).
+    Reads the standard extracted layout: JPEGImages/, SegmentationClass/,
+    ImageSets/Segmentation/{train,val,trainval}.txt."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download:
+            raise RuntimeError("no network in this environment; pass "
+                               "data_file= pointing at the extracted VOCdevkit")
+        if data_file is None or not os.path.isdir(data_file):
+            raise RuntimeError("VOC2012 needs data_file=<extracted dir>")
+        root = data_file
+        for sub in ("VOCdevkit/VOC2012", "VOC2012", ""):
+            cand = os.path.join(root, sub) if sub else root
+            if os.path.isdir(os.path.join(cand, "JPEGImages")):
+                root = cand
+                break
+        split = {"train": "train", "valid": "val", "test": "val",
+                 "trainval": "trainval"}.get(mode, "train")
+        list_file = os.path.join(root, "ImageSets", "Segmentation",
+                                 split + ".txt")
+        with open(list_file) as f:
+            names = [l.strip() for l in f if l.strip()]
+        self._imgs = [os.path.join(root, "JPEGImages", n + ".jpg")
+                      for n in names]
+        self._masks = [os.path.join(root, "SegmentationClass", n + ".png")
+                       for n in names]
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = _load_image(self._imgs[idx])
+        from PIL import Image
+        mask = np.asarray(Image.open(self._masks[idx]))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self._imgs)

@@ -557,6 +557,8 @@ class RandomAffine(BaseTransform):
                  interpolation="nearest", fill=0, center=None):
         if isinstance(degrees, numbers.Number):
             degrees = (-degrees, degrees)
+        if isinstance(shear, numbers.Number):
+            shear = (-shear, shear)
         self.degrees = degrees
         self.translate = translate
         self.scale_range = scale

@@ -277,3 +277,78 @@ class TestLayerControlFlow:
         got = sm2(x)
         np.testing.assert_allclose(np.asarray(got._data),
                                    np.asarray(want._data), rtol=1e-5)
+
+
+class TestForRange:
+    def test_static_range_matches_eager(self):
+        def f(x):
+            acc = x * 0.0
+            for i in range(3):
+                acc = acc + x * float(i + 1)
+            return acc
+
+        _eager_and_static(f, (np.ones(2, np.float32),))
+
+    def test_tensor_trip_count(self):
+        """range(tensor) would raise under plain tracing; the For rewrite
+        lowers it to lax.while_loop — one compile serves both counts."""
+        def f(x, n):
+            acc = x * 0.0
+            for _i in range(n):
+                acc = acc + x
+            return acc
+
+        sf = paddle.jit.to_static(f)
+        x = np.ones(2, np.float32)
+        o3 = sf(paddle.to_tensor(x), paddle.to_tensor(np.int32(3)))
+        o5 = sf(paddle.to_tensor(x), paddle.to_tensor(np.int32(5)))
+        np.testing.assert_allclose(np.asarray(o3._data), 3.0)
+        np.testing.assert_allclose(np.asarray(o5._data), 5.0)
+        assert len(sf._cache) == 1
+
+    def test_range_start_step(self):
+        def f(x):
+            acc = x * 0.0
+            for i in range(2, 8, 2):
+                acc = acc + float(i)
+            return acc
+
+        _eager_and_static(f, (np.zeros(2, np.float32),))
+
+    def test_nonrange_for_untouched(self):
+        def f(x):
+            acc = x * 0.0
+            for v in [1.0, 2.0]:
+                acc = acc + v
+            return acc
+
+        _eager_and_static(f, (np.zeros(2, np.float32),))
+
+
+class TestForSemantics:
+    def test_loop_var_final_value_matches_python(self):
+        def f(x):
+            i = -1.0
+            for i in range(3):
+                x = x + 1.0
+            return x * float(i)
+
+        _eager_and_static(f, (np.ones(2, np.float32),))
+
+    def test_zero_iteration_keeps_prior_binding(self):
+        def f(x):
+            i = 7
+            for i in range(0):
+                x = x + 100.0
+            return x + float(i)
+
+        _eager_and_static(f, (np.zeros(2, np.float32),))
+
+    def test_negative_step(self):
+        def f(x):
+            acc = x * 0.0
+            for i in range(5, 0, -2):
+                acc = acc + float(i)
+            return acc
+
+        _eager_and_static(f, (np.zeros(2, np.float32),))
