@@ -30,6 +30,17 @@ def _chip_peak_flops(device) -> float:
     return 275e12  # assume v4 if unknown
 
 
+def _timed_steps(step, iters, *stacked):
+    """Shared protocol: warm-compile + warm-shape run, then ONE timed
+    run_steps launch with a host-read fence. Returns (dt_seconds, loss)."""
+    losses = step.run_steps(iters, *stacked)
+    _ = float(losses.numpy()[-1])
+    t0 = time.perf_counter()
+    losses = step.run_steps(iters, *stacked)
+    final = float(losses.numpy()[-1])
+    return time.perf_counter() - t0, final
+
+
 def bench_resnet50(on_tpu):
     """ResNet-50 ImageNet-shape training throughput (BASELINE.md config).
     Same honest protocol as the GPT bench: N steps fused in one scan
@@ -53,12 +64,7 @@ def bench_resnet50(on_tpu):
     imgs = paddle.to_tensor(np.random.randn(iters, B, 3, hw, hw).astype(
         "bfloat16" if on_tpu else "float32"))
     lbls = paddle.to_tensor(np.random.randint(0, 1000, (iters, B)).astype("int64"))
-    losses = step.run_steps(iters, imgs, lbls)
-    _ = float(losses.numpy()[-1])
-    t0 = time.perf_counter()
-    losses = step.run_steps(iters, imgs, lbls)
-    final = float(losses.numpy()[-1])
-    dt = time.perf_counter() - t0
+    dt, final = _timed_steps(step, iters, imgs, lbls)
     ips = B * iters / dt
     print(json.dumps({
         "metric": f"images/sec/chip (resnet50 train, B={B} {hw}x{hw})",
@@ -98,12 +104,7 @@ def bench_bert(on_tpu):
                                        (iters, B, S)).astype("int32"))
     lbl = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
                                        (iters, B, S)).astype("int64"))
-    losses = step.run_steps(iters, ids, lbl)
-    _ = float(losses.numpy()[-1])
-    t0 = time.perf_counter()
-    losses = step.run_steps(iters, ids, lbl)
-    final = float(losses.numpy()[-1])
-    dt = time.perf_counter() - t0
+    dt, final = _timed_steps(step, iters, ids, lbl)
     tps = B * S * iters / dt
     n = sum(p.size for p in model.parameters())
     fpt = 6 * n + 12 * cfg.num_layers * cfg.hidden_size * S
@@ -131,6 +132,10 @@ def main():
         return bench_resnet50(on_tpu)
     if which == "bert":
         return bench_bert(on_tpu)
+    if which == "vit":
+        return bench_vit(on_tpu)
+    if which == "swin":
+        return bench_swin(on_tpu)
 
     import paddle_tpu as paddle
     from paddle_tpu.jit.train_step import TrainStep
@@ -218,6 +223,97 @@ def main():
                   "loss": round(final_loss, 4), "params": n_params},
     }))
 
+
+
+
+def bench_vit(on_tpu):
+    """ViT-L/16 (BASELINE.md config) training throughput."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.models import VisionTransformer, vit_config
+    import paddle_tpu.nn as nn
+
+    B, iters = (32, 8) if on_tpu else (2, 2)
+    preset = os.environ.get("PADDLE_TPU_BENCH_PRESET", "vit-l16")
+    if on_tpu:
+        cfg = vit_config(preset, image_size=224, num_classes=1000)
+    else:  # CPU smoke: tiny config (precedent: GPT drops to 125m off-TPU)
+        cfg = vit_config(preset, image_size=32, patch_size=16,
+                         hidden_size=64, num_layers=2, num_heads=4,
+                         num_classes=1000)
+    paddle.seed(0)
+    model = VisionTransformer(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    ce = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 moment_dtype="bfloat16" if on_tpu
+                                 else "float32")
+    step = TrainStep(model, opt, lambda x, y: ce(model(x), y))
+    hw = cfg.image_size
+    imgs = paddle.to_tensor(np.random.randn(iters, B, 3, hw, hw).astype(
+        "bfloat16" if on_tpu else "float32"))
+    lbls = paddle.to_tensor(np.random.randint(0, 1000, (iters, B)).astype("int64"))
+    dt, final = _timed_steps(step, iters, imgs, lbls)
+    ips = B * iters / dt
+    n = sum(p.size for p in model.parameters())
+    seq = cfg.num_patches + 1
+    fpi = 6 * n * seq + 12 * cfg.num_layers * cfg.hidden_size * seq * seq
+    import jax as _jax
+    peak = _chip_peak_flops(_jax.devices()[0])
+    print(json.dumps({
+        "metric": f"images/sec/chip ({preset} train, B={B} {hw}x{hw})",
+        "value": round(ips, 1), "unit": "images/s",
+        "vs_baseline": round(fpi * ips / peak / 0.70, 4),
+        "extra": {"mfu": round(fpi * ips / peak, 4),
+                  "step_ms": round(dt / iters * 1e3, 2),
+                  "loss": round(final, 4), "params": n},
+    }))
+
+
+def bench_swin(on_tpu):
+    """Swin-T/B (BASELINE.md config) training throughput — batched window
+    attention on the MXU."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.vision.models import swin_t, swin_b
+    import paddle_tpu.nn as nn
+
+    B, iters = (32, 8) if on_tpu else (2, 2)
+    preset = os.environ.get("PADDLE_TPU_BENCH_PRESET", "swin-t")
+    builder = swin_b if preset == "swin-b" else swin_t
+    paddle.seed(0)
+    if on_tpu:
+        model = builder(num_classes=1000)
+        model.to(dtype="bfloat16")
+        hw = 224
+    else:
+        from paddle_tpu.vision.models import SwinTransformer
+        model = SwinTransformer(image_size=32, patch_size=2, embed_dim=16,
+                                depths=(2, 2), num_heads=(2, 4),
+                                window_size=4, num_classes=10)
+        hw = 32
+    ce = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 moment_dtype="bfloat16" if on_tpu
+                                 else "float32")
+    step = TrainStep(model, opt, lambda x, y: ce(model(x), y))
+    imgs = paddle.to_tensor(np.random.randn(iters, B, 3, hw, hw).astype(
+        "bfloat16" if on_tpu else "float32"))
+    ncls = 1000 if on_tpu else 10
+    lbls = paddle.to_tensor(np.random.randint(0, ncls, (iters, B)).astype("int64"))
+    dt, final = _timed_steps(step, iters, imgs, lbls)
+    ips = B * iters / dt
+    print(json.dumps({
+        "metric": f"images/sec/chip ({preset} train, B={B} {hw}x{hw})",
+        "value": round(ips, 1), "unit": "images/s", "vs_baseline": None,
+        "extra": {"step_ms": round(dt / iters * 1e3, 2),
+                  "loss": round(final, 4)},
+    }))
 
 if __name__ == "__main__":
     main()

@@ -1068,14 +1068,16 @@ def thresholded_relu(x, threshold=1.0, name=None):
 
 
 def maxout(x, groups, axis=1, name=None):
-    """reference: maxout_op — max over `groups` channel sub-bands."""
+    """reference: maxout_op — out channel c = max over the CONSECUTIVE
+    input channels [c*groups, (c+1)*groups) (phi maxouting.cc:47 index
+    in_c = c*groups + ph)."""
     def fn(a):
         ax = axis if axis >= 0 else a.ndim + axis
         c = a.shape[ax]
         if c % groups:
             raise ValueError(f"channels {c} not divisible by groups {groups}")
-        shp = a.shape[:ax] + (groups, c // groups) + a.shape[ax + 1:]
-        return a.reshape(shp).max(axis=ax)
+        shp = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return a.reshape(shp).max(axis=ax + 1)
     return apply_op("maxout", fn, [x])
 
 

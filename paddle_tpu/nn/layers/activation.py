@@ -71,18 +71,7 @@ class Maxout(Layer):
         self.groups, self.axis = groups, axis
 
     def forward(self, x):
-        from ...core.tensor import apply_op
-        import jax.numpy as jnp
-        g = self.groups
-        ax = self.axis
-
-        def fn(a):
-            c = a.shape[ax]
-            new_shape = list(a.shape)
-            new_shape[ax] = c // g
-            new_shape.insert(ax + 1, g)
-            return a.reshape(new_shape).max(axis=ax + 1)
-        return apply_op("maxout", fn, [x])
+        return F.maxout(x, self.groups, self.axis)
 
 
 class Softmax2D(Layer):

@@ -379,3 +379,158 @@ def test_output_wave2(case):
 def test_grad_wave2(case):
     t = case()
     t.check_grad(list(t.inputs.keys()))
+
+
+# ---------------------------------------------------------------- wave 3:
+# surface-completion ops (take/tensordot/renorm/fold/logit/... — the batch
+# added for reference __all__ parity), same dual-executor + numeric-grad
+# contract.
+
+class TakeCase(OpTest):
+    def config(self):
+        self.op = paddle.take
+        self.inputs = {"x": _f32(3, 4)}
+        self.attrs = {"index": paddle.to_tensor(np.array([0, 5, 11]))}
+        self.ref = lambda x, index: x.reshape(-1)[[0, 5, 11]]
+
+
+class TensordotCase(OpTest):
+    def config(self):
+        a = _f32(3, 4)
+        b = _f32(4, 5)
+        self.op = paddle.tensordot
+        self.inputs = {"x": a, "y": b}
+        self.attrs = {"axes": 1}
+        self.ref = lambda x, y, axes: np.tensordot(x, y, axes=1)
+
+
+class LogitCase(OpTest):
+    def config(self):
+        self.op = paddle.logit
+        self.inputs = {"x": np.random.uniform(0.1, 0.9, (3, 4)).astype("float32")}
+        self.ref = lambda x: np.log(x) - np.log1p(-x)
+        self.rtol = 1e-4
+
+
+class Deg2RadCase(OpTest):
+    def config(self):
+        self.op = paddle.deg2rad
+        self.inputs = {"x": _f32(3, 4) * 90}
+        self.ref = np.deg2rad
+
+
+class StanhCase(OpTest):
+    def config(self):
+        self.op = paddle.stanh
+        self.inputs = {"x": _f32(3, 4)}
+        self.ref = lambda x: 1.7159 * np.tanh(0.67 * x)
+        self.rtol = 1e-4
+
+
+class DiagflatCase(OpTest):
+    def config(self):
+        self.op = paddle.diagflat
+        self.inputs = {"x": _f32(4)}
+        self.ref = np.diagflat
+
+
+class RenormCase(OpTest):
+    def config(self):
+        self.op = paddle.renorm
+        self.inputs = {"x": _f32(4, 6) * 3}
+        self.attrs = {"p": 2.0, "axis": 0, "max_norm": 1.0}
+
+        def ref(x, p, axis, max_norm):
+            norms = np.linalg.norm(x, axis=1, keepdims=True)
+            scale = np.where(norms > max_norm, max_norm / np.maximum(norms, 1e-12), 1.0)
+            return x * scale
+        self.ref = ref
+        self.grad_rtol = 5e-2
+        self.grad_atol = 5e-3
+
+
+class LogSigmoidCase(OpTest):
+    def config(self):
+        self.op = F.log_sigmoid
+        self.inputs = {"x": _f32(3, 4)}
+        self.ref = lambda x: -np.log1p(np.exp(-x))
+        self.rtol = 1e-4
+
+
+class SoftMarginCase(OpTest):
+    def config(self):
+        self.op = F.soft_margin_loss
+        self.inputs = {"input": _f32(3, 4)}
+        self.attrs = {"label": paddle.to_tensor(
+            np.sign(_f32(3, 4)) + (np.sign(_f32(3, 4)) == 0)),
+            "reduction": "mean"}
+
+        def ref(input, label, reduction):
+            lbl = np.asarray(label._data)
+            return np.mean(np.log1p(np.exp(-lbl * input)))
+        self.ref = ref
+        self.rtol = 1e-4
+        self.grad_rtol = 5e-2
+
+
+class FoldCase(OpTest):
+    def config(self):
+        self.op = F.fold
+        self.inputs = {"x": _f32(2, 12, 4)}
+        self.attrs = {"output_sizes": (4, 4), "kernel_sizes": 2, "strides": 2}
+
+        def ref(x, output_sizes, kernel_sizes, strides):
+            n, ckk, L = x.shape
+            c = ckk // 4
+            cols = x.reshape(n, c, 2, 2, 2, 2)
+            out = np.zeros((n, c, 4, 4), x.dtype)
+            for i in range(2):
+                for j in range(2):
+                    out[:, :, i::2, j::2] += cols[:, :, i, j]
+            return out
+        self.ref = ref
+
+
+class MaxoutCase(OpTest):
+    def config(self):
+        self.op = F.maxout
+        x = _f32(2, 6, 3, 3)
+        x[:, 1::2] += 10.0  # keep the per-pair max away from ties (finite
+        self.inputs = {"x": x}  # differences at a kink disagree)
+        self.attrs = {"groups": 2}
+
+        def ref(x, groups):
+            # reference semantics: consecutive channels per output channel
+            n, c, h, w = x.shape
+            return x.reshape(n, c // groups, groups, h, w).max(axis=2)
+        self.ref = ref
+
+
+class PixelUnshuffleCase(OpTest):
+    def config(self):
+        self.op = F.pixel_unshuffle
+        self.inputs = {"x": _f32(2, 2, 4, 4)}
+        self.attrs = {"downscale_factor": 2}
+
+        def ref(x, downscale_factor):
+            r = downscale_factor
+            n, c, h, w = x.shape
+            y = x.reshape(n, c, h // r, r, w // r, r)
+            return y.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r, w // r)
+        self.ref = ref
+
+
+_WAVE3 = [TakeCase, TensordotCase, LogitCase, Deg2RadCase, StanhCase,
+          DiagflatCase, RenormCase, LogSigmoidCase, SoftMarginCase, FoldCase,
+          MaxoutCase, PixelUnshuffleCase]
+
+
+@pytest.mark.parametrize("case", _WAVE3, ids=lambda c: c.__name__)
+def test_output_wave3(case):
+    case().check_output()
+
+
+@pytest.mark.parametrize("case", _WAVE3, ids=lambda c: c.__name__)
+def test_grad_wave3(case):
+    t = case()
+    t.check_grad(list(t.inputs.keys()))
