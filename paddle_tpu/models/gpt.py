@@ -57,6 +57,15 @@ class GPTConfig:
     # None = full-segment remat; "dots" = keep MXU outputs, recompute
     # elementwise only (see distributed/recompute.py)
     recompute_policy: Optional[str] = None
+    # Mixture-of-experts (GShard-style): num_experts > 0 replaces the MLP
+    # of every `moe_every_n_layers`-th block with a routed expert FFN
+    # (incubate MoELayer — all_to_all over the ep mesh axis); the router's
+    # load-balance aux loss is added to loss() with weight moe_aux_weight
+    moe_num_experts: int = 0
+    moe_every_n_layers: int = 2
+    moe_gate: str = "gshard"
+    moe_top_k: Optional[int] = None
+    moe_aux_weight: float = 0.01
     tie_word_embeddings: bool = True
     param_dtype: str = "float32"
     # "ring" | "ulysses" | None — schedule used when the mesh has sp > 1
@@ -181,14 +190,34 @@ class GPTMLP(Layer):
 
 
 class GPTBlock(Layer):
-    """Pre-LN transformer block."""
+    """Pre-LN transformer block; optionally a routed-expert FFN block
+    (GShard pattern: every Nth layer is MoE)."""
 
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, layer_idx: int = 0):
         super().__init__()
         self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
         self.attn = GPTSelfAttention(config)
         self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
-        self.mlp = GPTMLP(config)
+        self.is_moe = (config.moe_num_experts > 0 and
+                       layer_idx % max(1, config.moe_every_n_layers) ==
+                       max(1, config.moe_every_n_layers) - 1)
+        if self.is_moe:
+            from ..incubate.distributed.models.moe import MoELayer
+            self.mlp = MoELayer(config.hidden_size, config.intermediate_size,
+                                config.moe_num_experts, gate=config.moe_gate,
+                                top_k=config.moe_top_k)
+            # expert FFNs follow the same init convention as the dense
+            # path: Normal(initializer_range) in, depth-scaled residual out
+            w_init = I.Normal(std=config.initializer_range)
+            e, h, m = (config.moe_num_experts, config.hidden_size,
+                       config.intermediate_size)
+            self.mlp.w1.set_value(w_init([e, h, m], self.mlp.w1.dtype))
+            self.mlp.w2.set_value(
+                w_init([e, m, h], self.mlp.w2.dtype) /
+                math.sqrt(2 * config.num_layers))
+            self.moe_drop = Dropout(config.hidden_dropout)
+        else:
+            self.mlp = GPTMLP(config)
 
     def forward(self, x, cache=None):
         if cache is not None:
@@ -197,8 +226,10 @@ class GPTBlock(Layer):
             x = x + self.mlp(self.ln_2(x))
             return x, new_cache
         x = x + self.attn(self.ln_1(x))
-        x = x + self.mlp(self.ln_2(x))
-        return x
+        y = self.mlp(self.ln_2(x))
+        if self.is_moe and self.training and self.moe_drop.p:
+            y = self.moe_drop(y)  # dense GPTMLP applies this internally
+        return x + y
 
 
 class GPTModel(Layer):
@@ -213,7 +244,8 @@ class GPTModel(Layer):
                 [config.vocab_size, config.hidden_size], self.wte.weight.dtype))
         self.wpe = Embedding(config.max_position_embeddings, config.hidden_size)
         self.drop = Dropout(config.hidden_dropout)
-        self.h = LayerList([GPTBlock(config) for _ in range(config.num_layers)])
+        self.h = LayerList([GPTBlock(config, layer_idx=i)
+                            for i in range(config.num_layers)])
         self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
         if config.param_dtype != "float32":
             self.to(dtype=config.param_dtype)
@@ -231,14 +263,39 @@ class GPTModel(Layer):
             x = self.drop(x)
 
         new_caches = [] if caches is not None else None
+        aux_losses = []
         for i, block in enumerate(self.h):
             if caches is not None:
                 x, c = block(x, cache=caches[i])
                 new_caches.append(c)
             elif self.config.use_recompute and self.training:
-                x = recompute(block, x, policy=self.config.recompute_policy)
+                if getattr(block, "is_moe", False):
+                    # the router aux loss must be an explicit OUTPUT of the
+                    # remat region — reading it off the layer afterwards
+                    # would leak a tracer out of jax.checkpoint
+                    def call(inp, _b=block):
+                        y = _b(inp)
+                        return y, _b.mlp.aux_loss
+                    x, aux = recompute(
+                        call, x, policy=self.config.recompute_policy,
+                        params=[p for p in block.parameters()
+                                if not p.stop_gradient])
+                    aux_losses.append(aux)
+                else:
+                    x = recompute(block, x,
+                                  policy=self.config.recompute_policy)
             else:
                 x = block(x)
+                if getattr(block, "is_moe", False) and \
+                        block.mlp.aux_loss is not None:
+                    aux_losses.append(block.mlp.aux_loss)
+        # router load-balance total of this forward (MoE blocks only)
+        self.last_aux_loss = None
+        if aux_losses:
+            total = aux_losses[0]
+            for a in aux_losses[1:]:
+                total = total + a
+            self.last_aux_loss = total
         x = self.ln_f(x)
         if caches is not None:
             return x, new_caches
@@ -277,7 +334,10 @@ class GPTForCausalLM(Layer):
         """Fused-LM-head training loss: hidden states go straight into the
         chunked linear+softmax-CE (incubate.nn.functional.
         fused_linear_cross_entropy), so [B,S,vocab] logits never exist in
-        HBM. Numerically identical to forward()+GPTPretrainingCriterion."""
+        HBM. Numerically identical to forward()+GPTPretrainingCriterion for
+        dense configs; for MoE configs this ALSO adds
+        moe_aux_weight * router aux loss (the criterion path needs it
+        passed explicitly: crit(..., aux_loss=model.gpt.last_aux_loss))."""
         from ..incubate.nn.functional import fused_linear_cross_entropy
         x = self.gpt(input_ids, position_ids)
         w = (self.gpt.wte.weight if self.config.tie_word_embeddings
@@ -285,7 +345,11 @@ class GPTForCausalLM(Layer):
         per_tok = fused_linear_cross_entropy(
             x, w, labels, chunk_size=chunk_size,
             transpose_weight=not self.config.tie_word_embeddings)
-        return _masked_mean(per_tok, loss_mask)
+        loss = _masked_mean(per_tok, loss_mask)
+        aux = getattr(self.gpt, "last_aux_loss", None)
+        if aux is not None:
+            loss = loss + self.config.moe_aux_weight * aux
+        return loss
 
     def generate(self, input_ids, max_new_tokens: int = 16, temperature: float = 0.0):
         """Greedy/temperature sampling with KV cache (reference:
@@ -330,6 +394,13 @@ class GPTPretrainingCriterion(Layer):
         super().__init__()
         self.ce = ParallelCrossEntropy()
 
-    def forward(self, logits, labels, loss_mask=None):
-        loss = self.ce(logits, labels)           # [B, S, 1]
-        return _masked_mean(ops.squeeze(loss, -1), loss_mask)
+    def forward(self, logits, labels, loss_mask=None, aux_loss=None):
+        """For MoE configs pass the router load-balance loss explicitly:
+        crit(model(ids), ids, aux_loss=model.gpt.last_aux_loss) — the
+        criterion only sees logits and cannot recover it (model.loss()
+        adds it automatically)."""
+        loss = _masked_mean(ops.squeeze(self.ce(logits, labels), -1),
+                            loss_mask)
+        if aux_loss is not None:
+            return loss + aux_loss
+        return loss
