@@ -125,7 +125,8 @@ class TrainStep:
             for p, st in zip(self._params, self._opt_state):
                 spec = _opt_state_spec(p, self.optimizer)
                 for k in st:
-                    st[k] = self._to_global(st[k], spec)
+                    st[k] = self._to_global(
+                        st[k], self.optimizer.state_spec(p, k, st[k], spec))
 
     # ------------------------------------------------------------------
     def _build(self, treedef, ndims):
@@ -137,8 +138,13 @@ class TrainStep:
         if self.mesh is not None:
             pspecs = tuple(_spec_or_replicated(p) for p in params)
             sspecs = tuple(_opt_state_spec(p, opt) for p in params)
+            # per-entry spec comes from the optimizer (param-shaped state
+            # follows the param; e.g. int8 moment codes shard their block
+            # dim) — see Optimizer.state_spec
             state_specs = tuple(
-                {k: sspecs[i] for k in (self._opt_state[i] or {})}
+                {k: opt.state_spec(params[i], k, self._opt_state[i][k],
+                                   sspecs[i])
+                 for k in (self._opt_state[i] or {})}
                 for i in range(len(params)))
             flat_specs = [P(*self.data_axes) if nd > 0 else P() for nd in ndims]
             in_shardings = (
