@@ -91,10 +91,115 @@ def is_first_worker():
     return get_rank() == 0
 
 
-def barrier_worker():
-    from ..parallel import barrier
-    barrier()
-
-
 from . import utils  # noqa: E402,F401
 from . import elastic  # noqa: E402,F401
+
+
+# -------------------------------------------------------------- PS lifecycle
+# (reference: fleet.py:635-679 — init_server/run_server on PSERVER
+# processes, init_worker/stop_worker on trainers; roles from env like
+# PaddleCloudRoleMaker.)
+
+_ps_state = {"server": None}
+
+
+def _role() -> str:
+    return os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+
+
+def is_server() -> bool:
+    return _role() == "PSERVER"
+
+
+def is_worker() -> bool:
+    return not is_server()
+
+
+def server_num() -> int:
+    eps = os.environ.get("PADDLE_PSERVER_ENDPOINTS", "")
+    return len([e for e in eps.split(",") if e])
+
+
+def server_endpoints():
+    return [e for e in os.environ.get(
+        "PADDLE_PSERVER_ENDPOINTS", "").split(",") if e]
+
+
+def init_server(*args, **kwargs):
+    """Bind this process's PS shard on PADDLE_PORT (reference:
+    fleet.init_server loads tables; table creation here is lazy on first
+    trainer touch)."""
+    from .ps_runtime import PsServer
+    port = int(os.environ.get("PADDLE_PORT", "0"))
+    _ps_state["server"] = PsServer(port=port)
+    return _ps_state["server"]
+
+
+def run_server():
+    """Serve until a trainer sends stop (reference: fleet.run_server —
+    blocks for the life of the job)."""
+    if _ps_state["server"] is None:
+        init_server()
+    _ps_state["server"].serve_forever()
+
+
+def init_worker(scopes=None):
+    """Trainer-side PS bring-up: wait for every server shard to answer
+    ping (reference: fleet.init_worker barriers on server readiness)."""
+    import socket as _s
+    import time as _t
+    deadline = _t.time() + 120
+    for ep in server_endpoints():
+        host, port = ep.rsplit(":", 1)
+        while True:
+            try:
+                _s.create_connection((host, int(port)), timeout=2).close()
+                break
+            except OSError:
+                if _t.time() > deadline:
+                    raise TimeoutError(f"PS endpoint {ep} never came up")
+                _t.sleep(0.2)
+
+
+def stop_worker():
+    """Reference: fleet.stop_worker — worker 0 also tells the servers to
+    exit (the launch controller's job-teardown contract)."""
+    try:
+        wid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        wid = 0
+    if wid == 0:
+        from .ps_runtime import send_control
+        for ep in server_endpoints():
+            try:
+                send_control(ep, "stop")
+            except Exception:
+                pass
+
+
+_barrier_seq = {"n": 0}
+
+
+def barrier_worker():
+    """Barrier across trainers (reference: fleet.barrier_worker). PS jobs
+    (PADDLE_TRAINERS_BARRIER_STORE set by the launch ps controller) use the
+    job's store with a fresh key per call — trainers call it the same
+    number of times in SPMD fashion; collective jobs use the collective
+    barrier."""
+    ep = os.environ.get("PADDLE_TRAINERS_BARRIER_STORE")
+    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if not ep:
+        from ..parallel import barrier
+        barrier()
+        return
+    if n <= 1:
+        return
+    from ..store import TCPStore
+    host, port = ep.rsplit(":", 1)
+    s = TCPStore(host, int(port), world_size=n)
+    _barrier_seq["n"] += 1
+    s.barrier(f"fleet_worker_barrier_{_barrier_seq['n']}", n)
+    s.close()
+
+
+import os  # noqa: E402  (used by the PS lifecycle helpers above)

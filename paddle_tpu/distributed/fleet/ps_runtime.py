@@ -1,0 +1,214 @@
+"""Parameter-server runtime: server process + remote table client.
+
+Reference (SURVEY §2.2): the brpc PS — PSServer/PSClient (ps/service/
+ps_client.h:64, server.h:62) with sharded MemorySparseTables, driven by
+fleet's worker/server lifecycle (fleet.py:635-679 init_server/run_server/
+init_worker/stop_worker) and launched by the launch CLI's ps controller.
+
+TPU-native deployment: servers are plain CPU processes holding the host-RAM
+SparseTables (distributed/ps.py); trainers talk to them over the same
+pickle-frame protocol the rpc module uses. The dense model never touches
+this path — it trains on-device via XLA; only the sparse embedding
+pull/push rides the PS (the HeterPS split, redesigned per SURVEY §7).
+
+Env contract (reference PaddleCloudRoleMaker):
+    TRAINING_ROLE=PSERVER|TRAINER
+    PADDLE_PSERVER_ENDPOINTS=h1:p1,h2:p2   PADDLE_PORT / POD_IP (server)
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM (trainer)
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional
+
+from ..ps import SparseTable
+
+
+def _send_frame(sock, obj):
+    data = pickle.dumps(obj)
+    sock.sendall(struct.pack("!I", len(data)) + data)
+
+
+def _recv_frame(sock):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    n = struct.unpack("!I", hdr)[0]
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class PsServer:
+    """One PS shard process: serves pull/push/merge_delta/save/load for any
+    number of named tables (created on first touch with the client's
+    config) until `stop` arrives. Per-table locks keep independent tables
+    concurrent under the threading server; only creation takes the global
+    lock."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+        self.tables: Dict[str, SparseTable] = {}
+        self._lock = threading.Lock()            # table-registry creation
+        self._table_locks: Dict[str, threading.Lock] = {}
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        cmd, table, args = _recv_frame(self.request)
+                    except (ConnectionError, EOFError):
+                        return
+                    try:
+                        out = outer._dispatch(cmd, table, args)
+                    except Exception as e:  # keep serving on bad requests
+                        _send_frame(self.request, ("err", repr(e)))
+                        continue
+                    _send_frame(self.request, ("ok", out))
+                    if cmd == "stop":
+                        outer._srv.shutdown()
+                        return
+
+        socketserver.ThreadingTCPServer.allow_reuse_address = True
+        socketserver.ThreadingTCPServer.daemon_threads = True
+        self._srv = socketserver.ThreadingTCPServer((host, port), Handler)
+        self.port = self._srv.server_address[1]
+
+    def _dispatch(self, cmd, table, args):
+        if cmd == "ping":
+            return "pong"
+        if cmd == "stop":
+            return "bye"
+        if cmd == "create":
+            with self._lock:
+                if table not in self.tables:
+                    self.tables[table] = SparseTable(**args)
+                    self._table_locks[table] = threading.Lock()
+            return True
+        t = self.tables[table]
+        with self._table_locks[table]:
+            if cmd == "pull":
+                return t.pull(args)
+            if cmd == "push":
+                ids, grads = args
+                t.push(ids, grads)
+                return True
+            if cmd == "merge_delta":
+                ids, delta = args
+                t.merge_delta(ids, delta)
+                return True
+            if cmd == "save":
+                t.save(args)
+                return True
+            if cmd == "load":
+                t.load(args)
+                return True
+            if cmd == "size":
+                return len(t)
+        raise ValueError(f"unknown command {cmd!r}")
+
+    def serve_forever(self):
+        """Block serving requests (reference: fleet.run_server)."""
+        self._srv.serve_forever()
+
+    def serve_in_thread(self):
+        th = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        th.start()
+        return th
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class RemoteShard:
+    """SparseTable duck-type over one PS endpoint (the PSClient of the
+    reference, ps_client.h:64 — pull_sparse/push_sparse)."""
+
+    def __init__(self, endpoint: str, table: str, dim: int,
+                 optimizer: str = "adagrad", lr: float = 0.05,
+                 init_scale: float = 0.01, seed: int = 0,
+                 timeout: float = 60.0):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._lock = threading.Lock()
+        self.table = table
+        self.dim = dim
+        self.lr = lr
+        self._call("create", dict(dim=dim, optimizer=optimizer, lr=lr,
+                                  init_scale=init_scale, seed=seed))
+
+    def _call(self, cmd, args=None):
+        with self._lock:
+            _send_frame(self._sock, (cmd, self.table, args))
+            status, out = _recv_frame(self._sock)
+        if status != "ok":
+            raise RuntimeError(f"PS {cmd} failed: {out}")
+        return out
+
+    def pull(self, ids):
+        return self._call("pull", ids)
+
+    def push(self, ids, grads):
+        return self._call("push", (ids, grads))
+
+    def merge_delta(self, ids, delta):
+        return self._call("merge_delta", (ids, delta))
+
+    def save(self, path):
+        return self._call("save", path)
+
+    def load(self, path):
+        return self._call("load", path)
+
+    def __len__(self):
+        return self._call("size")
+
+    def stop_server(self):
+        try:
+            self._call("stop")
+        except (RuntimeError, ConnectionError):
+            pass
+
+    def close(self):
+        self._sock.close()
+
+
+def connect_remote_tables(dim: int, table: str = "embedding",
+                          endpoints: Optional[List[str]] = None,
+                          optimizer: str = "adagrad", lr: float = 0.05,
+                          init_scale: float = 0.01, seed: int = 0):
+    """Shard clients for every server endpoint (id % n_endpoints routing —
+    the same layout DistributedEmbedding uses locally)."""
+    eps = endpoints or os.environ.get("PADDLE_PSERVER_ENDPOINTS", "").split(",")
+    eps = [e for e in eps if e]
+    if not eps:
+        raise RuntimeError("no PS endpoints: set PADDLE_PSERVER_ENDPOINTS or "
+                           "pass endpoints=")
+    return [RemoteShard(e, table, dim, optimizer, lr,
+                        init_scale=init_scale, seed=seed + i)
+            for i, e in enumerate(eps)]
+
+
+def send_control(endpoint: str, cmd: str, timeout: float = 10.0):
+    """Fire a control command (ping/stop) without creating any table."""
+    host, port = endpoint.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        _send_frame(s, (cmd, "__ctl__", None))
+        status, out = _recv_frame(s)
+    if status != "ok":
+        raise RuntimeError(f"PS {cmd} failed: {out}")
+    return out

@@ -18,7 +18,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .controllers import CollectiveController
+from .controllers import CollectiveController, PSController
 
 
 def parse_args(argv=None):
@@ -43,6 +43,14 @@ def parse_args(argv=None):
     p.add_argument("--coordinator_port", type=int, default=6171)
     p.add_argument("--devices_per_proc", type=int, default=0,
                    help="emulate N CPU devices per process (testing)")
+    p.add_argument("--run_mode", default="collective",
+                   choices=["collective", "ps"],
+                   help="collective (SPMD over chips) or ps (parameter "
+                        "servers + trainers; reference ps controller)")
+    p.add_argument("--server_num", type=int, default=1,
+                   help="[ps mode] PS shard processes")
+    p.add_argument("--trainer_num", type=int, default=1,
+                   help="[ps mode] trainer processes")
     p.add_argument("--poll_interval", type=float, default=0.5)
     p.add_argument("script", help="training script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
@@ -51,7 +59,8 @@ def parse_args(argv=None):
 
 def launch(argv=None) -> int:
     args = parse_args(argv)
-    return CollectiveController(args).run()
+    ctl = PSController if args.run_mode == "ps" else CollectiveController
+    return ctl(args).run()
 
 
 if __name__ == "__main__":

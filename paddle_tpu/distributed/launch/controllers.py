@@ -162,3 +162,82 @@ class CollectiveController:
 def _hostname() -> str:
     import socket
     return socket.gethostname()
+
+
+class PSController(CollectiveController):
+    """Parameter-server job controller (reference: launch/controllers/ps.py
+    — spawns PSERVER and TRAINER processes with the PaddleCloud role env).
+    Single-node form: --run_mode ps --server_num N --trainer_num M."""
+
+    def build_pod(self):
+        args = self.args
+        n_servers = getattr(args, "server_num", 1)
+        n_trainers = getattr(args, "trainer_num", 1)
+        base_port = args.start_port
+        server_eps = [f"127.0.0.1:{base_port + i}" for i in range(n_servers)]
+        barrier_ep = f"127.0.0.1:{base_port + n_servers}"
+
+        self.pod.clear()
+        common = {
+            "PADDLE_PSERVER_ENDPOINTS": ",".join(server_eps),
+            "PADDLE_TRAINERS_NUM": str(n_trainers),
+            "PADDLE_TRAINERS_BARRIER_STORE": barrier_ep,
+        }
+        if args.devices_per_proc:
+            common["JAX_PLATFORMS"] = "cpu"
+        for i, ep in enumerate(server_eps):
+            env = dict(common)
+            env.update({"TRAINING_ROLE": "PSERVER",
+                        "PADDLE_PORT": ep.rsplit(":", 1)[1],
+                        "POD_IP": "127.0.0.1",
+                        "PADDLE_PSERVER_ID": str(i),
+                        "JAX_PLATFORMS": "cpu"})  # servers never touch chips
+            log = os.path.join(args.log_dir,
+                               f"serverlog.{i}") if args.log_dir else None
+            self.pod.add(Container(
+                [sys.executable, "-u", args.script] + list(args.script_args),
+                env, log))
+        for i in range(n_trainers):
+            env = dict(common)
+            env.update({"TRAINING_ROLE": "TRAINER",
+                        "PADDLE_TRAINER_ID": str(i)})
+            log = os.path.join(args.log_dir,
+                               f"workerlog.{i}") if args.log_dir else None
+            self.pod.add(Container(
+                [sys.executable, "-u", args.script] + list(args.script_args),
+                env, log))
+        # the CONTROLLER hosts the worker barrier store for the job's life
+        from ..store import MasterDaemon
+        if getattr(self, "_barrier_daemon", None) is None:
+            host, port = barrier_ep.rsplit(":", 1)
+            self._barrier_daemon = MasterDaemon(port=int(port))
+
+    def _watch(self) -> int:
+        """PS jobs finish when all TRAINERS exit; servers are told to stop
+        by worker 0 (fleet.stop_worker) or killed at teardown."""
+        n_servers = getattr(self.args, "server_num", 1)
+        trainers = self.pod.containers[n_servers:]
+        while True:
+            if all(not c.alive() for c in trainers):
+                code = 0
+                for c in trainers:
+                    code = code or (c.exit_code or 0)
+                self.pod.terminate()  # reap any server still up
+                self._stop_barrier_daemon()
+                return code
+            failed = self.pod.failed()
+            if failed is not None and failed in trainers:
+                tail = failed.tail_log()
+                if tail:
+                    sys.stderr.write(
+                        f"[launch] failed trainer log tail:\n{tail}\n")
+                self.pod.terminate()
+                self._stop_barrier_daemon()
+                return failed.exit_code or 1
+            time.sleep(self.args.poll_interval)
+
+    def _stop_barrier_daemon(self):
+        d = getattr(self, "_barrier_daemon", None)
+        if d is not None:
+            d.stop()
+            self._barrier_daemon = None

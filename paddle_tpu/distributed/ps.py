@@ -155,12 +155,23 @@ class DistributedEmbedding(Layer):
 
     def __init__(self, dim: int, num_shards: int = 1, optimizer: str = "adagrad",
                  lr: float = 0.05, init_scale: float = 0.01, seed: int = 0,
-                 name=None):
+                 endpoints=None, table_name: str = "embedding", name=None):
         super().__init__()
         self.dim = dim
-        self.num_shards = num_shards
-        self.tables = [SparseTable(dim, optimizer, lr, init_scale, seed=seed + s)
-                       for s in range(num_shards)]
+        if endpoints:
+            # remote mode: each PS endpoint owns one shard (reference: the
+            # distributed lookup against brpc PSServers; fleet/ps_runtime)
+            from .fleet.ps_runtime import connect_remote_tables
+            self.tables = connect_remote_tables(dim, table_name, endpoints,
+                                                optimizer, lr,
+                                                init_scale=init_scale,
+                                                seed=seed)
+            self.num_shards = len(self.tables)
+        else:
+            self.num_shards = num_shards
+            self.tables = [SparseTable(dim, optimizer, lr, init_scale,
+                                       seed=seed + s)
+                           for s in range(num_shards)]
         # anchor joins lookups to the autograd tape (host tables are not
         # jax arrays, so the tape needs a differentiable input to traverse)
         self._anchor = self.create_parameter([1])
