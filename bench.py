@@ -85,20 +85,21 @@ def bench_bert(on_tpu):
     import paddle_tpu.nn as nn
 
     B, S, iters = (32, 512, 8) if on_tpu else (2, 64, 2)
+    B = int(os.environ.get("PADDLE_TPU_BENCH_B", B))
+    S = int(os.environ.get("PADDLE_TPU_BENCH_S", S))
     cfg = bert_config("bert-base", max_position_embeddings=max(512, S))
     paddle.seed(0)
     model = BertForMaskedLM(cfg)
     if on_tpu:
         model.to(dtype="bfloat16")
-    ce = nn.CrossEntropyLoss()
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                 parameters=model.parameters())
+                                 parameters=model.parameters(),
+                                 moment_dtype="bfloat16" if on_tpu
+                                 else "float32")
 
-    def loss_fn(ids, lbl):
-        logits = model(ids)
-        return ce(logits.reshape([-1, cfg.vocab_size]), lbl.reshape([-1]))
-
-    step = TrainStep(model, opt, loss_fn)
+    # fused tied-decoder CE (no [B,S,vocab] logits; BertForMaskedLM.loss)
+    step = TrainStep(model, opt,
+                     lambda ids, lbl: model.loss(ids, lbl, chunk_size=256))
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
                                        (iters, B, S)).astype("int32"))

@@ -240,15 +240,43 @@ class BertForMaskedLM(Layer):
         # decoder tied to word embeddings (vocab-parallel logits)
         self.config = config
 
+    def _mlm_hidden(self, seq):
+        """The MLM head pipeline shared by logits and the fused loss."""
+        return self.ln(F.gelu(self.transform(seq), approximate=True))
+
     def mlm_logits(self, seq):
         """Shared MLM head: transform -> gelu -> LN -> tied logits."""
-        h = self.ln(F.gelu(self.transform(seq), approximate=True))
-        return _tied_logits(h, self.bert.embeddings.word_embeddings)
+        return _tied_logits(self._mlm_hidden(seq),
+                            self.bert.embeddings.word_embeddings)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         seq, _ = self.bert(input_ids, token_type_ids,
                            attention_mask=attention_mask)
         return self.mlm_logits(seq)
+
+    def loss(self, input_ids, labels, token_type_ids=None,
+             attention_mask=None, loss_mask=None, chunk_size: int = 256,
+             ignore_index: int = -100):
+        """Fused MLM loss: the tied decoder matmul runs inside the chunked
+        linear+softmax-CE (incubate.nn.functional), so [B, S, vocab] logits
+        never materialize — same mechanism as GPTForCausalLM.loss().
+        Positions with labels == ignore_index are masked out (the standard
+        MLM convention)."""
+        from ..incubate.nn.functional import fused_linear_cross_entropy
+        from ..core import ops
+        from .gpt import _masked_mean
+        seq, _ = self.bert(input_ids, token_type_ids,
+                           attention_mask=attention_mask)
+        h = self._mlm_hidden(seq)
+        w = self.bert.embeddings.word_embeddings.weight
+        safe_labels = ops.where(labels == ignore_index,
+                                ops.zeros_like(labels), labels)
+        per_tok = fused_linear_cross_entropy(h, w, safe_labels,
+                                             chunk_size=chunk_size)
+        ignore = ops.cast(labels != ignore_index, "float32")
+        mask = ignore if loss_mask is None else ignore * ops.cast(
+            loss_mask, "float32")
+        return _masked_mean(per_tok, mask)
 
 
 class BertForSequenceClassification(Layer):

@@ -102,3 +102,23 @@ class ErnieForMaskedLM(Layer):
         h, _ = self.ernie(input_ids, token_type_ids, position_ids,
                           attention_mask, task_type_ids)
         return _tied_logits(h, self.ernie.embeddings.word_embeddings)
+
+    def loss(self, input_ids, labels, token_type_ids=None, position_ids=None,
+             attention_mask=None, task_type_ids=None, loss_mask=None,
+             chunk_size: int = 256, ignore_index: int = -100):
+        """Fused MLM loss (chunked tied-decoder CE; see
+        BertForMaskedLM.loss)."""
+        from ..incubate.nn.functional import fused_linear_cross_entropy
+        from ..core import ops
+        from .gpt import _masked_mean
+        h, _ = self.ernie(input_ids, token_type_ids, position_ids,
+                          attention_mask, task_type_ids)
+        w = self.ernie.embeddings.word_embeddings.weight
+        safe_labels = ops.where(labels == ignore_index,
+                                ops.zeros_like(labels), labels)
+        per_tok = fused_linear_cross_entropy(h, w, safe_labels,
+                                             chunk_size=chunk_size)
+        mask = ops.cast(labels != ignore_index, "float32")
+        if loss_mask is not None:
+            mask = mask * ops.cast(loss_mask, "float32")
+        return _masked_mean(per_tok, mask)

@@ -7,7 +7,8 @@ import paddle_tpu.nn as nn
 from paddle_tpu.models import (BertConfig, BertForMaskedLM,
                                BertForPretraining,
                                BertForSequenceClassification, BertModel,
-                               ViTConfig, VisionTransformer)
+                               ViTConfig, VisionTransformer, bert_config,
+                               ernie_config)
 
 
 def tiny_bert(**kw):
@@ -197,3 +198,45 @@ def test_ernie_classification_and_mlm():
     mlm = ErnieForMaskedLM(cfg)
     out = mlm(ids)
     assert list(out.shape) == [2, 16, 128]
+
+
+def test_bert_fused_mlm_loss_matches_unfused():
+    """BertForMaskedLM.loss == CE over forward() logits at masked positions
+    (the -100 ignore-index contract)."""
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    cfg = bert_config("bert-base", vocab_size=128, hidden_size=64,
+                      num_layers=2, num_heads=4, max_position_embeddings=32,
+                      intermediate_size=128)
+    m = BertForMaskedLM(cfg)
+    m.eval()  # identical forwards need identical dropout (= none)
+    ids = paddle.to_tensor(np.random.randint(0, 128, (2, 16)).astype("int64"))
+    labels_np = np.random.randint(0, 128, (2, 16)).astype("int64")
+    labels_np[:, ::2] = -100          # only odd positions scored
+    labels = paddle.to_tensor(labels_np)
+
+    logits = m(ids)
+    ce = nn.CrossEntropyLoss(ignore_index=-100)
+    want = ce(logits.reshape([-1, 128]), labels.reshape([-1]))
+    got = m.loss(ids, labels, chunk_size=8)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+    got.backward()
+    assert np.isfinite(
+        m.bert.embeddings.word_embeddings.weight.grad.numpy()).all()
+
+
+def test_ernie_fused_mlm_loss_finite_and_trains():
+    from paddle_tpu.models import ErnieForMaskedLM
+    paddle.seed(0)
+    cfg = ernie_config("ernie-tiny", vocab_size=128, hidden_size=64,
+                       num_layers=2, num_heads=4,
+                       max_position_embeddings=32, intermediate_size=128)
+    m = ErnieForMaskedLM(cfg)
+    ids = paddle.to_tensor(np.random.randint(0, 128, (2, 16)).astype("int64"))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, opt,
+                                lambda a, b: m.loss(a, b, chunk_size=8))
+    l0 = float(step(ids, ids))
+    for _ in range(4):
+        l = float(step(ids, ids))
+    assert l < l0
