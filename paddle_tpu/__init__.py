@@ -11,6 +11,16 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# Integer-dtype contract: paddle's default integer dtype is int64
+# (reference: python/paddle/tensor/creation.py to_tensor — int lists become
+# int64). jax disables 64-bit types by default and silently truncates, which
+# would give users silent 32-bit wraparound. We enable x64 so int64 is real;
+# float defaults remain float32 because every creation op passes an explicit
+# dtype (get_default_dtype()). See MIGRATION.md "Integer dtypes".
+import jax as _jax  # noqa: E402
+
+_jax.config.update("jax_enable_x64", True)
+
 from .core.dtype import (  # noqa: F401
     float16, bfloat16, float32, float64, int8, int16, int32, int64,
     uint8, uint16, uint32, uint64, bool_, complex64, complex128,

@@ -36,7 +36,8 @@ def _arr(x):
 
 def _nodiff(fn, *args, **kw):
     """Run a non-differentiable op without tape recording."""
-    from .tensor import _static_record
+    from .tensor import _static_record, _no_implicit_f64
+    fn = _no_implicit_f64(fn)
     if _static_record is not None:
         res = _static_record(getattr(fn, "__name__", "op"), fn, list(args), kw, None)
         if res is not NotImplemented:
@@ -47,7 +48,20 @@ def _nodiff(fn, *args, **kw):
     return Tensor(out)
 
 
-def _unary(name, fn):
+def _floatify(a):
+    """Pre-cast integer/bool inputs of float-producing ops to the default
+    float dtype so the op never computes in (TPU-emulated) float64; the
+    output-side fold in tensor.py stays as the safety net."""
+    d = getattr(a, "dtype", None)
+    if d is not None and (jnp.issubdtype(d, jnp.integer) or d == jnp.bool_):
+        return a.astype(get_default_dtype())
+    return a
+
+
+def _unary(name, fn, float_only=False):
+    if float_only:
+        inner = fn
+        fn = lambda x: inner(_floatify(x))  # noqa: E731
     def op(x, name=None):
         return apply_op(name or op.__name__, fn, [x])
     op.__name__ = name
@@ -74,14 +88,14 @@ def _cmp(name, fn):
 
 
 # ---------------------------------------------------------------- math: unary
-exp = _unary("exp", jnp.exp)
-expm1 = _unary("expm1", jnp.expm1)
-log = _unary("log", jnp.log)
-log2 = _unary("log2", jnp.log2)
-log10 = _unary("log10", jnp.log10)
-log1p = _unary("log1p", jnp.log1p)
-sqrt = _unary("sqrt", jnp.sqrt)
-rsqrt = _unary("rsqrt", lax.rsqrt)
+exp = _unary("exp", jnp.exp, float_only=True)
+expm1 = _unary("expm1", jnp.expm1, float_only=True)
+log = _unary("log", jnp.log, float_only=True)
+log2 = _unary("log2", jnp.log2, float_only=True)
+log10 = _unary("log10", jnp.log10, float_only=True)
+log1p = _unary("log1p", jnp.log1p, float_only=True)
+sqrt = _unary("sqrt", jnp.sqrt, float_only=True)
+rsqrt = _unary("rsqrt", lax.rsqrt, float_only=True)
 square = _unary("square", jnp.square)
 abs = _unary("abs", jnp.abs)  # noqa: A001
 sign = _unary("sign", jnp.sign)
@@ -90,26 +104,26 @@ ceil = _unary("ceil", jnp.ceil)
 round = _unary("round", jnp.round)  # noqa: A001
 trunc = _unary("trunc", jnp.trunc)
 frac = _unary("frac", lambda x: x - jnp.trunc(x))
-reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
+reciprocal = _unary("reciprocal", lambda x: 1.0 / x, float_only=True)
 neg = _unary("neg", jnp.negative)
-sin = _unary("sin", jnp.sin)
-cos = _unary("cos", jnp.cos)
-tan = _unary("tan", jnp.tan)
-asin = _unary("asin", jnp.arcsin)
-acos = _unary("acos", jnp.arccos)
-atan = _unary("atan", jnp.arctan)
-sinh = _unary("sinh", jnp.sinh)
-cosh = _unary("cosh", jnp.cosh)
-tanh = _unary("tanh", jnp.tanh)
-asinh = _unary("asinh", jnp.arcsinh)
-acosh = _unary("acosh", jnp.arccosh)
-atanh = _unary("atanh", jnp.arctanh)
-sigmoid = _unary("sigmoid", jax.nn.sigmoid)
-logsigmoid = _unary("logsigmoid", jax.nn.log_sigmoid)
-erf = _unary("erf", lax.erf)
-erfinv = _unary("erfinv", lax.erf_inv)
-lgamma = _unary("lgamma", lax.lgamma)
-digamma = _unary("digamma", lax.digamma)
+sin = _unary("sin", jnp.sin, float_only=True)
+cos = _unary("cos", jnp.cos, float_only=True)
+tan = _unary("tan", jnp.tan, float_only=True)
+asin = _unary("asin", jnp.arcsin, float_only=True)
+acos = _unary("acos", jnp.arccos, float_only=True)
+atan = _unary("atan", jnp.arctan, float_only=True)
+sinh = _unary("sinh", jnp.sinh, float_only=True)
+cosh = _unary("cosh", jnp.cosh, float_only=True)
+tanh = _unary("tanh", jnp.tanh, float_only=True)
+asinh = _unary("asinh", jnp.arcsinh, float_only=True)
+acosh = _unary("acosh", jnp.arccosh, float_only=True)
+atanh = _unary("atanh", jnp.arctanh, float_only=True)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid, float_only=True)
+logsigmoid = _unary("logsigmoid", jax.nn.log_sigmoid, float_only=True)
+erf = _unary("erf", lax.erf, float_only=True)
+erfinv = _unary("erfinv", lax.erf_inv, float_only=True)
+lgamma = _unary("lgamma", lax.lgamma, float_only=True)
+digamma = _unary("digamma", lax.digamma, float_only=True)
 angle = _unary("angle", jnp.angle)
 conj = _unary("conj", jnp.conj)
 real = _unary("real", jnp.real)
@@ -123,7 +137,7 @@ isfinite = lambda x, name=None: _nodiff(jnp.isfinite, x)
 add = _binary("add", jnp.add)
 subtract = _binary("subtract", jnp.subtract)
 multiply = _binary("multiply", jnp.multiply)
-divide = _binary("divide", jnp.divide)
+divide = _binary("divide", lambda x, y: jnp.divide(_floatify(x), _floatify(y)))
 floor_divide = _binary("floor_divide", jnp.floor_divide)
 mod = _binary("mod", jnp.mod)
 remainder = mod
@@ -163,7 +177,10 @@ def multiplex(inputs, index, name=None):
 
 
 # ---------------------------------------------------------------- reductions
-def _reduce(name, fn):
+def _reduce(name, fn, float_only=False):
+    if float_only:
+        inner = fn
+        fn = lambda a, **kw: inner(_floatify(a), **kw)  # noqa: E731
     def op(x, axis=None, keepdim=False, name=None):
         if isinstance(axis, (list, tuple)):
             axis = tuple(axis)
@@ -174,37 +191,37 @@ def _reduce(name, fn):
 
 
 sum = _reduce("sum", jnp.sum)  # noqa: A001
-mean = _reduce("mean", jnp.mean)
+mean = _reduce("mean", jnp.mean, float_only=True)
 prod = _reduce("prod", jnp.prod)
 max = _reduce("max", jnp.max)  # noqa: A001
 min = _reduce("min", jnp.min)  # noqa: A001
 amax = _reduce("amax", jnp.max)
 amin = _reduce("amin", jnp.min)
-nanmean = _reduce("nanmean", jnp.nanmean)
+nanmean = _reduce("nanmean", jnp.nanmean, float_only=True)
 nansum = _reduce("nansum", jnp.nansum)
-logsumexp = _reduce("logsumexp", jax.scipy.special.logsumexp)
+logsumexp = _reduce("logsumexp", jax.scipy.special.logsumexp, float_only=True)
 
 
 def std(x, axis=None, unbiased=True, keepdim=False, name=None):
     ddof = 1 if unbiased else 0
     if isinstance(axis, (list, tuple)):
         axis = tuple(axis)
-    return apply_op("std", lambda a: jnp.std(a, axis=axis, ddof=ddof, keepdims=keepdim), [x])
+    return apply_op("std", lambda a: jnp.std(_floatify(a), axis=axis, ddof=ddof, keepdims=keepdim), [x])
 
 
 def var(x, axis=None, unbiased=True, keepdim=False, name=None):
     ddof = 1 if unbiased else 0
     if isinstance(axis, (list, tuple)):
         axis = tuple(axis)
-    return apply_op("var", lambda a: jnp.var(a, axis=axis, ddof=ddof, keepdims=keepdim), [x])
+    return apply_op("var", lambda a: jnp.var(_floatify(a), axis=axis, ddof=ddof, keepdims=keepdim), [x])
 
 
 def median(x, axis=None, keepdim=False, name=None):
-    return apply_op("median", lambda a: jnp.median(a, axis=axis, keepdims=keepdim), [x])
+    return apply_op("median", lambda a: jnp.median(_floatify(a), axis=axis, keepdims=keepdim), [x])
 
 
 def quantile(x, q, axis=None, keepdim=False, name=None):
-    return apply_op("quantile", lambda a: jnp.quantile(a, q, axis=axis, keepdims=keepdim), [x])
+    return apply_op("quantile", lambda a: jnp.quantile(_floatify(a), q, axis=axis, keepdims=keepdim), [x])
 
 
 def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
@@ -871,9 +888,10 @@ def ones(shape, dtype=None, name=None):
 
 def full(shape, fill_value, dtype=None, name=None):
     fv = _arr(fill_value) if isinstance(fill_value, Tensor) else fill_value
-    if dtype is None:
-        return Tensor(jnp.full(shape, fv))
-    return Tensor(jnp.full(shape, fv, dtype=convert_dtype(dtype)))
+    # dtype=None -> float32 (reference: tensor/creation.py full, "if dtype is
+    # None: dtype = 'float32'"), never weak-type promotion.
+    dt = convert_dtype(dtype) if dtype is not None else get_default_dtype()
+    return Tensor(jnp.full(shape, fv, dtype=dt))
 
 
 def empty(shape, dtype=None, name=None):

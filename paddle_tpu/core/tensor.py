@@ -17,6 +17,8 @@ jax.vjp closure on the tape.
 """
 from __future__ import annotations
 
+import builtins
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -226,6 +228,42 @@ def _install_amp_hook():
     _amp_cast = amp_cast_inputs
 
 
+# float64 is opt-in (MIGRATION.md "Integer dtypes"): with x64 enabled for
+# real int64 semantics, ops like divide/mean/sin would promote integer
+# inputs to float64 — slow software emulation on TPU and a dtype surprise.
+# Policy: unless an input already IS 64-bit inexact (user opted in) or the
+# op is an explicit cast, 64-bit inexact outputs fold back to 32-bit.
+_F64_OPT_IN_OPS = frozenset({"cast", "astype"})
+_F64 = np.dtype("float64")
+_C128 = np.dtype("complex128")
+
+
+def _no_implicit_f64(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*xs, **kw):
+        out = fn(*xs, **kw)
+        if builtins.any(getattr(x, "dtype", None) in (_F64, _C128) for x in xs):
+            return out
+
+        def fix(o):
+            d = getattr(o, "dtype", None)
+            if d == _F64:
+                return o.astype(jnp.float32)
+            if d == _C128:
+                return o.astype(jnp.complex64)
+            return o
+
+        if isinstance(out, (tuple, list)):
+            fixed = [fix(o) for o in out]
+            if hasattr(out, "_fields"):        # namedtuple (e.g. SVDResult)
+                return type(out)(*fixed)
+            return type(out)(fixed)
+        return fix(out)
+    return wrapped
+
+
 def apply_op(name, fn, tensor_args, static_kwargs=None, n_outputs=None):
     """Run `fn(*arrays, **static_kwargs)` eagerly, recording a tape node.
 
@@ -239,6 +277,8 @@ def apply_op(name, fn, tensor_args, static_kwargs=None, n_outputs=None):
     policies instead of per-op rewrite).
     """
     static_kwargs = static_kwargs or {}
+    if name not in _F64_OPT_IN_OPS:
+        fn = _no_implicit_f64(fn)
     if _static_record is not None:
         res = _static_record(name, fn, tensor_args, static_kwargs, n_outputs)
         if res is not NotImplemented:
@@ -306,8 +346,14 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tenso
         arr = data
     else:
         arr = np.asarray(data)
-        if arr.dtype == np.float64 and dtype is None:
-            arr = arr.astype(get_default_dtype())
+        # 64-bit inexact stays opt-in (MIGRATION.md): python/numpy float
+        # and complex default to their 32-bit paddle defaults unless the
+        # caller passes dtype= explicitly
+        if dtype is None:
+            if arr.dtype == np.float64:
+                arr = arr.astype(get_default_dtype())
+            elif arr.dtype == np.complex128:
+                arr = arr.astype(np.complex64)
     dt = convert_dtype(dtype)
     arr = jnp.asarray(arr, dtype=dt) if dt is not None else jnp.asarray(arr)
     return Tensor(arr, stop_gradient=stop_gradient)

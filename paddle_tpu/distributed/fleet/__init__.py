@@ -60,7 +60,11 @@ def distributed_model(model):
         raise RuntimeError("call fleet.init() first")
     mode = hcg.get_parallel_mode()
     if mode == "pipeline":
-        from ..pipeline import PipelineParallel
+        from ..pipeline import CompiledPipelineParallel, PipelineParallel
+        if getattr(model, "supports_compiled_pp", False):
+            # stacked-stage model (models/gpt_stacked.py contract): pp runs
+            # as ONE compiled program (pipeline_spmd), not the eager GPipe loop
+            return CompiledPipelineParallel(model, hcg, _fleet_state["strategy"])
         return PipelineParallel(model, hcg, _fleet_state["strategy"])
     return model
 

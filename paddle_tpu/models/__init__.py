@@ -10,6 +10,9 @@ from .gpt import (  # noqa: F401
     GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
     gpt_config, PRESETS as GPT_PRESETS,
 )
+from .gpt_stacked import (  # noqa: F401
+    GPTStackedForCausalLM,
+)
 from .bert import (  # noqa: F401
     BertConfig, BertModel, BertForMaskedLM, BertForSequenceClassification,
     BertForPretraining, bert_config,

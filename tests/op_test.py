@@ -39,6 +39,8 @@ class OpTest:
     grad_rtol: float = 1e-2
     grad_atol: float = 1e-3
     numeric_delta: float = 5e-3   # reference: numeric_grad_delta=0.005
+    check_static: bool = True     # dual-executor check (skip for ops whose
+                                  # python fallback needs concrete values)
 
     def __init__(self):
         self.attrs = self.attrs or {}
@@ -77,6 +79,13 @@ class OpTest:
         multi = isinstance(want, (tuple, list))
 
         got_eager = self._run_eager(self.inputs)
+        if not self.check_static:
+            outs = got_eager if multi else [got_eager]
+            wants = want if multi else [want]
+            for w, ge in zip(wants, outs):
+                np.testing.assert_allclose(ge.numpy(), w, rtol=self.rtol,
+                                           atol=self.atol, err_msg="eager")
+            return
         got_static = self._run_static(self.inputs)
         if multi:
             for w, ge, gs in zip(want, got_eager, [got_static] if not

