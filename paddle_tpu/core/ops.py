@@ -168,12 +168,11 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
 
 
 def multiplex(inputs, index, name=None):
-    arrs = [_arr(i) for i in inputs]
-    idx = _arr(index).reshape(-1)
-    def fn(*xs):
+    def fn(*args):
+        xs, idx = args[:-1], args[-1].reshape(-1)
         stacked = jnp.stack(xs, axis=0)
         return stacked[idx, jnp.arange(stacked.shape[1])]
-    return apply_op("multiplex", fn, list(inputs))
+    return apply_op("multiplex", fn, list(inputs) + [index])
 
 
 # ---------------------------------------------------------------- reductions
@@ -441,8 +440,9 @@ def moveaxis(x, source, destination, name=None):
     return apply_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), [x])
 
 
-def swapaxes(x, axis0, axis1, name=None):
-    return apply_op("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), [x])
+def swapaxes(x, axis1, axis2, name=None):
+    """Reference: paddle.swapaxes(x, axis1, axis2) (tensor/manipulation)."""
+    return apply_op("swapaxes", lambda a: jnp.swapaxes(a, axis1, axis2), [x])
 
 
 def squeeze(x, axis=None, name=None):
@@ -556,25 +556,24 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # n
 
 
 def gather(x, index, axis=0, name=None):
-    idx = _arr(index)
-    return apply_op("gather", lambda a: jnp.take(a, idx, axis=axis), [x])
+    return apply_op("gather", lambda a, i: jnp.take(a, i, axis=axis),
+                    [x, index])
 
 
 def gather_nd(x, index, name=None):
-    idx = _arr(index)
-    def fn(a):
-        return a[tuple(jnp.moveaxis(idx, -1, 0))]
-    return apply_op("gather_nd", fn, [x])
+    def fn(a, i):
+        return a[tuple(jnp.moveaxis(i, -1, 0))]
+    return apply_op("gather_nd", fn, [x, index])
 
 
 def take_along_axis(arr, indices, axis, name=None):
-    idx = _arr(indices)
-    return apply_op("take_along_axis", lambda a: jnp.take_along_axis(a, idx, axis=axis), [arr])
+    return apply_op("take_along_axis",
+                    lambda a, i: jnp.take_along_axis(a, i, axis=axis),
+                    [arr, indices])
 
 
 def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):  # noqa: A002
-    idx = _arr(indices)
-    def fn(a, v):
+    def fn(a, v, idx):
         v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
         dims = [jnp.arange(s).reshape([-1 if i == d else 1 for i in builtins.range(idx.ndim)])
                 for d, s in enumerate(idx.shape)]
@@ -587,59 +586,58 @@ def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):  # n
         if reduce in ("mul", "multiply"):
             return a.at[full_idx].multiply(v)
         raise ValueError(f"unknown reduce {reduce}")
-    return apply_op("put_along_axis", fn, [arr, values])
+    return apply_op("put_along_axis", fn, [arr, values, indices])
 
 
 def scatter(x, index, updates, overwrite=True, name=None):
     """Reference: paddle.scatter (phi scatter kernel) — row-wise scatter."""
-    idx = _arr(index)
-    def fn(a, u):
+    def fn(a, u, i):
         if overwrite:
-            return a.at[idx].set(u)
-        return a.at[idx].add(u)
-    return apply_op("scatter", fn, [x, updates])
+            return a.at[i].set(u)
+        return a.at[i].add(u)
+    return apply_op("scatter", fn, [x, updates, index])
 
 
 def scatter_nd_add(x, index, updates, name=None):
-    idx = _arr(index)
-    def fn(a, u):
-        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
-    return apply_op("scatter_nd_add", fn, [x, updates])
+    def fn(a, u, i):
+        return a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return apply_op("scatter_nd_add", fn, [x, updates, index])
 
 
 def scatter_nd(index, updates, shape, name=None):
-    idx = _arr(index)
-    def fn(u):
+    def fn(u, i):
         z = jnp.zeros(shape, dtype=u.dtype)
-        return z.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
-    return apply_op("scatter_nd", fn, [updates])
+        return z.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return apply_op("scatter_nd", fn, [updates, index])
 
 
 def index_select(x, index, axis=0, name=None):
-    idx = _arr(index)
-    return apply_op("index_select", lambda a: jnp.take(a, idx, axis=axis), [x])
+    return apply_op("index_select",
+                    lambda a, i: jnp.take(a, i, axis=axis), [x, index])
 
 
 def index_sample(x, index, name=None):
-    idx = _arr(index)
-    return apply_op("index_sample", lambda a: jnp.take_along_axis(a, idx, axis=1), [x])
+    return apply_op("index_sample",
+                    lambda a, i: jnp.take_along_axis(a, i, axis=1),
+                    [x, index])
 
 
 def index_add(x, index, axis, value, name=None):
-    idx = _arr(index)
-    def fn(a, v):
+    def fn(a, v, i):
         a_m = jnp.moveaxis(a, axis, 0)
         v_m = jnp.moveaxis(v, axis, 0)
-        out = a_m.at[idx].add(v_m)
+        out = a_m.at[i].add(v_m)
         return jnp.moveaxis(out, 0, axis)
-    return apply_op("index_add", fn, [x, value])
+    return apply_op("index_add", fn, [x, value, index])
 
 
 def index_put(x, indices, value, accumulate=False, name=None):
-    idxs = tuple(_arr(i) for i in indices)
-    def fn(a, v):
-        return a.at[idxs].add(v) if accumulate else a.at[idxs].set(v)
-    return apply_op("index_put", fn, [x, value])
+    if isinstance(indices, (Tensor, jax.Array, np.ndarray)):
+        indices = [indices]
+    def fn(a, v, *idxs):
+        return a.at[tuple(idxs)].add(v) if accumulate \
+            else a.at[tuple(idxs)].set(v)
+    return apply_op("index_put", fn, [x, value] + list(indices))
 
 
 def masked_select(x, mask, name=None):
@@ -650,19 +648,19 @@ def masked_select(x, mask, name=None):
 
 
 def masked_fill(x, mask, value, name=None):
-    mk = _arr(mask)
-    def fn(a, v):
-        return jnp.where(mk, v.astype(a.dtype) if hasattr(v, "astype") else v, a)
+    def fn(a, m, v):
+        return jnp.where(m, v.astype(a.dtype) if hasattr(v, "astype") else v, a)
     if isinstance(value, Tensor):
-        return apply_op("masked_fill", fn, [x, value])
-    return apply_op("masked_fill", lambda a: jnp.where(mk, value, a), [x])
+        return apply_op("masked_fill", fn, [x, mask, value])
+    return apply_op("masked_fill",
+                    lambda a, m: jnp.where(m, value, a), [x, mask])
 
 
 def where(condition, x=None, y=None, name=None):
     if x is None and y is None:
         return nonzero(condition, as_tuple=True)
-    cond = _arr(condition)
-    return apply_op("where", lambda a, b: jnp.where(cond, a, b), [x, y])
+    return apply_op("where", lambda c, a, b: jnp.where(c, a, b),
+                    [condition, x, y])
 
 
 def nonzero(x, as_tuple=False):
@@ -763,9 +761,25 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
-    arr = np.asarray(_arr(x))
-    from scipy import stats  # available via numpy ecosystem; fallback below
-    raise NotImplementedError("mode: host-side op, planned")
+    """Most frequent value along axis + index of its LAST occurrence
+    (reference: paddle.mode, phi mode kernel)."""
+    def fn(a):
+        am = jnp.moveaxis(a, axis, -1)
+        s = jnp.sort(am, axis=-1)
+        n = s.shape[-1]
+        # count of each sorted element = how many equal neighbors
+        eq = (s[..., :, None] == s[..., None, :])
+        counts = eq.sum(-1)
+        best = jnp.argmax(counts, axis=-1)          # first max-count slot
+        val = jnp.take_along_axis(s, best[..., None], -1)[..., 0]
+        # last occurrence index in the ORIGINAL order
+        is_mode = (am == val[..., None])
+        pos = jnp.arange(n)
+        idx = jnp.max(jnp.where(is_mode, pos, -1), axis=-1)
+        if keepdim:
+            return (jnp.expand_dims(val, axis), jnp.expand_dims(idx, axis))
+        return (val, idx)
+    return _nodiff(fn, x)
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, name=None):
@@ -811,10 +825,13 @@ def bincount(x, weights=None, minlength=0, name=None):
 
 
 def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
-    arr = np.asarray(_arr(input))
-    rng = None if (min == 0 and max == 0) else (min, max)
-    h, _ = np.histogram(arr, bins=bins, range=rng)
-    return Tensor(jnp.asarray(h))
+    def fn(a):
+        lo, hi = ((a.min(), a.max()) if (min == 0 and max == 0)
+                  else (jnp.asarray(min, a.dtype), jnp.asarray(max, a.dtype)))
+        edges = jnp.linspace(lo, hi, bins + 1)
+        h, _ = jnp.histogram(a, bins=edges)
+        return h
+    return _nodiff(fn, input)
 
 
 # ------------------------------------------------------------------ logical
@@ -1465,6 +1482,15 @@ def _attach_methods():
     T.__getitem__ = _getitem
     T.__setitem__ = _setitem
 
+    def _iter(s):
+        # Without an explicit __iter__, python's __getitem__ fallback never
+        # terminates: jax CLAMPS out-of-range gather indices instead of
+        # raising IndexError, so `for row in tensor` would loop forever.
+        if s.ndim == 0:
+            raise TypeError("iteration over a 0-D tensor")
+        return (s[i] for i in builtins.range(s.shape[0]))
+    T.__iter__ = _iter
+
     this = globals()
     method_names = [
         "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "square",
@@ -1528,7 +1554,9 @@ def unbind(x, axis=0, name=None):
 
 
 def increment(x, value=1.0, name=None):
-    return x._replace(add(x, value))
+    out = add(x, value)
+    x._replace(out)
+    return out
 
 
 _attach_methods()

@@ -66,19 +66,19 @@ def linear(x, weight, bias=None, name=None):
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     """Reference: functional/input.py embedding. Gather from the table; rows
     at padding_idx produce zero gradient (masked in fwd so vjp zeroes it)."""
-    idx = _arr(x)
-    def fn(w):
+    def fn(idx, w):
         out = jnp.take(w, idx, axis=0)
         if padding_idx is not None:
             mask = (idx == padding_idx)[..., None]
             out = jnp.where(mask, 0.0, out)
         return out
-    return apply_op("embedding", fn, [weight])
+    return apply_op("embedding", fn, [x, weight])
 
 
 def one_hot(x, num_classes, name=None):
-    idx = _arr(x)
-    return Tensor(jax.nn.one_hot(idx, num_classes, dtype=jnp.float32))
+    return apply_op("one_hot",
+                    lambda i: jax.nn.one_hot(i, num_classes, dtype=jnp.float32),
+                    [x])
 
 
 # ----------------------------------------------------------------- convs
@@ -541,29 +541,37 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
 
     use_batch_stats = training and not use_global_stats
     if use_batch_stats:
-        batch_mean = jnp.mean(_arr(x), axis=reduce_axes)
-        batch_var = jnp.var(_arr(x), axis=reduce_axes)
-        if running_mean is not None:
-            running_mean._data = momentum * running_mean._data + (1 - momentum) * batch_mean
-            running_var._data = momentum * running_var._data + (1 - momentum) * batch_var
+        import jax as _jax
+        # running-stat updates are an EAGER side effect (paddle semantics);
+        # under jit/static tracing the value is symbolic — skip the update
+        # rather than leak a tracer into the buffer
+        _xv = _arr(x)
+        if not isinstance(_xv, (_jax.ShapeDtypeStruct, _jax.core.Tracer)):
+            batch_mean = jnp.mean(_arr(x), axis=reduce_axes)
+            batch_var = jnp.var(_arr(x), axis=reduce_axes)
+            if running_mean is not None:
+                running_mean._data = momentum * running_mean._data + (1 - momentum) * batch_mean
+                running_var._data = momentum * running_var._data + (1 - momentum) * batch_var
 
-    def fn(a, *wb):
+    def fn(a, *rest):
+        j = 0
         if use_batch_stats:
             mu = a.mean(axis=reduce_axes, keepdims=True)
             var = a.var(axis=reduce_axes, keepdims=True)
         else:
-            mu = running_mean._data.reshape(bshape)
-            var = running_var._data.reshape(bshape)
+            mu = rest[0].reshape(bshape)
+            var = rest[1].reshape(bshape)
+            j = 2
         out = (a - mu) * lax.rsqrt(var + epsilon)
-        i = 0
         if weight is not None:
-            out = out * wb[i].reshape(bshape)
-            i += 1
+            out = out * rest[j].reshape(bshape)
+            j += 1
         if bias is not None:
-            out = out + wb[i].reshape(bshape)
+            out = out + rest[j].reshape(bshape)
         return out.astype(a.dtype)
 
-    args = [x] + [t for t in (weight, bias) if t is not None]
+    args = [x] + ([] if use_batch_stats else [running_mean, running_var]) \
+        + [t for t in (weight, bias) if t is not None]
     return apply_op("batch_norm", fn, args)
 
 
@@ -644,10 +652,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
     """Reference: functional/loss.py cross_entropy → phi
     softmax_with_cross_entropy kernel. Stable log_softmax + gather; on TPU the
     whole thing fuses into a couple of VPU passes."""
-    lbl = _arr(label)
-    w = _arr(weight) if weight is not None else None
-
-    def fn(logits):
+    def fn(logits, lbl, *wargs):
+        w = wargs[0] if wargs else None
         if use_softmax:
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
         else:
@@ -686,7 +692,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
             denom = jnp.maximum(valid.sum(), 1)
             return jnp.sum(nll) / denom
         return _reduce_loss(nll, reduction)
-    return apply_op("cross_entropy", fn, [input])
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op("cross_entropy", fn, args)
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
@@ -701,10 +708,8 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
 
 
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):  # noqa: A002
-    lbl = _arr(label)
-    w = _arr(weight) if weight is not None else None
-
-    def fn(logp):
+    def fn(logp, lbl, *wargs):
+        w = wargs[0] if wargs else None
         idx = lbl.astype(jnp.int32)
         safe = jnp.where(idx == ignore_index, 0, idx)
         picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0] if logp.ndim == idx.ndim + 1 \
@@ -720,7 +725,8 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", nam
         if reduction == "mean":
             return jnp.sum(nll) / jnp.maximum(valid.sum(), 1)
         return _reduce_loss(nll, reduction)
-    return apply_op("nll_loss", fn, [input])
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op("nll_loss", fn, args)
 
 
 def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
@@ -930,10 +936,12 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
 
 
 def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
-    ln = _arr(lengths)
-    m = int(maxlen) if maxlen is not None else int(np.asarray(ln).max())
-    out = (jnp.arange(m)[None, :] < ln[..., None]).astype(convert_dtype(dtype))
-    return Tensor(out)
+    if maxlen is None:  # data-dependent width: eager host read
+        maxlen = int(np.asarray(_arr(lengths)).max())
+    m = int(maxlen)
+    def fn(ln):
+        return (jnp.arange(m)[None, :] < ln[..., None]).astype(convert_dtype(dtype))
+    return apply_op("sequence_mask", fn, [lengths])
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
@@ -1011,9 +1019,7 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
 
 
 def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
-    g = _arr(grid)
-
-    def fn(a):
+    def fn(a, g):
         n, c, h, w = a.shape
         gx = (g[..., 0] + 1) * (w - 1) / 2 if align_corners else ((g[..., 0] + 1) * w - 1) / 2
         gy = (g[..., 1] + 1) * (h - 1) / 2 if align_corners else ((g[..., 1] + 1) * h - 1) / 2
@@ -1034,7 +1040,7 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=Tr
                sample(y1, x0) * (wy1 * wx0)[..., None] +
                sample(y1, x1) * (wy1 * wx1)[..., None])
         return jnp.moveaxis(out, -1, 1)
-    return apply_op("grid_sample", fn, [x])
+    return apply_op("grid_sample", fn, [x, grid])
 
 
 def affine_grid(theta, out_shape, align_corners=True, name=None):
