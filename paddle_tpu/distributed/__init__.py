@@ -25,8 +25,11 @@ from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 from .sharding import group_sharded_parallel, shard_optimizer_state  # noqa: F401
 from .recompute import recompute, recompute_sequential, recompute_hybrid  # noqa: F401
 from .pipeline import (  # noqa: F401
-    LayerDesc, SharedLayerDesc, PipelineLayer, PipelineParallel, pipeline_scan, pipeline_scan_interleaved,
+    LayerDesc, SharedLayerDesc, PipelineLayer, PipelineParallel,
+    CompiledPipelineParallel, pipeline_scan, pipeline_scan_interleaved,
+    pipeline_spmd,
 )
+from .heter import MeshShardedEmbedding  # noqa: F401
 from ..ops.ring_attention import (  # noqa: F401
     ring_attention, ulysses_attention, sequence_parallel_attention,
 )

@@ -1,0 +1,254 @@
+"""HeterPS answer: device-resident mesh-sharded embedding cache with
+host-RAM spill.
+
+Reference (SURVEY §2.2, VERDICT r1 missing #1): HeterPS keeps hot embedding
+rows in GPU hash tables with inter-device comms and spills the long tail to
+CPU/SSD (framework/fleet/heter_ps/hashtable_kernel.cu, heter_comm_inl.h:1,
+ps_gpu_wrapper.cc). TPU redesign per SURVEY §7 ("embedding sharding over
+mesh + host offload"):
+
+  * A fixed-capacity row cache LIVES ON DEVICE as a jax array, sharded
+    P(axis, None) over the mesh — each device owns capacity/axis rows, the
+    XLA gather/scatter ride ICI (the heter_comm analog).
+  * Forward/backward never touch the host: lookup is `take` on the cached
+    table; the backward applies a merged row-wise adagrad scatter update
+    on device (the GPU-hashtable update kernel analog).
+  * An id→slot map + LRU admission runs on host; misses pull rows (and
+    their accumulator state) from the host-RAM spill tier (ps.SparseTable
+    semantics) and evictions write cold rows back — the only h2d/d2h
+    traffic, proportional to the MISS set, not the batch.
+  * `prefetch(next_ids)` overlaps that admission with the current step
+    (HeterPS's pull-ahead pipeline, ps_gpu_wrapper.cc BuildGPUTask).
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..autograd import PyLayer
+from ..nn.layer import Layer
+from . import mesh as _mesh
+
+
+def _adagrad_rowwise(table, g2, slots, inv, grads, lr, eps=1e-6):
+    """Merged row-sparse adagrad on device. `slots` [N] are unique slot ids
+    padded with the sentinel row; `inv` maps each grad row to its slot's
+    segment so duplicate ids merge BEFORE the accumulator update (the
+    reference's gradient-merge push semantics, memory_sparse_table.cc)."""
+    n = slots.shape[0]
+    g = jax.ops.segment_sum(grads, inv, num_segments=n)
+    g2n = g2.at[slots].add(g * g)
+    denom = jnp.sqrt(jnp.take(g2n, slots, axis=0)) + eps
+    tab = table.at[slots].add(-lr * g / denom)
+    return tab, g2n
+
+
+_adagrad_rowwise_jit = jax.jit(_adagrad_rowwise, donate_argnums=(0, 1))
+
+
+class _CacheLookup(PyLayer):
+    """take on the device cache; backward = on-device row-sparse update.
+    (The pull/push pair of ps.DistributedEmbedding with both sides staying
+    in HBM.)"""
+
+    @staticmethod
+    def forward(ctx, anchor, module, slots, uniq, inv, out_shape):
+        ctx.module = module
+        ctx.uniq = uniq
+        ctx.inv = inv
+        rows = jnp.take(module._table, slots, axis=0)
+        return Tensor(rows.reshape(out_shape))
+
+    @staticmethod
+    def backward(ctx, dy):
+        m = ctx.module
+        g = dy._data.reshape(-1, m.dim).astype(jnp.float32)
+        m._table, m._g2 = _adagrad_rowwise_jit(
+            m._table, m._g2, ctx.uniq, ctx.inv, g, jnp.float32(m.lr))
+        return Tensor(jnp.zeros((), jnp.float32))
+
+
+class MeshShardedEmbedding(Layer):
+    """Device-cached sparse embedding over a mesh axis with host spill.
+
+    capacity: number of device-resident rows (plus one internal sentinel).
+    axis:     mesh axis the cache rows shard over (replicated if absent).
+    Rows carry their adagrad accumulator with them when spilled/admitted, so
+    cache evictions are exact (same trajectory as an infinite cache).
+    """
+
+    def __init__(self, dim: int, capacity: int = 1 << 16, axis: str = "mp",
+                 lr: float = 0.05, init_scale: float = 0.01, seed: int = 0):
+        super().__init__()
+        self.dim = dim
+        self.capacity = int(capacity)
+        self.axis = axis
+        self.lr = lr
+        self._init_scale = init_scale
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+
+        m = _mesh.get_mesh()
+        ax = m.shape[axis] if (m is not None and axis in m.axis_names) else 1
+        nrows = -(-(self.capacity + 1) // ax) * ax  # sentinel + axis padding
+        tab = jnp.zeros((nrows, dim), jnp.float32)
+        g2 = jnp.zeros((nrows, dim), jnp.float32)
+        if ax > 1:
+            sh = NamedSharding(m, P(axis, None))
+            tab, g2 = jax.device_put(tab, sh), jax.device_put(g2, sh)
+        self._table, self._g2 = tab, g2
+
+        self._slot_of: "OrderedDict[int, int]" = OrderedDict()  # LRU order
+        self._free = list(range(self.capacity - 1, -1, -1))
+        # host spill tier: id -> (row, accumulator) (SparseTable semantics
+        # with optimizer state carried along)
+        self._spill: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        self._staged = None   # (key, slots, uniq, inv) from prefetch
+
+    # -- host-side admission -------------------------------------------
+    def _new_row(self):
+        return self._rng.uniform(-self._init_scale, self._init_scale,
+                                 self.dim).astype(np.float32)
+
+    def _admit(self, flat_ids: np.ndarray):
+        """Map ids -> device slots, inserting misses (from spill or fresh)
+        and evicting LRU rows to spill when full. Returns (slots, uniq
+        padded with sentinel, inv) as device arrays."""
+        uniq_ids, first_idx, inv = np.unique(flat_ids, return_index=True,
+                                             return_inverse=True)
+        # insert misses in first-occurrence order — the same creation order
+        # as SparseTable.pull, so init streams line up row for row
+        missing = [k for k in uniq_ids[np.argsort(first_idx)].tolist()
+                   if k not in self._slot_of]
+        if len(uniq_ids) > self.capacity:
+            raise ValueError(
+                f"batch touches {len(uniq_ids)} unique ids > cache capacity "
+                f"{self.capacity}; size the device cache to at least the "
+                f"per-batch working set (HeterPS build-task contract)")
+        if missing:
+            need = len(missing) - len(self._free)
+            if need > 0:
+                self._evict(need, protect=set(uniq_ids.tolist()))
+            ins_slots = np.empty(len(missing), np.int64)
+            ins_rows = np.empty((len(missing), self.dim), np.float32)
+            ins_g2 = np.zeros((len(missing), self.dim), np.float32)
+            for i, k in enumerate(missing):
+                slot = self._free.pop()
+                self._slot_of[k] = slot
+                ins_slots[i] = slot
+                spilled = self._spill.pop(k, None)
+                if spilled is not None:
+                    ins_rows[i], ins_g2[i] = spilled
+                else:
+                    ins_rows[i] = self._new_row()
+            self._table = self._table.at[jnp.asarray(ins_slots)].set(
+                jnp.asarray(ins_rows))
+            self._g2 = self._g2.at[jnp.asarray(ins_slots)].set(
+                jnp.asarray(ins_g2))
+        slots_np = np.empty(len(uniq_ids), np.int64)
+        for i, k in enumerate(uniq_ids.tolist()):
+            slots_np[i] = self._slot_of[k]
+            self._slot_of.move_to_end(k)          # LRU touch
+        # pad unique slots to the flat batch length so the backward's
+        # segment_sum shape is static across steps (no recompiles)
+        n = len(flat_ids)
+        uniq_pad = np.full(n, self.capacity, np.int64)  # sentinel row
+        uniq_pad[:len(uniq_ids)] = slots_np
+        return (jnp.asarray(slots_np[inv]), jnp.asarray(uniq_pad),
+                jnp.asarray(inv.astype(np.int32)))
+
+    def _evict(self, n: int, protect=frozenset()):
+        """Write the n least-recently-used rows (with accumulators) back to
+        the host spill tier and free their slots; never evicts `protect`
+        (the current batch's working set)."""
+        victims = []
+        for k in list(self._slot_of.keys()):
+            if len(victims) >= n:
+                break
+            if k not in protect:
+                victims.append(k)
+        slots = np.array([self._slot_of[k] for k in victims], np.int64)
+        rows = np.asarray(jnp.take(self._table, jnp.asarray(slots), axis=0))
+        g2 = np.asarray(jnp.take(self._g2, jnp.asarray(slots), axis=0))
+        for i, k in enumerate(victims):
+            self._spill[k] = (rows[i], g2[i])
+            del self._slot_of[k]
+            self._free.append(int(slots[i]))
+
+    # -- API ------------------------------------------------------------
+    def prefetch(self, ids):
+        """Stage admission for the NEXT forward (overlap with current
+        step). Thread-safe with forward."""
+        ids_np = np.asarray(ids._data if isinstance(ids, Tensor) else ids,
+                            np.int64)
+        def work():
+            with self._lock:
+                flat = ids_np.reshape(-1)
+                self._staged = (ids_np.tobytes(), *self._admit(flat))
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        return t
+
+    def forward(self, ids):
+        ids_np = np.asarray(ids._data if isinstance(ids, Tensor) else ids,
+                            np.int64)
+        flat = ids_np.reshape(-1)
+        with self._lock:
+            st = self._staged
+            if st is not None and st[0] == ids_np.tobytes():
+                _, slots, uniq, inv = st
+                self._staged = None
+            else:
+                slots, uniq, inv = self._admit(flat)
+        anchor = Tensor(jnp.zeros((), jnp.float32), stop_gradient=False)
+        out_shape = tuple(ids_np.shape) + (self.dim,)
+        return _CacheLookup.apply(anchor, self, slots, uniq, inv, out_shape)
+
+    # -- introspection / persistence ------------------------------------
+    def state_size(self) -> int:
+        return len(self._slot_of) + len(self._spill)
+
+    def resident_rows(self) -> int:
+        return len(self._slot_of)
+
+    def rows_for(self, ids) -> np.ndarray:
+        """Current row values for ids (device cache or spill) — test hook."""
+        out = np.empty((len(ids), self.dim), np.float32)
+        tab = np.asarray(self._table)
+        for i, k in enumerate(ids):
+            k = int(k)
+            if k in self._slot_of:
+                out[i] = tab[self._slot_of[k]]
+            elif k in self._spill:
+                out[i] = self._spill[k][0]
+            else:
+                raise KeyError(k)
+        return out
+
+    def save(self, path: str):
+        """Spill everything then persist id->(row, g2) shards (the table
+        Save contract, memory_sparse_table.cc Save)."""
+        with self._lock:
+            self._evict(len(self._slot_of))
+            keys = np.fromiter(self._spill.keys(), np.int64, len(self._spill))
+            rows = np.stack([self._spill[int(k)][0] for k in keys]) \
+                if len(keys) else np.zeros((0, self.dim), np.float32)
+            g2 = np.stack([self._spill[int(k)][1] for k in keys]) \
+                if len(keys) else np.zeros((0, self.dim), np.float32)
+            np.savez(path, keys=keys, rows=rows, g2=g2, dim=self.dim,
+                     lr=self.lr)
+
+    def load(self, path: str):
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        with self._lock:
+            self._spill = {int(k): (data["rows"][i], data["g2"][i])
+                           for i, k in enumerate(data["keys"])}
+            self._slot_of.clear()
+            self._free = list(range(self.capacity - 1, -1, -1))
