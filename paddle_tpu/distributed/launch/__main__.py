@@ -39,6 +39,12 @@ def parse_args(argv=None):
                    help="0=off, 1=fault-tolerant restart (reference "
                         "FAULT_TOLERANCE), 2=elastic scale (ELASTIC)")
     p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--elastic_ttl", type=float, default=60.0,
+                   help="heartbeat TTL (s) for elastic membership "
+                        "(reference: etcd TTL, elastic/manager.py)")
+    p.add_argument("--hold_patience", type=float, default=None,
+                   help="seconds to wait below quorum before exiting "
+                        "(default 3*elastic_ttl)")
     p.add_argument("--start_port", type=int, default=6170)
     p.add_argument("--coordinator_port", type=int, default=6171)
     p.add_argument("--devices_per_proc", type=int, default=0,

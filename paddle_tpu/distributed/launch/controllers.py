@@ -19,7 +19,13 @@ import uuid
 from typing import List, Optional
 
 from ..store import TCPStore, MasterDaemon
+from ..fleet.elastic import ElasticManager, ElasticStatus
 from .job import Container, Pod
+
+# _watch sentinels (reference: ElasticStatus driving the manager loop,
+# elastic/manager.py:46)
+MEMBERSHIP_RESTART = -1001   # rank-table rebuild + trainer restart
+QUORUM_EXIT = -1002          # below np_min past patience: terminal exit
 
 
 class CollectiveController:
@@ -42,6 +48,8 @@ class CollectiveController:
             self.nnodes_min = self.nnodes_max = int(nn)
             self.elastic = False
         self.nnodes = self.nnodes_min
+        self._manager: Optional[ElasticManager] = None
+        self._hold_since: Optional[float] = None
 
     # ------------------------------------------------------------- rendezvous
     def _rendezvous(self):
@@ -122,20 +130,66 @@ class CollectiveController:
             return f"{host}:{self.args.coordinator_port}"
         return f"127.0.0.1:{self.args.coordinator_port}"
 
+    # ------------------------------------------------------------- elastic
+    def _start_elastic(self):
+        """Join the heartbeat ring; derive nnodes/rank from LIVE membership
+        (a late joiner sees the running nodes and slots in after them)."""
+        if not self.elastic or self.store is None:
+            return
+        ttl = getattr(self.args, "elastic_ttl", 60.0)
+        self._manager = ElasticManager(
+            self.store, self.job_id, node_id=f"{self.node_rank:06d}",
+            np_min=self.nnodes_min, np_max=self.nnodes_max,
+            ttl=ttl, beat_interval=max(0.2, ttl / 6.0))
+        self._manager.start()
+        self._apply_membership()
+
+    def _apply_membership(self):
+        """Rank-table rebuild (reference: manager.py:126 — rank re-assign +
+        endpoint re-render on membership change)."""
+        live = self._manager.live_nodes()
+        self.nnodes = max(1, min(len(live), self.nnodes_max))
+        me = self._manager.node_id
+        self.node_rank = live.index(me) if me in live else 0
+        self._manager.mark_epoch()
+
     # ------------------------------------------------------------- run loop
     def run(self) -> int:
         self._rendezvous()
+        self._start_elastic()
         while True:
             self.build_pod()
             self.pod.start()
             code = self._watch()
             if code == 0:
+                if self._manager:
+                    self._manager.stop()
                 return 0
+            if code == QUORUM_EXIT:
+                # terminal: membership stayed below np_min past patience
+                self.pod.terminate()
+                if self._manager:
+                    self._manager.stop()
+                return 9
+            if code == MEMBERSHIP_RESTART:
+                # node joined/left: rebuild the rank table, re-render the
+                # env, restart trainers (reference ElasticStatus.RESTART)
+                self.pod.terminate()
+                old = (self.nnodes, self.node_rank)
+                self._apply_membership()
+                self._hold_since = None
+                sys.stderr.write(
+                    f"[launch] membership changed: nnodes {old[0]} -> "
+                    f"{self.nnodes}, rank {old[1]} -> {self.node_rank}; "
+                    f"restarting trainers\n")
+                continue
             # failure: restart per elastic level (reference ElasticStatus
             # RESTART path, fleet/elastic/manager.py:46)
             if self.args.elastic_level <= 0 or \
                     self.restarts >= self.args.max_restarts:
                 self.pod.terminate()
+                if self._manager:
+                    self._manager.stop()
                 return code
             self.restarts += 1
             sys.stderr.write(
@@ -156,6 +210,25 @@ class CollectiveController:
                     sys.stderr.write(f"[launch] failed worker log tail:\n{tail}\n")
                 self.pod.terminate()
                 return failed.exit_code or 1
+            if self._manager is not None:
+                st = self._manager.watch()
+                if st == ElasticStatus.RESTART:
+                    return MEMBERSHIP_RESTART
+                if st == ElasticStatus.HOLD and \
+                        len(self._manager.live_nodes()) < self.nnodes_min:
+                    # below quorum: wait for rejoin, escalate after patience
+                    now = time.time()
+                    patience = getattr(self.args, "hold_patience", None) \
+                        or 3 * self._manager.ttl
+                    if self._hold_since is None:
+                        self._hold_since = now
+                    elif now - self._hold_since > patience:
+                        sys.stderr.write(
+                            "[launch] below elastic quorum past patience; "
+                            "exiting\n")
+                        return QUORUM_EXIT
+                else:
+                    self._hold_since = None
             time.sleep(self.args.poll_interval)
 
 

@@ -272,3 +272,97 @@ def test_launch_multihost_dp_tp_training(tmp_path):
     assert l0 == l1, (l0, l1)   # SPMD: same global loss on every host
     vals = [float(x) for x in l0.split(",")]
     assert all(np.isfinite(v) for v in vals)
+
+
+# ----------------------------------------------------- elastic scale in/out
+ELASTIC_WORKER = textwrap.dedent("""
+    import os, sys, time
+    rank = os.environ["PADDLE_TPU_PROCESS_ID"]
+    world = os.environ["PADDLE_TPU_NUM_PROCESSES"]
+    out_dir = sys.argv[1]
+    secs = float(sys.argv[2])
+    with open(os.path.join(out_dir, f"gen_{rank}_{world}.txt"), "a") as f:
+        f.write(f"{rank}/{world}\\n")
+    time.sleep(secs)
+""")
+
+
+def _spawn_node(tmp_path, master, nnodes, secs, ttl="1.0", log=None):
+    script = tmp_path / "ew.py"
+    if not script.exists():
+        script.write_text(ELASTIC_WORKER)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nnodes", nnodes, "--master", master, "--elastic_level", "2",
+           "--elastic_ttl", ttl, "--poll_interval", "0.2",
+           "--hold_patience", "3",
+           str(script), str(tmp_path), str(secs)]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            cwd="/root/repo")
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_launch_elastic_scale_up(tmp_path):
+    """Node joins a running 1:2 job: the incumbent rebuilds the rank table
+    (nnodes 1 -> 2) and restarts its trainers (reference: manager.py:126
+    join -> RESTART)."""
+    master = f"127.0.0.1:{_free_port()}"
+    a = _spawn_node(tmp_path, master, "1:2", secs=120)
+    # wait for generation 1 (world=1) to start
+    t0 = time.time()
+    while not (tmp_path / "gen_0_1.txt").exists():
+        assert time.time() - t0 < 90, "gen1 never started"
+        assert a.poll() is None, a.communicate()[1]
+        time.sleep(0.2)
+    b = _spawn_node(tmp_path, master, "1:2", secs=2)
+    t0 = time.time()
+    # incumbent must restart into a 2-node world: rank 0 of world 2
+    while not (tmp_path / "gen_0_2.txt").exists():
+        assert time.time() - t0 < 120, (a.poll(), b.poll())
+        time.sleep(0.2)
+    assert (tmp_path / "gen_1_2.txt").exists() or \
+        _wait_file(tmp_path / "gen_1_2.txt", 60)
+    a.kill(); b.kill()
+    a.communicate(); b.communicate()
+
+
+def test_launch_elastic_scale_down(tmp_path):
+    """Node dies mid-job: the survivor notices the lost heartbeat, shrinks
+    the world (nnodes 2 -> 1), and restarts trainers (reference:
+    manager.py leave -> RESTART; FAULT_TOLERANCE would HOLD)."""
+    master = f"127.0.0.1:{_free_port()}"
+    a = _spawn_node(tmp_path, master, "1:2", secs=180)
+    assert _wait_file(tmp_path / "gen_0_1.txt", 90)
+    b = _spawn_node(tmp_path, master, "1:2", secs=180)
+    assert _wait_file(tmp_path / "gen_0_2.txt", 120)  # two-node generation up
+    gen1 = tmp_path / "gen_0_1.txt"
+    base = gen1.read_text()                        # BEFORE the kill (race)
+    b.kill()                                       # hard kill: no dereg
+    b.communicate()
+    # survivor must rebuild to world=1 after TTL expiry
+    t0 = time.time()
+    while gen1.read_text() == base:
+        assert time.time() - t0 < 120, "no scale-down restart"
+        assert a.poll() is None, a.communicate()[1][-2000:]
+        time.sleep(0.3)
+    a.kill()
+    a.communicate()
+
+
+def _wait_file(path, timeout):
+    t0 = time.time()
+    while not path.exists():
+        if time.time() - t0 > timeout:
+            return False
+        time.sleep(0.2)
+    return True
