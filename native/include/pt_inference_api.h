@@ -1,0 +1,55 @@
+/* C inference API for paddle_tpu exported models.
+ *
+ * Reference capability: paddle/fluid/inference/capi_exp/pd_inference_api.h
+ * (the plain-C predictor ABI). Artifact: the StableHLO export written by
+ * paddle_tpu.jit.save / static.save_inference_model (<path>.pdmodel +
+ * .pdmeta + .pdparams).
+ *
+ * Link against libptinfer.so (built by paddle_tpu.io.native.build_infer_capi
+ * or the g++ line in predictor_capi.cc). The library embeds a Python
+ * interpreter to host the XLA runtime; callers see only this C surface.
+ *
+ * Dtype codes (PD_TensorCopyFromCpu): 0 = float32, 1 = int64, 2 = int32.
+ */
+#ifndef PT_INFERENCE_API_H_
+#define PT_INFERENCE_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+typedef struct PD_Tensor PD_Tensor;
+
+PD_Config* PD_ConfigCreate(void);
+void PD_ConfigSetModel(PD_Config*, const char* prog_file,
+                       const char* params_file);
+void PD_ConfigDestroy(PD_Config*);
+
+PD_Predictor* PD_PredictorCreate(PD_Config*);
+void PD_PredictorDestroy(PD_Predictor*);
+size_t PD_PredictorGetInputNum(PD_Predictor*);
+size_t PD_PredictorGetOutputNum(PD_Predictor*);
+/* returned strings are malloc'd; caller frees with free() */
+char* PD_PredictorGetInputName(PD_Predictor*, size_t idx);
+char* PD_PredictorGetOutputName(PD_Predictor*, size_t idx);
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor*, const char* name);
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor*, const char* name);
+/* returns 1 on success */
+int PD_PredictorRun(PD_Predictor*);
+
+void PD_TensorDestroy(PD_Tensor*);
+void PD_TensorReshape(PD_Tensor*, size_t ndim, const int32_t* shape);
+size_t PD_TensorGetNumel(PD_Tensor*);
+size_t PD_TensorGetShape(PD_Tensor*, int32_t* shape_out, size_t max_ndim);
+int PD_TensorCopyFromCpu(PD_Tensor*, const void* data, int dtype);
+int PD_TensorCopyToCpu(PD_Tensor*, void* data, size_t nbytes);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PT_INFERENCE_API_H_ */

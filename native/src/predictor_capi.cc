@@ -1,0 +1,310 @@
+// C inference ABI over paddle_tpu exported artifacts.
+//
+// Reference capability: paddle/fluid/inference/capi_exp/pd_inference_api.h —
+// a plain-C predictor surface (create/run/get-output) so non-C++/Python
+// serving stacks (Go, Rust, Java via FFI) can execute exported models.
+//
+// TPU-native design: the artifact is a serialized StableHLO module
+// (jit.save/.pdmodel) whose execution engine IS the XLA runtime that jax
+// hosts. Rather than reimplementing a PJRT host in C++, this library embeds
+// CPython and drives paddle_tpu.inference.Predictor through the CPython C
+// API (the image has no pybind11 — plain Python.h). The C caller never sees
+// Python; the ABI below is self-contained and mirrors the capi_exp naming.
+//
+// Build (see io/native.py build_infer_capi):
+//   g++ -O2 -std=c++17 -shared -fPIC predictor_capi.cc \
+//       $(python3-config --includes) -lpython3.X -o libptinfer.so
+//
+// Threading: all entry points serialize on the GIL; one interpreter is
+// initialized lazily on first PD_ConfigCreate and kept for process life.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+struct PD_Config {
+  std::string prog_file;
+  std::string params_file;
+};
+
+struct PD_Predictor {
+  PyObject* predictor;  // paddle_tpu.inference.Predictor
+};
+
+struct PD_Tensor {
+  PyObject* handle;     // paddle_tpu.inference.Tensor (named handle)
+};
+
+static bool g_py_inited = false;
+
+static void ensure_python() {
+  if (!g_py_inited) {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+    }
+    g_py_inited = true;
+  }
+}
+
+// ---------------------------------------------------------------- Config
+PD_Config* PD_ConfigCreate() {
+  ensure_python();
+  return new PD_Config();
+}
+
+void PD_ConfigSetModel(PD_Config* c, const char* prog_file,
+                       const char* params_file) {
+  c->prog_file = prog_file ? prog_file : "";
+  c->params_file = params_file ? params_file : "";
+}
+
+void PD_ConfigDestroy(PD_Config* c) { delete c; }
+
+// ------------------------------------------------------------- Predictor
+PD_Predictor* PD_PredictorCreate(PD_Config* c) {
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PD_Predictor* out = nullptr;
+
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (mod) {
+    PyObject* cfg_cls = PyObject_GetAttrString(mod, "Config");
+    PyObject* cfg = cfg_cls ? PyObject_CallFunction(
+        cfg_cls, "ss", c->prog_file.c_str(), c->params_file.c_str()) : nullptr;
+    PyObject* create = cfg ? PyObject_GetAttrString(mod, "create_predictor")
+                           : nullptr;
+    PyObject* pred = create ? PyObject_CallFunctionObjArgs(create, cfg, nullptr)
+                            : nullptr;
+    if (pred) {
+      out = new PD_Predictor{pred};
+    }
+    Py_XDECREF(create);
+    Py_XDECREF(cfg);
+    Py_XDECREF(cfg_cls);
+    Py_DECREF(mod);
+  }
+  if (!out && PyErr_Occurred()) PyErr_Print();
+  PyGILState_Release(gil);
+  return out;
+}
+
+void PD_PredictorDestroy(PD_Predictor* p) {
+  if (!p) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(p->predictor);
+  PyGILState_Release(gil);
+  delete p;
+}
+
+static char* dup_pystr(PyObject* s) {
+  const char* c = PyUnicode_AsUTF8(s);
+  return strdup(c ? c : "");
+}
+
+size_t PD_PredictorGetInputNum(PD_Predictor* p) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* names = PyObject_CallMethod(p->predictor, "get_input_names", NULL);
+  size_t n = names ? static_cast<size_t>(PyList_Size(names)) : 0;
+  Py_XDECREF(names);
+  PyGILState_Release(gil);
+  return n;
+}
+
+// caller frees with free()
+char* PD_PredictorGetInputName(PD_Predictor* p, size_t idx) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* names = PyObject_CallMethod(p->predictor, "get_input_names", NULL);
+  char* out = nullptr;
+  if (names && idx < static_cast<size_t>(PyList_Size(names))) {
+    out = dup_pystr(PyList_GetItem(names, static_cast<Py_ssize_t>(idx)));
+  }
+  Py_XDECREF(names);
+  PyGILState_Release(gil);
+  return out;
+}
+
+size_t PD_PredictorGetOutputNum(PD_Predictor* p) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* names = PyObject_CallMethod(p->predictor, "get_output_names", NULL);
+  size_t n = names ? static_cast<size_t>(PyList_Size(names)) : 0;
+  Py_XDECREF(names);
+  PyGILState_Release(gil);
+  return n;
+}
+
+char* PD_PredictorGetOutputName(PD_Predictor* p, size_t idx) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* names = PyObject_CallMethod(p->predictor, "get_output_names", NULL);
+  char* out = nullptr;
+  if (names && idx < static_cast<size_t>(PyList_Size(names))) {
+    out = dup_pystr(PyList_GetItem(names, static_cast<Py_ssize_t>(idx)));
+  }
+  Py_XDECREF(names);
+  PyGILState_Release(gil);
+  return out;
+}
+
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* p, const char* name) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* h = PyObject_CallMethod(p->predictor, "get_input_handle", "s", name);
+  PyGILState_Release(gil);
+  if (!h) return nullptr;
+  return new PD_Tensor{h};
+}
+
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* p, const char* name) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* h = PyObject_CallMethod(p->predictor, "get_output_handle", "s", name);
+  PyGILState_Release(gil);
+  if (!h) return nullptr;
+  return new PD_Tensor{h};
+}
+
+int PD_PredictorRun(PD_Predictor* p) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(p->predictor, "run", NULL);
+  int ok = r != nullptr;
+  if (!ok && PyErr_Occurred()) PyErr_Print();
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return ok;
+}
+
+// ---------------------------------------------------------------- Tensor
+void PD_TensorDestroy(PD_Tensor* t) {
+  if (!t) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(t->handle);
+  PyGILState_Release(gil);
+  delete t;
+}
+
+void PD_TensorReshape(PD_Tensor* t, size_t ndim, const int32_t* shape) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* lst = PyList_New(static_cast<Py_ssize_t>(ndim));
+  for (size_t i = 0; i < ndim; ++i) {
+    PyList_SetItem(lst, static_cast<Py_ssize_t>(i),
+                   PyLong_FromLong(shape[i]));
+  }
+  PyObject* r = PyObject_CallMethod(t->handle, "reshape", "O", lst);
+  Py_XDECREF(r);
+  Py_DECREF(lst);
+  PyGILState_Release(gil);
+}
+
+size_t PD_TensorGetNumel(PD_Tensor* t) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  size_t n = 1;
+  PyObject* shape = PyObject_CallMethod(t->handle, "shape", NULL);
+  if (shape) {
+    Py_ssize_t nd = PySequence_Size(shape);
+    for (Py_ssize_t i = 0; i < nd; ++i) {
+      PyObject* d = PySequence_GetItem(shape, i);
+      n *= static_cast<size_t>(PyLong_AsLong(d));
+      Py_XDECREF(d);
+    }
+    Py_DECREF(shape);
+  }
+  PyGILState_Release(gil);
+  return n;
+}
+
+size_t PD_TensorGetShape(PD_Tensor* t, int32_t* shape_out, size_t max_ndim) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  size_t nd_out = 0;
+  PyObject* shape = PyObject_CallMethod(t->handle, "shape", NULL);
+  if (shape) {
+    Py_ssize_t nd = PySequence_Size(shape);
+    nd_out = static_cast<size_t>(nd);
+    for (Py_ssize_t i = 0; i < nd && static_cast<size_t>(i) < max_ndim; ++i) {
+      PyObject* d = PySequence_GetItem(shape, i);
+      shape_out[i] = static_cast<int32_t>(PyLong_AsLong(d));
+      Py_XDECREF(d);
+    }
+    Py_DECREF(shape);
+  }
+  PyGILState_Release(gil);
+  return nd_out;
+}
+
+// dtype codes follow capi_exp PD_DataType: 0=float32, 1=int64, 2=int32
+static const char* dtype_name(int dtype) {
+  switch (dtype) {
+    case 1: return "int64";
+    case 2: return "int32";
+    default: return "float32";
+  }
+}
+
+static int dtype_size(int dtype) { return dtype == 0 || dtype == 2 ? 4 : 8; }
+
+int PD_TensorCopyFromCpu(PD_Tensor* t, const void* data, int dtype) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int ok = 0;
+  size_t numel = 1;
+  {
+    PyObject* shape = PyObject_CallMethod(t->handle, "shape", NULL);
+    if (shape) {
+      Py_ssize_t nd = PySequence_Size(shape);
+      for (Py_ssize_t i = 0; i < nd; ++i) {
+        PyObject* d = PySequence_GetItem(shape, i);
+        numel *= static_cast<size_t>(PyLong_AsLong(d));
+        Py_XDECREF(d);
+      }
+      Py_DECREF(shape);
+    }
+  }
+  // np.frombuffer(bytes, dtype).reshape(handle.shape) -> copy_from_cpu
+  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data),
+      static_cast<Py_ssize_t>(numel * dtype_size(dtype)));
+  if (np && bytes) {
+    PyObject* arr = PyObject_CallMethod(np, "frombuffer", "Os", bytes,
+                                        dtype_name(dtype));
+    PyObject* shape = arr ? PyObject_CallMethod(t->handle, "shape", NULL) : nullptr;
+    PyObject* shaped = shape ? PyObject_CallMethod(arr, "reshape", "O", shape)
+                             : nullptr;
+    if (shaped) {
+      PyObject* r = PyObject_CallMethod(t->handle, "copy_from_cpu", "O", shaped);
+      ok = r != nullptr;
+      Py_XDECREF(r);
+    }
+    Py_XDECREF(shaped);
+    Py_XDECREF(shape);
+    Py_XDECREF(arr);
+  }
+  if (!ok && PyErr_Occurred()) PyErr_Print();
+  Py_XDECREF(bytes);
+  Py_XDECREF(np);
+  PyGILState_Release(gil);
+  return ok;
+}
+
+int PD_TensorCopyToCpu(PD_Tensor* t, void* data, size_t nbytes) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int ok = 0;
+  PyObject* arr = PyObject_CallMethod(t->handle, "copy_to_cpu", NULL);
+  if (arr) {
+    PyObject* contig = PyObject_CallMethod(arr, "tobytes", NULL);
+    if (contig) {
+      Py_ssize_t n = PyBytes_Size(contig);
+      if (static_cast<size_t>(n) <= nbytes) {
+        memcpy(data, PyBytes_AsString(contig), static_cast<size_t>(n));
+        ok = 1;
+      }
+      Py_DECREF(contig);
+    }
+    Py_DECREF(arr);
+  }
+  if (!ok && PyErr_Occurred()) PyErr_Print();
+  PyGILState_Release(gil);
+  return ok;
+}
+
+}  // extern "C"

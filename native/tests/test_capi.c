@@ -1,0 +1,63 @@
+/* C smoke test for the inference ABI (reference: capi_exp test programs).
+ *
+ * Usage: test_capi <model_path_prefix>
+ * Loads <prefix>.pdmodel/.pdmeta, feeds ones, runs, prints the first few
+ * output values, exits 0 on success. Compiled and driven by
+ * tests/test_inference_capi.py.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "pt_inference_api.h"
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <model_prefix>\n", argv[0]);
+    return 2;
+  }
+  PD_Config* cfg = PD_ConfigCreate();
+  PD_ConfigSetModel(cfg, argv[1], "");
+  PD_Predictor* pred = PD_PredictorCreate(cfg);
+  if (!pred) {
+    fprintf(stderr, "predictor create failed\n");
+    return 1;
+  }
+  size_t nin = PD_PredictorGetInputNum(pred);
+  if (nin < 1) {
+    fprintf(stderr, "no inputs\n");
+    return 1;
+  }
+  char* in_name = PD_PredictorGetInputName(pred, 0);
+  PD_Tensor* in = PD_PredictorGetInputHandle(pred, in_name);
+  size_t numel = PD_TensorGetNumel(in);
+  float* buf = (float*)malloc(numel * sizeof(float));
+  for (size_t i = 0; i < numel; ++i) buf[i] = 1.0f;
+  if (!PD_TensorCopyFromCpu(in, buf, 0)) {
+    fprintf(stderr, "copy_from failed\n");
+    return 1;
+  }
+  if (!PD_PredictorRun(pred)) {
+    fprintf(stderr, "run failed\n");
+    return 1;
+  }
+  char* out_name = PD_PredictorGetOutputName(pred, 0);
+  PD_Tensor* out = PD_PredictorGetOutputHandle(pred, out_name);
+  size_t onumel = PD_TensorGetNumel(out);
+  float* obuf = (float*)malloc(onumel * sizeof(float));
+  if (!PD_TensorCopyToCpu(out, obuf, onumel * sizeof(float))) {
+    fprintf(stderr, "copy_to failed\n");
+    return 1;
+  }
+  printf("in=%s numel=%zu out=%s numel=%zu first=%.6f\n", in_name, numel,
+         out_name, onumel, (double)obuf[0]);
+  free(buf);
+  free(obuf);
+  free(in_name);
+  free(out_name);
+  PD_TensorDestroy(in);
+  PD_TensorDestroy(out);
+  PD_PredictorDestroy(pred);
+  PD_ConfigDestroy(cfg);
+  return 0;
+}

@@ -35,9 +35,41 @@ def _sources():
     src_dir = os.path.dirname(_SRC)
     try:
         return sorted(os.path.join(src_dir, f) for f in os.listdir(src_dir)
-                      if f.endswith(".cc"))
+                      if f.endswith(".cc")
+                      and f != "predictor_capi.cc")  # own lib (needs libpython)
     except OSError:
         return [_SRC]
+
+
+_INFER_LIB = os.path.join(_LIB_DIR, "libptinfer.so")
+
+
+def build_infer_capi() -> Optional[str]:
+    """Build the C inference ABI (native/src/predictor_capi.cc →
+    libptinfer.so; header native/include/pt_inference_api.h). Separate from
+    libptnative because it embeds CPython. Returns the .so path, or None
+    with the error recorded (same contract as load_native)."""
+    import sysconfig
+    src = os.path.join(os.path.dirname(_SRC), "predictor_capi.cc")
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    if os.path.exists(_INFER_LIB) and \
+            os.path.getmtime(src) <= os.path.getmtime(_INFER_LIB):
+        return _INFER_LIB
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    ver = sysconfig.get_config_var("LDVERSION") or "3"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           f"-I{inc}", src, f"-L{libdir}", f"-lpython{ver}",
+           f"-Wl,-rpath,{libdir}", "-o", _INFER_LIB]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if r.returncode != 0:
+        import sys
+        sys.stderr.write(f"build_infer_capi failed: {r.stderr[-1500:]}\n")
+        return None
+    return _INFER_LIB
 
 
 def _build() -> Optional[str]:
