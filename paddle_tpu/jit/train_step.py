@@ -80,7 +80,14 @@ class TrainStep:
 
     # ------------------------------------------------------------------
     def _init_opt_state(self):
-        return [self.optimizer.init_state(p._data) for p in self._params]
+        def _init(p, name):
+            try:
+                return self.optimizer.init_state(p._data, param_obj=p,
+                                                 name=name)
+            except TypeError:   # optimizers with the older signature
+                return self.optimizer.init_state(p._data)
+        return [_init(p, n)
+                for p, n in zip(self._params, self._param_names)]
 
     def _shard_param_tree(self, tree_template):
         if self.mesh is None:

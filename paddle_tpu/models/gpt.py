@@ -254,7 +254,9 @@ class GPTModel(Layer):
         s = input_ids.shape[1]
         past = caches[0][0].shape[1] if caches else 0
         if position_ids is None:
-            position_ids = ops.arange(past, past + s, dtype="int64")
+            # int32: positions fit trivially and i64 gathers are 2x-emulated
+            # on TPU (MIGRATION.md "Integer dtypes")
+            position_ids = ops.arange(past, past + s, dtype="int32")
             position_ids = ops.unsqueeze(position_ids, 0)
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = apply_op("act_shard", lambda a: _mesh.shard_constraint(

@@ -47,10 +47,10 @@ def attention_reference(q, k, v, mask=None, is_causal=False, scale=None,
     if is_causal:
         s_q, s_k = logits.shape[-2], logits.shape[-1]
         cmask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
-        logits = jnp.where(cmask, logits, -1e30)
+        logits = jnp.where(cmask, logits, jnp.asarray(-1e30, logits.dtype))
     if mask is not None:
         if mask.dtype == jnp.bool_:
-            logits = jnp.where(mask, logits, -1e30)
+            logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
         else:
             logits = logits + mask.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)

@@ -37,10 +37,16 @@ DEFAULT_BK = 1024
 _NEG = -1e30
 
 
+def _i0():
+    # index-map literal: must be i32 — with x64 enabled a bare python 0
+    # traces as i64, which Mosaic refuses to return from the index fn
+    return jnp.int32(0)
+
+
 def _causal_mask(s, qi, ki, bq, bk):
     q_idx = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_idx = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return jnp.where(q_idx >= k_idx, s, _NEG)
+    return jnp.where(q_idx >= k_idx, s, jnp.asarray(_NEG, s.dtype))
 
 
 # ------------------------------------------------------------------ forward
@@ -98,12 +104,12 @@ def _flash_fwd(q, k, v, *, scale, causal, bq, bk, interpret):
                    jax.ShapeDtypeStruct((b * h, 8, s_q), jnp.float32)),
         grid=(b * h, s_q // bq, n_kb),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _i0())),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, _i0())),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, _i0())),
         ],
-        out_specs=(pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-                   pl.BlockSpec((1, 8, bq), lambda bh, qi, ki: (bh, 0, qi))),
+        out_specs=(pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, _i0())),
+                   pl.BlockSpec((1, 8, bq), lambda bh, qi, ki: (bh, _i0(), qi))),
         scratch_shapes=[pltpu.VMEM((bq, 1), jnp.float32),
                         pltpu.VMEM((bq, 1), jnp.float32),
                         pltpu.VMEM((bq, d), jnp.float32)],
@@ -199,14 +205,14 @@ def _flash_bwd(res, g, *, scale, causal, bq, bk, interpret):
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), qt.dtype),
         grid=(bh, n_qb, n_kb),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, 8, bq), lambda b, qi, ki: (b, 0, qi)),
-            pl.BlockSpec((1, 8, bq), lambda b, qi, ki: (b, 0, qi)),
+            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, _i0())),
+            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, _i0())),
+            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, _i0())),
+            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, _i0())),
+            pl.BlockSpec((1, 8, bq), lambda b, qi, ki: (b, _i0(), qi)),
+            pl.BlockSpec((1, 8, bq), lambda b, qi, ki: (b, _i0(), qi)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, _i0())),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, dot, lse, delta)
@@ -218,15 +224,15 @@ def _flash_bwd(res, g, *, scale, causal, bq, bk, interpret):
                    jax.ShapeDtypeStruct((bh, s_k, d), vt.dtype)),
         grid=(bh, n_kb, n_qb),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, 8, bq), lambda b, ki, qi: (b, 0, qi)),
-            pl.BlockSpec((1, 8, bq), lambda b, ki, qi: (b, 0, qi)),
+            pl.BlockSpec((1, bq, d), lambda b, ki, qi: (b, qi, _i0())),
+            pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, _i0())),
+            pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, _i0())),
+            pl.BlockSpec((1, bq, d), lambda b, ki, qi: (b, qi, _i0())),
+            pl.BlockSpec((1, 8, bq), lambda b, ki, qi: (b, _i0(), qi)),
+            pl.BlockSpec((1, 8, bq), lambda b, ki, qi: (b, _i0(), qi)),
         ],
-        out_specs=(pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0)),
-                   pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0))),
+        out_specs=(pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, _i0())),
+                   pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, _i0()))),
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
@@ -244,15 +250,23 @@ def _flash(q, k, v, scale, causal, bq, bk, interpret):
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret):
-    out, lse, (qt, kt, vt) = _flash_fwd(q, k, v, scale=scale, causal=causal,
-                                        bq=bq, bk=bk, interpret=interpret)
+    out, lse, _ = _flash_fwd(q, k, v, scale=scale, causal=causal,
+                             bq=bq, bk=bk, interpret=interpret)
     b, s_q, h, d = q.shape
     o = jnp.moveaxis(out.reshape(b, h, s_q, d), 1, 2)
-    return o, (qt, kt, vt, out, lse, (b, h))
+    # residuals: the ORIGINAL layouts (alias the layer's live tensors) — the
+    # [b*h, s, d] transposes are recomputed in bwd, saving 3 head-major
+    # copies of q/k/v in HBM across the whole backward (~100MB at 1.3B
+    # S=8192; the difference between fitting bf16 moments and OOM)
+    return o, (q, k, v, out, lse, (b, h))
 
 
 def _flash_vjp_bwd(scale, causal, bq, bk, interpret, res, g):
-    qt, kt, vt, out, lse, (b, h) = res
+    q, k, v, out, lse, (b, h) = res
+    d = q.shape[-1]
+    qt = jnp.moveaxis(q, 2, 1).reshape(b * h, q.shape[1], d)
+    kt = jnp.moveaxis(k, 2, 1).reshape(b * h, k.shape[1], d)
+    vt = jnp.moveaxis(v, 2, 1).reshape(b * h, v.shape[1], d)
     dq, dk, dv = _flash_bwd((qt, kt, vt, out, lse), g, scale=scale,
                             causal=causal, bq=bq, bk=bk, interpret=interpret)
     s_q, s_k, d = dq.shape[1], dk.shape[1], dq.shape[2]

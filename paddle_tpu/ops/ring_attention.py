@@ -48,7 +48,8 @@ def _block_attend(q, kb, vb, *, scale, causal, q_off, k_off, m, l, o):
         qpos = q_off + jnp.arange(q.shape[1])
         kpos = k_off + jnp.arange(kb.shape[1])
         keep = qpos[:, None] >= kpos[None, :]
-        logits = jnp.where(keep[None, None], logits, _NEG)
+        logits = jnp.where(keep[None, None], logits,
+                           jnp.asarray(_NEG, logits.dtype))
     m_blk = jnp.max(logits, axis=-1)
     m_new = jnp.maximum(m, m_blk)
     p = jnp.exp(logits - m_new[..., None])

@@ -113,7 +113,10 @@ class Optimizer:
         for p, g, wd in zip(params, grads, wd_applicable):
             st = self._states.get(id(p))
             if st is None:
-                st = self.init_state(p._data)
+                try:
+                    st = self.init_state(p._data, param_obj=p)
+                except TypeError:
+                    st = self.init_state(p._data)
                 self._states[id(p)] = st
             new_p, new_st = self._jit_update(wd)(p._data, g, st, jnp.float32(lr),
                                                  jnp.int32(self._step_count))
@@ -288,15 +291,25 @@ class Adam(Optimizer):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=True, moment_dtype="float32", name=None):
+                 multi_precision=True, moment_dtype="float32",
+                 q8_param_fun=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._q8 = str(moment_dtype) in ("int8", "uint8")
+        # q8_param_fun(name) -> bool: blockwise-int8 moments for SELECTED
+        # params (embedding tables are the usual target: wte+wpe moments are
+        # ~8% of a 1.3B model's optimizer HBM — the margin that fits the
+        # S=8192 config) while the rest keeps moment_dtype. Mirrors
+        # apply_decay_param_fun's shape.
+        self._q8_param_fun = q8_param_fun
         self._moment_dtype = (jnp.dtype(jnp.int8) if self._q8
                               else jnp.dtype(moment_dtype))
 
-    def init_state(self, param):
-        if self._q8:
+    def init_state(self, param, param_obj=None, name=None):
+        name = name or getattr(param_obj, "name", None)
+        use_q8 = self._q8 or (self._q8_param_fun is not None and name
+                              and self._q8_param_fun(name))
+        if use_q8:
             q, s = _q8_encode(jnp.zeros(param.shape, jnp.float32))
             vq, vs = _q8v_encode(jnp.zeros(param.shape, jnp.float32))
             return {"moment1_q": q, "moment1_s": s,
@@ -305,7 +318,7 @@ class Adam(Optimizer):
                 "moment2": jnp.zeros_like(param, dtype=self._moment_dtype)}
 
     def _moments(self, state, grad32, b1, b2):
-        if self._q8:
+        if "moment1_q" in state:
             shape = grad32.shape
             m0 = _q8_decode(state["moment1_q"], state["moment1_s"], shape)
             v0 = _q8v_decode(state["moment2_q"], state["moment2_s"], shape)
@@ -318,7 +331,7 @@ class Adam(Optimizer):
 
     def state_spec(self, param, key, state_array, base_spec):
         from jax.sharding import PartitionSpec as P
-        if self._q8 and key.endswith(("_q", "_s")):
+        if key.endswith(("_q", "_s")) and key.startswith("moment"):
             # codes [nb, BLOCK] / scales [nb]: shard the block dim over the
             # first axis the param's spec uses — the dominant 8-bit state
             # stays distributed (ZeRO axis included via base_spec). jax
@@ -337,8 +350,8 @@ class Adam(Optimizer):
             return P()
         return super().state_spec(param, key, state_array, base_spec)
 
-    def _pack_moments(self, m, v):
-        if self._q8:
+    def _pack_moments(self, m, v, q8=None):
+        if (q8 if q8 is not None else self._q8):
             mq, ms = _q8_encode(m)
             vq, vs = _q8v_encode(v)
             return {"moment1_q": mq, "moment1_s": ms,
@@ -357,7 +370,8 @@ class Adam(Optimizer):
         m_hat = m / (1 - jnp.power(b1, t))
         v_hat = v / (1 - jnp.power(b2, t))
         new_p = p32 - lr * m_hat / (jnp.sqrt(v_hat) + eps)
-        return new_p.astype(param.dtype), self._pack_moments(m, v)
+        return new_p.astype(param.dtype), self._pack_moments(
+            m, v, q8="moment1_q" in state)
 
 
 class AdamW(Adam):
@@ -367,9 +381,10 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, lr_ratio=None,
                  apply_decay_param_fun=None, grad_clip=None, multi_precision=True,
-                 moment_dtype="float32", name=None):
+                 moment_dtype="float32", q8_param_fun=None, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip, moment_dtype=moment_dtype, name=name)
+                         None, grad_clip, moment_dtype=moment_dtype,
+                         q8_param_fun=q8_param_fun, name=name)
         self._wd_coeff = weight_decay
         self._apply_decay_param_fun = apply_decay_param_fun
 
@@ -388,7 +403,8 @@ class AdamW(Adam):
         v_hat = v / (1 - jnp.power(b2, t))
         p32 = p32 * (1 - lr * wd)  # decoupled decay
         new_p = p32 - lr * m_hat / (jnp.sqrt(v_hat) + eps)
-        return new_p.astype(param.dtype), self._pack_moments(m, v)
+        return new_p.astype(param.dtype), self._pack_moments(
+            m, v, q8="moment1_q" in state)
 
 
 class Adamax(Optimizer):

@@ -238,3 +238,29 @@ def test_int8_moments_on_sharded_mesh():
                                 mesh=mesh, data_axes=("dp",))
     ids = paddle.to_tensor(np.random.randint(0, 128, (4, 16)).astype("int64"))
     assert np.isfinite(float(step(ids, ids)))
+
+
+def test_adam_selective_q8_embedding_moments():
+    """q8_param_fun: int8 moments for SELECTED params (embedding tables),
+    bf16/f32 for the rest — what fits the S=8192 long-context config on one
+    chip (bench.py r2 ladder). Mixed state kinds must train together."""
+    import jax.numpy as jnp
+    paddle.seed(0)
+    m = GPTForCausalLM(_tiny())
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-3, parameters=m.parameters(),
+        moment_dtype="bfloat16",
+        q8_param_fun=lambda n: "wte" in n or "wpe" in n)
+    ids = paddle.to_tensor(np.random.randint(0, 128, (2, 16)).astype("int64"))
+    step = paddle.jit.TrainStep(m, opt, lambda a, b: m.loss(a, b, chunk_size=8))
+    l0 = float(step(ids, ids))
+    for _ in range(6):
+        l = float(step(ids, ids))
+    assert l < l0
+    kinds = {}
+    for name, st in zip(step._param_names, step._opt_state):
+        kinds[name] = "q8" if "moment1_q" in st else str(st["moment1"].dtype)
+    embs = [k for k in kinds if "wte" in k or "wpe" in k]
+    others = [k for k in kinds if k not in embs]
+    assert embs and all(kinds[k] == "q8" for k in embs), kinds
+    assert others and all(kinds[k] == "bfloat16" for k in others), kinds
