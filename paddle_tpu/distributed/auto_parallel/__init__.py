@@ -298,3 +298,8 @@ def _as_tensors(batch):
         return tuple(b if isinstance(b, Tensor) else Tensor(jnp.asarray(np.asarray(b)))
                      for b in batch)
     return (batch if isinstance(batch, Tensor) else Tensor(jnp.asarray(np.asarray(batch))),)
+
+from .cost_model import (  # noqa: F401,E402
+    Cluster, ModelDesc, PlanCost, Planner, estimate_plan,
+    ring_all_reduce_time, all_gather_time, all_to_all_time,
+)

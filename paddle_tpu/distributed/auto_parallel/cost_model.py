@@ -1,0 +1,227 @@
+"""Auto-parallel cluster description, cost model, and mesh planner.
+
+Reference (SURVEY §2.2 auto-parallel row): cluster.py (device/topology
+JSON), cost/ + cost_model.py (per-op compute & comm cost), planner_v2.py /
+tuner/ (search over distributed plans). TPU-native collapse: the plan space
+is just the mesh factorization (dp × mp × pp × sp over N chips) plus remat
+on/off — XLA handles op placement — so the planner is an analytic
+enumerate-and-score over that small space:
+
+  compute  = model FLOPs / (chips · peak · efficiency)
+  TP comm  = per-layer activation collectives over the mp axis (ICI ring)
+  DP comm  = grad all-reduce over dp (overlap-discounted)
+  PP       = bubble factor (S-1)/(M+S-1) on top of compute
+  memory   = params/moments/grads sharded per axis + activation estimate;
+             plans that exceed per-chip HBM are rejected (the reference
+             tuner's pruner) unless remat brings them under.
+
+Numbers are estimates for RANKING plans, not predictions — the contract of
+the reference's cost model too (cost/base_cost.py calibrated constants).
+"""
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class Cluster:
+    """Device + interconnect description (reference: auto_parallel/cluster.py
+    builds the same facts from a cluster JSON)."""
+    num_chips: int = 8
+    peak_flops: float = 197e12          # bf16 matmul peak per chip
+    hbm_bytes: float = 15.75e9          # usable HBM per chip
+    hbm_bw: float = 819e9               # bytes/s
+    ici_bw: float = 45e9                # bytes/s per direction per link
+    dcn_bw: float = 6.25e9              # bytes/s across slices
+    mfu_ceiling: float = 0.75           # achievable fraction of peak
+
+    PRESETS = {
+        "v4": dict(peak_flops=275e12, hbm_bytes=32e9, hbm_bw=1200e9,
+                   ici_bw=50e9),
+        "v5e": dict(peak_flops=197e12, hbm_bytes=15.75e9, hbm_bw=819e9,
+                    ici_bw=45e9),
+        "v5p": dict(peak_flops=459e12, hbm_bytes=95e9, hbm_bw=2765e9,
+                    ici_bw=100e9),
+    }
+
+    @classmethod
+    def preset(cls, kind: str, num_chips: int) -> "Cluster":
+        return cls(num_chips=num_chips, **cls.PRESETS[kind])
+
+    @classmethod
+    def from_json(cls, path: str) -> "Cluster":
+        with open(path) as f:
+            return cls(**json.load(f))
+
+    def to_json(self, path: str):
+        with open(path, "w") as f:
+            json.dump(self.__dict__, f, indent=1)
+
+
+# ------------------------------------------------------------- comm costs
+def ring_all_reduce_time(nbytes: float, k: int, bw: float) -> float:
+    """Ring allreduce moves 2(k-1)/k of the buffer per chip."""
+    if k <= 1:
+        return 0.0
+    return 2 * (k - 1) / k * nbytes / bw
+
+
+def all_gather_time(nbytes: float, k: int, bw: float) -> float:
+    if k <= 1:
+        return 0.0
+    return (k - 1) / k * nbytes / bw
+
+
+def all_to_all_time(nbytes: float, k: int, bw: float) -> float:
+    if k <= 1:
+        return 0.0
+    return (k - 1) / k * nbytes / bw
+
+
+@dataclass
+class ModelDesc:
+    """Transformer shape facts the cost model needs (GPT family)."""
+    hidden: int
+    layers: int
+    heads: int
+    vocab: int
+    intermediate: Optional[int] = None
+    param_bytes: int = 2                # bf16 params
+    moment_bytes: int = 4               # 2 x bf16 moments
+    grad_bytes: int = 2
+
+    def __post_init__(self):
+        if self.intermediate is None:
+            self.intermediate = 4 * self.hidden
+
+    @property
+    def num_params(self) -> float:
+        h, m = self.hidden, self.intermediate
+        per_layer = 4 * h * h + 2 * h * m + 4 * h   # qkv+out + mlp + ln
+        return self.layers * per_layer + self.vocab * h + 4 * h
+
+
+@dataclass
+class PlanCost:
+    mesh: Dict[str, int]
+    step_time: float                    # seconds (estimate, for ranking)
+    compute_time: float
+    comm_time: float
+    bubble_frac: float
+    mem_per_chip: float                 # bytes
+    fits: bool
+    use_recompute: bool = False
+
+    def __repr__(self):
+        shape = "x".join(f"{k}{v}" for k, v in self.mesh.items() if v > 1) \
+            or "single"
+        return (f"PlanCost({shape}: step={self.step_time*1e3:.1f}ms "
+                f"comm={self.comm_time*1e3:.1f}ms mem={self.mem_per_chip/1e9:.1f}G"
+                f"{' remat' if self.use_recompute else ''}"
+                f"{'' if self.fits else ' OOM'})")
+
+
+def estimate_plan(model: ModelDesc, cluster: Cluster, mesh: Dict[str, int],
+                  batch: int, seq: int, micro_batches: int = 4,
+                  use_recompute: bool = False) -> PlanCost:
+    """Analytic step-time + memory for one mesh factorization."""
+    dp = mesh.get("dp", 1)
+    mp = mesh.get("mp", 1)
+    pp = mesh.get("pp", 1)
+    chips = dp * mp * pp
+    h, L, m, V = model.hidden, model.layers, model.intermediate, model.vocab
+    tokens = batch * seq
+
+    # ---- compute: 6ND + attention term, split over all chips
+    flops = 6 * model.num_params * tokens + 12 * L * h * seq * tokens
+    if use_recompute:
+        flops *= 4 / 3                          # extra forward in backward
+    compute = flops / (chips * cluster.peak_flops * cluster.mfu_ceiling)
+
+    # ---- TP comm: 2 allreduces of the activation per layer (attn out +
+    # mlp down), fwd + bwd, batch sharded over dp, seq over nothing
+    act_bytes = (batch // max(dp, 1)) * seq * h * 2   # bf16 activations
+    tp_comm = 2 * 2 * L * ring_all_reduce_time(act_bytes, mp, cluster.ici_bw)
+
+    # ---- DP comm: grad allreduce over dp, 50% overlappable with bwd
+    grad_bytes = model.num_params / (mp * pp) * model.grad_bytes
+    dp_comm = 0.5 * ring_all_reduce_time(grad_bytes, dp, cluster.ici_bw)
+
+    # ---- PP: activation ring transfers + bubble
+    pp_comm = 0.0
+    bubble = 0.0
+    if pp > 1:
+        M = micro_batches
+        bubble = (pp - 1) / (M + pp - 1)
+        pp_comm = (M + pp - 1) * all_gather_time(
+            act_bytes / max(M, 1), 2, cluster.ici_bw)
+
+    comm = tp_comm + dp_comm + pp_comm
+    step = (compute + comm) / max(1e-9, (1 - bubble))
+
+    # ---- memory per chip
+    p_shard = model.num_params / (mp * pp)
+    mem = p_shard * (model.param_bytes + model.moment_bytes
+                     + model.grad_bytes)
+    # activation estimate: residual stream per layer (bwd live set), sharded
+    # over dp; remat keeps ~1 layer + sqrt(L) checkpoints
+    act_live = (batch / max(dp, 1)) * seq * h * 2
+    layers_here = L / pp
+    act_total = act_live * (4 * math.sqrt(layers_here) if use_recompute
+                            else 4 * layers_here)
+    mem += act_total
+    mem += (V * h / mp) * model.param_bytes     # embedding shard + logits ws
+    fits = mem <= cluster.hbm_bytes
+
+    return PlanCost(mesh=dict(mesh), step_time=step, compute_time=compute,
+                    comm_time=comm, bubble_frac=bubble, mem_per_chip=mem,
+                    fits=fits, use_recompute=use_recompute)
+
+
+def _factorizations(n: int) -> List[Tuple[int, int, int]]:
+    out = []
+    for dp in range(1, n + 1):
+        if n % dp:
+            continue
+        rest = n // dp
+        for mp in range(1, rest + 1):
+            if rest % mp:
+                continue
+            out.append((dp, mp, rest // mp))
+    return out
+
+
+class Planner:
+    """Enumerate-and-score mesh planner (reference: planner_v2.py + tuner/
+    — searches distributed plans with a cost model and memory pruning)."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def tune(self, model: ModelDesc, batch: int, seq: int,
+             micro_batches: int = 4, max_mp: Optional[int] = None,
+             top_k: int = 5) -> List[PlanCost]:
+        """Rank all (dp, mp, pp) factorizations of the cluster; plans that
+        do not fit HBM are retried with recompute, and dropped if they
+        still do not fit. Returns the top_k cheapest feasible plans."""
+        n = self.cluster.num_chips
+        plans = []
+        for dp, mp, pp in _factorizations(n):
+            if mp > (max_mp or model.heads):
+                continue
+            if model.layers % pp or (batch % (dp * micro_batches)
+                                     if pp > 1 else batch % dp):
+                continue
+            mesh = {"dp": dp, "mp": mp, "pp": pp}
+            plan = estimate_plan(model, self.cluster, mesh, batch, seq,
+                                 micro_batches)
+            if not plan.fits:
+                plan = estimate_plan(model, self.cluster, mesh, batch, seq,
+                                     micro_batches, use_recompute=True)
+            if plan.fits:
+                plans.append(plan)
+        plans.sort(key=lambda p: p.step_time)
+        return plans[:top_k]

@@ -203,3 +203,15 @@ def switch_case(branch_index, branch_fns, default=None):
             sel = jnp.where(idx == k, jnp.int32(i), sel)
         return jax.lax.switch(sel, [lambda v=v: v for v in branch_vals])
     return apply_op("switch_case", fn, [branch_index] + outs)
+
+
+# sequence_* LoD family (reference: static/nn/sequence_lod.py) — TPU-native
+# padded-dense + lengths representation; see static/sequence.py
+from .sequence import (  # noqa: F401,E402
+    sequence_conv, sequence_softmax, sequence_pool, sequence_concat,
+    sequence_first_step, sequence_last_step, sequence_slice,
+    sequence_expand, sequence_expand_as, sequence_pad, sequence_unpad,
+    sequence_reshape, sequence_scatter, sequence_enumerate,
+    sequence_reverse,
+)
+from ..nn.functional import sequence_mask  # noqa: F401,E402

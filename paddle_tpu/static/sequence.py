@@ -1,0 +1,339 @@
+"""sequence_* op family — the reference's LoD sequence corpus, TPU-native.
+
+Reference (SURVEY §2 op corpus; VERDICT r1 missing #4):
+paddle/fluid/operators/sequence_ops/ (30+ kernels) surfaced as
+python/paddle/static/nn/sequence_lod.py — all built on LoD (ragged)
+tensors, a representation XLA does not have. The TPU-native contract is the
+**padded-dense + lengths** pair the rest of this framework already uses
+(F.sequence_mask, CTC, RNN packing):
+
+  * "a batch of sequences" = `x: [B, T, ...]` padded dense + `lengths: [B]`
+  * functions that change per-row lengths return `(out, new_lengths)`
+  * reductions/elementwise keep shapes static so everything jits; the only
+    host-dependent op is sequence_unpad (flat total is data-dependent).
+
+Every function documents the reference analog it covers.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..nn import functional as F
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _mask(lengths, T, ndim_extra=0):
+    m = jnp.arange(T)[None, :] < _arr(lengths)[:, None]
+    return m.reshape(m.shape + (1,) * ndim_extra)
+
+
+def _lengths_or_full(x, lengths):
+    """lengths=None means "every row is full length" (the dense-tensor
+    degenerate case of a LoD batch)."""
+    if lengths is not None:
+        return lengths
+    a = _arr(x)
+    return jnp.full((a.shape[0],), a.shape[1], jnp.int64)
+
+
+# -------------------------------------------------------------- reductions
+def sequence_pool(input, pool_type, lengths=None, is_test=False,  # noqa: A002
+                  pad_value=0.0, name=None):
+    """reference: sequence_lod.py:253 (sum/average/sqrt/max/last/first).
+    input [B, T, H], lengths [B] -> [B, H]; empty rows get pad_value."""
+    pt = pool_type.lower()
+
+    def fn(x, ln):
+        B, T = x.shape[0], x.shape[1]
+        m = _mask(ln, T, x.ndim - 2)
+        xm = jnp.where(m, x, 0.0)
+        ln_f = jnp.maximum(ln, 1).astype(x.dtype).reshape(
+            (B,) + (1,) * (x.ndim - 2))
+        if pt == "sum":
+            out = xm.sum(1)
+        elif pt == "average":
+            out = xm.sum(1) / ln_f
+        elif pt == "sqrt":
+            out = xm.sum(1) / jnp.sqrt(ln_f)
+        elif pt == "max":
+            neg = jnp.asarray(jnp.finfo(
+                x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+                else jnp.float32).min, x.dtype)
+            out = jnp.where(m, x, neg).max(1)
+        elif pt == "first":
+            out = x[:, 0]
+        elif pt == "last":
+            idx = jnp.maximum(ln - 1, 0)
+            out = jnp.take_along_axis(
+                x, idx.reshape((B, 1) + (1,) * (x.ndim - 2)), axis=1)[:, 0]
+        else:
+            raise ValueError(f"unknown pool_type {pool_type!r}")
+        empty = (ln == 0).reshape((B,) + (1,) * (x.ndim - 2))
+        return jnp.where(empty, jnp.asarray(pad_value, out.dtype), out)
+
+    return apply_op("sequence_pool", fn, [input, _lengths_or_full(input, lengths)])
+
+
+def sequence_first_step(input, lengths=None, name=None):  # noqa: A002
+    """reference: sequence_lod.py:441."""
+    return sequence_pool(input, "first", lengths)
+
+
+def sequence_last_step(input, lengths=None, name=None):  # noqa: A002
+    """reference: sequence_lod.py:499."""
+    return sequence_pool(input, "last", lengths)
+
+
+def sequence_softmax(input, lengths=None, use_cudnn=False, name=None):  # noqa: A002
+    """reference: sequence_lod.py:166 — softmax over each row's valid
+    prefix; padding gets 0."""
+    def fn(x, ln):
+        T = x.shape[1]
+        m = _mask(ln, T, x.ndim - 2)
+        neg = jnp.asarray(-1e30, jnp.float32)
+        z = jnp.where(m, x.astype(jnp.float32), neg)
+        p = jax.nn.softmax(z, axis=1)
+        return jnp.where(m, p, 0.0).astype(x.dtype)
+    return apply_op("sequence_softmax", fn, [input, _lengths_or_full(input, lengths)])
+
+
+# ----------------------------------------------------------- restructuring
+def sequence_concat(input, lengths, name=None):  # noqa: A002
+    """reference: sequence_lod.py:371 — per-row concatenation of N
+    sequence batches: row b of the output is xs[0][b][:l0] ++ xs[1][b][:l1]
+    ++ .... Returns (out [B, sum(T_i), ...], new_lengths)."""
+    def fn(*args):
+        n = len(args) // 2
+        xs, lns = args[:n], args[n:]
+        B = xs[0].shape[0]
+        T_out = builtins.sum(x.shape[1] for x in xs)
+        feat = xs[0].shape[2:]
+        out = jnp.zeros((B, T_out) + feat, xs[0].dtype)
+        total = jnp.zeros((B,), lns[0].dtype)
+        for x, ln in zip(xs, lns):
+            T = x.shape[1]
+            # scatter x's valid prefix at per-row offset `total`
+            tpos = jnp.arange(T)[None, :]
+            dst = total[:, None] + tpos                       # [B, T]
+            valid = tpos < ln[:, None]
+            dst = jnp.where(valid, dst, T_out)                # sentinel slot
+            bidx = jnp.broadcast_to(jnp.arange(B)[:, None], dst.shape)
+            out = jnp.pad(out, [(0, 0), (0, 1)] + [(0, 0)] * len(feat)) \
+                .at[bidx, dst].set(x)[:, :T_out]
+            total = total + ln
+        return out, total
+    args = list(input) + list(lengths)
+    return apply_op("sequence_concat", fn, args, n_outputs=2)
+
+
+def sequence_slice(input, offset, length, lengths=None, name=None):  # noqa: A002
+    """reference: sequence_lod.py:558 — per-row [offset : offset+length)
+    window. Returns (out [B, max_len, ...], length)."""
+    def fn(x, off, ln):
+        B, T = x.shape[0], x.shape[1]
+        off = off.reshape(B)
+        ln2 = ln.reshape(B)
+        Tmax = int(x.shape[1])
+        tpos = jnp.arange(Tmax)[None, :]
+        src = jnp.clip(off[:, None] + tpos, 0, T - 1)
+        out = jnp.take_along_axis(
+            x, src.reshape((B, Tmax) + (1,) * (x.ndim - 2)), axis=1)
+        m = (tpos < ln2[:, None]).reshape((B, Tmax) + (1,) * (x.ndim - 2))
+        return jnp.where(m, out, 0.0), ln2
+    return apply_op("sequence_slice", fn, [input, offset, length],
+                    n_outputs=2)
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    """reference: sequence_lod.py:1414 — reverse each row's valid prefix,
+    padding stays in place."""
+    def fn(a, ln):
+        B, T = a.shape[0], a.shape[1]
+        tpos = jnp.arange(T)[None, :]
+        src = ln[:, None] - 1 - tpos
+        src = jnp.where(src >= 0, src, tpos)   # padding: identity
+        return jnp.take_along_axis(
+            a, src.reshape((B, T) + (1,) * (a.ndim - 2)), axis=1)
+    return apply_op("sequence_reverse", fn, [x, _lengths_or_full(x, lengths)])
+
+
+def sequence_pad(x, pad_value, lengths, maxlen=None, name=None):
+    """reference: sequence_lod.py:911 — here the ragged input is already
+    (padded buffer, lengths); this repads to `maxlen` with pad_value and
+    returns (out, lengths) like the reference's (Out, Length)."""
+    lv = _arr(lengths)
+    if maxlen is not None and not isinstance(lv, jax.core.Tracer):
+        top = int(np.asarray(jnp.max(lv)))
+        if top > int(maxlen):
+            # reference: sequence_pad enforces maxlen >= every sequence
+            raise ValueError(
+                f"sequence_pad: maxlen={maxlen} < longest sequence {top}")
+
+    def fn(a, pv, ln):
+        T = a.shape[1]
+        m = _mask(ln, T, a.ndim - 2)
+        out = jnp.where(m, a, pv.astype(a.dtype))
+        if maxlen is not None and int(maxlen) != T:
+            M = int(maxlen)
+            if M > T:
+                pads = [(0, 0), (0, M - T)] + [(0, 0)] * (a.ndim - 2)
+                out = jnp.pad(out, pads, constant_values=0)
+                out = jnp.where(_mask(ln, M, a.ndim - 2), out,
+                                pv.astype(a.dtype))
+            else:
+                out = out[:, :M]
+            ln = jnp.minimum(ln, M)
+        return out, ln
+    return apply_op("sequence_pad", fn, [x, pad_value, lengths], n_outputs=2)
+
+
+def sequence_unpad(x, length, name=None):
+    """reference: sequence_lod.py:1032 — drop padding, concatenate valid
+    rows: [B, T, ...] + lengths -> [sum(lengths), ...]. Output size is
+    data-dependent: eager host op (like masked_select)."""
+    a = np.asarray(_arr(x))
+    ln = np.asarray(_arr(length)).reshape(-1)
+    rows = [a[b, :int(ln[b])] for b in builtins.range(a.shape[0])]
+    return Tensor(jnp.asarray(np.concatenate(rows, axis=0)))
+
+
+def sequence_reshape(input, new_dim, lengths=None, name=None):  # noqa: A002
+    """reference: sequence_lod.py:1116 — re-chunk each row's valid payload
+    into rows of width new_dim. Returns (out, new_lengths)."""
+    lv = _arr(_lengths_or_full(input, lengths))
+    H0 = int(_arr(input).shape[-1])
+    if (int(_arr(input).shape[1]) * H0) % new_dim:
+        raise ValueError(
+            f"sequence_reshape: new_dim={new_dim} must divide the padded "
+            f"row payload T*H={int(_arr(input).shape[1]) * H0}")
+    if not isinstance(lv, jax.core.Tracer):
+        bad = np.asarray((lv * H0) % new_dim)
+        if (bad != 0).any():
+            # reference LoD op requires per-sequence divisibility
+            raise ValueError(
+                f"sequence_reshape: each row payload len*H must divide "
+                f"new_dim={new_dim}; offending rows "
+                f"{np.nonzero(bad)[0].tolist()}")
+
+    def fn(x, ln):
+        B, T, H = x.shape
+        out = x.reshape(B, (T * H) // new_dim, new_dim)
+        new_ln = (ln * H) // new_dim
+        return out, new_ln
+    return apply_op("sequence_reshape", fn,
+                    [input, _lengths_or_full(input, lengths)], n_outputs=2)
+
+
+def sequence_expand(x, y_lengths, x_lengths=None, ref_level=-1,
+                    max_repeat=None, name=None):
+    """reference: sequence_lod.py:652 — repeat row b of x y_lengths[b]
+    times along a new ragged batch. Output [B, R, ...] padded over the
+    repeat dim where R = max(y_lengths) (static-width form of the LoD
+    expand). Under jit the repeat width must be static: pass max_repeat."""
+    if max_repeat is None:
+        yv = _arr(y_lengths)
+        if isinstance(yv, jax.core.Tracer):
+            raise ValueError(
+                "sequence_expand under jit needs static max_repeat= (the "
+                "output width max(y_lengths) cannot be data-dependent)")
+        max_repeat = int(np.asarray(jnp.max(yv)))
+    R = int(max_repeat)
+
+    def fn(a, yln):
+        B = a.shape[0]
+        rep = jnp.arange(R)[None, :] < yln[:, None]
+        out = jnp.broadcast_to(a[:, None], (B, R) + a.shape[1:])
+        m = rep.reshape((B, R) + (1,) * (a.ndim - 1))
+        return jnp.where(m, out, 0.0)
+    return apply_op("sequence_expand", fn, [x, y_lengths])
+
+
+def sequence_expand_as(x, y, y_lengths, name=None):
+    """reference: sequence_lod.py:791 — expand each x row across y's row
+    width: x [B, H] -> [B, T_y, H] masked by y_lengths."""
+    def fn(a, yv, yln):
+        T = yv.shape[1]
+        out = jnp.broadcast_to(a[:, None], (a.shape[0], T) + a.shape[1:])
+        m = _mask(yln, T, a.ndim - 1)
+        return jnp.where(m, out, 0.0)
+    return apply_op("sequence_expand_as", fn,
+                    [x, y, _lengths_or_full(y, y_lengths)])
+
+
+def sequence_scatter(input, index, updates, lengths=None, name=None):  # noqa: A002
+    """reference: sequence_lod.py:1185 — per-row scatter-add of `updates`
+    into `input` at per-row positions `index` (padding rows of index are
+    masked by lengths)."""
+    def fn(x, idx, upd, ln):
+        B, T = idx.shape[0], idx.shape[1]
+        valid = _mask(ln, T)
+        safe = jnp.where(valid, idx, x.shape[1])
+        bidx = jnp.broadcast_to(jnp.arange(B)[:, None], safe.shape)
+        padded = jnp.pad(x, [(0, 0), (0, 1)])
+        out = padded.at[bidx, safe].add(jnp.where(valid, upd, 0.0))
+        return out[:, :x.shape[1]]
+    return apply_op("sequence_scatter", fn,
+                    [input, index, updates, _lengths_or_full(index, lengths)])
+
+
+def sequence_enumerate(input, win_size, lengths=None, pad_value=0, name=None):  # noqa: A002
+    """reference: sequence_lod.py:1281 — sliding windows of ids:
+    [B, T] -> [B, T, win_size], positions past a row's length padded."""
+    def fn(ids, ln):
+        B, T = ids.shape
+        tpos = jnp.arange(T)[:, None] + jnp.arange(win_size)[None, :]  # [T,W]
+        src = jnp.clip(tpos, 0, T - 1)
+        win = ids[:, src]                                   # [B, T, W]
+        ok = (tpos[None] < ln[:, None, None])
+        return jnp.where(ok, win, pad_value)
+    return apply_op("sequence_enumerate", fn, [input, _lengths_or_full(input, lengths)])
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,  # noqa: A002
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, lengths=None, name=None,
+                  weight=None, bias=None):
+    """reference: sequence_lod.py:26 — context-window projection over time:
+    each step's context [t+start, t+start+filter_size) (zero past row
+    bounds) flattens into one matmul against [filter_size*H, num_filters].
+    Pass `weight`/`bias` explicitly (functional form) or let it create them
+    eagerly like static.nn.fc."""
+    H = int(_arr(input).shape[-1])
+    if weight is None:
+        from .. import nn as dyn_nn
+        lin = dyn_nn.Linear(filter_size * H, num_filters,
+                            bias_attr=bias_attr if bias_attr is not None
+                            else None)
+        weight, bias = lin.weight, lin.bias
+    start = padding_start if padding_start is not None \
+        else -((filter_size - 1) // 2)
+
+    def fn(x, ln, w, *b):
+        B, T = x.shape[0], x.shape[1]
+        tpos = jnp.arange(T)[:, None] + start + jnp.arange(filter_size)[None]
+        src = jnp.clip(tpos, 0, T - 1)                      # [T, F]
+        ctx = x[:, src]                                     # [B, T, F, H]
+        ok = ((tpos >= 0)[None] & (tpos[None] < ln[:, None, None]))
+        ctx = jnp.where(ok[..., None], ctx, 0.0)
+        flat = ctx.reshape(B, T, filter_size * x.shape[-1])
+        out = flat @ w
+        if b:
+            out = out + b[0]
+        valid = _mask(ln, T, 1)
+        out = jnp.where(valid, out, 0.0)
+        return out[:, ::filter_stride] if filter_stride != 1 else out
+
+    args = [input, _lengths_or_full(input, lengths), weight] \
+        + ([bias] if bias is not None else [])
+    out = apply_op("sequence_conv", fn, args)
+    if act:
+        out = getattr(F, act)(out)
+    return out

@@ -95,34 +95,290 @@ class _LocalDataset:
 
 
 class Imdb(_LocalDataset):
-    """reference: paddle.text.datasets.Imdb (sentiment corpus)."""
+    """reference: paddle.text.datasets.Imdb (aclImdb sentiment corpus).
+
+    data_file: either an aclImdb-style directory root
+    ({mode}/pos/*.txt, {mode}/neg/*.txt) or a TSV file of "label<TAB>text"
+    lines (label 0/1). Tokenization and the frequency-cutoff vocab follow
+    the reference (imdb.py _build_work_dict: keep words with freq >= cutoff,
+    sorted by (-freq, word))."""
 
     def __init__(self, data_file=None, mode="train", cutoff=150):
         super().__init__(data_file, mode)
+        import re
+        tok = re.compile(r"[a-z]+")
 
+        def read_dir(split):
+            ds, ls = [], []
+            for label, sub in ((1, "pos"), (0, "neg")):
+                d = os.path.join(self.data_file, split, sub)
+                for fn in sorted(os.listdir(d)) if os.path.isdir(d) else []:
+                    with open(os.path.join(d, fn), errors="ignore") as f:
+                        ds.append(tok.findall(f.read().lower()))
+                        ls.append(label)
+            return ds, ls
 
-class Conll05st(_LocalDataset):
-    """reference: paddle.text.datasets.Conll05st (SRL corpus)."""
+        if os.path.isdir(self.data_file):
+            docs, labels = read_dir(mode)
+            # vocab ALWAYS from the train corpus so train/test ids agree
+            # (reference: imdb.py builds word_idx from the train pattern)
+            vocab_docs = docs if mode == "train" else read_dir("train")[0]
+        else:
+            docs, labels = [], []
+            with open(self.data_file, errors="ignore") as f:
+                for line in f:
+                    lab, _, text = line.partition("\t")
+                    if not text:
+                        continue
+                    docs.append(tok.findall(text.lower()))
+                    labels.append(int(lab))
+            vocab_docs = docs
+        freq = {}
+        for d in vocab_docs:
+            for w in d:
+                freq[w] = freq.get(w, 0) + 1
+        kept = sorted(((w, c) for w, c in freq.items() if c >= cutoff),
+                      key=lambda x: (-x[1], x[0]))
+        self.word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.array([self.word_idx.get(w, unk) for w in d],
+                              np.int64) for d in docs]
+        self.labels = np.array(labels, np.int64)
 
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
 
-class Movielens(_LocalDataset):
-    """reference: paddle.text.datasets.Movielens."""
-
-
-class UCIHousing(_LocalDataset):
-    """reference: paddle.text.datasets.UCIHousing."""
-
-
-class WMT14(_LocalDataset):
-    """reference: paddle.text.datasets.WMT14."""
-
-
-class WMT16(_LocalDataset):
-    """reference: paddle.text.datasets.WMT16."""
+    def __len__(self):
+        return len(self.docs)
 
 
 class Imikolov(_LocalDataset):
-    """reference: paddle.text.datasets.Imikolov."""
+    """reference: paddle.text.datasets.Imikolov (PTB language modelling).
+
+    data_file: plain text, one sentence per line (the extracted
+    ptb.{train,valid}.txt). NGRAM mode yields window_size-grams; SEQ mode
+    yields (<s>+sent, sent+<e>) pairs — the reference's exact contract
+    (imikolov.py:132-172), including the vocab rule: freq > min_word_freq,
+    sorted by (-freq, word), <unk> last."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50):
+        super().__init__(data_file, mode)
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        freq = {"<s>": 0, "<e>": 0}
+        lines = []
+        with open(self.data_file, errors="ignore") as f:
+            for line in f:
+                ws = line.strip().split()
+                lines.append(ws)
+                for w in ["<s>", "<e>"] + ws:
+                    freq[w] = freq.get(w, 0) + 1
+        freq.pop("<unk>", None)
+        kept = sorted(((w, c) for w, c in freq.items() if c > min_word_freq),
+                      key=lambda x: (-x[1], x[0]))
+        self.word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for ws in lines:
+            if self.data_type == "NGRAM":
+                assert self.window_size > -1, "Invalid gram length"
+                l2 = ["<s>"] + ws + ["<e>"]
+                if len(l2) >= self.window_size:
+                    ids = [self.word_idx.get(w, unk) for w in l2]
+                    for i in range(self.window_size, len(ids) + 1):
+                        self.data.append(tuple(ids[i - self.window_size:i]))
+            elif self.data_type == "SEQ":
+                ids = [self.word_idx.get(w, unk) for w in ws]
+                src = [self.word_idx.get("<s>", unk)] + ids
+                trg = ids + [self.word_idx.get("<e>", unk)]
+                if self.window_size > 0 and len(src) > self.window_size:
+                    continue
+                self.data.append((src, trg))
+            else:
+                raise ValueError(f"unknown data_type {data_type}")
+
+    def __getitem__(self, i):
+        return tuple(np.array(d) for d in self.data[i])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(_LocalDataset):
+    """reference: paddle.text.datasets.UCIHousing — space-separated
+    14-column file; per-feature (x-avg)/(max-min) normalization and the
+    80/20 train/test split are the reference's exact math
+    (uci_housing.py:107-121)."""
+
+    def __init__(self, data_file=None, mode="train"):
+        super().__init__(data_file, mode)
+        feature_num = 14
+        data = np.fromfile(self.data_file, sep=" ")
+        data = data.reshape(data.shape[0] // feature_num, feature_num)
+        maximums, minimums, avgs = (data.max(0), data.min(0),
+                                    data.sum(0) / data.shape[0])
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maximums[i] - minimums[i])
+        offset = int(data.shape[0] * 0.8)
+        self.data = data[:offset] if mode == "train" else data[offset:]
+        self.dtype = "float32"
+
+    def __getitem__(self, idx):
+        d = self.data[idx]
+        return (np.array(d[:-1]).astype(self.dtype),
+                np.array(d[-1:]).astype(self.dtype))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(_LocalDataset):
+    """reference: paddle.text.datasets.Movielens (ml-1m). data_file: a
+    directory containing ratings.dat / users.dat / movies.dat in the
+    ::-separated ml-1m format; yields (user_id, gender, age, job,
+    movie_id, title_ids, categories, rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        super().__init__(data_file, mode)
+        root = self.data_file
+        movies, self.categories_dict, self.movie_title_dict = {}, {}, {}
+        with open(os.path.join(root, "movies.dat"), errors="ignore") as f:
+            for line in f:
+                mid, title, cats = line.strip().split("::")
+                for c in cats.split("|"):
+                    self.categories_dict.setdefault(c, len(self.categories_dict))
+                tw = title.split()
+                for w in tw:
+                    self.movie_title_dict.setdefault(w, len(self.movie_title_dict))
+                movies[int(mid)] = (
+                    [self.categories_dict[c] for c in cats.split("|")],
+                    [self.movie_title_dict[w] for w in tw])
+        users = {}
+        with open(os.path.join(root, "users.dat"), errors="ignore") as f:
+            for line in f:
+                uid, gender, age, job, _zip = line.strip().split("::")
+                users[int(uid)] = (0 if gender == "M" else 1, int(age), int(job))
+        rng = np.random.RandomState(rand_seed)
+        self.data = []
+        with open(os.path.join(root, "ratings.dat"), errors="ignore") as f:
+            for line in f:
+                uid, mid, rating, _ts = line.strip().split("::")
+                uid, mid = int(uid), int(mid)
+                if mid not in movies or uid not in users:
+                    continue
+                is_test = rng.rand() < test_ratio
+                if (mode == "test") != is_test:
+                    continue
+                g, a, j = users[uid]
+                cats, title = movies[mid]
+                self.data.append((uid, g, a, j, mid, title, cats,
+                                  float(rating)))
+
+    def __getitem__(self, i):
+        return tuple(np.array(d) for d in self.data[i])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _ParallelCorpus(_LocalDataset):
+    """Shared WMT loader: data_file is a TSV of "src<TAB>tgt" sentence
+    pairs; builds per-side vocabs capped at dict_size (by frequency, specials
+    first) and yields (src_ids, trg_ids, trg_next) like the reference's
+    wmt14/wmt16 datasets."""
+
+    BOS, EOS, UNK = "<s>", "<e>", "<unk>"
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1):
+        super().__init__(data_file, mode)
+        pairs = []
+        with open(self.data_file, errors="ignore") as f:
+            for line in f:
+                s, _, t = line.rstrip("\n").partition("\t")
+                if t:
+                    pairs.append((s.split(), t.split()))
+
+        def vocab(side):
+            freq = {}
+            for p in pairs:
+                for w in p[side]:
+                    freq[w] = freq.get(w, 0) + 1
+            words = [w for w, _ in sorted(freq.items(),
+                                          key=lambda x: (-x[1], x[0]))]
+            if dict_size > 0:
+                words = words[:max(0, dict_size - 3)]
+            idx = {self.BOS: 0, self.EOS: 1, self.UNK: 2}
+            for w in words:
+                if w not in idx:      # corpora may contain literal specials
+                    idx[w] = len(idx)
+            return idx
+
+        self.src_ids, self.trg_ids = vocab(0), vocab(1)
+        su, tu = self.src_ids[self.UNK], self.trg_ids[self.UNK]
+        self.data = []
+        for s, t in pairs:
+            sid = [self.src_ids[self.BOS]] +                 [self.src_ids.get(w, su) for w in s] + [self.src_ids[self.EOS]]
+            tid = [self.trg_ids[self.BOS]] + [self.trg_ids.get(w, tu) for w in t]
+            tnxt = [self.trg_ids.get(w, tu) for w in t] + [self.trg_ids[self.EOS]]
+            self.data.append((sid, tid, tnxt))
+
+    def __getitem__(self, i):
+        return tuple(np.array(d) for d in self.data[i])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(_ParallelCorpus):
+    """reference: paddle.text.datasets.WMT14 (en-fr)."""
+
+
+class WMT16(_ParallelCorpus):
+    """reference: paddle.text.datasets.WMT16 (en-de)."""
+
+
+class Conll05st(_LocalDataset):
+    """reference: paddle.text.datasets.Conll05st (SRL). data_file: a
+    column-format file "word<TAB>predicate<TAB>label" with blank lines
+    between sentences; yields (word_ids, pred_ids, label_ids) with vocabs
+    built from the corpus."""
+
+    def __init__(self, data_file=None, mode="train"):
+        super().__init__(data_file, mode)
+        sents, cur = [], []
+        with open(self.data_file, errors="ignore") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line.strip():
+                    if cur:
+                        sents.append(cur)
+                        cur = []
+                    continue
+                cur.append(line.split("\t"))
+        if cur:
+            sents.append(cur)
+        self.word_dict, self.predicate_dict, self.label_dict = {}, {}, {}
+        for s in sents:
+            for w, p, lab in s:
+                self.word_dict.setdefault(w, len(self.word_dict))
+                self.predicate_dict.setdefault(p, len(self.predicate_dict))
+                self.label_dict.setdefault(lab, len(self.label_dict))
+        self.data = []
+        for s in sents:
+            self.data.append((
+                np.array([self.word_dict[w] for w, _, _ in s], np.int64),
+                np.array([self.predicate_dict[p] for _, p, _ in s], np.int64),
+                np.array([self.label_dict[lab] for _, _, lab in s], np.int64)))
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)
 
 
 datasets = type("datasets", (), {
@@ -130,3 +386,6 @@ datasets = type("datasets", (), {
     "UCIHousing": UCIHousing, "WMT14": WMT14, "WMT16": WMT16,
     "Imikolov": Imikolov,
 })
+
+from . import strings  # noqa: F401,E402  (StringTensor ops, phi strings analog)
+from .strings import StringTensor  # noqa: F401,E402

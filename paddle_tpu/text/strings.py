@@ -1,0 +1,112 @@
+"""StringTensor ops — the phi strings kernel family, TPU-native.
+
+Reference (SURVEY §2.1 "PHI fusion/sparse/strings"): paddle/phi/kernels/
+strings/ — StringTensor with lower/upper kernels (ASCII + UTF-8 paths,
+strings_lower_upper_kernel.h StringLowerKernel/StringUpperKernel) feeding
+the tokenizer ops. XLA has no string dtype, so the TPU-native StringTensor
+is a host-side numpy unicode array wrapper whose COMPUTE outputs (lengths,
+hashes, token ids) are device tensors; the string transforms themselves are
+host ops, exactly as the reference keeps them on CPU (string kernels are
+CPU-only there too).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class StringTensor:
+    """Batch of strings with tensor-like shape metadata (reference:
+    phi::StringTensor, phi/core/string_tensor.h)."""
+
+    def __init__(self, data, name=None):
+        self._data = np.asarray(data, dtype=np.str_)
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    def numpy(self):
+        return self._data
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+    def __eq__(self, other):
+        o = other._data if isinstance(other, StringTensor) else np.asarray(other)
+        return Tensor(jnp.asarray(self._data == o))
+
+
+def _as_np(x):
+    return x._data if isinstance(x, StringTensor) else np.asarray(x, np.str_)
+
+
+def lower(x, use_utf8_encoding: bool = True, name=None) -> StringTensor:
+    """reference: strings_lower_upper_kernel.h StringLowerKernel — python
+    str.lower() is Unicode-aware, covering both the ASCII and utf8 paths."""
+    a = _as_np(x)
+    if not use_utf8_encoding:
+        out = np.char.array(a).lower()  # bytes-style ASCII lowering
+        return StringTensor(np.asarray(out, np.str_))
+    return StringTensor(np.vectorize(str.lower, otypes=[np.str_])(a)
+                        if a.size else a)
+
+
+def upper(x, use_utf8_encoding: bool = True, name=None) -> StringTensor:
+    """reference: StringUpperKernel."""
+    a = _as_np(x)
+    if not use_utf8_encoding:
+        out = np.char.array(a).upper()
+        return StringTensor(np.asarray(out, np.str_))
+    return StringTensor(np.vectorize(str.upper, otypes=[np.str_])(a)
+                        if a.size else a)
+
+
+def length(x, name=None) -> Tensor:
+    """Per-string character count -> int64 device tensor."""
+    a = _as_np(x)
+    out = np.vectorize(len, otypes=[np.int64])(a) if a.size \
+        else np.zeros(a.shape, np.int64)
+    return Tensor(jnp.asarray(out))
+
+
+def strip(x, chars=None, name=None) -> StringTensor:
+    a = _as_np(x)
+    return StringTensor(np.vectorize(lambda s: s.strip(chars),
+                                     otypes=[np.str_])(a) if a.size else a)
+
+
+def join(x, sep: str = "", axis: int = -1, name=None) -> StringTensor:
+    """Concatenate strings along an axis (tokenizer detokenize building
+    block). Built row-by-row via an object array — np.apply_along_axis
+    would freeze the output dtype at the FIRST row's width and truncate
+    longer results."""
+    a = _as_np(x)
+    moved = np.moveaxis(a, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    joined = np.empty(flat.shape[0], object)
+    for i in range(flat.shape[0]):
+        joined[i] = sep.join(flat[i].tolist())
+    out = np.asarray(joined.reshape(moved.shape[:-1]), np.str_)
+    return StringTensor(out)
+
+
+def to_hash(x, num_buckets: int, name=None) -> Tensor:
+    """Stable FNV-1a string hash mod num_buckets -> int64 ids on device
+    (the sparse-feature signing step of the CTR pipeline; reference:
+    ps feature signing in the data feed)."""
+    a = _as_np(x)
+
+    def fnv(s: str) -> int:
+        h = 0xcbf29ce484222325
+        for byte in s.encode("utf-8"):
+            h = ((h ^ byte) * 0x100000001b3) & 0xFFFFFFFFFFFFFFFF
+        return h % num_buckets
+
+    out = np.vectorize(fnv, otypes=[np.int64])(a) if a.size \
+        else np.zeros(a.shape, np.int64)
+    return Tensor(jnp.asarray(out))

@@ -1,0 +1,66 @@
+"""Auto-parallel cluster/cost-model/planner (VERDICT §2.2 partial row:
+the reference's cluster.py + cost/ + planner_v2.py capability)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_parallel import (
+    Cluster, ModelDesc, Planner, estimate_plan, ring_all_reduce_time)
+
+
+GPT13 = ModelDesc(hidden=2048, layers=24, heads=16, vocab=50304)
+GPT67 = ModelDesc(hidden=4096, layers=32, heads=32, vocab=50304)
+
+
+def test_cluster_presets_and_json(tmp_path):
+    c = Cluster.preset("v5e", 8)
+    assert c.num_chips == 8 and c.peak_flops == 197e12
+    p = str(tmp_path / "cluster.json")
+    c.to_json(p)
+    c2 = Cluster.from_json(p)
+    assert c2.__dict__ == c.__dict__
+
+
+def test_comm_cost_shapes():
+    assert ring_all_reduce_time(1e9, 1, 45e9) == 0.0
+    t2 = ring_all_reduce_time(1e9, 2, 45e9)
+    t8 = ring_all_reduce_time(1e9, 8, 45e9)
+    assert 0 < t2 < t8 < 2 * 1e9 / 45e9  # bounded by 2x buffer/bw
+
+
+def test_estimate_more_chips_faster():
+    c8 = Cluster.preset("v5e", 8)
+    one = estimate_plan(GPT13, c8, {"dp": 1}, batch=8, seq=1024)
+    eight = estimate_plan(GPT13, c8, {"dp": 8}, batch=8, seq=1024)
+    assert eight.step_time < one.step_time
+
+
+def test_memory_pruning_and_remat_rescue():
+    c = Cluster.preset("v5e", 8)
+    # 6.7B pure-dp on a 16G chip cannot fit (params+moments ~ 53G)
+    solo = estimate_plan(GPT67, c, {"dp": 8}, batch=8, seq=1024)
+    assert not solo.fits
+    plans = Planner(c).tune(GPT67, batch=8, seq=1024)
+    assert plans, "planner found no feasible 6.7B plan on 8 chips"
+    assert all(p.fits for p in plans)
+    assert all(p.mesh["mp"] * p.mesh["pp"] > 1 for p in plans), \
+        "6.7B needs model/pipeline sharding on 16G chips"
+
+
+def test_planner_ranks_sanely_for_13b_class():
+    c = Cluster.preset("v5e", 8)
+    plans = Planner(c).tune(GPT13, batch=8, seq=1024)
+    assert plans and plans[0].fits
+    assert plans[0].step_time <= plans[-1].step_time
+    best = plans[0].mesh
+    assert best["dp"] * best["mp"] * best["pp"] == 8
+    # 1.3B fits per-chip with bf16 moments: pure-ish dp should win or tie
+    assert best["mp"] <= 2 and best["pp"] <= 2, plans
+
+
+def test_tp_beyond_heads_excluded():
+    c = Cluster.preset("v5e", 64)
+    plans = Planner(c).tune(GPT13, batch=64, seq=1024, max_mp=16)
+    assert all(p.mesh["mp"] <= 16 for p in plans)

@@ -1,0 +1,250 @@
+"""sequence_* op family (VERDICT r1 missing #4: op-corpus tail).
+
+Reference: static/nn/sequence_lod.py over LoD tensors; TPU-native contract
+is padded-dense [B, T, ...] + lengths [B] (static/sequence.py docstring).
+Each test checks against a per-row numpy simulation of the LoD semantics."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static.nn as snn
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+B, T, H = 3, 5, 4
+RNG = np.random.RandomState(0)
+X = RNG.randn(B, T, H).astype(np.float32)
+LEN = np.array([5, 3, 1], np.int64)
+
+
+def _rows():
+    return [X[b, :LEN[b]] for b in range(B)]
+
+
+class TestSequencePool:
+    @pytest.mark.parametrize("pt,ref", [
+        ("sum", lambda r: r.sum(0)),
+        ("average", lambda r: r.mean(0)),
+        ("sqrt", lambda r: r.sum(0) / np.sqrt(len(r))),
+        ("max", lambda r: r.max(0)),
+        ("first", lambda r: r[0]),
+        ("last", lambda r: r[-1]),
+    ])
+    def test_pool(self, pt, ref):
+        out = snn.sequence_pool(_t(X), pt, lengths=_t(LEN))
+        want = np.stack([ref(r) for r in _rows()])
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-5, atol=1e-6)
+
+    def test_first_last_steps(self):
+        np.testing.assert_allclose(
+            snn.sequence_first_step(_t(X), _t(LEN)).numpy(),
+            np.stack([r[0] for r in _rows()]), rtol=1e-6)
+        np.testing.assert_allclose(
+            snn.sequence_last_step(_t(X), _t(LEN)).numpy(),
+            np.stack([r[-1] for r in _rows()]), rtol=1e-6)
+
+
+def test_sequence_softmax():
+    ids = RNG.randn(B, T).astype(np.float32)
+    out = snn.sequence_softmax(_t(ids), lengths=_t(LEN)).numpy()
+    for b in range(B):
+        v = ids[b, :LEN[b]]
+        e = np.exp(v - v.max())
+        np.testing.assert_allclose(out[b, :LEN[b]], e / e.sum(), rtol=1e-5,
+                                   atol=1e-6)
+        assert np.all(out[b, LEN[b]:] == 0)
+
+
+def test_sequence_reverse():
+    out = snn.sequence_reverse(_t(X), lengths=_t(LEN)).numpy()
+    for b in range(B):
+        np.testing.assert_allclose(out[b, :LEN[b]], X[b, :LEN[b]][::-1])
+
+
+def test_sequence_concat():
+    X2 = RNG.randn(B, 4, H).astype(np.float32)
+    L2 = np.array([2, 4, 3], np.int64)
+    out, new_len = snn.sequence_concat([_t(X), _t(X2)], [_t(LEN), _t(L2)])
+    np.testing.assert_array_equal(new_len.numpy(), LEN + L2)
+    for b in range(B):
+        want = np.concatenate([X[b, :LEN[b]], X2[b, :L2[b]]], 0)
+        np.testing.assert_allclose(out.numpy()[b, :LEN[b] + L2[b]], want,
+                                   rtol=1e-6)
+
+
+def test_sequence_slice():
+    off = np.array([1, 0, 0], np.int64)
+    ln = np.array([2, 2, 1], np.int64)
+    out, olen = snn.sequence_slice(_t(X), _t(off), _t(ln), lengths=_t(LEN))
+    np.testing.assert_array_equal(olen.numpy(), ln)
+    for b in range(B):
+        np.testing.assert_allclose(out.numpy()[b, :ln[b]],
+                                   X[b, off[b]:off[b] + ln[b]], rtol=1e-6)
+
+
+def test_sequence_pad_and_unpad():
+    pv = np.float32(9.5)
+    out, ln = snn.sequence_pad(_t(X), _t(pv), _t(LEN), maxlen=6)
+    o = out.numpy()
+    assert o.shape == (B, 6, H)
+    for b in range(B):
+        np.testing.assert_allclose(o[b, :LEN[b]], X[b, :LEN[b]])
+        assert np.all(o[b, LEN[b]:] == pv)
+    flat = snn.sequence_unpad(_t(X), _t(LEN))
+    want = np.concatenate(_rows(), 0)
+    np.testing.assert_allclose(flat.numpy(), want)
+
+
+def test_sequence_reshape():
+    out, nl = snn.sequence_reshape(_t(X), new_dim=2, lengths=_t(LEN))
+    assert out.shape == [B, T * H // 2, 2]
+    np.testing.assert_array_equal(nl.numpy(), LEN * H // 2)
+    np.testing.assert_allclose(out.numpy()[0].reshape(-1),
+                               X[0].reshape(-1), rtol=1e-6)
+
+
+def test_sequence_expand_as():
+    xs = RNG.randn(B, H).astype(np.float32)
+    out = snn.sequence_expand_as(_t(xs), _t(X), _t(LEN)).numpy()
+    for b in range(B):
+        np.testing.assert_allclose(out[b, :LEN[b]],
+                                   np.tile(xs[b], (LEN[b], 1)), rtol=1e-6)
+        assert np.all(out[b, LEN[b]:] == 0)
+
+
+def test_sequence_scatter():
+    base = np.zeros((B, T), np.float32)
+    idx = np.array([[0, 2, 4, 0, 0], [1, 1, 0, 0, 0], [3, 0, 0, 0, 0]],
+                   np.int64)
+    upd = np.ones((B, T), np.float32)
+    ln = np.array([3, 2, 1], np.int64)
+    out = snn.sequence_scatter(_t(base), _t(idx), _t(upd), lengths=_t(ln))
+    want = np.zeros((B, T), np.float32)
+    for b in range(B):
+        for i in range(ln[b]):
+            want[b, idx[b, i]] += 1.0
+    np.testing.assert_allclose(out.numpy(), want)
+
+
+def test_sequence_enumerate():
+    ids = np.array([[1, 2, 3, 4, 5], [6, 7, 8, 0, 0], [9, 0, 0, 0, 0]],
+                   np.int64)
+    out = snn.sequence_enumerate(_t(ids), win_size=2, lengths=_t(LEN)).numpy()
+    assert out.shape == (B, T, 2)
+    np.testing.assert_array_equal(out[0, 0], [1, 2])
+    # window elements past the row boundary take pad_value (reference:
+    # sequence_enumerate_op fills beyond-boundary positions with pad)
+    np.testing.assert_array_equal(out[0, 4], [5, 0])
+    assert np.all(out[2, 1:] == 0)                      # past length -> pad
+
+
+def test_sequence_conv_matches_manual():
+    paddle.seed(0)
+    out = snn.sequence_conv(_t(X), num_filters=6, filter_size=3,
+                            lengths=_t(LEN))
+    assert out.shape == [B, T, 6]
+    o = out.numpy()
+    assert np.all(o[2, 1:] == 0)        # masked past row length
+    assert np.isfinite(o).all()
+    # functional form with explicit weight: exact numpy check
+    W = RNG.randn(3 * H, 6).astype(np.float32)
+    out2 = snn.sequence_conv(_t(X), 6, filter_size=3, lengths=_t(LEN),
+                             weight=_t(W)).numpy()
+    b = 0
+    for t in range(LEN[b]):
+        ctx = []
+        for k in (-1, 0, 1):
+            tt = t + k
+            ctx.append(X[b, tt] if 0 <= tt < LEN[b] else np.zeros(H, np.float32))
+        want = np.concatenate(ctx) @ W
+        np.testing.assert_allclose(out2[b, t], want, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_grad_flows():
+    x = _t(X)
+    x.stop_gradient = False
+    out = snn.sequence_pool(x, "average", lengths=_t(LEN))
+    out.sum().backward()
+    g = x.grad.numpy()
+    assert np.all(g[0, :5] != 0)
+    assert np.all(g[2, 1:] == 0)        # padding gets no gradient
+
+
+class TestStringOps:
+    """StringTensor family (reference: phi/kernels/strings — lower/upper
+    kernels with ASCII+UTF-8 paths, CPU-resident there too)."""
+
+    def test_lower_upper_utf8(self):
+        from paddle_tpu.text import strings as S
+        st = S.StringTensor([["Hello", "WÖRLD"], ["ÉcOlE", "abc"]])
+        lo = S.lower(st)
+        up = S.upper(st)
+        assert lo.numpy()[0, 1] == "wörld"
+        assert up.numpy()[1, 0] == "ÉCOLE"
+        assert lo.shape == [2, 2]
+
+    def test_ascii_path_and_length(self):
+        from paddle_tpu.text import strings as S
+        st = S.StringTensor(["AbC", "deF!"])
+        assert list(S.lower(st, use_utf8_encoding=False).numpy()) == \
+            ["abc", "def!"]
+        ln = S.length(st)
+        np.testing.assert_array_equal(ln.numpy(), [3, 4])
+        assert str(ln.dtype) == "int64"
+
+    def test_strip_join_hash(self):
+        from paddle_tpu.text import strings as S
+        st = S.StringTensor([" a ", "b  "])
+        assert list(S.strip(st).numpy()) == ["a", "b"]
+        j = S.join(S.StringTensor([["x", "y"], ["z", "w"]]), sep="-")
+        assert list(j.numpy()) == ["x-y", "z-w"]
+        h = S.to_hash(st, num_buckets=1000)
+        assert h.numpy().shape == (2,)
+        assert (h.numpy() >= 0).all() and (h.numpy() < 1000).all()
+        # hash is stable across calls
+        np.testing.assert_array_equal(h.numpy(),
+                                      S.to_hash(st, 1000).numpy())
+
+
+def test_sequence_review_edges():
+    """Edges from review: lengths=None default, pad maxlen validation,
+    reshape per-row divisibility, expand static width."""
+    x = _t(X)
+    # lengths=None == full rows
+    np.testing.assert_allclose(
+        snn.sequence_pool(x, "sum").numpy(), X.sum(1), rtol=1e-5)
+    with pytest.raises(ValueError, match="maxlen"):
+        snn.sequence_pad(x, _t(np.float32(0)), _t(LEN), maxlen=3)
+    with pytest.raises(ValueError, match="divide"):
+        snn.sequence_reshape(_t(X), new_dim=3, lengths=_t(LEN))
+    with pytest.raises(ValueError, match="max_repeat"):
+        import jax
+        jax.jit(lambda a, ln: snn.sequence_expand(
+            paddle.Tensor(a), paddle.Tensor(ln))._data)(
+            X[:, 0], LEN)
+    out = snn.sequence_expand(_t(X[:, 0]), _t(np.array([3, 1, 2])))
+    assert out.shape == [B, 3, H]
+    assert np.all(out.numpy()[1, 1:] == 0)
+
+
+def test_string_join_no_truncation():
+    """review: np.apply_along_axis froze width at the first row."""
+    from paddle_tpu.text import strings as S
+    j = S.join(S.StringTensor([["abc", "defgh"], ["x", "ylongerstring"]]),
+               sep="-")
+    assert list(j.numpy()) == ["abc-defgh", "x-ylongerstring"]
+
+
+def test_wmt_literal_special_tokens():
+    """review: corpora containing literal <unk> must not alias ids."""
+    import tempfile, os
+    from paddle_tpu import text
+    d = tempfile.mkdtemp()
+    f = os.path.join(d, "p.tsv")
+    open(f, "w").write("the <unk> cat\tle <unk> chat\nthe dog\tle chien\n")
+    ds = text.WMT16(data_file=f)
+    ids = list(ds.src_ids.values())
+    assert len(ids) == len(set(ids)), ds.src_ids
