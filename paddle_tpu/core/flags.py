@@ -21,6 +21,10 @@ _REGISTRY: Dict[str, Any] = {
     "FLAGS_benchmark": False,
     "FLAGS_cudnn_deterministic": True,   # TPU: deterministic by construction
     "FLAGS_use_autotune": True,          # XLA autotuning on by default
+    # measured Pallas tile selection (flash bq/bk) with a persistent cache;
+    # opt-in like the reference's conv autotune (switch_autotune.cc) since
+    # each candidate costs a compile at first encounter of a shape
+    "FLAGS_flash_autotune": False,
     "FLAGS_allocator_strategy": "xla",   # no custom allocator on TPU
     "FLAGS_fraction_of_gpu_memory_to_use": 0.0,
     "FLAGS_eager_delete_tensor_gb": 0.0,

@@ -294,6 +294,18 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
     import os
     env_bq = os.environ.get("PADDLE_TPU_FLASH_BQ")  # tuning knobs
     env_bk = os.environ.get("PADDLE_TPU_FLASH_BK")
+    if block_q is None and block_k is None and not env_bq and not env_bk \
+            and not interpret:
+        from ...core import flags as _flags
+        if _flags.get_flags("FLAGS_flash_autotune").get(
+                "FLAGS_flash_autotune", False):
+            # measured tile selection with a persistent cache (PHI
+            # autotune analog; see autotune.py) — shapes are static at
+            # trace time, so this runs eagerly even under an outer jit
+            from .autotune import tune_flash_blocks
+            block_q, block_k = tune_flash_blocks(
+                q.shape[0], s_q, s_k, q.shape[2], q.shape[3], causal,
+                q.dtype)
     bq = block_q or int(env_bq) if (block_q or env_bq) else min(DEFAULT_BQ, s_q)
     bk = block_k or int(env_bk) if (block_k or env_bk) else min(DEFAULT_BK, s_k)
     bq = min(bq, s_q)

@@ -54,3 +54,64 @@ def test_flash_cross_attention_lengths():
     want = attention_reference(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_flash_autotune_measured_selection(tmp_path, monkeypatch):
+    """PHI-autotune analog (SURVEY §2.1 autotune row): measured tile
+    selection, persistent cache hit on the second call."""
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.pallas import autotune as at
+    monkeypatch.setattr(at, "_CACHE_PATH", str(tmp_path / "at.json"))
+    at._CACHE = None
+    calls = {"n": 0}
+
+    def bench_fn(cand):
+        calls["n"] += 1
+        import jax.numpy as jnp
+        # pretend (512, 512) is fastest, (256,...) infeasible
+        if cand[0] == 256:
+            raise RuntimeError("vmem oom")
+        import time as _t
+        delay = 0.0 if cand == (512, 512) else 2e-3
+
+        def run():
+            _t.sleep(delay)
+            return jnp.zeros(())
+        return run
+
+    best = at.tune("k", (8, 512), [(1024, 512), (512, 512), (256, 512)],
+                   bench_fn, iters=1)
+    assert best == (512, 512)
+    n_first = calls["n"]
+    assert n_first >= 2                   # measured multiple candidates
+    best2 = at.tune("k", (8, 512), [(1024, 512), (512, 512)], bench_fn)
+    assert best2 == (512, 512)
+    assert calls["n"] == n_first          # cache hit: no re-measure
+    # cache file persisted
+    at._CACHE = None
+    assert at.tune("k", (8, 512), [], bench_fn) == (512, 512)
+
+
+def test_flash_autotune_flag_wiring():
+    """FLAGS_flash_autotune routes flash_attention through the tuner."""
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.pallas import flash_attention as fa, autotune as at
+    seen = {}
+
+    orig = at.tune_flash_blocks
+    at.tune_flash_blocks = \
+        lambda *a: (seen.setdefault("a", a), (512, 512))[1]
+    try:
+        paddle.set_flags({"FLAGS_flash_autotune": True})
+        q = jnp.zeros((1, 512, 2, 64), jnp.float32)
+        fa.flash_attention(q, q, q, causal=True, interpret=True)  # interpret: no tune
+        assert "a" not in seen
+        try:
+            fa.flash_attention(q, q, q, causal=True)
+        except Exception:
+            pass  # compiled pallas can't run on the CPU test backend;
+            #      the tuner consult happens before lowering
+        assert seen["a"][1] == 512        # s_q reached the tuner
+    finally:
+        at.tune_flash_blocks = orig
+        paddle.set_flags({"FLAGS_flash_autotune": False})
