@@ -4,9 +4,8 @@ Reference (SURVEY §2.1): phi/kernels/autotune/ — cache.h keyed kernel
 configs + switch_autotune.cc measuring candidate algorithms at runtime,
 gated on FLAGS_use_autotune. TPU-native version: Pallas kernel tile sizes
 (the flash-attention bq/bk) are the tunable axis; candidates are timed
-EAGERLY on the real device with synthetic data of the call's static shape
-— which works even while an outer jit is tracing, because tuning only
-needs shapes, not values. Results persist to a JSON cache keyed by
+eagerly on the real device with synthetic data of the call's static
+shape. Results persist to a JSON cache keyed by
 (device kind, kernel, shape signature) so the cost is paid once per
 machine/shape, like the reference's AlgorithmsCache.
 
@@ -14,6 +13,13 @@ Opt-in via paddle.set_flags({'FLAGS_flash_autotune': True}) — runtime
 measurement costs one compile per candidate, which on remote-compile
 setups is seconds each (the reference's conv autotune is opt-in for the
 same reason).
+
+Tracing rule: measurement happens ONLY on eager (concrete) calls — under
+an outer jit everything would be staged into the caller's trace and
+nothing actually runs, so flash_attention consults the cache during
+tracing but never tunes there. Warm the cache with one eager call (or
+tune_flash_blocks directly, using your PER-DEVICE shapes when training
+SPMD — the kernel tile choice is per-shard).
 
 MEASURED CAVEAT (v5e, r2 session): isolated-kernel timing can MISLEAD —
 for GPT-1.3B S=2048 the tuner picks (256,512) which wins in isolation but
@@ -77,6 +83,15 @@ def flash_candidates(s_q: int, s_k: int) -> List[Tuple[int, int]]:
     return out or [(min(1024, s_q), min(1024, s_k))]
 
 
+def cached_blocks(kernel: str, sig: Tuple) -> Optional[Tuple]:
+    """Cache lookup only (no measurement) — safe during jit tracing."""
+    import jax
+    cache = _load()
+    dev = getattr(jax.devices()[0], "device_kind", "cpu")
+    hit = cache.get(f"{dev}|{kernel}|{'x'.join(str(s) for s in sig)}")
+    return tuple(hit) if hit is not None else None
+
+
 def tune(kernel: str, sig: Tuple, candidates: List[Tuple],
          bench_fn, iters: int = 3) -> Tuple:
     """Generic measured selection with persistent caching.
@@ -94,11 +109,18 @@ def tune(kernel: str, sig: Tuple, candidates: List[Tuple],
     if hit is not None:
         return tuple(hit)
 
+    if not candidates:
+        raise ValueError(f"tune({kernel!r}): empty candidate list")
+    import jax.core as _core
     best, best_t = None, float("inf")
     for cand in candidates:
         try:
             run = bench_fn(cand)
             out = run()
+            if isinstance(jax.tree.leaves(out)[0], _core.Tracer):
+                raise RuntimeError(
+                    "tune() called under a jit trace: the benchmark would "
+                    "be staged, not measured — call it eagerly")
             jax.block_until_ready(out)          # compile + warm
             t0 = time.perf_counter()
             for _ in range(iters):
@@ -110,7 +132,9 @@ def tune(kernel: str, sig: Tuple, candidates: List[Tuple],
         if dt < best_t:
             best, best_t = cand, dt
     if best is None:
-        best = candidates[0]
+        # nothing measured (all candidates failed): fall back WITHOUT
+        # caching, so a transient failure cannot poison the persistent cache
+        return tuple(candidates[0])
     cache[key] = list(best)
     _save()
     return tuple(best)

@@ -300,12 +300,21 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
         if _flags.get_flags("FLAGS_flash_autotune").get(
                 "FLAGS_flash_autotune", False):
             # measured tile selection with a persistent cache (PHI
-            # autotune analog; see autotune.py) — shapes are static at
-            # trace time, so this runs eagerly even under an outer jit
-            from .autotune import tune_flash_blocks
-            block_q, block_k = tune_flash_blocks(
-                q.shape[0], s_q, s_k, q.shape[2], q.shape[3], causal,
-                q.dtype)
+            # autotune analog; see autotune.py). Measurement only happens
+            # on EAGER calls — under an outer jit the benchmark would be
+            # staged into the caller's trace, so during tracing we consult
+            # the cache and fall back to defaults on a miss.
+            import jax.core as _core
+            from . import autotune as _at
+            sig = (q.shape[0], s_q, s_k, q.shape[2], q.shape[3],
+                   int(causal), str(q.dtype))
+            cached = _at.cached_blocks("flash_attention", sig)
+            if cached is not None:
+                block_q, block_k = cached
+            elif not isinstance(q, _core.Tracer):
+                block_q, block_k = _at.tune_flash_blocks(
+                    q.shape[0], s_q, s_k, q.shape[2], q.shape[3], causal,
+                    q.dtype)
     bq = block_q or int(env_bq) if (block_q or env_bq) else min(DEFAULT_BQ, s_q)
     bk = block_k or int(env_bk) if (block_k or env_bk) else min(DEFAULT_BK, s_k)
     bq = min(bq, s_q)

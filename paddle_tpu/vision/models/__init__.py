@@ -14,4 +14,7 @@ from .densenet import __all__ as _d
 from .swin import __all__ as _sw
 
 __all__ = list(_r) + list(_v) + list(_m) + list(_s) + list(_d) + list(_sw)
-from .yolo import YOLOConfig, YOLODetector, yolo_lite, yolo_loss  # noqa: F401
+from .yolo import (  # noqa: F401
+    YOLOConfig, YOLODetector, yolo_lite, yolo_loss,
+    ppyoloe_s, ppyoloe_m, ppyoloe_l,
+)

@@ -32,7 +32,8 @@ from ...nn.layers.conv import Conv2D
 from ...nn.layers.norm import BatchNorm2D
 from .. import ops as vops
 
-__all__ = ["YOLOConfig", "YOLODetector", "yolo_lite", "yolo_loss"]
+__all__ = ["YOLOConfig", "YOLODetector", "yolo_lite", "yolo_loss",
+           "ppyoloe_s", "ppyoloe_m", "ppyoloe_l"]
 
 
 @dataclass
@@ -42,6 +43,11 @@ class YOLOConfig:
     strides: Sequence[int] = (8, 16, 32)
     score_thresh: float = 0.25
     nms_iou: float = 0.5
+    # PP-YOLOE ET-head options: DFL regression (distance as the softmax
+    # expectation over reg_max+1 bins) and varifocal (IoU-quality-aware)
+    # classification — 0/False reproduces the plain FCOS-style head
+    reg_max: int = 0
+    use_varifocal: bool = False
 
 
 class ConvBNAct(Layer):
@@ -121,21 +127,34 @@ class FPN(Layer):
 
 
 class Head(Layer):
-    """Decoupled anchor-free head: per-scale cls logits [B,C,H,W] and
-    box ltrb distances (in stride units) [B,4,H,W] (PP-YOLOE ET-head
-    simplified: no DFL distribution, direct distance regression)."""
+    """Decoupled anchor-free head (PP-YOLOE ET-head): per-scale cls logits
+    [B,C,H,W] and either direct ltrb distances [B,4,H,W] (reg_max=0) or
+    DFL bin logits [B,4*(reg_max+1),H,W]."""
 
-    def __init__(self, c, num_classes):
+    def __init__(self, c, num_classes, reg_max=0):
         super().__init__()
+        self.reg_max = reg_max
         self.cls_conv = ConvBNAct(c, c, 3)
         self.reg_conv = ConvBNAct(c, c, 3)
         self.cls_pred = Conv2D(c, num_classes, 1)
-        self.reg_pred = Conv2D(c, 4, 1)
+        self.reg_pred = Conv2D(c, 4 * (reg_max + 1) if reg_max else 4, 1)
 
     def forward(self, x):
         cls = self.cls_pred(self.cls_conv(x))
-        reg = F.softplus(self.reg_pred(self.reg_conv(x)))  # distances >= 0
-        return cls, reg
+        raw = self.reg_pred(self.reg_conv(x))
+        if self.reg_max:
+            return cls, raw                       # DFL bin logits
+        return cls, F.softplus(raw)               # distances >= 0
+
+
+def _dfl_expectation(raw, reg_max):
+    """[B, 4*(R+1), H, W] bin logits -> [B, 4, H, W] distances: the
+    softmax-expectation decode of DFL (PP-YOLOE's integral regression)."""
+    B, _, H, W = raw.shape
+    bins = raw.reshape(B, 4, reg_max + 1, H, W)
+    p = jax.nn.softmax(bins, axis=2)
+    proj = jnp.arange(reg_max + 1, dtype=p.dtype).reshape(1, 1, -1, 1, 1)
+    return (p * proj).sum(axis=2)
 
 
 class YOLODetector(Layer):
@@ -148,7 +167,8 @@ class YOLODetector(Layer):
         w = self.config.width
         self.backbone = Backbone(w)
         self.neck = FPN(w)
-        self.heads = LayerList([Head(w * 4, self.config.num_classes)
+        self.heads = LayerList([Head(w * 4, self.config.num_classes,
+                                     reg_max=self.config.reg_max)
                                 for _ in self.config.strides])
 
     def forward(self, images):
@@ -168,7 +188,10 @@ class YOLODetector(Layer):
         all_boxes, all_scores, all_cls = [], [], []
         for (cls, reg), stride in zip(outs, cfg.strides):
             c = np.asarray(cls._data)      # [B,C,H,W]
-            r = np.asarray(reg._data)      # [B,4,H,W]
+            if cfg.reg_max:
+                r = np.asarray(_dfl_expectation(reg._data, cfg.reg_max))
+            else:
+                r = np.asarray(reg._data)  # [B,4,H,W]
             Bc, C, H, W = c.shape
             ys, xs = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
             cx = (xs + 0.5) * stride
@@ -244,16 +267,12 @@ def yolo_loss(outputs, gt_boxes, gt_labels, gt_mask, config: YOLOConfig):
             tx1, ty1, tx2, ty2 = take(x1), take(y1), take(x2), take(y2)
             tlab = take(labels.astype(jnp.float32)).astype(jnp.int32)
 
-            # classification: BCE over classes, target one-hot at positives
-            onehot = jax.nn.one_hot(tlab, C, axis=-1)             # [B,H,W,C]
-            onehot = onehot * pos[..., None]
-            logits = jnp.moveaxis(cls, 1, -1)                     # [B,H,W,C]
-            cls_loss = jnp.mean(
-                jnp.maximum(logits, 0) - logits * onehot +
-                jnp.log1p(jnp.exp(-jnp.abs(logits))))
-
-            # regression: GIoU on positive cells
-            l, t, r, b = (reg[:, i] * stride for i in range(4))
+            # regression distances (DFL: softmax expectation over bins)
+            if config.reg_max:
+                dist = _dfl_expectation(reg, config.reg_max)
+            else:
+                dist = reg
+            l, t, r, b = (dist[:, i] * stride for i in range(4))
             px1, py1 = cx[None] - l, cy[None] - t
             px2, py2 = cx[None] + r, cy[None] + b
             iw = jnp.maximum(jnp.minimum(px2, tx2) - jnp.maximum(px1, tx1), 0)
@@ -269,7 +288,45 @@ def yolo_loss(outputs, gt_boxes, gt_labels, gt_mask, config: YOLOConfig):
             giou = iou - (enc - union) / enc
             npos = jnp.maximum(jnp.sum(pos), 1.0)
             reg_loss = jnp.sum((1.0 - giou) * pos) / npos
-            return cls_loss + reg_loss
+
+            # classification AFTER regression so varifocal can use the
+            # IoU as the quality target (PP-YOLOE: VFL(q = IoU))
+            onehot = jax.nn.one_hot(tlab, C, axis=-1)             # [B,H,W,C]
+            logits = jnp.moveaxis(cls, 1, -1)                     # [B,H,W,C]
+            if config.use_varifocal:
+                q = jax.lax.stop_gradient(
+                    jnp.clip(iou, 0.0, 1.0)) * pos                # [B,H,W]
+                tgt = onehot * q[..., None]
+                p = jax.nn.sigmoid(logits)
+                alpha, gamma = 0.75, 2.0
+                w = jnp.where(tgt > 0, tgt, alpha * jnp.power(p, gamma))
+                bce = jnp.maximum(logits, 0) - logits * tgt +                     jnp.log1p(jnp.exp(-jnp.abs(logits)))
+                cls_loss = jnp.sum(w * bce) / npos
+            else:
+                tgt = onehot * pos[..., None]
+                cls_loss = jnp.mean(
+                    jnp.maximum(logits, 0) - logits * tgt +
+                    jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+            # DFL: CE against the two integer bins bracketing the target
+            # distance (on positives)
+            dfl_loss = 0.0
+            if config.reg_max:
+                R = config.reg_max
+                B2, _, H2, W2 = dist.shape
+                bins = reg.reshape(B2, 4, R + 1, H2, W2)
+                logp = jax.nn.log_softmax(bins, axis=2)
+                tdist = jnp.stack([
+                    cx[None] - tx1, cy[None] - ty1,
+                    tx2 - cx[None], ty2 - cy[None]], axis=1) / stride
+                tdist = jnp.clip(tdist, 0.0, R - 1e-3)            # [B,4,H,W]
+                lo_bin = jnp.floor(tdist).astype(jnp.int32)
+                hi_w = tdist - lo_bin
+                lp_lo = jnp.take_along_axis(logp, lo_bin[:, :, None], 2)[:, :, 0]
+                lp_hi = jnp.take_along_axis(logp, (lo_bin + 1)[:, :, None], 2)[:, :, 0]
+                per = -((1 - hi_w) * lp_lo + hi_w * lp_hi)        # [B,4,H,W]
+                dfl_loss = jnp.sum(per.mean(1) * pos) / npos * 0.25
+            return cls_loss + reg_loss + dfl_loss
 
         return apply_op("yolo_loss_scale", fn,
                         [cls_t, reg_t, gt_boxes, gt_labels, gt_mask])
@@ -285,3 +342,24 @@ def yolo_loss(outputs, gt_boxes, gt_labels, gt_mask, config: YOLOConfig):
 def yolo_lite(num_classes=80, **kw):
     """Small PP-YOLOE-class detector preset."""
     return YOLODetector(YOLOConfig(num_classes=num_classes, **kw))
+
+
+def _ppyoloe(width, num_classes, **kw):
+    kw.setdefault("reg_max", 16)
+    kw.setdefault("use_varifocal", True)
+    return YOLODetector(YOLOConfig(num_classes=num_classes, width=width, **kw))
+
+
+def ppyoloe_s(num_classes=80, **kw):
+    """PP-YOLOE-S-class entrypoint (BASELINE.md toolkit config): DFL
+    integral regression + varifocal classification on the anchor-free
+    head."""
+    return _ppyoloe(32, num_classes, **kw)
+
+
+def ppyoloe_m(num_classes=80, **kw):
+    return _ppyoloe(48, num_classes, **kw)
+
+
+def ppyoloe_l(num_classes=80, **kw):
+    return _ppyoloe(64, num_classes, **kw)

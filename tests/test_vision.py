@@ -272,3 +272,49 @@ def test_yolo_detector_trains_and_decodes():
     assert len(dets) == B
     boxes, scores, classes = dets[0]
     assert boxes.shape[1] == 4 and len(scores) == len(classes) <= 5
+
+
+def test_ppyoloe_dfl_varifocal_trains_and_decodes():
+    """PP-YOLOE ET-head pieces (BASELINE toolkit entrypoint): DFL integral
+    regression + varifocal classification — train a few steps on one
+    synthetic box, loss decreases, decode returns finite boxes."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import ppyoloe_s
+    from paddle_tpu.vision.models.yolo import (YOLOConfig, YOLODetector,
+                                               yolo_loss, _dfl_expectation)
+    import jax.numpy as jnp
+
+    paddle.seed(0)
+    model = YOLODetector(YOLOConfig(num_classes=3, width=8, reg_max=8,
+                                    use_varifocal=True))
+    imgs = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 64, 64).astype("float32"))
+    outs = model(imgs)
+    # head emits 4*(reg_max+1) bin logits per cell
+    assert outs[0][1].shape[1] == 4 * 9
+    # expectation decode is bounded by reg_max
+    d = _dfl_expectation(outs[0][1]._data, 8)
+    assert float(jnp.max(d)) <= 8.0 and float(jnp.min(d)) >= 0.0
+
+    gt_boxes = paddle.to_tensor(np.array(
+        [[[8.0, 8.0, 40.0, 40.0]], [[16.0, 16.0, 56.0, 48.0]]], np.float32))
+    gt_labels = paddle.to_tensor(np.array([[1], [2]], np.int64))
+    gt_mask = paddle.to_tensor(np.ones((2, 1), np.float32))
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=5e-3)
+    losses = []
+    for _ in range(6):
+        loss = yolo_loss(model(imgs), gt_boxes, gt_labels, gt_mask,
+                         model.config)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    model.eval()
+    dets = model.decode(imgs, score_thresh=0.0, max_dets=5)
+    assert len(dets) == 2
+    bb, ss, cc = dets[0]
+    assert np.isfinite(bb).all() if len(bb) else True
+    # preset entrypoints exist
+    assert ppyoloe_s(num_classes=3).config.reg_max == 16
