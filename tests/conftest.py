@@ -30,3 +30,9 @@ def _seed():
     np.random.seed(0)
     import paddle_tpu
     paddle_tpu.seed(0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-minute tests (subprocess clusters, detector "
+        "training) — `-m 'not slow'` gives the quick pass")

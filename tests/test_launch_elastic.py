@@ -253,6 +253,7 @@ TRAIN_WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 def test_launch_multihost_dp_tp_training(tmp_path):
     """Full DP(cross-process) x TP(local) training through the launcher:
     two processes with 4 virtual devices each form one 8-device mesh; the
@@ -312,6 +313,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow
 def test_launch_elastic_scale_up(tmp_path):
     """Node joins a running 1:2 job: the incumbent rebuilds the rank table
     (nnodes 1 -> 2) and restarts its trainers (reference: manager.py:126
@@ -336,6 +338,7 @@ def test_launch_elastic_scale_up(tmp_path):
     a.communicate(); b.communicate()
 
 
+@pytest.mark.slow
 def test_launch_elastic_scale_down(tmp_path):
     """Node dies mid-job: the survivor notices the lost heartbeat, shrinks
     the world (nnodes 2 -> 1), and restarts trainers (reference:

@@ -233,6 +233,7 @@ def test_fake_data_dataloader():
     assert tuple(imgs.shape) == (4, 3, 8, 8)
 
 
+@pytest.mark.slow
 def test_yolo_detector_trains_and_decodes():
     """PP-YOLOE-class detector: dense static-shape loss decreases on a
     synthetic single-box task; decode returns NMS'd detections."""
@@ -274,6 +275,7 @@ def test_yolo_detector_trains_and_decodes():
     assert boxes.shape[1] == 4 and len(scores) == len(classes) <= 5
 
 
+@pytest.mark.slow
 def test_ppyoloe_dfl_varifocal_trains_and_decodes():
     """PP-YOLOE ET-head pieces (BASELINE toolkit entrypoint): DFL integral
     regression + varifocal classification — train a few steps on one
