@@ -22,6 +22,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -40,15 +41,21 @@ struct PD_Tensor {
   PyObject* handle;     // paddle_tpu.inference.Tensor (named handle)
 };
 
-static bool g_py_inited = false;
-
 static void ensure_python() {
-  if (!g_py_inited) {
+  // once_flag: concurrent first calls from different server threads must
+  // not race Py_IsInitialized/Py_InitializeEx (concurrent init is UB).
+  static std::once_flag flag;
+  std::call_once(flag, [] {
     if (!Py_IsInitialized()) {
       Py_InitializeEx(0);
+      // Py_InitializeEx leaves the calling thread holding the GIL. Release
+      // it here so that PD_* entry points — which each take the GIL via
+      // PyGILState_Ensure/Release — can be called from ANY thread of a
+      // multithreaded serving stack without deadlocking on the initializer
+      // thread's never-released GIL.
+      PyEval_SaveThread();
     }
-    g_py_inited = true;
-  }
+  });
 }
 
 // ---------------------------------------------------------------- Config

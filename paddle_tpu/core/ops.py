@@ -137,7 +137,21 @@ isfinite = lambda x, name=None: _nodiff(jnp.isfinite, x)
 add = _binary("add", jnp.add)
 subtract = _binary("subtract", jnp.subtract)
 multiply = _binary("multiply", jnp.multiply)
-divide = _binary("divide", lambda x, y: jnp.divide(_floatify(x), _floatify(y)))
+def _divide_fn(x, y):
+    # Reference parity: paddle.divide keeps INTEGER division (C trunc
+    # toward zero, the int DivideFunctor) when both inputs are integer
+    # tensors — divide(5, 2) == 2. Only the `/` operator path float-casts
+    # (math_op_patch.py _scalar_div_); see _true_divide below.
+    xd, yd = jnp.asarray(x).dtype, jnp.asarray(y).dtype
+    if jnp.issubdtype(xd, jnp.integer) and jnp.issubdtype(yd, jnp.integer):
+        cd = jnp.promote_types(xd, yd)
+        return lax.div(jnp.asarray(x).astype(cd), jnp.asarray(y).astype(cd))
+    return jnp.divide(_floatify(x), _floatify(y))
+
+
+divide = _binary("divide", _divide_fn)
+_true_divide = _binary(
+    "divide", lambda x, y: jnp.divide(_floatify(x), _floatify(y)))
 floor_divide = _binary("floor_divide", jnp.floor_divide)
 mod = _binary("mod", jnp.mod)
 remainder = mod
@@ -1459,8 +1473,8 @@ def _attach_methods():
     T.__rsub__ = lambda s, o: subtract(to_tensor(o) if not isinstance(o, Tensor) else o, s)
     T.__mul__ = lambda s, o: multiply(s, o)
     T.__rmul__ = lambda s, o: multiply(to_tensor(o) if not isinstance(o, Tensor) else o, s)
-    T.__truediv__ = lambda s, o: divide(s, o)
-    T.__rtruediv__ = lambda s, o: divide(to_tensor(o) if not isinstance(o, Tensor) else o, s)
+    T.__truediv__ = lambda s, o: _true_divide(s, o)
+    T.__rtruediv__ = lambda s, o: _true_divide(to_tensor(o) if not isinstance(o, Tensor) else o, s)
     T.__floordiv__ = lambda s, o: floor_divide(s, o)
     T.__mod__ = lambda s, o: mod(s, o)
     T.__pow__ = lambda s, o: pow(s, o)

@@ -70,8 +70,13 @@ class _CacheLookup(PyLayer):
     def backward(ctx, dy):
         m = ctx.module
         g = dy._data.reshape(-1, m.dim).astype(jnp.float32)
-        m._table, m._g2 = _adagrad_rowwise_jit(
-            m._table, m._g2, ctx.uniq, ctx.inv, g, jnp.float32(m.lr))
+        # _lock orders this read-modify-write of (_table, _g2) against the
+        # prefetch() admission thread, which also updates both arrays —
+        # without it the overlap pattern (prefetch(next); loss.backward())
+        # can drop a whole batch's update or touch a donated buffer.
+        with m._lock:
+            m._table, m._g2 = _adagrad_rowwise_jit(
+                m._table, m._g2, ctx.uniq, ctx.inv, g, jnp.float32(m.lr))
         return Tensor(jnp.zeros((), jnp.float32))
 
 

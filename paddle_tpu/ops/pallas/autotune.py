@@ -133,8 +133,17 @@ def tune(kernel: str, sig: Tuple, candidates: List[Tuple],
             best, best_t = cand, dt
     if best is None:
         # nothing measured (all candidates failed): fall back WITHOUT
-        # caching, so a transient failure cannot poison the persistent cache
-        return tuple(candidates[0])
+        # caching, so a transient failure cannot poison the persistent
+        # cache. Candidate lists are ordered largest-tile-first, and the
+        # dominant failure mode is VMEM OOM — so pick the SMALLEST
+        # candidate (most likely to compile), not candidates[0].
+        import logging
+        import math
+        smallest = min(candidates, key=lambda c: math.prod(c))
+        logging.getLogger(__name__).warning(
+            "autotune(%s): every candidate failed to run; falling back to "
+            "smallest tile %s (unmeasured)", kernel, smallest)
+        return tuple(smallest)
     cache[key] = list(best)
     _save()
     return tuple(best)

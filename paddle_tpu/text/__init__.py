@@ -103,7 +103,8 @@ class Imdb(_LocalDataset):
     the reference (imdb.py _build_work_dict: keep words with freq >= cutoff,
     sorted by (-freq, word))."""
 
-    def __init__(self, data_file=None, mode="train", cutoff=150):
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 word_idx=None):
         super().__init__(data_file, mode)
         import re
         tok = re.compile(r"[a-z]+")
@@ -118,29 +119,54 @@ class Imdb(_LocalDataset):
                         ls.append(label)
             return ds, ls
 
-        if os.path.isdir(self.data_file):
-            docs, labels = read_dir(mode)
-            # vocab ALWAYS from the train corpus so train/test ids agree
-            # (reference: imdb.py builds word_idx from the train pattern)
-            vocab_docs = docs if mode == "train" else read_dir("train")[0]
-        else:
-            docs, labels = [], []
-            with open(self.data_file, errors="ignore") as f:
+        def read_tsv(path):
+            ds, ls = [], []
+            with open(path, errors="ignore") as f:
                 for line in f:
                     lab, _, text = line.partition("\t")
                     if not text:
                         continue
-                    docs.append(tok.findall(text.lower()))
-                    labels.append(int(lab))
-            vocab_docs = docs
-        freq = {}
-        for d in vocab_docs:
-            for w in d:
-                freq[w] = freq.get(w, 0) + 1
-        kept = sorted(((w, c) for w, c in freq.items() if c >= cutoff),
-                      key=lambda x: (-x[1], x[0]))
-        self.word_idx = {w: i for i, (w, _) in enumerate(kept)}
-        self.word_idx["<unk>"] = len(self.word_idx)
+                    ds.append(tok.findall(text.lower()))
+                    ls.append(int(lab))
+            return ds, ls
+
+        if os.path.isdir(self.data_file):
+            docs, labels = read_dir(mode)
+            # vocab ALWAYS from the train corpus so train/test ids agree
+            # (reference: imdb.py builds word_idx from the train pattern)
+            if word_idx is not None or mode == "train":
+                vocab_docs = docs
+            else:
+                vocab_docs = read_dir("train")[0]
+        else:
+            docs, labels = read_tsv(self.data_file)
+            if word_idx is not None or mode == "train":
+                vocab_docs = docs
+            else:
+                # same rule for TSV input: ids must come from the TRAIN
+                # corpus. Look for the sibling train file (test.tsv ->
+                # train.tsv, basename only — the mode string may also occur
+                # in directory names); else the caller must share word_idx.
+                head, base = os.path.split(self.data_file)
+                sib = os.path.join(head, base.replace(mode, "train"))
+                if base != base.replace(mode, "train") and os.path.exists(sib):
+                    vocab_docs = read_tsv(sib)[0]
+                else:
+                    raise ValueError(
+                        "Imdb(TSV, mode=%r): cannot locate the train file to "
+                        "build a train-consistent vocab; pass word_idx= from "
+                        "the train dataset" % mode)
+        if word_idx is not None:
+            self.word_idx = dict(word_idx)
+        else:
+            freq = {}
+            for d in vocab_docs:
+                for w in d:
+                    freq[w] = freq.get(w, 0) + 1
+            kept = sorted(((w, c) for w, c in freq.items() if c >= cutoff),
+                          key=lambda x: (-x[1], x[0]))
+            self.word_idx = {w: i for i, (w, _) in enumerate(kept)}
+            self.word_idx["<unk>"] = len(self.word_idx)
         unk = self.word_idx["<unk>"]
         self.docs = [np.array([self.word_idx.get(w, unk) for w in d],
                               np.int64) for d in docs]
