@@ -145,7 +145,9 @@ def _divide_fn(x, y):
     xd, yd = jnp.asarray(x).dtype, jnp.asarray(y).dtype
     if jnp.issubdtype(xd, jnp.integer) and jnp.issubdtype(yd, jnp.integer):
         cd = jnp.promote_types(xd, yd)
-        return lax.div(jnp.asarray(x).astype(cd), jnp.asarray(y).astype(cd))
+        xb, yb = jnp.broadcast_arrays(jnp.asarray(x).astype(cd),
+                                      jnp.asarray(y).astype(cd))
+        return lax.div(xb, yb)
     return jnp.divide(_floatify(x), _floatify(y))
 
 

@@ -200,12 +200,20 @@ class CompiledPipelineParallel(PipelineParallel):
     `pipeline_spmd` microbatch schedule over the pp mesh axis — the compiled
     replacement for the reference's eager 1F1B driver loop
     (pipeline_parallel.py:117-228). Requires the model to expose
-    `loss(inputs, labels, num_microbatches=...)`."""
+    `loss(inputs, labels, num_microbatches=...)`.
+
+    strategy.pipeline_configs["interleave"] > 1 routes through the
+    interleaved virtual-stage schedule (pipeline_scan_interleaved; the
+    reference's PipelineParallelWithInterleave production mode,
+    pipeline_parallel.py:461-761) — the model's loss() must accept
+    num_virtual (models/gpt_stacked.py does)."""
 
     def __init__(self, model, hcg, strategy):
         super().__init__(model, hcg, strategy)
         self._train_step = None
         self._step_optimizer = None
+        self.num_virtual = int(
+            strategy.pipeline_configs.get("interleave", 1)) if strategy else 1
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         if scaler is not None:
@@ -222,10 +230,13 @@ class CompiledPipelineParallel(PipelineParallel):
         if self._train_step is None or self._step_optimizer is not optimizer:
             from ..jit.train_step import TrainStep
             n = max(1, self.accumulate_steps)
+            v = max(1, self.num_virtual)
+            kw = {"num_virtual": v} if v > 1 else {}
             mesh = getattr(self.hcg, "mesh", None) or _mesh.get_mesh()
             self._train_step = TrainStep(
                 self.model, optimizer,
-                lambda ids, lbl: self.model.loss(ids, lbl, num_microbatches=n),
+                lambda ids, lbl: self.model.loss(ids, lbl,
+                                                 num_microbatches=n, **kw),
                 mesh=mesh, data_axes=("dp",))
             self._step_optimizer = optimizer
         loss = self._train_step(x, y)
@@ -362,71 +373,104 @@ def pipeline_scan(stage_fn: Callable, stacked_params, x_microbatches,
     return f(stacked_params, x_microbatches)
 
 
+def interleaved_ticks(M: int, S: int, V: int) -> int:
+    """Tick count of `pipeline_scan_interleaved` (one CHUNK of compute per
+    device per tick). Microbatch m is injected at tick (m%S) + S·V·(m//S)
+    and drains S·V ticks later; for M = k·S this is M·V + S - 1 — the
+    interleaved-1F1B fill/drain cost (Megatron: bubble shrinks by 1/V).
+    The plain schedule costs (M+S-1) ticks of V chunks each = V·(M+S-1)
+    chunk-times, strictly more for V>1: interleaving trades more, smaller
+    p2p messages for a shorter pipeline fill — the same trade the
+    reference's PipelineParallelWithInterleave makes."""
+    L = S * V
+    return (M - 1) % S + L * ((M - 1) // S) + L
+
+
 def pipeline_scan_interleaved(stage_fn: Callable, stacked_params,
                               x_microbatches, axis: str = "pp",
                               num_virtual: int = 2):
     """Interleaved virtual-stage pipeline (reference:
     PipelineParallelWithInterleave, pipeline_parallel.py:461-761).
 
-    The model's L = S·V stages are dealt round-robin: device d owns virtual
-    chunks {v·S + d}, so the activation ring visits every device V times per
-    sweep. Versus the plain scan's bubble of (S-1)/(M+S-1) ticks, the
-    interleaved ring keeps devices busy on other chunks while a microbatch
-    transits — the same bubble-shrinking trade (more, smaller p2p messages)
-    the reference's schedule makes, expressed as one lax.scan over ticks
-    with a [V, ...] activation buffer per device and one ppermute per tick.
+    The model's L = S·V logical stages are dealt round-robin: device d owns
+    virtual chunks {v·S + d}. Each tick every device computes ONE chunk —
+    1/V of a plain-schedule tick — and the ring advances one logical stage
+    via ppermute. A microbatch therefore reaches the next device after one
+    CHUNK (L/(S·V) of the model), not one full stage slice: the pipeline
+    fill costs (S-1) chunk-times instead of (S-1) stage-times, the
+    interleaved-1F1B bubble reduction. Total cost `interleaved_ticks(M,S,V)`
+    = M·V + S - 1 chunk-times (M = k·S) vs the plain scan's V·(M+S-1).
 
-    `stacked_params` leaves have leading dim L = S·num_virtual ordered by
-    logical stage, sharded P(axis) → each device holds its V chunks.
+    The ring carries (activation, logical_stage, microbatch_id) per device;
+    device 0 injects a fresh microbatch whenever the arriving slot is free
+    (finished microbatches leave the ring at device S-1). Manual collectives
+    run only over `axis` (shard_map axis_names={axis}), so dp/mp shardings
+    inside stage_fn stay in XLA's auto-sharding world — this kernel composes
+    with hybrid dp×pp×mp meshes, unlike a fully-manual shard_map.
+
+    `stacked_params` leaves have leading dim L = S·num_virtual, ordered so
+    that P(axis) sharding hands device d rows [d·V, (d+1)·V) = its chunks
+    v·S+d in chunk order (the caller permutes: row d·V+v = logical v·S+d).
     Returns outputs stacked [M, ...].
     """
     S = _mesh.mesh_axis_size(axis)
     V = num_virtual
     L = S * V
     M = x_microbatches.shape[0]
+    T = interleaved_ticks(M, S, V)
 
     def per_device(params, xs):
-        # params leaves: [V, ...] — this device's chunks, logical stage of
-        # chunk v being v*S + sid
+        # params leaves: [V, ...] — this device's chunks; the chunk of an
+        # arriving activation at logical stage l is l // S (l % S == sid is
+        # a ring invariant: injection at stage 0 on device 0, +1 per hop)
         sid = lax.axis_index(axis)
-        T = M + L - 1
         perm = [(i, (i + 1) % S) for i in range(S)]
-        buf = jnp.zeros((V,) + xs.shape[1:], xs.dtype)
-        outs = jnp.zeros((M,) + xs.shape[1:], xs.dtype)
 
         def tick(carry, t):
-            buf, outs = carry
-            # device 0 chunk 0 consumes a fresh microbatch each tick
-            mb_idx = jnp.clip(t, 0, M - 1)
-            inp0 = jnp.where(sid == 0, xs[mb_idx], buf[0])
-            inp = buf.at[0].set(inp0)
-            acts = []
-            for v in range(V):
-                pv = jax.tree.map(lambda a: a[v], params)
-                acts.append(stage_fn(pv, inp[v]))
-            acts = jnp.stack(acts)
-            # the microbatch leaving logical stage L-1 (device S-1, chunk
-            # V-1) at tick t is t-(L-1)
-            done_idx = t - (L - 1)
-            is_done = jnp.logical_and(sid == S - 1, done_idx >= 0)
+            act, stage, mb, inj, outs = carry
+            # device 0 injects into a free arriving slot (stage < 0)
+            do_inj = (sid == 0) & (stage < 0) & (inj < M)
+            act = jnp.where(do_inj, xs[jnp.clip(inj, 0, M - 1)], act)
+            stage = jnp.where(do_inj, jnp.int32(0), stage)
+            mb = jnp.where(do_inj, inj, mb)
+            inj = inj + do_inj.astype(jnp.int32)
+            # ONE chunk of compute (empty slots compute garbage and mask —
+            # the static-shape XLA idiom for an idle tick)
+            v = jnp.clip(stage // S, 0, V - 1)
+            pv = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, v, 0, keepdims=False),
+                params)
+            occupied = stage >= 0
+            act = jnp.where(occupied, stage_fn(pv, act), act)
+            stage = jnp.where(occupied, stage + 1, stage)
+            # finished microbatches leave the ring at device S-1 (= (L-1)%S)
+            done = occupied & (stage == L)
             outs = lax.cond(
-                is_done,
-                lambda o: o.at[jnp.clip(done_idx, 0, M - 1)].set(acts[V - 1]),
+                done,
+                lambda o: o.at[jnp.clip(mb, 0, M - 1)].set(act),
                 lambda o: o, outs)
-            rotated = lax.ppermute(acts, axis, perm)
-            # crossing S-1 -> 0 promotes an activation to the next chunk
-            promoted = jnp.roll(rotated, 1, axis=0)
-            new_buf = jnp.where(sid == 0, promoted, rotated)
-            return (new_buf, outs), None
+            stage = jnp.where(done, jnp.int32(-1), stage)
+            act = lax.ppermute(act, axis, perm)
+            stage = lax.ppermute(stage, axis, perm)
+            mb = lax.ppermute(mb, axis, perm)
+            return (act, stage, mb, inj, outs), None
 
-        (buf, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(T))
+        init = (jnp.zeros(xs.shape[1:], xs.dtype), jnp.int32(-1),
+                jnp.int32(-1), jnp.int32(0), jnp.zeros_like(xs))
+        (_, _, _, _, outs), _ = lax.scan(tick, init, jnp.arange(T))
         contrib = jnp.where(sid == S - 1, outs, jnp.zeros_like(outs))
         return lax.psum(contrib, axis)
 
     mesh = _mesh.get_mesh()
     from jax import shard_map
     pspec = jax.tree.map(lambda _: P(axis), stacked_params)
-    f = shard_map(per_device, mesh=mesh,
+    f = shard_map(per_device, mesh=mesh, axis_names={axis},
                   in_specs=(pspec, P()), out_specs=P(),
                   check_vma=False)
-    return f(stacked_params, x_microbatches)
+    # partial-manual shard_map (manual pp, auto dp/mp) only lowers inside a
+    # jit scope — a bare eager call (and a bare jax.vjp trace, which the
+    # eager tape uses) rejects it at construction. The jit wrapper is a
+    # fresh closure per call, so the EAGER path recompiles each loss();
+    # acceptable for tests/interactive use — production runs inside the one
+    # fused TrainStep program, where this jit is traced inline.
+    return jax.jit(f)(stacked_params, x_microbatches)

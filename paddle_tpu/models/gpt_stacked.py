@@ -98,6 +98,39 @@ def _block_batch(p, x, cfg: GPTConfig):
     return x + d
 
 
+def _block_single(p, x, cfg: GPTConfig):
+    """One transformer block on a single activation [mb, s, H] with
+    per-layer params (no stage dim) — the interleaved-pipeline chunk body.
+    Constraints name only auto axes (dp/mp): inside
+    `pipeline_scan_interleaved` the pp axis is manual (shard_map
+    axis_names={'pp'}) and must not appear in sharding constraints."""
+    nh, hd = cfg.num_heads, cfg.head_dim
+    eps = cfg.layer_norm_epsilon
+    mb, s, H = x.shape
+
+    h = _ln(x, p["ln1_w"], p["ln1_b"], eps)
+    qkv = jnp.einsum("mth,hk->mtk", h, p["qkv_w"]) + p["qkv_b"]
+    qkv = _mesh.shard_constraint(qkv, "dp", None, "mp")
+    qkv = qkv.reshape(mb, s, 3, nh, hd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = _mesh.shard_constraint(q, "dp", None, "mp", None)
+    k = _mesh.shard_constraint(k, "dp", None, "mp", None)
+    v = _mesh.shard_constraint(v, "dp", None, "mp", None)
+    ctx = functional_attention(q, k, v, is_causal=True)
+    a = jnp.einsum("mtk,kh->mth", ctx.reshape(mb, s, nh * hd), p["out_w"]) \
+        + p["out_b"]
+    a = _mesh.shard_constraint(a, "dp", None, None)
+    x = x + a
+
+    h2 = _ln(x, p["ln2_w"], p["ln2_b"], eps)
+    u = jnp.einsum("mth,hk->mtk", h2, p["up_w"]) + p["up_b"]
+    u = _mesh.shard_constraint(u, "dp", None, "mp")
+    g = jax.nn.gelu(u, approximate=True)
+    d = jnp.einsum("mtk,kh->mth", g, p["down_w"]) + p["down_b"]
+    d = _mesh.shard_constraint(d, "dp", None, None)
+    return x + d
+
+
 def _embed(ids, wte, wpe, cfg):
     x = jnp.take(wte, ids, axis=0) + wpe[None, :ids.shape[1]]
     return _mesh.shard_constraint(x, "dp", None, None)
@@ -115,12 +148,44 @@ def _stacked_forward_scan(block_tree, x, cfg):
 
 def _stacked_loss_array(ids, labels, loss_mask, wte, wpe, lnf_w, lnf_b,
                         *block_leaves, cfg: GPTConfig, num_microbatches=None,
-                        chunk_size=128):
-    """Pure-array stacked-GPT loss; pipelines over pp when the mesh has it."""
+                        chunk_size=128, num_virtual=1):
+    """Pure-array stacked-GPT loss; pipelines over pp when the mesh has it.
+    num_virtual > 1 routes through the interleaved virtual-stage schedule
+    (reference PipelineParallelWithInterleave, pipeline_parallel.py:461)."""
     block_tree = dict(zip([n for n, *_ in _BLOCK_PARAMS], block_leaves))
     x = _embed(ids, wte, wpe, cfg)
     pp = _mesh.mesh_axis_size("pp")
-    if pp > 1:
+    if pp > 1 and num_virtual > 1:
+        from ..distributed.pipeline import pipeline_scan_interleaved
+        B, s, H = x.shape
+        M = num_microbatches or pp
+        V = num_virtual
+        Lp = pp * V                       # logical pipeline stages
+        L = cfg.num_layers
+        assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+        assert L % Lp == 0, \
+            f"layers {L} not divisible by pp*num_virtual {Lp}"
+        xs = x.reshape(M, B // M, s, H)
+
+        def chunk_fn(ptree, act):
+            # ptree leaves [depth_per_chunk, ...] -> scan this chunk's depth
+            def body(a, pslice):
+                return _block_single(pslice, a, cfg), None
+
+            act, _ = jax.lax.scan(body, act, ptree)
+            return act
+
+        # deal logical stages round-robin: sharded row d*V+v must hold
+        # logical stage v*pp+d (see pipeline_scan_interleaved contract)
+        order = jnp.asarray([v * pp + d for d in range(pp)
+                             for v in range(V)], jnp.int32)
+        staged = jax.tree.map(
+            lambda t: t.reshape((Lp, L // Lp) + t.shape[1:])[order],
+            block_tree)
+        out = pipeline_scan_interleaved(chunk_fn, staged, xs, axis="pp",
+                                        num_virtual=V)
+        x = out.reshape(B, s, H)
+    elif pp > 1:
         from ..distributed.pipeline import pipeline_spmd
         B, s, H = x.shape
         M = num_microbatches or pp
@@ -251,10 +316,12 @@ class GPTStackedForCausalLM(Layer):
                          self.ln_f_b] + self._block_tensors())
 
     def loss(self, input_ids, labels, loss_mask=None,
-             num_microbatches: Optional[int] = None, chunk_size: int = 128):
+             num_microbatches: Optional[int] = None, chunk_size: int = 128,
+             num_virtual: int = 1):
         cfg = self.config
         fn = partial(_stacked_loss_array, cfg=cfg,
-                     num_microbatches=num_microbatches, chunk_size=chunk_size)
+                     num_microbatches=num_microbatches, chunk_size=chunk_size,
+                     num_virtual=num_virtual)
         if loss_mask is None:
             def fn2(ids, labels_, *rest):
                 return fn(ids, labels_, None, *rest)

@@ -148,8 +148,12 @@ class Imdb(_LocalDataset):
                 # train.tsv, basename only — the mode string may also occur
                 # in directory names); else the caller must share word_idx.
                 head, base = os.path.split(self.data_file)
-                sib = os.path.join(head, base.replace(mode, "train"))
-                if base != base.replace(mode, "train") and os.path.exists(sib):
+                # replace only the LAST occurrence of the mode token in the
+                # basename (a name like "protest_test.tsv" contains it twice)
+                pre, hit, post = base.rpartition(mode)
+                sib_base = pre + "train" + post if hit else base
+                sib = os.path.join(head, sib_base)
+                if sib_base != base and os.path.exists(sib):
                     vocab_docs = read_tsv(sib)[0]
                 else:
                     raise ValueError(

@@ -440,3 +440,82 @@ class TestStackedPipelineGPT:
         txt = str(jaxpr)
         assert "2,2,8,32" in txt.replace(" ", ""), \
             "expected [pp=2, mb=2, s=8, H=32] pipeline buffer in jaxpr"
+
+
+class TestInterleavedPipelineGPT:
+    """Interleaved virtual-stage pipeline wired into the flagship path
+    (VERDICT r2 #3; reference PipelineParallelWithInterleave,
+    pipeline_parallel.py:461-761): loss parity vs the plain schedule AND
+    the layered model on a hybrid dp×pp×mp mesh, fleet strategy routing,
+    and the bubble-accounting claim."""
+
+    def _cfg(self):
+        from paddle_tpu.models import GPTConfig
+        return GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                         num_heads=4, max_position_embeddings=16,
+                         intermediate_size=64)
+
+    def test_interleaved_loss_and_grad_parity(self):
+        from paddle_tpu.models import GPTForCausalLM, GPTStackedForCausalLM
+        paddle.seed(7)
+        m = GPTForCausalLM(self._cfg())
+        sm = GPTStackedForCausalLM.from_layered(m)
+        ids = paddle.to_tensor(
+            np.random.RandomState(2).randint(0, 64, (4, 8)).astype("int32"))
+        ref = float(m.loss(ids, ids))
+
+        mesh = dist.build_mesh({"dp": 2, "pp": 2, "mp": 2})
+        with dist.mesh_scope(mesh):
+            plain = sm.loss(ids, ids, num_microbatches=2)
+            inter = sm.loss(ids, ids, num_microbatches=2, num_virtual=2)
+            assert abs(float(inter) - ref) < 1e-4, (float(inter), ref)
+            assert abs(float(inter) - float(plain)) < 1e-5
+            inter.backward()
+            g_i = sm.qkv_w.grad.numpy().copy()
+        for p in sm.parameters():
+            p.clear_grad()
+        l = sm.loss(ids, ids)
+        l.backward()
+        np.testing.assert_allclose(g_i, sm.qkv_w.grad.numpy(),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_fleet_interleave_flag_routes_and_trains(self):
+        from paddle_tpu.models import GPTStackedForCausalLM
+        from paddle_tpu.distributed.pipeline import CompiledPipelineParallel
+        import paddle_tpu.optimizer as opt
+        st = DistributedStrategy()
+        st.pipeline = True
+        st.pipeline_configs = {"accumulate_steps": 2, "interleave": 2}
+        st.hybrid_configs = {"dp_degree": 2, "pp_degree": 2, "mp_degree": 2}
+        fleet.init(strategy=st)
+        paddle.seed(8)
+        sm = GPTStackedForCausalLM(self._cfg())
+        pp = fleet.distributed_model(sm)
+        assert isinstance(pp, CompiledPipelineParallel)
+        assert pp.num_virtual == 2
+        o = opt.AdamW(learning_rate=1e-3, parameters=sm.parameters())
+        ids = paddle.to_tensor(
+            np.random.RandomState(3).randint(0, 64, (4, 8)).astype("int32"))
+        l0 = float(pp.train_batch((ids, ids), o))
+        losses = [float(pp.train_batch((ids, ids), o)) for _ in range(4)]
+        assert np.isfinite(l0) and all(np.isfinite(x) for x in losses)
+        assert losses[-1] < l0, (l0, losses)
+
+    def test_interleaved_bubble_accounting(self):
+        """The schedule's cost model: T ticks of ONE chunk each. For V>1
+        the total chunk-time cost M·V+S-1 (M=k·S) is strictly below the
+        plain schedule's V·(M+S-1) — the interleave bubble reduction; at
+        V=1 the two coincide."""
+        from paddle_tpu.distributed.pipeline import interleaved_ticks
+        for S in (2, 4):
+            for M in (S, 2 * S, 4 * S):
+                assert interleaved_ticks(M, S, 1) == M + S - 1
+                for V in (2, 4):
+                    ticks = interleaved_ticks(M, S, V)
+                    assert ticks == M * V + S - 1
+                    assert ticks < V * (M + S - 1)
+                    # bubble fraction shrinks ~1/V (fill cost S-1 chunks
+                    # instead of V*(S-1))
+                    bubble_i = (ticks - M * V) / ticks
+                    bubble_p = (S - 1) / (M + S - 1)
+                    assert bubble_i < bubble_p
