@@ -263,6 +263,147 @@ class TrainStep:
 
         return pure_step
 
+    # ------------------------------------------------------------------
+    def loss_and_grad_norm(self, *batch, key=None):
+        """(loss, global grad norm) WITHOUT updating — the distributed-vs-
+        single-device parity probe (reference strategy: test_dist_base.py:899
+        compares distributed loss against a single-process replay). Pass the
+        same `key` to both runs for identical dropout/rng."""
+        params = self._params
+        loss_fn = self.loss_fn
+        arrays = _tree_unwrap(batch)
+        flat, treedef = jax.tree.flatten(arrays)
+        key_sig = ("lgn", treedef,
+                   tuple((tuple(a.shape), str(a.dtype)) for a in flat))
+        cached = self._compiled.get(key_sig)
+        if cached is not None:
+            if self.mesh is not None:
+                flat = [self._to_global(a, P(*self.data_axes))
+                        if a.ndim > 0 else a for a in flat]
+            loss, gn = cached(tuple(p._data for p in params),
+                              key if key is not None else jax.random.PRNGKey(0),
+                              *flat)
+            return float(loss), float(gn)
+
+        def f(param_arrays, k, *flat_batch):
+            b = jax.tree.unflatten(treedef, flat_batch)
+
+            def loss_of(pa):
+                with _trace_guard(), _swap_params(params, list(pa)), \
+                        _random.trace_key_scope(k), autograd.no_grad():
+                    out = loss_fn(*_tree_wrap(b))
+                arr = out._data if isinstance(out, Tensor) else out
+                return arr.astype(jnp.float32)
+
+            loss, grads = jax.value_and_grad(loss_of)(list(param_arrays))
+            gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                              for g in grads))
+            return loss, gn
+
+        kwargs = {}
+        if self.mesh is not None:
+            pspecs = tuple(_spec_or_replicated(p) for p in params)
+            flat_specs = [P(*self.data_axes) if a.ndim > 0 else P()
+                          for a in flat]
+            kwargs = dict(in_shardings=(
+                tuple(self._placement(s) for s in pspecs), None,
+                *[self._placement(s) for s in flat_specs]))
+            if self._opt_state is None:
+                self._opt_state = self._init_opt_state()
+            self._apply_param_shardings()
+            flat = [self._to_global(a, P(*self.data_axes))
+                    if a.ndim > 0 else a for a in flat]
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        compiled = jax.jit(f, **kwargs)
+        self._compiled[key_sig] = compiled
+        loss, gn = compiled(tuple(p._data for p in params), key, *flat)
+        return float(loss), float(gn)
+
+    def _abstract_opt_state(self):
+        """Optimizer-state tree as ShapeDtypeStructs — no arrays allocated
+        (jax.eval_shape over init_state). Lets memory planning for very
+        large models run without materializing moments."""
+        out = []
+        for p, n in zip(self._params, self._param_names):
+            sds = jax.ShapeDtypeStruct(p._data.shape, p._data.dtype)
+
+            def init(a, _p=p, _n=n):
+                try:
+                    return self.optimizer.init_state(a, param_obj=_p, name=_n)
+                except TypeError:
+                    return self.optimizer.init_state(a)
+
+            out.append(jax.eval_shape(init, sds))
+        return out
+
+    def memory_plan(self, axes: Optional[Dict[str, int]] = None) -> Dict:
+        """Analytic per-device HBM accounting from shapes + PartitionSpecs
+        (the "jax.eval_shape math" plan; reference capability anchor:
+        group_sharded_stage3.py:60 gather-on-use memory arithmetic).
+
+        axes: mesh axis sizes to divide by — defaults to self.mesh's. Pass a
+        hypothetical dict (e.g. a v4-64 factorization) to extrapolate the
+        plan to meshes this host cannot build. Returns bytes/device for
+        params, grads (same layout as params), and optimizer state.
+        """
+        if axes is None:
+            axes = dict(self.mesh.shape) if self.mesh is not None else {}
+
+        def div_of(spec, shape):
+            d = 1
+            for e, s in zip(tuple(spec or ()), shape):
+                names = (e,) if isinstance(e, str) else tuple(e or ())
+                for nm in names:
+                    d *= axes.get(nm, 1)
+            return d
+
+        state = self._opt_state or self._abstract_opt_state()
+        plan = {"params": 0, "grads": 0, "opt_state": 0}
+        for p, st in zip(self._params, state):
+            spec = _spec_or_replicated(p)
+            nbytes = int(np.prod(p._data.shape)) * p._data.dtype.itemsize
+            per_dev = nbytes // div_of(spec, p._data.shape)
+            plan["params"] += per_dev
+            plan["grads"] += per_dev
+            sspec = _opt_state_spec(p, self.optimizer)
+            for k, arr in (st or {}).items():
+                s = self.optimizer.state_spec(p, k, arr, sspec)
+                plan["opt_state"] += (int(np.prod(arr.shape))
+                                      * jnp.dtype(arr.dtype).itemsize
+                                      ) // div_of(s, arr.shape)
+        plan["total"] = sum(plan.values())
+        plan["axes"] = dict(axes)
+        return plan
+
+    def aot_memory_analysis(self, *batch):
+        """Compile the full step ahead-of-time with ABSTRACT inputs (params,
+        optimizer state, and batch as ShapeDtypeStructs — nothing is
+        materialized or executed) and return XLA's buffer-assignment memory
+        analysis: the compiler-accounted per-device argument/output/temp
+        bytes, i.e. the true activation+workspace footprint of the chosen
+        remat/pipeline schedule. `batch` leaves may be jax.ShapeDtypeStruct
+        or arrays."""
+        abstract_state = self._abstract_opt_state()
+        saved = self._opt_state
+        self._opt_state = abstract_state
+        try:
+            flat, treedef = jax.tree.flatten(tuple(
+                b if isinstance(b, jax.ShapeDtypeStruct)
+                else (b._data if isinstance(b, Tensor) else jnp.asarray(b))
+                for b in batch))
+            built = self._build(treedef, [len(a.shape) for a in flat])
+            p_sds = tuple(jax.ShapeDtypeStruct(p._data.shape, p._data.dtype)
+                          for p in self._params)
+            s_sds = tuple(abstract_state)
+            key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+            lowered = built.lower(
+                p_sds, s_sds, jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.float32), key, *flat)
+            return lowered.compile().memory_analysis()
+        finally:
+            self._opt_state = saved
+
     def run_steps(self, n_steps: int, *stacked_batch):
         """Run `n_steps` steps from batches stacked on dim 0 ([n, ...] per
         leaf), one compiled launch. Returns the per-step losses Tensor."""

@@ -29,6 +29,38 @@ def _fans(shape):
     return shape[1] * receptive, shape[0] * receptive
 
 
+_fast_init_depth = 0
+
+
+def fast_init():
+    """Context manager: random initializers return zeros (structural init).
+
+    For memory planning / AOT compilation of very large models, where
+    drawing billions of random values on a single host would dominate setup
+    time and the VALUES are irrelevant (only shapes/shardings matter) —
+    used by __graft_entry__'s 6.7B memory plan. Constant/Assign/Dirac
+    initializers are unaffected.
+    """
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        global _fast_init_depth
+        _fast_init_depth += 1
+        try:
+            yield
+        finally:
+            _fast_init_depth -= 1
+
+    return cm()
+
+
+def _fast_zeros(shape, dtype):
+    if _fast_init_depth:
+        return jnp.zeros(tuple(shape), convert_dtype(dtype))
+    return None
+
+
 class Initializer:
     def __call__(self, shape, dtype="float32"):
         raise NotImplementedError
@@ -47,6 +79,9 @@ class Normal(Initializer):
         self.mean, self.std = mean, std
 
     def __call__(self, shape, dtype="float32"):
+        z = _fast_zeros(shape, dtype)
+        if z is not None:
+            return z
         dt = convert_dtype(dtype)
         return self.mean + self.std * jax.random.normal(_random.split_key(), tuple(shape), dtype=dt)
 
@@ -56,6 +91,9 @@ class TruncatedNormal(Initializer):
         self.mean, self.std = mean, std
 
     def __call__(self, shape, dtype="float32"):
+        z = _fast_zeros(shape, dtype)
+        if z is not None:
+            return z
         dt = convert_dtype(dtype)
         x = jax.random.truncated_normal(_random.split_key(), -2.0, 2.0, tuple(shape), dtype=dt)
         return self.mean + self.std * x
@@ -66,6 +104,9 @@ class Uniform(Initializer):
         self.low, self.high = low, high
 
     def __call__(self, shape, dtype="float32"):
+        z = _fast_zeros(shape, dtype)
+        if z is not None:
+            return z
         dt = convert_dtype(dtype)
         return jax.random.uniform(_random.split_key(), tuple(shape), dtype=dt,
                                   minval=self.low, maxval=self.high)
@@ -76,6 +117,9 @@ class XavierUniform(Initializer):
         self.fan_in, self.fan_out = fan_in, fan_out
 
     def __call__(self, shape, dtype="float32"):
+        z = _fast_zeros(shape, dtype)
+        if z is not None:
+            return z
         fi, fo = _fans(shape)
         fi = self.fan_in if self.fan_in is not None else fi
         fo = self.fan_out if self.fan_out is not None else fo
@@ -88,6 +132,9 @@ class XavierNormal(Initializer):
         self.fan_in, self.fan_out = fan_in, fan_out
 
     def __call__(self, shape, dtype="float32"):
+        z = _fast_zeros(shape, dtype)
+        if z is not None:
+            return z
         fi, fo = _fans(shape)
         fi = self.fan_in if self.fan_in is not None else fi
         fo = self.fan_out if self.fan_out is not None else fo
@@ -101,6 +148,9 @@ class KaimingUniform(Initializer):
         self.negative_slope = negative_slope
 
     def __call__(self, shape, dtype="float32"):
+        z = _fast_zeros(shape, dtype)
+        if z is not None:
+            return z
         fi, _ = _fans(shape)
         fi = self.fan_in if self.fan_in is not None else fi
         gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
@@ -114,6 +164,9 @@ class KaimingNormal(Initializer):
         self.negative_slope = negative_slope
 
     def __call__(self, shape, dtype="float32"):
+        z = _fast_zeros(shape, dtype)
+        if z is not None:
+            return z
         fi, _ = _fans(shape)
         fi = self.fan_in if self.fan_in is not None else fi
         gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
@@ -135,6 +188,9 @@ class Orthogonal(Initializer):
         self.gain = gain
 
     def __call__(self, shape, dtype="float32"):
+        z = _fast_zeros(shape, dtype)
+        if z is not None:
+            return z
         dt = convert_dtype(dtype)
         rows, cols = shape[0], int(np.prod(shape[1:]))
         flat = jax.random.normal(_random.split_key(), (max(rows, cols), min(rows, cols)))
