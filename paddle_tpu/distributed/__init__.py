@@ -30,6 +30,7 @@ from .pipeline import (  # noqa: F401
     pipeline_spmd,
 )
 from .heter import MeshShardedEmbedding  # noqa: F401
+from .dgc import sparse_allreduce, dgc_value_and_grad  # noqa: F401
 from ..ops.ring_attention import (  # noqa: F401
     ring_attention, ulysses_attention, sequence_parallel_attention,
 )

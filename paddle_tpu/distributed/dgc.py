@@ -1,0 +1,120 @@
+"""DGC — top-k sparsified gradient exchange (real communication compression).
+
+Reference: the DGC operator + DGCMomentumOptimizer
+(paddle/fluid/operators/dgc_op.h, distributed/fleet/meta_optimizers/
+dgc_optimizer.py): each worker sends only the top-k gradient entries per
+step (k = (1-sparsity)·n), keeps the rest as error feedback, and the
+ring-allreduce is replaced by an allgather of (values, indices) —
+compressing wire bytes by ~n/(2·k·D).
+
+TPU-native design: the dense DP gradient all-reduce is implicit in the
+pjit'd step, so compressing it means stepping OUT of auto-sharding for the
+exchange: `sparse_allreduce` runs under shard_map over the dp axis — each
+dp shard computes a local top-k, the (values, indices) pairs ride the ICI
+via all_gather (2·k·D elements instead of n), and every shard
+scatter-accumulates the union into a dense tensor. `dgc_value_and_grad`
+packages the whole DGC step: per-shard grads (no implicit all-reduce) →
+top-k exchange → error feedback, returning the compressed global gradient
+plus the new per-shard residual, ready for any optimizer's update.
+
+The wire math (per step, per tensor of n elements over D workers):
+  dense all-reduce   ≈ 2·n       elements on the ring
+  DGC allgather      ≈ 2·k·D     (values+indices), k = (1-sparsity)·n
+  compression ratio  = n / (k·D) (e.g. 999x sparsity, D=8 → ~125x)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from . import mesh as _mesh
+
+
+def sparse_allreduce(x, axis: str = "dp", sparsity: float = 0.999,
+                     residual=None):
+    """Top-k sparsified sum over mesh `axis` with error feedback.
+
+    x:        per-shard dense tensor, REPLICATED shape (each dp shard holds
+              its own local value — e.g. a local gradient).
+    residual: per-shard error-feedback carry of the same shape (or None).
+
+    Returns (global_sum_of_topk, new_residual): the dense accumulation of
+    every shard's top-k entries, and what this shard kept back. Must be
+    called under shard_map manual over `axis` — `dgc_value_and_grad` does
+    that for you; call this directly only inside your own shard_map.
+    """
+    flat = x.reshape(-1).astype(jnp.float32)
+    if residual is not None:
+        flat = flat + residual.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    k = max(1, int(n * (1.0 - sparsity)))
+    vals, idx = lax.top_k(jnp.abs(flat), k)
+    sent = flat[idx]                                  # signed top-k values
+    kept = flat.at[idx].set(0.0)                      # error feedback
+    # exchange: allgather the (values, indices) pairs over the dp axis —
+    # the 2·k·D-element wire cost that replaces the n-element all-reduce
+    all_vals = lax.all_gather(sent, axis)             # [D, k]
+    all_idx = lax.all_gather(idx, axis)               # [D, k]
+    dense = jnp.zeros((n,), jnp.float32)
+    dense = dense.at[all_idx.reshape(-1)].add(all_vals.reshape(-1))
+    return dense.reshape(x.shape).astype(x.dtype), kept.reshape(x.shape)
+
+
+def dgc_value_and_grad(loss_fn, params, batch, axis: str = "dp",
+                       sparsity: float = 0.999, residuals=None,
+                       mesh=None):
+    """(loss, compressed grads, new residuals) for a pure-DP step.
+
+    loss_fn(params, local_batch) -> scalar loss for ONE dp shard's
+    microbatch (no internal psum — the DGC exchange IS the reduction).
+    params are replicated; batch leaves are sharded P(axis) on dim 0;
+    residuals leaves are PER-SHARD state, stored [D, *param_shape] and
+    sharded P(axis) (pass None to start at zero).
+
+    The mean over shards is folded in (sent values are pre-divided by D),
+    so the result drops into any optimizer exactly where the dense
+    all-reduced gradient would.
+    """
+    mesh = mesh or _mesh.get_mesh()
+    if mesh is None:
+        raise ValueError("dgc_value_and_grad needs a mesh (argument or "
+                         "distributed.set_mesh/mesh_scope)")
+    D = int(mesh.shape[axis])
+    if residuals is None:
+        residuals = [jnp.zeros((D,) + tuple(p.shape), jnp.float32)
+                     for p in params]
+
+    flat, treedef = jax.tree.flatten((list(params), list(residuals), batch))
+    key = (loss_fn, mesh, axis, round(sparsity, 12), treedef,
+           tuple((tuple(a.shape), str(jnp.asarray(a).dtype)) for a in flat))
+    compiled = _JIT_CACHE.get(key)
+    if compiled is None:
+        def per_shard(params_, residuals_, batch_):
+            loss, grads = jax.value_and_grad(loss_fn)(params_, batch_)
+            outs, news = [], []
+            for g, r in zip(grads, residuals_):
+                # r arrives as this shard's [1, *shape] slice of the
+                # [D, ...] per-shard state
+                s, nr = sparse_allreduce(g / D, axis, sparsity,
+                                         residual=r[0])
+                outs.append(s)
+                news.append(nr[None])
+            return lax.pmean(loss, axis), outs, news
+
+        from jax import shard_map
+        bspec = jax.tree.map(lambda _: P(axis), batch)
+        rspec = [P(axis)] * len(residuals)
+        compiled = jax.jit(shard_map(
+            per_shard, mesh=mesh, axis_names={axis},
+            in_specs=(P(), rspec, bspec),
+            out_specs=(P(), [P()] * len(params), rspec),
+            check_vma=False))
+        _JIT_CACHE[key] = compiled
+    return compiled(list(params), list(residuals), batch)
+
+
+_JIT_CACHE: dict = {}

@@ -18,7 +18,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .controllers import CollectiveController, PSController
+from .controllers import CollectiveController, PSController, RpcController
 
 
 def parse_args(argv=None):
@@ -50,9 +50,10 @@ def parse_args(argv=None):
     p.add_argument("--devices_per_proc", type=int, default=0,
                    help="emulate N CPU devices per process (testing)")
     p.add_argument("--run_mode", default="collective",
-                   choices=["collective", "ps"],
-                   help="collective (SPMD over chips) or ps (parameter "
-                        "servers + trainers; reference ps controller)")
+                   choices=["collective", "ps", "rpc"],
+                   help="collective (SPMD over chips), ps (parameter "
+                        "servers + trainers), or rpc (paddle.distributed."
+                        "rpc process group; reference rpc controller)")
     p.add_argument("--server_num", type=int, default=1,
                    help="[ps mode] PS shard processes")
     p.add_argument("--trainer_num", type=int, default=1,
@@ -65,7 +66,8 @@ def parse_args(argv=None):
 
 def launch(argv=None) -> int:
     args = parse_args(argv)
-    ctl = PSController if args.run_mode == "ps" else CollectiveController
+    ctl = {"ps": PSController, "rpc": RpcController}.get(
+        args.run_mode, CollectiveController)
     return ctl(args).run()
 
 

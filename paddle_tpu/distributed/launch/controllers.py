@@ -237,6 +237,59 @@ def _hostname() -> str:
     return socket.gethostname()
 
 
+def _node_ip() -> str:
+    import socket
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+class RpcController(CollectiveController):
+    """RPC-mode job controller (reference: launch/controllers/rpc.py
+    RpcController — wires a process group for paddle.distributed.rpc
+    instead of collectives: every worker gets the rpc master endpoint
+    (peer 0), its own worker endpoint, and its global rank; the job is
+    done when all workers exit)."""
+
+    def build_pod(self):
+        args = self.args
+        nproc = args.nproc_per_node
+        world = self.nnodes * nproc
+        base_port = args.start_port
+        master_host = (args.master.rsplit(":", 1)[0]
+                       if args.master and self.nnodes > 1 else "127.0.0.1")
+        master_ep = f"{master_host}:{base_port}"
+        # endpoint hints: single-node jobs use loopback; multi-node workers
+        # advertise this node's address so peers can reach them (init_rpc
+        # registers its ACTUAL ip:port in the store either way — these fix
+        # the port so firewalled clusters can pre-open it)
+        my_host = _node_ip() if self.nnodes > 1 else "127.0.0.1"
+        endpoints = [f"{my_host}:{base_port + 1 + i}" for i in range(world)]
+
+        self.pod.clear()
+        for local_rank in range(nproc):
+            global_rank = self.node_rank * nproc + local_rank
+            env = {
+                "PADDLE_MASTER": master_ep,
+                "PADDLE_WORKER_ENDPOINT": endpoints[global_rank],
+                "PADDLE_TRAINER_ID": str(global_rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+            }
+            if args.devices_per_proc:
+                env["JAX_PLATFORMS"] = "cpu"
+            log = os.path.join(
+                args.log_dir,
+                f"workerlog.{global_rank}") if args.log_dir else None
+            cmd = [sys.executable, "-u", args.script] + list(args.script_args)
+            self.pod.add(Container(cmd, env, log))
+
+
 class PSController(CollectiveController):
     """Parameter-server job controller (reference: launch/controllers/ps.py
     — spawns PSERVER and TRAINER processes with the PaddleCloud role env).

@@ -53,14 +53,44 @@ def recompute(function, *args, use_reentrant: bool = True, preserve_rng_state: b
             return tuple(o._data if isinstance(o, Tensor) else o for o in out)
         return out._data if isinstance(out, Tensor) else out
 
-    # policy: None = save nothing (reference semantics — recompute the whole
-    # segment); "dots" = save MXU matmul outputs, recompute only the
-    # bandwidth-bound elementwise work (much cheaper backward, smaller
-    # memory win); or any jax.checkpoint_policies callable.
-    if policy == "dots":
-        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-    remat_fn = jax.checkpoint(raw, policy=policy)
+    remat_fn = jax.checkpoint(raw, policy=resolve_policy(policy))
     return apply_op("recompute", remat_fn, list(args) + params)
+
+
+def resolve_policy(policy):
+    """Named selective-remat policies (the reference's recompute_granularity
+    'full'/'full_attn'/'core_attn' knob, fleet recompute configs — here as
+    save-lists over checkpoint_name tags placed in models/gpt.py):
+
+    None        — save nothing: recompute the whole segment (reference
+                  default semantics; max memory win, ~2ND extra FLOPs).
+    "save_qkv"  — keep the QKV projection output [B,S,3H]; the flash
+                  backward reads saved q/k/v instead of recomputing
+                  ln1+qkv-proj (≈1/4 of the remat tax for ≈3BSH bytes).
+    "save_attn" — also keep the attention context [B,S,H] so the
+                  out-projection gradient skips the attention forward.
+    "save_big"  — additionally keep the MLP up-projection output [B,S,4H]:
+                  backward recomputes only LayerNorms/GELU (elementwise).
+    "dots"      — XLA's dots_with_no_batch_dims_saveable policy.
+    or any jax.checkpoint_policies callable.
+    """
+    named = {
+        "save_qkv": ("qkv_proj",),
+        "save_attn": ("qkv_proj", "attn_ctx"),
+        "save_big": ("qkv_proj", "attn_ctx", "mlp_up"),
+    }
+    if policy in named:
+        return jax.checkpoint_policies.save_only_these_names(*named[policy])
+    if policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return policy
+
+
+def checkpoint_tag(t, name: str):
+    """Tag a Tensor's value with jax.ad_checkpoint.checkpoint_name so the
+    named policies above can elect to save it; identity outside remat."""
+    from jax.ad_checkpoint import checkpoint_name
+    return apply_op("ckpt_" + name, lambda a: checkpoint_name(a, name), [t])
 
 
 def recompute_sequential(ctx, functions, *args, **kwargs):

@@ -70,7 +70,16 @@ def init_rpc(name: str, rank: int = None, world_size: int = None,
 
     socketserver.ThreadingTCPServer.allow_reuse_address = True
     socketserver.ThreadingTCPServer.daemon_threads = True
-    server = socketserver.ThreadingTCPServer(("0.0.0.0", 0), _RpcHandler)
+    # honor the launch rpc controller's per-worker endpoint when set
+    # (launch/controllers.py RpcController); else bind an ephemeral port —
+    # either way the REGISTERED store entry is the source of truth peers use
+    want = os.environ.get("PADDLE_WORKER_ENDPOINT", "")
+    want_port = int(want.rsplit(":", 1)[1]) if ":" in want else 0
+    try:
+        server = socketserver.ThreadingTCPServer(("0.0.0.0", want_port),
+                                                 _RpcHandler)
+    except OSError:
+        server = socketserver.ThreadingTCPServer(("0.0.0.0", 0), _RpcHandler)
     port = server.server_address[1]
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()

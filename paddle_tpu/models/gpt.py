@@ -148,6 +148,8 @@ class GPTSelfAttention(Layer):
 
 
 def _qkv_attention(qkv, nh, hd, sequence_parallel="ring"):
+    from jax.ad_checkpoint import checkpoint_name
+    qkv = checkpoint_name(qkv, "qkv_proj")   # save-list hook (recompute.py)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     q = _mesh.shard_constraint(q, "dp", "sp", "mp", None)
     k = _mesh.shard_constraint(k, "dp", "sp", "mp", None)
@@ -160,7 +162,8 @@ def _qkv_attention(qkv, nh, hd, sequence_parallel="ring"):
                                           schedule=sequence_parallel)
     else:
         out = functional_attention(q, k, v, is_causal=True)
-    return _mesh.shard_constraint(out, "dp", "sp", "mp", None)
+    out = _mesh.shard_constraint(out, "dp", "sp", "mp", None)
+    return checkpoint_name(out, "attn_ctx")
 
 
 def _attend(q, k, v, causal):
@@ -183,7 +186,9 @@ class GPTMLP(Layer):
         self.dropout = Dropout(config.hidden_dropout)
 
     def forward(self, x):
-        y = self.down(F.gelu(self.up(x), approximate=True))
+        from ..distributed.recompute import checkpoint_tag
+        u = checkpoint_tag(self.up(x), "mlp_up")
+        y = self.down(F.gelu(u, approximate=True))
         if self.training and self.dropout.p:
             y = self.dropout(y)
         return y

@@ -369,3 +369,45 @@ def _wait_file(path, timeout):
             return False
         time.sleep(0.2)
     return True
+
+
+# ----------------------------------------------------------- rpc controller
+RPC_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, "/root/repo")
+    import paddle_tpu.distributed.rpc as rpc
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    assert os.environ["PADDLE_MASTER"]
+    ep_port = int(os.environ["PADDLE_WORKER_ENDPOINT"].rsplit(":", 1)[1])
+    me = rpc.init_rpc(f"worker{rank}")
+    assert me.port == ep_port, (me.port, ep_port)  # endpoint contract honored
+    def add(a, b):
+        return a + b
+    if rank == 0:
+        got = rpc.rpc_sync("worker1", add, args=(20, 22))
+        assert got == 42, got
+        with open(os.path.join(sys.argv[1], "rpc_ok.txt"), "w") as f:
+            f.write(str(got))
+    else:
+        import time
+        time.sleep(2.0)   # stay up to serve rank 0's call
+    rpc.shutdown()
+""")
+
+
+def test_launch_rpc_mode(tmp_path):
+    """--run_mode rpc wires PADDLE_MASTER / PADDLE_WORKER_ENDPOINT /
+    TRAINER_ID so paddle.distributed.rpc workers rendezvous and call each
+    other (reference: launch/controllers/rpc.py RpcController)."""
+    script = tmp_path / "rpc_worker.py"
+    script.write_text(RPC_WORKER)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--run_mode", "rpc", "--nproc_per_node", "2",
+           "--start_port", "6390", str(script), str(tmp_path)]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=120, cwd="/root/repo")
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "rpc_ok.txt").read_text() == "42"
