@@ -95,7 +95,7 @@ PD_Predictor* PD_PredictorCreate(PD_Config* c) {
     Py_XDECREF(cfg_cls);
     Py_DECREF(mod);
   }
-  if (!out && PyErr_Occurred()) PyErr_Print();
+  if (!out && PyErr_Occurred()) PyErr_Print();  // PyErr_Print clears
   PyGILState_Release(gil);
   return out;
 }
@@ -159,6 +159,7 @@ char* PD_PredictorGetOutputName(PD_Predictor* p, size_t idx) {
 PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* p, const char* name) {
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject* h = PyObject_CallMethod(p->predictor, "get_input_handle", "s", name);
+  if (!h) PyErr_Print();  // diagnostic to stderr; also clears the error
   PyGILState_Release(gil);
   if (!h) return nullptr;
   return new PD_Tensor{h};
@@ -167,6 +168,7 @@ PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* p, const char* name) {
 PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* p, const char* name) {
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject* h = PyObject_CallMethod(p->predictor, "get_output_handle", "s", name);
+  if (!h) PyErr_Print();
   PyGILState_Release(gil);
   if (!h) return nullptr;
   return new PD_Tensor{h};

@@ -1,9 +1,14 @@
-/* C smoke test for the inference ABI (reference: capi_exp test programs).
+/* C tests for the inference ABI (reference: capi_exp test programs).
  *
- * Usage: test_capi <model_path_prefix>
- * Loads <prefix>.pdmodel/.pdmeta, feeds ones, runs, prints the first few
- * output values, exits 0 on success. Compiled and driven by
- * tests/test_inference_capi.py.
+ * Usage:
+ *   test_capi <model_prefix>           happy path: feed ones, print first
+ *   test_capi <model_prefix> errors    error paths: bad artifact path, bad
+ *                                      handle names, undersized output
+ *                                      buffer, NULL destroys — all must
+ *                                      fail SOFTLY (NULL/0), never crash
+ *   test_capi <model_prefix> multiio   two inputs / two outputs by name,
+ *                                      prints sum0=… sum1=…
+ * Compiled and driven by tests/test_inference_capi.py.
  */
 #include <stdio.h>
 #include <stdlib.h>
@@ -11,20 +16,21 @@
 
 #include "pt_inference_api.h"
 
-int main(int argc, char** argv) {
-  if (argc < 2) {
-    fprintf(stderr, "usage: %s <model_prefix>\n", argv[0]);
-    return 2;
-  }
+static PD_Predictor* make_pred(const char* prefix) {
   PD_Config* cfg = PD_ConfigCreate();
-  PD_ConfigSetModel(cfg, argv[1], "");
+  PD_ConfigSetModel(cfg, prefix, "");
   PD_Predictor* pred = PD_PredictorCreate(cfg);
+  PD_ConfigDestroy(cfg);
+  return pred;
+}
+
+static int run_happy(const char* prefix) {
+  PD_Predictor* pred = make_pred(prefix);
   if (!pred) {
     fprintf(stderr, "predictor create failed\n");
     return 1;
   }
-  size_t nin = PD_PredictorGetInputNum(pred);
-  if (nin < 1) {
+  if (PD_PredictorGetInputNum(pred) < 1) {
     fprintf(stderr, "no inputs\n");
     return 1;
   }
@@ -58,6 +64,133 @@ int main(int argc, char** argv) {
   PD_TensorDestroy(in);
   PD_TensorDestroy(out);
   PD_PredictorDestroy(pred);
-  PD_ConfigDestroy(cfg);
   return 0;
+}
+
+static int run_errors(const char* prefix) {
+  /* 1) missing artifact: create must return NULL, not crash */
+  PD_Predictor* bad = make_pred("/nonexistent/definitely_missing_model");
+  if (bad != NULL) {
+    fprintf(stderr, "ERR: create on missing artifact returned non-NULL\n");
+    return 1;
+  }
+  /* 2) NULL destroys are no-ops */
+  PD_PredictorDestroy(NULL);
+  PD_TensorDestroy(NULL);
+
+  /* 3) the ABI stays usable after a failed create (no poisoned
+     interpreter error state) */
+  PD_Predictor* pred = make_pred(prefix);
+  if (!pred) {
+    fprintf(stderr, "ERR: good artifact failed after bad create\n");
+    return 1;
+  }
+  /* 4) unknown tensor names return NULL */
+  if (PD_PredictorGetInputHandle(pred, "no_such_input") != NULL ||
+      PD_PredictorGetOutputHandle(pred, "no_such_output") != NULL) {
+    fprintf(stderr, "ERR: unknown handle name returned non-NULL\n");
+    return 1;
+  }
+  /* 5) out-of-range name index returns NULL */
+  if (PD_PredictorGetInputName(pred, 9999) != NULL) {
+    fprintf(stderr, "ERR: out-of-range input name returned non-NULL\n");
+    return 1;
+  }
+  /* 6) undersized output buffer: CopyToCpu must refuse (return 0) and
+     leave the buffer guard untouched */
+  char* in_name = PD_PredictorGetInputName(pred, 0);
+  PD_Tensor* in = PD_PredictorGetInputHandle(pred, in_name);
+  size_t numel = PD_TensorGetNumel(in);
+  float* buf = (float*)malloc(numel * sizeof(float));
+  for (size_t j = 0; j < numel; ++j) buf[j] = 1.0f;
+  PD_TensorCopyFromCpu(in, buf, 0);
+  PD_PredictorRun(pred);
+  char* out_name = PD_PredictorGetOutputName(pred, 0);
+  PD_Tensor* out = PD_PredictorGetOutputHandle(pred, out_name);
+  unsigned char tiny[2] = {0xAB, 0xCD};
+  if (PD_TensorCopyToCpu(out, tiny, 1) != 0) {
+    fprintf(stderr, "ERR: undersized copy_to reported success\n");
+    return 1;
+  }
+  if (tiny[1] != 0xCD) {
+    fprintf(stderr, "ERR: undersized copy_to wrote past the buffer\n");
+    return 1;
+  }
+  /* 7) the predictor still works after all the failed calls */
+  float* obuf = (float*)malloc(PD_TensorGetNumel(out) * sizeof(float));
+  if (!PD_TensorCopyToCpu(out, obuf,
+                          PD_TensorGetNumel(out) * sizeof(float))) {
+    fprintf(stderr, "ERR: valid copy_to failed after error-path calls\n");
+    return 1;
+  }
+  printf("errors_ok first=%.6f\n", (double)obuf[0]);
+  free(buf);
+  free(obuf);
+  free(in_name);
+  free(out_name);
+  PD_TensorDestroy(in);
+  PD_TensorDestroy(out);
+  PD_PredictorDestroy(pred);
+  return 0;
+}
+
+static int run_multiio(const char* prefix) {
+  PD_Predictor* pred = make_pred(prefix);
+  if (!pred) {
+    fprintf(stderr, "predictor create failed\n");
+    return 1;
+  }
+  size_t nin = PD_PredictorGetInputNum(pred);
+  size_t nout = PD_PredictorGetOutputNum(pred);
+  if (nin != 2 || nout != 2) {
+    fprintf(stderr, "expected 2x2 io, got %zux%zu\n", nin, nout);
+    return 1;
+  }
+  for (size_t i = 0; i < nin; ++i) {
+    char* name = PD_PredictorGetInputName(pred, i);
+    PD_Tensor* t = PD_PredictorGetInputHandle(pred, name);
+    size_t numel = PD_TensorGetNumel(t);
+    float* buf = (float*)malloc(numel * sizeof(float));
+    for (size_t j = 0; j < numel; ++j) buf[j] = (float)(i + 1);
+    if (!PD_TensorCopyFromCpu(t, buf, 0)) {
+      fprintf(stderr, "copy_from input %zu failed\n", i);
+      return 1;
+    }
+    free(buf);
+    free(name);
+    PD_TensorDestroy(t);
+  }
+  if (!PD_PredictorRun(pred)) {
+    fprintf(stderr, "run failed\n");
+    return 1;
+  }
+  double sums[2] = {0, 0};
+  for (size_t i = 0; i < nout; ++i) {
+    char* name = PD_PredictorGetOutputName(pred, i);
+    PD_Tensor* t = PD_PredictorGetOutputHandle(pred, name);
+    size_t numel = PD_TensorGetNumel(t);
+    float* buf = (float*)malloc(numel * sizeof(float));
+    if (!PD_TensorCopyToCpu(t, buf, numel * sizeof(float))) {
+      fprintf(stderr, "copy_to output %zu failed\n", i);
+      return 1;
+    }
+    for (size_t j = 0; j < numel; ++j) sums[i] += (double)buf[j];
+    free(buf);
+    free(name);
+    PD_TensorDestroy(t);
+  }
+  printf("sum0=%.6f sum1=%.6f\n", sums[0], sums[1]);
+  PD_PredictorDestroy(pred);
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <model_prefix> [errors|multiio]\n", argv[0]);
+    return 2;
+  }
+  if (argc >= 3 && strcmp(argv[2], "errors") == 0) return run_errors(argv[1]);
+  if (argc >= 3 && strcmp(argv[2], "multiio") == 0)
+    return run_multiio(argv[1]);
+  return run_happy(argv[1]);
 }

@@ -11,9 +11,45 @@ from __future__ import annotations
 import contextlib
 
 from ..static import (  # noqa: F401
-    Executor, Program, program_guard, default_main_program,
+    Program, default_main_program,
     default_startup_program, global_scope, CompiledProgram,
 )
+from ..static import Executor as _StaticExecutor
+from ..static import program_guard as _static_program_guard
+from ..static.program import (enable_static as _enable_static,
+                              disable_static as _disable_static,
+                              in_static_mode as _in_static_mode)
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """fluid-1.x scripts never call paddle.enable_static() — static WAS the
+    default world (reference: fluid/framework.py program_guard). The shim
+    therefore turns recording on for the guard's duration and restores the
+    caller's mode after, so verbatim fluid scripts build programs while the
+    surrounding process stays eager."""
+    prev = _in_static_mode()
+    _enable_static()
+    try:
+        with _static_program_guard(main_program, startup_program):
+            yield
+    finally:
+        if not prev:
+            _disable_static()
+
+
+class Executor(_StaticExecutor):
+    """fluid.Executor — static-mode-owning run() (same rationale as
+    program_guard above: fluid-era call sites assume static is on)."""
+
+    def run(self, *args, **kwargs):
+        prev = _in_static_mode()
+        _enable_static()
+        try:
+            return super().run(*args, **kwargs)
+        finally:
+            if not prev:
+                _disable_static()
 from ..static.program import data  # noqa: F401
 from ..core.tensor import Tensor, Parameter  # noqa: F401
 from ..framework.io import save, load  # noqa: F401
@@ -88,25 +124,166 @@ class dygraph:
 
 
 class layers:
-    """fluid.layers shim: the old functional layer API over modern ops."""
+    """fluid.layers shim: the old functional layer API over modern ops.
+
+    Deep enough to run verbatim fluid-era training scripts (reference:
+    python/paddle/fluid/layers/nn.py surface — fc/data/embedding +
+    square_error_cost/cross_entropy/accuracy + activations), per
+    MIGRATION.md's fluid-user path.
+    """
     @staticmethod
-    def fc(input, size, num_flatten_dims=1, act=None, name=None, **kw):
+    def fc(input, size, num_flatten_dims=1, act=None, name=None,
+           param_attr=None, bias_attr=None, **kw):
         from ..static.nn import fc as _fc
-        return _fc(input, size, num_flatten_dims, activation=act)
+        return _fc(input, size, num_flatten_dims, weight_attr=param_attr,
+                   activation=act, bias_attr=bias_attr)
 
     @staticmethod
-    def data(name, shape, dtype="float32", **kw):
+    def data(name, shape, dtype="float32", append_batch_size=True, **kw):
+        """fluid.layers.data PREPENDS the batch dim (fluid/layers/io.py:
+        append_batch_size=True) — unlike the newer fluid.data/static.data
+        which take the full shape."""
+        shape = list(shape)
+        if append_batch_size and (not shape or shape[0] != -1):
+            shape = [-1] + shape
         return data(name, shape, dtype)
+
+    @staticmethod
+    def embedding(input, size, is_sparse=False, padding_idx=None,
+                  param_attr=None, dtype="float32", **kw):
+        from ..static.nn import embedding as _emb
+        return _emb(input, size, is_sparse=is_sparse,
+                    padding_idx=padding_idx, weight_attr=param_attr)
+
+    @staticmethod
+    def square_error_cost(input, label):
+        """reference: fluid/layers/loss.py square_error_cost — elementwise
+        (input - label)^2, NO mean."""
+        d = input - label
+        return d * d
+
+    @staticmethod
+    def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+        """FLUID semantics (fluid/layers/loss.py cross_entropy): `input` is
+        a PROBABILITY distribution (post-softmax, e.g. fc(act='softmax')),
+        not logits; returns per-example -log p [N, 1], with 0 at
+        ignore_index positions (the fluid padding-label contract)."""
+        eps = 1e-12
+        p = _ops.clip(input, min=eps, max=1.0)
+        if soft_label:
+            return -_ops.sum(label * _ops.log(p), axis=-1, keepdim=True)
+        lab = label
+        if len(lab.shape) == len(input.shape) - 1:
+            lab = _ops.unsqueeze(lab, -1)
+        lab = _ops.cast(lab, "int64")
+        ignored = _ops.equal(lab, _ops.full_like(lab, ignore_index))
+        safe = _ops.where(ignored, _ops.zeros_like(lab), lab)
+        picked = _ops.take_along_axis(p, safe, axis=-1)
+        loss = -_ops.log(picked)
+        return _ops.where(ignored, _ops.zeros_like(loss), loss)
+
+    @staticmethod
+    def accuracy(input, label, k=1, **kw):
+        from ..static import accuracy as _acc
+        return _acc(input, label, k=k)
 
     relu = staticmethod(_ops.relu) if hasattr(_ops, "relu") else None
     softmax = staticmethod(lambda x, axis=-1, name=None: _nn.functional.softmax(x, axis))
-    cross_entropy = staticmethod(
-        lambda input, label, **kw: _nn.functional.cross_entropy(input, label))
+    sigmoid = staticmethod(lambda x, name=None: _ops.sigmoid(x))
+    tanh = staticmethod(lambda x, name=None: _ops.tanh(x))
     mean = staticmethod(_ops.mean)
     concat = staticmethod(_ops.concat)
     reshape = staticmethod(lambda x, shape, **kw: _ops.reshape(x, shape))
     reduce_sum = staticmethod(lambda x, dim=None, keep_dim=False, name=None:
                               _ops.sum(x, axis=dim, keepdim=keep_dim))
+    reduce_mean = staticmethod(lambda x, dim=None, keep_dim=False, name=None:
+                               _ops.mean(x, axis=dim, keepdim=keep_dim))
+
+
+class optimizer:
+    """fluid.optimizer namespace (reference: fluid/optimizer.py) — the
+    fluid-era constructors (parameter_list/regularization kwargs) over the
+    modern optimizers; .minimize(loss) works in program context."""
+
+    @staticmethod
+    def _translate(kw):
+        out = dict(kw)
+        if "parameter_list" in out:
+            out["parameters"] = out.pop("parameter_list")
+        reg = out.pop("regularization", None)
+        if reg is not None:
+            if isinstance(reg, regularizer.L1Decay):
+                # the modern optimizers apply weight_decay as an L2
+                # penalty; silently retargeting L1 to L2 would train to
+                # different weights with no diagnostic
+                raise NotImplementedError(
+                    "fluid.regularizer.L1Decay is not supported by the "
+                    "compat shim (weight_decay is L2 here); use L2Decay or "
+                    "add an explicit L1 penalty term to the loss")
+            out["weight_decay"] = getattr(reg, "coeff", reg)
+        out.pop("name", None)
+        return out
+
+    @staticmethod
+    def SGD(learning_rate=0.001, **kw):  # noqa: N802
+        from .. import optimizer as _opt
+        return _opt.SGD(learning_rate=learning_rate,
+                        **optimizer._translate(kw))
+
+    SGDOptimizer = SGD
+
+    @staticmethod
+    def Momentum(learning_rate=0.001, momentum=0.9, **kw):  # noqa: N802
+        from .. import optimizer as _opt
+        return _opt.Momentum(learning_rate=learning_rate, momentum=momentum,
+                             **optimizer._translate(kw))
+
+    MomentumOptimizer = Momentum
+
+    @staticmethod
+    def Adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+             **kw):  # noqa: N802
+        from .. import optimizer as _opt
+        return _opt.Adam(learning_rate=learning_rate, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon,
+                         **optimizer._translate(kw))
+
+    AdamOptimizer = Adam
+
+    @staticmethod
+    def Adagrad(learning_rate=0.001, epsilon=1e-6, **kw):  # noqa: N802
+        from .. import optimizer as _opt
+        return _opt.Adagrad(learning_rate=learning_rate, epsilon=epsilon,
+                            **optimizer._translate(kw))
+
+    AdagradOptimizer = Adagrad
+
+
+class initializer:
+    """fluid.initializer namespace (reference: fluid/initializer.py)."""
+    from ..nn.initializer import (  # noqa: F401
+        Constant, Normal, TruncatedNormal, Uniform, XavierUniform,
+        XavierNormal, KaimingNormal, KaimingUniform)
+    ConstantInitializer = Constant
+    NormalInitializer = Normal
+    UniformInitializer = Uniform
+    XavierInitializer = XavierUniform
+    MSRAInitializer = KaimingNormal
+
+
+class regularizer:
+    """fluid.regularizer namespace (reference: fluid/regularizer.py)."""
+
+    class L2Decay:
+        def __init__(self, regularization_coeff=0.0):
+            self.coeff = regularization_coeff
+
+    class L1Decay:
+        def __init__(self, regularization_coeff=0.0):
+            self.coeff = regularization_coeff
+
+    L2DecayRegularizer = L2Decay
+    L1DecayRegularizer = L1Decay
 
 
 core = type("core", (), {

@@ -31,7 +31,7 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     if num_flatten_dims != len(in_shape) - 1 or in_features != in_shape[-1]:
         from ..core import ops as _ops
         x = _ops.reshape(x, in_shape[:num_flatten_dims] + [in_features])
-    layer = dyn_nn.Linear(in_features, size,
+    layer = dyn_nn.Linear(in_features, size, weight_attr=weight_attr,
                           bias_attr=bias_attr if bias_attr is not None else None)
     out = layer(x)
     if activation:
@@ -42,7 +42,8 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
 def embedding(input, size, is_sparse=False, padding_idx=None, weight_attr=None,
               name=None):
     """reference: paddle.static.nn.embedding."""
-    layer = dyn_nn.Embedding(size[0], size[1], padding_idx=padding_idx)
+    layer = dyn_nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                             weight_attr=weight_attr)
     return layer(input)
 
 

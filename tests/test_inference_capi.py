@@ -36,26 +36,124 @@ def exported_model(tmp_path_factory):
     return path, out
 
 
-def test_c_program_runs_exported_model(exported_model, tmp_path):
+def test_c_program_runs_exported_model(capi_exe, exported_model):
+    path, want = exported_model
+    r = subprocess.run([capi_exe, path], capture_output=True, text=True,
+                       timeout=300, env=_c_env(), cwd=REPO)
+    assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+    first = float(r.stdout.split("first=")[1])
+    np.testing.assert_allclose(first, float(want.reshape(-1)[0]), rtol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def capi_exe(tmp_path_factory):
     lib = build_infer_capi()
     if lib is None:
         pytest.skip("no native toolchain / libpython")
-    path, want = exported_model
-    exe = str(tmp_path / "test_capi")
+    exe = str(tmp_path_factory.mktemp("capi_bin") / "test_capi")
     src = os.path.join(REPO, "native", "tests", "test_capi.c")
     inc = os.path.join(REPO, "native", "include")
-    r = subprocess.run(
-        ["gcc", "-O2", src, f"-I{inc}", lib, "-o", exe],
-        capture_output=True, text=True)
+    r = subprocess.run(["gcc", "-O2", src, f"-I{inc}", lib, "-o", exe],
+                       capture_output=True, text=True)
     assert r.returncode == 0, r.stderr
+    return exe
+
+
+def _c_env():
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     for k in list(env):
         if k.startswith(("PALLAS_AXON", "AXON_")):
-            env.pop(k)   # embedded interpreter must not claim the real chip
-    r = subprocess.run([exe, path], capture_output=True, text=True,
-                       timeout=300, env=env, cwd=REPO)
+            env.pop(k)
+    return env
+
+
+def test_c_error_paths(capi_exe, exported_model):
+    """VERDICT r2 #10: missing artifact, unknown handle names, undersized
+    output buffer, NULL destroys — every failure must be soft (NULL/0
+    return), leave the interpreter unpoisoned, and the predictor must still
+    work afterwards."""
+    path, want = exported_model
+    r = subprocess.run([capi_exe, path, "errors"], capture_output=True,
+                       text=True, timeout=300, env=_c_env(), cwd=REPO)
+    assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+    first = float(r.stdout.split("first=")[1])
+    np.testing.assert_allclose(first, float(want.reshape(-1)[0]), rtol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def exported_multiio(tmp_path_factory):
+    d = tmp_path_factory.mktemp("capi_mio")
+    paddle.seed(1)
+
+    class TwoIO(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(4, 3)
+            self.l2 = nn.Linear(5, 2)
+
+        def forward(self, a, b):
+            return self.l1(a), self.l2(b)
+
+    m = TwoIO()
+    path = str(d / "mio")
+    paddle.jit.save(m, path, input_spec=[
+        paddle.jit.InputSpec([2, 4], "float32", name="a"),
+        paddle.jit.InputSpec([2, 5], "float32", name="b")])
+    from paddle_tpu import inference
+    pred = inference.create_predictor(inference.Config(path, ""))
+    names = pred.get_input_names()
+    pred.get_input_handle(names[0]).copy_from_cpu(
+        np.full((2, 4), 1.0, np.float32))
+    pred.get_input_handle(names[1]).copy_from_cpu(
+        np.full((2, 5), 2.0, np.float32))
+    pred.run()
+    sums = [float(pred.get_output_handle(n).copy_to_cpu().sum())
+            for n in pred.get_output_names()]
+    return path, sums
+
+
+def test_c_multi_input_output(capi_exe, exported_multiio):
+    """Two named inputs, two outputs through the C surface; sums match the
+    python predictor (reference: capi_exp multi-io contract)."""
+    path, want = exported_multiio
+    r = subprocess.run([capi_exe, path, "multiio"], capture_output=True,
+                       text=True, timeout=300, env=_c_env(), cwd=REPO)
+    assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+    got0 = float(r.stdout.split("sum0=")[1].split()[0])
+    got1 = float(r.stdout.split("sum1=")[1].split()[0])
+    np.testing.assert_allclose([got0, got1], want, rtol=1e-4)
+
+
+def test_c_runs_int8_payload_artifact(capi_exe, tmp_path):
+    """Weight-only-int8 export (quantization.save_quantized): the C ABI
+    serves the artifact, and the int8 payload rides alongside (codes
+    verified int8 on disk)."""
+    import paddle_tpu.quantization as Q
+    paddle.seed(2)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    ptq = Q.PTQ()
+    m = ptq.quantize(m)
+    rng = np.random.RandomState(3)
+    for _ in range(4):   # calibration passes
+        m(paddle.to_tensor(rng.randn(4, 8).astype("float32")))
+    path = str(tmp_path / "qm")
+    Q.save_quantized(m, path, input_spec=[
+        paddle.jit.InputSpec([2, 8], "float32")])
+    payload = np.load(path + ".pdquant.npz")
+    code_keys = [k for k in payload.files if k.endswith("/codes")]
+    assert code_keys and all(payload[k].dtype == np.int8 for k in code_keys)
+
+    from paddle_tpu import inference
+    pred = inference.create_predictor(inference.Config(path, ""))
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(np.ones((2, 8), np.float32))
+    pred.run()
+    want = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+
+    r = subprocess.run([capi_exe, path], capture_output=True, text=True,
+                       timeout=300, env=_c_env(), cwd=REPO)
     assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
     first = float(r.stdout.split("first=")[1])
     np.testing.assert_allclose(first, float(want.reshape(-1)[0]), rtol=1e-5)
