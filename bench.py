@@ -185,12 +185,17 @@ def main():
         else None)
     # fused LM-head CE: no [B,S,vocab] logits in HBM (models/gpt.py loss())
     ce_chunk = int(os.environ.get("PADDLE_TPU_BENCH_CE_CHUNK", "256"))
+    # gradient accumulation: activation memory of B/accum at the update
+    # math of B (the knob that fits big models without more remat)
+    accum = int(os.environ.get("PADDLE_TPU_BENCH_ACCUM", "1"))
     if ce_chunk > 0:
         step = TrainStep(model, opt,
                          lambda ids, lbl: model.loss(ids, lbl,
-                                                     chunk_size=ce_chunk))
+                                                     chunk_size=ce_chunk),
+                         grad_accum_steps=accum)
     else:  # unfused reference path
-        step = TrainStep(model, opt, lambda ids, lbl: crit(model(ids), lbl))
+        step = TrainStep(model, opt, lambda ids, lbl: crit(model(ids), lbl),
+                         grad_accum_steps=accum)
 
     ids = paddle.to_tensor(
         np.random.randint(0, cfg.vocab_size, (B, S)).astype("int32"))

@@ -268,7 +268,8 @@ class initializer:
     NormalInitializer = Normal
     UniformInitializer = Uniform
     XavierInitializer = XavierUniform
-    MSRAInitializer = KaimingNormal
+    # fluid's MSRAInitializer defaults to uniform=True (fluid/initializer.py)
+    MSRAInitializer = KaimingUniform
 
 
 class regularizer:

@@ -32,7 +32,7 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
         from ..core import ops as _ops
         x = _ops.reshape(x, in_shape[:num_flatten_dims] + [in_features])
     layer = dyn_nn.Linear(in_features, size, weight_attr=weight_attr,
-                          bias_attr=bias_attr if bias_attr is not None else None)
+                          bias_attr=bias_attr)
     out = layer(x)
     if activation:
         out = getattr(dyn_nn.functional, activation)(out)

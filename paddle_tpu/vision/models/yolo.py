@@ -48,6 +48,13 @@ class YOLOConfig:
     # classification — 0/False reproduces the plain FCOS-style head
     reg_max: int = 0
     use_varifocal: bool = False
+    # "tal" = task-aligned assignment (PP-YOLOE's production assigner;
+    # reference ppdet TaskAlignedAssigner), "center" = FCOS-style
+    # center/size-range assignment (the simplified fallback)
+    assigner: str = "center"
+    tal_topk: int = 13
+    tal_alpha: float = 1.0
+    tal_beta: float = 6.0
 
 
 class ConvBNAct(Layer):
@@ -219,15 +226,190 @@ class YOLODetector(Layer):
         return results
 
 
+def tal_assign(align, inside, topk):
+    """Task-aligned assignment core (reference: PP-YOLOE's
+    TaskAlignedAssigner, ppdet task_aligned_assigner.py — the production
+    assigner the center-window scheme approximated).
+
+    align  [B, M, A]: alignment metric s^alpha * iou^beta per (gt, anchor)
+    inside [B, M, A]: anchor-center-inside-gt AND gt-valid mask
+    Returns (assigned_gt [B, A] int32, pos [B, A] bool): each positive
+    anchor's gt, where per gt the top-k anchors by metric are candidates
+    and an anchor claimed by several gts goes to the highest-metric one —
+    all static-shape (top_k + one-hot scatter, no dynamic gather).
+    """
+    B, M, A = align.shape
+    masked = jnp.where(inside, align, -jnp.inf)
+    k = min(topk, A)
+    top_v, top_i = jax.lax.top_k(masked, k)                 # [B, M, k]
+    # scatter: candidate[b,m,top_i] = top_v finite
+    onehot = jax.nn.one_hot(top_i, A, dtype=jnp.float32)    # [B, M, k, A]
+    cand = (onehot * jnp.isfinite(top_v)[..., None].astype(
+        jnp.float32)).sum(2) > 0                            # [B, M, A]
+    cand_align = jnp.where(cand, align, -jnp.inf)           # [B, M, A]
+    assigned_gt = jnp.argmax(cand_align, axis=1).astype(jnp.int32)  # [B, A]
+    pos = jnp.isfinite(jnp.max(cand_align, axis=1))         # [B, A]
+    return assigned_gt, pos
+
+
+def _yolo_loss_tal(outputs, gt_boxes, gt_labels, gt_mask, config):
+    """Task-aligned loss over ALL scales jointly (TAL is cross-scale by
+    design: every anchor competes for every gt on the combined metric)."""
+    C = config.num_classes
+    R = config.reg_max
+
+    flat_args = []
+    for cls_t, reg_t in outputs:
+        flat_args += [cls_t, reg_t]
+
+    def fn(*arrs):
+        *scale_arrs, boxes, labels, mask = arrs
+        cls_list, dist_list, bins_list, cx_list, cy_list, st_list = \
+            [], [], [], [], [], []
+        for i in range(len(config.strides)):
+            cls, reg = scale_arrs[2 * i], scale_arrs[2 * i + 1]
+            B, _, H, W = cls.shape
+            stride = config.strides[i]
+            ys, xs = jnp.meshgrid(jnp.arange(H), jnp.arange(W),
+                                  indexing="ij")
+            cx_list.append(((xs + 0.5) * stride).reshape(-1))
+            cy_list.append(((ys + 0.5) * stride).reshape(-1))
+            st_list.append(jnp.full((H * W,), float(stride)))
+            cls_list.append(jnp.moveaxis(cls, 1, -1).reshape(B, -1, C))
+            if R:
+                dist_list.append(jnp.moveaxis(
+                    _dfl_expectation(reg, R), 1, -1).reshape(B, -1, 4))
+                bins_list.append(                            # [B, HW, 4, R+1]
+                    reg.reshape(B, 4, R + 1, H * W).transpose(0, 3, 1, 2))
+            else:
+                dist_list.append(jnp.moveaxis(reg, 1, -1).reshape(B, -1, 4))
+        logits = jnp.concatenate(cls_list, axis=1)          # [B, A, C]
+        dist = jnp.concatenate(dist_list, axis=1)           # [B, A, 4]
+        cx = jnp.concatenate(cx_list)                       # [A]
+        cy = jnp.concatenate(cy_list)
+        st = jnp.concatenate(st_list)
+        bins = jnp.concatenate(bins_list, axis=1) if R else None  # [B,A,4,R+1]
+        B, A = logits.shape[0], logits.shape[1]
+        M = boxes.shape[1]
+
+        # predicted boxes (xyxy, image coords)
+        px1 = cx[None] - dist[..., 0] * st[None]
+        py1 = cy[None] - dist[..., 1] * st[None]
+        px2 = cx[None] + dist[..., 2] * st[None]
+        py2 = cy[None] + dist[..., 3] * st[None]
+
+        x1, y1, x2, y2 = (boxes[..., i] for i in range(4))  # [B, M]
+
+        def pair_iou():
+            iw = jnp.maximum(
+                jnp.minimum(px2[:, None], x2[..., None]) -
+                jnp.maximum(px1[:, None], x1[..., None]), 0)
+            ih = jnp.maximum(
+                jnp.minimum(py2[:, None], y2[..., None]) -
+                jnp.maximum(py1[:, None], y1[..., None]), 0)
+            inter = iw * ih                                  # [B, M, A]
+            pa = jnp.maximum((px2 - px1) * (py2 - py1), 0)[:, None]
+            ga = jnp.maximum((x2 - x1) * (y2 - y1), 0)[..., None]
+            return inter / jnp.maximum(pa + ga - inter, 1e-9)
+
+        iou = pair_iou()                                     # [B, M, A]
+        p = jax.nn.sigmoid(logits)                           # [B, A, C]
+        lab_idx = jnp.clip(labels, 0, C - 1).astype(jnp.int32)
+        s = jnp.take_along_axis(
+            p.transpose(0, 2, 1),                            # [B, C, A]
+            jnp.broadcast_to(lab_idx[..., None], (B, M, A)),
+            axis=1)                                          # [B, M, A]
+        align = jnp.power(jnp.maximum(s, 1e-9), config.tal_alpha) * \
+            jnp.power(iou, config.tal_beta)
+        inside = ((cx[None, None] >= x1[..., None]) &
+                  (cx[None, None] <= x2[..., None]) &
+                  (cy[None, None] >= y1[..., None]) &
+                  (cy[None, None] <= y2[..., None]) &
+                  (mask[..., None] > 0))
+        assigned, pos = tal_assign(align, inside, config.tal_topk)
+
+        def take_gt(v):                                      # [B,M] -> [B,A]
+            return jnp.take_along_axis(v, assigned, axis=1)
+
+        tx1, ty1, tx2, ty2 = take_gt(x1), take_gt(y1), take_gt(x2), take_gt(y2)
+        tlab = take_gt(labels.astype(jnp.int32))
+        # per-anchor metric of its assigned gt
+        t_anchor = jnp.take_along_axis(
+            align.transpose(0, 2, 1), assigned[..., None], axis=2)[..., 0]
+        iou_anchor = jnp.take_along_axis(
+            iou.transpose(0, 2, 1), assigned[..., None], axis=2)[..., 0]
+        # normalize: per gt, target peaks at its max IoU (PP-YOLOE's
+        # t_norm = t / max_t * max_iou)
+        neg_inf = -jnp.inf
+        t_gt_max = jnp.max(jnp.where(inside, align, neg_inf), axis=2)  # [B,M]
+        iou_gt_max = jnp.max(jnp.where(inside, iou, neg_inf), axis=2)
+        t_max_a = take_gt(jnp.where(jnp.isfinite(t_gt_max), t_gt_max, 1.0))
+        iou_max_a = take_gt(jnp.where(jnp.isfinite(iou_gt_max),
+                                      iou_gt_max, 0.0))
+        q = jnp.where(pos, t_anchor / jnp.maximum(t_max_a, 1e-9) *
+                      iou_max_a, 0.0)
+        q = jax.lax.stop_gradient(jnp.clip(q, 0.0, 1.0))
+
+        npos = jnp.maximum(jnp.sum(pos), 1.0)
+
+        # GIoU regression on positives
+        iw = jnp.maximum(jnp.minimum(px2, tx2) - jnp.maximum(px1, tx1), 0)
+        ih = jnp.maximum(jnp.minimum(py2, ty2) - jnp.maximum(py1, ty1), 0)
+        inter = iw * ih
+        pa = jnp.maximum((px2 - px1) * (py2 - py1), 0)
+        ta = jnp.maximum((tx2 - tx1) * (ty2 - ty1), 0)
+        union = pa + ta - inter
+        iou_a = inter / jnp.maximum(union, 1e-9)
+        ex1, ey1 = jnp.minimum(px1, tx1), jnp.minimum(py1, ty1)
+        ex2, ey2 = jnp.maximum(px2, tx2), jnp.maximum(py2, ty2)
+        enc = jnp.maximum((ex2 - ex1) * (ey2 - ey1), 1e-9)
+        giou = iou_a - (enc - union) / enc
+        reg_loss = jnp.sum((1.0 - giou) * pos * q) / jnp.maximum(
+            jnp.sum(pos * q), 1e-9)
+
+        # varifocal classification with the task-aligned quality target
+        onehot = jax.nn.one_hot(tlab, C, axis=-1)            # [B, A, C]
+        tgt = onehot * q[..., None]
+        alpha, gamma = 0.75, 2.0
+        w = jnp.where(tgt > 0, tgt, alpha * jnp.power(p, gamma))
+        bce = jnp.maximum(logits, 0) - logits * tgt + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        cls_loss = jnp.sum(w * bce) / npos
+
+        dfl_loss = 0.0
+        if R:
+            logp = jax.nn.log_softmax(bins, axis=-1)         # [B,A,4,R+1]
+            tdist = jnp.stack([
+                (cx[None] - tx1) / st[None], (cy[None] - ty1) / st[None],
+                (tx2 - cx[None]) / st[None], (ty2 - cy[None]) / st[None]],
+                axis=-1)                                     # [B, A, 4]
+            tdist = jnp.clip(tdist, 0.0, R - 1e-3)
+            lo_bin = jnp.floor(tdist).astype(jnp.int32)
+            hi_w = tdist - lo_bin
+            lp_lo = jnp.take_along_axis(logp, lo_bin[..., None],
+                                        axis=-1)[..., 0]
+            lp_hi = jnp.take_along_axis(logp, (lo_bin + 1)[..., None],
+                                        axis=-1)[..., 0]
+            per = -((1 - hi_w) * lp_lo + hi_w * lp_hi)       # [B, A, 4]
+            dfl_loss = jnp.sum(per.mean(-1) * pos) / npos * 0.25
+        return cls_loss + reg_loss + dfl_loss
+
+    return apply_op("yolo_loss_tal", fn,
+                    flat_args + [gt_boxes, gt_labels, gt_mask])
+
+
 def yolo_loss(outputs, gt_boxes, gt_labels, gt_mask, config: YOLOConfig):
-    """FCOS-style dense loss, fully static-shape.
+    """Dense detection loss, fully static-shape. config.assigner picks
+    "tal" (task-aligned, the PP-YOLOE production assigner — see
+    _yolo_loss_tal) or "center" (FCOS-style center/size-range windows).
 
     gt_boxes: [B, M, 4] xyxy (padded), gt_labels: [B, M] int,
-    gt_mask: [B, M] 1/0 valid. Assignment: a grid cell is positive for the
-    smallest valid gt box containing its center, at the scale whose stride
-    range covers the box size (center sampling as in FCOS/PP-YOLOE's
-    simplified static alternative to TAL).
+    gt_mask: [B, M] 1/0 valid. "center" assignment: a grid cell is
+    positive for the smallest valid gt box containing its center, at the
+    scale whose stride range covers the box size.
     """
+    if config.assigner == "tal":
+        return _yolo_loss_tal(outputs, gt_boxes, gt_labels, gt_mask, config)
     num_classes = config.num_classes
     size_ranges = []
     lo = 0.0
@@ -347,6 +529,7 @@ def yolo_lite(num_classes=80, **kw):
 def _ppyoloe(width, num_classes, **kw):
     kw.setdefault("reg_max", 16)
     kw.setdefault("use_varifocal", True)
+    kw.setdefault("assigner", "tal")
     return YOLODetector(YOLOConfig(num_classes=num_classes, width=width, **kw))
 
 

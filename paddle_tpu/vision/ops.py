@@ -1,8 +1,11 @@
-"""Vision ops: boxes, NMS, RoI ops, DeformConv stub (reference:
-python/paddle/vision/ops.py).
+"""Vision ops: boxes, NMS, RoI ops (align/pool/psroi), DeformConv (DCNv1/
+v2), SSD prior_box, RPN generate_proposals (reference:
+python/paddle/vision/ops.py; detection ops from
+paddle/fluid/operators/detection/).
 
-TPU-first: NMS is implemented as a fixed-iteration lax.while-free masked
-suppression (compile-friendly static shapes), not a dynamic loop.
+TPU-first: NMS/proposal generation are static-shape masked suppression
+(padded tensors + counts for ragged results), DeformConv's gather feeds
+one MXU einsum, prior boxes fold to constants at trace time.
 """
 from __future__ import annotations
 
@@ -13,7 +16,8 @@ from ..core.tensor import Tensor, apply_op
 from ..nn.layer import Layer
 
 __all__ = ["yolo_box", "box_coder", "nms", "roi_align", "roi_pool",
-           "distribute_fpn_proposals", "box_iou"]
+           "distribute_fpn_proposals", "box_iou", "psroi_pool",
+           "deform_conv2d", "prior_box", "generate_proposals"]
 
 
 def _data(x):
@@ -441,6 +445,179 @@ class DeformConv2D(Layer):
                              mask=mask, **self._cfg)
 
 
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """reference: vision/ops.py:424 prior_box (SSD anchor generator).
+
+    Returns (box, var), each [H, W, num_priors, 4]; boxes are normalized
+    (xmin, ymin, xmax, ymax). Per cell: one box per expanded aspect ratio
+    per min_size (ar 1 first; `flip` adds 1/ar), plus one sqrt(min*max)
+    box per max_size — appended after the ar boxes by default, or right
+    after the first min box when min_max_aspect_ratios_order=True (the
+    Caffe-SSD layout). Pure shape math: computed with numpy at trace time
+    (anchors are constants; XLA folds them), like the reference's CPU
+    kernel feeding a const."""
+    import numpy as np
+    xa, ia = _data(input), _data(image)
+    H, W = int(xa.shape[2]), int(xa.shape[3])
+    img_h, img_w = int(ia.shape[2]), int(ia.shape[3])
+    step_w = float(steps[0]) or img_w / W
+    step_h = float(steps[1]) or img_h / H
+    min_sizes = [float(m) for m in np.atleast_1d(min_sizes)]
+    max_sizes = [float(m) for m in np.atleast_1d(max_sizes)] \
+        if max_sizes is not None else []
+    if max_sizes:
+        assert len(max_sizes) == len(min_sizes)
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - e) < 1e-6 for e in ars):
+            continue
+        ars.append(float(ar))
+        if flip:
+            ars.append(1.0 / float(ar))
+
+    whs = []           # per-cell prior (w, h) list, in the reference order
+    for i, ms in enumerate(min_sizes):
+        per = [(ms * (ar ** 0.5), ms / (ar ** 0.5)) for ar in ars]
+        if max_sizes:
+            sq = (ms * max_sizes[i]) ** 0.5
+            if min_max_aspect_ratios_order:
+                per.insert(1, (sq, sq))
+            else:
+                per.append((sq, sq))
+        whs.extend(per)
+    whs = np.asarray(whs, np.float32)                       # [P, 2]
+
+    cx = (np.arange(W, dtype=np.float32) + offset) * step_w  # [W]
+    cy = (np.arange(H, dtype=np.float32) + offset) * step_h  # [H]
+    cxg, cyg = np.meshgrid(cx, cy)                           # [H, W]
+    half_w = whs[:, 0] / 2.0
+    half_h = whs[:, 1] / 2.0
+    box = np.stack([
+        (cxg[..., None] - half_w) / img_w,
+        (cyg[..., None] - half_h) / img_h,
+        (cxg[..., None] + half_w) / img_w,
+        (cyg[..., None] + half_h) / img_h,
+    ], axis=-1).astype(np.float32)                          # [H, W, P, 4]
+    if clip:
+        box = np.clip(box, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          box.shape).copy()
+    return Tensor(jnp.asarray(box)), Tensor(jnp.asarray(var))
+
+
+def _nms_keep_mask(boxes_sorted, iou_threshold):
+    """Trace-safe masked NMS over score-DESC-sorted boxes -> bool keep
+    mask (static shapes; the sequential suppression runs as a fori_loop,
+    the TPU analog of the reference's dynamic CPU loop)."""
+    n = boxes_sorted.shape[0]
+    x1, y1, x2, y2 = (boxes_sorted[:, i] for i in range(4))
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    iw = jnp.maximum(jnp.minimum(x2[:, None], x2[None]) -
+                     jnp.maximum(x1[:, None], x1[None]), 0)
+    ih = jnp.maximum(jnp.minimum(y2[:, None], y2[None]) -
+                     jnp.maximum(y1[:, None], y1[None]), 0)
+    inter = iw * ih
+    iou = inter / jnp.maximum(area[:, None] + area[None] - inter, 1e-9)
+    idx = jnp.arange(n)
+
+    def body(i, keep):
+        sup = (iou[i] > iou_threshold) & keep[i] & (idx > i)
+        return keep & ~sup
+
+    return jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """reference: generate_proposals_v2 (RPN proposal stage,
+    operators/detection/generate_proposals_v2_op.cc; python surface
+    vision/ops.py generate_proposals).
+
+    scores [N,A,H,W], bbox_deltas [N,4A,H,W], img_size [N,2] (h, w),
+    anchors/variances [H,W,A,4]. TPU redesign: everything static-shape —
+    per-image top-k -> delta decode -> clip -> min-size mask -> masked-NMS
+    fori_loop -> top-k; rejected slots carry score 0 and rois_num reports
+    the true count (the reference's dynamic LoD output, expressed as
+    padded tensors + counts like every other TPU-side ragged result here).
+
+    Returns (rois [N*post, 4], roi_probs [N*post, 1], rois_num [N]).
+    """
+    args = [scores, bbox_deltas, img_size, anchors, variances]
+
+    def fn(sc, bd, ims, an, va):
+        N, A, H, W = sc.shape
+        an4 = an.reshape(-1, 4)
+        va4 = va.reshape(-1, 4) if va is not None else jnp.ones_like(an4)
+        K = an4.shape[0]                      # = H*W*A
+        pre_n = min(pre_nms_top_n, K)
+        post_n = min(post_nms_top_n, pre_n)
+        # bound the O(n^2) masked suppression: candidates beyond a few
+        # multiples of post_n essentially never survive NMS (the reference
+        # CPU loop likewise stops after post_n keeps); this caps the IoU
+        # matrix at (4*post_n)^2 instead of pre_n^2
+        nms_n = min(pre_n, max(4 * post_n, 256))
+        off = 1.0 if pixel_offset else 0.0
+
+        def per_image(s_i, d_i, hw):
+            # [A,H,W] -> [H,W,A] flat, matching anchors' [H,W,A] layout
+            s_flat = jnp.transpose(s_i, (1, 2, 0)).reshape(-1)
+            d_flat = jnp.transpose(d_i.reshape(A, 4, H, W),
+                                   (2, 3, 0, 1)).reshape(-1, 4)
+            top_s, top_i = jax.lax.top_k(s_flat, pre_n)
+            anc = an4[top_i]
+            var = va4[top_i]
+            dlt = d_flat[top_i]
+            aw = anc[:, 2] - anc[:, 0] + off
+            ah = anc[:, 3] - anc[:, 1] + off
+            acx = anc[:, 0] + 0.5 * aw
+            acy = anc[:, 1] + 0.5 * ah
+            bound = jnp.log(1000.0 / 16.0)
+            pcx = dlt[:, 0] * var[:, 0] * aw + acx
+            pcy = dlt[:, 1] * var[:, 1] * ah + acy
+            pw = jnp.exp(jnp.minimum(dlt[:, 2] * var[:, 2], bound)) * aw
+            ph = jnp.exp(jnp.minimum(dlt[:, 3] * var[:, 3], bound)) * ah
+            x1 = pcx - 0.5 * pw
+            y1 = pcy - 0.5 * ph
+            x2 = pcx + 0.5 * pw - off
+            y2 = pcy + 0.5 * ph - off
+            imh, imw = hw[0], hw[1]
+            x1 = jnp.clip(x1, 0, imw - off)
+            x2 = jnp.clip(x2, 0, imw - off)
+            y1 = jnp.clip(y1, 0, imh - off)
+            y2 = jnp.clip(y2, 0, imh - off)
+            boxes = jnp.stack([x1, y1, x2, y2], axis=1)
+            wide = ((x2 - x1 + off) >= min_size) & \
+                   ((y2 - y1 + off) >= min_size)
+            s_kept = jnp.where(wide, top_s, -jnp.inf)
+            # (top_k already sorted desc; re-sort after the min-size mask)
+            order = jnp.argsort(-s_kept)[:nms_n]
+            boxes = boxes[order]
+            s_kept = s_kept[order]
+            keep = _nms_keep_mask(boxes, nms_thresh) & \
+                jnp.isfinite(s_kept)
+            final_s = jnp.where(keep, s_kept, -jnp.inf)
+            sel_s, sel_i = jax.lax.top_k(final_s, post_n)
+            rois = boxes[sel_i] * (sel_s > -jnp.inf)[:, None]
+            probs = jnp.where(sel_s > -jnp.inf, sel_s, 0.0)
+            count = jnp.sum(sel_s > -jnp.inf).astype(jnp.int32)
+            return rois, probs[:, None], count
+
+        rois, probs, counts = jax.vmap(per_image)(sc, bd, ims)
+        return (rois.reshape(-1, 4), probs.reshape(-1, 1),
+                counts.reshape(-1))
+
+    rois, probs, num = apply_op("generate_proposals", fn, args, n_outputs=3)
+    if return_rois_num:
+        return rois, probs, num
+    return rois, probs
+
+
 def read_file(filename, name=None):
     """reference: vision/ops.py read_file — file bytes as a uint8 tensor."""
     import jax.numpy as jnp
@@ -471,47 +648,6 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return Tensor(jnp.asarray(arr))
-
-
-def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),  # noqa: A002
-              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
-              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
-              name=None):
-    """reference: prior_box op (SSD anchors): per feature-map cell, boxes of
-    each (size, ratio), normalized [x1,y1,x2,y2]."""
-    import numpy as np
-    import jax.numpy as jnp
-    from ..core.tensor import Tensor
-    fh, fw = int(input.shape[2]), int(input.shape[3])
-    ih, iw = int(image.shape[2]), int(image.shape[3])
-    step_h = steps[1] or ih / fh
-    step_w = steps[0] or iw / fw
-    ratios = list(aspect_ratios)
-    if flip:
-        ratios += [1.0 / r for r in ratios if r != 1.0]
-    boxes = []
-    for i in range(fh):
-        for j in range(fw):
-            cx = (j + offset) * step_w
-            cy = (i + offset) * step_h
-            cell = []
-            for k, ms in enumerate(min_sizes):
-                for r in ratios:
-                    bw = ms * np.sqrt(r) / 2
-                    bh = ms / np.sqrt(r) / 2
-                    cell.append([(cx - bw) / iw, (cy - bh) / ih,
-                                 (cx + bw) / iw, (cy + bh) / ih])
-                if max_sizes:
-                    ms2 = np.sqrt(ms * max_sizes[k])
-                    cell.append([(cx - ms2 / 2) / iw, (cy - ms2 / 2) / ih,
-                                 (cx + ms2 / 2) / iw, (cy + ms2 / 2) / ih])
-            boxes.append(cell)
-    out = np.asarray(boxes, np.float32).reshape(fh, fw, -1, 4)
-    if clip:
-        out = out.clip(0.0, 1.0)
-    var = np.broadcast_to(np.asarray(variance, np.float32),
-                          out.shape).copy()
-    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
 
 
 def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
@@ -581,74 +717,6 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
     if return_rois_num:
         res.append(rois_num)
     return tuple(res) if len(res) > 1 else out
-
-
-def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
-                       pre_nms_top_n=6000, post_nms_top_n=1000,
-                       nms_thresh=0.5, min_size=0.1, eta=1.0,
-                       pixel_offset=False, return_rois_num=False, name=None):
-    """reference: generate_proposals op (RPN): decode deltas on anchors,
-    clip, filter small, NMS top-k."""
-    import numpy as np
-    import jax.numpy as jnp
-    from ..core.tensor import Tensor
-    sc = np.asarray(_data(scores), np.float32)        # [N, A, H, W]
-    bd = np.asarray(_data(bbox_deltas), np.float32)   # [N, 4A, H, W]
-    ims = np.asarray(_data(img_size), np.float32)     # [N, 2]
-    an = np.asarray(_data(anchors), np.float32).reshape(-1, 4)
-    va = np.asarray(_data(variances), np.float32).reshape(-1, 4)
-    N = sc.shape[0]
-    all_rois, all_scores, nums = [], [], []
-    for n in range(N):
-        s = sc[n].transpose(1, 2, 0).reshape(-1)
-        d = bd[n].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
-            .transpose(2, 3, 0, 1).reshape(-1, 4)
-        order = np.argsort(-s)[:pre_nms_top_n]
-        s, d, a, v = s[order], d[order], an[order % len(an)], va[order % len(va)]
-        aw = a[:, 2] - a[:, 0]
-        ah = a[:, 3] - a[:, 1]
-        acx = a[:, 0] + aw / 2
-        acy = a[:, 1] + ah / 2
-        cx = v[:, 0] * d[:, 0] * aw + acx
-        cy = v[:, 1] * d[:, 1] * ah + acy
-        w = aw * np.exp(np.clip(v[:, 2] * d[:, 2], None, 10))
-        h = ah * np.exp(np.clip(v[:, 3] * d[:, 3], None, 10))
-        boxes = np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], 1)
-        H, W = ims[n]
-        boxes[:, 0::2] = boxes[:, 0::2].clip(0, W)
-        boxes[:, 1::2] = boxes[:, 1::2].clip(0, H)
-        keep = ((boxes[:, 2] - boxes[:, 0] >= min_size) &
-                (boxes[:, 3] - boxes[:, 1] >= min_size))
-        boxes, s = boxes[keep], s[keep]
-        # plain NMS
-        order2 = np.argsort(-s)
-        sel = []
-        while order2.size and len(sel) < post_nms_top_n:
-            i = order2[0]
-            sel.append(i)
-            if order2.size == 1:
-                break
-            rest = order2[1:]
-            xx1 = np.maximum(boxes[i, 0], boxes[rest, 0])
-            yy1 = np.maximum(boxes[i, 1], boxes[rest, 1])
-            xx2 = np.minimum(boxes[i, 2], boxes[rest, 2])
-            yy2 = np.minimum(boxes[i, 3], boxes[rest, 3])
-            inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
-            a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
-            a2 = ((boxes[rest, 2] - boxes[rest, 0]) *
-                  (boxes[rest, 3] - boxes[rest, 1]))
-            iou = inter / np.maximum(a1 + a2 - inter, 1e-9)
-            order2 = rest[iou <= nms_thresh]
-        all_rois.append(boxes[sel])
-        all_scores.append(s[sel])
-        nums.append(len(sel))
-    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0) if all_rois
-                              else np.zeros((0, 4), np.float32)))
-    rscores = Tensor(jnp.asarray(np.concatenate(all_scores, 0) if all_scores
-                                 else np.zeros((0,), np.float32)))
-    if return_rois_num:
-        return rois, rscores, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
-    return rois, rscores
 
 
 def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
