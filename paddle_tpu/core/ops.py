@@ -835,9 +835,29 @@ def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
 
 
 def bincount(x, weights=None, minlength=0, name=None):
-    arr = np.asarray(_arr(x))
-    w = np.asarray(_arr(weights)) if weights is not None else None
-    return Tensor(jnp.asarray(np.bincount(arr, weights=w, minlength=minlength)))
+    """Eager: output length = max(x)+1 like the reference. Under jit the
+    output SHAPE is value-dependent, so a static bound is required: pass
+    minlength >= max(x)+1 (the jnp.bincount `length` contract) — counts
+    lower to one scatter-add on device, no host fallback."""
+    has_w = weights is not None
+
+    def fn(a, *w):
+        import jax.core as _core
+        if isinstance(a, _core.Tracer):
+            if minlength <= 0:
+                raise NotImplementedError(
+                    "bincount under jit needs a static output length: pass "
+                    "minlength >= max(x)+1 (eager calls size dynamically "
+                    "like the reference)")
+            length = int(minlength)
+        else:
+            # builtins.max: plain `max` is this module's reduction op
+            length = builtins.max((int(a.max()) + 1) if a.size else 0,
+                                  int(minlength))
+        return jnp.bincount(a.reshape(-1), weights=w[0] if w else None,
+                            length=length)
+
+    return apply_op("bincount", fn, [x] + ([weights] if has_w else []))
 
 
 def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002

@@ -241,14 +241,17 @@ class TrainStep:
                     l, g = jax.value_and_grad(loss_of)(
                         list(param_arrays), mb, k)
                     return (loss_acc + l / accum,
-                            [ga + gi / accum for ga, gi in zip(g_acc, g)]), None
+                            [ga + (gi / accum).astype(ga.dtype)
+                             for ga, gi in zip(g_acc, g)]), None
 
-                zeros = [jnp.zeros(p.shape, jnp.float32)
+                # accumulate in the PARAM dtype: autodiff grads already come
+                # out in param dtype (bf16 for bf16 models), and an f32
+                # accumulator would double the grad footprint — the very
+                # memory the microbatching exists to save
+                zeros = [jnp.zeros(p.shape, p.dtype)
                          for p in param_arrays]
                 (loss, grads), _ = jax.lax.scan(
                     acc_body, (jnp.float32(0.0), zeros), (micro, keys))
-                grads = [g.astype(p.dtype)
-                         for g, p in zip(grads, param_arrays)]
             if grad_clip is not None and type(grad_clip).__name__ == "ClipGradByGlobalNorm":
                 total = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                                      for g in grads))

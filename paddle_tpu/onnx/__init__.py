@@ -13,17 +13,18 @@ from __future__ import annotations
 def export(layer, path, input_spec=None, opset_version=9, **configs):
     """reference: paddle.onnx.export(layer, path, input_spec, ...).
 
-    Produces `path`.pdmodel/.pdmeta (serialized StableHLO, loadable by
-    paddle_tpu.inference.create_predictor) — the TPU-native equivalent of an
-    .onnx file. Raises if the caller demands a literal .onnx artifact."""
-    if path.endswith(".onnx"):
-        raise NotImplementedError(
-            "ONNX serialization is not available in the TPU-native stack; "
-            "export produces a StableHLO artifact instead — pass a path "
-            "prefix (no .onnx suffix) and serve it with "
-            "paddle_tpu.inference.create_predictor")
-    from ..jit.save_load import save as _jit_save
+    A `path` ending in `.onnx` produces a LITERAL ONNX file (opset 13) via
+    the built-in converter for the common feed-forward layer set
+    (_export_onnx.py: Linear/Conv2D/BatchNorm/activations/pools/Flatten/
+    Sequential) — real interchange with the ONNX ecosystem. Any other path
+    produces `path`.pdmodel/.pdmeta (serialized StableHLO, loadable by
+    paddle_tpu.inference.create_predictor), the TPU-native deploy artifact
+    that covers EVERY model the framework can trace."""
     if input_spec is None:
         raise ValueError("onnx.export requires input_spec")
+    if path.endswith(".onnx"):
+        from ._export_onnx import export_onnx
+        return export_onnx(layer, path, input_spec)
+    from ..jit.save_load import save as _jit_save
     _jit_save(layer, path, input_spec=input_spec)
     return path

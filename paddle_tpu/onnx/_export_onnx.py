@@ -1,0 +1,244 @@
+"""Literal .onnx serialization for the common feed-forward layer set.
+
+Reference capability: python/paddle/onnx/export.py (delegating to
+paddle2onnx's full converter). This module implements the interchange
+format directly for the layers that cover MLP/LeNet/VGG-class inference
+models: Linear->Gemm, Conv2D->Conv, BatchNorm2D->BatchNormalization,
+ReLU/Tanh/Sigmoid/Softmax, MaxPool2D/AvgPool2D, Flatten, Dropout (elided
+at inference), and Sequential composition. Anything richer exports the
+TPU-native StableHLO artifact instead (paddle_tpu.inference serves it).
+
+The schema is compiled on first use from onnx_subset.proto (the public
+ONNX wire contract, subset) via protoc into real protobuf bindings — no
+hand-rolled wire encoding.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+_PB = None
+
+
+def _proto():
+    """Compile + import the ONNX subset schema (cached per process)."""
+    global _PB
+    if _PB is not None:
+        return _PB
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.gettempdir(),
+                       f"ptpu_onnx_pb_{os.getuid()}")
+    os.makedirs(out, exist_ok=True)
+    gen = os.path.join(out, "onnx_subset_pb2.py")
+    src = os.path.join(here, "onnx_subset.proto")
+    if not os.path.exists(gen) or \
+            os.path.getmtime(gen) < os.path.getmtime(src):
+        r = subprocess.run(["protoc", f"--python_out={out}", "-I", here, src],
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(f"protoc failed for ONNX schema: {r.stderr}")
+    if out not in sys.path:
+        sys.path.insert(0, out)
+    import onnx_subset_pb2 as PB  # noqa: E402
+    _PB = PB
+    return PB
+
+
+def _np(t):
+    return np.asarray(t._data if hasattr(t, "_data") else t, np.float32)
+
+
+class _Builder:
+    def __init__(self, PB):
+        self.PB = PB
+        self.model = PB.ModelProto()
+        self.model.ir_version = 8
+        self.model.producer_name = "paddle_tpu"
+        op = self.model.opset_import.add()
+        op.domain = ""
+        op.version = 13
+        self.g = self.model.graph
+        self.g.name = "paddle_tpu_graph"
+        self.n = 0
+
+    def tensor(self, name, arr):
+        t = self.g.initializer.add()
+        t.name = name
+        arr = np.ascontiguousarray(arr, np.float32)
+        t.dims.extend(arr.shape)
+        t.data_type = self.PB.TensorProto.FLOAT
+        t.raw_data = arr.tobytes()
+        return name
+
+    def io(self, coll, name, shape):
+        vi = coll.add()
+        vi.name = name
+        tt = vi.type.tensor_type
+        tt.elem_type = self.PB.TensorProto.FLOAT
+        for d in shape:
+            dim = tt.shape.dim.add()
+            if d is None or (isinstance(d, int) and d < 0):
+                dim.dim_param = "N"
+            else:
+                dim.dim_value = int(d)
+
+    def node(self, op_type, inputs, n_out=1, **attrs):
+        nd = self.g.node.add()
+        nd.op_type = op_type
+        nd.name = f"{op_type}_{self.n}"
+        outs = [f"t{self.n}_{i}" for i in range(n_out)]
+        self.n += 1
+        nd.input.extend(inputs)
+        nd.output.extend(outs)
+        for k, v in attrs.items():
+            a = nd.attribute.add()
+            a.name = k
+            if isinstance(v, float):
+                a.type = self.PB.AttributeProto.FLOAT
+                a.f = v
+            elif isinstance(v, int):
+                a.type = self.PB.AttributeProto.INT
+                a.i = v
+            elif isinstance(v, (list, tuple)):
+                a.type = self.PB.AttributeProto.INTS
+                a.ints.extend(int(x) for x in v)
+            else:
+                raise TypeError(f"attr {k}={v!r}")
+        return outs[0] if n_out == 1 else outs
+
+
+def _pair(v, what="stride/padding"):
+    if isinstance(v, int):
+        return (v, v)
+    if isinstance(v, (list, tuple)) and len(v) == 2 and \
+            all(isinstance(e, int) for e in v):
+        return tuple(v)
+    raise NotImplementedError(
+        f"onnx.export: {what} form {v!r} is not supported by the built-in "
+        "converter (int or [h, w] ints only — no 'SAME'/'VALID' strings, "
+        "4-element, or per-side nested paddings)")
+
+
+def _emit(layer, b: _Builder, x: str) -> str:
+    """Map one Layer to ONNX node(s); returns the output tensor name."""
+    kind = type(layer).__name__
+    if kind == "Sequential":
+        for sub in layer:
+            x = _emit(sub, b, x)
+        return x
+    if kind == "Linear":
+        w = b.tensor(f"w{b.n}", _np(layer.weight))          # [in, out]
+        ins = [x, w]
+        if getattr(layer, "bias", None) is not None:
+            ins.append(b.tensor(f"b{b.n}", _np(layer.bias)))
+        return b.node("Gemm", ins, alpha=1.0, beta=1.0, transB=0)
+    if kind == "Conv2D":
+        w = b.tensor(f"w{b.n}", _np(layer.weight))          # [O, I/g, kh, kw]
+        ins = [x, w]
+        if getattr(layer, "bias", None) is not None:
+            ins.append(b.tensor(f"b{b.n}", _np(layer.bias)))
+        s = _pair(getattr(layer, "_stride", 1), "stride")
+        p = _pair(getattr(layer, "_padding", 0), "padding")
+        d = _pair(getattr(layer, "_dilation", 1), "dilation")
+        g = int(getattr(layer, "_groups", 1))
+        return b.node("Conv", ins, strides=list(s),
+                      pads=[p[0], p[1], p[0], p[1]], dilations=list(d),
+                      group=g)
+    if kind in ("BatchNorm2D", "BatchNorm1D", "BatchNorm"):
+        scale = b.tensor(f"g{b.n}", _np(layer.weight))
+        bias = b.tensor(f"b{b.n}", _np(layer.bias))
+        mean = b.tensor(f"m{b.n}", _np(layer._mean))
+        var = b.tensor(f"v{b.n}", _np(layer._variance))
+        return b.node("BatchNormalization", [x, scale, bias, mean, var],
+                      epsilon=float(layer._epsilon))
+    if kind == "ReLU":
+        return b.node("Relu", [x])
+    if kind == "Tanh":
+        return b.node("Tanh", [x])
+    if kind == "Sigmoid":
+        return b.node("Sigmoid", [x])
+    if kind == "Softmax":
+        return b.node("Softmax", [x],
+                      axis=int(getattr(layer, "_kw", {}).get("axis", -1)))
+    if kind == "Flatten":
+        # ONNX Flatten(axis) collapses to RANK 2; that matches paddle's
+        # Flatten only for the (default) start_axis=1, stop_axis=-1 form
+        if getattr(layer, "stop_axis", -1) != -1 or \
+                getattr(layer, "start_axis", 1) != 1:
+            raise NotImplementedError(
+                "onnx.export Flatten supports start_axis=1/stop_axis=-1 "
+                "only (ONNX Flatten always produces a rank-2 tensor)")
+        return b.node("Flatten", [x], axis=1)
+    if kind == "MaxPool2D":
+        if getattr(layer, "ceil_mode", False) or \
+                getattr(layer, "return_mask", False):
+            raise NotImplementedError(
+                "onnx.export MaxPool2D: ceil_mode/return_mask not supported")
+        k = _pair(layer.k, "kernel_size")
+        st = _pair(layer.s if layer.s is not None else layer.k, "stride")
+        p = _pair(getattr(layer, "p", 0), "padding")
+        return b.node("MaxPool", [x], kernel_shape=list(k), strides=list(st),
+                      pads=[p[0], p[1], p[0], p[1]])
+    if kind == "AvgPool2D":
+        if getattr(layer, "ceil_mode", False) or \
+                getattr(layer, "divisor", None) is not None:
+            raise NotImplementedError(
+                "onnx.export AvgPool2D: ceil_mode/divisor_override not "
+                "supported")
+        k = _pair(layer.k, "kernel_size")
+        st = _pair(layer.s if layer.s is not None else layer.k, "stride")
+        p = _pair(getattr(layer, "p", 0), "padding")
+        # paddle `exclusive` == NOT ONNX count_include_pad
+        return b.node("AveragePool", [x], kernel_shape=list(k),
+                      strides=list(st), pads=[p[0], p[1], p[0], p[1]],
+                      count_include_pad=0 if getattr(layer, "exclusive",
+                                                     True) else 1)
+    if kind in ("Dropout", "Dropout2D"):
+        return x                                   # inference: identity
+    raise NotImplementedError(
+        f"onnx.export: layer {kind} has no ONNX mapping in the built-in "
+        "converter (supported: Sequential/Linear/Conv2D/BatchNorm2D/ReLU/"
+        "Tanh/Sigmoid/Softmax/Flatten/MaxPool2D/AvgPool2D/Dropout). Export "
+        "without the .onnx suffix for the StableHLO artifact instead.")
+
+
+def export_onnx(layer, path, input_spec):
+    """Serialize `layer` to a literal .onnx file (opset 13, float32)."""
+    PB = _proto()
+    if not input_spec or len(input_spec) != 1:
+        raise ValueError("onnx.export supports exactly one input spec")
+    spec = input_spec[0]
+    shape = list(getattr(spec, "shape", spec))
+    b = _Builder(PB)
+    b.io(b.g.input, "input", shape)
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        out = _emit(layer, b, "input")
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+    # output shape via abstract eval on the framework itself
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    from ..core import autograd
+
+    def fwd(a):
+        with autograd.no_grad():
+            return layer(Tensor(a))._data
+
+    oshape = jax.eval_shape(
+        fwd, jax.ShapeDtypeStruct(
+            tuple(1 if (d is None or d < 0) else d for d in shape),
+            jnp.float32)).shape
+    b.io(b.g.output, out, (None,) + tuple(oshape[1:])
+         if (shape and (shape[0] in (None, -1))) else oshape)
+    with open(path, "wb") as f:
+        f.write(b.model.SerializeToString())
+    return path

@@ -25,9 +25,10 @@ MEASURED CAVEAT (v5e, r2 session): isolated-kernel timing can MISLEAD —
 for GPT-1.3B S=2048 the tuner picks (256,512) which wins in isolation but
 loses 6 MFU points inside the full training step (smaller K/V tiles
 re-read HBM; the bandwidth they steal is invisible when the kernel runs
-alone). Treat autotune results as exploration hints and confirm against
-the end-to-end bench; the shipped defaults (1024,1024) come from
-full-step measurements.
+alone). `tune_in_step` closes this trap: it times candidates inside a
+caller-supplied FULL step (bench.py wires it for the flagship via
+PADDLE_TPU_BENCH_AUTOTUNE=step). The isolated `tune_flash_blocks` remains
+for quick exploration.
 """
 from __future__ import annotations
 
@@ -147,6 +148,58 @@ def tune(kernel: str, sig: Tuple, candidates: List[Tuple],
     cache[key] = list(best)
     _save()
     return tuple(best)
+
+
+_OVERRIDE = None
+
+
+def override_blocks(bq: int, bk: int):
+    """Context manager forcing flash tile sizes — the hook tune_in_step
+    uses to rebuild a caller's step under each candidate."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        global _OVERRIDE
+        prev = _OVERRIDE
+        _OVERRIDE = (int(bq), int(bk))
+        try:
+            yield
+        finally:
+            _OVERRIDE = prev
+
+    return cm()
+
+
+def tune_in_step(kernel: str, sig: Tuple, candidates: List[Tuple],
+                 build_step, iters: int = 2) -> Tuple:
+    """Measured tile selection INSIDE a representative training step —
+    closing the isolated-kernel trap documented above (r2: the isolated
+    tuner's (256,512) pick lost 6 MFU points end-to-end because the HBM
+    bandwidth small tiles steal is invisible when the kernel runs alone).
+
+    build_step() -> run() must construct a FRESH step (fresh compile
+    cache) and return a zero-arg callable executing one full step; it is
+    rebuilt once per candidate under override_blocks(cand), so every
+    flash_attention call inside traces with that candidate's tiles. The
+    winner persists in the same cache as tune() under key
+    (device, kernel, sig) — reference contract:
+    phi/kernels/autotune/switch_autotune.cc (measure-then-pick-then-cache).
+    """
+    def bench_fn(cand):
+        # compile happens on the first run() call (the tune() harness warms
+        # once, then times): candidate timing is the steady-state full step
+        holder = {}
+
+        def run():
+            with override_blocks(*cand):
+                if "step" not in holder:
+                    holder["step"] = build_step()
+                return holder["step"]()
+
+        return run
+
+    return tune(kernel, sig, candidates, bench_fn, iters=iters)
 
 
 def tune_flash_blocks(b: int, s_q: int, s_k: int, h: int, d: int,

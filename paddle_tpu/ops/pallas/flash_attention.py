@@ -292,6 +292,10 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
         scale = 1.0 / math.sqrt(q.shape[-1])
     s_q, s_k = q.shape[1], k.shape[1]
     import os
+    from . import autotune as _at0
+    if block_q is None and block_k is None and _at0._OVERRIDE is not None:
+        # in-context tuner (autotune.tune_in_step) forcing this candidate
+        block_q, block_k = _at0._OVERRIDE
     env_bq = os.environ.get("PADDLE_TPU_FLASH_BQ")  # tuning knobs
     env_bk = os.environ.get("PADDLE_TPU_FLASH_BK")
     if block_q is None and block_k is None and not env_bq and not env_bk \

@@ -36,3 +36,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-minute tests (subprocess clusters, detector "
         "training) — `-m 'not slow'` gives the quick pass")
+    config.addinivalue_line(
+        "markers", "heavy: compile-heavy batches (numeric-grad sweep, "
+        "under-jit sweep, model trainings); the SMOKE tier is "
+        "`-m 'not slow and not heavy'` and finishes <5 min on one core "
+        "(reference testslist.csv RUN_TYPE labels)")

