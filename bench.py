@@ -53,6 +53,7 @@ def bench_resnet50(on_tpu):
     import paddle_tpu.nn as nn
 
     B, hw, iters = (64, 224, 8) if on_tpu else (4, 64, 2)
+    B = int(os.environ.get("PADDLE_TPU_BENCH_B", B))
     paddle.seed(0)
     model = resnet50(num_classes=1000)
     if on_tpu:
@@ -143,15 +144,15 @@ def main():
     from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion, gpt_config
 
     if on_tpu:
-        # default: the best measured single-chip flagship point. v5e r2
-        # ladder (all bf16 moments, fused chunked LM-head CE): B=3 S=2048
-        # 73.8% MFU / 16.0k tok/s (the default; beats the >=70% north star);
-        # B=6 S=1024 72.4% / 16.8k tok/s (max raw throughput; B=8 drops to
-        # 69.7% -- XLA auto-remats under HBM pressure, so MORE batch is
-        # LESS speed past the knee); long-context B=2 S=4096 73.4%;
-        # B=1 S=8192 71.1% with blockwise-int8 EMBEDDING moments
-        # (q8_param_fun) + CE chunk 512 -- no remat needed. 2.7B fits with
-        # RECOMPUTE=1 MOMENT_DTYPE=int8.
+        # default: the best measured single-chip flagship point. v5e r3
+        # ladder (bf16 moments, fused chunked LM-head CE, chunk 512):
+        # B=3 S=2048 73.7% MFU / 15.9k tok/s (beats the >=70% north star;
+        # in-step autotune confirms flash tiles (1024,1024));
+        # B=6 S=1024 72.4% / 16.8k tok/s (max raw throughput; B=8 and
+        # B=4 S=2048 drop to ~69.5% -- XLA auto-remats under HBM pressure,
+        # MORE batch is LESS speed past the knee); B=2 S=4096 73.4%;
+        # B=1 S=8192 71.1% with int8 EMBEDDING moments (q8_param_fun).
+        # 2.7B fits with RECOMPUTE=save_qkv MOMENT_DTYPE=int8 B=6 (46.1%).
         preset = os.environ.get("PADDLE_TPU_BENCH_PRESET", "gpt3-1.3b")
         B = int(os.environ.get("PADDLE_TPU_BENCH_B", "3"))
         S = int(os.environ.get("PADDLE_TPU_BENCH_S", "2048"))
@@ -165,11 +166,7 @@ def main():
         cfg.use_recompute = True
         if rc != "1":
             cfg.recompute_policy = rc
-    paddle.seed(0)
-    model = GPTForCausalLM(cfg)
-    if on_tpu:
-        model.to(dtype="bfloat16")  # TPU-native bf16 params+compute
-    crit = GPTPretrainingCriterion(cfg)
+    # knobs shared by the bench step and the in-step autotuner
     # bf16 moments: compute still f32, halves optimizer HBM so the batch
     # (and MXU efficiency) can grow on one chip
     # embedding-table moments in blockwise int8 (q8_param_fun): wte+wpe
@@ -177,64 +174,71 @@ def main():
     # S=8192 long-context config with bf16 moments elsewhere
     q8_emb = os.environ.get("PADDLE_TPU_BENCH_Q8_EMB", "1" if S >= 8192
                             else "0") == "1"
-    opt = paddle.optimizer.AdamW(
-        learning_rate=1e-4, parameters=model.parameters(),
-        moment_dtype=os.environ.get("PADDLE_TPU_BENCH_MOMENT_DTYPE",
-                                    "bfloat16" if on_tpu else "float32"),
-        q8_param_fun=(lambda n: ("wte" in n or "wpe" in n)) if q8_emb
-        else None)
+    moment_dtype = os.environ.get("PADDLE_TPU_BENCH_MOMENT_DTYPE",
+                                  "bfloat16" if on_tpu else "float32")
     # fused LM-head CE: no [B,S,vocab] logits in HBM (models/gpt.py loss())
-    ce_chunk = int(os.environ.get("PADDLE_TPU_BENCH_CE_CHUNK", "256"))
+    ce_chunk = int(os.environ.get("PADDLE_TPU_BENCH_CE_CHUNK", "512"))
     # gradient accumulation: activation memory of B/accum at the update
     # math of B (the knob that fits big models without more remat)
     accum = int(os.environ.get("PADDLE_TPU_BENCH_ACCUM", "1"))
-    if ce_chunk > 0:
-        step = TrainStep(model, opt,
-                         lambda ids, lbl: model.loss(ids, lbl,
-                                                     chunk_size=ce_chunk),
-                         grad_accum_steps=accum)
-    else:  # unfused reference path
-        step = TrainStep(model, opt, lambda ids, lbl: crit(model(ids), lbl),
-                         grad_accum_steps=accum)
-
     ids = paddle.to_tensor(
         np.random.randint(0, cfg.vocab_size, (B, S)).astype("int32"))
 
+    def make_step():
+        """The benchmarked config, exactly — also what the in-step
+        autotuner measures (an unrepresentative step is the trap
+        tune_in_step exists to close)."""
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        if on_tpu:
+            m.to(dtype="bfloat16")  # TPU-native bf16 params+compute
+        o = paddle.optimizer.AdamW(
+            learning_rate=1e-4, parameters=m.parameters(),
+            moment_dtype=moment_dtype,
+            q8_param_fun=(lambda n: ("wte" in n or "wpe" in n)) if q8_emb
+            else None)
+        c = GPTPretrainingCriterion(cfg)
+        if ce_chunk > 0:
+            st = TrainStep(m, o,
+                           lambda a, b: m.loss(a, b, chunk_size=ce_chunk),
+                           grad_accum_steps=accum)
+        else:  # unfused reference path
+            st = TrainStep(m, o, lambda a, b: c(m(a), b),
+                           grad_accum_steps=accum)
+        return m, st
+
     # in-context autotune (VERDICT r2 #8): measure flash tile candidates
-    # inside THIS config's full single step before the timed run
+    # inside THIS config's full single step BEFORE the bench model
+    # allocates (each candidate holds a full model+optimizer on device)
     if on_tpu and os.environ.get("PADDLE_TPU_BENCH_AUTOTUNE") == "step":
+        import logging
+        logging.getLogger("paddle_tpu.ops.pallas.autotune").setLevel(
+            logging.INFO)
+        if not logging.getLogger().handlers:
+            logging.basicConfig(level=logging.INFO)
         from paddle_tpu.ops.pallas import autotune as _at
 
-        def build_step():
-            # must mirror the benchmarked config EXACTLY (optimizer state
-            # dtypes, q8 params, CE path, accumulation) — an unrepresentative
-            # step is the trap tune_in_step exists to close
-            paddle.seed(0)
-            m = GPTForCausalLM(cfg)
-            m.to(dtype="bfloat16")
-            o = paddle.optimizer.AdamW(
-                learning_rate=1e-4, parameters=m.parameters(),
-                moment_dtype=os.environ.get("PADDLE_TPU_BENCH_MOMENT_DTYPE",
-                                            "bfloat16"),
-                q8_param_fun=(lambda n: ("wte" in n or "wpe" in n))
-                if q8_emb else None)
-            c = GPTPretrainingCriterion(cfg)
-            if ce_chunk > 0:
-                st = TrainStep(m, o,
-                               lambda a, b: m.loss(a, b,
-                                                   chunk_size=ce_chunk),
-                               grad_accum_steps=accum)
-            else:
-                st = TrainStep(m, o, lambda a, b: c(m(a), b),
-                               grad_accum_steps=accum)
-            return lambda: float(st(ids, ids))
+        # candidates are timed over a MULTI-step fused launch (run_steps):
+        # per-call dispatch/transfer latency through a remote relay is
+        # larger than the per-step differences being measured (r4 session:
+        # single-step timing picked tiles 1.1 MFU points below default)
+        tune_ids = paddle.to_tensor(np.random.randint(
+            0, cfg.vocab_size, (4, B, S)).astype("int32"))
 
-        sig = ("in_step", preset, B, S, ce_chunk, accum)
+        def build_step():
+            _, st = make_step()
+            return lambda: float(
+                st.run_steps(4, tune_ids, tune_ids).numpy()[-1])
+
+        sig = ("in_step4", preset, B, S, ce_chunk, accum,
+               moment_dtype, int(q8_emb), rc or "none")
         best = _at.tune_in_step("flash_attention_step", sig,
                                 _at.flash_candidates(S, S), build_step)
         os.environ["PADDLE_TPU_FLASH_BQ"] = str(best[0])
         os.environ["PADDLE_TPU_FLASH_BK"] = str(best[1])
         print(f"# in-step autotune picked blocks {best}", file=sys.stderr)
+
+    model, step = make_step()
 
     # timed region runs `iters` steps as ONE executable (TrainStep.run_steps
     # — lax.scan over stacked batches): amortizes host/relay dispatch and,
@@ -293,6 +297,7 @@ def bench_vit(on_tpu):
     import paddle_tpu.nn as nn
 
     B, iters = (32, 8) if on_tpu else (2, 2)
+    B = int(os.environ.get("PADDLE_TPU_BENCH_B", B))
     preset = os.environ.get("PADDLE_TPU_BENCH_PRESET", "vit-l16")
     if on_tpu:
         cfg = vit_config(preset, image_size=224, num_classes=1000)

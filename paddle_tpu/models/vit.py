@@ -118,6 +118,21 @@ class ViTBlock(Layer):
         return x + y
 
 
+def _patchify_matmul(img, w, bias, p):
+    """[B,C,H,W] -> [B, N, hidden] patch embedding: space-to-depth then one
+    einsum with the Conv2D weight [hidden, C, p, p] flattened — exactly the
+    stride-p conv, expressed so forward AND backward are plain matmuls.
+    Partial trailing patches are floored away like the strided conv."""
+    B, C, H, W = img.shape
+    gh, gw = H // p, W // p
+    if (H % p) or (W % p):
+        img = img[:, :, :gh * p, :gw * p]
+    x = img.reshape(B, C, gh, p, gw, p)
+    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(B, gh * gw, C * p * p)
+    wm = w.reshape(w.shape[0], -1)                    # [hidden, C*p*p]
+    return jnp.einsum("bnk,hk->bnh", x, wm) + bias
+
+
 class VisionTransformer(Layer):
     """ViT backbone + classification head (cls-token pooling)."""
 
@@ -142,9 +157,25 @@ class VisionTransformer(Layer):
             self.to(dtype=config.param_dtype)
 
     def forward(self, pixel_values):
-        x = self.patch_embed(pixel_values)            # [B, H, gh, gw]
-        b, h = x.shape[0], x.shape[1]
-        x = ops.transpose(ops.reshape(x, [b, h, -1]), [0, 2, 1])  # [B, N, H]
+        # Patchify as space-to-depth + ONE matmul on the conv's own weight
+        # (numerically the strided conv, same parameters/state dict). The
+        # conv formulation cost ~17 ms/step of ViT-L's 107 ms on v5e —
+        # XLA's conv/conv-grad kernels + layout transposes for a kernel
+        # that is really a reshape — vs matmul fwd+bwd on the MXU
+        # (r3 profile, VERDICT r2 #4).
+        p = self.config.patch_size
+        pe = self.patch_embed
+        if pe.bias is not None:
+            x = apply_op(
+                "vit_patchify",
+                lambda img, w, bias: _patchify_matmul(img, w, bias, p),
+                [pixel_values, pe.weight, pe.bias])
+        else:
+            x = apply_op(
+                "vit_patchify",
+                lambda img, w: _patchify_matmul(img, w, 0.0, p),
+                [pixel_values, pe.weight])
+        b, h = x.shape[0], x.shape[2]
         cls = ops.expand(self.cls_token, [b, 1, h])
         x = ops.concat([cls, x], axis=1) + self.pos_embed
         if self.training and self.config.hidden_dropout:

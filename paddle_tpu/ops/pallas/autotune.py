@@ -84,12 +84,20 @@ def flash_candidates(s_q: int, s_k: int) -> List[Tuple[int, int]]:
     return out or [(min(1024, s_q), min(1024, s_k))]
 
 
+def _cache_key(kernel: str, sig: Tuple) -> str:
+    import jax
+    dev = getattr(jax.devices()[0], "device_kind", "cpu")
+    return f"{dev}|{kernel}|{'x'.join(str(s) for s in sig)}"
+
+
+def _smallest(candidates):
+    import math
+    return min(candidates, key=lambda c: math.prod(c))
+
+
 def cached_blocks(kernel: str, sig: Tuple) -> Optional[Tuple]:
     """Cache lookup only (no measurement) — safe during jit tracing."""
-    import jax
-    cache = _load()
-    dev = getattr(jax.devices()[0], "device_kind", "cpu")
-    hit = cache.get(f"{dev}|{kernel}|{'x'.join(str(s) for s in sig)}")
+    hit = _load().get(_cache_key(kernel, sig))
     return tuple(hit) if hit is not None else None
 
 
@@ -104,8 +112,7 @@ def tune(kernel: str, sig: Tuple, candidates: List[Tuple],
     import jax
 
     cache = _load()
-    dev = getattr(jax.devices()[0], "device_kind", "cpu")
-    key = f"{dev}|{kernel}|{'x'.join(str(s) for s in sig)}"
+    key = _cache_key(kernel, sig)
     hit = cache.get(key)
     if hit is not None:
         return tuple(hit)
@@ -139,8 +146,7 @@ def tune(kernel: str, sig: Tuple, candidates: List[Tuple],
         # dominant failure mode is VMEM OOM — so pick the SMALLEST
         # candidate (most likely to compile), not candidates[0].
         import logging
-        import math
-        smallest = min(candidates, key=lambda c: math.prod(c))
+        smallest = _smallest(candidates)
         logging.getLogger(__name__).warning(
             "autotune(%s): every candidate failed to run; falling back to "
             "smallest tile %s (unmeasured)", kernel, smallest)
@@ -179,27 +185,61 @@ def tune_in_step(kernel: str, sig: Tuple, candidates: List[Tuple],
     bandwidth small tiles steal is invisible when the kernel runs alone).
 
     build_step() -> run() must construct a FRESH step (fresh compile
-    cache) and return a zero-arg callable executing one full step; it is
-    rebuilt once per candidate under override_blocks(cand), so every
-    flash_attention call inside traces with that candidate's tiles. The
-    winner persists in the same cache as tune() under key
-    (device, kernel, sig) — reference contract:
-    phi/kernels/autotune/switch_autotune.cc (measure-then-pick-then-cache).
+    cache) and return a zero-arg callable that executes one full step AND
+    fences on device completion (e.g. end with a host read like float(...)
+    or jax.block_until_ready) — the tuner times run() wall-clock, and a
+    fire-and-forget runner would measure async dispatch, not the step; the
+    raw array case is fenced here as a safety net. Rebuilt once per
+    candidate under override_blocks(cand), so every flash_attention call
+    inside traces with that candidate's tiles. The winner persists in the
+    same cache as tune() under key (device, kernel, sig) — reference
+    contract: phi/kernels/autotune/switch_autotune.cc
+    (measure-then-pick-then-cache).
     """
-    def bench_fn(cand):
-        # compile happens on the first run() call (the tune() harness warms
-        # once, then times): candidate timing is the steady-state full step
-        holder = {}
+    import gc
+    import logging
 
-        def run():
+    cache = _load()
+    key = _cache_key(kernel, sig)
+    hit = cache.get(key)
+    if hit is not None:
+        return tuple(hit)
+
+    log = logging.getLogger(__name__)
+    best, best_t = None, float("inf")
+    for cand in candidates:
+        try:
             with override_blocks(*cand):
-                if "step" not in holder:
-                    holder["step"] = build_step()
-                return holder["step"]()
-
-        return run
-
-    return tune(kernel, sig, candidates, bench_fn, iters=iters)
+                import jax as _jax
+                step = build_step()
+                _jax.block_until_ready(step())   # compile (safety fence)
+                _jax.block_until_ready(step())   # steady-state warm
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = step()
+                _jax.block_until_ready(out)
+                dt = (time.perf_counter() - t0) / iters
+            log.info("tune_in_step(%s) %s: %.1f ms", kernel, cand, dt * 1e3)
+        except Exception as e:
+            log.info("tune_in_step(%s) %s: infeasible (%s)", kernel, cand,
+                     str(e)[:120])
+            dt = None
+        finally:
+            # each candidate holds a FULL model + optimizer state on
+            # device; free before the next build (and before the caller's
+            # own model allocates)
+            step = None
+            gc.collect()
+        if dt is not None and dt < best_t:
+            best, best_t = cand, dt
+    if best is None:
+        smallest = _smallest(candidates)
+        log.warning("tune_in_step(%s): every candidate failed; falling "
+                    "back to smallest tile %s", kernel, smallest)
+        return tuple(smallest)
+    cache[key] = list(best)
+    _save()
+    return tuple(best)
 
 
 def tune_flash_blocks(b: int, s_q: int, s_k: int, h: int, d: int,

@@ -32,6 +32,67 @@ def _seed():
     paddle_tpu.seed(0)
 
 
+# ---------------------------------------------------------------------------
+# Tier table (reference: testslist.csv RUN_TYPE labels). The SMOKE tier
+# (`-m 'not slow and not heavy'`) keeps at least one representative per
+# subsystem and finishes <5 min on one core; everything matching a pattern
+# below joins the heavy tier (compile-heavy trainings/parities), on top of
+# tests explicitly marked slow/heavy in their files.
+_HEAVY_PATTERNS = (
+    # vision: model-zoo forwards + trainings (transforms/box-op math stays)
+    "test_vision.py::test_model_forward_shape",
+    "test_vision.py::test_more_zoo_constructs",
+    "test_vision.py::test_swin_",
+    "test_vision.py::test_vgg_forward",
+    "test_vision.py::test_train_step_resnet18",
+    "test_vision.py::TestDetectionOpsTail::test_generate_proposals",
+    # GPT model family: parities/moe/int8 (core fwd+bwd+train stays)
+    "test_models_gpt.py::test_generate_kv_cache",
+    "test_models_gpt.py::test_recompute_parity",
+    "test_models_gpt.py::test_hybrid_tp_parity",
+    "test_models_gpt.py::test_gpt_moe_",
+    "test_models_gpt.py::test_adam_int8_moments_train",
+    "test_models_gpt.py::test_int8_moments_on_sharded_mesh",
+    "test_models_gpt.py::test_adam_selective_q8",
+    # distributed: multi-device trainings/parities (collectives/topology/
+    # mesh math/bubble accounting stay)
+    "test_distributed.py::TestTensorParallel::test_tp_training_matches",
+    "test_distributed.py::TestSharding::test_group_sharded_stage3",
+    "test_distributed.py::TestRecompute",
+    "test_distributed.py::TestPipeline::test_pipeline_parallel_train_batch",
+    "test_distributed.py::TestStackedPipelineGPT",
+    "test_distributed.py::TestInterleavedPipelineGPT::test_interleaved_loss",
+    "test_distributed.py::TestInterleavedPipelineGPT::test_fleet_interleave",
+    # launch CLI: subprocess spawns (store + one basic launch stay)
+    "test_launch_elastic.py::test_launch_restarts",
+    "test_launch_elastic.py::test_launch_fails_without",
+    "test_launch_elastic.py::test_launch_jax_distributed",
+    "test_launch_elastic.py::test_launch_multihost",
+    "test_launch_elastic.py::test_launch_rpc_mode",
+    # hapi/moe/sp/nn trainings
+    "test_hapi.py::test_fit_evaluate_predict",
+    "test_hapi.py::test_model_fit_fused_step",
+    "test_hapi.py::test_early_stopping_saves_best",
+    "test_moe_incubate.py::TestMoE::test_switch_router_learns",
+    "test_moe_incubate.py::TestMoE::test_moe_model_trains",
+    "test_moe_incubate.py::TestMoE::test_ep_mesh_parity",
+    "test_moe_incubate.py::TestFusedLayers::test_encoder_layer_and_stack",
+    "test_moe_incubate.py::TestFusedLayers::test_multi_transformer_cached",
+    "test_moe_incubate.py::TestIncubateOptimizers::test_distributed_fused",
+    "test_sequence_parallel.py::test_sp_attention_matches_dense",
+    "test_sequence_parallel.py::test_gpt_step_with_sp_axis",
+    "test_nn_extras.py::test_conv2d_transpose_matches_numpy_scatter",
+    "test_nn_extras.py::test_pool3d_and_adaptive",
+    "test_dgc.py::TestDGC::test_training_converges",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if any(p in item.nodeid for p in _HEAVY_PATTERNS):
+            item.add_marker(pytest.mark.heavy)
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-minute tests (subprocess clusters, detector "
