@@ -107,7 +107,13 @@ class ViTBlock(Layer):
             q = _mesh.shard_constraint(q, "dp", None, "mp", None)
             k = _mesh.shard_constraint(k, "dp", None, "mp", None)
             v = _mesh.shard_constraint(v, "dp", None, "mp", None)
-            o = functional_attention(q, k, v, is_causal=False)
+            # bf16 models store the S×S scores in bf16 (f32 accumulation
+            # stays inside the dots/softmax stats): halves the dominant
+            # O(S²) HBM traffic of the XLA path — measured +5 MFU points
+            # on ViT-L/16 B=32 v5e. A head-major inline variant and a
+            # padded-flash route both measured NO better at S=197.
+            o = functional_attention(q, k, v, is_causal=False,
+                                     score_dtype=q.dtype)
             return _mesh.shard_constraint(o, "dp", None, "mp", None)
 
         ctx = apply_op("vit_attention", attend, [qkv])
@@ -180,6 +186,10 @@ class VisionTransformer(Layer):
         x = ops.concat([cls, x], axis=1) + self.pos_embed
         if self.training and self.config.hidden_dropout:
             x = self.dropout(x)
+        # Measured dead end (r3, v5e): flattening the residual stream to
+        # [B*S, H] for the whole encoder is ~7% SLOWER end-to-end (45.0%
+        # vs 48.4% MFU) — cleaner LN layouts, but XLA re-materializes
+        # attention-side transposes at every 2D<->4D boundary.
         for blk in self.blocks:
             x = blk(x)
         x = self.ln(x)

@@ -38,26 +38,47 @@ def _use_pallas(q_shape, head_dim):
 
 
 def attention_reference(q, k, v, mask=None, is_causal=False, scale=None,
-                        dropout_p=0.0, dropout_key=None):
-    """Reference jnp attention on [B, S, H, D]; fp32 softmax accumulation."""
+                        dropout_p=0.0, dropout_key=None, score_dtype=None):
+    """Reference jnp attention on [B, S, H, D]; fp32 softmax accumulation.
+
+    score_dtype: dtype the S×S logit/probability arrays take in HBM.
+    Default float32 (exact). Passing the model dtype (bf16) HALVES the
+    dominant O(S²) memory traffic of this path — the QK dot still
+    accumulates in f32 and the softmax max/sum run in f32; only the stored
+    logits/probs round to bf16 (same numerics class as bf16 weights).
+    Measured on v5e ViT-L/16 B=32: the f32 score arrays are ~320 MB/layer
+    of traffic, the single largest non-matmul cost of the XLA path."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     dt = q.dtype
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    sdt = jnp.dtype(score_dtype) if score_dtype is not None else jnp.float32
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    logits = (logits * scale).astype(sdt)
+    neg = jnp.asarray(-1e30 if sdt == jnp.float32 else -3e38, sdt)
     if is_causal:
         s_q, s_k = logits.shape[-2], logits.shape[-1]
         cmask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
-        logits = jnp.where(cmask, logits, jnp.asarray(-1e30, logits.dtype))
+        logits = jnp.where(cmask, logits, neg)
     if mask is not None:
         if mask.dtype == jnp.bool_:
-            logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
+            logits = jnp.where(mask, logits, neg)
         else:
-            logits = logits + mask.astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)
+            logits = (logits.astype(jnp.float32)
+                      + mask.astype(jnp.float32)).astype(sdt)
+    if sdt == jnp.float32:
+        probs = jax.nn.softmax(logits, axis=-1)
+    else:
+        m = jnp.max(logits.astype(jnp.float32), axis=-1, keepdims=True)
+        p = jnp.exp(logits.astype(jnp.float32) - m).astype(sdt)
+        l = jnp.sum(p.astype(jnp.float32), axis=-1, keepdims=True)
+        probs = (p.astype(jnp.float32) / l).astype(sdt)
     if dropout_p > 0.0 and dropout_key is not None:
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
-        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(dt), v)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros((), probs.dtype))
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(dt), v,
+                      preferred_element_type=jnp.float32).astype(dt)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
@@ -82,13 +103,37 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     return apply_op("sdpa", fn, [query, key, value])
 
 
-def functional_attention(q, k, v, *, is_causal=False, scale=None, mask=None):
+def functional_attention(q, k, v, *, is_causal=False, scale=None, mask=None,
+                         score_dtype=None):
     """Pure-array attention for jitted model code: picks flash kernel on TPU,
     reference path elsewhere. Differentiable in both cases. An explicit mask
     (bool keep-mask or additive float, broadcastable to [B,H,Sq,Sk]) forces
     the reference path."""
-    if mask is None and _use_pallas(tuple(q.shape), q.shape[-1]):
+    # (the explicit %128 guard keeps this branch from swallowing odd
+    # sequence lengths when PADDLE_TPU_FLASH=1 forces _use_pallas true —
+    # those must reach the padded kv_len route below)
+    if (mask is None and q.shape[1] % 128 == 0
+            and _use_pallas(tuple(q.shape), q.shape[-1])):
         from .pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=is_causal, scale=scale)
+    # Padded-flash path: self-attention with an odd sequence length
+    # zero-pads q/k/v up to the 128-row block boundary and masks padded
+    # KEYS inside the kernel (kv_len). Padded q rows compute garbage that
+    # is sliced off; their cotangent is zero so dk/dv stay exact.
+    # Threshold: measured on v5e, at ViT scale (S=197) the pad/transpose
+    # overhead LOSES to XLA's O(S²) path (40% vs 48% MFU end-to-end), so
+    # the route only opens where the S² term dominates (S >= 512).
+    s = q.shape[1]
+    pad = (-s) % 128
+    if (mask is None and not is_causal and pad and s >= 512
+            and q.shape[1] == k.shape[1]
+            and _use_pallas((q.shape[0], s + pad) + tuple(q.shape[2:]),
+                            q.shape[-1])):
+        from .pallas.flash_attention import flash_attention
+        cfg = [(0, 0), (0, pad), (0, 0), (0, 0)]
+        out = flash_attention(jnp.pad(q, cfg), jnp.pad(k, cfg),
+                              jnp.pad(v, cfg), causal=False, scale=scale,
+                              kv_len=s)
+        return out[:, :s]
     return attention_reference(q, k, v, mask=mask, is_causal=is_causal,
-                               scale=scale)
+                               scale=scale, score_dtype=score_dtype)

@@ -49,9 +49,19 @@ def _causal_mask(s, qi, ki, bq, bk):
     return jnp.where(q_idx >= k_idx, s, jnp.asarray(_NEG, s.dtype))
 
 
+def _kv_mask(s, ki, bk, kv_len):
+    """Mask key columns with global index >= kv_len (static padding mask).
+
+    Lets callers with ragged/odd sequence lengths (e.g. ViT's 197 tokens)
+    zero-pad K/V up to the 128-row block boundary: padded columns score
+    -inf, so exp() gives them zero probability and zero dk/dv."""
+    k_idx = ki * bk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(k_idx < kv_len, s, jnp.asarray(_NEG, s.dtype))
+
+
 # ------------------------------------------------------------------ forward
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
-                *, scale, causal, n_kb):
+                *, scale, causal, n_kb, kv_len=None):
     qi, ki = pl.program_id(1), pl.program_id(2)
     bq = q_ref.shape[1]
     bk = k_ref.shape[1]
@@ -73,6 +83,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, ki, bq, bk)
+        if kv_len is not None:
+            s = _kv_mask(s, ki, bk, kv_len)
         m_prev, l_prev = m_sc[...], l_sc[...]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -90,7 +102,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
                                       (8, bq))
 
 
-def _flash_fwd(q, k, v, *, scale, causal, bq, bk, interpret):
+def _flash_fwd(q, k, v, *, scale, causal, bq, bk, interpret, kv_len=None):
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     qt = jnp.moveaxis(q, 2, 1).reshape(b * h, s_q, d)
@@ -99,7 +111,8 @@ def _flash_fwd(q, k, v, *, scale, causal, bq, bk, interpret):
     n_kb = s_k // bk
 
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal, n_kb=n_kb),
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, n_kb=n_kb,
+                          kv_len=kv_len),
         out_shape=(jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
                    jax.ShapeDtypeStruct((b * h, 8, s_q), jnp.float32)),
         grid=(b * h, s_q // bq, n_kb),
@@ -120,7 +133,7 @@ def _flash_fwd(q, k, v, *, scale, causal, bq, bk, interpret):
 
 # ----------------------------------------------------------------- backward
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_sc, *, scale, causal, n_kb):
+                   dq_sc, *, scale, causal, n_kb, kv_len=None):
     qi, ki = pl.program_id(1), pl.program_id(2)
     bq = q_ref.shape[1]
     bk = k_ref.shape[1]
@@ -142,6 +155,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, ki, bq, bk)
+        if kv_len is not None:
+            s = _kv_mask(s, ki, bk, kv_len)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(k.dtype)
@@ -153,7 +168,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_sc, dv_sc, *, scale, causal, n_qb):
+                    dk_ref, dv_ref, dk_sc, dv_sc, *, scale, causal, n_qb,
+                    kv_len=None):
     ki, qi = pl.program_id(1), pl.program_id(2)
     bk = k_ref.shape[1]
     bq = q_ref.shape[1]
@@ -176,6 +192,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, ki, bq, bk)
+        if kv_len is not None:
+            s = _kv_mask(s, ki, bk, kv_len)
         p = jnp.exp(s - lse)
         pt = p.astype(do.dtype)
         dv_sc[...] += jnp.dot(pt.T, do, preferred_element_type=jnp.float32)
@@ -189,7 +207,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, *, scale, causal, bq, bk, interpret):
+def _flash_bwd(res, g, *, scale, causal, bq, bk, interpret, kv_len=None):
     qt, kt, vt, out, lse = res
     bh, s_q, d = qt.shape
     s_k = kt.shape[1]
@@ -201,7 +219,7 @@ def _flash_bwd(res, g, *, scale, causal, bq, bk, interpret):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          n_kb=n_kb),
+                          n_kb=n_kb, kv_len=kv_len),
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), qt.dtype),
         grid=(bh, n_qb, n_kb),
         in_specs=[
@@ -219,7 +237,7 @@ def _flash_bwd(res, g, *, scale, causal, bq, bk, interpret):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          n_qb=n_qb),
+                          n_qb=n_qb, kv_len=kv_len),
         out_shape=(jax.ShapeDtypeStruct((bh, s_k, d), kt.dtype),
                    jax.ShapeDtypeStruct((bh, s_k, d), vt.dtype)),
         grid=(bh, n_kb, n_qb),
@@ -241,17 +259,17 @@ def _flash_bwd(res, g, *, scale, causal, bq, bk, interpret):
 
 
 # ------------------------------------------------------------- custom_vjp
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, bq, bk, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, bq, bk, interpret, kv_len=None):
     out, _, _ = _flash_fwd(q, k, v, scale=scale, causal=causal, bq=bq, bk=bk,
-                           interpret=interpret)
+                           interpret=interpret, kv_len=kv_len)
     b, s_q, h, d = q.shape
     return jnp.moveaxis(out.reshape(b, h, s_q, d), 1, 2)
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret):
+def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret, kv_len=None):
     out, lse, _ = _flash_fwd(q, k, v, scale=scale, causal=causal,
-                             bq=bq, bk=bk, interpret=interpret)
+                             bq=bq, bk=bk, interpret=interpret, kv_len=kv_len)
     b, s_q, h, d = q.shape
     o = jnp.moveaxis(out.reshape(b, h, s_q, d), 1, 2)
     # residuals: the ORIGINAL layouts (alias the layer's live tensors) — the
@@ -261,14 +279,15 @@ def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret):
     return o, (q, k, v, out, lse, (b, h))
 
 
-def _flash_vjp_bwd(scale, causal, bq, bk, interpret, res, g):
+def _flash_vjp_bwd(scale, causal, bq, bk, interpret, kv_len, res, g):
     q, k, v, out, lse, (b, h) = res
     d = q.shape[-1]
     qt = jnp.moveaxis(q, 2, 1).reshape(b * h, q.shape[1], d)
     kt = jnp.moveaxis(k, 2, 1).reshape(b * h, k.shape[1], d)
     vt = jnp.moveaxis(v, 2, 1).reshape(b * h, v.shape[1], d)
     dq, dk, dv = _flash_bwd((qt, kt, vt, out, lse), g, scale=scale,
-                            causal=causal, bq=bq, bk=bk, interpret=interpret)
+                            causal=causal, bq=bq, bk=bk, interpret=interpret,
+                            kv_len=kv_len)
     s_q, s_k, d = dq.shape[1], dk.shape[1], dq.shape[2]
     dq = jnp.moveaxis(dq.reshape(b, h, s_q, d), 1, 2)
     dk = jnp.moveaxis(dk.reshape(b, h, s_k, d), 1, 2)
@@ -286,11 +305,17 @@ def _reference(q, k, v, *, scale, causal):
 
 def flash_attention(q, k, v, causal: bool = False, scale=None,
                     block_q: int = None, block_k: int = None,
-                    interpret: bool = False):
-    """Differentiable flash attention on [B, S, H, D] arrays."""
+                    interpret: bool = False, kv_len: int = None):
+    """Differentiable flash attention on [B, S, H, D] arrays.
+
+    kv_len: static number of VALID key/value rows; rows >= kv_len (zero
+    padding up to the block boundary) receive -inf scores in forward and
+    backward, so their probability and dk/dv are exactly zero."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     s_q, s_k = q.shape[1], k.shape[1]
+    if kv_len is not None and kv_len >= s_k:
+        kv_len = None
     import os
     from . import autotune as _at0
     if block_q is None and block_k is None and _at0._OVERRIDE is not None:
@@ -328,6 +353,11 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
     while s_k % bk:
         bk //= 2
     if bq < 8 or bk < 8:
+        if kv_len is not None:
+            from ..attention import attention_reference
+            kmask = (jnp.arange(s_k) < kv_len)[None, None, None, :]
+            return attention_reference(q, k, v, mask=kmask, is_causal=causal,
+                                       scale=scale)
         return _reference(q, k, v, scale=scale, causal=causal)
     d = q.shape[-1]
     pad = (-d) % 128
@@ -337,5 +367,5 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
         k = jnp.pad(k, cfg)
         v = jnp.pad(v, cfg)
     out = _flash(q, k, v, float(scale), bool(causal), int(bq), int(bk),
-                 bool(interpret))
+                 bool(interpret), None if kv_len is None else int(kv_len))
     return out[..., :d] if pad else out
