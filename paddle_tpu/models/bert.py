@@ -149,11 +149,15 @@ class BertAttention(Layer):
                     mask = mask[:, None, None, :]          # additive [B,Sk]
                 else:
                     mask = (mask > 0)[:, None, None, :]    # 0/1 keep [B,Sk]
+            # bf16 models store the S×S scores in bf16 (f32 accumulation
+            # in the dots and softmax stats — see attention_reference):
+            # at S=512 the f32 score arrays are ~400 MB per materialization
             if attn_p == 0.0:
-                o = functional_attention(q, k, v, is_causal=False, mask=mask)
+                o = functional_attention(q, k, v, is_causal=False, mask=mask,
+                                         score_dtype=q.dtype)
             else:
                 o = attention_reference(q, k, v, mask=mask, dropout_p=attn_p,
-                                        dropout_key=dk)
+                                        dropout_key=dk, score_dtype=q.dtype)
             return _mesh.shard_constraint(o, "dp", "sp", "mp", None)
 
         ctx = apply_op("bert_attention", attend, tensor_args)

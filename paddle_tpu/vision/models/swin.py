@@ -85,24 +85,29 @@ class WindowAttention(Layer):
         drop_key = _random.split_key() if p_drop > 0.0 else None
 
         def attend(a, table):
+            from ...ops.attention import attention_reference
             bnw = a.shape[0]
             a = a.reshape(bnw, n, 3, nh, hd)
             q, k, v = a[:, :, 0], a[:, :, 1], a[:, :, 2]
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-            logits = logits * scale
+            # relative-position bias (+ shift mask) fold into ONE additive
+            # mask for attention_reference, which owns the mixed-precision
+            # softmax: score_dtype=model dtype stores the [BnW, nh, N, N]
+            # logits/probs in bf16 (f32 dot accumulation + f32 stats) —
+            # windows are tiny but BnW is huge, so score traffic dominates
             bias = table[rel_index.reshape(-1)].reshape(n, n, nh)
-            logits = logits + bias.transpose(2, 0, 1).astype(jnp.float32)[None]
+            add = bias.transpose(2, 0, 1)[None].astype(jnp.float32)
             if mask is not None:
                 nw = mask.shape[0]
-                m = jnp.asarray(mask)[None, :, None]           # [1, nW, 1, N, N]
-                logits = (logits.reshape(bnw // nw, nw, nh, n, n) + m
-                          ).reshape(bnw, nh, n, n)
-            probs = jax.nn.softmax(logits, axis=-1)
-            if drop_key is not None:
-                keep = jax.random.bernoulli(drop_key, 1.0 - p_drop, probs.shape)
-                probs = jnp.where(keep, probs / (1.0 - p_drop), 0.0)
-            return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v
-                              ).reshape(bnw, n, nh * hd)
+                m = jnp.asarray(mask)[:, None]                 # [nW, 1, N, N]
+                # broadcast+reshape (not tile): stays a lazy broadcast for
+                # XLA to fuse into the logits+mask addition
+                add = jnp.broadcast_to((add + m)[None],
+                                       (bnw // nw, nw, nh, n, n))
+                add = add.reshape(bnw, nh, n, n)
+            o = attention_reference(q, k, v, mask=add, scale=scale,
+                                    dropout_p=p_drop, dropout_key=drop_key,
+                                    score_dtype=a.dtype)
+            return o.reshape(bnw, n, nh * hd)
 
         ctx = apply_op("swin_window_attention", attend,
                        [qkv, self.rel_bias_table])

@@ -212,13 +212,16 @@ def test_functional_attention_padded_flash_route(monkeypatch):
     monkeypatch.setenv("PADDLE_TPU_FLASH", "1")
     import paddle_tpu.ops.pallas.flash_attention as FA
     orig = FA.flash_attention
+    calls = []
 
     def interp_flash(*a, **kw):
+        calls.append(kw.get("kv_len"))
         kw["interpret"] = True
         return orig(*a, **kw)
 
     monkeypatch.setattr(FA, "flash_attention", interp_flash)
     got = A.functional_attention(q, k, v)
+    assert calls == [520], f"padded flash route not taken: {calls}"
     assert got.shape == q.shape
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
