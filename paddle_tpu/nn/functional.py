@@ -499,6 +499,26 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
     n_axes = len(tuple(normalized_shape))
     axes = tuple(builtins.range(_arr(x).ndim - n_axes, _arr(x).ndim))
 
+    if n_axes == 1:
+        # opt-in Pallas fused LN (PADDLE_TPU_FUSED_LN=1): single HBM pass
+        # per direction. Measured neutral-to-slower than XLA's autodiff on
+        # the v5e bench chip (see ops/pallas/layer_norm.py docstring), so
+        # the XLA formulation stays the default.
+        from ..ops.pallas.layer_norm import (fused_layer_norm,
+                                             fused_layer_norm_supported)
+        xs = tuple(_arr(x).shape)
+        if fused_layer_norm_supported(xs, xs[-1]):
+            def ffn(a, *wb):
+                i = 0
+                g = bb = None
+                if weight is not None:
+                    g = wb[i]; i += 1
+                if bias is not None:
+                    bb = wb[i]
+                return fused_layer_norm(a, g, bb, eps=epsilon)
+            args = [x] + [t for t in (weight, bias) if t is not None]
+            return apply_op("layer_norm", ffn, args)
+
     def fn(a, *wb):
         mu = a.mean(axis=axes, keepdims=True)
         var = ((a - mu) ** 2).mean(axis=axes, keepdims=True)

@@ -259,32 +259,46 @@ def _flash_bwd(res, g, *, scale, causal, bq, bk, interpret, kv_len=None):
 
 
 # ------------------------------------------------------------- custom_vjp
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, scale, causal, bq, bk, interpret, kv_len=None):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, scale, causal, bq, bk, interpret, kv_len=None,
+           save_transposed=False):
     out, _, _ = _flash_fwd(q, k, v, scale=scale, causal=causal, bq=bq, bk=bk,
                            interpret=interpret, kv_len=kv_len)
     b, s_q, h, d = q.shape
     return jnp.moveaxis(out.reshape(b, h, s_q, d), 1, 2)
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret, kv_len=None):
-    out, lse, _ = _flash_fwd(q, k, v, scale=scale, causal=causal,
-                             bq=bq, bk=bk, interpret=interpret, kv_len=kv_len)
+def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret, kv_len=None,
+                   save_transposed=False):
+    out, lse, (qt, kt, vt) = _flash_fwd(q, k, v, scale=scale, causal=causal,
+                                        bq=bq, bk=bk, interpret=interpret,
+                                        kv_len=kv_len)
     b, s_q, h, d = q.shape
     o = jnp.moveaxis(out.reshape(b, h, s_q, d), 1, 2)
-    # residuals: the ORIGINAL layouts (alias the layer's live tensors) — the
-    # [b*h, s, d] transposes are recomputed in bwd, saving 3 head-major
+    if save_transposed:
+        # residuals: the HEAD-MAJOR [b*h, s, d] copies the forward already
+        # built — backward reuses them instead of re-transposing, saving 3
+        # layout passes per layer (~20 ms/step on the 1.3B flagship at the
+        # measured ~180 GB/s effective HBM bw) at +3·B·S·H·2B residual
+        # memory. Right when HBM has headroom; wrong near the remat knee.
+        return o, (qt, kt, vt, out, lse, (b, h))
+    # default residuals: the ORIGINAL layouts (alias the layer's live
+    # tensors) — the transposes are recomputed in bwd, saving 3 head-major
     # copies of q/k/v in HBM across the whole backward (~100MB at 1.3B
     # S=8192; the difference between fitting bf16 moments and OOM)
     return o, (q, k, v, out, lse, (b, h))
 
 
-def _flash_vjp_bwd(scale, causal, bq, bk, interpret, kv_len, res, g):
+def _flash_vjp_bwd(scale, causal, bq, bk, interpret, kv_len, save_transposed,
+                   res, g):
     q, k, v, out, lse, (b, h) = res
     d = q.shape[-1]
-    qt = jnp.moveaxis(q, 2, 1).reshape(b * h, q.shape[1], d)
-    kt = jnp.moveaxis(k, 2, 1).reshape(b * h, k.shape[1], d)
-    vt = jnp.moveaxis(v, 2, 1).reshape(b * h, v.shape[1], d)
+    if save_transposed:
+        qt, kt, vt = q, k, v
+    else:
+        qt = jnp.moveaxis(q, 2, 1).reshape(b * h, q.shape[1], d)
+        kt = jnp.moveaxis(k, 2, 1).reshape(b * h, k.shape[1], d)
+        vt = jnp.moveaxis(v, 2, 1).reshape(b * h, v.shape[1], d)
     dq, dk, dv = _flash_bwd((qt, kt, vt, out, lse), g, scale=scale,
                             causal=causal, bq=bq, bk=bk, interpret=interpret,
                             kv_len=kv_len)
@@ -305,12 +319,18 @@ def _reference(q, k, v, *, scale, causal):
 
 def flash_attention(q, k, v, causal: bool = False, scale=None,
                     block_q: int = None, block_k: int = None,
-                    interpret: bool = False, kv_len: int = None):
+                    interpret: bool = False, kv_len: int = None,
+                    save_transposed: bool = None):
     """Differentiable flash attention on [B, S, H, D] arrays.
 
     kv_len: static number of VALID key/value rows; rows >= kv_len (zero
     padding up to the block boundary) receive -inf scores in forward and
-    backward, so their probability and dk/dv are exactly zero."""
+    backward, so their probability and dk/dv are exactly zero.
+
+    save_transposed: keep the forward's head-major q/k/v copies as
+    backward residuals (saves 3 re-transpose passes per layer) at the cost
+    of 3·B·S·H·2 bytes of residual memory. Default: env
+    PADDLE_TPU_FLASH_SAVE_T ("1"/"0"), else False (memory-lean)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     s_q, s_k = q.shape[1], k.shape[1]
@@ -366,6 +386,338 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
         q = jnp.pad(q, cfg)
         k = jnp.pad(k, cfg)
         v = jnp.pad(v, cfg)
+    if save_transposed is None:
+        save_transposed = os.environ.get("PADDLE_TPU_FLASH_SAVE_T") == "1"
     out = _flash(q, k, v, float(scale), bool(causal), int(bq), int(bk),
-                 bool(interpret), None if kv_len is None else int(kv_len))
+                 bool(interpret), None if kv_len is None else int(kv_len),
+                 bool(save_transposed))
     return out[..., :d] if pad else out
+
+
+# ----------------------------------------------------- packed-layout kernel
+# The [B, S, H, D] kernel above needs head-major [B*H, S, D] copies of
+# q/k/v (and of dq/dk/dv/out on the way back) — ~11 layout passes per layer
+# that cost ~85 ms/step on the GPT-1.3B flagship at the measured ~180 GB/s
+# effective HBM bandwidth (r3 profile). This variant consumes the
+# projection output DIRECTLY: q/k/v stay [B, S, H·D] (lane-contiguous),
+# the grid is (B, q_block, k_block), and heads are a compile-time loop of
+# 128-lane slices inside the kernel — zero transposes in fwd OR bwd.
+# Requires head_dim == 128 (lane-tile-aligned slices): true for GPT-1.3B
+# and GPT-6.7B (2048/16, 4096/32).
+
+def _p_slice(ref0, h, hd):
+    return ref0[:, h * hd:(h + 1) * hd]
+
+
+def _packed_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc,
+                       acc_sc, *, scale, causal, n_kb, nh, hd, kv_len=None):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    needed = True if not causal else (ki * bk <= (qi + 1) * bq - 1)
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        for h in range(nh):
+            s = jnp.dot(_p_slice(q, h, hd), _p_slice(k, h, hd).T,
+                        preferred_element_type=jnp.float32) * scale
+            if causal:
+                s = _causal_mask(s, qi, ki, bq, bk)
+            if kv_len is not None:
+                s = _kv_mask(s, ki, bk, kv_len)
+            m_prev = m_sc[:, h:h + 1]
+            l_prev = l_sc[:, h:h + 1]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            m_sc[:, h:h + 1] = m_new
+            l_sc[:, h:h + 1] = corr * l_prev + p.sum(axis=-1, keepdims=True)
+            acc_sc[:, h * hd:(h + 1) * hd] = (
+                corr * acc_sc[:, h * hd:(h + 1) * hd]
+                + jnp.dot(p.astype(v.dtype), _p_slice(v, h, hd),
+                          preferred_element_type=jnp.float32))
+
+    @pl.when(ki == n_kb - 1)
+    def _finish():
+        l = jnp.maximum(l_sc[...], 1e-30)                    # (bq, nh)
+        lhd = jnp.repeat(l, hd, axis=1)                      # (bq, nh*hd)
+        o_ref[0] = (acc_sc[...] / lhd).astype(o_ref.dtype)
+        lse = m_sc[...] + jnp.log(l)                         # (bq, nh)
+        lse_ref[0] = jnp.broadcast_to(
+            lse.T[:, None, :], (nh, 8, bq)).reshape(nh * 8, bq)
+
+
+def _packed_flash_fwd(q, k, v, *, scale, causal, bq, bk, interpret, nh,
+                      kv_len=None):
+    b, s_q, H = q.shape
+    s_k = k.shape[1]
+    hd = H // nh
+    n_kb = s_k // bk
+
+    out, lse = pl.pallas_call(
+        functools.partial(_packed_fwd_kernel, scale=scale, causal=causal,
+                          n_kb=n_kb, nh=nh, hd=hd, kv_len=kv_len),
+        out_shape=(jax.ShapeDtypeStruct((b, s_q, H), q.dtype),
+                   jax.ShapeDtypeStruct((b, nh * 8, s_q), jnp.float32)),
+        grid=(b, s_q // bq, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, bq, H), lambda bi, qi, ki: (bi, qi, _i0())),
+            pl.BlockSpec((1, bk, H), lambda bi, qi, ki: (bi, ki, _i0())),
+            pl.BlockSpec((1, bk, H), lambda bi, qi, ki: (bi, ki, _i0())),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bq, H), lambda bi, qi, ki: (bi, qi, _i0())),
+            pl.BlockSpec((1, nh * 8, bq), lambda bi, qi, ki: (bi, _i0(), qi)),
+        ),
+        scratch_shapes=[pltpu.VMEM((bq, nh), jnp.float32),
+                        pltpu.VMEM((bq, nh), jnp.float32),
+                        pltpu.VMEM((bq, H), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _packed_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dq_ref, dq_sc, *, scale, causal, n_kb, nh, hd,
+                          kv_len=None):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    needed = True if not causal else (ki * bk <= (qi + 1) * bq - 1)
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse_all = lse_ref[0].reshape(nh, 8, bq)
+        delta_all = delta_ref[0].reshape(nh, 8, bq)
+        for h in range(nh):
+            s = jnp.dot(_p_slice(q, h, hd), _p_slice(k, h, hd).T,
+                        preferred_element_type=jnp.float32) * scale
+            if causal:
+                s = _causal_mask(s, qi, ki, bq, bk)
+            if kv_len is not None:
+                s = _kv_mask(s, ki, bk, kv_len)
+            lse = lse_all[h, 0][:, None]
+            delta = delta_all[h, 0][:, None]
+            p = jnp.exp(s - lse)
+            dp = jnp.dot(_p_slice(do, h, hd), _p_slice(v, h, hd).T,
+                         preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta)).astype(k.dtype)
+            dq_sc[:, h * hd:(h + 1) * hd] += jnp.dot(
+                ds, _p_slice(k, h, hd), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_kb - 1)
+    def _finish():
+        dq_ref[0] = (dq_sc[...] * scale).astype(dq_ref.dtype)
+
+
+def _packed_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, dk_sc, dv_sc, *, scale, causal,
+                           n_qb, nh, hd, kv_len=None):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    bk = k_ref.shape[1]
+    bq = q_ref.shape[1]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    needed = True if not causal else ((qi + 1) * bq - 1 >= ki * bk)
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse_all = lse_ref[0].reshape(nh, 8, bq)
+        delta_all = delta_ref[0].reshape(nh, 8, bq)
+        for h in range(nh):
+            s = jnp.dot(_p_slice(q, h, hd), _p_slice(k, h, hd).T,
+                        preferred_element_type=jnp.float32) * scale
+            if causal:
+                s = _causal_mask(s, qi, ki, bq, bk)
+            if kv_len is not None:
+                s = _kv_mask(s, ki, bk, kv_len)
+            lse = lse_all[h, 0][:, None]
+            delta = delta_all[h, 0][:, None]
+            p = jnp.exp(s - lse)
+            pt = p.astype(do.dtype)
+            dv_sc[:, h * hd:(h + 1) * hd] += jnp.dot(
+                pt.T, _p_slice(do, h, hd),
+                preferred_element_type=jnp.float32)
+            dp = jnp.dot(_p_slice(do, h, hd), _p_slice(v, h, hd).T,
+                         preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta)).astype(q.dtype)
+            dk_sc[:, h * hd:(h + 1) * hd] += jnp.dot(
+                ds.T, _p_slice(q, h, hd),
+                preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_qb - 1)
+    def _finish():
+        dk_ref[0] = (dk_sc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
+
+
+def _packed_flash_bwd(q, k, v, out, lse, g, *, scale, causal, bq, bk,
+                      interpret, nh, kv_len=None):
+    b, s_q, H = q.shape
+    s_k = k.shape[1]
+    hd = H // nh
+    # backward kernels hold 2x f32 accumulator panels (bk, H) — clamp their
+    # blocks to fit the 16M scoped-VMEM budget independently of the
+    # forward's (the fwd carries only ONE panel and can afford 512);
+    # re-establish divisibility after the clamp or the grid under-covers
+    # the sequence and uncovered gradient rows come back as garbage
+    bq = min(bq, 256)
+    bk = min(bk, 256)
+    while s_q % bq:
+        bq //= 2
+    while s_k % bk:
+        bk //= 2
+    n_kb = s_k // bk
+    n_qb = s_q // bq
+    # delta = rowsum(dO . O) per head: [B, S, nh] -> [B, nh*8, S]
+    delta = jnp.sum((g.astype(jnp.float32) * out.astype(jnp.float32))
+                    .reshape(b, s_q, nh, hd), axis=-1)       # [B, S, nh]
+    delta = jnp.broadcast_to(jnp.moveaxis(delta, 1, 2)[:, :, None, :],
+                             (b, nh, 8, s_q)).reshape(b, nh * 8, s_q)
+
+    dq = pl.pallas_call(
+        functools.partial(_packed_bwd_dq_kernel, scale=scale, causal=causal,
+                          n_kb=n_kb, nh=nh, hd=hd, kv_len=kv_len),
+        out_shape=jax.ShapeDtypeStruct((b, s_q, H), q.dtype),
+        grid=(b, n_qb, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, bq, H), lambda bi, qi, ki: (bi, qi, _i0())),
+            pl.BlockSpec((1, bk, H), lambda bi, qi, ki: (bi, ki, _i0())),
+            pl.BlockSpec((1, bk, H), lambda bi, qi, ki: (bi, ki, _i0())),
+            pl.BlockSpec((1, bq, H), lambda bi, qi, ki: (bi, qi, _i0())),
+            pl.BlockSpec((1, nh * 8, bq), lambda bi, qi, ki: (bi, _i0(), qi)),
+            pl.BlockSpec((1, nh * 8, bq), lambda bi, qi, ki: (bi, _i0(), qi)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, H), lambda bi, qi, ki: (bi, qi, _i0())),
+        scratch_shapes=[pltpu.VMEM((bq, H), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_packed_bwd_dkv_kernel, scale=scale, causal=causal,
+                          n_qb=n_qb, nh=nh, hd=hd, kv_len=kv_len),
+        out_shape=(jax.ShapeDtypeStruct((b, s_k, H), k.dtype),
+                   jax.ShapeDtypeStruct((b, s_k, H), v.dtype)),
+        grid=(b, n_kb, n_qb),
+        in_specs=[
+            pl.BlockSpec((1, bq, H), lambda bi, ki, qi: (bi, qi, _i0())),
+            pl.BlockSpec((1, bk, H), lambda bi, ki, qi: (bi, ki, _i0())),
+            pl.BlockSpec((1, bk, H), lambda bi, ki, qi: (bi, ki, _i0())),
+            pl.BlockSpec((1, bq, H), lambda bi, ki, qi: (bi, qi, _i0())),
+            pl.BlockSpec((1, nh * 8, bq), lambda bi, ki, qi: (bi, _i0(), qi)),
+            pl.BlockSpec((1, nh * 8, bq), lambda bi, ki, qi: (bi, _i0(), qi)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bk, H), lambda bi, ki, qi: (bi, ki, _i0())),
+            pl.BlockSpec((1, bk, H), lambda bi, ki, qi: (bi, ki, _i0())),
+        ),
+        scratch_shapes=[pltpu.VMEM((bk, H), jnp.float32),
+                        pltpu.VMEM((bk, H), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _packed_flash(q, k, v, nh, scale, causal, bq, bk, interpret, kv_len=None):
+    out, _ = _packed_flash_fwd(q, k, v, scale=scale, causal=causal, bq=bq,
+                               bk=bk, interpret=interpret, nh=nh,
+                               kv_len=kv_len)
+    return out
+
+
+def _packed_vjp_fwd(q, k, v, nh, scale, causal, bq, bk, interpret,
+                    kv_len=None):
+    out, lse = _packed_flash_fwd(q, k, v, scale=scale, causal=causal, bq=bq,
+                                 bk=bk, interpret=interpret, nh=nh,
+                                 kv_len=kv_len)
+    return out, (q, k, v, out, lse)
+
+
+def _packed_vjp_bwd(nh, scale, causal, bq, bk, interpret, kv_len, res, g):
+    q, k, v, out, lse = res
+    dq, dk, dv = _packed_flash_bwd(q, k, v, out, lse, g, scale=scale,
+                                   causal=causal, bq=bq, bk=bk,
+                                   interpret=interpret, nh=nh, kv_len=kv_len)
+    return dq, dk, dv
+
+
+_packed_flash.defvjp(_packed_vjp_fwd, _packed_vjp_bwd)
+
+PACKED_BQ = 256
+PACKED_BK = 256
+
+
+def flash_attention_packed(q, k, v, num_heads: int, causal: bool = False,
+                           scale=None, block_q: int = None,
+                           block_k: int = None, interpret: bool = False,
+                           kv_len: int = None):
+    """Flash attention on PACKED [B, S, num_heads*128] arrays.
+
+    Zero layout transposes: inputs are the projection outputs as-is, and
+    dq/dk/dv come back in the same layout for the projection weight grads.
+    Requires head_dim == 128. Falls back to the [B,S,H,D] kernel via
+    reshape when the shape constraints don't hold.
+
+    Measured on v5e (GPT-1.3B B=3 S=2048): parity with the head-major
+    kernel at best (73.4% vs 73.3-73.7% MFU across block configs) — the
+    ~11 boundary layout passes the packed form eliminates turn out to
+    OVERLAP with MXU work in the XLA schedule, while the in-kernel head
+    loop (16 lane-sliced dots per block, 16M scoped-VMEM ceiling forcing
+    256-row blocks) gives the saving back. Kept as an opt-in
+    (PADDLE_TPU_FLASH_PACKED=1 routes GPT through it) for hardware where
+    the trade lands differently; the head-major kernel stays the default.
+    """
+    b, s_q, H = q.shape
+    hd = H // num_heads
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    s_k = k.shape[1]
+    if kv_len is not None and kv_len >= s_k:
+        kv_len = None
+    bq = block_q or min(PACKED_BQ, s_q)
+    bk = block_k or min(PACKED_BK, s_k)
+    bq = min(bq, s_q)
+    bk = min(bk, s_k)
+    while s_q % bq:
+        bq //= 2
+    while s_k % bk:
+        bk //= 2
+    if hd != 128 or bq < 8 or bk < 8:
+        q4 = q.reshape(b, s_q, num_heads, hd)
+        k4 = k.reshape(b, s_k, num_heads, hd)
+        v4 = v.reshape(b, s_k, num_heads, hd)
+        out = flash_attention(q4, k4, v4, causal=causal, scale=scale,
+                              block_q=block_q, block_k=block_k,
+                              interpret=interpret, kv_len=kv_len)
+        return out.reshape(b, s_q, H)
+    return _packed_flash(q, k, v, int(num_heads), float(scale), bool(causal),
+                         int(bq), int(bk), bool(interpret),
+                         None if kv_len is None else int(kv_len))

@@ -225,3 +225,99 @@ def test_functional_attention_padded_flash_route(monkeypatch):
     assert got.shape == q.shape
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+class TestPackedFlash:
+    """flash_attention_packed: [B, S, nh*128] layout, in-kernel head loop."""
+
+    def _qkv(self, B=2, S=256, NH=2, HD=128, seed=7):
+        rng = np.random.RandomState(seed)
+        H = NH * HD
+        mk = lambda: jnp.asarray(rng.randn(B, S, H).astype(np.float32) * 0.3)
+        return mk(), mk(), mk(), NH, HD
+
+    def _ref(self, q, k, v, nh, hd, causal, kv_len=None):
+        B, S, H = q.shape
+        q4 = q.reshape(B, S, nh, hd)
+        k4 = k.reshape(B, S, nh, hd)
+        v4 = v.reshape(B, S, nh, hd)
+        if kv_len is not None:
+            k4, v4 = k4[:, :kv_len], v4[:, :kv_len]
+        return attention_reference(q4, k4, v4, is_causal=causal,
+                                   scale=1.0 / np.sqrt(hd)).reshape(B, S, H)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_and_grads(self, causal):
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention_packed
+        q, k, v, NH, HD = self._qkv()
+
+        def lf(q, k, v):
+            return jnp.sum(flash_attention_packed(
+                q, k, v, NH, causal=causal, block_q=128, block_k=128,
+                interpret=True) ** 2)
+
+        def lr(q, k, v):
+            return jnp.sum(self._ref(q, k, v, NH, HD, causal) ** 2)
+
+        np.testing.assert_allclose(float(lf(q, k, v)), float(lr(q, k, v)),
+                                   rtol=2e-4)
+        gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+        for a, c, nm in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=2e-3, atol=2e-3,
+                                       err_msg=f"d{nm} causal={causal}")
+
+    def test_kv_len(self):
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention_packed
+        q, k, v, NH, HD = self._qkv()
+        out = flash_attention_packed(q, k, v, NH, block_q=128, block_k=128,
+                                     interpret=True, kv_len=200)
+        want = self._ref(q, k, v, NH, HD, False, kv_len=200)
+        np.testing.assert_allclose(np.asarray(out[:, :200]),
+                                   np.asarray(want[:, :200]),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_head_dim_fallback(self):
+        # hd != 128 falls back to the 4-D kernel path (reference fallback
+        # on CPU since tiles degrade) — shape contract holds
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention_packed
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(1, 64, 2 * 64).astype(np.float32))
+        out = flash_attention_packed(q, q, q, 2, interpret=True)
+        assert out.shape == q.shape
+
+    def test_gpt_routes_through_packed(self, monkeypatch):
+        """PADDLE_TPU_FLASH_PACKED=1 routes GPT training attention through
+        the packed kernel (interpret-mode, tiny config)."""
+        monkeypatch.setenv("PADDLE_TPU_FLASH_PACKED", "1")
+        # the platform gate correctly refuses CPU — stub it for the
+        # interpret-mode routing check
+        import paddle_tpu.models.gpt as G
+        monkeypatch.setattr(G, "_use_packed_flash", lambda: True)
+        import paddle_tpu.ops.pallas.flash_attention as FA
+        calls = []
+        orig = FA.flash_attention_packed
+
+        def spy(*a, **kw):
+            calls.append(a[3] if len(a) > 3 else kw.get("num_heads"))
+            kw["interpret"] = True
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(FA, "flash_attention_packed", spy)
+        import numpy as np_
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.models import GPTForCausalLM, gpt_config
+        paddle.seed(0)
+        cfg = gpt_config("gpt3-125m", hidden_size=256, num_layers=1,
+                         num_heads=2, vocab_size=128,
+                         max_position_embeddings=128)
+        assert cfg.head_dim == 128
+        m = GPTForCausalLM(cfg)
+        ids = paddle.to_tensor(np_.random.randint(0, 128, (1, 128)).astype("int32"))
+        lbl = paddle.to_tensor(np_.random.randint(0, 128, (1, 128)).astype("int64"))
+        loss = m.loss(ids, lbl)
+        loss.backward()
+        assert calls, "packed kernel was not routed to"
+        assert float(loss.numpy()) > 0 and np_.isfinite(float(loss.numpy()))
