@@ -506,8 +506,7 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
         # the XLA formulation stays the default.
         from ..ops.pallas.layer_norm import (fused_layer_norm,
                                              fused_layer_norm_supported)
-        xs = tuple(_arr(x).shape)
-        if fused_layer_norm_supported(xs, xs[-1]):
+        if fused_layer_norm_supported(tuple(_arr(x).shape)):
             def ffn(a, *wb):
                 i = 0
                 g = bb = None

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .flash_attention import _i0  # i32 index-map literal (Mosaic x64 rule)
+
 DEFAULT_BLOCK_ROWS = 256
 
 
@@ -90,10 +92,6 @@ def _pick_block(r):
     while r % bq:
         bq //= 2
     return bq
-
-
-def _i0():
-    return jnp.int32(0)
 
 
 def _ln_fwd(x2, gamma, beta, eps, interpret):
@@ -224,18 +222,25 @@ def fused_layer_norm(x, gamma=None, beta=None, eps: float = 1e-5,
     return out.reshape(x.shape)
 
 
-def fused_layer_norm_supported(x_shape, h):
+def fused_layer_norm_supported(x_shape):
     """Static routing predicate shared with nn.functional.layer_norm.
 
     OPT-IN ONLY (PADDLE_TPU_FUSED_LN=1): on the v5e bench chip XLA's
     autodiff LN measured faster than this kernel (2.8 vs 3.4 ms fwd+bwd on
     [3,2048,2048]) — Mosaic's lowering of the f32 cast + two-axis reduce
     chain doesn't beat the fusion XLA already emits. Kept because the
-    single-pass schedule is the right shape where relative costs differ."""
+    single-pass schedule is the right shape where relative costs differ.
+    The platform gate keeps the env opt-in from routing a CPU host into a
+    Mosaic compile that cannot succeed."""
     import os
     if os.environ.get("PADDLE_TPU_FUSED_LN") != "1":
         return False
-    if h % 128 != 0:
+    try:
+        if jax.devices()[0].platform not in ("tpu", "axon"):
+            return False
+    except RuntimeError:
+        return False
+    if x_shape[-1] % 128 != 0:
         return False
     r = 1
     for d in x_shape[:-1]:
