@@ -143,6 +143,8 @@ def main():
         return bench_bert(on_tpu)
     if which == "vit":
         return bench_vit(on_tpu)
+    if which == "decode":
+        return bench_decode(on_tpu)
     if which == "swin":
         return bench_swin(on_tpu)
 
@@ -293,6 +295,47 @@ def main():
     }))
 
 
+
+
+def bench_decode(on_tpu):
+    """Autoregressive decode throughput via generate_static (ONE compiled
+    program: prefill + lax.scan of fixed-shape KV-cache steps)."""
+    import time
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_config
+
+    if on_tpu:
+        preset, B, p_len, new = "gpt3-1.3b", 8, 128, 128
+    else:
+        preset, B, p_len, new = "gpt3-125m", 2, 16, 16
+    preset = os.environ.get("PADDLE_TPU_BENCH_PRESET", preset)
+    B = int(os.environ.get("PADDLE_TPU_BENCH_B", B))
+    cfg = gpt_config(preset)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, p_len)).astype("int64"))
+    out = model.generate_static(ids, max_new_tokens=new)   # warm compile
+    _ = out.numpy()
+    t0 = time.perf_counter()
+    out = model.generate_static(ids, max_new_tokens=new)
+    _ = out.numpy()
+    dt = time.perf_counter() - t0
+    tps = B * new / dt
+    print(json.dumps({
+        "metric": f"decode tokens/sec/chip ({preset} generate_static, "
+                  f"B={B} prefill={p_len} new={new})",
+        "value": round(tps, 1), "unit": "tokens/s",
+        "vs_baseline": None,
+        "extra": {"ms_per_step": round(dt / new * 1e3, 3),
+                  "ms_per_token": round(dt / (new * B) * 1e3, 3),
+                  "total_s": round(dt, 2)},
+    }))
 
 
 def bench_vit(on_tpu):

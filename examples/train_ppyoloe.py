@@ -21,12 +21,18 @@ import paddle_tpu as paddle
 
 
 def main():
-    from paddle_tpu.vision.models import (yolo_lite, ppyoloe_s, yolo_loss)
+    from paddle_tpu.vision.models import (yolo_lite, ppyoloe_s, ppyoloe_m,
+                                          ppyoloe_l, yolo_loss)
     paddle.seed(0)
     rng = np.random.RandomState(0)
 
+    presets = {"ppyoloe-s": ppyoloe_s, "ppyoloe-m": ppyoloe_m,
+               "ppyoloe-l": ppyoloe_l}
     if len(sys.argv) > 1 and sys.argv[1].startswith("ppyoloe"):
-        model = {"ppyoloe-s": ppyoloe_s}[sys.argv[1]](num_classes=80)
+        if sys.argv[1] not in presets:
+            raise SystemExit(f"unknown preset {sys.argv[1]!r}; "
+                             f"choose from {sorted(presets)}")
+        model = presets[sys.argv[1]](num_classes=80)
         B, H, steps = 8, 640, 20
     else:
         model = yolo_lite(num_classes=3, width=8)

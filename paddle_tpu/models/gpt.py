@@ -124,7 +124,39 @@ class GPTSelfAttention(Layer):
         b, s = qkv.shape[0], qkv.shape[1]
 
         new_cache = None
-        if cache is not None:
+        if cache is not None and len(cache) == 3:
+            # STATIC-cache decode (TPU-native serving path): fixed-size
+            # [B, L_max, nh, hd] buffers + write position — every step has
+            # the same shapes, so the whole generation compiles ONCE
+            # (generate_static). The growing-cache branch below recompiles
+            # per length, which is fine eagerly but ruinous under jit.
+            qkv = ops.reshape(qkv, [b, s, 3, nh, hd])
+            k_buf, v_buf, pos = cache
+            q = qkv[:, :, 0]
+
+            def _upd(buf, new, p):
+                import jax.lax as _lax
+                return _lax.dynamic_update_slice(
+                    buf, new.astype(buf.dtype),
+                    (jnp.int32(0), p.astype(jnp.int32), jnp.int32(0),
+                     jnp.int32(0)))
+
+            k2 = apply_op("static_cache_k", _upd, [k_buf, qkv[:, :, 1], pos])
+            v2 = apply_op("static_cache_v", _upd, [v_buf, qkv[:, :, 2], pos])
+            new_cache = (k2.detach(), v2.detach(), pos + s)
+
+            def _attend_static(qa, ka, va, p):
+                from ..ops.attention import attention_reference
+                L = ka.shape[1]
+                col = jnp.arange(L)[None, None, None, :]
+                row = jnp.arange(qa.shape[1])[None, None, :, None]
+                mask = col <= (p.astype(jnp.int32) + row)
+                return attention_reference(qa, ka, va, mask=mask,
+                                           score_dtype=qa.dtype)
+
+            ctx = apply_op("static_cache_attend", _attend_static,
+                           [q, k2, v2, pos])
+        elif cache is not None:
             # incremental decode: append K/V (reference MultiHeadAttention
             # Cache semantics, nn/layer/transformer.py)
             qkv = ops.reshape(qkv, [b, s, 3, nh, hd])
@@ -296,12 +328,17 @@ class GPTModel(Layer):
 
     def forward(self, input_ids, position_ids=None, caches=None):
         s = input_ids.shape[1]
-        past = caches[0][0].shape[1] if caches else 0
         if position_ids is None:
             # int32: positions fit trivially and i64 gathers are 2x-emulated
             # on TPU (MIGRATION.md "Integer dtypes")
-            position_ids = ops.arange(past, past + s, dtype="int32")
-            position_ids = ops.unsqueeze(position_ids, 0)
+            if caches and len(caches[0]) == 3:
+                # static-cache decode: the write position IS the offset
+                position_ids = ops.unsqueeze(
+                    caches[0][2] + ops.arange(0, s, dtype="int32"), 0)
+            else:
+                past = caches[0][0].shape[1] if caches else 0
+                position_ids = ops.arange(past, past + s, dtype="int32")
+                position_ids = ops.unsqueeze(position_ids, 0)
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = apply_op("act_shard", lambda a: _mesh.shard_constraint(
             a, "dp", "sp", None), [x])
@@ -396,6 +433,88 @@ class GPTForCausalLM(Layer):
         if aux is not None:
             loss = loss + self.config.moe_aux_weight * aux
         return loss
+
+    def generate_static(self, input_ids, max_new_tokens: int = 16,
+                        temperature: float = 0.0, max_len: int = None,
+                        seed: int = 0):
+        """TPU-native generation: static KV-cache buffers + the WHOLE
+        prefill-then-decode loop compiled as ONE XLA program (lax.scan over
+        decode steps). Same outputs as generate() for greedy decoding; the
+        growing-cache generate() retraces at every new length, which is
+        fine eagerly but recompiles per token under jit/serving.
+
+        Capability anchor: the reference serves decode via
+        fused_multi_transformer_op with a fixed CacheKV workspace
+        (operators/fused/fused_multi_transformer_op.cu) — same design:
+        preallocated [B, L_max, nh, hd] caches, write cursor, masked
+        attention over the full buffer."""
+        import jax
+        from jax import lax
+        from ..jit.api import _swap_params, _trace_guard
+        from ..core import autograd
+
+        cfg = self.config
+        ids = input_ids if isinstance(input_ids, Tensor) else Tensor(input_ids)
+        b, p_len = ids.shape
+        L = int(max_len or (p_len + max_new_tokens))
+        assert L >= p_len + max_new_tokens, "max_len too small"
+        params = list(self.parameters())
+        cdt = self.gpt.wte.weight._data.dtype
+        nh, hd, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
+
+        def model_step(pa, tokens, caches):
+            with _trace_guard(), _swap_params(params, list(pa)), \
+                    autograd.no_grad():
+                logits, nc = self.forward(
+                    Tensor(tokens),
+                    caches=[(Tensor(k), Tensor(v), Tensor(p))
+                            for (k, v, p) in caches])
+            return logits._data, [(k._data, v._data, p._data)
+                                  for (k, v, p) in nc]
+
+        def pick(last, key):
+            if temperature > 0.0:
+                return jax.random.categorical(key, last / temperature, axis=-1)
+            return jnp.argmax(last, axis=-1)
+
+        def run(pa, prompt, key0):
+            caches = [(jnp.zeros((b, L, nh, hd), cdt),
+                       jnp.zeros((b, L, nh, hd), cdt), jnp.int32(0))
+                      for _ in range(nl)]
+            logits, caches = model_step(pa, prompt, caches)     # prefill
+            key0, k1 = jax.random.split(key0)
+            nxt = pick(logits[:, -1].astype(jnp.float32), k1)
+
+            def body(carry, _):
+                caches, cur, key = carry
+                logits, caches = model_step(pa, cur[:, None], caches)
+                key, kk = jax.random.split(key)
+                new = pick(logits[:, -1].astype(jnp.float32), kk)
+                return (caches, new, key), new
+
+            (_, _, _), toks = lax.scan(body, (caches, nxt, key0), None,
+                                       length=max_new_tokens - 1)
+            gen = jnp.concatenate([nxt[:, None], jnp.moveaxis(toks, 0, 1)],
+                                  axis=1)
+            return jnp.concatenate([prompt.astype(jnp.int64),
+                                    gen.astype(jnp.int64)], axis=1)
+
+        # cache the jitted runner per static signature — a fresh closure
+        # every call would retrace AND recompile every generation. The
+        # param dtype is part of the key: the cached closure bakes cdt
+        # into its KV-buffer allocation, so a model.to(dtype=...) after
+        # the first call must miss the cache, not reuse stale buffers.
+        sig = (b, p_len, int(max_new_tokens), L, float(temperature),
+               str(cdt))
+        cache = getattr(self, "_gen_static_cache", None)
+        if cache is None:
+            cache = self._gen_static_cache = {}
+        fn = cache.get(sig)
+        if fn is None:
+            fn = cache[sig] = jax.jit(run)
+        out = fn(tuple(p._data for p in params), ids._data,
+                 jax.random.PRNGKey(seed))
+        return Tensor(out)
 
     def generate(self, input_ids, max_new_tokens: int = 16, temperature: float = 0.0):
         """Greedy/temperature sampling with KV cache (reference:

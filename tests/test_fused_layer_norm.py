@@ -54,16 +54,11 @@ def test_functional_layer_norm_routes_fused(monkeypatch):
     monkeypatch.setenv("PADDLE_TPU_FUSED_LN", "1")
     import paddle_tpu as paddle
     import paddle_tpu.ops.pallas.layer_norm as LN
-    # the platform gate correctly refuses CPU — stub the predicate for the
-    # interpret-mode routing check (shape checks preserved)
-    real = LN.fused_layer_norm_supported
-
-    def no_platform_gate(x_shape):
-        import os
-        return (os.environ.get("PADDLE_TPU_FUSED_LN") == "1"
-                and x_shape[-1] % 128 == 0)
-
-    monkeypatch.setattr(LN, "fused_layer_norm_supported", no_platform_gate)
+    # the platform gate correctly refuses CPU — fake a TPU device so the
+    # REAL predicate (env + shape checks included) drives the routing
+    from types import SimpleNamespace
+    monkeypatch.setattr(LN.jax, "devices",
+                        lambda: [SimpleNamespace(platform="tpu")])
     calls = []
     orig = LN.fused_layer_norm
 

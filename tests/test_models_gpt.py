@@ -264,3 +264,26 @@ def test_adam_selective_q8_embedding_moments():
     others = [k for k in kinds if k not in embs]
     assert embs and all(kinds[k] == "q8" for k in embs), kinds
     assert others and all(kinds[k] == "bfloat16" for k in others), kinds
+
+
+def test_generate_static_matches_growing_cache():
+    """generate_static (fixed buffers + one compiled scan) must produce
+    exactly the growing-cache generate() sequence for greedy decoding."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_config
+
+    paddle.seed(0)
+    cfg = gpt_config("gpt3-125m", hidden_size=128, num_layers=2, num_heads=2,
+                     vocab_size=256, max_position_embeddings=64)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 256, (2, 8)).astype("int64"))
+    a = m.generate(ids, max_new_tokens=6).numpy()
+    b = m.generate_static(ids, max_new_tokens=6).numpy()
+    assert (a == b).all(), (a, b)
+    # second call reuses the compiled runner (no retrace)
+    c = m.generate_static(ids, max_new_tokens=6).numpy()
+    assert (a == c).all()
+    assert len(m._gen_static_cache) == 1
