@@ -1,0 +1,66 @@
+"""GPT text generation example: eager growing-cache vs compiled static-cache.
+
+Shows the two decode paths and why serving wants the static one:
+`generate()` re-traces at every new sequence length (fine eagerly),
+`generate_static()` compiles prefill + the whole decode loop ONCE
+(fixed KV buffers + lax.scan) — 1571 tokens/s/chip at GPT-1.3B B=8 on v5e.
+
+Usage: PYTHONPATH=. python examples/generate_gpt.py
+       PADDLE_TPU_EXAMPLE_TPU=1 ... [gpt3-1.3b] to decode big on the chips.
+"""
+import os
+import sys
+import time
+
+import jax
+
+if not os.environ.get("PADDLE_TPU_EXAMPLE_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import paddle_tpu as paddle
+
+
+def main():
+    from paddle_tpu.models import GPTForCausalLM, gpt_config
+    paddle.seed(0)
+
+    if len(sys.argv) > 1:
+        cfg = gpt_config(sys.argv[1])
+        B, p_len, new = 8, 128, 64
+    else:
+        cfg = gpt_config("gpt3-125m", hidden_size=128, num_layers=2,
+                         num_heads=2, vocab_size=512,
+                         max_position_embeddings=256)
+        B, p_len, new = 2, 16, 16
+
+    model = GPTForCausalLM(cfg)
+    if os.environ.get("PADDLE_TPU_EXAMPLE_TPU"):
+        model.to(dtype="bfloat16")
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, p_len)).astype("int64"))
+
+    out_a = model.generate(ids, max_new_tokens=new)          # eager, growing
+    t0 = time.perf_counter()
+    out_b = model.generate_static(ids, max_new_tokens=new)   # one program
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out_b = model.generate_static(ids, max_new_tokens=new)   # cached runner
+    run_s = time.perf_counter() - t0
+
+    assert (out_a.numpy() == out_b.numpy()).all(), "greedy parity violated"
+    print(f"greedy parity OK over {new} tokens; static path: "
+          f"{compile_s:.1f}s first call (compile), {run_s * 1e3:.0f} ms after "
+          f"({B * new / run_s:.0f} tokens/s)")
+
+    # temperature sampling through the same compiled path
+    sampled = model.generate_static(ids, max_new_tokens=new, temperature=0.8,
+                                    seed=1)
+    print("sampled tail:", sampled.numpy()[0, -8:].tolist())
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -455,6 +455,8 @@ class GPTForCausalLM(Layer):
 
         cfg = self.config
         ids = input_ids if isinstance(input_ids, Tensor) else Tensor(input_ids)
+        if max_new_tokens <= 0:
+            return ids                      # generate() contract: prompt as-is
         b, p_len = ids.shape
         L = int(max_len or (p_len + max_new_tokens))
         assert L >= p_len + max_new_tokens, "max_len too small"
