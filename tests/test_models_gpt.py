@@ -287,3 +287,43 @@ def test_generate_static_matches_growing_cache():
     c = m.generate_static(ids, max_new_tokens=6).numpy()
     assert (a == c).all()
     assert len(m._gen_static_cache) == 1
+
+
+def test_sampling_top_k_top_p():
+    """top-k restricts sampled ids to the k best; top-p to the nucleus;
+    both paths (eager generate and compiled generate_static) honor them."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_config
+    from paddle_tpu.models.gpt import sample_logits
+
+    # unit level: a peaked distribution
+    logits = jnp.asarray(np.array([[10.0, 9.0, 1.0, 0.0, -5.0]], np.float32))
+    key = jax.random.PRNGKey(0)
+    for i in range(5):
+        tok = int(sample_logits(logits, jax.random.fold_in(key, i),
+                                temperature=1.0, top_k=2)[0])
+        assert tok in (0, 1), tok
+    # top_p tiny -> only the argmax survives
+    for i in range(3):
+        tok = int(sample_logits(logits, jax.random.fold_in(key, i),
+                                temperature=5.0, top_p=1e-6)[0])
+        assert tok == 0, tok
+    # greedy path unaffected by the knobs
+    assert int(sample_logits(logits, key, temperature=0.0, top_k=1)[0]) == 0
+
+    # model level: both generates run with the knobs and stay in-vocab
+    paddle.seed(0)
+    cfg = gpt_config("gpt3-125m", hidden_size=64, num_layers=1, num_heads=2,
+                     vocab_size=32, max_position_embeddings=32)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(np.arange(4, dtype="int64").reshape(1, 4))
+    a = m.generate(ids, max_new_tokens=4, temperature=0.9, top_k=5, seed=3)
+    b = m.generate_static(ids, max_new_tokens=4, temperature=0.9, top_k=5,
+                          top_p=0.9, seed=3)
+    for o in (a, b):
+        arr = o.numpy()
+        assert arr.shape == (1, 8) and (arr >= 0).all() and (arr < 32).all()
