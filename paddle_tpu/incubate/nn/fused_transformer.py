@@ -55,7 +55,14 @@ class FusedMultiHeadAttention(Layer):
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         """cache: optional (k_past, v_past) Tensors [B, S_past, H, D] for
         incremental decode; returns (out, (k_new, v_new)) when given
-        (reference Cache contract, fused_transformer.py:192)."""
+        (reference Cache contract, fused_transformer.py:192).
+
+        A 3-tuple (k_buf, v_buf, pos) selects STATIC-cache decode instead:
+        fixed [B, L_max, H, D] buffers + write cursor, constant shapes at
+        every step so a serving loop compiles once — the reference's
+        fused_multi_transformer CacheKV workspace semantics
+        (operators/fused/fused_multi_transformer_op.cu); same design as
+        GPTForCausalLM.generate_static."""
         if (key is not None and key is not query) or \
                 (value is not None and value is not query):
             raise NotImplementedError(
@@ -69,6 +76,44 @@ class FusedMultiHeadAttention(Layer):
         pre = self.normalize_before
         mask = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
         with_cache = cache is not None
+        if with_cache and len(cache) == 3:
+            # STATIC-cache decode — checked BEFORE any dropout key is
+            # drawn: this inference-shaped path applies no dropout, and
+            # consuming op_keys it never uses would silently advance the
+            # global RNG stream
+            if mask is not None:
+                raise NotImplementedError(
+                    "static-cache decode builds its own position mask; "
+                    "combine custom masks on the growing-cache path")
+            from ...ops.attention import (static_cache_update,
+                                          static_cache_mask)
+            k_buf, v_buf, pos = cache
+
+            def fn_static(x, qkv_w, qkv_b, lw, lb, pls, plb, lns, lnb,
+                          kb, vb, p):
+                residual = x
+                if pre:
+                    x = _ln(x, pls, plb, eps)
+                qkv = jnp.einsum("bsh,tndh->bstnd", x, qkv_w) + qkv_b
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                k2 = static_cache_update(kb, k, p)
+                v2 = static_cache_update(vb, v, p)
+                pmask = static_cache_mask(k2.shape[1], q.shape[1], p)
+                o = attention_reference(q, k2, v2, mask=pmask,
+                                        score_dtype=q.dtype)
+                o = o.reshape(o.shape[0], o.shape[1], nh * hd)
+                o = o @ lw + lb
+                o = residual + o
+                if not pre:
+                    o = _ln(o, lns, lnb, eps)
+                return o, k2, v2
+
+            sargs = [query, self.qkv_weight, self.qkv_bias,
+                     self.linear_weight, self.linear_bias,
+                     self.pre_ln_scale, self.pre_ln_bias,
+                     self.ln_scale, self.ln_bias, k_buf, v_buf, pos]
+            o, k2, v2 = apply_op("fused_mha_static_cache", fn_static, sargs)
+            return o, (k2.detach(), v2.detach(), pos + query.shape[1])
         # dropout keys ride through apply_op as inputs (op_key → symbolic
         # under static recording: fresh mask every Executor.run)
         has_ka, has_ko = bool(attn_p), bool(out_p)

@@ -134,23 +134,17 @@ class GPTSelfAttention(Layer):
             k_buf, v_buf, pos = cache
             q = qkv[:, :, 0]
 
-            def _upd(buf, new, p):
-                import jax.lax as _lax
-                return _lax.dynamic_update_slice(
-                    buf, new.astype(buf.dtype),
-                    (jnp.int32(0), p.astype(jnp.int32), jnp.int32(0),
-                     jnp.int32(0)))
-
-            k2 = apply_op("static_cache_k", _upd, [k_buf, qkv[:, :, 1], pos])
-            v2 = apply_op("static_cache_v", _upd, [v_buf, qkv[:, :, 2], pos])
+            from ..ops.attention import (static_cache_update,
+                                         static_cache_mask)
+            k2 = apply_op("static_cache_k", static_cache_update,
+                          [k_buf, qkv[:, :, 1], pos])
+            v2 = apply_op("static_cache_v", static_cache_update,
+                          [v_buf, qkv[:, :, 2], pos])
             new_cache = (k2.detach(), v2.detach(), pos + s)
 
             def _attend_static(qa, ka, va, p):
                 from ..ops.attention import attention_reference
-                L = ka.shape[1]
-                col = jnp.arange(L)[None, None, None, :]
-                row = jnp.arange(qa.shape[1])[None, None, :, None]
-                mask = col <= (p.astype(jnp.int32) + row)
+                mask = static_cache_mask(ka.shape[1], qa.shape[1], p)
                 return attention_reference(qa, ka, va, mask=mask,
                                            score_dtype=qa.dtype)
 

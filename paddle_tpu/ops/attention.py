@@ -137,3 +137,33 @@ def functional_attention(q, k, v, *, is_causal=False, scale=None, mask=None,
         return out[:, :s]
     return attention_reference(q, k, v, mask=mask, is_causal=is_causal,
                                scale=scale, score_dtype=score_dtype)
+
+
+# ----------------------------------------------------- static KV-cache ops
+def static_cache_update(buf, new, pos):
+    """Write `new` [B, s, H, D] into the fixed buffer [B, L_max, H, D] at
+    row cursor `pos` (the CacheKV-workspace write shared by
+    GPTForCausalLM.generate_static and incubate FusedMultiHeadAttention).
+
+    Eager calls (concrete pos) raise on overflow; under jit the caller
+    owns capacity (lax.dynamic_update_slice would silently clamp)."""
+    import jax.core as _core
+    from jax import lax
+    if not isinstance(pos, _core.Tracer):
+        p = int(pos)
+        if p + new.shape[1] > buf.shape[1]:
+            raise ValueError(
+                f"static KV cache overflow: pos {p} + {new.shape[1]} new "
+                f"rows > L_max {buf.shape[1]}")
+    return lax.dynamic_update_slice(
+        buf, new.astype(buf.dtype),
+        (jnp.int32(0), pos.astype(jnp.int32), jnp.int32(0), jnp.int32(0)))
+
+
+def static_cache_mask(kv_capacity, s, pos):
+    """Bool keep-mask [1, 1, s, L_max]: query row i (global position
+    pos+i) sees buffer columns <= pos+i — causal over the valid prefix,
+    zeroed padding beyond the cursor."""
+    col = jnp.arange(kv_capacity)[None, None, None, :]
+    row = jnp.arange(s)[None, None, :, None]
+    return col <= (pos.astype(jnp.int32) + row)

@@ -251,3 +251,65 @@ class TestIncubateOptimizers:
             opt.clear_grad()
             losses.append(float(loss))
         assert losses[-1] < losses[0]
+
+
+def test_multi_transformer_static_cache_matches_growing():
+    """FusedMultiTransformer 3-tuple static cache == 2-tuple growing cache
+    over an incremental decode (the fused_multi_transformer CacheKV
+    workspace semantics)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+    paddle.seed(0)
+    d, nh, nl, B, L = 32, 2, 2, 2, 12
+    m = FusedMultiTransformer(d, nh, dim_feedforward=64, num_layers=nl,
+                              dropout_rate=0.0)
+    m.eval()
+    rng = np.random.RandomState(0)
+    steps = [paddle.to_tensor(rng.randn(B, 1, d).astype("float32"))
+             for _ in range(4)]
+
+    # growing cache
+    g_caches = [(paddle.zeros([B, 0, nh, d // nh]),
+                 paddle.zeros([B, 0, nh, d // nh])) for _ in range(nl)]
+    g_outs = []
+    for x in steps:
+        o, g_caches = m(x, caches=g_caches)
+        g_outs.append(o.numpy())
+
+    # static buffers
+    s_caches = [(paddle.zeros([B, L, nh, d // nh]),
+                 paddle.zeros([B, L, nh, d // nh]),
+                 paddle.to_tensor(np.int32(0))) for _ in range(nl)]
+    for i, x in enumerate(steps):
+        o, s_caches = m(x, caches=s_caches)
+        np.testing.assert_allclose(o.numpy(), g_outs[i], rtol=1e-5,
+                                   atol=1e-5, err_msg=f"step {i}")
+    assert int(s_caches[0][2].numpy()) == len(steps)
+
+    # multi-token PREFILL through the static path is causal per row —
+    # must equal token-by-token growing decode (the growing path applies
+    # no intra-step mask for s>1, so it is NOT the comparison point)
+    x4 = paddle.to_tensor(rng.randn(B, 4, d).astype("float32"))
+    p_caches = [(paddle.zeros([B, L, nh, d // nh]),
+                 paddle.zeros([B, L, nh, d // nh]),
+                 paddle.to_tensor(np.int32(0))) for _ in range(nl)]
+    o4, p_caches = m(x4, caches=p_caches)
+    gg = [(paddle.zeros([B, 0, nh, d // nh]),
+           paddle.zeros([B, 0, nh, d // nh])) for _ in range(nl)]
+    per_tok = []
+    for t in range(4):
+        o1, gg = m(x4[:, t:t + 1], caches=gg)
+        per_tok.append(o1.numpy())
+    np.testing.assert_allclose(o4.numpy(), np.concatenate(per_tok, axis=1),
+                               rtol=1e-5, atol=1e-5)
+    assert int(p_caches[0][2].numpy()) == 4
+
+    # eager overflow raises instead of silently clamping
+    tiny = [(paddle.zeros([B, 2, nh, d // nh]),
+             paddle.zeros([B, 2, nh, d // nh]),
+             paddle.to_tensor(np.int32(0))) for _ in range(nl)]
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="overflow"):
+        m(x4, caches=tiny)
