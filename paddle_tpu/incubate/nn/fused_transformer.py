@@ -52,6 +52,23 @@ class FusedMultiHeadAttention(Layer):
             [h], default_initializer=I.Constant(1.0))
         self.ln_bias = self.create_parameter([h], is_bias=True)
 
+    def _mha_head(self, x, qkv_w, qkv_b, pls, plb):
+        """Shared pre-LN + fused QKV projection (both cache paths)."""
+        residual = x
+        if self.normalize_before:
+            x = _ln(x, pls, plb, self._epsilon)
+        qkv = jnp.einsum("bsh,tndh->bstnd", x, qkv_w) + qkv_b
+        return residual, qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    def _mha_tail(self, o, residual, lw, lb, lns, lnb, out_p=0.0, k_out=None):
+        """Shared out-projection + residual + post-LN (both cache paths)."""
+        o = o.reshape(o.shape[0], o.shape[1], self.num_heads * self.head_dim)
+        o = o @ lw + lb
+        o = residual + _drop(o, out_p, k_out)
+        if not self.normalize_before:
+            o = _ln(o, lns, lnb, self._epsilon)
+        return o
+
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         """cache: optional (k_past, v_past) Tensors [B, S_past, H, D] for
         incremental decode; returns (out, (k_new, v_new)) when given
@@ -81,6 +98,11 @@ class FusedMultiHeadAttention(Layer):
             # drawn: this inference-shaped path applies no dropout, and
             # consuming op_keys it never uses would silently advance the
             # global RNG stream
+            if attn_p or out_p:
+                raise NotImplementedError(
+                    "static-cache decode is inference-only (no dropout): "
+                    "call .eval() or set dropout rates to 0, or use the "
+                    "growing (k, v) cache for cached training")
             if mask is not None:
                 raise NotImplementedError(
                     "static-cache decode builds its own position mask; "
@@ -91,21 +113,13 @@ class FusedMultiHeadAttention(Layer):
 
             def fn_static(x, qkv_w, qkv_b, lw, lb, pls, plb, lns, lnb,
                           kb, vb, p):
-                residual = x
-                if pre:
-                    x = _ln(x, pls, plb, eps)
-                qkv = jnp.einsum("bsh,tndh->bstnd", x, qkv_w) + qkv_b
-                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                residual, q, k, v = self._mha_head(x, qkv_w, qkv_b, pls, plb)
                 k2 = static_cache_update(kb, k, p)
                 v2 = static_cache_update(vb, v, p)
                 pmask = static_cache_mask(k2.shape[1], q.shape[1], p)
                 o = attention_reference(q, k2, v2, mask=pmask,
                                         score_dtype=q.dtype)
-                o = o.reshape(o.shape[0], o.shape[1], nh * hd)
-                o = o @ lw + lb
-                o = residual + o
-                if not pre:
-                    o = _ln(o, lns, lnb, eps)
+                o = self._mha_tail(o, residual, lw, lb, lns, lnb)
                 return o, k2, v2
 
             sargs = [query, self.qkv_weight, self.qkv_bias,
@@ -123,11 +137,7 @@ class FusedMultiHeadAttention(Layer):
             k_attn = rest.pop(0) if has_ka else None
             k_out = rest.pop(0) if has_ko else None
             past = rest
-            residual = x
-            if pre:
-                x = _ln(x, pls, plb, eps)
-            qkv = jnp.einsum("bsh,tndh->bstnd", x, qkv_w) + qkv_b
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            residual, q, k, v = self._mha_head(x, qkv_w, qkv_b, pls, plb)
             if past:
                 k = jnp.concatenate([past[0], k], axis=1)
                 v = jnp.concatenate([past[1], v], axis=1)
@@ -136,11 +146,7 @@ class FusedMultiHeadAttention(Layer):
                                         dropout_key=k_attn)
             else:
                 o = functional_attention(q, k, v)
-            o = o.reshape(o.shape[0], o.shape[1], nh * hd)
-            o = o @ lw + lb
-            o = residual + _drop(o, out_p, k_out)
-            if not pre:
-                o = _ln(o, lns, lnb, eps)
+            o = self._mha_tail(o, residual, lw, lb, lns, lnb, out_p, k_out)
             return (o, k, v) if past else o
 
         args = [query, self.qkv_weight, self.qkv_bias, self.linear_weight,

@@ -925,17 +925,21 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
 # ----------------------------------------------------------------- attention
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
-                                 is_causal=False, training=True, name=None):
+                                 is_causal=False, training=True, name=None,
+                                 score_dtype=None):
     """Fused attention entry point. [B, S, H, D] layout (paddle convention).
 
     Uses the Pallas flash-attention kernel on TPU when shapes allow (see
     paddle_tpu/ops/pallas/flash_attention.py), else a reference jnp path —
     beyond the reference snapshot, which has no flash attention (SURVEY §5.7).
+    score_dtype (beyond-reference knob): storage dtype for the S×S
+    logits/probs on the non-flash path; pass the model dtype (bf16) to
+    halve its O(S²) HBM traffic — f32 accumulation is kept either way.
     """
     from ..ops import attention as _attn
     return _attn.scaled_dot_product_attention(
         query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
-        is_causal=is_causal, training=training)
+        is_causal=is_causal, training=training, score_dtype=score_dtype)
 
 
 # ----------------------------------------------------------------- misc
