@@ -431,7 +431,7 @@ class GPTForCausalLM(Layer):
     def generate_static(self, input_ids, max_new_tokens: int = 16,
                         temperature: float = 0.0, top_k: int = 0,
                         top_p: float = 1.0, max_len: int = None,
-                        seed: int = 0):
+                        seed: int = 0, eos_token_id: int = None):
         """TPU-native generation: static KV-cache buffers + the WHOLE
         prefill-then-decode loop compiled as ONE XLA program (lax.scan over
         decode steps). Same outputs as generate() for greedy decoding; the
@@ -480,16 +480,25 @@ class GPTForCausalLM(Layer):
             logits, caches = model_step(pa, prompt, caches)     # prefill
             key0, k1 = jax.random.split(key0)
             nxt = pick(logits[:, -1].astype(jnp.float32), k1)
+            done = (jnp.zeros((b,), bool) if eos_token_id is None
+                    else nxt == eos_token_id)
 
             def body(carry, _):
-                caches, cur, key = carry
+                # sequences that emitted EOS keep emitting EOS — the scan
+                # has static length, so early stop is a per-row mask (the
+                # compiled-serving analog of the eager break)
+                caches, cur, key, done = carry
                 logits, caches = model_step(pa, cur[:, None], caches)
                 key, kk = jax.random.split(key)
                 new = pick(logits[:, -1].astype(jnp.float32), kk)
-                return (caches, new, key), new
+                if eos_token_id is not None:
+                    new = jnp.where(done, jnp.asarray(eos_token_id,
+                                                      new.dtype), new)
+                    done = done | (new == eos_token_id)
+                return (caches, new, key, done), new
 
-            (_, _, _), toks = lax.scan(body, (caches, nxt, key0), None,
-                                       length=max_new_tokens - 1)
+            (_, _, _, _), toks = lax.scan(body, (caches, nxt, key0, done),
+                                          None, length=max_new_tokens - 1)
             gen = jnp.concatenate([nxt[:, None], jnp.moveaxis(toks, 0, 1)],
                                   axis=1)
             return jnp.concatenate([prompt.astype(jnp.int64),
@@ -501,7 +510,8 @@ class GPTForCausalLM(Layer):
         # into its KV-buffer allocation, so a model.to(dtype=...) after
         # the first call must miss the cache, not reuse stale buffers.
         sig = (b, p_len, int(max_new_tokens), L, float(temperature),
-               int(top_k), float(top_p), str(cdt))
+               int(top_k), float(top_p),
+               None if eos_token_id is None else int(eos_token_id), str(cdt))
         cache = getattr(self, "_gen_static_cache", None)
         if cache is None:
             cache = self._gen_static_cache = {}
@@ -514,7 +524,8 @@ class GPTForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens: int = 16,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0, seed: int = None):
+                 top_p: float = 1.0, seed: int = None,
+                 eos_token_id: int = None):
         """Greedy/temperature/top-k/top-p sampling with KV cache
         (reference: paddlenlp-style generate; cache semantics of
         MultiHeadAttention). seed=None (default) draws from the global
@@ -533,6 +544,8 @@ class GPTForCausalLM(Layer):
         cur = input_ids
         key = jax.random.PRNGKey(seed) if seed is not None \
             else _random.split_key()
+        import numpy as _np
+        done = _np.zeros((b,), bool)
         for i in range(max_new_tokens):
             logits, caches = self.forward(cur, caches=caches)
             last = logits[:, -1]
@@ -544,8 +557,19 @@ class GPTForCausalLM(Layer):
                                         top_p=top_p)[:, None],
                 [last])
             nxt = ops.cast(nxt, "int64")
+            if eos_token_id is not None:
+                # finished rows stay on EOS — masking stays on-device; the
+                # only host read is the all-done check that drives `break`
+                nxt = apply_op(
+                    "eos_mask",
+                    lambda a, d=jnp.asarray(done): jnp.where(
+                        d[:, None], jnp.asarray(eos_token_id, a.dtype), a),
+                    [nxt])
+                done = done | (nxt.numpy()[:, 0] == eos_token_id)
             out = ops.concat([out, nxt], axis=1)
             cur = nxt
+            if eos_token_id is not None and bool(done.all()):
+                break                           # eager path CAN stop early
         return out
 
 

@@ -327,3 +327,38 @@ def test_sampling_top_k_top_p():
     for o in (a, b):
         arr = o.numpy()
         assert arr.shape == (1, 8) and (arr >= 0).all() and (arr < 32).all()
+
+
+def test_generate_eos_early_stop():
+    """eos_token_id: eager generate stops early; static generate masks
+    finished rows to EOS inside the compiled scan."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_config
+
+    paddle.seed(0)
+    cfg = gpt_config("gpt3-125m", hidden_size=64, num_layers=1, num_heads=2,
+                     vocab_size=32, max_position_embeddings=64)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(np.arange(8, dtype="int64").reshape(2, 4))
+    # greedy reference without eos
+    ref = m.generate(ids, max_new_tokens=8).numpy()
+    # pick the token the model emits FIRST for row 0 as the eos id
+    eos = int(ref[0, 4])
+    a = m.generate(ids, max_new_tokens=8, eos_token_id=eos).numpy()
+    b = m.generate_static(ids, max_new_tokens=8, eos_token_id=eos).numpy()
+    # row 0 hits eos immediately: everything after is eos in both paths
+    assert (a[0, 4:] == eos).all()
+    assert (b[0, 4:] == eos).all()
+    # rows that never emit eos match the unconstrained reference prefix
+    if not (ref[1] == eos).any():
+        n = a.shape[1]
+        assert (a[1, :n] == ref[1, :n]).all()
+
+    # single-row batch where the row hits eos immediately: the eager path
+    # must actually BREAK (strictly shorter than the unconstrained run)
+    one = paddle.to_tensor(ids.numpy()[:1])
+    short = m.generate(one, max_new_tokens=8, eos_token_id=eos).numpy()
+    assert short.shape[1] < ref.shape[1], short.shape
+    assert short[0, -1] == eos
