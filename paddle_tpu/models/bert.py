@@ -127,17 +127,42 @@ class BertAttention(Layer):
         self.attn_dropout_p = config.attention_dropout
 
     def forward(self, x, attention_mask=None):
+        import jax
         import jax.numpy as jnp
         from ..core import random as _random
         from ..ops.attention import attention_reference
 
+        from ..ops.pallas.fused_mha import fused_mha, use_fused_mha
+
         nh, hd = self.num_heads, self.head_dim
         qkv = self.qkv(x)
         b, s = qkv.shape[0], qkv.shape[1]
-        qkv = ops.reshape(qkv, [b, s, 3, nh, hd])
-        tensor_args = [qkv] if attention_mask is None else [qkv, attention_mask]
         attn_p = self.attn_dropout_p if self.training else 0.0
         dk = _random.split_key() if attn_p > 0.0 else None
+
+        if (attention_mask is None and use_fused_mha(s, nh, hd)
+                and _mesh.mesh_axis_size("mp") == 1
+                and _mesh.mesh_axis_size("sp") == 1):
+            # Whole-sequence fused MHA on the packed projection output with
+            # IN-KERNEL PRNG dropout (ops/pallas/fused_mha.py): the S² of
+            # attention-probability dropout bits never exist in HBM — that
+            # threefry traffic was the single largest cost of the r3 MLM
+            # step (~20% MFU). Mask regeneration in backward is validated
+            # bit-identical by tools/validate_fused_mha_tpu.py.
+            def attend_packed(a):
+                seed = None
+                if attn_p > 0.0:
+                    seed = jax.random.randint(dk, (), 0, 2 ** 31 - 1)
+                return fused_mha(a, nh, dropout_p=attn_p, dropout_seed=seed)
+
+            ctx = apply_op("bert_attention", attend_packed, [qkv])
+            y = self.out(ctx)
+            if self.training and self.dropout.p:
+                y = self.dropout(y)
+            return y
+
+        qkv = ops.reshape(qkv, [b, s, 3, nh, hd])
+        tensor_args = [qkv] if attention_mask is None else [qkv, attention_mask]
 
         def attend(a, mask=None):
             q, k, v = a[:, :, 0], a[:, :, 1], a[:, :, 2]

@@ -97,9 +97,26 @@ class ViTBlock(Layer):
         self.dropout = Dropout(config.hidden_dropout)
 
     def forward(self, x):
+        from ..ops.pallas.fused_mha import fused_mha, use_fused_mha
         nh, hd = self.num_heads, self.head_dim
         qkv = self.qkv(self.ln_1(x))
         b, s = qkv.shape[0], qkv.shape[1]
+        if (use_fused_mha(s, nh, hd)
+                and _mesh.mesh_axis_size("mp") == 1
+                and _mesh.mesh_axis_size("sp") == 1):
+            # Whole-sequence fused MHA on the PACKED projection output
+            # (ops/pallas/fused_mha.py): no [B,S,3,nh,hd] reshape, no
+            # head-major transposes, and no padding — Mosaic masks the
+            # ragged S=197 block dims natively. The r3 XLA path left
+            # ~12 ms of layout copies + ~9 ms of softmax per ViT-L step
+            # on the table; measured 54% -> 57.8% MFU on v5e.
+            ctx = apply_op("vit_attention",
+                           lambda a: fused_mha(a, nh), [qkv])
+            x = x + self.out(ctx)
+            y = self.down(F.gelu(self.up(self.ln_2(x)), approximate=True))
+            if self.training and self.dropout.p:
+                y = self.dropout(y)
+            return x + y
         qkv = ops.reshape(qkv, [b, s, 3, nh, hd])
 
         def attend(a):

@@ -1,0 +1,138 @@
+"""Trace one training-step executable on TPU and print a device-time table.
+
+Mirrors bench.py's model configs (vit / bert / gpt / swin); runs a few steps
+under jax.profiler.trace and aggregates XLA-op durations from the device
+lanes of the captured .trace.json.gz — per-op-name totals over the steady
+window, sorted. This is the only trustworthy per-component timing on the
+axon tunnel (host-side timers measure dispatch, not device work).
+
+Usage: python tools/profile_step.py vit [outdir]
+"""
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, ".")
+
+
+def build_step(which):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.train_step import TrainStep
+    import paddle_tpu.nn as nn
+
+    if which == "vit":
+        from paddle_tpu.models import VisionTransformer, vit_config
+        cfg = vit_config("vit-l16", image_size=224, num_classes=1000)
+        paddle.seed(0)
+        model = VisionTransformer(cfg)
+        model.to(dtype="bfloat16")
+        ce = nn.CrossEntropyLoss()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     moment_dtype="bfloat16")
+        step = TrainStep(model, opt, lambda x, y: ce(model(x), y))
+        B = int(os.environ.get("PADDLE_TPU_BENCH_B", "32"))
+        x = paddle.to_tensor(np.random.randn(4, B, 3, 224, 224)
+                             .astype("bfloat16"))
+        y = paddle.to_tensor(np.random.randint(0, 1000, (4, B))
+                             .astype("int64"))
+        return step, (x, y)
+    if which == "bert":
+        from paddle_tpu.models import BertForMaskedLM, bert_config
+        cfg = bert_config("bert-base")
+        paddle.seed(0)
+        model = BertForMaskedLM(cfg)
+        model.to(dtype="bfloat16")
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     moment_dtype="bfloat16")
+        step = TrainStep(model, opt,
+                         lambda ids, lbl: model.loss(ids, lbl,
+                                                     chunk_size=256))
+        B = int(os.environ.get("PADDLE_TPU_BENCH_B", "32"))
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, B, 512))
+                               .astype("int32"))
+        lbl = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, B, 512))
+                               .astype("int64"))
+        return step, (ids, lbl)
+    if which == "gpt":
+        from paddle_tpu.models import GPTForCausalLM, gpt_config
+        preset = os.environ.get("PADDLE_TPU_BENCH_PRESET", "gpt3-1.3b")
+        B = int(os.environ.get("PADDLE_TPU_BENCH_B", "3"))
+        S = int(os.environ.get("PADDLE_TPU_BENCH_S", "2048"))
+        cfg = gpt_config(preset, max_position_embeddings=max(1024, S))
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+        model.to(dtype="bfloat16")
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     moment_dtype="bfloat16")
+        step = TrainStep(model, opt,
+                         lambda a, b: model.loss(a, b, chunk_size=512))
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, B, S))
+                               .astype("int32"))
+        return step, (ids, ids)
+    raise SystemExit(f"unknown model {which}")
+
+
+def aggregate(outdir, n_steps):
+    files = glob.glob(os.path.join(outdir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    if not files:
+        raise SystemExit(f"no trace files under {outdir}")
+    path = max(files, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    # device lanes: pids whose process_name mentions TPU/device; XLA ops
+    # carry 'dur'. Build pid->name map first.
+    pid_name = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_name[e.get("pid")] = e.get("args", {}).get("name", "")
+    per_op = defaultdict(float)
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        pname = pid_name.get(e.get("pid"), "")
+        if not any(k in pname for k in ("TPU", "device", "Device")):
+            continue
+        if "XLA Modules" in pname:  # whole-module envelope, skip
+            continue
+        per_op[e["name"]] += e["dur"]
+        total += e["dur"]
+    rows = sorted(per_op.items(), key=lambda kv: -kv[1])
+    print(f"\ntrace: {path}")
+    print(f"device op time total: {total / 1e3 / n_steps:.2f} ms/step "
+          f"over {n_steps} steps\n")
+    print(f"{'ms/step':>9}  {'%':>5}  op")
+    for name, us in rows[:45]:
+        print(f"{us / 1e3 / n_steps:9.3f}  {us / total * 100:5.1f}  "
+              f"{name[:100]}")
+    return rows, total
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "vit"
+    outdir = sys.argv[2] if len(sys.argv) > 2 else f"/tmp/trace_{which}"
+    import jax
+    step, args = build_step(which)
+    losses = step.run_steps(4, *args)          # compile + warm
+    _ = float(losses.numpy()[-1])
+    n = 4
+    jax.profiler.start_trace(outdir)
+    losses = step.run_steps(n, *args)
+    _ = float(losses.numpy()[-1])
+    jax.profiler.stop_trace()
+    aggregate(outdir, n)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,159 @@
+"""Hardware validation for the fused short-seq MHA kernel (run on TPU).
+
+The Mosaic PRNG has no CPU emulation, so everything dropout-related is
+checked here on the real chip:
+  1. compiled fwd parity vs the XLA reference (no dropout), ViT and BERT shapes
+  2. compiled grad parity vs XLA autodiff of the reference
+  3. dropout determinism per seed / divergence across seeds
+  4. inverted-dropout mean preservation (E[out] ~ no-dropout out)
+  5. drop-rate estimate from the zero fraction of a probe row
+  6. finite-difference gradient consistency WITH dropout on (the backward
+     regenerates the mask from the same seeds — this is the check that the
+     regeneration is bit-identical)
+
+Usage: python tools/validate_fused_mha_tpu.py
+"""
+import sys
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.fused_mha import fused_mha, mha_reference_packed
+
+
+def _rand_qkv(b, s, nh, hd, seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(b, s, 3 * nh * hd).astype(dtype)) * 0.3
+
+
+def check(name, ok, detail=""):
+    print(f"[{'PASS' if ok else 'FAIL'}] {name} {detail}")
+    if not ok:
+        sys.exit(1)
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev)
+
+    # 1. forward parity, ViT-L shape (S=197 ragged) and BERT shape (S=512)
+    for (s, nh, hd, tag) in [(197, 16, 64, "vit-l"), (512, 12, 64, "bert-b")]:
+        qkv = _rand_qkv(2, s, nh, hd, seed=1)
+        out = jax.jit(lambda a: fused_mha(a, nh))(qkv)
+        want = mha_reference_packed(qkv, nh)
+        err = float(jnp.max(jnp.abs(out - want)))
+        check(f"fwd parity {tag}", err < 2e-4, f"max_err={err:.2e}")
+
+    # 2. grad parity (no dropout)
+    qkv = _rand_qkv(1, 197, 16, 64, seed=2)
+    gk = jax.jit(jax.grad(lambda a: jnp.sum(fused_mha(a, 16) ** 2)))(qkv)
+    gr = jax.grad(lambda a: jnp.sum(mha_reference_packed(a, 16) ** 2))(qkv)
+    err = float(jnp.max(jnp.abs(gk - gr)))
+    check("grad parity vit-l", err < 5e-3, f"max_err={err:.2e}")
+
+    # 3. dropout determinism
+    qkv = _rand_qkv(1, 512, 12, 64, seed=3)
+    f = jax.jit(lambda a, sd: fused_mha(a, 12, dropout_p=0.1,
+                                        dropout_seed=sd))
+    a1 = np.asarray(f(qkv, 7.0))
+    a2 = np.asarray(f(qkv, 7.0))
+    a3 = np.asarray(f(qkv, 8.0))
+    check("dropout deterministic per seed", np.array_equal(a1, a2))
+    check("dropout differs across seeds", np.abs(a1 - a3).max() > 1e-6,
+          f"max_delta={np.abs(a1 - a3).max():.3f}")
+
+    # 4. mean preservation over seeds. Per-element expected sampling error
+    # of an N-seed average of Bernoulli(1-p)/(1-p) masks is
+    # sqrt(p/((1-p)·N)); gate at 2 sigma.
+    n_seeds, p = 32, 0.1
+    base = np.asarray(jax.jit(lambda a: fused_mha(a, 12))(qkv), np.float64)
+    outs = [np.asarray(f(qkv, float(i)), np.float64) for i in range(n_seeds)]
+    avg = np.mean(outs, axis=0)
+    drift = np.abs(avg - base).mean() / (np.abs(base).mean() + 1e-9)
+    bound = 2.0 * float(np.sqrt(p / ((1 - p) * n_seeds)))
+    check("dropout mean preserved", drift < bound,
+          f"rel_drift={drift:.4f} (2sigma bound {bound:.4f})")
+
+    # 5. drop RATE: with q=0 the softmax is uniform (sigma=1/S), v=1 makes
+    # out_i = keep_count_i / (S·(1-p)) — so mean(out)·(1-p) estimates the
+    # keep rate directly. Binomial std of the estimate ~ sqrt(p(1-p)/S)/S^0.5
+    s_probe, p_probe = 512, 0.3
+    probe = jnp.concatenate([
+        jnp.zeros((1, s_probe, 12 * 64), jnp.float32),       # q = 0
+        qkv[:, :, 12 * 64:2 * 12 * 64],                      # k arbitrary
+        jnp.ones((1, s_probe, 12 * 64), jnp.float32)], -1)   # v = 1
+    o = np.asarray(jax.jit(lambda a: fused_mha(a, 12, dropout_p=p_probe,
+                                               dropout_seed=5.0))(probe))
+    keep_rate = o.mean() * (1 - p_probe)
+    check("dropout rate matches p", abs(keep_rate - (1 - p_probe)) < 0.01,
+          f"keep_rate={keep_rate:.4f} want {1 - p_probe:.2f}")
+
+    # 6. backward mask regeneration consistency. Finite differences are
+    # blind here (MXU default precision truncates f32 operands to bf16, so
+    # the compiled function carries ~1e-3 noise). Instead: EXTRACT the
+    # realized keep mask — the output is linear in v, so basis-block v
+    # probes return the dropped-probability matrix pd column-block by
+    # column-block, and pd == 0 exactly marks dropped entries (softmax
+    # probs are strictly positive). Then compare the kernel's autodiff
+    # grads against an f64 host emulation that uses the extracted mask;
+    # a fwd/bwd seed mismatch would show as O(1) error in dv.
+    nh, hd, s_m, p_m, seed_m = 4, 64, 128, 0.25, 3.0
+    F = nh * hd
+    qkv = _rand_qkv(1, s_m, nh, hd, seed=9)
+    fm = jax.jit(lambda a: fused_mha(a, nh, dropout_p=p_m,
+                                     dropout_seed=seed_m))
+    pd = np.zeros((nh, s_m, s_m))
+    for blk in range(s_m // hd):
+        v_probe = np.zeros((1, s_m, F), np.float32)
+        for h in range(nh):
+            v_probe[0, blk * hd:(blk + 1) * hd, h * hd:(h + 1) * hd] = \
+                np.eye(hd)
+        probe = jnp.concatenate([qkv[:, :, :2 * F], jnp.asarray(v_probe)], -1)
+        o = np.asarray(fm(probe), np.float64)
+        for h in range(nh):
+            pd[h][:, blk * hd:(blk + 1) * hd] = o[0, :, h * hd:(h + 1) * hd]
+    keep = pd != 0.0
+    drop_rate = 1.0 - keep.mean()
+    check("extracted mask rate", abs(drop_rate - p_m) < 0.01,
+          f"drop_rate={drop_rate:.4f}")
+
+    # f64 host emulation with the extracted mask
+    a = np.asarray(qkv, np.float64)[0]
+    q_, k_, v_ = a[:, :F], a[:, F:2 * F], a[:, 2 * F:]
+    w = np.random.RandomState(1).randn(s_m, F)
+    gk = jax.jit(jax.grad(lambda x: jnp.sum(
+        jnp.asarray(w[None], jnp.float32)
+        * fused_mha(x, nh, dropout_p=p_m, dropout_seed=seed_m))))(qkv)
+    gk = np.asarray(gk, np.float64)[0]
+    scale = 1.0 / np.sqrt(hd)
+    inv = 1.0 / (1.0 - p_m)
+    ref_g = np.zeros_like(a)
+    for h in range(nh):
+        sl = slice(h * hd, (h + 1) * hd)
+        qh, kh, vh, doh = q_[:, sl], k_[:, sl], v_[:, sl], w[:, sl]
+        sc = qh @ kh.T * scale
+        e = np.exp(sc - sc.max(-1, keepdims=True))
+        sig = e / e.sum(-1, keepdims=True)
+        m = keep[h] * inv
+        pdh = sig * m
+        dv = pdh.T @ doh
+        dsig = (doh @ vh.T) * m
+        r = (dsig * sig).sum(-1, keepdims=True)
+        ds = sig * (dsig - r)
+        ref_g[:, sl] = ds @ kh * scale
+        ref_g[:, F + h * hd:F + (h + 1) * hd] = ds.T @ qh * scale
+        ref_g[:, 2 * F + h * hd:2 * F + (h + 1) * hd] = dv
+    denom = np.abs(ref_g).mean() + 1e-9
+    rel = np.abs(gk - ref_g).max() / denom
+    # bf16 MXU operand truncation bounds agreement at the ~1% level
+    check("dropout grads match extracted-mask emulation", rel < 0.15,
+          f"max_err/mean|g|={rel:.4f}")
+
+    print("all hardware checks passed")
+
+
+if __name__ == "__main__":
+    main()
