@@ -334,6 +334,11 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     s_q, s_k = q.shape[1], k.shape[1]
+    if kv_len is not None and kv_len <= 0:
+        # every key column masked would make exp(s - m) == 1 uniformly and
+        # return an average of V rather than erroring — reject up front
+        raise ValueError(f"flash_attention: kv_len must be positive, "
+                         f"got {kv_len}")
     if kv_len is not None and kv_len >= s_k:
         kv_len = None
     import os
@@ -700,6 +705,9 @@ def flash_attention_packed(q, k, v, num_heads: int, causal: bool = False,
     if scale is None:
         scale = 1.0 / math.sqrt(hd)
     s_k = k.shape[1]
+    if kv_len is not None and kv_len <= 0:
+        raise ValueError(f"flash_attention_packed: kv_len must be positive, "
+                         f"got {kv_len}")
     if kv_len is not None and kv_len >= s_k:
         kv_len = None
     bq = block_q or min(PACKED_BQ, s_q)
