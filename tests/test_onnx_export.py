@@ -26,6 +26,10 @@ def _decode(path):
 def _initializers(g):
     out = {}
     for t in g.initializer:
+        if t.data_type == 7:  # INT64 (Reshape shapes, Slice bounds)
+            out[t.name] = np.frombuffer(t.raw_data, np.int64).reshape(
+                tuple(t.dims))
+            continue
         assert t.data_type == 1  # FLOAT
         out[t.name] = np.frombuffer(t.raw_data, np.float32).reshape(
             tuple(t.dims))
@@ -121,9 +125,46 @@ def _run_onnx(model, x):
         elif nd.op_type == "AveragePool":
             y = pool2d(ins[0], ints("kernel_shape"), ints("strides"),
                        ints("pads", [0, 0, 0, 0]), "avg")
+        elif nd.op_type == "MatMul":
+            y = np.matmul(ins[0], ins[1])
+        elif nd.op_type == "Add":
+            y = ins[0] + ins[1]
+        elif nd.op_type == "Sub":
+            y = ins[0] - ins[1]
+        elif nd.op_type == "Mul":
+            y = ins[0] * ins[1]
+        elif nd.op_type == "Div":
+            y = ins[0] / ins[1]
+        elif nd.op_type == "Pow":
+            y = ins[0] ** ins[1]
+        elif nd.op_type == "Sqrt":
+            y = np.sqrt(ins[0])
+        elif nd.op_type == "Erf":
+            import math
+            y = np.vectorize(math.erf)(ins[0]).astype(np.float32)
+        elif nd.op_type == "ReduceMean":
+            axes = tuple(ints("axes"))
+            keep = bool(a["keepdims"].i) if "keepdims" in a else True
+            y = ins[0].mean(axis=axes, keepdims=keep)
+        elif nd.op_type == "Transpose":
+            y = ins[0].transpose(tuple(ints("perm")))
+        elif nd.op_type == "Reshape":
+            shp = [int(v) for v in ins[1]]
+            shp = [ins[0].shape[i] if v == 0 else v
+                   for i, v in enumerate(shp)]   # ONNX 0 = copy input dim
+            y = ins[0].reshape(shp)
+        elif nd.op_type == "Slice":
+            starts, ends, axes = (np.asarray(ins[1]), np.asarray(ins[2]),
+                                  np.asarray(ins[3]))
+            sl = [slice(None)] * ins[0].ndim
+            for st, en, ax in zip(starts, ends, axes):
+                sl[int(ax)] = slice(int(st), int(en))
+            y = ins[0][tuple(sl)]
         else:
             raise AssertionError(f"evaluator: unexpected op {nd.op_type}")
-        env[nd.output[0]] = y.astype(np.float32)
+        if y.dtype != np.int64:
+            y = y.astype(np.float32)
+        env[nd.output[0]] = y
     return env[g.output[0].name]
 
 
@@ -196,3 +237,52 @@ class TestOnnxExport:
             paddle.jit.InputSpec([2, 4], "float32")])
         import os
         assert os.path.exists(p + ".pdmodel")
+
+
+class TestOnnxTransformerExport:
+    def test_bert_base_encoder_parity(self, tmp_path):
+        """A literal bert-base ENCODER exports to opset-13 .onnx (MatMul/
+        Softmax/decomposed-LayerNorm/tanh-Gelu/Reshape/Transpose/Slice) and
+        the independent numpy evaluation matches the framework forward
+        (VERDICT r3 #9; reference: python/paddle/onnx/export.py:22 via
+        paddle2onnx's full transformer converter)."""
+        from paddle_tpu.models import BertModel, bert_config
+        from paddle_tpu import onnx as ponnx
+
+        cfg = bert_config("bert-base")          # real 768x12x12 encoder
+        paddle.seed(0)
+        model = BertModel(cfg)
+        model.eval()
+        S = 32
+        path = str(tmp_path / "bert_encoder.onnx")
+        ponnx.export(model.encoder, path,
+                     input_spec=[[None, S, cfg.hidden_size]])
+
+        m = _decode(path)
+        ops = {nd.op_type for nd in m.graph.node}
+        assert {"MatMul", "Softmax", "Transpose", "Reshape", "Slice",
+                "Tanh", "ReduceMean"} <= ops, ops
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, S, cfg.hidden_size).astype(np.float32) * 0.3
+        got = _run_onnx(m, x)
+
+        t = paddle.to_tensor(x)
+        for layer in model.encoder:
+            t = layer(t)
+        want = t.numpy()
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_layer_norm_and_gelu_standalone(self, tmp_path):
+        from paddle_tpu import onnx as ponnx
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(16, 32), nn.LayerNorm(32), nn.GELU())
+        m.eval()
+        path = str(tmp_path / "ln.onnx")
+        ponnx.export(m, path, input_spec=[[None, 8, 16]])
+        dec = _decode(path)
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 8, 16).astype(np.float32)
+        got = _run_onnx(dec, x)
+        np.testing.assert_allclose(got, m(paddle.to_tensor(x)).numpy(),
+                                   rtol=1e-4, atol=1e-4)
