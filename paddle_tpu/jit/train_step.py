@@ -257,11 +257,62 @@ class TrainStep:
                                      for g in grads))
                 scale = jnp.minimum(1.0, grad_clip.clip_norm / jnp.maximum(total, 1e-12))
                 grads = [g * scale.astype(g.dtype) for g in grads]
-            new_params, new_state = [], []
-            for pa, g, st, wd in zip(param_arrays, grads, opt_state, wds):
+            new_params = [None] * len(param_arrays)
+            new_state = [None] * len(param_arrays)
+            # fused multi-tensor apply (reference analog:
+            # distributed_fused_lamb.py:82): the ~hundreds of tiny params
+            # (LN scales/biases, linear biases) each cost XLA a separate
+            # small fusion in the update phase; for elementwise optimizers
+            # concatenate each (dtype, moment-dtype) group into ONE flat
+            # update and slice back. Weight decay becomes a per-element
+            # constant vector, so mixed wd groups fuse too.
+            import os as _os
+            fuse_t = int(_os.environ.get("PADDLE_TPU_FUSE_SMALL_UPDATES",
+                                         "262144"))
+            groups = {}
+            if getattr(opt, "_fusable_elementwise", False) and fuse_t > 0:
+                for i, (pa, st) in enumerate(zip(param_arrays, opt_state)):
+                    if (pa.size <= fuse_t and st is not None
+                            and set(st) == {"moment1", "moment2"}
+                            and pa.ndim >= 1):
+                        key_g = (str(pa.dtype), str(st["moment1"].dtype),
+                                 str(st["moment2"].dtype))
+                        groups.setdefault(key_g, []).append(i)
+            fused_idx = set()
+            for idxs in groups.values():
+                if len(idxs) < 2:
+                    continue
+                fused_idx.update(idxs)
+                sizes = [param_arrays[i].size for i in idxs]
+                offs = [0]
+                for s_ in sizes:
+                    offs.append(offs[-1] + s_)
+                flat_p = jnp.concatenate(
+                    [param_arrays[i].reshape(-1) for i in idxs])
+                flat_g = jnp.concatenate(
+                    [grads[i].reshape(-1) for i in idxs])
+                flat_st = {
+                    k: jnp.concatenate(
+                        [opt_state[i][k].reshape(-1) for i in idxs])
+                    for k in ("moment1", "moment2")}
+                wd_vec = jnp.concatenate(
+                    [jnp.full((param_arrays[i].size,), float(wds[i]),
+                              jnp.float32) for i in idxs])
+                fp, fs = opt.update(flat_p, flat_g, flat_st, lr, step_i,
+                                    wd_vec)
+                for j, i in enumerate(idxs):
+                    sl = slice(offs[j], offs[j + 1])
+                    new_params[i] = fp[sl].reshape(param_arrays[i].shape)
+                    new_state[i] = {
+                        k: fs[k][sl].reshape(opt_state[i][k].shape)
+                        for k in ("moment1", "moment2")}
+            for i, (pa, g, st, wd) in enumerate(
+                    zip(param_arrays, grads, opt_state, wds)):
+                if i in fused_idx:
+                    continue
                 np_, ns_ = opt.update(pa, g, st, lr, step_i, wd)
-                new_params.append(np_)
-                new_state.append(ns_)
+                new_params[i] = np_
+                new_state[i] = ns_
             return loss, tuple(new_params), tuple(new_state)
 
         return pure_step

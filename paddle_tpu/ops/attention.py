@@ -165,10 +165,26 @@ def static_cache_update(buf, new, pos):
         (jnp.int32(0), pos.astype(jnp.int32), jnp.int32(0), jnp.int32(0)))
 
 
-def static_cache_mask(kv_capacity, s, pos):
-    """Bool keep-mask [1, 1, s, L_max]: query row i (global position
-    pos+i) sees buffer columns <= pos+i — causal over the valid prefix,
-    zeroed padding beyond the cursor."""
+def static_cache_mask(kv_capacity, s, pos, prompt_lens=None,
+                      prefill_cap=None):
+    """Bool keep-mask for fixed-buffer decode.
+
+    Base form [1, 1, s, L_max]: query row i (global position pos+i) sees
+    buffer columns <= pos+i — causal over the valid prefix, zeroed padding
+    beyond the cursor.
+
+    Ragged form (prompt_lens [B], prefill_cap int): prompts were RIGHT-
+    padded to prefill_cap before prefill, so buffer rows in
+    [prompt_lens[b], prefill_cap) hold garbage k/v — additionally mask
+    them per batch row: a column is valid iff col < prompt_lens[b] (real
+    prompt) or col >= prefill_cap (decoded tokens). One compiled program
+    then serves ANY prompt length <= prefill_cap (VERDICT r3 #7; reference
+    CacheKV analog: fused_multi_transformer_op.cu)."""
     col = jnp.arange(kv_capacity)[None, None, None, :]
     row = jnp.arange(s)[None, None, :, None]
-    return col <= (pos.astype(jnp.int32) + row)
+    keep = col <= (pos.astype(jnp.int32) + row)
+    if prompt_lens is not None:
+        valid = ((col < prompt_lens.astype(jnp.int32)[:, None, None, None])
+                 | (col >= prefill_cap))
+        keep = keep & valid
+    return keep

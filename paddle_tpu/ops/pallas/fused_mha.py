@@ -156,13 +156,14 @@ def _bwd_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, dqkv_ref,
         jnp.concatenate(dvs, axis=-1).astype(dt)
 
 
-def _pick_group(nh, hd, s, itemsize, n_bufs):
+def _pick_group(nh, hd, s, itemsize, n_bufs, fixed_bytes=0):
     """Largest G dividing nh whose blocks fit the VMEM plan.
 
     n_bufs: resident (S, G·hd) stream buffers — inputs are double-buffered
     by the pipeline (count 2×), plus ~4 f32 (S,S) ephemerals for the
-    score/prob/grad matrices."""
-    eph = 4 * s * s * 4
+    score/prob/grad matrices. fixed_bytes: group-size-independent residents
+    (the backward's full (S, 3F) dqkv output block, double-buffered)."""
+    eph = 4 * s * s * 4 + fixed_bytes
     aligned = [G for G in range(nh, 0, -1)
                if nh % G == 0 and (G * hd) % 128 == 0]
     if not aligned:
@@ -242,9 +243,11 @@ def _mha_vjp_bwd(nh, scale, kv_len, causal, drop_p, G, interpret, res, g_out):
     b, s, F3 = qkv.shape
     F = F3 // 3
     hd = F // nh
-    # the backward streams q,k,v,do in plus the resident (S,3F) dqkv
-    # block out (~= 7 group-sized buffers) — re-plan its own head group
-    Gb = min(G, _pick_group(nh, hd, s, qkv.dtype.itemsize, n_bufs=7))
+    # the backward streams 4 group-sized buffers (q,k,v,do in) plus the
+    # FULL (S, 3F) dqkv output block, which is group-size-independent and
+    # double-buffered across the batch grid dim — budget it as fixed
+    Gb = min(G, _pick_group(nh, hd, s, qkv.dtype.itemsize, n_bufs=4,
+                            fixed_bytes=2 * s * F3 * qkv.dtype.itemsize))
     while Gb > 1 and (nh % Gb or (Gb * hd) % 128):
         Gb -= 1
     n_groups = nh // Gb

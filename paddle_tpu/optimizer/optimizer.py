@@ -359,11 +359,20 @@ class Adam(Optimizer):
         md = self._moment_dtype
         return {"moment1": m.astype(md), "moment2": v.astype(md)}
 
+    # elementwise update math: concatenating params changes nothing, so the
+    # fused multi-tensor apply in TrainStep may group small params into one
+    # flat update (reference analog: distributed_fused_lamb.py:82's
+    # flattened apply; LAMB itself is NOT elementwise — per-tensor trust
+    # ratios — which is why this flag lives on the Adam family only)
+    _fusable_elementwise = True
+
     def update(self, param, grad, state, lr, step, wd=0.0):
         b1, b2, eps = self._beta1, self._beta2, self._eps
         g = grad.astype(jnp.float32)
         p32 = param.astype(jnp.float32)
-        if wd:  # L2-regularization semantics (coupled), like reference Adam+L2Decay
+        # L2-regularization semantics (coupled), like reference Adam+L2Decay;
+        # wd may be a per-element vector under the fused multi-tensor apply
+        if isinstance(wd, jnp.ndarray) or wd:
             g = g + wd * p32
         m, v = self._moments(state, g, b1, b2)
         t = step.astype(jnp.float32)

@@ -362,3 +362,87 @@ def test_generate_eos_early_stop():
     short = m.generate(one, max_new_tokens=8, eos_token_id=eos).numpy()
     assert short.shape[1] < ref.shape[1], short.shape
     assert short[0, -1] == eos
+
+
+def test_generate_static_ragged_one_program():
+    """Ragged serving (VERDICT r3 #7a): one compiled program serves any
+    prompt length <= cap — per-row greedy parity with generate_static on
+    the unpadded prompts, and a second lengths-pattern must NOT add a new
+    executable to the cache."""
+    import numpy as np
+    paddle.seed(3)
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+                    max_position_embeddings=64, intermediate_size=64)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    rng = np.random.RandomState(0)
+    P_cap, new = 10, 6
+    lens = [4, 10, 7]
+    prompts = np.zeros((3, P_cap), np.int64)
+    rows = []
+    for i, ln in enumerate(lens):
+        row = rng.randint(1, 96, (ln,))
+        prompts[i, :ln] = row
+        rows.append(row)
+
+    out = m.generate_static_ragged(
+        paddle.to_tensor(prompts), lens, max_new_tokens=new).numpy()
+    assert out.shape == (3, P_cap + new)
+
+    for i, ln in enumerate(lens):
+        single = m.generate_static(
+            paddle.to_tensor(rows[i][None]), max_new_tokens=new).numpy()[0]
+        np.testing.assert_array_equal(out[i, P_cap:], single[ln:],
+                                      err_msg=f"row {i} len {ln}")
+
+    n_exec = len(m._gen_static_cache)
+    lens2 = [9, 2, 5]
+    prompts2 = np.zeros((3, P_cap), np.int64)
+    for i, ln in enumerate(lens2):
+        prompts2[i, :ln] = rng.randint(1, 96, (ln,))
+    _ = m.generate_static_ragged(paddle.to_tensor(prompts2), lens2,
+                                 max_new_tokens=new)
+    assert len(m._gen_static_cache) == n_exec  # SAME executable reused
+
+
+def test_generate_static_ragged_eos_and_sampling():
+    import numpy as np
+    paddle.seed(4)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1, num_heads=4,
+                    max_position_embeddings=48, intermediate_size=64)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    prompts = np.zeros((2, 6), np.int64)
+    prompts[0, :3] = [5, 6, 7]
+    prompts[1, :6] = [8, 9, 10, 11, 12, 13]
+    out = m.generate_static_ragged(
+        paddle.to_tensor(prompts), [3, 6], max_new_tokens=5,
+        temperature=0.8, top_k=8, seed=11).numpy()
+    assert out.shape == (2, 11)
+    assert np.all((out[:, 6:] >= 0) & (out[:, 6:] < 64))
+
+
+def test_generate_static_int8_weights(monkeypatch):
+    """Weight-only int8 decode (VERDICT r3 #7b): quantized payload
+    generates near-greedy-parity output on a toy model and never NaNs."""
+    import numpy as np
+    monkeypatch.setenv("PADDLE_TPU_Q8_DECODE_MIN", "4096")  # toy-size gate
+    paddle.seed(5)
+    cfg = GPTConfig(vocab_size=96, hidden_size=128, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    intermediate_size=256)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(1, 96, (2, 8)).astype(np.int64))
+    full = m.generate_static(ids, max_new_tokens=8).numpy()
+    q8 = m.generate_static(ids, max_new_tokens=8, weight_dtype="int8").numpy()
+    assert q8.shape == full.shape
+    # per-channel int8 weights keep greedy decode mostly on-trajectory for
+    # a toy model; exact parity is not the contract (weights ARE perturbed)
+    agree = (q8[:, 8:] == full[:, 8:]).mean()
+    assert agree >= 0.5, f"int8 decode diverged: agreement {agree}"
+    # quantized payload is cached: second call must reuse it
+    assert m._q8_decode_cache is m._decode_quantized_params()
+    # a >=1M-param weight must actually be int8 in the payload
+    assert any(q.dtype == np.int8 for q, _ in m._q8_decode_cache.values())
