@@ -446,3 +446,41 @@ def test_generate_static_int8_weights(monkeypatch):
     assert m._q8_decode_cache is m._decode_quantized_params()
     # a >=1M-param weight must actually be int8 in the payload
     assert any(q.dtype == np.int8 for q, _ in m._q8_decode_cache.values())
+
+
+def test_fused_small_param_update_parity(monkeypatch):
+    """The fused multi-tensor optimizer apply (TrainStep) must produce
+    numerically identical params/moments to the per-param loop — it is the
+    same elementwise math on a concatenation."""
+    import numpy as np
+    from paddle_tpu.jit.train_step import TrainStep
+
+    def build():
+        paddle.seed(9)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=4, max_position_embeddings=32,
+                        intermediate_size=64)
+        m = GPTForCausalLM(cfg)
+        o = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                   parameters=m.parameters(),
+                                   weight_decay=0.01)
+        s = TrainStep(m, o, lambda a, b: m.loss(a, b, chunk_size=64))
+        return m, s
+
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 64, (4, 16)).astype("int32"))
+
+    monkeypatch.setenv("PADDLE_TPU_FUSE_SMALL_UPDATES", "0")
+    m0, s0 = build()
+    l0 = [float(s0(ids, ids)) for _ in range(3)]
+
+    monkeypatch.setenv("PADDLE_TPU_FUSE_SMALL_UPDATES", "262144")
+    m1, s1 = build()
+    l1 = [float(s1(ids, ids)) for _ in range(3)]
+
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    for p0, p1 in zip(m0.parameters(), m1.parameters()):
+        np.testing.assert_allclose(np.asarray(p0._data, np.float64),
+                                   np.asarray(p1._data, np.float64),
+                                   rtol=1e-6, atol=1e-7,
+                                   err_msg=p0.name)
