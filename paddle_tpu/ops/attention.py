@@ -53,6 +53,14 @@ def attention_reference(q, k, v, mask=None, is_causal=False, scale=None,
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     dt = q.dtype
+    import os
+    if os.environ.get("PADDLE_TPU_SCORE_F32") == "1":
+        # advisor r3: models hard-wire score_dtype=model-dtype for the
+        # measured HBM win; this env reverts EVERY attention to exact f32
+        # stored scores for convergence-sensitivity checks without code
+        # changes (the Pallas kernels are unaffected — their scores are
+        # f32-in-VMEM always)
+        score_dtype = None
     sdt = jnp.dtype(score_dtype) if score_dtype is not None else jnp.float32
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32)

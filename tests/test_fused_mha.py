@@ -109,3 +109,19 @@ class TestDropout:
         qkv = _rand_qkv(1, 128, 2, 64)
         with pytest.raises(ValueError):
             fused_mha(qkv, 2, dropout_p=0.1)
+
+
+def test_score_f32_env_override(monkeypatch):
+    """PADDLE_TPU_SCORE_F32=1 reverts bf16 score storage to exact f32
+    everywhere (advisor r3: give users a no-code-change convergence
+    check for the models that hard-wire score_dtype=model dtype)."""
+    from paddle_tpu.ops.attention import attention_reference
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(rng.randn(1, 16, 2, 8).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    exact = attention_reference(q, k, v)                     # f32 scores
+    half = attention_reference(q, k, v, score_dtype=jnp.bfloat16)
+    assert np.abs(np.asarray(exact) - np.asarray(half)).max() > 0
+    monkeypatch.setenv("PADDLE_TPU_SCORE_F32", "1")
+    forced = attention_reference(q, k, v, score_dtype=jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(forced), np.asarray(exact))

@@ -1,0 +1,66 @@
+"""Round-4 measurement batch (run ALONE on the TPU — concurrent compiles
+can kill the relay helper).
+
+Rows: flagship with/without the fused small-param optimizer apply,
+ViT-L at B=64, decode bf16 vs int8 weights. One process, sequential,
+gc between rows; prints one JSON line per row.
+"""
+import gc
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+import bench
+
+
+def main():
+    which = sys.argv[1:] or ["flagship_ab", "flagship_q8", "vit64",
+                             "decode_ab"]
+
+    if "flagship_ab" in which:
+        os.environ["PADDLE_TPU_FUSE_SMALL_UPDATES"] = "262144"
+        r = bench.bench_gpt(True)
+        r["extra"]["variant"] = "fused-small-updates"
+        print(json.dumps({"variant": "flagship fused", "v": r["value"],
+                          "mfu": r["extra"]["mfu"]}), flush=True)
+        gc.collect()
+        os.environ["PADDLE_TPU_FUSE_SMALL_UPDATES"] = "0"
+        r = bench.bench_gpt(True)
+        print(json.dumps({"variant": "flagship loop", "v": r["value"],
+                          "mfu": r["extra"]["mfu"]}), flush=True)
+        os.environ.pop("PADDLE_TPU_FUSE_SMALL_UPDATES", None)
+        gc.collect()
+
+    if "flagship_q8" in which:
+        # moment traffic at bf16 is ~10GB/step of the flagship's HBM
+        # budget; blockwise-int8 moments (the 2.7B fit mechanism) halve it
+        r = bench.bench_gpt(True, moment_dtype="int8")
+        print(json.dumps({"variant": "flagship int8-moments",
+                          "v": r["value"], "mfu": r["extra"]["mfu"],
+                          "loss": r["extra"]["loss"]}), flush=True)
+        gc.collect()
+
+    if "vit64" in which:
+        os.environ["PADDLE_TPU_BENCH_B"] = "64"
+        r = bench.bench_vit(True)
+        print(json.dumps({"variant": "vit B=64", "v": r["value"],
+                          "mfu": r["extra"]["mfu"]}), flush=True)
+        os.environ.pop("PADDLE_TPU_BENCH_B", None)
+        gc.collect()
+
+    if "decode_ab" in which:
+        r = bench.bench_decode(True)
+        print(json.dumps({"variant": "decode bf16", "v": r["value"]}),
+              flush=True)
+        gc.collect()
+        os.environ["PADDLE_TPU_BENCH_DECODE_W8"] = "1"
+        r = bench.bench_decode(True)
+        print(json.dumps({"variant": "decode int8", "v": r["value"]}),
+              flush=True)
+        os.environ.pop("PADDLE_TPU_BENCH_DECODE_W8", None)
+
+
+if __name__ == "__main__":
+    main()
