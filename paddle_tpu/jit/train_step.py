@@ -270,13 +270,13 @@ class TrainStep:
             fuse_t = int(_os.environ.get("PADDLE_TPU_FUSE_SMALL_UPDATES",
                                          "262144"))
             groups = {}
+            fkeys = tuple(getattr(opt, "_fused_state_keys", ()))
             if getattr(opt, "_fusable_elementwise", False) and fuse_t > 0:
                 for i, (pa, st) in enumerate(zip(param_arrays, opt_state)):
-                    if (pa.size <= fuse_t and st is not None
-                            and set(st) == {"moment1", "moment2"}
-                            and pa.ndim >= 1):
-                        key_g = (str(pa.dtype), str(st["moment1"].dtype),
-                                 str(st["moment2"].dtype))
+                    if (pa.size <= fuse_t and pa.ndim >= 1
+                            and st is not None and set(st) == set(fkeys)):
+                        key_g = (str(pa.dtype),) + tuple(
+                            str(st[k].dtype) for k in fkeys)
                         groups.setdefault(key_g, []).append(i)
             fused_idx = set()
             for idxs in groups.values():
@@ -294,7 +294,7 @@ class TrainStep:
                 flat_st = {
                     k: jnp.concatenate(
                         [opt_state[i][k].reshape(-1) for i in idxs])
-                    for k in ("moment1", "moment2")}
+                    for k in fkeys}
                 wd_vec = jnp.concatenate(
                     [jnp.full((param_arrays[i].size,), float(wds[i]),
                               jnp.float32) for i in idxs])
@@ -305,7 +305,7 @@ class TrainStep:
                     new_params[i] = fp[sl].reshape(param_arrays[i].shape)
                     new_state[i] = {
                         k: fs[k][sl].reshape(opt_state[i][k].shape)
-                        for k in ("moment1", "moment2")}
+                        for k in fkeys}
             for i, (pa, g, st, wd) in enumerate(
                     zip(param_arrays, grads, opt_state, wds)):
                 if i in fused_idx:

@@ -192,15 +192,25 @@ class Optimizer:
 class SGD(Optimizer):
     """Reference: optimizer/sgd.py → phi sgd kernel."""
 
+    _fusable_elementwise = True
+    _fused_state_keys = ()
+
     def update(self, param, grad, state, lr, step, wd=0.0):
         g = grad.astype(jnp.float32)
-        if wd:
+        if isinstance(wd, jnp.ndarray) or wd:
             g = g + wd * param.astype(jnp.float32)
         return (param - lr * g.astype(param.dtype)).astype(param.dtype), state
 
 
 class Momentum(Optimizer):
     """Reference: optimizer/momentum.py (use_nesterov supported)."""
+
+    # elementwise math — safe for the fused multi-tensor apply; the win is
+    # biggest here: conv nets have hundreds of tiny BN scale/bias params
+    # (r3 ResNet-50 profile: 628 per-weight update fusions, 5.8 ms of a
+    # 38 ms step)
+    _fusable_elementwise = True
+    _fused_state_keys = ("velocity",)
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
@@ -213,7 +223,8 @@ class Momentum(Optimizer):
 
     def update(self, param, grad, state, lr, step, wd=0.0):
         g = grad.astype(jnp.float32)
-        if wd:
+        # wd may be a per-element vector under the fused multi-tensor apply
+        if isinstance(wd, jnp.ndarray) or wd:
             g = g + wd * param.astype(jnp.float32)
         v = self._momentum * state["velocity"] + g
         if self._nesterov:
@@ -363,8 +374,9 @@ class Adam(Optimizer):
     # fused multi-tensor apply in TrainStep may group small params into one
     # flat update (reference analog: distributed_fused_lamb.py:82's
     # flattened apply; LAMB itself is NOT elementwise — per-tensor trust
-    # ratios — which is why this flag lives on the Adam family only)
+    # ratios — which is why only elementwise optimizers carry this flag)
     _fusable_elementwise = True
+    _fused_state_keys = ("moment1", "moment2")
 
     def update(self, param, grad, state, lr, step, wd=0.0):
         b1, b2, eps = self._beta1, self._beta2, self._eps

@@ -484,3 +484,36 @@ def test_fused_small_param_update_parity(monkeypatch):
                                    np.asarray(p1._data, np.float64),
                                    rtol=1e-6, atol=1e-7,
                                    err_msg=p0.name)
+
+
+def test_fused_small_param_update_parity_momentum(monkeypatch):
+    """Momentum joins the fused multi-tensor apply (the big customer is
+    ResNet's 628 BN/bias updates): parity vs the per-param loop."""
+    import numpy as np
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit.train_step import TrainStep
+
+    def build():
+        paddle.seed(2)
+        m = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 8))
+        o = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                      parameters=m.parameters(),
+                                      weight_decay=0.001)
+        ce = nn.MSELoss()
+        s = TrainStep(m, o, lambda a, b: ce(m(a), b))
+        return m, s
+
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(8, 16).astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(8, 8).astype("float32"))
+    monkeypatch.setenv("PADDLE_TPU_FUSE_SMALL_UPDATES", "0")
+    m0, s0 = build()
+    l0 = [float(s0(x, y)) for _ in range(3)]
+    monkeypatch.setenv("PADDLE_TPU_FUSE_SMALL_UPDATES", "262144")
+    m1, s1 = build()
+    l1 = [float(s1(x, y)) for _ in range(3)]
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    for p0, p1 in zip(m0.parameters(), m1.parameters()):
+        np.testing.assert_allclose(np.asarray(p0._data), np.asarray(p1._data),
+                                   rtol=1e-6, atol=1e-7)
