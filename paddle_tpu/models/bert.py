@@ -126,7 +126,7 @@ class BertAttention(Layer):
         self.dropout = Dropout(config.hidden_dropout)
         self.attn_dropout_p = config.attention_dropout
 
-    def forward(self, x, attention_mask=None):
+    def forward(self, x, attention_mask=None, seq_lens=None):
         import jax
         import jax.numpy as jnp
         from ..core import random as _random
@@ -139,6 +139,35 @@ class BertAttention(Layer):
         b, s = qkv.shape[0], qkv.shape[1]
         attn_p = self.attn_dropout_p if self.training else 0.0
         dk = _random.split_key() if attn_p > 0.0 else None
+
+        if (seq_lens is not None and attention_mask is None
+                and use_fused_mha(s, nh, hd)
+                and _mesh.mesh_axis_size("mp") == 1
+                and _mesh.mesh_axis_size("sp") == 1):
+            # RIGHT-PADDED batches via explicit lengths (beyond-reference
+            # fast path): the fused kernel masks key columns >= len[b] per
+            # batch row from an SMEM table — the padding mask never exists
+            # as an S x S tensor, and in-kernel dropout still applies.
+            # Padded QUERY rows compute garbage that the loss masks out.
+            def attend_lens(a, lens):
+                seed = None
+                if attn_p > 0.0:
+                    seed = jax.random.randint(dk, (), 0, 2 ** 31 - 1)
+                return fused_mha(a, nh, kv_len=lens, dropout_p=attn_p,
+                                 dropout_seed=seed)
+
+            ctx = apply_op("bert_attention", attend_lens, [qkv, seq_lens])
+            y = self.out(ctx)
+            if self.training and self.dropout.p:
+                y = self.dropout(y)
+            return y
+        if seq_lens is not None:
+            # fallback platforms: lengths become a bool keep-mask
+            attention_mask = apply_op(
+                "lens_to_mask",
+                lambda l: (jnp.arange(s)[None, :]
+                           < l.astype(jnp.int32)[:, None]).astype(jnp.int32),
+                [seq_lens])
 
         if (attention_mask is None and use_fused_mha(s, nh, hd)
                 and _mesh.mesh_axis_size("mp") == 1
@@ -210,8 +239,9 @@ class BertLayer(Layer):
         self.ln_2 = LayerNorm(h, epsilon=config.layer_norm_epsilon)
         self.dropout = Dropout(config.hidden_dropout)
 
-    def forward(self, x, attention_mask=None):
-        x = self.ln_1(x + self.attention(x, attention_mask))
+    def forward(self, x, attention_mask=None, seq_lens=None):
+        x = self.ln_1(x + self.attention(x, attention_mask,
+                                         seq_lens=seq_lens))
         y = self.down(F.gelu(self.up(x), approximate=True))
         if self.training and self.dropout.p:
             y = self.dropout(y)
@@ -252,10 +282,13 @@ class BertModel(Layer):
             self.to(dtype=config.param_dtype)
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
-                attention_mask=None):
+                attention_mask=None, seq_lens=None):
+        """seq_lens (beyond-reference fast path): per-row valid lengths of
+        a RIGHT-padded batch — routes the padding mask into the fused MHA
+        kernel's SMEM table instead of an S x S mask tensor."""
         x = self.embeddings(input_ids, token_type_ids, position_ids)
         for layer in self.encoder:
-            x = layer(x, attention_mask)
+            x = layer(x, attention_mask, seq_lens=seq_lens)
         return x, self.pooler(x)
 
 
@@ -278,14 +311,15 @@ class BertForMaskedLM(Layer):
         return _tied_logits(self._mlm_hidden(seq),
                             self.bert.embeddings.word_embeddings)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                seq_lens=None):
         seq, _ = self.bert(input_ids, token_type_ids,
-                           attention_mask=attention_mask)
+                           attention_mask=attention_mask, seq_lens=seq_lens)
         return self.mlm_logits(seq)
 
     def loss(self, input_ids, labels, token_type_ids=None,
              attention_mask=None, loss_mask=None, chunk_size: int = 256,
-             ignore_index: int = -100):
+             ignore_index: int = -100, seq_lens=None):
         """Fused MLM loss: the tied decoder matmul runs inside the chunked
         linear+softmax-CE (incubate.nn.functional), so [B, S, vocab] logits
         never materialize — same mechanism as GPTForCausalLM.loss().
@@ -295,7 +329,7 @@ class BertForMaskedLM(Layer):
         from ..core import ops
         from .gpt import _masked_mean
         seq, _ = self.bert(input_ids, token_type_ids,
-                           attention_mask=attention_mask)
+                           attention_mask=attention_mask, seq_lens=seq_lens)
         h = self._mlm_hidden(seq)
         w = self.bert.embeddings.word_embeddings.weight
         safe_labels = ops.where(labels == ignore_index,

@@ -85,8 +85,12 @@ def _head(ref, j, hd):
     return ref[0, :, j * hd:(j + 1) * hd]
 
 
-def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, *, nh, hd, G, scale,
-                kv_len, causal, drop_p):
+def _fwd_kernel(seed_ref, *rest, nh, hd, G, scale, kv_len, causal, drop_p,
+                per_row_lens=False):
+    if per_row_lens:
+        lens_ref, q_ref, k_ref, v_ref, o_ref = rest
+    else:
+        q_ref, k_ref, v_ref, o_ref = rest
     bi, g = pl.program_id(0), pl.program_id(1)
     for j in range(G):
         q = _head(q_ref, j, hd)
@@ -94,7 +98,11 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, *, nh, hd, G, scale,
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask_2d(s)
-        if kv_len is not None:
+        if per_row_lens:
+            # per-batch-row valid length (right-padded batches): the SMEM
+            # scalar load by traced bi keeps the mask in-register
+            s = _kv_mask_2d(s, lens_ref[bi, 0])
+        elif kv_len is not None:
             s = _kv_mask_2d(s, kv_len)
         p = _softmax_f32(s)
         if drop_p > 0.0:
@@ -105,8 +113,12 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, *, nh, hd, G, scale,
             preferred_element_type=jnp.float32).astype(o_ref.dtype)
 
 
-def _bwd_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, dqkv_ref,
-                *, nh, hd, G, scale, kv_len, causal, drop_p):
+def _bwd_kernel(seed_ref, *rest, nh, hd, G, scale, kv_len, causal, drop_p,
+                per_row_lens=False):
+    if per_row_lens:
+        lens_ref, q_ref, k_ref, v_ref, do_ref, dqkv_ref = rest
+    else:
+        q_ref, k_ref, v_ref, do_ref, dqkv_ref = rest
     # dqkv_ref is the FULL (1, S, 3F) packed-gradient block, resident
     # across the head-group grid dim — each group writes its own column
     # span, so d(qkv) leaves the kernel already concatenated (the layout
@@ -125,7 +137,9 @@ def _bwd_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, dqkv_ref,
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask_2d(s)
-        if kv_len is not None:
+        if per_row_lens:
+            s = _kv_mask_2d(s, lens_ref[bi, 0])
+        elif kv_len is not None:
             s = _kv_mask_2d(s, kv_len)
         sigma = _softmax_f32(s)
         dpd = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
@@ -206,40 +220,54 @@ def _specs(G, hd, s, n_groups):
     return at
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8))
-def _mha(qkv, seed, nh, scale, kv_len, causal, drop_p, G, interpret):
-    return _mha_fwd(qkv, seed, nh, scale, kv_len, causal, drop_p, G,
-                    interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _mha(qkv, seed, lensf, nh, scale, kv_len, causal, drop_p, G, interpret,
+         use_lens):
+    return _mha_fwd(qkv, seed, lensf, nh, scale, kv_len, causal, drop_p, G,
+                    interpret, use_lens)
 
 
-def _mha_fwd(qkv, seed, nh, scale, kv_len, causal, drop_p, G, interpret):
+def _lens_spec(b):
+    # full [B,1] i32 table in SMEM; every program reads its own row
+    return pl.BlockSpec((b, 1), lambda bi, g: (_i0(), _i0()),
+                        memory_space=pltpu.SMEM)
+
+
+def _mha_fwd(qkv, seed, lensf, nh, scale, kv_len, causal, drop_p, G,
+             interpret, use_lens):
     b, s, F3 = qkv.shape
     F = F3 // 3
     hd = F // nh
     n_groups = nh // G
     at = _specs(G, hd, s, n_groups)
+    extra_specs = [_lens_spec(b)] if use_lens else []
+    extra_args = [lensf.astype(jnp.int32)] if use_lens else []
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, nh=nh, hd=hd, G=G, scale=scale,
-                          kv_len=kv_len, causal=causal, drop_p=drop_p),
+                          kv_len=kv_len, causal=causal, drop_p=drop_p,
+                          per_row_lens=use_lens),
         out_shape=jax.ShapeDtypeStruct((b, s, F), qkv.dtype),
         grid=(b, n_groups),
         in_specs=[
-            _smem_spec(),
+            _smem_spec(), *extra_specs,
             at(0), at(1), at(2),
         ],
         out_specs=pl.BlockSpec((1, s, G * hd), lambda bi, g: (bi, _i0(), g)),
         interpret=interpret,
-    )(seed.astype(jnp.int32), qkv, qkv, qkv)
+    )(seed.astype(jnp.int32), *extra_args, qkv, qkv, qkv)
     return out
 
 
-def _mha_vjp_fwd(qkv, seed, nh, scale, kv_len, causal, drop_p, G, interpret):
-    out = _mha_fwd(qkv, seed, nh, scale, kv_len, causal, drop_p, G, interpret)
-    return out, (qkv, seed)
+def _mha_vjp_fwd(qkv, seed, lensf, nh, scale, kv_len, causal, drop_p, G,
+                 interpret, use_lens):
+    out = _mha_fwd(qkv, seed, lensf, nh, scale, kv_len, causal, drop_p, G,
+                   interpret, use_lens)
+    return out, (qkv, seed, lensf)
 
 
-def _mha_vjp_bwd(nh, scale, kv_len, causal, drop_p, G, interpret, res, g_out):
-    qkv, seed = res
+def _mha_vjp_bwd(nh, scale, kv_len, causal, drop_p, G, interpret, use_lens,
+                 res, g_out):
+    qkv, seed, lensf = res
     b, s, F3 = qkv.shape
     F = F3 // 3
     hd = F // nh
@@ -253,20 +281,23 @@ def _mha_vjp_bwd(nh, scale, kv_len, causal, drop_p, G, interpret, res, g_out):
     n_groups = nh // Gb
     at = _specs(Gb, hd, s, n_groups)
     gspec = pl.BlockSpec((1, s, Gb * hd), lambda bi, gg: (bi, _i0(), gg))
+    extra_specs = [_lens_spec(b)] if use_lens else []
+    extra_args = [lensf.astype(jnp.int32)] if use_lens else []
     dqkv = pl.pallas_call(
         functools.partial(_bwd_kernel, nh=nh, hd=hd, G=Gb, scale=scale,
-                          kv_len=kv_len, causal=causal, drop_p=drop_p),
+                          kv_len=kv_len, causal=causal, drop_p=drop_p,
+                          per_row_lens=use_lens),
         out_shape=jax.ShapeDtypeStruct((b, s, F3), qkv.dtype),
         grid=(b, n_groups),
         in_specs=[
-            _smem_spec(),
+            _smem_spec(), *extra_specs,
             at(0), at(1), at(2), gspec,
         ],
         out_specs=pl.BlockSpec((1, s, F3),
                                lambda bi, gg: (bi, _i0(), _i0())),
         interpret=interpret,
-    )(seed.astype(jnp.int32), qkv, qkv, qkv, g_out)
-    return dqkv, jnp.zeros_like(seed)
+    )(seed.astype(jnp.int32), *extra_args, qkv, qkv, qkv, g_out)
+    return dqkv, jnp.zeros_like(seed), jnp.zeros_like(lensf)
 
 
 _mha.defvjp(_mha_vjp_fwd, _mha_vjp_bwd)
@@ -334,6 +365,11 @@ def fused_mha(qkv, num_heads, *, scale=None, kv_len=None, causal=False,
         scale = 1.0 / math.sqrt(hd)
     if dropout_p > 0.0 and dropout_seed is None:
         raise ValueError("fused_mha: dropout_p > 0 requires dropout_seed")
+    lens_arr = None
+    if kv_len is not None and not isinstance(kv_len, int):
+        # per-batch-row valid lengths (right-padded batches) — [B] ints
+        lens_arr = jnp.asarray(kv_len, jnp.float32).reshape(b, 1)
+        kv_len = None
     if kv_len is not None and kv_len <= 0:
         raise ValueError(f"fused_mha: kv_len must be positive, got {kv_len}")
     # No sequence padding: Mosaic masks unaligned block dims natively
@@ -350,6 +386,9 @@ def fused_mha(qkv, num_heads, *, scale=None, kv_len=None, causal=False,
         seed = jnp.zeros((1, 1), jnp.float32)
     G = heads_per_program or _pick_group(num_heads, hd, s, qkv.dtype.itemsize,
                                          n_bufs=4)
-    return _mha(qkv, seed, int(num_heads), float(scale),
+    use_lens = lens_arr is not None
+    if lens_arr is None:
+        lens_arr = jnp.zeros((b, 1), jnp.float32)   # float carrier (vjp)
+    return _mha(qkv, seed, lens_arr, int(num_heads), float(scale),
                 None if kv_len is None else int(kv_len), bool(causal),
-                float(dropout_p), int(G), bool(interpret))
+                float(dropout_p), int(G), bool(interpret), bool(use_lens))

@@ -125,3 +125,36 @@ def test_score_f32_env_override(monkeypatch):
     monkeypatch.setenv("PADDLE_TPU_SCORE_F32", "1")
     forced = attention_reference(q, k, v, score_dtype=jnp.bfloat16)
     np.testing.assert_array_equal(np.asarray(forced), np.asarray(exact))
+
+
+def test_fused_mha_per_row_lengths():
+    """kv_len as a [B] array: per-row padding masks (right-padded batches)
+    match the masked reference row by row, fwd and grads."""
+    qkv = _rand_qkv(3, 64, 2, 64, seed=11)
+    lens = jnp.asarray([64, 40, 17], jnp.int32)
+    out = fused_mha(qkv, 2, kv_len=lens, interpret=True)
+    for i, ln in enumerate([64, 40, 17]):
+        want = mha_reference_packed(qkv[i:i + 1], 2, kv_len=ln)
+        np.testing.assert_allclose(np.asarray(out[i:i + 1, :ln]),
+                                   np.asarray(want[:, :ln]),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"row {i} len {ln}")
+
+    def f(a):
+        o = fused_mha(a, 2, kv_len=lens, interpret=True)
+        # only valid query rows contribute (padded-row outputs are garbage
+        # by contract — the model discards them)
+        m = (jnp.arange(64)[None, :, None] < lens[:, None, None])
+        return jnp.sum(jnp.where(m, o, 0.0) ** 2)
+
+    def f_ref(a):
+        tot = 0.0
+        for i, ln in enumerate([64, 40, 17]):
+            o = mha_reference_packed(a[i:i + 1], 2, kv_len=ln)
+            tot = tot + jnp.sum(o[:, :ln] ** 2)
+        return tot
+
+    gk = jax.grad(f)(qkv)
+    gr = jax.grad(f_ref)(qkv)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gr),
+                               rtol=2e-3, atol=2e-3)

@@ -240,3 +240,30 @@ def test_ernie_fused_mlm_loss_finite_and_trains():
     for _ in range(4):
         l = float(step(ids, ids))
     assert l < l0
+
+
+def test_bert_seq_lens_matches_mask_path():
+    """seq_lens (per-row lengths) must equal the equivalent bool padding
+    mask on the reference path — CPU uses the fallback conversion, TPU
+    routes into the fused kernel's SMEM table."""
+    import numpy as np
+    paddle.seed(6)
+    cfg = bert_config("bert-base", hidden_size=64, num_layers=2, num_heads=4,
+                      vocab_size=128, intermediate_size=128,
+                      hidden_dropout=0.0, attention_dropout=0.0)
+    m = BertModel(cfg)
+    m.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(1, 128, (3, 24)).astype("int32"))
+    lens = [24, 15, 7]
+    mask = np.zeros((3, 24), np.int32)
+    for i, ln in enumerate(lens):
+        mask[i, :ln] = 1
+    seq_a, _ = m(ids, attention_mask=paddle.to_tensor(mask))
+    seq_b, _ = m(ids, seq_lens=paddle.to_tensor(
+        np.asarray(lens, np.int32)))
+    for i, ln in enumerate(lens):
+        np.testing.assert_allclose(seq_a.numpy()[i, :ln],
+                                   seq_b.numpy()[i, :ln],
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"row {i}")
