@@ -258,6 +258,74 @@ class optimizer:
 
     AdagradOptimizer = Adagrad
 
+    # ---- the rest of the fluid/optimizer.py class roster (reference
+    # fluid/optimizer.py:92-2762) over the modern rules; each keeps the
+    # fluid-era kwargs via _translate ----
+    @staticmethod
+    def AdamW(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+              **kw):  # noqa: N802
+        from .. import optimizer as _opt
+        kw = optimizer._translate(kw)
+        wd = kw.pop("weight_decay", 0.01)
+        return _opt.AdamW(learning_rate=learning_rate, beta1=beta1,
+                          beta2=beta2, epsilon=epsilon, weight_decay=wd,
+                          **kw)
+
+    AdamWOptimizer = AdamW
+
+    @staticmethod
+    def Adamax(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+               **kw):  # noqa: N802
+        from .. import optimizer as _opt
+        return _opt.Adamax(learning_rate=learning_rate, beta1=beta1,
+                           beta2=beta2, epsilon=epsilon,
+                           **optimizer._translate(kw))
+
+    AdamaxOptimizer = Adamax
+
+    @staticmethod
+    def Adadelta(learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 **kw):  # noqa: N802
+        from .. import optimizer as _opt
+        return _opt.Adadelta(learning_rate=learning_rate, epsilon=epsilon,
+                             rho=rho, **optimizer._translate(kw))
+
+    AdadeltaOptimizer = Adadelta
+
+    @staticmethod
+    def RMSProp(learning_rate=0.001, rho=0.95, epsilon=1e-6, momentum=0.0,
+                centered=False, **kw):  # noqa: N802
+        from .. import optimizer as _opt
+        return _opt.RMSProp(learning_rate=learning_rate, rho=rho,
+                            epsilon=epsilon, momentum=momentum,
+                            centered=centered, **optimizer._translate(kw))
+
+    RMSPropOptimizer = RMSProp
+
+    @staticmethod
+    def Lamb(learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+             beta2=0.999, epsilon=1e-6, **kw):  # noqa: N802
+        from .. import optimizer as _opt
+        kw = optimizer._translate(kw)
+        kw.pop("weight_decay", None)
+        return _opt.Lamb(learning_rate=learning_rate,
+                         lamb_weight_decay=lamb_weight_decay, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon, **kw)
+
+    LambOptimizer = Lamb
+
+    @staticmethod
+    def LarsMomentum(learning_rate=0.001, momentum=0.9,
+                     lars_coeff=0.001, lars_weight_decay=0.0005,
+                     **kw):  # noqa: N802
+        from .. import optimizer as _opt
+        return _opt.LarsMomentum(learning_rate=learning_rate,
+                                 momentum=momentum, lars_coeff=lars_coeff,
+                                 lars_weight_decay=lars_weight_decay,
+                                 **optimizer._translate(kw))
+
+    LarsMomentumOptimizer = LarsMomentum
+
 
 class initializer:
     """fluid.initializer namespace (reference: fluid/initializer.py)."""
