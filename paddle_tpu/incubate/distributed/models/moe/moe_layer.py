@@ -69,6 +69,31 @@ def _topk_dispatch(probs, top_k: int, capacity: int):
     return combine, dispatch, frac_top1
 
 
+def _topk_routing(probs, top_k: int, capacity: int):
+    """Index-form routing: per (token, slot) the expert id, capacity slot,
+    and keep flag — same GShard cumsum assignment as _topk_dispatch but
+    WITHOUT materializing [N, E, C] one-hot tensors."""
+    n, e = probs.shape
+    gate_vals, idx = lax.top_k(probs, top_k)                  # [N, k]
+    if top_k > 1:
+        denom = jnp.sum(gate_vals, axis=-1, keepdims=True)
+        gate_vals = gate_vals / jnp.maximum(denom, 1e-9)
+    counts = jnp.zeros((e,), jnp.int32)
+    frac_top1 = None
+    locs, keeps = [], []
+    for slot in range(top_k):
+        oh = jax.nn.one_hot(idx[:, slot], e, dtype=jnp.int32)  # [N, E]
+        if frac_top1 is None:
+            frac_top1 = jnp.mean(oh.astype(probs.dtype), axis=0)
+        pos = jnp.cumsum(oh, axis=0) - 1 + counts
+        counts = counts + jnp.sum(oh, axis=0)
+        loc = jnp.sum(pos * oh, axis=-1)                       # [N]
+        locs.append(loc)
+        keeps.append(loc < capacity)
+    return (gate_vals, idx, jnp.stack(locs, 1), jnp.stack(keeps, 1),
+            frac_top1)
+
+
 def _moe_forward(x, gw, w1, b1, w2, b2, *, top_k, capacity_factor, gate_type,
                  activation, ext_logits=None):
     b, s, m = x.shape
@@ -81,6 +106,48 @@ def _moe_forward(x, gw, w1, b1, w2, b2, *, top_k, capacity_factor, gate_type,
         logits = ext_logits.reshape(b * s, e).astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
     cap = _capacity(b * s, e, top_k, capacity_factor)
+    import os
+    n = tokens.shape[0]
+    gather_mode = os.environ.get("PADDLE_TPU_MOE_GATHER", "1") == "1"
+
+    if gather_mode:
+        # INDEX dispatch (r4): the one-hot einsum pair costs
+        # O(N·E·C·M) MXU FLOPs — at the measured bench shape as much as
+        # the experts themselves (66% routing overhead). Scatter each
+        # (token, slot) id into its [E·C] slot and GATHER rows instead:
+        # O(N·k·M) bytes, zero matmul FLOPs. Dropped tokens (loc >= C)
+        # target the sentinel row; empty slots read the appended zero row.
+        gate_vals, idx, locs, keeps, frac = _topk_routing(probs, top_k, cap)
+        me = jnp.mean(probs, axis=0)
+        aux = e * jnp.sum(me * frac) if gate_type in ("gshard", "switch") \
+            else jnp.zeros((), probs.dtype)
+
+        flatpos = idx * cap + locs                             # [N, k]
+        safe_pos = jnp.where(keeps, flatpos, e * cap)          # drop slot
+        src = jnp.full((e * cap,), n, jnp.int32)
+        tok_ids = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None],
+                                   (n, top_k))
+        src = src.at[safe_pos.reshape(-1)].set(tok_ids.reshape(-1),
+                                               mode="drop")
+        tokens_ext = jnp.concatenate(
+            [tokens, jnp.zeros((1, m), tokens.dtype)], axis=0)
+        expert_in = tokens_ext[src].reshape(e, cap, m)
+        expert_in = _mesh.shard_constraint(expert_in, "ep", None, None)
+        h = activation(jnp.einsum("ecm,emh->ech", expert_in, w1)
+                       + b1[:, None, :])
+        out = jnp.einsum("ech,ehm->ecm", h, w2) + b2[:, None, :]
+        out = _mesh.shard_constraint(out, "ep", None, None)
+        out_ext = jnp.concatenate(
+            [out.reshape(e * cap, m), jnp.zeros((1, m), out.dtype)], axis=0)
+        y = jnp.zeros((n, m), x.dtype)
+        for slot in range(top_k):
+            w_slot = (gate_vals[:, slot]
+                      * keeps[:, slot].astype(probs.dtype)).astype(x.dtype)
+            rows = out_ext[jnp.where(keeps[:, slot], flatpos[:, slot],
+                                     e * cap)]
+            y = y + w_slot[:, None] * rows
+        return y.reshape(b, s, m), aux.astype(jnp.float32)
+
     combine, dispatch, frac = _topk_dispatch(probs, top_k, cap)
 
     # load-balance aux loss: GShard/Switch  E * sum_e mean_prob_e * frac_e
