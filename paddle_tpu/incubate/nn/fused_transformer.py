@@ -141,10 +141,31 @@ class FusedMultiHeadAttention(Layer):
             if past:
                 k = jnp.concatenate([past[0], k], axis=1)
                 v = jnp.concatenate([past[1], v], axis=1)
-            if attn_p or mask is not None:
+            o = None
+            if not past and mask is None:
+                # short-seq fused MHA with in-kernel PRNG dropout (the
+                # fused_attention_op.cu capability this layer mirrors):
+                # same 2.2x-class win as BertAttention — the S² dropout
+                # bits never exist in HBM. Pack cost is O(B·S·3F) copies.
+                from ...ops.pallas.fused_mha import fused_mha, use_fused_mha
+                from ...distributed import mesh as _dmesh
+                b_, s_, nh_, hd_ = q.shape
+                if (use_fused_mha(s_, nh_, hd_)
+                        and _dmesh.mesh_axis_size("mp") == 1
+                        and _dmesh.mesh_axis_size("sp") == 1):
+                    qkvp = jnp.concatenate(
+                        [q.reshape(b_, s_, nh_ * hd_),
+                         k.reshape(b_, s_, nh_ * hd_),
+                         v.reshape(b_, s_, nh_ * hd_)], axis=-1)
+                    seed = (jax.random.randint(k_attn, (), 0, 2 ** 31 - 1)
+                            if attn_p else None)
+                    o = fused_mha(qkvp, nh_, dropout_p=attn_p,
+                                  dropout_seed=seed
+                                  ).reshape(b_, s_, nh_, hd_)
+            if o is None and (attn_p or mask is not None):
                 o = attention_reference(q, k, v, mask=mask, dropout_p=attn_p,
                                         dropout_key=k_attn)
-            else:
+            elif o is None:
                 o = functional_attention(q, k, v)
             o = self._mha_tail(o, residual, lw, lb, lns, lnb, out_p, k_out)
             return (o, k, v) if past else o
