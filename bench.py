@@ -2,8 +2,9 @@
 available accelerator (reference gate analog: tools/ci_model_benchmark.sh:50
 benches a model SUITE, not one config).
 
-Default (TPU): runs the FULL ladder — flagship GPT-1.3B, ViT-L, BERT, decode,
-MoE, ResNet-50, GPT-2.7B — printing ONE JSON line per row as it completes,
+Default (TPU): runs the FULL ladder — flagship GPT-1.3B, ViT-L, BERT-base,
+decode, MoE, ResNet-50, BERT-large, ViT-H/14, GPT-2.7B — printing ONE JSON
+line per row as it completes,
 then a final line repeating the flagship row with the whole ladder embedded
 under extra.ladder (the driver parses the LAST line; partial output still
 carries every completed row).
@@ -100,8 +101,8 @@ def bench_resnet50(on_tpu):
     })
 
 
-def bench_bert(on_tpu):
-    """BERT-base MLM pretraining throughput (BASELINE.md config): fused
+def bench_bert(on_tpu, preset=None, B=None):
+    """BERT MLM pretraining throughput (BASELINE.md config): fused
     short-seq MHA kernel with in-kernel PRNG attention dropout."""
     import jax
     import numpy as np
@@ -109,10 +110,12 @@ def bench_bert(on_tpu):
     from paddle_tpu.jit.train_step import TrainStep
     from paddle_tpu.models import BertForMaskedLM, bert_config
 
-    B, S, iters = (32, 512, 8) if on_tpu else (2, 64, 2)
-    B = int(os.environ.get("PADDLE_TPU_BENCH_B", B))
+    preset = preset or os.environ.get("PADDLE_TPU_BENCH_PRESET", "bert-base")
+    Bd, S, iters = ((16 if preset == "bert-large" else 32), 512, 8) \
+        if on_tpu else (2, 64, 2)
+    B = B or int(os.environ.get("PADDLE_TPU_BENCH_B", Bd))
     S = int(os.environ.get("PADDLE_TPU_BENCH_S", S))
-    cfg = bert_config("bert-base", max_position_embeddings=max(512, S))
+    cfg = bert_config(preset, max_position_embeddings=max(512, S))
     paddle.seed(0)
     model = BertForMaskedLM(cfg)
     if on_tpu:
@@ -136,7 +139,7 @@ def bench_bert(on_tpu):
     fpt = 6 * n + 12 * cfg.num_layers * cfg.hidden_size * S
     peak = _chip_peak_flops(jax.devices()[0])
     return _emit({
-        "metric": f"tokens/sec/chip (bert-base MLM + dropout, B={B} S={S})",
+        "metric": f"tokens/sec/chip ({preset} MLM + dropout, B={B} S={S})",
         "value": round(tps, 1), "unit": "tokens/s",
         "vs_baseline": round(fpt * tps / peak / 0.70, 4),
         "extra": {"mfu": round(fpt * tps / peak, 4),
@@ -401,21 +404,22 @@ def bench_decode(on_tpu):
     })
 
 
-def bench_vit(on_tpu):
-    """ViT-L/16 (BASELINE.md config) training throughput — fused
-    whole-sequence MHA kernel at the ragged S=197."""
+def bench_vit(on_tpu, preset=None, B=None):
+    """ViT (BASELINE.md config) training throughput — fused whole-sequence
+    MHA kernel at the ragged patch-sequence length."""
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.jit.train_step import TrainStep
     from paddle_tpu.models import VisionTransformer, vit_config
     import paddle_tpu.nn as nn
 
-    # B=64 default: the fused whole-sequence MHA kernel pipelines across
-    # batch programs — measured 66.0% MFU at B=64 vs 55-58% at B=32 on
-    # v5e (r3's XLA path measured the SAME MFU for B=32..64)
-    B, iters = (64, 8) if on_tpu else (2, 2)
-    B = int(os.environ.get("PADDLE_TPU_BENCH_B", B))
-    preset = os.environ.get("PADDLE_TPU_BENCH_PRESET", "vit-l16")
+    preset = preset or os.environ.get("PADDLE_TPU_BENCH_PRESET", "vit-l16")
+    # vit-l B=64 default: the fused whole-sequence MHA kernel pipelines
+    # across batch programs — measured 66.2% MFU at B=64 vs 55-58% at
+    # B=32 on v5e (B=128 plateaus); vit-h is MXU-heavy enough at B=32
+    Bd = 32 if preset == "vit-h14" else 64
+    B = B or int(os.environ.get("PADDLE_TPU_BENCH_B", Bd if on_tpu else 2))
+    iters = 8 if on_tpu else 2
     if on_tpu:
         cfg = vit_config(preset, image_size=224, num_classes=1000)
     else:  # CPU smoke: tiny config (precedent: GPT drops to 125m off-TPU)
@@ -535,6 +539,9 @@ def _ladder(on_tpu):
         ("decode", lambda: bench_decode(on_tpu), 120),
         ("moe", lambda: bench_moe(on_tpu), 240),
         ("resnet50", lambda: bench_resnet50(on_tpu), 150),
+        # model-scale depth rows (cheap; measured r4: 49.3% / 67.5%)
+        ("bert-large", lambda: bench_bert(on_tpu, preset="bert-large"), 150),
+        ("vit-h14", lambda: bench_vit(on_tpu, preset="vit-h14"), 150),
         # 2.7B last: longest compile; config = best measured r3 point
         ("gpt-2.7b", lambda: _bench_gpt27(on_tpu), 420),
     ]
