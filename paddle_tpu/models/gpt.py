@@ -600,7 +600,8 @@ class GPTForCausalLM(Layer):
                                max_new_tokens: int = 16,
                                temperature: float = 0.0, top_k: int = 0,
                                top_p: float = 1.0, max_len: int = None,
-                               seed: int = 0, eos_token_id: int = None):
+                               seed: int = 0, eos_token_id: int = None,
+                               weight_dtype: str = None):
         """ONE compiled program for ANY prompt length (VERDICT r3 #7a).
 
         input_ids: [B, P_cap] prompts RIGHT-padded to a fixed cap; only
@@ -637,9 +638,25 @@ class GPTForCausalLM(Layer):
         params = list(self.parameters())
         cdt = self.gpt.wte.weight._data.dtype
         nh, hd, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
+        q8 = weight_dtype == "int8"
+        qmap = self._decode_quantized_params() if q8 else {}
+
+        def expand(pa):
+            # same weight-only int8 contract as generate_static: dequant
+            # AT USE behind an optimization barrier (no full-width hoist)
+            if not q8:
+                return list(pa)
+            out = []
+            for v in pa:
+                if isinstance(v, tuple):
+                    qv, sv = lax.optimization_barrier(v)
+                    out.append((qv.astype(jnp.float32) * sv).astype(cdt))
+                else:
+                    out.append(v)
+            return out
 
         def model_step(pa, tokens, caches, pos_ids):
-            with _trace_guard(), _swap_params(params, list(pa)), \
+            with _trace_guard(), _swap_params(params, expand(pa)), \
                     autograd.no_grad():
                 logits, nc = self.forward(
                     Tensor(tokens), position_ids=Tensor(pos_ids),
@@ -692,7 +709,8 @@ class GPTForCausalLM(Layer):
         # signature excludes the lengths: THE ragged-serving property
         sig = ("ragged", b, p_cap, int(max_new_tokens), L,
                float(temperature), int(top_k), float(top_p),
-               None if eos_token_id is None else int(eos_token_id), str(cdt))
+               None if eos_token_id is None else int(eos_token_id), str(cdt),
+               "q8" if q8 else "full")
         import collections
         cache = getattr(self, "_gen_static_cache", None)
         if cache is None:
@@ -704,8 +722,10 @@ class GPTForCausalLM(Layer):
                 cache.popitem(last=False)
         else:
             cache.move_to_end(sig)
-        out = fn(tuple(p._data for p in params), ids._data, lens_arr,
-                 jax.random.PRNGKey(seed))
+        payload = tuple(qmap[i] if i in qmap else p._data
+                        for i, p in enumerate(params)) if q8 else \
+            tuple(p._data for p in params)
+        out = fn(payload, ids._data, lens_arr, jax.random.PRNGKey(seed))
         return Tensor(out)
 
     def generate(self, input_ids, max_new_tokens: int = 16,

@@ -517,3 +517,38 @@ def test_fused_small_param_update_parity_momentum(monkeypatch):
     for p0, p1 in zip(m0.parameters(), m1.parameters()):
         np.testing.assert_allclose(np.asarray(p0._data), np.asarray(p1._data),
                                    rtol=1e-6, atol=1e-7)
+
+
+def test_generate_static_ragged_int8(monkeypatch):
+    """Ragged serving composes with weight-only int8: one executable, any
+    prompt length, quantized payload."""
+    import numpy as np
+    monkeypatch.setenv("PADDLE_TPU_Q8_DECODE_MIN", "4096")
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=96, hidden_size=128, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    intermediate_size=256)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    P_cap, new = 8, 6
+    lens = [8, 3]
+    prompts = np.zeros((2, P_cap), np.int64)
+    rng = np.random.RandomState(0)
+    for i, ln in enumerate(lens):
+        prompts[i, :ln] = rng.randint(1, 96, (ln,))
+    full = m.generate_static_ragged(paddle.to_tensor(prompts), lens,
+                                    max_new_tokens=new).numpy()
+    q8 = m.generate_static_ragged(paddle.to_tensor(prompts), lens,
+                                  max_new_tokens=new,
+                                  weight_dtype="int8").numpy()
+    assert q8.shape == full.shape
+    agree = (q8[:, P_cap:] == full[:, P_cap:]).mean()
+    assert agree >= 0.5, f"int8 ragged diverged: {agree}"
+    n_exec = len(m._gen_static_cache)
+    lens2 = [5, 7]
+    prompts2 = np.zeros((2, P_cap), np.int64)
+    for i, ln in enumerate(lens2):
+        prompts2[i, :ln] = rng.randint(1, 96, (ln,))
+    _ = m.generate_static_ragged(paddle.to_tensor(prompts2), lens2,
+                                 max_new_tokens=new, weight_dtype="int8")
+    assert len(m._gen_static_cache) == n_exec   # same executable reused
