@@ -597,7 +597,11 @@ def main():
 
     which = os.environ.get("PADDLE_TPU_BENCH_MODEL")
     if which:
-        return _SINGLE[which](on_tpu)
+        fn = _SINGLE.get(which)
+        if fn is None:
+            sys.exit(f"unknown PADDLE_TPU_BENCH_MODEL={which!r}; valid rows: "
+                     f"{', '.join(sorted(_SINGLE))}")
+        return fn(on_tpu)
     if not on_tpu:
         # CPU smoke: single flagship row (the driver runs the ladder on TPU)
         return bench_gpt(on_tpu)

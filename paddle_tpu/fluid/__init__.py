@@ -303,11 +303,21 @@ class optimizer:
     RMSPropOptimizer = RMSProp
 
     @staticmethod
-    def Lamb(learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+    def Lamb(learning_rate=0.001, lamb_weight_decay=None, beta1=0.9,
              beta2=0.999, epsilon=1e-6, **kw):  # noqa: N802
         from .. import optimizer as _opt
         kw = optimizer._translate(kw)
-        kw.pop("weight_decay", None)
+        # fluid's regularization=L2Decay(x) IS the LAMB decay term in the
+        # reference (LAMB applies the regularizer as its weight-decay):
+        # map it onto lamb_weight_decay unless the caller passed both.
+        reg_wd = kw.pop("weight_decay", None)
+        if lamb_weight_decay is None:
+            lamb_weight_decay = 0.01 if reg_wd is None else reg_wd
+        elif reg_wd is not None and float(reg_wd) != float(lamb_weight_decay):
+            raise ValueError(
+                "fluid.optimizer.Lamb: got both lamb_weight_decay="
+                f"{lamb_weight_decay} and regularization coeff {reg_wd}; "
+                "pass only one")
         return _opt.Lamb(learning_rate=learning_rate,
                          lamb_weight_decay=lamb_weight_decay, beta1=beta1,
                          beta2=beta2, epsilon=epsilon, **kw)

@@ -635,6 +635,17 @@ class GPTForCausalLM(Layer):
             else _np.asarray(prompt_lens), jnp.int32)
         L = int(max_len or (p_cap + max_new_tokens))
         assert L >= p_cap + max_new_tokens, "max_len too small"
+        # lens are concrete host values at call time — validate BEFORE
+        # tracing: len 0 would index logits[b, -1] (wraps to the padded
+        # tail) and mask every real column; len > P_cap would un-mask
+        # garbage cache rows. Both produce wrong output with no error.
+        _host_lens = _np.asarray(lens_arr)
+        if _host_lens.size and (
+                int(_host_lens.min()) < 1 or int(_host_lens.max()) > p_cap):
+            raise ValueError(
+                f"generate_static_ragged: prompt_lens must satisfy "
+                f"1 <= len <= P_cap ({p_cap}); got range "
+                f"[{int(_host_lens.min())}, {int(_host_lens.max())}]")
         params = list(self.parameters())
         cdt = self.gpt.wte.weight._data.dtype
         nh, hd, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers

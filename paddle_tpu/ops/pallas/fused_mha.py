@@ -254,7 +254,8 @@ def _mha_fwd(qkv, seed, lensf, nh, scale, kv_len, causal, drop_p, G,
         ],
         out_specs=pl.BlockSpec((1, s, G * hd), lambda bi, g: (bi, _i0(), g)),
         interpret=interpret,
-    )(seed.astype(jnp.int32), *extra_args, qkv, qkv, qkv)
+    )(jax.lax.bitcast_convert_type(seed, jnp.int32),
+      *extra_args, qkv, qkv, qkv)
     return out
 
 
@@ -296,7 +297,8 @@ def _mha_vjp_bwd(nh, scale, kv_len, causal, drop_p, G, interpret, use_lens,
         out_specs=pl.BlockSpec((1, s, F3),
                                lambda bi, gg: (bi, _i0(), _i0())),
         interpret=interpret,
-    )(seed.astype(jnp.int32), *extra_args, qkv, qkv, qkv, g_out)
+    )(jax.lax.bitcast_convert_type(seed, jnp.int32),
+      *extra_args, qkv, qkv, qkv, g_out)
     return dqkv, jnp.zeros_like(seed), jnp.zeros_like(lensf)
 
 
@@ -379,11 +381,24 @@ def fused_mha(qkv, num_heads, *, scale=None, kv_len=None, causal=False,
         kv_len = None
     if dropout_p > 0.0:
         # float32 carrier for the PRNG seed: custom_vjp requires float
-        # primals (int args have no cotangent type); the kernel wrapper
-        # casts back to int32 before SMEM
-        seed = jnp.asarray(dropout_seed, jnp.float32).reshape(1, 1)
+        # primals (int args have no cotangent type). The int seed is packed
+        # LOSSLESSLY by bitcast (a value-cast to f32 would round seeds
+        # >= 2^24 to multiples of up to 128, shrinking the seed space);
+        # the kernel bitcasts back to int32 before SMEM.
+        seed = jax.lax.bitcast_convert_type(
+            jnp.asarray(dropout_seed).astype(jnp.int32),
+            jnp.float32).reshape(1, 1)
     else:
         seed = jnp.zeros((1, 1), jnp.float32)
+    if heads_per_program is not None and (
+            num_heads % heads_per_program
+            or (heads_per_program * hd) % 128):
+        # validated HERE so the backward's group-shrink loop can never
+        # silently land on an unaligned dqkv span offset (Mosaic lane rule)
+        raise ValueError(
+            f"fused_mha: heads_per_program={heads_per_program} must divide "
+            f"num_heads={num_heads} with heads_per_program*head_dim "
+            f"({heads_per_program * hd}) a multiple of 128")
     G = heads_per_program or _pick_group(num_heads, hd, s, qkv.dtype.itemsize,
                                          n_bufs=4)
     use_lens = lens_arr is not None
