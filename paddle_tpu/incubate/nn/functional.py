@@ -101,9 +101,21 @@ def fused_linear_cross_entropy_array(x, weight, labels, *, chunk_size=128,
     x: [B, S, H]; weight: [V, H] ([H, V] with transpose_weight); labels
     [B, S] int. Returns per-token loss [B, S] float32.
     """
+    B, S, H = x.shape
+    # weight is [V, H] by default, [H, V] when transpose_weight
+    V = weight.shape[-1] if transpose_weight else weight.shape[0]
+    from ...ops.pallas.linear_ce import use_linear_ce, linear_cross_entropy
+    if weight.ndim == 2 and use_linear_ce(B * S, H, V):
+        # Pallas path: online-logsumexp head kernel — the [T, V] logits
+        # never exist in HBM in the forward, and the backward rebuilds
+        # bf16 dlogits from the saved lse instead of re-running the
+        # checkpointed f32 chunk chain (ops/pallas/linear_ce.py).
+        per_tok = linear_cross_entropy(
+            x.reshape(B * S, H), weight, labels.reshape(B * S),
+            w_layout="hv" if transpose_weight else "vh")
+        return per_tok.reshape(B, S)
     if transpose_weight:
         weight = weight.T
-    B, S, H = x.shape
     C = min(chunk_size, S)
     while S % C:
         C -= 1

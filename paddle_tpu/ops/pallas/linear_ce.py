@@ -1,0 +1,254 @@
+"""Fused LM-head + softmax cross-entropy ("linear CE") for TPU.
+
+Reference anchor: paddle/fluid/operators/collective/
+c_softmax_with_cross_entropy_op.cu — the reference fuses softmax-CE over
+sharded logits but still takes MATERIALIZED logits as input. Here the head
+matmul itself lives inside the loss kernel, so the [T, V] logits never exist
+in HBM in the forward pass at all.
+
+Why this is the right TPU design (r4 profile): at GPT-1.3B flagship shape
+(T = B·S = 6144 tokens, V = 50304, H = 2048) the chunked-XLA path streams
+f32 chunk logits through HBM in the forward AND recomputes + re-streams them
+under jax.checkpoint in the backward — ~30-37 ms of a 385 ms step, the
+largest attackable non-MXU term on the board. The FLOP floor of the three
+head matmuls (fwd, dx, dW) is ~19 ms at peak; the gap is pure logits traffic.
+
+Forward (Pallas): grid (token_block, vocab_block), vocab innermost. One
+x-tile [Bt, H] and one W-tile [Bv, H] are resident; the [Bt, Bv] f32 logits
+tile lives only in registers/VMEM. Running max / sum-exp / gold-logit
+accumulators persist in VMEM scratch across the vocab dimension (the same
+online-softmax pattern as flash_attention.py). Outputs: per-token loss and
+per-token logsumexp (the backward residual).
+
+Backward (XLA matmuls, NO logits recompute chain): with lse saved, the
+gradient is closed-form —
+    dlogits[t, v] = g[t] * (exp(logits[t, v] - lse[t]) - 1{v == label[t]})
+so each token chunk needs ONE bf16 matmul to rebuild the probability tile
+fused with its epilogue, then dx = dlogits @ W and dW = dlogitsᵀ @ x run as
+plain MXU matmuls. dlogits is materialized in bf16 (half the bytes of the
+checkpoint path's f32 logits, with no second recompute pass); chunking keeps
+its residency bounded.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+
+
+def _i0():
+    # index-map literals must be i32 under x64 (Mosaic refuses i64)
+    return jnp.int32(0)
+
+
+def _fwd_kernel(lab_ref, x_ref, w_ref, loss_ref, lse_ref, m_sc, s_sc, g_sc,
+                *, n_v, block_v, vocab, w_layout):
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG)
+        s_sc[...] = jnp.zeros_like(s_sc)
+        g_sc[...] = jnp.zeros_like(g_sc)
+
+    x = x_ref[...]
+    w = w_ref[...]
+    if w_layout == "vh":
+        # logits tile = x [Bt,H] · wᵀ [H,Bv] — contraction on both lasts
+        logits = lax.dot_general(x, w, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    else:  # "hv": w tile is [H, Bv]
+        logits = lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    col = vi * block_v + lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    if vocab % block_v:
+        # mask the ragged tail tile: out-of-vocab columns score -inf
+        logits = jnp.where(col < vocab, logits, jnp.float32(_NEG))
+    # gold-logit contribution: exactly one vocab tile contains each label
+    lab = lab_ref[...]  # [Bt, 1] i32
+    g_sc[...] += jnp.sum(jnp.where(col == lab, logits, jnp.float32(0.0)),
+                         axis=1, keepdims=True)
+    m_prev = m_sc[...]
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=1, keepdims=True))
+    s_sc[...] = s_sc[...] * jnp.exp(m_prev - m_new) + jnp.sum(
+        jnp.exp(logits - m_new), axis=1, keepdims=True)
+    m_sc[...] = m_new
+
+    @pl.when(vi == n_v - 1)
+    def _finish():
+        lse = m_sc[...] + jnp.log(s_sc[...])
+        lse_ref[...] = lse
+        loss_ref[...] = lse - g_sc[...]
+
+
+def _pick_block_t(t, h, itemsize):
+    """Largest token block dividing T that keeps the VMEM plan honest:
+    x tile (2x buffered) + w tile (2x) + f32 logits tile + scratch.
+    Measured on v5e at flagship shape (T=6144 H=2048 V=50304): bt=1024
+    with bv=256 beats bt=512/bv=384 and bt=768 (fewer W re-streams; the
+    W stream is the forward's bandwidth term)."""
+    for bt in (1024, 768, 512, 384, 256, 128, 64, 32, 16, 8):
+        if t % bt == 0 and (2 * bt * h * itemsize) <= 8 * 1024 * 1024:
+            return bt
+    return t
+
+
+def _fwd(x, w, labels, *, block_t, block_v, w_layout, interpret):
+    t, h = x.shape
+    vocab = w.shape[0] if w_layout == "vh" else w.shape[1]
+    n_t = t // block_t
+    n_v = -(-vocab // block_v)
+    if w_layout == "vh":
+        wspec = pl.BlockSpec((block_v, h), lambda ti, vi: (vi, _i0()))
+    else:
+        wspec = pl.BlockSpec((h, block_v), lambda ti, vi: (_i0(), vi))
+    loss, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, n_v=n_v, block_v=block_v, vocab=vocab,
+                          w_layout=w_layout),
+        out_shape=(jax.ShapeDtypeStruct((t, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((t, 1), jnp.float32)),
+        grid=(n_t, n_v),
+        in_specs=[
+            pl.BlockSpec((block_t, 1), lambda ti, vi: (ti, _i0())),
+            pl.BlockSpec((block_t, h), lambda ti, vi: (ti, _i0())),
+            wspec,
+        ],
+        out_specs=(pl.BlockSpec((block_t, 1), lambda ti, vi: (ti, _i0())),
+                   pl.BlockSpec((block_t, 1), lambda ti, vi: (ti, _i0()))),
+        scratch_shapes=[pltpu.VMEM((block_t, 1), jnp.float32),
+                        pltpu.VMEM((block_t, 1), jnp.float32),
+                        pltpu.VMEM((block_t, 1), jnp.float32)],
+        interpret=interpret,
+    )(labels.reshape(t, 1).astype(jnp.int32), x, w)
+    return loss[:, 0], lse[:, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _linear_ce(x, w, labels, block_t, block_v, w_layout, interpret,
+               bwd_chunks):
+    loss, _ = _fwd(x, w, labels, block_t=block_t, block_v=block_v,
+                   w_layout=w_layout, interpret=interpret)
+    return loss
+
+
+def _linear_ce_fwd(x, w, labels, block_t, block_v, w_layout, interpret,
+                   bwd_chunks):
+    loss, lse = _fwd(x, w, labels, block_t=block_t, block_v=block_v,
+                     w_layout=w_layout, interpret=interpret)
+    return loss, (x, w, labels, lse)
+
+
+def _linear_ce_bwd(block_t, block_v, w_layout, interpret, bwd_chunks,
+                   res, g):
+    import os
+    impl = os.environ.get("PADDLE_TPU_LINEAR_CE_BWD", "onehot")
+    x, w, labels, lse = res
+    t, h = x.shape
+    nc = bwd_chunks
+    while t % nc:
+        nc -= 1
+    ct = t // nc
+    dxs = []
+    dw = None
+    for c in range(nc):
+        sl = slice(c * ct, (c + 1) * ct)
+        xc, lc, sc, gc = x[sl], labels[sl], lse[sl], g[sl]
+        if w_layout == "vh":
+            logits = lax.dot_general(xc, w, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        else:
+            logits = jnp.dot(xc, w, preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - sc[:, None])
+        if impl == "gather":
+            # keep the [T, V] path a PURE matmul epilogue (p·g, one fused
+            # convert) and handle the gold term outside it: the dx part is
+            # a row-GATHER of W (g_t · W[label_t]); the dW part is a row-
+            # SCATTER-add of g_t · x_t. Both touch T rows, not T·V.
+            dlog = (p * gc[:, None]).astype(x.dtype)
+            wl = w if w_layout == "vh" else w.T  # [V, H] view for gather
+            gold_rows = wl[lc] * gc[:, None].astype(wl.dtype)
+            dxs.append((jnp.dot(dlog, wl,
+                                preferred_element_type=jnp.float32)
+                        - gold_rows.astype(jnp.float32)).astype(x.dtype))
+            dwc = lax.dot_general(dlog, xc, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+            dwc = dwc.at[lc].add(-(gc[:, None] * xc.astype(jnp.float32)))
+            if w_layout != "vh":
+                dwc = dwc.T
+            dw = dwc if dw is None else dw + dwc
+            continue
+        if impl == "scatter":
+            # gold term as a T-sized scatter-add instead of a [T, V]
+            # iota-compare (the autodiff'd take_along_axis shape)
+            dlog = (p * gc[:, None]).astype(x.dtype)
+            dlog = dlog.at[jnp.arange(ct), lc].add(
+                (-gc).astype(x.dtype), mode="drop")
+        else:
+            onehot = (lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+                      == lc[:, None].astype(jnp.int32))
+            # bf16 dlogits: half the checkpoint path's f32 bytes
+            dlog = ((p - onehot) * gc[:, None]).astype(x.dtype)
+        if w_layout == "vh":
+            dxs.append(jnp.dot(dlog, w, preferred_element_type=jnp.float32)
+                       .astype(x.dtype))
+            dwc = lax.dot_general(dlog, xc, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        else:
+            dxs.append(lax.dot_general(dlog, w, (((1,), (1,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+                       .astype(x.dtype))
+            dwc = lax.dot_general(xc, dlog, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dw = dwc if dw is None else dw + dwc
+    dx = jnp.concatenate(dxs, axis=0) if len(dxs) > 1 else dxs[0]
+    return dx, dw.astype(w.dtype), None
+
+
+_linear_ce.defvjp(_linear_ce_fwd, _linear_ce_bwd)
+
+
+def use_linear_ce(t, h, v):
+    """Gate: TPU-class platform, MXU-friendly dims (mirrors use_fused_mha)."""
+    import os
+    force = os.environ.get("PADDLE_TPU_LINEAR_CE")
+    if force == "0":
+        return False
+    if force != "1":
+        try:
+            d = jax.devices()[0].platform
+        except RuntimeError:
+            return False
+        if d not in ("tpu", "axon"):
+            return False
+    return h % 128 == 0 and t % 8 == 0 and v >= 1024
+
+
+def linear_cross_entropy(x, w, labels, *, w_layout="vh", block_t=None,
+                         block_v=None, bwd_chunks=None, interpret=False):
+    """Per-token softmax-CE of logits = x @ Wᵀ (w_layout="vh", W [V, H]) or
+    x @ W (w_layout="hv", W [H, V]), with logits never materialized in the
+    forward. x: [T, H]; labels: [T] int. Returns f32 [T] losses.
+    """
+    import os
+    t, h = x.shape
+    if block_t is None:
+        block_t = int(os.environ.get("PADDLE_TPU_LINEAR_CE_BT", "0")) \
+            or _pick_block_t(t, h, x.dtype.itemsize)
+    if block_v is None:
+        # bigger token blocks shrink the W-stream count; shrink the vocab
+        # tile to keep the scoped-VMEM plan under the 16M chip limit
+        block_v = int(os.environ.get("PADDLE_TPU_LINEAR_CE_BV", "0")) or (
+            256 if block_t >= 1024 else 384)
+    if bwd_chunks is None:
+        bwd_chunks = int(os.environ.get("PADDLE_TPU_LINEAR_CE_BC", "2"))
+    if t % block_t:
+        raise ValueError(f"linear_cross_entropy: T={t} not divisible by "
+                         f"block_t={block_t}")
+    return _linear_ce(x, w, labels, int(block_t), int(block_v),
+                      str(w_layout), bool(interpret), int(bwd_chunks))

@@ -75,7 +75,40 @@ class WindowAttention(Layer):
             [n_rel, num_heads], default_initializer=I.TruncatedNormal(std=0.02))
         self._rel_index = _rel_pos_index(window_size)          # static numpy
 
-    def forward(self, xw, mask: np.ndarray | None):
+    def _bias_plan(self, bnw, n_windows, mask):
+        """Static plan for window-BATCHED fused attention: group W_g
+        windows into one length W_g·N sequence with a block-diagonal
+        additive bias (periodic over the batch with period R = nW/W_g).
+        Returns (W_g, static_bias [R, S, S] numpy) or None."""
+        nW = n_windows if n_windows else (mask.shape[0]
+                                          if mask is not None else 1)
+        n = self.ws * self.ws
+        from ...ops.pallas.fused_mha_bias import use_fused_mha_bias
+        divisor_of = nW if nW > 1 else bnw
+        # try group sizes largest-first: a rejected candidate (VMEM plan)
+        # can still admit a smaller one — stage 4's nh=24+ rejects wg=8
+        # but runs fused at wg=4
+        wg = next((w for w in (8, 4, 2)
+                   if divisor_of % w == 0
+                   and use_fused_mha_bias(w * n, self.num_heads,
+                                          self.head_dim)), 1)
+        if wg == 1:
+            return None
+        r_n = max(1, nW // wg)
+        cached = getattr(self, "_bias_static_cache", None)
+        if cached is not None and cached[0] == (wg, r_n):
+            return wg, cached[1]
+        s = wg * n
+        static = np.full((r_n, s, s), -1e9, np.float32)
+        for r in range(r_n):
+            for w in range(wg):
+                blk = (mask[r * wg + w] if (mask is not None and nW > 1)
+                       else 0.0)
+                static[r, w * n:(w + 1) * n, w * n:(w + 1) * n] = blk
+        self._bias_static_cache = ((wg, r_n), static)
+        return wg, static
+
+    def forward(self, xw, mask: np.ndarray | None, n_windows: int = 0):
         """xw: [B*nW, N, C]; mask: static numpy [nW, N, N] or None."""
         nh, hd, scale = self.num_heads, self.head_dim, self.scale
         n = self.ws * self.ws
@@ -83,6 +116,29 @@ class WindowAttention(Layer):
         qkv = self.qkv(xw)                                     # [BnW, N, 3C]
         p_drop = self.attn_drop.p if self.training else 0.0
         drop_key = _random.split_key() if p_drop > 0.0 else None
+
+        plan = (self._bias_plan(int(xw.shape[0]), n_windows, mask)
+                if p_drop == 0.0 else None)
+        if plan is not None:
+            wg, static = plan
+
+            def attend_fused(a, table):
+                from ...ops.pallas.fused_mha_bias import fused_mha_bias
+                bnw = a.shape[0]
+                rel = table[rel_index.reshape(-1)].reshape(n, n, nh)
+                rel = rel.transpose(2, 0, 1).astype(jnp.float32)
+                tiled = jnp.tile(rel, (1, wg, wg))      # [nh, S, S]
+                bias = jnp.asarray(static)[:, None] + tiled[None]
+                ag = a.reshape(bnw // wg, wg * n, a.shape[-1])
+                o = fused_mha_bias(ag, nh, bias, scale=scale)
+                return o.reshape(bnw, n, nh * hd)
+
+            ctx = apply_op("swin_window_attention_fused", attend_fused,
+                           [qkv, self.rel_bias_table])
+            out = self.proj(ctx)
+            if self.training and self.proj_drop.p:
+                out = self.proj_drop(out)
+            return out
 
         def attend(a, table):
             from ...ops.attention import attention_reference
@@ -160,7 +216,8 @@ class SwinBlock(Layer):
         b = x.shape[0]
         shortcut = x
         xw = self._windows(self.norm1(x))
-        aw = self.attn(xw, self._mask)
+        aw = self.attn(xw, self._mask,
+                       n_windows=(self.H // self.ws) * (self.W // self.ws))
         x = shortcut + self._unwindows(aw, b)
         y = self.fc2(F.gelu(self.fc1(self.norm2(x)), approximate=True))
         if self.training and self.drop.p:

@@ -78,6 +78,29 @@ def build_step(which):
         ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, B, S))
                                .astype("int32"))
         return step, (ids, ids)
+    if which in ("swin", "resnet50"):
+        # shared imagenet-train harness; only constructor/opt/batch differ
+        paddle.seed(0)
+        if which == "swin":
+            from paddle_tpu.vision.models import swin_t
+            model, default_b = swin_t(num_classes=1000), 32
+            opt_fn = lambda ps: paddle.optimizer.AdamW(  # noqa: E731
+                learning_rate=1e-4, parameters=ps, moment_dtype="bfloat16")
+        else:
+            from paddle_tpu.vision.models import resnet50
+            model, default_b = resnet50(num_classes=1000), 64
+            opt_fn = lambda ps: paddle.optimizer.Momentum(  # noqa: E731
+                learning_rate=0.1, parameters=ps)
+        model.to(dtype="bfloat16")
+        ce = nn.CrossEntropyLoss()
+        opt = opt_fn(model.parameters())
+        step = TrainStep(model, opt, lambda x, y: ce(model(x), y))
+        B = int(os.environ.get("PADDLE_TPU_BENCH_B", str(default_b)))
+        x = paddle.to_tensor(np.random.randn(4, B, 3, 224, 224)
+                             .astype("bfloat16"))
+        y = paddle.to_tensor(np.random.randint(0, 1000, (4, B))
+                             .astype("int64"))
+        return step, (x, y)
     raise SystemExit(f"unknown model {which}")
 
 
