@@ -347,7 +347,8 @@ def bench_moe(on_tpu):
                   f"B={B} S={S})",
         "value": round(tps_m, 1), "unit": "tokens/s",
         "vs_baseline": round(mfu_m / 0.70, 4),
-        "extra": {"mfu_active_flops": round(mfu_m, 4),
+        "extra": {"mfu": round(mfu_m, 4),   # active-FLOP MFU (driver key)
+                  "mfu_active_flops": round(mfu_m, 4),
                   "step_ms": round(dt_m / iters * 1e3, 2),
                   "loss": round(loss_m, 4),
                   "dense_twin_tok_s": round(tps_d, 1),
@@ -357,7 +358,7 @@ def bench_moe(on_tpu):
     })
 
 
-def bench_decode(on_tpu):
+def bench_decode(on_tpu, B=None, w8=None):
     """Autoregressive decode throughput via generate_static (ONE compiled
     program: prefill + lax.scan of fixed-shape KV-cache steps)."""
     import numpy as np
@@ -365,20 +366,23 @@ def bench_decode(on_tpu):
     from paddle_tpu.models import GPTForCausalLM, gpt_config
 
     if on_tpu:
-        preset, B, p_len, new = "gpt3-1.3b", 8, 128, 128
+        preset, Bd, p_len, new = "gpt3-1.3b", 8, 128, 128
     else:
-        preset, B, p_len, new = "gpt3-125m", 2, 16, 16
+        preset, Bd, p_len, new = "gpt3-125m", 2, 16, 16
     preset = os.environ.get("PADDLE_TPU_BENCH_PRESET", preset)
-    B = int(os.environ.get("PADDLE_TPU_BENCH_B", B))
+    B = B or int(os.environ.get("PADDLE_TPU_BENCH_B", Bd))
     cfg = gpt_config(preset)
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     if on_tpu:
         model.to(dtype="bfloat16")
     model.eval()
-    # weight-only int8 decode (VERDICT r3 #7b): decode is weight-bandwidth-
-    # bound, so halving the scan's weight bytes is the lever
-    wdt = os.environ.get("PADDLE_TPU_BENCH_DECODE_W8", "0") == "1"
+    # weight-only int8 decode: decode is weight-bandwidth-bound, so halving
+    # the scan's weight bytes is the lever; r5 streams the int8 bytes
+    # through the Pallas dequant-in-register matmul (ops/pallas/
+    # int8_matmul.py) instead of materializing dequantized copies
+    wdt = (w8 if w8 is not None
+           else os.environ.get("PADDLE_TPU_BENCH_DECODE_W8", "0") == "1")
     kw = {"weight_dtype": "int8"} if wdt else {}
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(
@@ -492,10 +496,23 @@ def bench_swin(on_tpu):
     lbls = paddle.to_tensor(np.random.randint(0, ncls, (iters, B)).astype("int64"))
     dt, final = _timed_steps(step, iters, imgs, lbls)
     ips = B * iters / dt
+    # swin-t 224²: ~4.5 GMACs fwd -> 9.0e9 FLOPs at MAC=2 (same convention
+    # as the resnet row); swin-b ~15.4 GMACs. Train ≈ 3x fwd. Swin is
+    # dispatch/relayout-bound, not MXU-bound — img/s is the primary metric,
+    # mfu is reported for the ladder's common scale.
+    import jax as _jax
+    # off-TPU smoke runs a tiny stand-in model, so the swin-t/b FLOP
+    # constants would fabricate an mfu — report it on TPU only
+    mfu = None
+    if on_tpu:
+        fwd_flops = 30.8e9 if preset == "swin-b" else 9.0e9
+        mfu = 3 * fwd_flops * ips / _chip_peak_flops(_jax.devices()[0])
     return _emit({
         "metric": f"images/sec/chip ({preset} train, B={B} {hw}x{hw})",
-        "value": round(ips, 1), "unit": "images/s", "vs_baseline": None,
-        "extra": {"step_ms": round(dt / iters * 1e3, 2),
+        "value": round(ips, 1), "unit": "images/s",
+        "vs_baseline": None if mfu is None else round(mfu / 0.70, 4),
+        "extra": {"mfu": None if mfu is None else round(mfu, 4),
+                  "step_ms": round(dt / iters * 1e3, 2),
                   "loss": round(final, 4)},
     })
 
@@ -537,11 +554,17 @@ def _ladder(on_tpu):
         ("vit-l16", lambda: bench_vit(on_tpu), 120),
         ("bert-base", lambda: bench_bert(on_tpu), 120),
         ("decode", lambda: bench_decode(on_tpu), 120),
+        # serving rows (VERDICT r4 #5): int8 weight-only at the latency
+        # point, bf16 at the throughput point
+        ("decode-int8-b8", lambda: bench_decode(on_tpu, B=8, w8=True), 120),
+        ("decode-b32", lambda: bench_decode(on_tpu, B=32, w8=False), 120),
         ("moe", lambda: bench_moe(on_tpu), 240),
         ("resnet50", lambda: bench_resnet50(on_tpu), 150),
         # model-scale depth rows (cheap; measured r4: 49.3% / 67.5%)
         ("bert-large", lambda: bench_bert(on_tpu, preset="bert-large"), 150),
         ("vit-h14", lambda: bench_vit(on_tpu, preset="vit-h14"), 150),
+        # swin-t: window-batched fused-bias attention (r5; 655->829 img/s)
+        ("swin-t", lambda: bench_swin(on_tpu), 150),
         # 2.7B last: longest compile; config = best measured r3 point
         ("gpt-2.7b", lambda: _bench_gpt27(on_tpu), 420),
     ]

@@ -52,10 +52,28 @@ class VocabParallelEmbedding(Layer):
         self.weight.pspec = P("mp", None)
 
     def forward(self, x):
+        q8 = _q8_payload(self.weight)
+
         def fn(ids, w):
-            out = jnp.take(w, ids, axis=0)
+            if q8 is not None:
+                # int8 row gather + per-row scale: the full-width table is
+                # never reconstructed for an O(B) lookup
+                qv, sv = q8
+                out = (jnp.take(qv, ids, axis=0).astype(jnp.float32)
+                       * jnp.take(sv, ids, axis=0)).astype(w.dtype)
+            else:
+                out = jnp.take(w, ids, axis=0)
             return _mesh.shard_constraint(out, None, None, None)
         return apply_op("vocab_parallel_embedding", fn, [x, self.weight])
+
+
+def _q8_payload(weight_tensor):
+    """Weight-only int8 decode payload (set by GPT's generate_static while
+    tracing with weight_dtype="int8"): (int8 codes, per-channel scale).
+    When present, matmul consumers stream the int8 bytes through the
+    Pallas dequant-in-register kernel instead of reading a full-width
+    dequantized copy (ops/pallas/int8_matmul.py)."""
+    return getattr(weight_tensor, "_q8", None)
 
 
 class ColumnParallelLinear(Layer):
@@ -79,11 +97,17 @@ class ColumnParallelLinear(Layer):
 
     def forward(self, x):
         gather = self.gather_output
+        q8 = _q8_payload(self.weight)
 
         def fn(x_, w, *b):
-            y = jnp.matmul(x_, w)
-            if b:
-                y = y + b[0]
+            if q8 is not None:
+                from ..ops.pallas.int8_matmul import int8_linear_nd
+                y = int8_linear_nd(x_, q8[0], q8[1].reshape(-1),
+                                   b[0] if b else None)
+            else:
+                y = jnp.matmul(x_, w)
+                if b:
+                    y = y + b[0]
             if not gather:
                 y = _mesh.shard_constraint(y, *([None] * (y.ndim - 1)), "mp")
             return y
@@ -113,9 +137,15 @@ class RowParallelLinear(Layer):
             self.bias.pspec = P()
 
     def forward(self, x):
+        q8 = _q8_payload(self.weight)
+
         def fn(x_, w, *b):
             x_ = _mesh.shard_constraint(x_, *([None] * (x_.ndim - 1)), "mp")
-            y = jnp.matmul(x_, w)
+            if q8 is not None:
+                from ..ops.pallas.int8_matmul import int8_linear_nd
+                y = int8_linear_nd(x_, q8[0], q8[1].reshape(-1))
+            else:
+                y = jnp.matmul(x_, w)
             y = _mesh.shard_constraint(y, *([None] * y.ndim))
             if b:
                 y = y + b[0]

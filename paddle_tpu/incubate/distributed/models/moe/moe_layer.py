@@ -78,20 +78,16 @@ def _topk_routing(probs, top_k: int, capacity: int):
     if top_k > 1:
         denom = jnp.sum(gate_vals, axis=-1, keepdims=True)
         gate_vals = gate_vals / jnp.maximum(denom, 1e-9)
-    counts = jnp.zeros((e,), jnp.int32)
-    frac_top1 = None
-    locs, keeps = [], []
-    for slot in range(top_k):
-        oh = jax.nn.one_hot(idx[:, slot], e, dtype=jnp.int32)  # [N, E]
-        if frac_top1 is None:
-            frac_top1 = jnp.mean(oh.astype(probs.dtype), axis=0)
-        pos = jnp.cumsum(oh, axis=0) - 1 + counts
-        counts = counts + jnp.sum(oh, axis=0)
-        loc = jnp.sum(pos * oh, axis=-1)                       # [N]
-        locs.append(loc)
-        keeps.append(loc < capacity)
-    return (gate_vals, idx, jnp.stack(locs, 1), jnp.stack(keeps, 1),
-            frac_top1)
+    # ONE slot-major pass (r5): flattening [N, k] slot-major makes a single
+    # cumsum reproduce the loop's priority order (every slot-0 assignment
+    # outranks every slot-1 assignment) with k fewer op chains
+    ohf = jax.nn.one_hot(idx.T.reshape(-1), e, dtype=jnp.int32)  # [k·N, E]
+    frac_top1 = jnp.mean(ohf[:n].astype(probs.dtype), axis=0)
+    pos = jnp.cumsum(ohf, axis=0) - 1
+    loc_f = jnp.sum(pos * ohf, axis=-1)                          # [k·N]
+    locs = loc_f.reshape(top_k, n).T                             # [N, k]
+    keeps = locs < capacity
+    return gate_vals, idx, locs, keeps, frac_top1
 
 
 def _moe_forward(x, gw, w1, b1, w2, b2, *, top_k, capacity_factor, gate_type,
@@ -139,13 +135,11 @@ def _moe_forward(x, gw, w1, b1, w2, b2, *, top_k, capacity_factor, gate_type,
         out = _mesh.shard_constraint(out, "ep", None, None)
         out_ext = jnp.concatenate(
             [out.reshape(e * cap, m), jnp.zeros((1, m), out.dtype)], axis=0)
-        y = jnp.zeros((n, m), x.dtype)
-        for slot in range(top_k):
-            w_slot = (gate_vals[:, slot]
-                      * keeps[:, slot].astype(probs.dtype)).astype(x.dtype)
-            rows = out_ext[jnp.where(keeps[:, slot], flatpos[:, slot],
-                                     e * cap)]
-            y = y + w_slot[:, None] * rows
+        # ONE batched combine gather (r5): all N·k rows in a single gather
+        # + a k-reduction, instead of k sequential gather/axpy chains
+        rows = out_ext[safe_pos]                               # [N, k, M]
+        w_all = (gate_vals * keeps.astype(probs.dtype)).astype(x.dtype)
+        y = jnp.einsum("nk,nkm->nm", w_all, rows)
         return y.reshape(b, s, m), aux.astype(jnp.float32)
 
     combine, dispatch, frac = _topk_dispatch(probs, top_k, cap)

@@ -122,17 +122,38 @@ class TrainStep:
                 arr, self.mesh, fspec)
         return jax.device_put(arr, NamedSharding(self.mesh, fspec))
 
+    def _to_global_from_full(self, arr, spec):
+        """Place a host array that EVERY process holds in full (params and
+        optimizer state — same-seed init) onto the mesh: each process
+        contributes exactly the slices its devices own
+        (make_array_from_callback), so specs sharded over process-CROSSING
+        axes (e.g. pipeline stages split across hosts) assemble correctly.
+        host_local_array_to_global_array would instead CONCATENATE the full
+        copies — doubling any param sharded across the process boundary.
+        Data batches keep the host-local-shard convention (_to_global)."""
+        from ..distributed import mesh as _dmesh
+        with _dmesh.mesh_scope(self.mesh):
+            fspec = _dmesh.filter_spec(*spec) if spec is not None else P()
+        sh = NamedSharding(self.mesh, fspec)
+        if jax.process_count() > 1:
+            import numpy as _np
+            host = _np.asarray(arr)
+            return jax.make_array_from_callback(host.shape, sh,
+                                                lambda idx: host[idx])
+        return jax.device_put(arr, sh)
+
     def _apply_param_shardings(self):
         """place params/opt state by their pspec (once)."""
         if self.mesh is None:
             return
         for p in self._params:
-            p._data = self._to_global(p._data, _spec_or_replicated(p))
+            p._data = self._to_global_from_full(p._data,
+                                                _spec_or_replicated(p))
         if self._opt_state is not None:
             for p, st in zip(self._params, self._opt_state):
                 spec = _opt_state_spec(p, self.optimizer)
                 for k in st:
-                    st[k] = self._to_global(
+                    st[k] = self._to_global_from_full(
                         st[k], self.optimizer.state_spec(p, k, st[k], spec))
 
     # ------------------------------------------------------------------

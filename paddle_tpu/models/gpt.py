@@ -100,6 +100,28 @@ def gpt_config(preset: str, **overrides) -> GPTConfig:
     return GPTConfig(**cfg)
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def _q8_bind(params, payloads):
+    """Tag param Tensors with their barrier'd int8 (codes, scale) payload
+    for the duration of a decode trace: matmul/embedding consumers
+    (mpu layers, tied head) check `_q8` and stream int8 bytes through the
+    Pallas dequant-in-register kernel instead of reading the full-width
+    dequantized copy."""
+    tagged = []
+    try:
+        for p, v in zip(params, payloads):
+            if v is not None:
+                p._q8 = v
+                tagged.append(p)
+        yield
+    finally:
+        for p in tagged:
+            del p._q8
+
+
 class GPTSelfAttention(Layer):
     """Fused QKV column-parallel attention block."""
 
@@ -405,11 +427,19 @@ class GPTForCausalLM(Layer):
         out = self.gpt(input_ids, position_ids, caches=caches)
         x, new_caches = out if caches is not None else (out, None)
         if self.config.tie_word_embeddings:
-            logits = apply_op(
-                "tied_lm_head",
-                lambda a, w: _mesh.shard_constraint(
-                    jnp.einsum("bsh,vh->bsv", a, w), "dp", "sp", "mp"),
-                [x, self.gpt.wte.weight])
+            q8 = getattr(self.gpt.wte.weight, "_q8", None)
+
+            def _head_fn(a, w):
+                if q8 is not None:
+                    from ..ops.pallas.int8_matmul import int8_linear_nd
+                    y = int8_linear_nd(a, q8[0], q8[1].reshape(-1),
+                                       w_layout="nk")
+                else:
+                    y = jnp.einsum("bsh,vh->bsv", a, w)
+                return _mesh.shard_constraint(y, "dp", "sp", "mp")
+
+            logits = apply_op("tied_lm_head", _head_fn,
+                              [x, self.gpt.wte.weight])
         else:
             logits = self.lm_head(x)
         if caches is not None:
@@ -503,25 +533,31 @@ class GPTForCausalLM(Layer):
         qmap = self._decode_quantized_params() if q8 else {}
 
         def expand(pa):
-            """Mixed payload -> full param list; int8 entries dequantize
-            AT USE, behind an optimization barrier so XLA cannot hoist the
-            bf16 reconstruction out of the decode while-loop (which would
-            re-materialize full-width weights and void the bandwidth
-            saving)."""
+            """Mixed payload -> (full param list, q8 payload list); int8
+            entries dequantize AT USE behind an optimization barrier so XLA
+            cannot hoist the bf16 reconstruction out of the decode loop.
+            The barrier'd (codes, scale) pairs ALSO ride along so matmul
+            consumers can stream int8 bytes directly through the Pallas
+            dequant-in-register kernel (_q8_bind) — when every consumer of
+            a weight takes that route, the dequantized copy is dead code
+            and XLA drops it entirely."""
             if not q8:
-                return list(pa)
-            out = []
+                return list(pa), [None] * len(pa)
+            out, pays = [], []
             for v in pa:
                 if isinstance(v, tuple):
                     qv, sv = lax.optimization_barrier(v)
                     out.append((qv.astype(jnp.float32) * sv).astype(cdt))
+                    pays.append((qv, sv))
                 else:
                     out.append(v)
-            return out
+                    pays.append(None)
+            return out, pays
 
         def model_step(pa, tokens, caches):
-            with _trace_guard(), _swap_params(params, expand(pa)), \
-                    autograd.no_grad():
+            ex, pays = expand(pa)
+            with _trace_guard(), _swap_params(params, ex), \
+                    _q8_bind(params, pays), autograd.no_grad():
                 logits, nc = self.forward(
                     Tensor(tokens),
                     caches=[(Tensor(k), Tensor(v), Tensor(p))
@@ -654,21 +690,26 @@ class GPTForCausalLM(Layer):
 
         def expand(pa):
             # same weight-only int8 contract as generate_static: dequant
-            # AT USE behind an optimization barrier (no full-width hoist)
+            # AT USE behind an optimization barrier (no full-width hoist);
+            # barrier'd (codes, scale) pairs ride along for the int8-matmul
+            # consumer hooks (_q8_bind)
             if not q8:
-                return list(pa)
-            out = []
+                return list(pa), [None] * len(pa)
+            out, pays = [], []
             for v in pa:
                 if isinstance(v, tuple):
                     qv, sv = lax.optimization_barrier(v)
                     out.append((qv.astype(jnp.float32) * sv).astype(cdt))
+                    pays.append((qv, sv))
                 else:
                     out.append(v)
-            return out
+                    pays.append(None)
+            return out, pays
 
         def model_step(pa, tokens, caches, pos_ids):
-            with _trace_guard(), _swap_params(params, expand(pa)), \
-                    autograd.no_grad():
+            ex, pays = expand(pa)
+            with _trace_guard(), _swap_params(params, ex), \
+                    _q8_bind(params, pays), autograd.no_grad():
                 logits, nc = self.forward(
                     Tensor(tokens), position_ids=Tensor(pos_ids),
                     caches=[(Tensor(k), Tensor(v), Tensor(p),

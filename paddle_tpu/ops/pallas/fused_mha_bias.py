@@ -23,9 +23,13 @@ output block resident across the inner sweep, accumulating per-program
 contributions — Pallas TPU grids are sequential, so read-modify-write on
 the resident output block is race-free.
 
-Layout/convention notes shared with fused_mha.py: packed [B, S, 3·nh·hd]
-qkv, per-head static lane slices, bf16 dots with f32 accumulation, f32
-softmax. No dropout / ragged-lens support here (Swin uses neither).
+Unlike fused_mha.py (packed [B,S,3F], which needs F % 128 == 0 for its
+block slicing), q/k/v ride as SEPARATE arrays here: swin head counts (3,
+6, 12, 24 at hd=32) give F = 96/192 that no packed block satisfies, while
+a (1, S, G·hd) block over a [B, S, F] array is legal whenever G·hd is
+128-aligned OR the full F. The packed<->split boundary is one XLA
+slice/concat pair per call — noise at window sizes. Numerics conventions
+are shared: bf16 dots, f32 accumulation, f32 softmax.
 """
 from __future__ import annotations
 
@@ -35,7 +39,6 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from .fused_mha import _head, _softmax_f32, _i0
 
@@ -53,11 +56,9 @@ def _fwd_kernel(b_ref, q_ref, k_ref, v_ref, o_ref, *, nh, hd, G, scale):
             preferred_element_type=jnp.float32).astype(o_ref.dtype)
 
 
-def _bwd_kernel(b_ref, q_ref, k_ref, v_ref, do_ref, dqkv_ref, db_ref,
-                *, nh, hd, G, scale, n_t):
+def _bwd_kernel(b_ref, q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref,
+                db_ref, *, nh, hd, G, scale):
     t, gg = pl.program_id(1), pl.program_id(2)
-    F = nh * hd
-    dqs, dks, dvs = [], [], []
     for j in range(G):
         q = _head(q_ref, j, hd)
         k = _head(k_ref, j, hd)
@@ -67,8 +68,9 @@ def _bwd_kernel(b_ref, q_ref, k_ref, v_ref, do_ref, dqkv_ref, db_ref,
         s = s + b_ref[0, j].astype(jnp.float32)
         sigma = _softmax_f32(s)
         dsig = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        dvs.append(jnp.dot(sigma.astype(do.dtype).T, do,
-                           preferred_element_type=jnp.float32))
+        dv_ref[0, :, j * hd:(j + 1) * hd] = jnp.dot(
+            sigma.astype(do.dtype).T, do,
+            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
         r = jnp.sum(dsig * sigma, axis=-1, keepdims=True)
         ds_f32 = sigma * (dsig - r)          # grad wrt (scaled logits+bias)
         hslot = gg * G + j
@@ -82,93 +84,75 @@ def _bwd_kernel(b_ref, q_ref, k_ref, v_ref, do_ref, dqkv_ref, db_ref,
             db_ref[0, hslot] += ds_f32
 
         ds = ds_f32.astype(q.dtype)
-        dqs.append(jnp.dot(ds, k, preferred_element_type=jnp.float32)
-                   * scale)
-        dks.append(jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-                   * scale)
-    span = G * hd
-    base = gg * span
-    dt = dqkv_ref.dtype
-    dqkv_ref[0, :, pl.ds(base, span)] = \
-        jnp.concatenate(dqs, axis=-1).astype(dt)
-    dqkv_ref[0, :, pl.ds(F + base, span)] = \
-        jnp.concatenate(dks, axis=-1).astype(dt)
-    dqkv_ref[0, :, pl.ds(2 * F + base, span)] = \
-        jnp.concatenate(dvs, axis=-1).astype(dt)
+        dq_ref[0, :, j * hd:(j + 1) * hd] = (jnp.dot(
+            ds, k, preferred_element_type=jnp.float32)
+            * scale).astype(dq_ref.dtype)
+        dk_ref[0, :, j * hd:(j + 1) * hd] = (jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32)
+            * scale).astype(dk_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
-def _mha_b(qkv, bias, nh, scale, G, interpret):
-    return _fwd(qkv, bias, nh, scale, G, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _mha_b(q, k, v, bias, nh, scale, G, interpret):
+    return _fwd(q, k, v, bias, nh, scale, G, interpret)
 
 
-def _fwd(qkv, bias, nh, scale, G, interpret):
-    b, s, F3 = qkv.shape
-    F = F3 // 3
+def _fwd(q, k, v, bias, nh, scale, G, interpret):
+    b, s, F = q.shape
     hd = F // nh
     R = bias.shape[0]
     n_groups = nh // G
     n_t = b // R
-
-    def at(third):
-        return pl.BlockSpec(
-            (1, s, G * hd),
-            lambda r, g, t, _t=third: (t * R + r, _i0(), _t * n_groups + g))
-
+    spec = pl.BlockSpec((1, s, G * hd),
+                        lambda r, g, t: (t * R + r, _i0(), g))
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, nh=nh, hd=hd, G=G, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((b, s, F), qkv.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, s, F), q.dtype),
         grid=(R, n_groups, n_t),
         in_specs=[
             pl.BlockSpec((1, G, s, s),
                          lambda r, g, t: (r, g, _i0(), _i0())),
-            at(0), at(1), at(2),
+            spec, spec, spec,
         ],
-        out_specs=pl.BlockSpec((1, s, G * hd),
-                               lambda r, g, t: (t * R + r, _i0(), g)),
+        out_specs=spec,
         interpret=interpret,
-    )(bias, qkv, qkv, qkv)
+    )(bias, q, k, v)
     return out
 
 
-def _vjp_fwd(qkv, bias, nh, scale, G, interpret):
-    return _fwd(qkv, bias, nh, scale, G, interpret), (qkv, bias)
+def _vjp_fwd(q, k, v, bias, nh, scale, G, interpret):
+    return _fwd(q, k, v, bias, nh, scale, G, interpret), (q, k, v, bias)
 
 
 def _vjp_bwd(nh, scale, G, interpret, res, g_out):
-    qkv, bias = res
-    b, s, F3 = qkv.shape
-    F = F3 // 3
+    q, k, v, bias = res
+    b, s, F = q.shape
     hd = F // nh
     R = bias.shape[0]
     n_groups = nh // G
     n_t = b // R
-
-    def at(third):
-        return pl.BlockSpec(
-            (1, s, G * hd),
-            lambda r, t, g, _t=third: (t * R + r, _i0(), _t * n_groups + g))
-
-    dqkv, dbias = pl.pallas_call(
-        functools.partial(_bwd_kernel, nh=nh, hd=hd, G=G, scale=scale,
-                          n_t=n_t),
-        out_shape=(jax.ShapeDtypeStruct((b, s, F3), qkv.dtype),
+    spec = pl.BlockSpec((1, s, G * hd),
+                        lambda r, t, g: (t * R + r, _i0(), g))
+    dq, dk, dv, dbias = pl.pallas_call(
+        functools.partial(_bwd_kernel, nh=nh, hd=hd, G=G, scale=scale),
+        out_shape=(jax.ShapeDtypeStruct((b, s, F), q.dtype),
+                   jax.ShapeDtypeStruct((b, s, F), q.dtype),
+                   jax.ShapeDtypeStruct((b, s, F), q.dtype),
                    jax.ShapeDtypeStruct((R, nh, s, s), jnp.float32)),
         grid=(R, n_t, n_groups),
         in_specs=[
             pl.BlockSpec((1, G, s, s),
                          lambda r, t, g: (r, g, _i0(), _i0())),
-            at(0), at(1), at(2), at(0),
+            spec, spec, spec, spec,
         ],
         out_specs=(
-            pl.BlockSpec((1, s, F3), lambda r, t, g: (t * R + r, _i0(),
-                                                      _i0())),
+            spec, spec, spec,
             pl.BlockSpec((1, nh, s, s), lambda r, t, g: (r, _i0(), _i0(),
                                                          _i0())),
         ),
         interpret=interpret,
-    )(bias, qkv, qkv, qkv, g_out)
-    return dqkv, dbias.astype(bias.dtype)
+    )(bias, q, k, v, g_out)
+    return dq, dk, dv, dbias.astype(bias.dtype)
 
 
 _mha_b.defvjp(_vjp_fwd, _vjp_bwd)
@@ -178,7 +162,8 @@ def fused_mha_bias(qkv, num_heads, bias, *, scale=None,
                    heads_per_program=None, interpret=False):
     """Batched-window attention with additive per-head bias.
 
-    qkv: [B, S, 3·nh·hd] packed [q heads | k heads | v heads].
+    qkv: [B, S, 3·nh·hd] packed [q heads | k heads | v heads] (split into
+        three arrays at the XLA boundary — one slice, one concat in vjp).
     bias: [R, nh, S, S] additive logits bias; program batch index p uses
         bias[p mod R] (B must be a multiple of R). Differentiable — the
         backward kernel accumulates d(bias) across the batch.
@@ -199,13 +184,14 @@ def fused_mha_bias(qkv, num_heads, bias, *, scale=None,
     G = heads_per_program or _pick_bias_group(num_heads, hd, s,
                                               qkv.dtype.itemsize)
     if num_heads % G or ((G * hd) % 128 and G != num_heads):
-        # dqkv span offsets g·(G·hd) must be 128-lane aligned unless there
-        # is a single group (offset 0 is static)
+        # the (1, S, G·hd) blocks need a 128-aligned last dim unless the
+        # block spans the full F (single group)
         raise ValueError(
             f"fused_mha_bias: heads_per_program={G} invalid for nh="
             f"{num_heads} hd={hd} (need nh%G==0 and (G*hd)%128==0, or "
             f"G==nh)")
-    return _mha_b(qkv, bias, int(num_heads), float(scale), int(G),
+    q, k, v = qkv[..., :F], qkv[..., F:2 * F], qkv[..., 2 * F:]
+    return _mha_b(q, k, v, bias, int(num_heads), float(scale), int(G),
                   bool(interpret))
 
 

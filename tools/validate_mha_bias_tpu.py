@@ -1,0 +1,48 @@
+"""Hardware validation for fused_mha_bias at swin stage shapes.
+
+PYTHONPATH=/root/repo:$PYTHONPATH python tools/validate_mha_bias_tpu.py
+"""
+import os
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from paddle_tpu.ops.pallas.fused_mha_bias import fused_mha_bias  # noqa
+from tests.test_fused_mha_bias import _ref_bias  # noqa
+
+
+def check(b, s, nh, hd, r_n, g=None, seed=0, tag=""):
+    rng = np.random.RandomState(seed)
+    qkv = jnp.asarray(rng.randn(b, s, 3 * nh * hd).astype(np.float32) * 0.3,
+                      jnp.bfloat16)
+    bias = jnp.asarray(rng.randn(r_n, nh, s, s).astype(np.float32) * 0.5)
+
+    def fk(a, bb):
+        return jnp.sum(fused_mha_bias(a, nh, bb, heads_per_program=g)
+                       .astype(jnp.float32) ** 2)
+
+    def fr(a, bb):
+        return jnp.sum(_ref_bias(a, nh, bb).astype(jnp.float32) ** 2)
+
+    vk, gk = jax.value_and_grad(fk, argnums=(0, 1))(qkv, bias)
+    vr, gr = jax.value_and_grad(fr, argnums=(0, 1))(qkv, bias)
+    rel = abs(float(vk) - float(vr)) / (abs(float(vr)) + 1e-9)
+    dq = np.abs(np.asarray(gk[0], np.float32)
+                - np.asarray(gr[0], np.float32)).max()
+    db = np.abs(np.asarray(gk[1], np.float32)
+                - np.asarray(gr[1], np.float32)).max()
+    dbs = np.abs(np.asarray(gr[1], np.float32)).max() + 1e-9
+    print(f"{tag}: fwd-rel {rel:.2e}  dqkv-maxdiff {dq:.3e}  "
+          f"dbias-relmax {db / dbs:.3e}")
+
+
+if __name__ == "__main__":
+    # swin-t stages: (windows grouped) S=196, heads 3/6/12/24, hd=32
+    check(64, 196, 3, 32, 16, g=3, tag="stage1 G=nh=3")
+    check(16, 196, 6, 32, 4, g=6, tag="stage2 G=nh=6")
+    check(4, 196, 12, 32, 1, g=4, tag="stage3 G=4")
+    check(8, 196, 24, 32, 1, g=4, tag="stage4 G=4")
+    check(8, 392, 4, 32, 2, g=4, tag="wg8 S=392 G=4")
