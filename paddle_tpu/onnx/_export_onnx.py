@@ -167,14 +167,19 @@ def _gelu_tanh(b, x):
                           b.node("Add", [t, b.scalar(1.0)])])
 
 
-def _bert_attention(b, layer, x, s):
-    """BertAttention inference graph (models/bert.py BertAttention.forward,
-    mask-free): packed qkv MatMul → per-third Slice → [B,S,nh,hd] Reshape →
-    head-major Transpose → QKᵀ·scale → Softmax → PV → repack → out proj."""
+def _packed_attention(b, layer, x, s, causal=False):
+    """Packed-QKV attention inference graph (models/bert.py BertAttention /
+    models/gpt.py GPTSelfAttention — both pack [q|k|v] along the last dim):
+    packed qkv MatMul → per-third Slice → [B,S,nh,hd] Reshape → head-major
+    Transpose → QKᵀ·scale (+ causal mask) → Softmax → PV → repack → out
+    proj. causal=True adds the teacher-forcing decoder mask as a static
+    [1,1,S,S] initializer (reference: paddle2onnx's decoder path over
+    python/paddle/onnx/export.py:22)."""
     nh, hd = layer.num_heads, layer.head_dim
     if s is None:
         raise ValueError(
-            "onnx.export: encoder blocks need a STATIC sequence length in "
+            "onnx.export: transformer blocks need a STATIC sequence "
+            "length in "
             "input_spec (e.g. [None, 128, hidden]) — the attention Reshape "
             "bakes it into the graph; only the batch dim may be symbolic")
     H = nh * hd
@@ -190,12 +195,22 @@ def _bert_attention(b, layer, x, s):
     v = b.node("Transpose", [heads[2]], perm=[0, 2, 1, 3])
     scores = b.node("Mul", [b.node("MatMul", [q, kT]),
                             b.scalar(1.0 / float(np.sqrt(hd)))])
+    if causal:
+        # one shared [1,1,S,S] initializer per seq length: a 24-block
+        # decoder reuses it instead of embedding ~4MB per block
+        key = getattr(b, "_cmask", {}).get(s)
+        if key is None:
+            mask = np.triu(np.full((1, 1, s, s), -1e9, np.float32), k=1)
+            key = b.tensor(f"cmask{b.n}", mask)
+            b._cmask = {**getattr(b, "_cmask", {}), s: key}
+        scores = b.node("Add", [scores, key])
     probs = b.node("Softmax", [scores], axis=-1)
     ctx = b.node("MatMul", [probs, v])                       # [B,nh,S,hd]
     ctx = b.node("Transpose", [ctx], perm=[0, 2, 1, 3])
     ctx = b.node("Reshape", [ctx, b.i64([0, s, H])])
     return _mm_bias(b, ctx, layer.out.weight,
                     getattr(layer.out, "bias", None))
+
 
 
 def _emit(layer, b: _Builder, x: str) -> str:
@@ -214,12 +229,31 @@ def _emit(layer, b: _Builder, x: str) -> str:
         e = b.node("Erf", [b.node("Div", [x, b.scalar(1.4142135623730951)])])
         return b.node("Mul", [b.node("Mul", [x, b.scalar(0.5)]),
                               b.node("Add", [e, b.scalar(1.0)])])
+    if kind == "GPTBlock":
+        # pre-LN DECODER block with causal teacher-forcing attention
+        # (models/gpt.py GPTBlock.forward, cache-free branch)
+        if getattr(layer, "is_moe", False):
+            raise NotImplementedError(
+                "onnx.export: MoE GPT blocks have no ONNX mapping (routed "
+                "dispatch); export the StableHLO artifact instead")
+        s = b.seq_len
+        h = _ln(b, x, layer.ln_1.weight, layer.ln_1.bias,
+                float(layer.ln_1._epsilon))
+        x = b.node("Add", [x, _packed_attention(b, layer.attn, h, s,
+                                                causal=True)])
+        h2 = _ln(b, x, layer.ln_2.weight, layer.ln_2.bias,
+                 float(layer.ln_2._epsilon))
+        up = _mm_bias(b, h2, layer.mlp.up.weight,
+                      getattr(layer.mlp.up, "bias", None))
+        y = _mm_bias(b, _gelu_tanh(b, up), layer.mlp.down.weight,
+                     getattr(layer.mlp.down, "bias", None))
+        return b.node("Add", [x, y])
     if kind == "BertLayer":
         # post-LN encoder block (models/bert.py BertLayer.forward);
         # reference analog: paddle2onnx's transformer path over
         # incubate/nn/layer/fused_transformer.py:725 encoders
         s = b.seq_len
-        attn = _bert_attention(b, layer.attention, x, s)
+        attn = _packed_attention(b, layer.attention, x, s)
         x = _ln(b, b.node("Add", [x, attn]), layer.ln_1.weight,
                 layer.ln_1.bias, float(layer.ln_1._epsilon))
         up = _mm_bias(b, x, layer.up.weight, getattr(layer.up, "bias", None))

@@ -43,11 +43,20 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, *, w_layout, out_dtype):
     o_ref[...] = (acc * s_ref[...]).astype(out_dtype)
 
 
-def _pick_mt(m):
+def _pick_tiles(m, k, n, itemsize, block_n):
+    """(mt, bn) under the scoped-VMEM plan: 2x-buffered x tile (mt, K)
+    + 2x-buffered int8 tile (K, bn) + f32 accumulator tile."""
+    budget = 11 * 1024 * 1024
     for mt in (256, 128, 64, 32, 16, 8):
-        if m % mt == 0:
-            return mt
-    return m
+        if m % mt:
+            continue
+        for bn in (block_n, 256, 128):
+            if n % bn:
+                continue
+            need = 2 * mt * k * itemsize + 2 * k * bn + 2 * mt * bn * 4
+            if need <= budget:
+                return mt, bn
+    return 8, 128
 
 
 def int8_matmul(x, q, s, *, w_layout="kn", block_n=512, interpret=False):
@@ -66,10 +75,7 @@ def int8_matmul(x, q, s, *, w_layout="kn", block_n=512, interpret=False):
             acc = lax.dot_general(x, qw, (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.float32)
         return (acc * s).astype(x.dtype)
-    mt = _pick_mt(m)
-    bn = block_n
-    while n % bn:
-        bn //= 2
+    mt, bn = _pick_tiles(m, k, n, x.dtype.itemsize, block_n)
     grid = (m // mt, n // bn)
     if w_layout == "kn":
         qspec = pl.BlockSpec((k, bn), lambda mi, ni: (_i0(), ni))

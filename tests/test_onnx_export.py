@@ -273,6 +273,44 @@ class TestOnnxTransformerExport:
         want = t.numpy()
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
+    def test_gpt_decoder_block_parity_and_causality(self, tmp_path):
+        """GPT DECODER blocks export to real opset-13 .onnx with a causal
+        teacher-forcing mask (VERDICT r4 #9): numeric parity vs the
+        framework forward, AND causality holds — perturbing position t
+        leaves outputs at positions < t unchanged. Reference:
+        python/paddle/onnx/export.py:22 (paddle2onnx decoder path)."""
+        from paddle_tpu.models import GPTForCausalLM, gpt_config
+        from paddle_tpu import onnx as ponnx
+
+        cfg = gpt_config("gpt3-125m")
+        cfg.num_layers = 2
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        S = 16
+        path = str(tmp_path / "gpt_blocks.onnx")
+        ponnx.export(model.gpt.h, path,
+                     input_spec=[[None, S, cfg.hidden_size]])
+
+        m = _decode(path)
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, S, cfg.hidden_size).astype(np.float32) * 0.3
+        got = _run_onnx(m, x)
+
+        t = paddle.to_tensor(x)
+        for blk in model.gpt.h:
+            t = blk(t)
+        want = t.numpy()
+        np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+        # causality: perturb position 9; outputs[:, :9] must be unchanged
+        x2 = x.copy()
+        x2[:, 9] += 1.0
+        got2 = _run_onnx(m, x2)
+        np.testing.assert_allclose(got2[:, :9], got[:, :9],
+                                   rtol=1e-6, atol=1e-6)
+        assert np.abs(got2[:, 9:] - got[:, 9:]).max() > 1e-3
+
     def test_layer_norm_and_gelu_standalone(self, tmp_path):
         from paddle_tpu import onnx as ponnx
         paddle.seed(0)
