@@ -390,6 +390,11 @@ def fused_mha(qkv, num_heads, *, scale=None, kv_len=None, causal=False,
             jnp.float32).reshape(1, 1)
     else:
         seed = jnp.zeros((1, 1), jnp.float32)
+    if heads_per_program is None:
+        # env override rides through the SAME validation as explicit args
+        import os
+        heads_per_program = (
+            int(os.environ.get("PADDLE_TPU_FUSED_MHA_G", "0")) or None)
     if heads_per_program is not None and (
             num_heads % heads_per_program
             or (heads_per_program * hd) % 128):
