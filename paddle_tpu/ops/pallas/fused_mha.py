@@ -170,7 +170,7 @@ def _bwd_kernel(seed_ref, *rest, nh, hd, G, scale, kv_len, causal, drop_p,
         jnp.concatenate(dvs, axis=-1).astype(dt)
 
 
-def _pick_group(nh, hd, s, itemsize, n_bufs, fixed_bytes=0):
+def _pick_group(nh, hd, s, itemsize, n_bufs, fixed_bytes=0, batch=None):
     """Largest G dividing nh whose blocks fit the VMEM plan.
 
     n_bufs: resident (S, G·hd) stream buffers — inputs are double-buffered
@@ -190,9 +190,13 @@ def _pick_group(nh, hd, s, itemsize, n_bufs, fixed_bytes=0):
         if blocks + eph <= _VMEM_BUDGET:
             best = G
             break
-    # measured on v5e (B=32 S=197 nh=16 hd=64): G=8 beats G=16 by ~25%
-    # forward — two groups per batch item pipeline DMA against compute
-    while best > 8 and nh % (best // 2) == 0:
+    # measured on v5e (S=197 nh=16 hd=64): at B=64 G=8 beats G=16 (two
+    # groups per batch item pipeline DMA against compute, full-step 66.2%
+    # vs lower); at B=32 the FULL STEP prefers G=16 (56.3% vs 54.2% at
+    # G=8 — fewer, fatter programs when the grid is short). The r4 note
+    # preferring G=8 universally came from a forward-only microbench.
+    while best > 8 and nh % (best // 2) == 0 and (batch is None
+                                                  or batch > 32):
         best //= 2
     return best
 
@@ -275,6 +279,10 @@ def _mha_vjp_bwd(nh, scale, kv_len, causal, drop_p, G, interpret, use_lens,
     # the backward streams 4 group-sized buffers (q,k,v,do in) plus the
     # FULL (S, 3F) dqkv output block, which is group-size-independent and
     # double-buffered across the batch grid dim — budget it as fixed
+    # note: no batch= here — the measured B=32 configuration (ViT-L 56.3%)
+    # is fwd G=16 / bwd G=8: the backward's resident dqkv block already
+    # fattens its programs, so the small-batch large-G preference is a
+    # forward-only effect
     Gb = min(G, _pick_group(nh, hd, s, qkv.dtype.itemsize, n_bufs=4,
                             fixed_bytes=2 * s * F3 * qkv.dtype.itemsize))
     while Gb > 1 and (nh % Gb or (Gb * hd) % 128):
@@ -405,7 +413,7 @@ def fused_mha(qkv, num_heads, *, scale=None, kv_len=None, causal=False,
             f"num_heads={num_heads} with heads_per_program*head_dim "
             f"({heads_per_program * hd}) a multiple of 128")
     G = heads_per_program or _pick_group(num_heads, hd, s, qkv.dtype.itemsize,
-                                         n_bufs=4)
+                                         n_bufs=4, batch=b)
     use_lens = lens_arr is not None
     if lens_arr is None:
         lens_arr = jnp.zeros((b, 1), jnp.float32)   # float carrier (vjp)

@@ -1,0 +1,66 @@
+"""ViT-L B=32 vs B=64 attention-term ablation (real step deltas).
+
+The B=32 operating point sits ~10 MFU points under B=64 with the same
+kernel. This measures WHERE: run the full step and a variant with the
+fused-MHA call replaced by a values-passthrough (keeps qkv/out projections
+and everything else; ablates only the S^2 attention math + its kernel),
+at both batch sizes. If the non-attention time scales ~2x from B=32 to
+B=64 but the attention term does not, the kernel's batch-pipelining is
+the pinned cost.
+
+PYTHONPATH=/root/repo:$PYTHONPATH python tools/vit_budget.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from tools.step_budget import timed  # noqa: E402
+
+
+def build(B, ablate_attn):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.models import VisionTransformer, vit_config
+    from paddle_tpu.ops.pallas import fused_mha as FM
+
+    if ablate_attn:
+        orig = FM.fused_mha
+
+        def stub(qkv, num_heads, **kw):
+            f3 = qkv.shape[-1]
+            return qkv[..., 2 * f3 // 3:]          # values passthrough
+        FM.fused_mha = stub
+    cfg = vit_config("vit-l16", image_size=224, num_classes=1000)
+    paddle.seed(0)
+    model = VisionTransformer(cfg)
+    model.to(dtype="bfloat16")
+    ce = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 moment_dtype="bfloat16")
+    step = TrainStep(model, opt, lambda x, y: ce(model(x), y))
+    iters = 8
+    x = paddle.to_tensor(np.random.randn(iters, B, 3, 224, 224)
+                         .astype("bfloat16"))
+    y = paddle.to_tensor(np.random.randint(0, 1000, (iters, B))
+                         .astype("int64"))
+    ms = timed(step, iters, x, y)
+    if ablate_attn:
+        FM.fused_mha = orig
+    return ms
+
+
+def main():
+    for B in (32, 64):
+        full = build(B, False)
+        noat = build(B, True)
+        print(f"B={B}: full {full:7.2f} ms  no-attn {noat:7.2f} ms  "
+              f"attention term {full - noat:6.2f} ms "
+              f"({(full - noat) / B * 1e3:.1f} us/img)")
+
+
+if __name__ == "__main__":
+    main()
