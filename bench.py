@@ -17,7 +17,7 @@ repo publishes no absolute numbers (BASELINE.md), so the target line is the
 baseline.
 
 Env knobs: PADDLE_TPU_BENCH_MODEL=<row> runs one row (gpt|vit|bert|resnet50|
-swin|decode|moe|gpt27); PADDLE_TPU_BENCH_BUDGET_S caps ladder wall time;
+swin|decode|moe|gpt27|...see _SINGLE); PADDLE_TPU_BENCH_BUDGET_S caps ladder wall time;
 per-row B/S/preset overrides as before.
 """
 from __future__ import annotations
