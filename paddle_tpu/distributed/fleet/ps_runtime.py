@@ -105,6 +105,12 @@ class PsServer:
                 ids, grads = args
                 t.push(ids, grads)
                 return True
+            if cmd == "push_pull":
+                # one round-trip for the dense-PS hot path (transpiler):
+                # apply the update, return the fresh rows
+                ids, grads = args
+                t.push(ids, grads)
+                return t.pull(ids)
             if cmd == "merge_delta":
                 ids, delta = args
                 t.merge_delta(ids, delta)
@@ -164,6 +170,10 @@ class RemoteShard:
 
     def push(self, ids, grads):
         return self._call("push", (ids, grads))
+
+    def push_pull(self, ids, grads):
+        """Apply the update and return fresh rows in ONE round-trip."""
+        return self._call("push_pull", (ids, grads))
 
     def merge_delta(self, ids, delta):
         return self._call("merge_delta", (ids, delta))

@@ -368,3 +368,11 @@ class regularizer:
 core = type("core", (), {
     "Scope": None,
 })
+
+
+# fluid-1.x distributed transpiler (reference:
+# fluid/transpiler/distribute_transpiler.py:264) — PS-mode training over
+# the fleet PS runtime; see fluid/transpiler.py for the redesign notes
+from . import transpiler  # noqa: F401,E402
+from .transpiler import (  # noqa: F401,E402
+    DistributeTranspiler, DistributeTranspilerConfig)

@@ -1,0 +1,209 @@
+"""fluid.DistributeTranspiler — fluid-1.x parameter-server training.
+
+Reference: python/paddle/fluid/transpiler/distribute_transpiler.py:264 —
+transpile() rewrites a fluid static Program into a trainer program (grads
+sent to parameter servers, fresh params received) and per-endpoint pserver
+programs (param shards + the optimizer applied server-side, behind a
+Listen&Serv op).
+
+TPU-native redesign (no program surgery): the static Program replays as one
+jitted XLA step here, so the transpiler marks the program for PS execution
+instead of rewriting it. The Executor then builds the SAME step minus the
+optimizer apply, fetches the gradients, and the bridge below pushes them to
+the PS runtime (distributed/fleet/ps_runtime.py: the same pickle-frame
+PsServer/RemoteShard pair the sparse-table path uses) which applies the
+update server-side and returns fresh rows. Dense params shard across
+endpoints round-robin (the reference's slice_var_up=False layout).
+
+Supported scope (documented subset): server-side optimizer = SGD (the
+reference moves whatever optimizer server-side; here non-SGD raises),
+single- or multi-trainer with ASYNCHRONOUS application semantics (trainer 0
+initializes the tables; the reference's geo/async modes share this shape).
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+class DistributeTranspilerConfig:
+    """Accepted fluid-1.x knobs. Layout knobs are advisory here: params
+    shard whole (round-robin) — the reference's slice_var_up=False mode."""
+
+    slice_var_up = False
+    split_method = None
+    min_block_size = 8192
+    enable_dc_asgd = False
+    mode = "pserver"
+    print_log = False
+    wait_port = True
+
+
+def _rows_view(arr):
+    """Param -> (m, n) row matrix: dim-0 rows for >=2-D, one row for 1-D."""
+    a = np.asarray(arr, np.float32)
+    if a.ndim <= 1:
+        return a.reshape(1, -1)
+    return a.reshape(a.shape[0], -1)
+
+
+class _PsTrainerBridge:
+    """Push-grads / pull-params glue the Executor calls once per step."""
+
+    def __init__(self, endpoints: List[str], trainer_id: int, trainers: int):
+        self.endpoints = endpoints
+        self.trainer_id = trainer_id
+        self.trainers = trainers
+        self._shards = None
+        self._meta = None
+
+    def _connect(self, params, lr):
+        from ..distributed.fleet.ps_runtime import RemoteShard
+        self._shards, self._meta = [], []
+        self._lr0 = float(lr)
+        self._fingerprint = tuple((p.name, tuple(p._data.shape))
+                                  for p in params)
+        for i, p in enumerate(params):
+            rows = _rows_view(p._data)
+            ep = self.endpoints[i % len(self.endpoints)]
+            name = f"dtp_{p.name or f'param_{i}'}"
+            sh = RemoteShard(ep, name, rows.shape[1], optimizer="sgd",
+                             lr=float(lr), init_scale=0.0)
+            ids = np.arange(rows.shape[0], dtype=np.int64)
+            if self.trainer_id == 0:
+                # ONE merge_delta both materializes the rows (exact zeros
+                # under init_scale=0) and sets the initial values — atomic
+                # under the server's per-table lock, so other trainers'
+                # size probe can never observe half-initialized tables
+                sh.merge_delta(ids, rows)
+            else:
+                deadline = time.time() + 120.0
+                while len(sh) < rows.shape[0]:   # wait for trainer 0 init
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            f"DistributeTranspiler: table {name} not "
+                            "initialized by trainer 0 within 120s")
+                    time.sleep(0.05)
+            self._shards.append(sh)
+            self._meta.append((ids, p._data.shape, p._data.dtype))
+
+    def apply(self, params, grads, lr):
+        import jax.numpy as jnp
+        if self._shards is None:
+            self._connect(params, lr)
+        if float(lr) != self._lr0:
+            raise NotImplementedError(
+                "DistributeTranspiler: the server-side SGD applies the "
+                f"creation-time lr ({self._lr0}); LR schedules are not "
+                "supported in PS mode")
+        if tuple((p.name, tuple(p._data.shape))
+                 for p in params) != self._fingerprint:
+            raise RuntimeError(
+                "DistributeTranspiler: trainable-parameter set changed "
+                "after the first step (e.g. stop_gradient toggled) — "
+                "re-transpile to rebuild the table binding")
+        for p, g, sh, (ids, shape, dtype) in zip(params, grads,
+                                                 self._shards, self._meta):
+            fresh = sh.push_pull(ids, _rows_view(g))
+            p._data = jnp.asarray(fresh.reshape(shape), dtype=dtype)
+            p._node = None
+
+    def close(self):
+        for sh in self._shards or []:
+            sh.close()
+
+
+class _PServerProgram:
+    """What get_pserver_program returns; exe.run(it) blocks serving —
+    the reference's Listen&Serv loop. `_ps_serve` is the Executor hook."""
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+        self._server = None
+
+    def _start(self):
+        from ..distributed.fleet.ps_runtime import PsServer
+        host, port = self.endpoint.rsplit(":", 1)
+        self._server = PsServer(port=int(port),
+                                host=host if host not in ("", "*") else
+                                "0.0.0.0")
+        return self._server
+
+    def _ps_serve(self):
+        self._start().serve_forever()
+        return []
+
+    def _ps_serve_in_thread(self):
+        srv = self._start()
+        th = srv.serve_in_thread()
+        return srv, th
+
+
+class DistributeTranspiler:
+    """Reference API surface: transpile / get_trainer_program /
+    get_pserver_program(s) / get_startup_program."""
+
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._prog = None
+        self._pservers: List[str] = []
+        self._trainer_id = 0
+        self._trainers = 1
+        self._sync_mode = True
+
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=True, startup_program=None,
+                  current_endpoint=None):
+        from ..static.program import default_main_program
+        self._prog = program or default_main_program()
+        self._pservers = [e.strip() for e in str(pservers).split(",")
+                          if e.strip()]
+        if not self._pservers:
+            raise ValueError("DistributeTranspiler.transpile: pservers "
+                             "endpoint list is empty")
+        self._trainer_id = int(trainer_id)
+        self._trainers = int(trainers)
+        self._sync_mode = bool(sync_mode)
+
+    def get_trainer_program(self, wait_port=True):
+        opt = getattr(self._prog, "_optimizer", None)
+        if opt is not None and type(opt).__name__ not in ("SGD",):
+            raise NotImplementedError(
+                "DistributeTranspiler: server-side optimizer application "
+                f"supports SGD (got {type(opt).__name__}) — the reference "
+                "moves the optimizer to the pserver; richer rules belong "
+                "to the fleet PS runtime (distributed/fleet)")
+        if opt is not None:
+            # the local executor path would clip and weight-decay; the PS
+            # path ships raw grads to a plain-SGD server — refuse instead
+            # of silently training a different objective
+            if getattr(opt, "_grad_clip", None) is not None:
+                raise NotImplementedError(
+                    "DistributeTranspiler: grad_clip is applied by the "
+                    "local executor path but not by the PS server — "
+                    "unsupported in PS mode")
+            if any(float(opt._wd_for(p) or 0.0) != 0.0
+                   for p in self._prog._params if not p.stop_gradient):
+                raise NotImplementedError(
+                    "DistributeTranspiler: weight_decay/regularization is "
+                    "not applied by the PS server's plain SGD — "
+                    "unsupported in PS mode")
+        self._prog._ps_dist = _PsTrainerBridge(
+            self._pservers, self._trainer_id, self._trainers)
+        return self._prog
+
+    def get_pserver_program(self, endpoint):
+        return _PServerProgram(endpoint)
+
+    def get_pserver_programs(self, endpoint):
+        prog = self.get_pserver_program(endpoint)
+        return prog, self.get_startup_program(endpoint, prog)
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        # startup initializers already ran eagerly in this framework;
+        # an empty program is a no-op under Executor.run
+        from ..static.program import Program
+        return Program()

@@ -96,6 +96,10 @@ class Executor:
             scope=None, **kw):
         if isinstance(program, CompiledProgram):
             program = program.program
+        if hasattr(program, "_ps_serve"):
+            # fluid DistributeTranspiler pserver program: block serving
+            # (the reference's Listen&Serv loop) — see fluid/transpiler.py
+            return program._ps_serve()
         prog: Program = program or default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -109,6 +113,7 @@ class Executor:
 
         fetch_vids = tuple(self._fetch_vid(prog, f) for f in fetch_list)
         train = prog._optimizer is not None and prog._loss_vid is not None
+        ps_bridge = getattr(prog, "_ps_dist", None) if train else None
 
         feed_arrays = []
         feed_sig = []
@@ -129,14 +134,28 @@ class Executor:
         # cache key includes the trainable partition: freezing a parameter
         # between runs must trigger a rebuild, not bind wrong slots
         part_sig = tuple(id(p) in _diff_ids for p in prog._params)
-        key = (prog.id, prog._version, tuple(feed_sig), fetch_vids, train, part_sig)
+        mode = "ps" if ps_bridge is not None else (
+            "train" if train else "infer")
+        key = (prog.id, prog._version, tuple(feed_sig), fetch_vids, mode,
+               part_sig)
         fn = self._cache.get(key)
         if fn is None:
-            fn = self._build(prog, fetch_vids, train)
+            fn = self._build(prog, fetch_vids, train,
+                             ps_grads=ps_bridge is not None)
             self._cache[key] = fn
         keys = tuple(random_mod.split_key() for _ in prog._key_vars)
 
-        if train:
+        if ps_bridge is not None:
+            # PS-distributed fluid training: the step returns GRADS; the
+            # bridge pushes them to the parameter servers (which apply the
+            # update) and pulls fresh params back into the program
+            fetches, grads = fn(tuple(p._data for p in diff_params),
+                                tuple(p._data for p in const_params),
+                                keys, *feed_arrays)
+            ps_bridge.apply(diff_params,
+                            [np.asarray(g, np.float32) for g in grads],
+                            prog._optimizer.get_lr())
+        elif train:
             opt = prog._optimizer
             if prog.id not in self._opt_state:
                 self._opt_state[prog.id] = [opt.init_state(p._data) for p in diff_params]
@@ -168,7 +187,8 @@ class Executor:
             return prog.global_block().var(f).vid
         raise TypeError(f"fetch_list entries must be Variable or name, got {type(f)}")
 
-    def _build(self, prog, fetch_vids, train, feed_vars=None):
+    def _build(self, prog, fetch_vids, train, feed_vars=None,
+               ps_grads=False):
         # backward-slice the op list to the ancestors of what we actually
         # compute (the reference's Prune pass over ProgramDesc —
         # framework/prune.cc — done here as a reverse walk over the DAG)
@@ -257,6 +277,25 @@ class Executor:
                     env2 = replay(dpa, kpa, keys, feeds, var_override={_x: xa})
                     return jnp.sum(env2[_t].astype(jnp.float32))
                 env[gvid] = jax.grad(tgt)(env[xvid])
+
+        if ps_grads:
+            # DistributeTranspiler trainer step: loss + grads only; the
+            # optimizer applies SERVER-side (fluid/transpiler.py)
+            def ps_step(dpa, kpa, keys, *feeds):
+                def loss_fn(pa):
+                    env = replay(pa, kpa, keys, feeds)
+                    return env[loss_vid].astype(jnp.float32), env
+                (_, env), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(list(dpa))
+                for pidx, gvid in grad_of.items():
+                    tag, pos = param_slot[pidx]
+                    if tag == "d":
+                        env[gvid] = grads[pos]
+                eval_var_grads(env, dpa, kpa, keys, feeds)
+                fetches = tuple(env[v] for v in fetch_vids)
+                return fetches, tuple(grads)
+
+            return jax.jit(ps_step)
 
         if train:
             def step(dpa, kpa, opt_state, lr, step_i, keys, *feeds):
