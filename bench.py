@@ -3,8 +3,9 @@ available accelerator (reference gate analog: tools/ci_model_benchmark.sh:50
 benches a model SUITE, not one config).
 
 Default (TPU): runs the FULL ladder — flagship GPT-1.3B, ViT-L, BERT-base,
-decode, MoE, ResNet-50, BERT-large, ViT-H/14, GPT-2.7B — printing ONE JSON
-line per row as it completes,
+decode (bf16 B=8, int8 B=8, bf16 B=32), MoE, ResNet-50, BERT-large,
+ViT-H/14, Swin-T, GPT-2.7B — printing ONE JSON line per row as it
+completes,
 then a final line repeating the flagship row with the whole ladder embedded
 under extra.ladder (the driver parses the LAST line; partial output still
 carries every completed row).
