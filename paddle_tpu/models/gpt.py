@@ -103,6 +103,25 @@ def gpt_config(preset: str, **overrides) -> GPTConfig:
 from contextlib import contextmanager
 
 
+def _is_q8_cache(cache):
+    """True iff a static-cache tuple is the int8 form (k_codes, k_scale,
+    v_codes, v_scale, pos[, ragged]). The length check alone is not a safe
+    tag — the codes buffer's dtype is — so both dispatch sites (here and
+    GPTModel.forward's position offset) verify int8 explicitly and a
+    malformed tuple fails loudly instead of reading a scale buffer as the
+    position cursor."""
+    first = cache[0]
+    dt = first._data.dtype if hasattr(first, "_data") else first.dtype
+    if len(cache) >= 5:
+        if dt != jnp.int8:
+            raise ValueError(
+                f"static KV-cache tuple of length {len(cache)} must carry "
+                f"int8 codes first (got {dt}); bf16/f32 caches are "
+                f"(k, v, pos[, ragged])")
+        return True
+    return False
+
+
 @contextmanager
 def _q8_bind(params, payloads):
     """Tag param Tensors with their barrier'd int8 (codes, scale) payload
@@ -146,7 +165,43 @@ class GPTSelfAttention(Layer):
         b, s = qkv.shape[0], qkv.shape[1]
 
         new_cache = None
-        if cache is not None and len(cache) >= 3:
+        if cache is not None and _is_q8_cache(cache):
+            # INT8 static-cache decode (cache_dtype="int8"): the bf16 path
+            # below is KV-bandwidth-bound at small batch — storing the
+            # cache as int8 codes + per-(pos,head) scales halves the KV
+            # bytes each decode step streams from HBM. Dequant is a fused
+            # elementwise producer of the attention dots (never a
+            # materialized bf16 buffer). Reference analog: CacheKV int8 in
+            # operators/fused/fused_multi_transformer_op.cu.
+            # Tuple: (k_codes, k_scale, v_codes, v_scale, pos[, ragged]).
+            qkv = ops.reshape(qkv, [b, s, 3, nh, hd])
+            kc, ks, vc, vs, pos = cache[:5]
+            ragged = cache[5] if len(cache) >= 6 else None
+            q = qkv[:, :, 0]
+
+            from ..ops.attention import (static_cache_update_q8,
+                                         static_cache_mask)
+            kc2, ks2 = apply_op("static_cache_k_q8", static_cache_update_q8,
+                                [kc, ks, qkv[:, :, 1], pos])
+            vc2, vs2 = apply_op("static_cache_v_q8", static_cache_update_q8,
+                                [vc, vs, qkv[:, :, 2], pos])
+            new_cache = (kc2.detach(), ks2.detach(), vc2.detach(),
+                         vs2.detach(), pos + s) + (
+                (ragged,) if ragged is not None else ())
+
+            def _attend_static_q8(qa, kca, ksa, vca, vsa, p, lens=None):
+                from ..ops.attention import attention_q8_cache
+                mask = static_cache_mask(
+                    kca.shape[1], qa.shape[1], p,
+                    prompt_lens=lens,
+                    prefill_cap=None if ragged is None else ragged[1])
+                return attention_q8_cache(qa, kca, ksa, vca, vsa, mask)
+
+            args = [q, kc2, ks2, vc2, vs2, pos]
+            if ragged is not None:
+                args.append(ragged[0])
+            ctx = apply_op("static_cache_attend_q8", _attend_static_q8, args)
+        elif cache is not None and len(cache) >= 3:
             # STATIC-cache decode (TPU-native serving path): fixed-size
             # [B, L_max, nh, hd] buffers + write position — every step has
             # the same shapes, so the whole generation compiles ONCE
@@ -359,8 +414,11 @@ class GPTModel(Layer):
             # on TPU (MIGRATION.md "Integer dtypes")
             if caches and len(caches[0]) >= 3:
                 # static-cache decode: the write position IS the offset
+                # (int8 tuples carry it at index 4, bf16 at index 2)
+                pos0 = (caches[0][4] if _is_q8_cache(caches[0])
+                        else caches[0][2])
                 position_ids = ops.unsqueeze(
-                    caches[0][2] + ops.arange(0, s, dtype="int32"), 0)
+                    pos0 + ops.arange(0, s, dtype="int32"), 0)
             else:
                 past = caches[0][0].shape[1] if caches else 0
                 position_ids = ops.arange(past, past + s, dtype="int32")
@@ -409,6 +467,36 @@ class GPTModel(Layer):
         if caches is not None:
             return x, new_caches
         return x
+
+
+def _validate_cache_dtype(cache_dtype, cdt):
+    """Shared generate_static/_ragged check: None, the model dtype, or
+    'int8'. Returns True when the int8 KV-cache path is requested."""
+    if cache_dtype == "int8":
+        return True
+    if cache_dtype is not None and jnp.dtype(cache_dtype) != jnp.dtype(cdt):
+        raise ValueError(f"cache_dtype must be None, the model dtype, "
+                         f"or 'int8'; got {cache_dtype!r}")
+    return False
+
+
+def _make_static_caches(c8, nl, b, L, nh, hd, cdt, lens=None):
+    """Per-layer static KV-cache carries for the compiled decode loop.
+
+    bf16/f32: (k, v, pos[, lens]); int8: (k_codes, k_scale, v_codes,
+    v_scale, pos[, lens]) — codes int8, scales f32 per (pos, head). The
+    lens vector (ragged serving) always rides LAST so model_step wrappers
+    can treat it uniformly."""
+    if c8:
+        base = (jnp.zeros((b, L, nh, hd), jnp.int8),
+                jnp.zeros((b, L, nh), jnp.float32),
+                jnp.zeros((b, L, nh, hd), jnp.int8),
+                jnp.zeros((b, L, nh), jnp.float32), jnp.int32(0))
+    else:
+        base = (jnp.zeros((b, L, nh, hd), cdt),
+                jnp.zeros((b, L, nh, hd), cdt), jnp.int32(0))
+    tail = () if lens is None else (lens,)
+    return [base + tail for _ in range(nl)]
 
 
 class GPTForCausalLM(Layer):
@@ -502,7 +590,7 @@ class GPTForCausalLM(Layer):
                         temperature: float = 0.0, top_k: int = 0,
                         top_p: float = 1.0, max_len: int = None,
                         seed: int = 0, eos_token_id: int = None,
-                        weight_dtype: str = None):
+                        weight_dtype: str = None, cache_dtype: str = None):
         """TPU-native generation: static KV-cache buffers + the WHOLE
         prefill-then-decode loop compiled as ONE XLA program (lax.scan over
         decode steps). Same outputs as generate() for greedy decoding; the
@@ -530,6 +618,7 @@ class GPTForCausalLM(Layer):
         cdt = self.gpt.wte.weight._data.dtype
         nh, hd, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
         q8 = weight_dtype == "int8"
+        c8 = _validate_cache_dtype(cache_dtype, cdt)
         qmap = self._decode_quantized_params() if q8 else {}
 
         def expand(pa):
@@ -558,21 +647,19 @@ class GPTForCausalLM(Layer):
             ex, pays = expand(pa)
             with _trace_guard(), _swap_params(params, ex), \
                     _q8_bind(params, pays), autograd.no_grad():
+                # tuple-generic wrap: (k, v, pos) bf16 or the int8 5-tuple
+                # (k_codes, k_scale, v_codes, v_scale, pos)
                 logits, nc = self.forward(
                     Tensor(tokens),
-                    caches=[(Tensor(k), Tensor(v), Tensor(p))
-                            for (k, v, p) in caches])
-            return logits._data, [(k._data, v._data, p._data)
-                                  for (k, v, p) in nc]
+                    caches=[tuple(Tensor(e) for e in c) for c in caches])
+            return logits._data, [tuple(e._data for e in c) for c in nc]
 
         def pick(last, key):
             return sample_logits(last, key, temperature=temperature,
                                  top_k=top_k, top_p=top_p)
 
         def run(pa, prompt, key0):
-            caches = [(jnp.zeros((b, L, nh, hd), cdt),
-                       jnp.zeros((b, L, nh, hd), cdt), jnp.int32(0))
-                      for _ in range(nl)]
+            caches = _make_static_caches(c8, nl, b, L, nh, hd, cdt)
             logits, caches = model_step(pa, prompt, caches)     # prefill
             key0, k1 = jax.random.split(key0)
             nxt = pick(logits[:, -1].astype(jnp.float32), k1)
@@ -608,7 +695,7 @@ class GPTForCausalLM(Layer):
         sig = (b, p_len, int(max_new_tokens), L, float(temperature),
                int(top_k), float(top_p),
                None if eos_token_id is None else int(eos_token_id), str(cdt),
-               "q8" if q8 else "full")
+               "q8" if q8 else "full", "c8" if c8 else "cfull")
         # LRU-capped: each distinct signature retains a compiled XLA
         # executable; a serving loop over ragged prompt lengths would
         # otherwise accumulate compilations without bound (advisor r3).
@@ -637,7 +724,8 @@ class GPTForCausalLM(Layer):
                                temperature: float = 0.0, top_k: int = 0,
                                top_p: float = 1.0, max_len: int = None,
                                seed: int = 0, eos_token_id: int = None,
-                               weight_dtype: str = None):
+                               weight_dtype: str = None,
+                               cache_dtype: str = None):
         """ONE compiled program for ANY prompt length (VERDICT r3 #7a).
 
         input_ids: [B, P_cap] prompts RIGHT-padded to a fixed cap; only
@@ -686,6 +774,7 @@ class GPTForCausalLM(Layer):
         cdt = self.gpt.wte.weight._data.dtype
         nh, hd, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
         q8 = weight_dtype == "int8"
+        c8 = _validate_cache_dtype(cache_dtype, cdt)
         qmap = self._decode_quantized_params() if q8 else {}
 
         def expand(pa):
@@ -710,22 +799,23 @@ class GPTForCausalLM(Layer):
             ex, pays = expand(pa)
             with _trace_guard(), _swap_params(params, ex), \
                     _q8_bind(params, pays), autograd.no_grad():
+                # carry entries are flat tuples ending in the lens vector;
+                # the forward's ragged element is the nested (lens, cap)
                 logits, nc = self.forward(
                     Tensor(tokens), position_ids=Tensor(pos_ids),
-                    caches=[(Tensor(k), Tensor(v), Tensor(p),
-                             (Tensor(ln), p_cap))
-                            for (k, v, p, ln) in caches])
-            return logits._data, [(k._data, v._data, p._data, ln._data)
-                                  for (k, v, p, (ln, _)) in nc]
+                    caches=[tuple(Tensor(e) for e in c[:-1])
+                            + ((Tensor(c[-1]), p_cap),)
+                            for c in caches])
+            return logits._data, [tuple(e._data for e in c[:-1])
+                                  + (c[-1][0]._data,) for c in nc]
 
         def pick(last, key):
             return sample_logits(last, key, temperature=temperature,
                                  top_k=top_k, top_p=top_p)
 
         def run(pa, prompt, lens, key0):
-            caches = [(jnp.zeros((b, L, nh, hd), cdt),
-                       jnp.zeros((b, L, nh, hd), cdt), jnp.int32(0), lens)
-                      for _ in range(nl)]
+            caches = _make_static_caches(c8, nl, b, L, nh, hd, cdt,
+                                         lens=lens)
             pos0 = jnp.broadcast_to(jnp.arange(p_cap, dtype=jnp.int32)[None],
                                     (b, p_cap))
             logits, caches = model_step(pa, prompt, caches, pos0)
@@ -762,7 +852,7 @@ class GPTForCausalLM(Layer):
         sig = ("ragged", b, p_cap, int(max_new_tokens), L,
                float(temperature), int(top_k), float(top_p),
                None if eos_token_id is None else int(eos_token_id), str(cdt),
-               "q8" if q8 else "full")
+               "q8" if q8 else "full", "c8" if c8 else "cfull")
         import collections
         cache = getattr(self, "_gen_static_cache", None)
         if cache is None:

@@ -448,6 +448,95 @@ def test_generate_static_int8_weights(monkeypatch):
     assert any(q.dtype == np.int8 for q, _ in m._q8_decode_cache.values())
 
 
+def test_generate_static_int8_kv_cache():
+    """cache_dtype="int8" (VERDICT r4 #5 follow-on): the KV cache is stored
+    as int8 codes + per-(pos,head) scales — attention reads half the HBM
+    bytes per decode step. Greedy output must stay near-parity with the
+    bf16 cache on a toy model (the cache IS perturbed by quantization, so
+    exact parity is not the contract), and the factored-scale attention
+    math must match explicit dequantization."""
+    import numpy as np
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=96, hidden_size=128, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    intermediate_size=256)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(1).randint(1, 96, (2, 8)).astype(np.int64))
+    full = m.generate_static(ids, max_new_tokens=8).numpy()
+    c8 = m.generate_static(ids, max_new_tokens=8,
+                           cache_dtype="int8").numpy()
+    assert c8.shape == full.shape
+    assert (c8[:, :8] == full[:, :8]).all()          # prompt passthrough
+    agree = (c8[:, 8:] == full[:, 8:]).mean()
+    assert agree >= 0.5, f"int8-cache decode diverged: agreement {agree}"
+    # ragged variant composes with the int8 cache (one program, any len):
+    # full-length rows must stay on the non-ragged greedy trajectory
+    lens = [3, 8]
+    r_full = m.generate_static_ragged(ids, lens, max_new_tokens=6).numpy()
+    r_c8 = m.generate_static_ragged(ids, lens, max_new_tokens=6,
+                                    cache_dtype="int8").numpy()
+    assert r_c8.shape == r_full.shape
+    assert (r_c8[1] == r_full[1]).mean() >= 0.75
+    import pytest
+    with pytest.raises(ValueError):
+        m.generate_static(ids, max_new_tokens=2, cache_dtype="float64")
+
+
+def test_generate_static_int8_weights_and_kv_compose(monkeypatch):
+    """weight_dtype="int8" + cache_dtype="int8" together — the exact config
+    of the bench ladder's decode-int8-b8 row: int8 GEMM weight streaming
+    AND factored-scale int8 cache attention in one compiled program."""
+    import numpy as np
+    monkeypatch.setenv("PADDLE_TPU_Q8_DECODE_MIN", "4096")  # toy-size gate
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=96, hidden_size=128, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    intermediate_size=256)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(2).randint(1, 96, (2, 8)).astype(np.int64))
+    full = m.generate_static(ids, max_new_tokens=8).numpy()
+    both = m.generate_static(ids, max_new_tokens=8, weight_dtype="int8",
+                             cache_dtype="int8").numpy()
+    assert both.shape == full.shape
+    assert (both[:, :8] == full[:, :8]).all()
+    agree = (both[:, 8:] == full[:, 8:]).mean()
+    assert agree >= 0.5, f"w8+c8 decode diverged: agreement {agree}"
+    assert not np.isnan(both.astype(np.float64)).any()
+
+
+def test_attention_q8_cache_matches_dequant():
+    """attention_q8_cache's factored scales (q·cᵀ·s_k; (p·s_v)·c_v) must be
+    numerically equivalent to attending over explicitly dequantized K/V."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.ops.attention import (attention_q8_cache, quantize_kv,
+                                          dequantize_kv,
+                                          attention_reference,
+                                          static_cache_mask)
+    rng = np.random.RandomState(3)
+    B, L, H, D = 2, 16, 4, 32
+    k = jnp.asarray(rng.randn(B, L, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, L, H, D).astype(np.float32))
+    q = jnp.asarray(rng.randn(B, 1, H, D).astype(np.float32))
+    kc, ks = quantize_kv(k)
+    vc, vs = quantize_kv(v)
+    # roundtrip error bound: symmetric int8 over head_dim rows
+    kd = dequantize_kv(kc, ks, jnp.float32)
+    rel = float(jnp.max(jnp.abs(kd - k)) / jnp.max(jnp.abs(k)))
+    assert rel < 0.01, rel
+    pos = jnp.int32(L - 1)
+    mask = static_cache_mask(L, 1, pos)
+    got = attention_q8_cache(q, kc, ks, vc, vs, mask)
+    want = attention_reference(q, kd, dequantize_kv(vc, vs, jnp.float32),
+                               mask=mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-3)
+
+
 def test_fused_small_param_update_parity(monkeypatch):
     """The fused multi-tensor optimizer apply (TrainStep) must produce
     numerically identical params/moments to the per-param loop — it is the
