@@ -93,11 +93,16 @@ class FusedMultiHeadAttention(Layer):
         pre = self.normalize_before
         mask = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
         with_cache = cache is not None
-        if with_cache and len(cache) == 3:
-            # STATIC-cache decode — checked BEFORE any dropout key is
-            # drawn: this inference-shaped path applies no dropout, and
-            # consuming op_keys it never uses would silently advance the
-            # global RNG stream
+        if with_cache and len(cache) in (3, 5):
+            # STATIC-cache decode (shared preconditions for both forms) —
+            # checked BEFORE any dropout key is drawn: this inference-
+            # shaped path applies no dropout, and consuming op_keys it
+            # never uses would silently advance the global RNG stream.
+            # 3-tuple (k, v, pos): full-width buffers. 5-tuple
+            # (k_codes, k_scale, v_codes, v_scale, pos): INT8 CacheKV (the
+            # reference fused_multi_transformer cache-quant mode) — codes
+            # int8 [B, L_max, H, D], scales f32 [B, L_max, H], same
+            # factored-scale attention as GPTForCausalLM cache_dtype=int8.
             if attn_p or out_p:
                 raise NotImplementedError(
                     "static-cache decode is inference-only (no dropout): "
@@ -107,27 +112,53 @@ class FusedMultiHeadAttention(Layer):
                 raise NotImplementedError(
                     "static-cache decode builds its own position mask; "
                     "combine custom masks on the growing-cache path")
-            from ...ops.attention import (static_cache_update,
-                                          static_cache_mask)
-            k_buf, v_buf, pos = cache
-
-            def fn_static(x, qkv_w, qkv_b, lw, lb, pls, plb, lns, lnb,
-                          kb, vb, p):
-                residual, q, k, v = self._mha_head(x, qkv_w, qkv_b, pls, plb)
-                k2 = static_cache_update(kb, k, p)
-                v2 = static_cache_update(vb, v, p)
-                pmask = static_cache_mask(k2.shape[1], q.shape[1], p)
-                o = attention_reference(q, k2, v2, mask=pmask,
-                                        score_dtype=q.dtype)
-                o = self._mha_tail(o, residual, lw, lb, lns, lnb)
-                return o, k2, v2
-
+            q8 = len(cache) == 5
+            if q8:
+                # same fail-loud tag rule as models/gpt.py _is_q8_cache:
+                # length alone is not a safe dispatch key — the codes
+                # buffer's dtype is
+                c0 = cache[0]
+                cdt0 = c0._data.dtype if isinstance(c0, Tensor) else c0.dtype
+                if cdt0 != jnp.int8:
+                    raise ValueError(
+                        f"5-tuple static CacheKV must carry int8 codes "
+                        f"first (got {cdt0}); full-width caches are "
+                        f"(k, v, pos)")
             sargs = [query, self.qkv_weight, self.qkv_bias,
                      self.linear_weight, self.linear_bias,
                      self.pre_ln_scale, self.pre_ln_bias,
-                     self.ln_scale, self.ln_bias, k_buf, v_buf, pos]
-            o, k2, v2 = apply_op("fused_mha_static_cache", fn_static, sargs)
-            return o, (k2.detach(), v2.detach(), pos + query.shape[1])
+                     self.ln_scale, self.ln_bias] + list(cache)
+            from ...ops.attention import (static_cache_update,
+                                          static_cache_update_q8,
+                                          static_cache_mask,
+                                          attention_q8_cache)
+
+            def fn_static(x, qkv_w, qkv_b, lw, lb, pls, plb, lns, lnb,
+                          *cbufs):
+                residual, q, k, v = self._mha_head(x, qkv_w, qkv_b, pls, plb)
+                if q8:
+                    kcb, ksb, vcb, vsb, p = cbufs
+                    kc2, ks2 = static_cache_update_q8(kcb, ksb, k, p)
+                    vc2, vs2 = static_cache_update_q8(vcb, vsb, v, p)
+                    pmask = static_cache_mask(kc2.shape[1], q.shape[1], p)
+                    o = attention_q8_cache(q, kc2, ks2, vc2, vs2, pmask)
+                    new = (kc2, ks2, vc2, vs2)
+                else:
+                    kb, vb, p = cbufs
+                    k2 = static_cache_update(kb, k, p)
+                    v2 = static_cache_update(vb, v, p)
+                    pmask = static_cache_mask(k2.shape[1], q.shape[1], p)
+                    o = attention_reference(q, k2, v2, mask=pmask,
+                                            score_dtype=q.dtype)
+                    new = (k2, v2)
+                o = self._mha_tail(o, residual, lw, lb, lns, lnb)
+                return (o,) + new
+
+            name = "fused_mha_static_cache" + ("_q8" if q8 else "")
+            outs = apply_op(name, fn_static, sargs)
+            o, new = outs[0], outs[1:]
+            return o, tuple(t.detach() for t in new) + (
+                cache[-1] + query.shape[1],)
         # dropout keys ride through apply_op as inputs (op_key → symbolic
         # under static recording: fresh mask every Executor.run)
         has_ka, has_ko = bool(attn_p), bool(out_p)

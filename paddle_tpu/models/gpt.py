@@ -66,6 +66,13 @@ class GPTConfig:
     moe_gate: str = "gshard"
     moe_top_k: Optional[int] = None
     moe_aux_weight: float = 0.01
+    # expert-slot headroom over perfectly-balanced routing. 1.25 is the
+    # GShard-paper default; the padding slots COMPUTE but don't count as
+    # active FLOPs, so it is the largest routing-overhead term (measured
+    # decomposition in README's MoE row). 1.0 = tight capacity (more
+    # dropped tokens under imbalance — the aux loss keeps the drop rate
+    # low once routing converges).
+    moe_capacity_factor: float = 1.25
     tie_word_embeddings: bool = True
     param_dtype: str = "float32"
     # "ring" | "ulysses" | None — schedule used when the mesh has sp > 1
@@ -362,7 +369,8 @@ class GPTBlock(Layer):
             from ..incubate.distributed.models.moe import MoELayer
             self.mlp = MoELayer(config.hidden_size, config.intermediate_size,
                                 config.moe_num_experts, gate=config.moe_gate,
-                                top_k=config.moe_top_k)
+                                top_k=config.moe_top_k,
+                                capacity_factor=config.moe_capacity_factor)
             # expert FFNs follow the same init convention as the dense
             # path: Normal(initializer_range) in, depth-scaled residual out
             w_init = I.Normal(std=config.initializer_range)

@@ -176,6 +176,25 @@ def test_gpt_moe_blocks_train_and_aux_loss_flows():
     assert l < l0
 
 
+def test_gpt_moe_capacity_factor_plumbs():
+    """moe_capacity_factor reaches MoELayer and changes the expert-slot
+    capacity; cf=1.0 (tight slots) still trains with finite grads."""
+    from paddle_tpu.incubate.distributed.models.moe.moe_layer import \
+        _capacity
+    paddle.seed(0)
+    cfg = _tiny(moe_num_experts=4, moe_every_n_layers=2,
+                moe_capacity_factor=1.0)
+    m = GPTForCausalLM(cfg)
+    mlp = [b for b in m.gpt.h if b.is_moe][0].mlp
+    assert mlp.capacity_factor == 1.0
+    n_tok = 2 * 16
+    assert _capacity(n_tok, 4, 2, 1.0) < _capacity(n_tok, 4, 2, 1.25)
+    ids = paddle.to_tensor(np.random.randint(0, 128, (2, 16)).astype("int64"))
+    loss = m.loss(ids, ids, chunk_size=8)
+    loss.backward()
+    assert np.isfinite(mlp.w1.grad.numpy()).all()
+
+
 def test_gpt_moe_dryrun_on_ep_mesh():
     """Expert weights shard over the ep axis; the fused hybrid step
     compiles and runs on a dp x ep virtual mesh."""

@@ -253,6 +253,43 @@ class TestIncubateOptimizers:
         assert losses[-1] < losses[0]
 
 
+def test_multi_transformer_int8_static_cache():
+    """5-tuple int8 CacheKV (codes+scales, the reference fused_multi_
+    transformer cache-quant analog) tracks the bf16 static cache closely:
+    same decode trajectory with int8 quantization noise only."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+    paddle.seed(0)
+    d, nh, nl, B, L = 32, 2, 2, 2, 12
+    hd = d // nh
+    m = FusedMultiTransformer(d, nh, dim_feedforward=64, num_layers=nl,
+                              dropout_rate=0.0)
+    m.eval()
+    rng = np.random.RandomState(0)
+    steps = [paddle.to_tensor(rng.randn(B, 1, d).astype("float32"))
+             for _ in range(4)]
+
+    s_caches = [(paddle.zeros([B, L, nh, hd]),
+                 paddle.zeros([B, L, nh, hd]),
+                 paddle.to_tensor(np.int32(0))) for _ in range(nl)]
+    q_caches = [(paddle.zeros([B, L, nh, hd], dtype="int8"),
+                 paddle.zeros([B, L, nh]),
+                 paddle.zeros([B, L, nh, hd], dtype="int8"),
+                 paddle.zeros([B, L, nh]),
+                 paddle.to_tensor(np.int32(0))) for _ in range(nl)]
+    for i, x in enumerate(steps):
+        o_s, s_caches = m(x, caches=s_caches)
+        o_q, q_caches = m(x, caches=q_caches)
+        ref = o_s.numpy()
+        tol = 0.05 * np.abs(ref).max() + 1e-3
+        np.testing.assert_allclose(o_q.numpy(), ref, atol=tol,
+                                   err_msg=f"step {i}")
+    assert int(q_caches[0][4].numpy()) == len(steps)
+    assert q_caches[0][0].numpy().dtype == np.int8
+
+
 def test_multi_transformer_static_cache_matches_growing():
     """FusedMultiTransformer 3-tuple static cache == 2-tuple growing cache
     over an incremental decode (the fused_multi_transformer CacheKV
