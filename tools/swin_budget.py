@@ -1,0 +1,91 @@
+"""Swin-T B=32 step decomposition (real step deltas, vit_budget.py style).
+
+Swin-T at B=32 measures ~11% MFU — far under the dense-model rows. This
+pins WHERE the 39 ms step goes with two ablations run against the full
+step in the same session:
+
+  1. attention ablated (values-passthrough in WindowAttention, both the
+     fused-bias kernel path and the XLA fallback) — isolates the window
+     S=49 attention math + its kernel;
+  2. window/roll plumbing ablated on top (identity _windows/_unwindows
+     with the same [B*nW, N, C] output shape via reshape only) — isolates
+     the partition/merge/roll layout traffic.
+
+What remains after both is patch-embed + MLPs + LN + head + optimizer.
+
+PYTHONPATH=/root/repo python tools/swin_budget.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from tools.step_budget import timed  # noqa: E402
+
+
+def build(B, ablate_attn=False, ablate_windows=False):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.vision.models import swin_t
+    from paddle_tpu.vision.models import swin as SW
+
+    orig_fwd = SW.WindowAttention.forward
+    orig_win = SW.SwinBlock._windows
+    orig_unwin = SW.SwinBlock._unwindows
+    if ablate_attn:
+        def stub_fwd(self, xw, mask, n_windows=0):
+            # values passthrough: keeps qkv/proj matmuls, drops the
+            # S=49 attention math + kernel
+            qkv = self.qkv(xw)
+            f3 = qkv.shape[-1]
+            return self.proj(qkv[:, :, 2 * f3 // 3:])
+        SW.WindowAttention.forward = stub_fwd
+    if ablate_windows:
+        def stub_win(self, x):
+            from paddle_tpu.core import ops
+            # same output shape, no roll / 6-D transpose: plain reshape
+            return ops.reshape(x, [-1, self.ws * self.ws, x.shape[-1]])
+
+        def stub_unwin(self, xw, b):
+            from paddle_tpu.core import ops
+            return ops.reshape(xw, [b, self.H * self.W, xw.shape[-1]])
+        SW.SwinBlock._windows = stub_win
+        SW.SwinBlock._unwindows = stub_unwin
+
+    try:
+        paddle.seed(0)
+        model = swin_t(num_classes=1000)
+        model.to(dtype="bfloat16")
+        ce = nn.CrossEntropyLoss()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     moment_dtype="bfloat16")
+        step = TrainStep(model, opt, lambda x, y: ce(model(x), y))
+        iters = 8
+        x = paddle.to_tensor(np.random.randn(iters, B, 3, 224, 224)
+                             .astype("bfloat16"))
+        y = paddle.to_tensor(np.random.randint(0, 1000, (iters, B))
+                             .astype("int64"))
+        ms = timed(step, iters, x, y)
+    finally:
+        SW.WindowAttention.forward = orig_fwd
+        SW.SwinBlock._windows = orig_win
+        SW.SwinBlock._unwindows = orig_unwin
+    return ms
+
+
+def main():
+    B = int(os.environ.get("PADDLE_TPU_BENCH_B", "32"))
+    full = build(B)
+    noat = build(B, ablate_attn=True)
+    nowin = build(B, ablate_attn=True, ablate_windows=True)
+    print(f"B={B}: full {full:7.2f} ms")
+    print(f"  attention term          {full - noat:6.2f} ms")
+    print(f"  window/roll layout term {noat - nowin:6.2f} ms")
+    print(f"  residual (embed+MLP+LN+head+optimizer) {nowin:6.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
