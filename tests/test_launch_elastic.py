@@ -380,10 +380,14 @@ RPC_WORKER = textwrap.dedent("""
     world = int(os.environ["PADDLE_TRAINERS_NUM"])
     assert os.environ["PADDLE_MASTER"]
     ep_port = int(os.environ["PADDLE_WORKER_ENDPOINT"].rsplit(":", 1)[1])
-    me = rpc.init_rpc(f"worker{rank}")
-    assert me.port == ep_port, (me.port, ep_port)  # endpoint contract honored
+    # define BEFORE init_rpc: calls resolve functions by __main__ reference
+    # on the receiving side, and a peer may dispatch the moment init_rpc
+    # registers us — defining add afterwards is a race under load (seen
+    # once with a heavily loaded host CPU)
     def add(a, b):
         return a + b
+    me = rpc.init_rpc(f"worker{rank}")
+    assert me.port == ep_port, (me.port, ep_port)  # endpoint contract honored
     if rank == 0:
         got = rpc.rpc_sync("worker1", add, args=(20, 22))
         assert got == 42, got
