@@ -605,6 +605,9 @@ def _ladder(on_tpu):
         ("vit-h14", lambda: bench_vit(on_tpu, preset="vit-h14"), 150),
         # swin-t: window-batched fused-bias attention (r5; 655->829 img/s)
         ("swin-t", lambda: bench_swin(on_tpu), 150),
+        # long-context point (SURVEY §5.7): flash attention keeps S=4096
+        # MXU-bound — driver-captures the long-context claim (r5: 73.4%)
+        ("gpt-s4096", lambda: bench_gpt(on_tpu, B=2, S=4096), 180),
         # 2.7B last: longest compile; config = best measured r3 point
         ("gpt-2.7b", lambda: _bench_gpt27(on_tpu), 420),
     ]
