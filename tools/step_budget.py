@@ -17,6 +17,8 @@ Variants:
   no_dropout  — dropout probabilities zeroed (bert only)
   no_attn     — attention context replaced by the value projection input
                 (keeps every matmul EXCEPT the S^2 attention math)
+  no_ln       — LayerNorm replaced by identity (gpt only; measures the
+                mean/var reductions + normalize fwd+bwd)
   sgd_opt     — optimizer swapped for bare SGD (isolates AdamW moments)
 """
 import os
@@ -95,14 +97,20 @@ def gpt_budget():
     ids = paddle.to_tensor(rng.randint(0, 50304,
                                        (iters, B, S)).astype("int32"))
 
-    def build(loss_kind="full"):
+    def build(loss_kind="full", optimizer="adamw"):
         cfg = gpt_config("gpt3-1.3b", max_position_embeddings=2048)
         paddle.seed(0)
         m = GPTForCausalLM(cfg)
         m.to(dtype="bfloat16")
-        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                     parameters=m.parameters(),
-                                     moment_dtype="bfloat16")
+        if optimizer == "sgd":
+            # bare SGD: p -= lr*g reads p+g, writes p — the delta vs
+            # AdamW is the measured moment-state traffic + moment math
+            opt = paddle.optimizer.SGD(learning_rate=1e-4,
+                                       parameters=m.parameters())
+        else:
+            opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                         parameters=m.parameters(),
+                                         moment_dtype="bfloat16")
         if loss_kind == "full":
             fn = lambda a, b: m.loss(a, b, chunk_size=512)  # noqa: E731
         else:
@@ -111,9 +119,23 @@ def gpt_budget():
                 return (h.astype("float32") ** 2).mean()
         return TrainStep(m, opt, fn)
 
+    def timed_no_ln():
+        # LayerNorm -> identity for the WHOLE build+run: measures the
+        # LN mean/var reductions + normalize fwd+bwd as a real step delta
+        # (residual adds and every matmul stay)
+        from paddle_tpu.nn.layers.norm import LayerNorm
+        orig = LayerNorm.forward
+        LayerNorm.forward = lambda self, x: x
+        try:
+            return timed(build(), iters, ids, ids)
+        finally:
+            LayerNorm.forward = orig
+
     rows = {}
     rows["full"] = timed(build(), iters, ids, ids)
     rows["no_ce"] = timed(build("no_ce"), iters, ids, ids)
+    rows["no_ln"] = timed_no_ln()
+    rows["sgd_opt"] = timed(build(optimizer="sgd"), iters, ids, ids)
     print("\ngpt3-1.3b B=3 S=2048 (ms/step):")
     for k, v in rows.items():
         print(f"  {k:12s} {v:8.2f}")
@@ -124,6 +146,8 @@ def gpt_budget():
     print(f"  head+CE term      {ce:8.2f}")
     print(f"  head matmul floor {flops / 197e12 * 1e3:8.2f} (at peak), "
           f"{flops / (0.9 * 197e12) * 1e3:8.2f} (at 90%)")
+    print(f"  LayerNorm term    {rows['full'] - rows['no_ln']:8.2f}")
+    print(f"  AdamW-vs-SGD term {rows['full'] - rows['sgd_opt']:8.2f}")
 
 
 if __name__ == "__main__":
