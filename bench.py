@@ -382,7 +382,7 @@ def bench_moe(on_tpu, cf=None):
     })
 
 
-def bench_decode(on_tpu, B=None, w8=None, c8=None):
+def bench_decode(on_tpu, B=None, w8=None, c8=None, marginal=False):
     """Autoregressive decode throughput via generate_static (ONE compiled
     program: prefill + lax.scan of fixed-shape KV-cache steps)."""
     import numpy as np
@@ -432,6 +432,32 @@ def bench_decode(on_tpu, B=None, w8=None, c8=None):
         _ = out.numpy()
         dt = min(dt, time.perf_counter() - t0)
     tps = B * new / dt
+    extra = {"ms_per_step": round(dt / new * 1e3, 3),
+             "ms_per_token": round(dt / (new * B) * 1e3, 3),
+             "total_s": round(dt, 2)}
+    if marginal:
+        # whole-launch tok/s folds a fixed per-launch cost (prefill +
+        # relay dispatch + host read, measured 20-56 ms varying with
+        # relay state across a day) over only `new` steps. A second
+        # launch at 2x steps separates it: the marginal rate is the
+        # steady-state decode throughput a serving loop actually sees.
+        out = model.generate_static(ids, max_new_tokens=2 * new, **kw)
+        _ = out.numpy()
+        dt2 = float("inf")
+        for _rep in range(3):
+            t0 = time.perf_counter()
+            out = model.generate_static(ids, max_new_tokens=2 * new, **kw)
+            _ = out.numpy()
+            dt2 = min(dt2, time.perf_counter() - t0)
+        marg = dt2 - dt
+        # same-state launches measure tight (<4% over 12 reps), but guard
+        # the subtraction anyway: a jitter hit on every 2x rep could push
+        # marg past dt and the fixed cost negative — report only sane
+        # separations, never a nonsensical negative fixed cost
+        if 0 < marg <= dt:
+            extra["marginal_tok_s"] = round(B * new / marg, 1)
+            extra["marginal_ms_per_step"] = round(marg / new * 1e3, 3)
+            extra["fixed_launch_ms"] = round((dt - marg) * 1e3, 1)
     return _emit({
         "metric": f"decode tokens/sec/chip ({preset} generate_static"
                   f"{' int8-weights' if wdt else ''}"
@@ -439,9 +465,7 @@ def bench_decode(on_tpu, B=None, w8=None, c8=None):
                   f"B={B} prefill={p_len} new={new})",
         "value": round(tps, 1), "unit": "tokens/s",
         "vs_baseline": None,
-        "extra": {"ms_per_step": round(dt / new * 1e3, 3),
-                  "ms_per_token": round(dt / (new * B) * 1e3, 3),
-                  "total_s": round(dt, 2)},
+        "extra": extra,
     })
 
 
@@ -596,7 +620,8 @@ def _ladder(on_tpu):
         # int8 weights + int8 KV cache: B=8 3.46 -> 3.00 ms/step (the KV
         # read is the residual bandwidth term once weights are int8)
         ("decode-int8-b8", lambda: bench_decode(on_tpu, B=8, w8=True,
-                                                c8=True), 120),
+                                                c8=True, marginal=True),
+         220),
         ("decode-b32", lambda: bench_decode(on_tpu, B=32, w8=False), 120),
         ("moe", lambda: bench_moe(on_tpu), 240),
         ("resnet50", lambda: bench_resnet50(on_tpu), 150),
@@ -639,7 +664,13 @@ def _ladder(on_tpu):
              "value": r["value"], "unit": r["unit"],
              "vs_baseline": r["vs_baseline"],
              "mfu": r["extra"].get("mfu"),
-             "step_ms": r["extra"].get("step_ms")}
+             "step_ms": r["extra"].get("step_ms"),
+             # decode rows: steady-state rate + fixed launch cost (the
+             # driver parses only this last line — keep the serving
+             # metric visible in it)
+             **({"marginal_tok_s": r["extra"]["marginal_tok_s"],
+                 "fixed_launch_ms": r["extra"]["fixed_launch_ms"]}
+                if "marginal_tok_s" in r["extra"] else {})}
             for r in rows]
         final["extra"]["ladder_wall_s"] = round(time.perf_counter() - t0, 1)
         _emit(final)
