@@ -628,28 +628,13 @@ class GPTForCausalLM(Layer):
         q8 = weight_dtype == "int8"
         c8 = _validate_cache_dtype(cache_dtype, cdt)
         qmap = self._decode_quantized_params() if q8 else {}
-
-        def expand(pa):
-            """Mixed payload -> (full param list, q8 payload list); int8
-            entries dequantize AT USE behind an optimization barrier so XLA
-            cannot hoist the bf16 reconstruction out of the decode loop.
-            The barrier'd (codes, scale) pairs ALSO ride along so matmul
-            consumers can stream int8 bytes directly through the Pallas
-            dequant-in-register kernel (_q8_bind) — when every consumer of
-            a weight takes that route, the dequantized copy is dead code
-            and XLA drops it entirely."""
-            if not q8:
-                return list(pa), [None] * len(pa)
-            out, pays = [], []
-            for v in pa:
-                if isinstance(v, tuple):
-                    qv, sv = lax.optimization_barrier(v)
-                    out.append((qv.astype(jnp.float32) * sv).astype(cdt))
-                    pays.append((qv, sv))
-                else:
-                    out.append(v)
-                    pays.append(None)
-            return out, pays
+        # mixed payload -> (full param list, q8 payload list); int8 entries
+        # dequantize AT USE behind an optimization barrier so XLA cannot
+        # hoist the bf16 reconstruction out of the decode loop, and the
+        # barrier'd (codes, scale) pairs ride along for the int8-GEMM
+        # consumer hooks (_q8_bind) — when every consumer streams int8 the
+        # dequantized copy is dead code and XLA drops it
+        expand = self._make_expand(q8, cdt)
 
         def model_step(pa, tokens, caches):
             ex, pays = expand(pa)
@@ -700,32 +685,196 @@ class GPTForCausalLM(Layer):
         # param dtype is part of the key: the cached closure bakes cdt
         # into its KV-buffer allocation, so a model.to(dtype=...) after
         # the first call must miss the cache, not reuse stale buffers.
+        # LRU-capped compiled-runner cache: a serving loop over ragged
+        # prompt lengths would otherwise accumulate compilations without
+        # bound (advisor r3). Callers that want ONE executable for all
+        # prompt lengths should pass max_len=L (fixed) — prefill is
+        # kv_len-masked to p_len, so any prompt <= L reuses the program.
         sig = (b, p_len, int(max_new_tokens), L, float(temperature),
                int(top_k), float(top_p),
                None if eos_token_id is None else int(eos_token_id), str(cdt),
                "q8" if q8 else "full", "c8" if c8 else "cfull")
-        # LRU-capped: each distinct signature retains a compiled XLA
-        # executable; a serving loop over ragged prompt lengths would
-        # otherwise accumulate compilations without bound (advisor r3).
-        # Callers that want ONE executable for all prompt lengths should
-        # pass max_len=L (fixed) — prefill is kv_len-masked to p_len, so
-        # any prompt <= L reuses the same program.
+        fn = self._gen_cache_get(sig, lambda: jax.jit(run))
+        payload = tuple(qmap[i] if i in qmap else p._data
+                        for i, p in enumerate(params)) if q8 else \
+            tuple(p._data for p in params)
+        out = fn(payload, ids._data, jax.random.PRNGKey(seed))
+        return Tensor(out)
+
+    # ----------------------------------------------- prefix-reuse serving
+    def prefill_static(self, input_ids, max_len: int,
+                       weight_dtype: str = None, cache_dtype: str = None):
+        """Run the prompt ONCE and return a reusable prefill state.
+
+        Serving loops that share a prompt prefix (a system prompt, a
+        few-shot template, best-of-N sampling over one prompt) pay the
+        prefill forward a single time; every `decode_static` call then
+        continues from the returned state without recomputing it. The
+        reference serves the same pattern by retaining the CacheKV
+        workspace between fused_multi_transformer launches
+        (operators/fused/fused_multi_transformer_op.cu).
+
+        Returns an opaque state dict. The state is immutable — each
+        decode_static writes into its own copy of the cache buffers (XLA
+        copy-on-write), so one prefill fans out to any number of
+        continuations."""
+        import jax
+        from ..jit.api import _swap_params, _trace_guard
+        from ..core import autograd
+
+        cfg = self.config
+        ids = input_ids if isinstance(input_ids, Tensor) else Tensor(input_ids)
+        b, p_len = ids.shape
+        if max_len <= p_len:
+            raise ValueError(f"max_len ({max_len}) must exceed the prompt "
+                             f"length ({p_len}) to leave room for decode")
+        params = list(self.parameters())
+        cdt = self.gpt.wte.weight._data.dtype
+        nh, hd, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
+        q8 = weight_dtype == "int8"
+        c8 = _validate_cache_dtype(cache_dtype, cdt)
+        qmap = self._decode_quantized_params() if q8 else {}
+        expand = self._make_expand(q8, cdt)
+
+        def run(pa, prompt):
+            caches = _make_static_caches(c8, nl, b, max_len, nh, hd, cdt)
+            ex, pays = expand(pa)
+            with _trace_guard(), _swap_params(params, ex), \
+                    _q8_bind(params, pays), autograd.no_grad():
+                logits, nc = self.forward(
+                    Tensor(prompt),
+                    caches=[tuple(Tensor(e) for e in c) for c in caches])
+            return ([tuple(e._data for e in c) for c in nc],
+                    logits._data[:, -1].astype(jnp.float32))
+
+        sig = ("prefill", b, p_len, int(max_len), str(cdt),
+               "q8" if q8 else "full", "c8" if c8 else "cfull")
+        fn = self._gen_cache_get(sig, lambda: jax.jit(run))
+        payload = tuple(qmap[i] if i in qmap else p._data
+                        for i, p in enumerate(params)) if q8 else \
+            tuple(p._data for p in params)
+        caches, last_logits = fn(payload, ids._data)
+        # cdt is captured at PREFILL time: a model.to(dtype=...) between
+        # prefill and decode must not mix the state's arrays with a new
+        # live dtype (decode_static validates against this)
+        return {"caches": caches, "last_logits": last_logits,
+                "prompt": ids._data, "max_len": int(max_len),
+                "q8": q8, "c8": c8, "payload": payload, "cdt": str(cdt)}
+
+    def decode_static(self, state, max_new_tokens: int,
+                      temperature: float = 0.0, top_k: int = 0,
+                      top_p: float = 1.0, seed: int = 0,
+                      eos_token_id: int = None):
+        """Continue from a `prefill_static` state: ONE compiled lax.scan of
+        fixed-shape decode steps. Repeated calls (different seeds /
+        sampling configs) reuse the SAME prefill — greedy output equals
+        the tail of `generate_static` on the same prompt."""
+        import jax
+        from jax import lax
+        from ..jit.api import _swap_params, _trace_guard
+        from ..core import autograd
+
+        b, p_len = state["prompt"].shape
+        L = state["max_len"]
+        if max_new_tokens <= 0:
+            raise ValueError("decode_static needs max_new_tokens >= 1 "
+                             "(the state already holds the prompt)")
+        if p_len + max_new_tokens > L:
+            raise ValueError(
+                f"decode_static: prompt ({p_len}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the prefill state's max_len "
+                f"({L})")
+        params = list(self.parameters())
+        cdt = self.gpt.wte.weight._data.dtype
+        if str(cdt) != state["cdt"]:
+            raise ValueError(
+                f"decode_static: the model's dtype changed since prefill "
+                f"({state['cdt']} -> {cdt}); re-run prefill_static")
+        q8 = state["q8"]
+        expand = self._make_expand(q8, cdt)
+
+        def model_step(pa, tokens, caches):
+            ex, pays = expand(pa)
+            with _trace_guard(), _swap_params(params, ex), \
+                    _q8_bind(params, pays), autograd.no_grad():
+                logits, nc = self.forward(
+                    Tensor(tokens),
+                    caches=[tuple(Tensor(e) for e in c) for c in caches])
+            return logits._data, [tuple(e._data for e in c) for c in nc]
+
+        def pick(last, key):
+            return sample_logits(last, key, temperature=temperature,
+                                 top_k=top_k, top_p=top_p)
+
+        def run(pa, caches, last_logits, key0):
+            key0, k1 = jax.random.split(key0)
+            nxt = pick(last_logits, k1)
+            done = (jnp.zeros((b,), bool) if eos_token_id is None
+                    else nxt == eos_token_id)
+
+            def body(carry, _):
+                caches, cur, key, done = carry
+                logits, caches = model_step(pa, cur[:, None], caches)
+                key, kk = jax.random.split(key)
+                new = pick(logits[:, -1].astype(jnp.float32), kk)
+                if eos_token_id is not None:
+                    new = jnp.where(done, jnp.asarray(eos_token_id,
+                                                      new.dtype), new)
+                    done = done | (new == eos_token_id)
+                return (caches, new, key, done), new
+
+            (_, _, _, _), toks = lax.scan(body, (caches, nxt, key0, done),
+                                          None, length=max_new_tokens - 1)
+            return jnp.concatenate([nxt[:, None],
+                                    jnp.moveaxis(toks, 0, 1)],
+                                   axis=1).astype(jnp.int64)
+
+        sig = ("decode", b, p_len, L, int(max_new_tokens),
+               float(temperature), int(top_k), float(top_p),
+               None if eos_token_id is None else int(eos_token_id),
+               str(cdt), "q8" if q8 else "full",
+               "c8" if state["c8"] else "cfull")
+        fn = self._gen_cache_get(sig, lambda: jax.jit(run))
+        toks = fn(state["payload"], state["caches"], state["last_logits"],
+                  jax.random.PRNGKey(seed))
+        return Tensor(toks)
+
+    def _make_expand(self, q8, cdt):
+        """The shared mixed-payload expander (full arrays pass through;
+        barrier'd int8 (codes, scale) pairs dequantize at use AND ride
+        along for the int8-GEMM consumer hooks)."""
+        from jax import lax
+
+        def expand(pa):
+            if not q8:
+                return list(pa), [None] * len(pa)
+            out, pays = [], []
+            for v in pa:
+                if isinstance(v, tuple):
+                    qv, sv = lax.optimization_barrier(v)
+                    out.append((qv.astype(jnp.float32) * sv).astype(cdt))
+                    pays.append((qv, sv))
+                else:
+                    out.append(v)
+                    pays.append(None)
+            return out, pays
+        return expand
+
+    def _gen_cache_get(self, sig, build):
+        """LRU-capped compiled-runner cache shared by every static-serving
+        entry point (generate_static/_ragged, prefill/decode_static)."""
         import collections
         cache = getattr(self, "_gen_static_cache", None)
         if cache is None:
             cache = self._gen_static_cache = collections.OrderedDict()
         fn = cache.get(sig)
         if fn is None:
-            fn = cache[sig] = jax.jit(run)
+            fn = cache[sig] = build()
             while len(cache) > 16:
                 cache.popitem(last=False)
         else:
             cache.move_to_end(sig)
-        payload = tuple(qmap[i] if i in qmap else p._data
-                        for i, p in enumerate(params)) if q8 else \
-            tuple(p._data for p in params)
-        out = fn(payload, ids._data, jax.random.PRNGKey(seed))
-        return Tensor(out)
+        return fn
 
     def generate_static_ragged(self, input_ids, prompt_lens,
                                max_new_tokens: int = 16,
@@ -784,24 +933,8 @@ class GPTForCausalLM(Layer):
         q8 = weight_dtype == "int8"
         c8 = _validate_cache_dtype(cache_dtype, cdt)
         qmap = self._decode_quantized_params() if q8 else {}
-
-        def expand(pa):
-            # same weight-only int8 contract as generate_static: dequant
-            # AT USE behind an optimization barrier (no full-width hoist);
-            # barrier'd (codes, scale) pairs ride along for the int8-matmul
-            # consumer hooks (_q8_bind)
-            if not q8:
-                return list(pa), [None] * len(pa)
-            out, pays = [], []
-            for v in pa:
-                if isinstance(v, tuple):
-                    qv, sv = lax.optimization_barrier(v)
-                    out.append((qv.astype(jnp.float32) * sv).astype(cdt))
-                    pays.append((qv, sv))
-                else:
-                    out.append(v)
-                    pays.append(None)
-            return out, pays
+        # same weight-only int8 contract as generate_static (_make_expand)
+        expand = self._make_expand(q8, cdt)
 
         def model_step(pa, tokens, caches, pos_ids):
             ex, pays = expand(pa)
@@ -861,17 +994,7 @@ class GPTForCausalLM(Layer):
                float(temperature), int(top_k), float(top_p),
                None if eos_token_id is None else int(eos_token_id), str(cdt),
                "q8" if q8 else "full", "c8" if c8 else "cfull")
-        import collections
-        cache = getattr(self, "_gen_static_cache", None)
-        if cache is None:
-            cache = self._gen_static_cache = collections.OrderedDict()
-        fn = cache.get(sig)
-        if fn is None:
-            fn = cache[sig] = jax.jit(run)
-            while len(cache) > 16:
-                cache.popitem(last=False)
-        else:
-            cache.move_to_end(sig)
+        fn = self._gen_cache_get(sig, lambda: jax.jit(run))
         payload = tuple(qmap[i] if i in qmap else p._data
                         for i, p in enumerate(params)) if q8 else \
             tuple(p._data for p in params)

@@ -527,6 +527,48 @@ def test_generate_static_int8_weights_and_kv_compose(monkeypatch):
     assert not np.isnan(both.astype(np.float64)).any()
 
 
+def test_prefill_decode_static_prefix_reuse():
+    """prefill_static/decode_static (r5 prefix-reuse serving): one prompt
+    forward fans out to many continuations — greedy decode equals
+    generate_static's tail, repeated decodes from one state are identical
+    (the state is immutable), different sampling seeds diverge, int8
+    weights+cache compose, and capacity overflow raises."""
+    import numpy as np
+    import pytest
+    paddle.seed(3)
+    cfg = GPTConfig(vocab_size=96, hidden_size=128, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    intermediate_size=256)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(4).randint(1, 96, (2, 8)).astype(np.int64))
+    full = m.generate_static(ids, max_new_tokens=8).numpy()
+    st = m.prefill_static(ids, max_len=16)
+    d1 = m.decode_static(st, max_new_tokens=8).numpy()
+    assert (d1 == full[:, 8:]).all()
+    d2 = m.decode_static(st, max_new_tokens=8).numpy()
+    assert (d1 == d2).all()
+    s1 = m.decode_static(st, max_new_tokens=8, temperature=0.9,
+                         seed=1).numpy()
+    s2 = m.decode_static(st, max_new_tokens=8, temperature=0.9,
+                         seed=2).numpy()
+    assert not (s1 == s2).all()
+    # eos handling inside the reused-state decode
+    eos = int(d1[0, 0])
+    de = m.decode_static(st, max_new_tokens=8, eos_token_id=eos).numpy()
+    assert (de[0] == eos).all()          # row 0 hits eos immediately
+    with pytest.raises(ValueError):
+        m.decode_static(st, max_new_tokens=64)     # 8 + 64 > max_len 16
+    with pytest.raises(ValueError):
+        m.prefill_static(ids, max_len=8)           # no decode room
+    # int8 cache composes with the prefix-reuse path
+    st8 = m.prefill_static(ids, max_len=16, cache_dtype="int8")
+    d8 = m.decode_static(st8, max_new_tokens=8).numpy()
+    assert d8.shape == d1.shape
+    assert (d8 == full[:, 8:]).mean() >= 0.5
+
+
 def test_attention_q8_cache_matches_dequant():
     """attention_q8_cache's factored scales (q·cᵀ·s_k; (p·s_v)·c_v) must be
     numerically equivalent to attending over explicitly dequantized K/V."""
