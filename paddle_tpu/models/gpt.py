@@ -488,6 +488,40 @@ def _validate_cache_dtype(cache_dtype, cdt):
     return False
 
 
+def _coerce_prompt_lens(prompt_lens, cap, name):
+    """Shared ragged-serving lens handling: coerce to an int32 device
+    array and validate 1 <= len <= cap on the HOST (lens are concrete at
+    call time; len 0 would index the padded tail and mask every real
+    column, len > cap would un-mask garbage cache rows)."""
+    import numpy as _np
+    lens_arr = jnp.asarray(
+        prompt_lens._data if isinstance(prompt_lens, Tensor)
+        else _np.asarray(prompt_lens), jnp.int32)
+    host = _np.asarray(lens_arr)
+    if host.size and (int(host.min()) < 1 or int(host.max()) > cap):
+        raise ValueError(
+            f"{name}: prompt_lens must satisfy 1 <= len <= P_cap ({cap}); "
+            f"got range [{int(host.min())}, {int(host.max())}]")
+    return lens_arr
+
+
+def _wrap_ragged_caches(caches, cap):
+    """Flat carry tuples (ending in the raw lens vector) -> the forward's
+    cache format, whose ragged marker is the nested (lens, cap) LAST
+    element. The single definition keeps the three serving entry points
+    (generate_static_ragged, prefill_static, decode_static) from drifting
+    on this pytree convention."""
+    return [tuple(Tensor(e) for e in c[:-1]) + ((Tensor(c[-1]), cap),)
+            for c in caches]
+
+
+def _unwrap_ragged_caches(new_caches):
+    """Inverse of _wrap_ragged_caches for the updated caches the forward
+    returns: flatten the nested (lens, cap) back to a trailing lens."""
+    return [tuple(e._data for e in c[:-1]) + (c[-1][0]._data,)
+            for c in new_caches]
+
+
 def _make_static_caches(c8, nl, b, L, nh, hd, cdt, lens=None):
     """Per-layer static KV-cache carries for the compiled decode loop.
 
@@ -703,7 +737,8 @@ class GPTForCausalLM(Layer):
 
     # ----------------------------------------------- prefix-reuse serving
     def prefill_static(self, input_ids, max_len: int,
-                       weight_dtype: str = None, cache_dtype: str = None):
+                       weight_dtype: str = None, cache_dtype: str = None,
+                       prompt_lens=None):
         """Run the prompt ONCE and return a reusable prefill state.
 
         Serving loops that share a prompt prefix (a system prompt, a
@@ -717,7 +752,13 @@ class GPTForCausalLM(Layer):
         Returns an opaque state dict. The state is immutable — each
         decode_static writes into its own copy of the cache buffers (XLA
         copy-on-write), so one prefill fans out to any number of
-        continuations."""
+        continuations.
+
+        prompt_lens (optional, [B] host ints): RAGGED prompts right-padded
+        to input_ids' width — rows in [len, width) hold garbage k/v that
+        the per-row cache masks exclude, and each row's continuation
+        starts at its TRUE length (same contract as
+        generate_static_ragged)."""
         import jax
         from ..jit.api import _swap_params, _trace_guard
         from ..core import autograd
@@ -736,30 +777,50 @@ class GPTForCausalLM(Layer):
         qmap = self._decode_quantized_params() if q8 else {}
         expand = self._make_expand(q8, cdt)
 
-        def run(pa, prompt):
-            caches = _make_static_caches(c8, nl, b, max_len, nh, hd, cdt)
+        lens_arr = None
+        if prompt_lens is not None:
+            lens_arr = _coerce_prompt_lens(prompt_lens, p_len,
+                                           "prefill_static")
+
+        def run(pa, prompt, lens):
+            caches = _make_static_caches(c8, nl, b, max_len, nh, hd, cdt,
+                                         lens=lens)
             ex, pays = expand(pa)
             with _trace_guard(), _swap_params(params, ex), \
                     _q8_bind(params, pays), autograd.no_grad():
-                logits, nc = self.forward(
-                    Tensor(prompt),
-                    caches=[tuple(Tensor(e) for e in c) for c in caches])
-            return ([tuple(e._data for e in c) for c in nc],
-                    logits._data[:, -1].astype(jnp.float32))
+                if lens is None:
+                    logits, nc = self.forward(
+                        Tensor(prompt),
+                        caches=[tuple(Tensor(e) for e in c)
+                                for c in caches])
+                    nc_out = [tuple(e._data for e in c) for c in nc]
+                    last = logits._data[:, -1].astype(jnp.float32)
+                else:
+                    pos0 = jnp.broadcast_to(
+                        jnp.arange(p_len, dtype=jnp.int32)[None], (b, p_len))
+                    logits, nc = self.forward(
+                        Tensor(prompt), position_ids=Tensor(pos0),
+                        caches=_wrap_ragged_caches(caches, p_len))
+                    nc_out = _unwrap_ragged_caches(nc)
+                    last = logits._data[jnp.arange(b),
+                                        lens - 1].astype(jnp.float32)
+            return nc_out, last
 
         sig = ("prefill", b, p_len, int(max_len), str(cdt),
-               "q8" if q8 else "full", "c8" if c8 else "cfull")
+               "q8" if q8 else "full", "c8" if c8 else "cfull",
+               "ragged" if lens_arr is not None else "fixed")
         fn = self._gen_cache_get(sig, lambda: jax.jit(run))
         payload = tuple(qmap[i] if i in qmap else p._data
                         for i, p in enumerate(params)) if q8 else \
             tuple(p._data for p in params)
-        caches, last_logits = fn(payload, ids._data)
+        caches, last_logits = fn(payload, ids._data, lens_arr)
         # cdt is captured at PREFILL time: a model.to(dtype=...) between
         # prefill and decode must not mix the state's arrays with a new
         # live dtype (decode_static validates against this)
         return {"caches": caches, "last_logits": last_logits,
                 "prompt": ids._data, "max_len": int(max_len),
-                "q8": q8, "c8": c8, "payload": payload, "cdt": str(cdt)}
+                "q8": q8, "c8": c8, "payload": payload, "cdt": str(cdt),
+                "lens": lens_arr}
 
     def decode_static(self, state, max_new_tokens: int,
                       temperature: float = 0.0, top_k: int = 0,
@@ -791,30 +852,41 @@ class GPTForCausalLM(Layer):
                 f"decode_static: the model's dtype changed since prefill "
                 f"({state['cdt']} -> {cdt}); re-run prefill_static")
         q8 = state["q8"]
+        ragged = state.get("lens") is not None
         expand = self._make_expand(q8, cdt)
 
-        def model_step(pa, tokens, caches):
+        def model_step(pa, tokens, caches, pos_ids=None):
             ex, pays = expand(pa)
             with _trace_guard(), _swap_params(params, ex), \
                     _q8_bind(params, pays), autograd.no_grad():
+                if ragged:
+                    logits, nc = self.forward(
+                        Tensor(tokens),
+                        position_ids=Tensor(pos_ids),
+                        caches=_wrap_ragged_caches(caches, p_len))
+                    return logits._data, _unwrap_ragged_caches(nc)
                 logits, nc = self.forward(
                     Tensor(tokens),
                     caches=[tuple(Tensor(e) for e in c) for c in caches])
-            return logits._data, [tuple(e._data for e in c) for c in nc]
+                return logits._data, [tuple(e._data for e in c)
+                                      for c in nc]
 
         def pick(last, key):
             return sample_logits(last, key, temperature=temperature,
                                  top_k=top_k, top_p=top_p)
 
-        def run(pa, caches, last_logits, key0):
+        def run(pa, caches, last_logits, lens, key0):
             key0, k1 = jax.random.split(key0)
             nxt = pick(last_logits, k1)
             done = (jnp.zeros((b,), bool) if eos_token_id is None
                     else nxt == eos_token_id)
 
-            def body(carry, _):
+            def body(carry, step):
                 caches, cur, key, done = carry
-                logits, caches = model_step(pa, cur[:, None], caches)
+                # ragged rows continue from their TRUE length: the step-th
+                # generated token sits at position lens + step - 1
+                pos = None if lens is None else (lens + step - 1)[:, None]
+                logits, caches = model_step(pa, cur[:, None], caches, pos)
                 key, kk = jax.random.split(key)
                 new = pick(logits[:, -1].astype(jnp.float32), kk)
                 if eos_token_id is not None:
@@ -823,8 +895,9 @@ class GPTForCausalLM(Layer):
                     done = done | (new == eos_token_id)
                 return (caches, new, key, done), new
 
-            (_, _, _, _), toks = lax.scan(body, (caches, nxt, key0, done),
-                                          None, length=max_new_tokens - 1)
+            (_, _, _, _), toks = lax.scan(
+                body, (caches, nxt, key0, done),
+                jnp.arange(1, max_new_tokens, dtype=jnp.int32))
             return jnp.concatenate([nxt[:, None],
                                     jnp.moveaxis(toks, 0, 1)],
                                    axis=1).astype(jnp.int64)
@@ -833,10 +906,11 @@ class GPTForCausalLM(Layer):
                float(temperature), int(top_k), float(top_p),
                None if eos_token_id is None else int(eos_token_id),
                str(cdt), "q8" if q8 else "full",
-               "c8" if state["c8"] else "cfull")
+               "c8" if state["c8"] else "cfull",
+               "ragged" if ragged else "fixed")
         fn = self._gen_cache_get(sig, lambda: jax.jit(run))
         toks = fn(state["payload"], state["caches"], state["last_logits"],
-                  jax.random.PRNGKey(seed))
+                  state.get("lens"), jax.random.PRNGKey(seed))
         return Tensor(toks)
 
     def _make_expand(self, q8, cdt):
@@ -910,23 +984,10 @@ class GPTForCausalLM(Layer):
         if max_new_tokens <= 0:
             return ids
         b, p_cap = ids.shape
-        import numpy as _np
-        lens_arr = jnp.asarray(
-            prompt_lens._data if isinstance(prompt_lens, Tensor)
-            else _np.asarray(prompt_lens), jnp.int32)
+        lens_arr = _coerce_prompt_lens(prompt_lens, p_cap,
+                                       "generate_static_ragged")
         L = int(max_len or (p_cap + max_new_tokens))
         assert L >= p_cap + max_new_tokens, "max_len too small"
-        # lens are concrete host values at call time — validate BEFORE
-        # tracing: len 0 would index logits[b, -1] (wraps to the padded
-        # tail) and mask every real column; len > P_cap would un-mask
-        # garbage cache rows. Both produce wrong output with no error.
-        _host_lens = _np.asarray(lens_arr)
-        if _host_lens.size and (
-                int(_host_lens.min()) < 1 or int(_host_lens.max()) > p_cap):
-            raise ValueError(
-                f"generate_static_ragged: prompt_lens must satisfy "
-                f"1 <= len <= P_cap ({p_cap}); got range "
-                f"[{int(_host_lens.min())}, {int(_host_lens.max())}]")
         params = list(self.parameters())
         cdt = self.gpt.wte.weight._data.dtype
         nh, hd, nl = cfg.num_heads, cfg.head_dim, cfg.num_layers
@@ -944,11 +1005,8 @@ class GPTForCausalLM(Layer):
                 # the forward's ragged element is the nested (lens, cap)
                 logits, nc = self.forward(
                     Tensor(tokens), position_ids=Tensor(pos_ids),
-                    caches=[tuple(Tensor(e) for e in c[:-1])
-                            + ((Tensor(c[-1]), p_cap),)
-                            for c in caches])
-            return logits._data, [tuple(e._data for e in c[:-1])
-                                  + (c[-1][0]._data,) for c in nc]
+                    caches=_wrap_ragged_caches(caches, p_cap))
+            return logits._data, _unwrap_ragged_caches(nc)
 
         def pick(last, key):
             return sample_logits(last, key, temperature=temperature,

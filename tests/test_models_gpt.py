@@ -567,6 +567,15 @@ def test_prefill_decode_static_prefix_reuse():
     d8 = m.decode_static(st8, max_new_tokens=8).numpy()
     assert d8.shape == d1.shape
     assert (d8 == full[:, 8:]).mean() >= 0.5
+    # RAGGED prompts compose: per-row greedy tail equals
+    # generate_static_ragged on the same padded prompts/lens
+    lens = [3, 8]
+    r_full = m.generate_static_ragged(ids, lens, max_new_tokens=6).numpy()
+    str_ = m.prefill_static(ids, max_len=16, prompt_lens=lens)
+    dr = m.decode_static(str_, max_new_tokens=6).numpy()
+    assert (dr == r_full[:, 8:]).all()
+    with pytest.raises(ValueError):
+        m.prefill_static(ids, max_len=16, prompt_lens=[0, 8])  # len 0
 
 
 def test_attention_q8_cache_matches_dequant():
