@@ -62,8 +62,22 @@ def _timed_steps(step, iters, *stacked):
     return dt, final
 
 
+def _channels_last_ctx(on_tpu):
+    """Enable the channels-last vision fast path for a bench row (restored
+    by the caller). Default on for TPU (the NHWC/HWIO conv layout + fused
+    conv-bn-act epilogues are the point of the vision rows); override with
+    PADDLE_TPU_BENCH_CL=0/1."""
+    import paddle_tpu as paddle
+    want = os.environ.get("PADDLE_TPU_BENCH_CL", "1" if on_tpu else "0") == "1"
+    prev = paddle.get_flags("FLAGS_conv_channels_last")[
+        "FLAGS_conv_channels_last"]
+    paddle.set_flags({"FLAGS_conv_channels_last": want})
+    return prev, want
+
+
 def bench_resnet50(on_tpu):
-    """ResNet-50 ImageNet-shape training throughput (BASELINE.md config)."""
+    """ResNet-50 ImageNet-shape training throughput (BASELINE.md config):
+    fused conv-bn-act epilogue blocks, channels-last trunk on TPU."""
     import jax
     import numpy as np
     import paddle_tpu as paddle
@@ -73,30 +87,37 @@ def bench_resnet50(on_tpu):
 
     B, hw, iters = (64, 224, 8) if on_tpu else (4, 64, 2)
     B = int(os.environ.get("PADDLE_TPU_BENCH_B", B))
-    paddle.seed(0)
-    model = resnet50(num_classes=1000)
-    if on_tpu:
-        model.to(dtype="bfloat16")
-    ce = nn.CrossEntropyLoss()
-    opt = paddle.optimizer.Momentum(learning_rate=0.1,
-                                    parameters=model.parameters())
-    step = TrainStep(model, opt, lambda x, y: ce(model(x), y))
-    imgs = paddle.to_tensor(np.random.randn(iters, B, 3, hw, hw).astype(
-        "bfloat16" if on_tpu else "float32"))
-    lbls = paddle.to_tensor(np.random.randint(0, 1000, (iters, B)).astype("int64"))
-    # group the ~106 tiny BN-scale/bias updates into one fused elementwise
-    # apply: +2-4% measured r5 (GLOBAL grouping measured -12% in r4; only
-    # the small-param grouping pays). Scoped to THIS row and restored —
-    # later ladder rows must not inherit it.
-    prev_fuse = os.environ.get("PADDLE_TPU_FUSE_SMALL_UPDATES")
-    os.environ.setdefault("PADDLE_TPU_FUSE_SMALL_UPDATES", "4096")
+    # flag restore wraps EVERYTHING from here (a build/OOM error mid-row
+    # must not leak channels-last into later ladder rows)
+    prev_cl, use_cl = _channels_last_ctx(on_tpu)
     try:
-        dt, final = _timed_steps(step, iters, imgs, lbls)
+        paddle.seed(0)
+        model = resnet50(num_classes=1000)
+        if on_tpu:
+            model.to(dtype="bfloat16")
+        ce = nn.CrossEntropyLoss()
+        opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                        parameters=model.parameters())
+        step = TrainStep(model, opt, lambda x, y: ce(model(x), y))
+        imgs = paddle.to_tensor(np.random.randn(iters, B, 3, hw, hw).astype(
+            "bfloat16" if on_tpu else "float32"))
+        lbls = paddle.to_tensor(
+            np.random.randint(0, 1000, (iters, B)).astype("int64"))
+        # group the ~106 tiny BN-scale/bias updates into one fused
+        # elementwise apply: +2-4% measured r5 (GLOBAL grouping measured
+        # -12% in r4; only the small-param grouping pays). Scoped to THIS
+        # row and restored — later ladder rows must not inherit it.
+        prev_fuse = os.environ.get("PADDLE_TPU_FUSE_SMALL_UPDATES")
+        os.environ.setdefault("PADDLE_TPU_FUSE_SMALL_UPDATES", "4096")
+        try:
+            dt, final = _timed_steps(step, iters, imgs, lbls)
+        finally:
+            if prev_fuse is None:
+                os.environ.pop("PADDLE_TPU_FUSE_SMALL_UPDATES", None)
+            else:
+                os.environ["PADDLE_TPU_FUSE_SMALL_UPDATES"] = prev_fuse
     finally:
-        if prev_fuse is None:
-            os.environ.pop("PADDLE_TPU_FUSE_SMALL_UPDATES", None)
-        else:
-            os.environ["PADDLE_TPU_FUSE_SMALL_UPDATES"] = prev_fuse
+        paddle.set_flags({"FLAGS_conv_channels_last": prev_cl})
     ips = B * iters / dt
     # ResNet-50 at 224²: ~3.86 GMACs fwd → 7.7e9 FLOPs at MAC=2, matching
     # the FMA=2 convention of _chip_peak_flops and the transformer benches;
@@ -105,11 +126,13 @@ def bench_resnet50(on_tpu):
     peak = _chip_peak_flops(jax.devices()[0])
     mfu = 3 * fwd_flops * ips / peak
     return _emit({
-        "metric": f"images/sec/chip (resnet50 train, B={B} {hw}x{hw})",
+        "metric": f"images/sec/chip (resnet50 train, B={B} {hw}x{hw}"
+                  f"{' nhwc' if use_cl else ''})",
         "value": round(ips, 1), "unit": "images/s",
         "vs_baseline": round(mfu / 0.70, 4),
         "extra": {"mfu": round(mfu, 4),
                   "step_ms": round(dt / iters * 1e3, 2),
+                  "channels_last": use_cl,
                   "loss": round(final, 4)},
     })
 
@@ -294,6 +317,11 @@ def bench_gpt(on_tpu, preset=None, B=None, S=None, recompute=None,
     })
 
 
+# dense-twin results are capacity-factor independent; cache across the two
+# moe ladder points (cf=1.0 tight, cf=1.25 GShard/model default)
+_MOE_DENSE_CACHE = {}
+
+
 def bench_moe(on_tpu, cf=None):
     """GPT-MoE routed-expert throughput (reference anchor:
     incubate/distributed/models/moe/moe_layer.py:260): 1.3B-class TOTAL
@@ -323,6 +351,11 @@ def bench_moe(on_tpu, cf=None):
         cf = float(os.environ.get("PADDLE_TPU_BENCH_MOE_CF", "1.0"))
 
     def run(num_experts):
+        # the dense twin is capacity-factor independent — cache it so a
+        # second ladder point (cf=1.25) pays only the MoE run
+        dense_key = (preset, B, S, iters)
+        if num_experts == 0 and dense_key in _MOE_DENSE_CACHE:
+            return _MOE_DENSE_CACHE[dense_key]
         cfg = gpt_config(preset, max_position_embeddings=max(1024, S),
                          moe_num_experts=num_experts, moe_every_n_layers=2,
                          moe_gate="gshard", moe_aux_weight=0.01,
@@ -339,6 +372,23 @@ def bench_moe(on_tpu, cf=None):
         ids = paddle.to_tensor(rng.randint(
             0, cfg.vocab_size, (iters, B, S)).astype("int32"))
         dt, final = _timed_steps(st, iters, ids, ids)
+        # measured (token, slot) drop rate at the TRAINED router state
+        # (ADVICE r5: the capacity_factor disclosure needs the drop rate it
+        # trades against): one eager forward with the telemetry recorder on
+        drop = None
+        if num_experts:
+            from paddle_tpu.core import autograd as _ag
+            from paddle_tpu.incubate.distributed.models.moe import (
+                moe_layer as _ml)
+            _ml.record_drop_rate(True)
+            try:
+                with _ag.no_grad():
+                    _ = m.loss(paddle.to_tensor(ids._data[0]),
+                               paddle.to_tensor(ids._data[0]),
+                               chunk_size=512)
+                drop = _ml.measured_drop_rate()
+            finally:
+                _ml.record_drop_rate(False)
         n = sum(p.size for p in m.parameters())
         # ACTIVATED flops/token: dense blocks + top-2 of 8 experts — count
         # the params a token actually visits (standard MoE MFU convention)
@@ -352,10 +402,13 @@ def bench_moe(on_tpu, cf=None):
                                            * n_moe_layers
                                            if num_experts else 0)
         fpt = 6 * n_active + 12 * L * H * S
-        return dt, final, n, n_active, fpt
+        res = (dt, final, n, n_active, fpt, drop)
+        if num_experts == 0:
+            _MOE_DENSE_CACHE[dense_key] = res
+        return res
 
-    dt_m, loss_m, n_m, act_m, fpt_m = run(8)
-    dt_d, _, _, _, fpt_d = run(0)
+    dt_m, loss_m, n_m, act_m, fpt_m, drop_rate = run(8)
+    dt_d, _, _, _, fpt_d, _ = run(0)
     tps_m = B * S * iters / dt_m
     tps_d = B * S * iters / dt_d
     peak = _chip_peak_flops(jax.devices()[0])
@@ -367,7 +420,7 @@ def bench_moe(on_tpu, cf=None):
     return _emit({
         "metric": f"tokens/sec/chip (gpt-moe {preset}+8exp top2, "
                   f"{n_m/1e9:.2f}B total/{act_m/1e9:.2f}B active, "
-                  f"B={B} S={S})",
+                  f"B={B} S={S} cf={cf})",
         "value": round(tps_m, 1), "unit": "tokens/s",
         "vs_baseline": round(mfu_m / 0.70, 4),
         "extra": {"mfu": round(mfu_m, 4),   # active-FLOP MFU (driver key)
@@ -378,6 +431,10 @@ def bench_moe(on_tpu, cf=None):
                   "dense_twin_step_ms": round(dt_d / iters * 1e3, 2),
                   "routing_overhead_pct": round(routing * 100, 1),
                   "capacity_factor": cf,
+                  # measured (token,slot) overflow at this cf — the cost
+                  # the capacity knob trades against padding compute
+                  "drop_rate_pct": (None if drop_rate is None
+                                    else round(drop_rate * 100, 2)),
                   "params_total": n_m, "params_active": act_m},
     })
 
@@ -534,28 +591,33 @@ def bench_swin(on_tpu):
     B, iters = (32, 8) if on_tpu else (2, 2)
     preset = os.environ.get("PADDLE_TPU_BENCH_PRESET", "swin-t")
     builder = swin_b if preset == "swin-b" else swin_t
-    paddle.seed(0)
-    if on_tpu:
-        model = builder(num_classes=1000)
-        model.to(dtype="bfloat16")
-        hw = 224
-    else:
-        from paddle_tpu.vision.models import SwinTransformer
-        model = SwinTransformer(image_size=32, patch_size=2, embed_dim=16,
-                                depths=(2, 2), num_heads=(2, 4),
-                                window_size=4, num_classes=10)
-        hw = 32
-    ce = nn.CrossEntropyLoss()
-    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                 parameters=model.parameters(),
-                                 moment_dtype="bfloat16" if on_tpu
-                                 else "float32")
-    step = TrainStep(model, opt, lambda x, y: ce(model(x), y))
-    imgs = paddle.to_tensor(np.random.randn(iters, B, 3, hw, hw).astype(
-        "bfloat16" if on_tpu else "float32"))
-    ncls = 1000 if on_tpu else 10
-    lbls = paddle.to_tensor(np.random.randint(0, ncls, (iters, B)).astype("int64"))
-    dt, final = _timed_steps(step, iters, imgs, lbls)
+    prev_cl, use_cl = _channels_last_ctx(on_tpu)
+    try:
+        paddle.seed(0)
+        if on_tpu:
+            model = builder(num_classes=1000)
+            model.to(dtype="bfloat16")
+            hw = 224
+        else:
+            from paddle_tpu.vision.models import SwinTransformer
+            model = SwinTransformer(image_size=32, patch_size=2, embed_dim=16,
+                                    depths=(2, 2), num_heads=(2, 4),
+                                    window_size=4, num_classes=10)
+            hw = 32
+        ce = nn.CrossEntropyLoss()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     moment_dtype="bfloat16" if on_tpu
+                                     else "float32")
+        step = TrainStep(model, opt, lambda x, y: ce(model(x), y))
+        imgs = paddle.to_tensor(np.random.randn(iters, B, 3, hw, hw).astype(
+            "bfloat16" if on_tpu else "float32"))
+        ncls = 1000 if on_tpu else 10
+        lbls = paddle.to_tensor(
+            np.random.randint(0, ncls, (iters, B)).astype("int64"))
+        dt, final = _timed_steps(step, iters, imgs, lbls)
+    finally:
+        paddle.set_flags({"FLAGS_conv_channels_last": prev_cl})
     ips = B * iters / dt
     # swin-t 224²: ~4.5 GMACs fwd -> 9.0e9 FLOPs at MAC=2 (same convention
     # as the resnet row); swin-b ~15.4 GMACs. Train ≈ 3x fwd. Swin is
@@ -569,11 +631,13 @@ def bench_swin(on_tpu):
         fwd_flops = 30.8e9 if preset == "swin-b" else 9.0e9
         mfu = 3 * fwd_flops * ips / _chip_peak_flops(_jax.devices()[0])
     return _emit({
-        "metric": f"images/sec/chip ({preset} train, B={B} {hw}x{hw})",
+        "metric": f"images/sec/chip ({preset} train, B={B} {hw}x{hw}"
+                  f"{' nhwc' if use_cl else ''})",
         "value": round(ips, 1), "unit": "images/s",
         "vs_baseline": None if mfu is None else round(mfu / 0.70, 4),
         "extra": {"mfu": None if mfu is None else round(mfu, 4),
                   "step_ms": round(dt / iters * 1e3, 2),
+                  "channels_last": use_cl,
                   "loss": round(final, 4)},
     })
 
@@ -624,6 +688,10 @@ def _ladder(on_tpu):
          220),
         ("decode-b32", lambda: bench_decode(on_tpu, B=32, w8=False), 120),
         ("moe", lambda: bench_moe(on_tpu), 240),
+        # the SHIPPED default capacity (GShard 1.25) stays driver-tracked;
+        # its dense twin is reused from the cf=1.0 row, so this pays only
+        # the MoE model's compile+steps (ADVICE r5)
+        ("moe-cf125", lambda: bench_moe(on_tpu, cf=1.25), 150),
         ("resnet50", lambda: bench_resnet50(on_tpu), 150),
         # model-scale depth rows (cheap; measured r4: 49.3% / 67.5%)
         ("bert-large", lambda: bench_bert(on_tpu, preset="bert-large"), 150),

@@ -21,6 +21,28 @@ import jax as _jax  # noqa: E402
 
 _jax.config.update("jax_enable_x64", True)
 
+# jax version compat: `jax.shard_map` became a top-level export after 0.4.x
+# (with `axis_names=` selecting the manually-mapped mesh axes and
+# `check_vma=` replacing `check_rep=`); older runtimes ship the previous
+# signature under jax.experimental. Install a translating alias before any
+# submodule does `from jax import shard_map`.
+if not hasattr(_jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _esm
+
+    def _shard_map_compat(f, *, mesh, in_specs, out_specs, axis_names=None,
+                          check_vma=None, check_rep=None, auto=None):
+        if auto is None and axis_names is not None and mesh is not None:
+            # new API names the MAPPED axes; old API names the AUTO rest
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        cr = (check_rep if check_rep is not None
+              else check_vma if check_vma is not None else True)
+        kw = {"check_rep": cr}
+        if auto:
+            kw["auto"] = frozenset(auto)
+        return _esm(f, mesh, in_specs, out_specs, **kw)
+
+    _jax.shard_map = _shard_map_compat
+
 from .core.dtype import (  # noqa: F401
     float16, bfloat16, float32, float64, int8, int16, int32, int64,
     uint8, uint16, uint32, uint64, bool_, complex64, complex128,

@@ -25,6 +25,11 @@ _REGISTRY: Dict[str, Any] = {
     # opt-in like the reference's conv autotune (switch_autotune.cc) since
     # each candidate costs a compile at first encounter of a shape
     "FLAGS_flash_autotune": False,
+    # channels-last vision fast path: convs compute with TPU-preferred
+    # NHWC/HWIO dimension numbers even when the API-level layout is NCHW,
+    # and layout-aware models (resnet/swin) run their conv trunk internally
+    # channels-last with transposes only at trunk entry/exit
+    "FLAGS_conv_channels_last": False,
     "FLAGS_allocator_strategy": "xla",   # no custom allocator on TPU
     "FLAGS_fraction_of_gpu_memory_to_use": 0.0,
     "FLAGS_eager_delete_tensor_gb": 0.0,
@@ -37,11 +42,15 @@ _REGISTRY: Dict[str, Any] = {
 # fast-path mirror consumed by apply_op (bool lookup, no dict churn)
 check_nan_inf: bool = False
 benchmark: bool = False
+conv_channels_last: bool = False
 
 
 def _apply_side_effects(name: str, value):
-    global check_nan_inf, benchmark
-    if name == "FLAGS_check_nan_inf":
+    global check_nan_inf, benchmark, conv_channels_last
+    if name == "FLAGS_conv_channels_last":
+        conv_channels_last = (bool(int(value))
+                              if not isinstance(value, bool) else value)
+    elif name == "FLAGS_check_nan_inf":
         check_nan_inf = bool(int(value)) if not isinstance(value, bool) else value
         try:
             import jax

@@ -46,7 +46,7 @@ _PRINT_OPTS = {"precision": 8, "threshold": 1000, "edgeitems": 3, "linewidth": 8
 
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx", "name",
-                 "persistable", "_hooks", "pspec", "__weakref__")
+                 "persistable", "_hooks", "pspec", "_layout", "__weakref__")
 
     def __init__(self, data, stop_gradient: bool = True, name: str = None):
         if isinstance(data, Tensor):
@@ -62,6 +62,9 @@ class Tensor:
         self.persistable = False
         self._hooks = []
         self.pspec = None  # optional jax PartitionSpec annotation (distributed)
+        # internal physical-layout annotation ("NHWC" while riding the
+        # channels-last conv trunk; see nn.layout). None = API layout.
+        self._layout = None
 
     # ---- basic properties -------------------------------------------------
     @property
@@ -187,6 +190,7 @@ class Tensor:
         self._node = new._node
         self._out_idx = new._out_idx
         self.stop_gradient = new.stop_gradient
+        self._layout = getattr(new, "_layout", None)
         return self
 
     def set_value(self, value):

@@ -37,6 +37,33 @@ def _capacity(num_tokens: int, num_experts: int, top_k: int,
     return max(4, c + (-c) % 4)   # pad to a multiple of 4 lanes
 
 
+# ---- drop-rate telemetry (bench/debug) -------------------------------------
+# When enabled, each EAGER MoE forward accumulates how many (token, slot)
+# assignments overflowed their expert's static capacity — the quantity the
+# capacity_factor knob trades against padding compute. Tracer-safe: inside
+# jit traces the values are symbolic and recording is skipped, so enable it
+# and run one eager forward (bench.py bench_moe does exactly that).
+_DROP_REC = {"on": False, "kept": 0, "assigned": 0}
+
+
+def record_drop_rate(on: bool = True):
+    """Toggle (and reset) eager drop-rate accumulation."""
+    _DROP_REC.update(on=bool(on), kept=0, assigned=0)
+
+
+def measured_drop_rate():
+    """Fraction of (token, slot) assignments dropped since enabling, or
+    None if nothing eager was recorded."""
+    a = _DROP_REC["assigned"]
+    return None if a == 0 else 1.0 - _DROP_REC["kept"] / a
+
+
+def _record_keeps(kept, assigned):
+    if _DROP_REC["on"] and not isinstance(kept, jax.core.Tracer):
+        _DROP_REC["kept"] += int(kept)
+        _DROP_REC["assigned"] += int(assigned)
+
+
 def _topk_dispatch(probs, top_k: int, capacity: int):
     """GShard one-hot dispatch: probs [N, E] -> combine/dispatch [N, E, C].
 
@@ -114,6 +141,8 @@ def _moe_forward(x, gw, w1, b1, w2, b2, *, top_k, capacity_factor, gate_type,
         # O(N·k·M) bytes, zero matmul FLOPs. Dropped tokens (loc >= C)
         # target the sentinel row; empty slots read the appended zero row.
         gate_vals, idx, locs, keeps, frac = _topk_routing(probs, top_k, cap)
+        if _DROP_REC["on"]:  # guard BEFORE the reduction: off = zero cost
+            _record_keeps(jnp.sum(keeps), keeps.size)
         me = jnp.mean(probs, axis=0)
         aux = e * jnp.sum(me * frac) if gate_type in ("gshard", "switch") \
             else jnp.zeros((), probs.dtype)
@@ -143,6 +172,8 @@ def _moe_forward(x, gw, w1, b1, w2, b2, *, top_k, capacity_factor, gate_type,
         return y.reshape(b, s, m), aux.astype(jnp.float32)
 
     combine, dispatch, frac = _topk_dispatch(probs, top_k, cap)
+    if _DROP_REC["on"]:  # guard BEFORE the [N,E,C] reduction
+        _record_keeps(jnp.sum(dispatch), n * top_k)
 
     # load-balance aux loss: GShard/Switch  E * sum_e mean_prob_e * frac_e
     me = jnp.mean(probs, axis=0)

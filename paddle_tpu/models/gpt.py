@@ -816,10 +816,16 @@ class GPTForCausalLM(Layer):
         caches, last_logits = fn(payload, ids._data, lens_arr)
         # cdt is captured at PREFILL time: a model.to(dtype=...) between
         # prefill and decode must not mix the state's arrays with a new
-        # live dtype (decode_static validates against this)
+        # live dtype (decode_static validates against this). param_ids
+        # snapshots the identity of the prefill-time parameter arrays so
+        # decode_static can reject decode against mutated weights (ADVICE
+        # r5): decode replays state["payload"], i.e. the PREFILL-time
+        # weights, so silently continuing after an optimizer step would
+        # sample from a model the caller no longer holds.
         return {"caches": caches, "last_logits": last_logits,
                 "prompt": ids._data, "max_len": int(max_len),
                 "q8": q8, "c8": c8, "payload": payload, "cdt": str(cdt),
+                "param_ids": tuple(id(p._data) for p in params),
                 "lens": lens_arr}
 
     def decode_static(self, state, max_new_tokens: int,
@@ -840,17 +846,39 @@ class GPTForCausalLM(Layer):
         if max_new_tokens <= 0:
             raise ValueError("decode_static needs max_new_tokens >= 1 "
                              "(the state already holds the prompt)")
-        if p_len + max_new_tokens > L:
+        # capacity: the LAST sampled token is returned but never written to
+        # the KV cache (scan steps 1..max_new_tokens-1 write positions
+        # p_len..p_len+max_new_tokens-2), so a state sized L admits
+        # p_len + max_new_tokens - 1 cache rows — not p_len + max_new_tokens
+        # (ADVICE r5: the stricter check wasted the buffer's last row)
+        if p_len + max_new_tokens - 1 > L:
             raise ValueError(
                 f"decode_static: prompt ({p_len}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds the prefill state's max_len "
-                f"({L})")
+                f"({max_new_tokens}) needs {p_len + max_new_tokens - 1} "
+                f"cache rows, exceeding the prefill state's max_len ({L})")
         params = list(self.parameters())
         cdt = self.gpt.wte.weight._data.dtype
         if str(cdt) != state["cdt"]:
             raise ValueError(
                 f"decode_static: the model's dtype changed since prefill "
                 f"({state['cdt']} -> {cdt}); re-run prefill_static")
+        # stale-weight guard (ADVICE r5): decode replays the PREFILL-time
+        # parameter snapshot carried in the state. If the live parameter
+        # arrays are no longer the ones prefill saw (optimizer step,
+        # set_value, load_dict), continuing would silently sample from
+        # stale weights — reject instead. Identity comparison is exact for
+        # the full-precision path (the state's payload pins the prefill
+        # arrays alive, so their ids cannot be recycled); under q8 the
+        # un-quantized prefill arrays are not pinned, so a freed id could
+        # in principle be recycled by a replacement array — a best-effort
+        # guard there (every param would have to collide, in order).
+        snap = state.get("param_ids")
+        if snap is not None and tuple(id(p._data) for p in params) != snap:
+            raise ValueError(
+                "decode_static: the model's parameters changed since "
+                "prefill_static; decode would replay the prefill-time "
+                "weight snapshot. Re-run prefill_static after mutating "
+                "weights (or decode before updating them).")
         q8 = state["q8"]
         ragged = state.get("lens") is not None
         expand = self._make_expand(q8, cdt)

@@ -1,6 +1,7 @@
 """paddle.nn-equivalent namespace (reference: python/paddle/nn/__init__.py,
 137 exported layer symbols)."""
 from . import functional  # noqa: F401
+from . import layout  # noqa: F401  (channels-last trunk annotation helpers)
 from . import initializer  # noqa: F401
 from .layer import (  # noqa: F401
     Layer, Sequential, LayerList, LayerDict, ParameterList, Identity, ParamAttr,

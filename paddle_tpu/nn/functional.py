@@ -27,7 +27,7 @@ from ..core.ops import (  # re-exported op-level functions  # noqa: F401
 
 __all__ = [
     "linear", "embedding", "one_hot",
-    "conv1d", "conv2d", "conv3d", "conv2d_transpose",
+    "conv1d", "conv2d", "conv3d", "conv2d_transpose", "fused_conv_bn_act",
     "max_pool1d", "max_pool2d", "avg_pool1d", "avg_pool2d",
     "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_max_pool2d",
     "relu", "relu6", "gelu", "silu", "swish", "elu", "selu", "celu",
@@ -82,12 +82,78 @@ def one_hot(x, num_classes, name=None):
 
 
 # ----------------------------------------------------------------- convs
+_CHANNEL_LAST_FORMATS = ("NHWC", "NLC", "NDHWC", "NHC")
+
+
 def _conv_dn(ndim, channel_last=False):
+    """Dimension numbers. Channel-last uses the TPU-preferred HWIO kernel
+    layout (channel contraction minor-most for both operands — the layout
+    the MXU wants; OIHW kernels force a relayout in front of every conv)."""
     if ndim == 1:
-        return ("NCH", "OIH", "NCH") if not channel_last else ("NHC", "OIH", "NHC")
+        return ("NHC", "HIO", "NHC") if channel_last else ("NCH", "OIH", "NCH")
     if ndim == 2:
-        return ("NCHW", "OIHW", "NCHW") if not channel_last else ("NHWC", "OIHW", "NHWC")
-    return ("NCDHW", "OIDHW", "NCDHW") if not channel_last else ("NDHWC", "OIDHW", "NDHWC")
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+# per-param cache of channels-last kernel transposes for the non-recording
+# (inference/no-grad) eager path. Entries hold only a WEAKREF to the source
+# array (jax arrays are immutable and weakref-able), so a dropped model's
+# kernels — and their HWIO copies — become collectable as soon as the
+# originals die; dead entries are purged opportunistically on insert. The id
+# key is validated against the live referent, so id reuse cannot alias.
+_W_CL_CACHE: "dict[int, tuple]" = {}   # id(w) -> (weakref(w), w_transposed)
+_W_CL_CACHE_MAX = 512
+
+
+def clear_channels_last_weight_cache():
+    """Drop all cached HWIO kernel transposes (see _cl_weight_cached)."""
+    _W_CL_CACHE.clear()
+    _FOLD_CACHE.clear()
+
+
+def _static_recording_active():
+    """True while static-mode Program recording is capturing ops: any
+    hoisted concrete array would be baked into the Program as a CONSTANT
+    instead of a parameter reference, silently pinning stale weights."""
+    from ..core import tensor as _ct
+    if _ct._static_record is None:
+        return False
+    from ..static.program import _recording_active
+    return _recording_active()
+
+
+def _cl_weight_cached(weight, perm):
+    """Return the pre-transposed HWIO kernel for `weight` when it is safe to
+    take the transpose OUT of the autograd graph (weight not differentiated
+    this call, no static recording), else None (caller transposes inside the
+    op fn, which under jit happens once per trace)."""
+    import weakref
+    from ..core import autograd as _autograd
+    if not isinstance(weight, Tensor):
+        return None
+    if _autograd.is_grad_enabled() and (not weight.stop_gradient
+                                        or weight._node is not None):
+        return None  # gradient must flow through the transpose
+    w = weight._data
+    if isinstance(w, jax.core.Tracer):
+        return None
+    if _static_recording_active():
+        return None
+    key = id(w)
+    hit = _W_CL_CACHE.get(key)
+    if hit is not None and hit[0]() is w:
+        return hit[1]
+    wt = jnp.transpose(w, perm)
+    for k in [k for k, (r, _) in _W_CL_CACHE.items() if r() is None]:
+        del _W_CL_CACHE[k]
+    if len(_W_CL_CACHE) >= _W_CL_CACHE_MAX:
+        _W_CL_CACHE.pop(next(iter(_W_CL_CACHE)))
+    try:
+        _W_CL_CACHE[key] = (weakref.ref(w), wt)
+    except TypeError:
+        return wt  # non-weakrefable array type: serve uncached
+    return wt
 
 
 def _norm_tuple(v, n):
@@ -110,12 +176,53 @@ def _conv_padding(padding, n):
 
 
 def _convnd(x, weight, bias, stride, padding, dilation, groups, n, data_format):
-    channel_last = data_format in ("NHWC", "NLC", "NDHWC", "NHC")
-    dn = lax.conv_dimension_numbers(
-        _arr(x).shape, _arr(weight).shape, _conv_dn(n, channel_last))
+    from ..core import flags as _flags
+    channel_last = data_format in _CHANNEL_LAST_FORMATS
+    # internal channels-last compute whenever the data already is, or the
+    # framework flag asks for it (then NCHW data is transposed at the op
+    # boundary — adjacent convs' transposes cancel under XLA, and the conv
+    # itself runs in the MXU-preferred layout)
+    internal_cl = channel_last or bool(_flags.conv_channels_last)
     strides = _norm_tuple(stride, n)
     dil = _norm_tuple(dilation, n)
     pad_cfg = _conv_padding(padding, n)
+
+    if internal_cl:
+        xs = tuple(_arr(x).shape)
+        ws = tuple(_arr(weight).shape)                 # [O, I/g, *k]
+        xs_int = xs if channel_last else (xs[0],) + xs[2:] + (xs[1],)
+        ws_int = ws[2:] + (ws[1], ws[0])               # HWIO
+        dn = lax.conv_dimension_numbers(xs_int, ws_int, _conv_dn(n, True))
+        to_cl = (0,) + tuple(builtins.range(2, 2 + n)) + (1,)
+        to_cf = (0, n + 1) + tuple(builtins.range(1, n + 1))
+        w_perm = tuple(builtins.range(2, 2 + n)) + (1, 0)
+        # kernel transpose: hoisted + cached per-param when not
+        # differentiated this call; otherwise in-graph (once per trace)
+        cached_w = _cl_weight_cached(weight, w_perm)
+
+        def fn(a, w, *b):
+            if not channel_last:
+                a = jnp.transpose(a, to_cl)
+            if cached_w is None:
+                w = jnp.transpose(w, w_perm)
+            out = lax.conv_general_dilated(
+                a, w, window_strides=strides, padding=pad_cfg,
+                rhs_dilation=dil, dimension_numbers=dn,
+                feature_group_count=groups)
+            out = out.astype(a.dtype)
+            if b:
+                # bias add in the NHWC epilogue, before any exit transpose
+                out = out + b[0].reshape((1,) * (out.ndim - 1) + (-1,))
+            if not channel_last:
+                out = jnp.transpose(out, to_cf)
+            return out
+
+        args = [x, cached_w if cached_w is not None else weight] \
+            + ([bias] if bias is not None else [])
+        return apply_op("conv%dd" % n, fn, args)
+
+    dn = lax.conv_dimension_numbers(
+        _arr(x).shape, _arr(weight).shape, _conv_dn(n, channel_last))
 
     def fn(a, w, *b):
         # NOTE: no preferred_element_type upcast — the TPU MXU accumulates
@@ -153,6 +260,239 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCDHW", name=None):
     return _convnd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+# inference BN-fold cache: (weight, stats, affine, bias) identity ->
+# (folded kernel, shift). Same safety rules as _cl_weight_cached (nothing
+# differentiated, no tracers, no static recording); the weight rides a
+# weakref, the small per-channel vectors are pinned in the value so their
+# ids cannot be recycled into a false hit.
+_FOLD_CACHE: "dict[tuple, tuple]" = {}
+_FOLD_CACHE_MAX = 256
+
+
+def _fold_bn_cached(weight, bias, rm, rv, gamma, beta, epsilon, w_perm):
+    import weakref
+    from ..core import autograd as _autograd
+    parts = [t for t in (weight, bias, rm, rv, gamma, beta) if t is not None]
+    if not all(isinstance(t, Tensor) for t in parts):
+        return None
+    if _autograd.is_grad_enabled() and any(
+            (not t.stop_gradient or t._node is not None) for t in parts):
+        return None
+    arrs = [t._data for t in parts]
+    if any(isinstance(a, jax.core.Tracer) for a in arrs):
+        return None
+    if _static_recording_active():
+        return None
+    w = weight._data
+    rest = tuple(arrs[1:])
+    key = tuple(id(a) for a in arrs) + (float(epsilon), w_perm)
+    hit = _FOLD_CACHE.get(key)
+    if (hit is not None and hit[0]() is w
+            and builtins.all(a is b for a, b in zip(hit[1], rest))):
+        return hit[2]
+    inv = lax.rsqrt(rv._data.astype(jnp.float32) + epsilon)
+    scale = inv if gamma is None else gamma._data.astype(jnp.float32) * inv
+    shift = -rm._data.astype(jnp.float32) * scale
+    if bias is not None:
+        shift = shift + bias._data.astype(jnp.float32) * scale
+    if beta is not None:
+        shift = shift + beta._data.astype(jnp.float32)
+    w_f = w * scale.astype(w.dtype).reshape(-1, 1, 1, 1)
+    if w_perm is not None:
+        w_f = jnp.transpose(w_f, w_perm)
+    for k in [k for k, (r, _, _) in _FOLD_CACHE.items() if r() is None]:
+        del _FOLD_CACHE[k]
+    if len(_FOLD_CACHE) >= _FOLD_CACHE_MAX:
+        _FOLD_CACHE.pop(next(iter(_FOLD_CACHE)))
+    try:
+        _FOLD_CACHE[key] = (weakref.ref(w), rest, (w_f, shift))
+    except TypeError:
+        pass
+    return (w_f, shift)
+
+
+# epilogue activations XLA fuses onto the conv's MXU output
+_EPILOGUE_ACTS = {
+    None: lambda v: v,
+    "identity": lambda v: v,
+    "relu": lambda v: jnp.maximum(v, 0),
+    "relu6": lambda v: jnp.clip(v, 0, 6),
+    # exact erf form to match F.gelu's default (jax.nn.gelu defaults to
+    # the tanh approximation, which would break unfused-path parity)
+    "gelu": lambda v: jax.nn.gelu(v, approximate=False),
+    "silu": jax.nn.silu,
+    "hardswish": lambda v: v * jnp.clip(v + 3, 0, 6) / 6,
+    "leaky_relu": jax.nn.leaky_relu,
+}
+
+
+def fused_conv_bn_act(x, weight, bias=None, running_mean=None,
+                      running_var=None, bn_weight=None, bn_bias=None,
+                      stride=1, padding=0, dilation=1, groups=1,
+                      data_format="NCHW", training=False, momentum=0.9,
+                      epsilon=1e-5, use_global_stats=None, act=None,
+                      residual=None, name=None):
+    """Conv2D + BatchNorm + residual-add + activation as ONE jit-visible op.
+
+    Inference (and use_global_stats) mode folds the BN scale/shift into the
+    conv kernel and bias — w' = w * gamma/sqrt(var+eps) over the out-channel
+    axis, b' = beta + (b - mean) * gamma/sqrt(var+eps) — so the whole block
+    is a single conv whose epilogue (bias, residual, act) XLA fuses onto the
+    MXU output. Training mode keeps batch statistics but still emits conv →
+    normalize → scale/shift → (+residual) → act inside one op, so nothing
+    re-enters HBM between the conv and its epilogue. Running stats update
+    eagerly exactly like `batch_norm` (skipped inside jit traces).
+
+    `act`: None or one of "relu", "relu6", "gelu", "silu", "hardswish",
+    "leaky_relu", "identity" (see _EPILOGUE_ACTS). `residual` is added
+    pre-activation and must be in the same layout as `x`. Honors
+    FLAGS_conv_channels_last like `conv2d`.
+    """
+    from ..core import flags as _flags
+    n = 2
+    channel_last = data_format in _CHANNEL_LAST_FORMATS
+    internal_cl = channel_last or bool(_flags.conv_channels_last)
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    pad_cfg = _conv_padding(padding, n)
+    act_fn = _EPILOGUE_ACTS[act]
+    use_batch_stats = training and not use_global_stats
+    if not use_batch_stats and (running_mean is None or running_var is None):
+        raise ValueError("fused_conv_bn_act in inference mode needs "
+                         "running_mean/running_var")
+
+    xs = tuple(_arr(x).shape)
+    ws = tuple(_arr(weight).shape)                     # [O, I/g, kh, kw]
+    if internal_cl:
+        xs_int = xs if channel_last else (xs[0],) + xs[2:] + (xs[1],)
+        dn = lax.conv_dimension_numbers(
+            xs_int, ws[2:] + (ws[1], ws[0]), _conv_dn(n, True))
+    else:
+        dn = lax.conv_dimension_numbers(xs, ws, _conv_dn(n, False))
+    to_cl, to_cf, w_perm = (0, 2, 3, 1), (0, 3, 1, 2), (2, 3, 1, 0)
+    # broadcast shape for per-channel terms in the INTERNAL layout
+    bshape = (1, 1, 1, -1) if internal_cl else (1, -1, 1, 1)
+    red_axes = (0, 1, 2) if internal_cl else (0, 2, 3)
+
+    def conv(a, w):
+        return lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad_cfg, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=groups).astype(a.dtype)
+
+    has = (bias is not None, bn_weight is not None, bn_bias is not None,
+           residual is not None)
+
+    def unpack(rest):
+        i = 0
+        cb = gamma = beta = res = rm = rv = None
+        if has[0]:
+            cb = rest[i]; i += 1
+        if not use_batch_stats:
+            rm, rv = rest[i], rest[i + 1]; i += 2
+        if has[1]:
+            gamma = rest[i]; i += 1
+        if has[2]:
+            beta = rest[i]; i += 1
+        if has[3]:
+            res = rest[i]; i += 1
+        return cb, gamma, beta, res, rm, rv
+
+    args = [x, weight] + ([bias] if has[0] else []) \
+        + ([] if use_batch_stats else [running_mean, running_var]) \
+        + ([bn_weight] if has[1] else []) + ([bn_bias] if has[2] else []) \
+        + ([residual] if has[3] else [])
+
+    if not use_batch_stats:
+        folded = _fold_bn_cached(weight, bias, running_mean, running_var,
+                                 bn_weight, bn_bias, epsilon,
+                                 w_perm if internal_cl else None)
+        if folded is not None:
+            # eager-inference fast path: the folded kernel/shift are
+            # computed ONCE per (weight, stats, affine) identity — a
+            # serving loop pays only the conv + epilogue per call
+            w_f, shift = folded
+
+            def ffn(a, wf, sh, *res):
+                if internal_cl and not channel_last:
+                    a = jnp.transpose(a, to_cl)
+                    res = tuple(jnp.transpose(r, to_cl) for r in res)
+                out = conv(a, wf) + sh.astype(a.dtype).reshape(bshape)
+                if res:
+                    out = out + res[0]
+                out = act_fn(out).astype(a.dtype)
+                if internal_cl and not channel_last:
+                    out = jnp.transpose(out, to_cf)
+                return out
+            return apply_op("fused_conv_bn_act", ffn,
+                            [x, w_f, shift]
+                            + ([residual] if has[3] else []))
+
+        def fn(a, w, *rest):
+            cb, gamma, beta, res, rm, rv = unpack(rest)
+            inv = lax.rsqrt(rv.astype(jnp.float32) + epsilon)
+            scale = (inv if gamma is None
+                     else gamma.astype(jnp.float32) * inv)      # [O]
+            shift = -rm.astype(jnp.float32) * scale
+            if cb is not None:
+                shift = shift + cb.astype(jnp.float32) * scale
+            if beta is not None:
+                shift = shift + beta.astype(jnp.float32)
+            w_f = w * scale.astype(w.dtype).reshape(-1, 1, 1, 1)  # fold [O]
+            if internal_cl:
+                w_f = jnp.transpose(w_f, w_perm)
+                if not channel_last:
+                    a = jnp.transpose(a, to_cl)
+                    if res is not None:
+                        res = jnp.transpose(res, to_cl)
+            out = conv(a, w_f) + shift.astype(a.dtype).reshape(bshape)
+            if res is not None:
+                out = out + res
+            out = act_fn(out).astype(a.dtype)
+            if internal_cl and not channel_last:
+                out = jnp.transpose(out, to_cf)
+            return out
+        return apply_op("fused_conv_bn_act", fn, args)
+
+    def fn(a, w, *rest):
+        cb, gamma, beta, res, _, _ = unpack(rest)
+        if internal_cl:
+            w = jnp.transpose(w, w_perm)
+            if not channel_last:
+                a = jnp.transpose(a, to_cl)
+                if res is not None:
+                    res = jnp.transpose(res, to_cl)
+        y = conv(a, w)
+        if cb is not None:
+            y = y + cb.reshape(bshape)
+        mu = y.mean(axis=red_axes, keepdims=True)
+        var = y.var(axis=red_axes, keepdims=True)
+        out = (y - mu) * lax.rsqrt(var + epsilon)
+        if gamma is not None:
+            out = out * gamma.reshape(bshape)
+        if beta is not None:
+            out = out + beta.reshape(bshape)
+        if res is not None:
+            out = out + res
+        out = act_fn(out).astype(a.dtype)
+        if internal_cl and not channel_last:
+            out = jnp.transpose(out, to_cf)
+        return out, mu.reshape(-1), var.reshape(-1)
+
+    out, bm, bv = apply_op("fused_conv_bn_act", fn, args, n_outputs=3)
+    # eager running-stat side effect, identical to batch_norm's (the batch
+    # stats ride out of the op as extra outputs so the conv output never
+    # materializes outside it); skipped under jit/static tracing
+    if running_mean is not None and isinstance(bm, Tensor):
+        m = bm._data
+        if not isinstance(m, (jax.ShapeDtypeStruct, jax.core.Tracer)):
+            rm_d, rv_d = running_mean._data, running_var._data
+            running_mean._data = momentum * rm_d + \
+                (1 - momentum) * m.astype(rm_d.dtype)
+            running_var._data = momentum * rv_d + \
+                (1 - momentum) * bv._data.astype(rv_d.dtype)
+    return out
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
@@ -285,22 +625,32 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
     out_hw = _norm_tuple(output_size, 2)
+    channel_last = data_format == "NHWC"
 
     def fn(a):
-        h, w = a.shape[-2], a.shape[-1]
+        h, w = (a.shape[1], a.shape[2]) if channel_last \
+            else (a.shape[-2], a.shape[-1])
         oh, ow = out_hw
         if h % oh == 0 and w % ow == 0:
+            if channel_last:
+                a2 = a.reshape(a.shape[0], oh, h // oh, ow, w // ow,
+                               a.shape[-1])
+                return a2.mean(axis=(2, 4))
             a2 = a.reshape(*a.shape[:-2], oh, h // oh, ow, w // ow)
             return a2.mean(axis=(-3, -1))
-        # general case: interpolate bin edges
-        out = jnp.zeros((*a.shape[:-2], oh, ow), a.dtype)
+        # general case: interpolate bin edges (NCHW coordinates)
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
         rows = [(int(np.floor(i * h / oh)), int(np.ceil((i + 1) * h / oh))) for i in builtins.range(oh)]
         cols = [(int(np.floor(j * w / ow)), int(np.ceil((j + 1) * w / ow))) for j in builtins.range(ow)]
         parts = []
         for (r0, r1) in rows:
             row_parts = [a[..., r0:r1, c0:c1].mean(axis=(-2, -1)) for (c0, c1) in cols]
             parts.append(jnp.stack(row_parts, axis=-1))
-        return jnp.stack(parts, axis=-2)
+        out = jnp.stack(parts, axis=-2)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
     return apply_op("adaptive_avg_pool2d", fn, [x])
 
 

@@ -10,6 +10,7 @@ import numpy as np
 from ..layer import Layer
 from .. import functional as F
 from .. import initializer as I
+from .. import layout as _layout
 
 
 class _ConvNd(Layer):
@@ -65,6 +66,19 @@ class Conv2D(_ConvNd):
                          dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
 
     def forward(self, x):
+        # channels-last trunk propagation: an input tagged NHWC (see
+        # nn.layout) computes directly in that layout and keeps the tag —
+        # no transposes inside the trunk. A config that cannot honor the
+        # tag exits the layout region instead of misreading the data.
+        if _layout.is_nhwc(x):
+            if self._data_format == "NCHW":
+                out = F.conv2d(x, self.weight, self.bias, self._stride,
+                               self._padding, self._dilation, self._groups,
+                               "NHWC")
+                return _layout.tag_nhwc(out)
+            # declared NHWC: data already is — drop only the annotation
+            x = _layout.untag(x) if self._data_format == "NHWC" \
+                else _layout.to_nchw(x)
         return F.conv2d(x, self.weight, self.bias, self._stride, self._padding,
                         self._dilation, self._groups, self._data_format)
 

@@ -61,9 +61,21 @@ class _BatchNormBase(Layer):
         self.register_buffer("_variance", Tensor(jnp.ones(num_features)))
 
     def forward(self, x):
+        from .. import layout as _layout
+        df = self._data_format
+        if _layout.is_nhwc(x):
+            if df == "NCHW":
+                out = F.batch_norm(x, self._mean, self._variance, self.weight,
+                                   self.bias, training=self.training,
+                                   momentum=self._momentum,
+                                   epsilon=self._epsilon, data_format="NHWC",
+                                   use_global_stats=self._use_global_stats)
+                return _layout.tag_nhwc(out)
+            # declared NHWC: data already is — drop only the annotation
+            x = _layout.untag(x) if df == "NHWC" else _layout.to_nchw(x)
         return F.batch_norm(x, self._mean, self._variance, self.weight, self.bias,
                             training=self.training, momentum=self._momentum,
-                            epsilon=self._epsilon, data_format=self._data_format,
+                            epsilon=self._epsilon, data_format=df,
                             use_global_stats=self._use_global_stats)
 
     def extra_repr(self):

@@ -3,6 +3,7 @@ from __future__ import annotations
 
 from ..layer import Layer
 from .. import functional as F
+from .. import layout as _layout
 
 
 class MaxPool1D(Layer):
@@ -24,6 +25,14 @@ class MaxPool2D(Layer):
         self.return_mask, self.ceil_mode, self.data_format = return_mask, ceil_mode, data_format
 
     def forward(self, x):
+        if _layout.is_nhwc(x):
+            if self.data_format == "NCHW" and not self.return_mask:
+                out = F.max_pool2d(x, self.k, self.s, self.p, self.ceil_mode,
+                                   False, "NHWC")
+                return _layout.tag_nhwc(out)
+            # declared NHWC: data already is — drop only the annotation
+            x = _layout.untag(x) if self.data_format == "NHWC" \
+                else _layout.to_nchw(x)
         return F.max_pool2d(x, self.k, self.s, self.p, self.ceil_mode,
                             self.return_mask, self.data_format)
 
@@ -47,6 +56,13 @@ class AvgPool2D(Layer):
         self.data_format = data_format
 
     def forward(self, x):
+        if _layout.is_nhwc(x):
+            if self.data_format == "NCHW":
+                out = F.avg_pool2d(x, self.k, self.s, self.p, self.ceil_mode,
+                                   self.exclusive, self.divisor, "NHWC")
+                return _layout.tag_nhwc(out)
+            x = _layout.untag(x) if self.data_format == "NHWC" \
+                else _layout.to_nchw(x)
         return F.avg_pool2d(x, self.k, self.s, self.p, self.ceil_mode, self.exclusive,
                             self.divisor, self.data_format)
 
@@ -66,6 +82,12 @@ class AdaptiveAvgPool2D(Layer):
         self.output_size, self.data_format = output_size, data_format
 
     def forward(self, x):
+        if _layout.is_nhwc(x):
+            if self.data_format == "NCHW":
+                out = F.adaptive_avg_pool2d(x, self.output_size, "NHWC")
+                return _layout.tag_nhwc(out)
+            x = _layout.untag(x) if self.data_format == "NHWC" \
+                else _layout.to_nchw(x)
         return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)
 
 

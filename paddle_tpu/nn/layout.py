@@ -1,0 +1,76 @@
+"""Channels-last layout propagation for the vision conv trunk.
+
+TPUs strongly prefer NHWC activations and HWIO kernels: the MXU consumes the
+channel (contraction) dimension from the minor-most axis, so NCHW convs force
+XLA to insert relayouts around every conv. Under `FLAGS_conv_channels_last`
+the vision models run their conv trunk *internally* channels-last while the
+public API stays NCHW:
+
+- entry (`to_nhwc`) transposes once and tags the tensor with an internal
+  `_layout = "NHWC"` annotation;
+- layout-aware layers (Conv2D, BatchNorm2D, pools, the fused conv epilogues)
+  see the tag, compute directly in NHWC, and propagate the tag;
+- exit (`to_nchw`) transposes back exactly once at the trunk boundary.
+
+The tag lives on the eager Tensor wrapper (core.tensor Tensor._layout), so it
+propagates identically in eager mode and inside jit traces (TrainStep
+re-executes the Python forward per trace). Ops that are not layout-aware
+produce untagged tensors — the annotation never silently escapes the trunk:
+a model must opt in by calling `to_nhwc` at a known boundary.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import flags as _flags
+from ..core.tensor import Tensor, apply_op
+
+NHWC = "NHWC"
+
+
+def channels_last_enabled() -> bool:
+    """True when FLAGS_conv_channels_last is set."""
+    return bool(_flags.conv_channels_last)
+
+
+def is_nhwc(t) -> bool:
+    """True when `t` carries the internal channels-last annotation."""
+    return isinstance(t, Tensor) and getattr(t, "_layout", None) == NHWC
+
+
+def tag_nhwc(t: Tensor) -> Tensor:
+    t._layout = NHWC
+    return t
+
+
+def to_nhwc(x: Tensor) -> Tensor:
+    """Trunk entry: NCHW -> physically-NHWC tensor tagged for propagation."""
+    if is_nhwc(x):
+        return x
+    out = apply_op("layout_to_nhwc",
+                   lambda a: jnp.transpose(a, (0, 2, 3, 1)), [x])
+    return tag_nhwc(out)
+
+
+def to_nchw(x: Tensor) -> Tensor:
+    """Trunk exit: restore the API NCHW layout (no-op on untagged input)."""
+    if not is_nhwc(x):
+        return x
+    out = apply_op("layout_to_nchw",
+                   lambda a: jnp.transpose(a, (0, 3, 1, 2)), [x])
+    out._layout = None
+    return out
+
+
+def untag(x: Tensor) -> Tensor:
+    """Drop the annotation WITHOUT moving data — for handing a tagged
+    tensor to a consumer whose declared data_format already is NHWC (the
+    physical layout matches; only the bookkeeping must not leak). Returns a
+    fresh wrapper sharing the array and autograd edge; the caller's tensor
+    keeps its tag."""
+    if not is_nhwc(x):
+        return x
+    out = Tensor(x._data, stop_gradient=x.stop_gradient)
+    out._node = x._node
+    out._out_idx = x._out_idx
+    return out

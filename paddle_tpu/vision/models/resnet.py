@@ -1,13 +1,20 @@
 """ResNet family (reference: python/paddle/vision/models/resnet.py).
 
-Re-designed TPU-first: the stack is plain Conv2D/BatchNorm2D composition —
-XLA fuses conv+bn+relu; no hand-written fused blocks needed. Width/grouping
+Re-designed TPU-first: every Conv→BN(→ReLU) triple — including the residual
+add — executes through `F.fused_conv_bn_act`, ONE jit-visible op whose
+epilogue (bias/residual/act) XLA fuses onto the conv's MXU output; inference
+mode folds the BN scale/shift into the conv kernel entirely. Under
+FLAGS_conv_channels_last the whole trunk additionally runs internally NHWC
+(nn.layout), with layout transposes only at trunk entry/exit. Width/grouping
 variants (wide_resnet, resnext) follow the reference's single BottleneckBlock
 parameterisation.
 """
 from __future__ import annotations
 
 from ... import nn
+from ...nn import functional as F
+from ...nn import layout as _layout
+from ...nn.layers.norm import _BatchNormBase
 
 
 __all__ = [
@@ -15,6 +22,34 @@ __all__ = [
     "resnext50_32x4d", "resnext50_64x4d", "resnext101_32x4d", "resnext101_64x4d",
     "resnext152_32x4d", "resnext152_64x4d", "wide_resnet50_2", "wide_resnet101_2",
 ]
+
+
+def _fused_cba(x, conv, bn, act=None, residual=None):
+    """Run `act(bn(conv(x)) [+ residual])` as one fused op, honoring the
+    channels-last tag on `x` (see nn.layout)."""
+    df = "NHWC" if (_layout.is_nhwc(x) and conv._data_format == "NCHW") \
+        else conv._data_format
+    out = F.fused_conv_bn_act(
+        x, conv.weight, conv.bias, bn._mean, bn._variance, bn.weight,
+        bn.bias, stride=conv._stride, padding=conv._padding,
+        dilation=conv._dilation, groups=conv._groups, data_format=df,
+        training=bn.training, momentum=bn._momentum, epsilon=bn._epsilon,
+        use_global_stats=bn._use_global_stats, act=act, residual=residual)
+    return _layout.tag_nhwc(out) if df == "NHWC" else out
+
+
+def _can_fuse(*bns):
+    return all(isinstance(bn, _BatchNormBase) for bn in bns)
+
+
+def _downsample_out(ds, x):
+    """Projection shortcut: fuse its Conv+BN too when it is the standard
+    Sequential(Conv2D, BatchNorm) pair; any other module is not
+    layout-aware, so leave the NHWC region before calling it."""
+    if (isinstance(ds, nn.Sequential) and len(ds) == 2
+            and isinstance(ds[0], nn.Conv2D) and _can_fuse(ds[1])):
+        return _fused_cba(x, ds[0], ds[1])
+    return ds(_layout.to_nchw(x))
 
 
 class BasicBlock(nn.Layer):
@@ -37,6 +72,20 @@ class BasicBlock(nn.Layer):
 
     def forward(self, x):
         identity = x
+        if _can_fuse(self.bn1, self.bn2):
+            out = _fused_cba(x, self.conv1, self.bn1, act="relu")
+            if self.downsample is not None:
+                identity = _downsample_out(self.downsample, x)
+                if _layout.is_nhwc(out) and not _layout.is_nhwc(identity):
+                    # non-layout-aware shortcut exited the NHWC region:
+                    # the residual epilogue needs matching layouts
+                    out = _layout.to_nchw(out)
+            # residual add + final relu ride the second conv's epilogue
+            return _fused_cba(out, self.conv2, self.bn2, act="relu",
+                              residual=identity)
+        # unfused fallback: bare activations drop the layout annotation, so
+        # leave the NHWC region first (no-op on untagged input)
+        x = identity = _layout.to_nchw(x)
         out = self.relu(self.bn1(self.conv1(x)))
         out = self.bn2(self.conv2(out))
         if self.downsample is not None:
@@ -65,6 +114,16 @@ class BottleneckBlock(nn.Layer):
 
     def forward(self, x):
         identity = x
+        if _can_fuse(self.bn1, self.bn2, self.bn3):
+            out = _fused_cba(x, self.conv1, self.bn1, act="relu")
+            out = _fused_cba(out, self.conv2, self.bn2, act="relu")
+            if self.downsample is not None:
+                identity = _downsample_out(self.downsample, x)
+                if _layout.is_nhwc(out) and not _layout.is_nhwc(identity):
+                    out = _layout.to_nchw(out)
+            return _fused_cba(out, self.conv3, self.bn3, act="relu",
+                              residual=identity)
+        x = identity = _layout.to_nchw(x)
         out = self.relu(self.bn1(self.conv1(x)))
         out = self.relu(self.bn2(self.conv2(out)))
         out = self.bn3(self.conv3(out))
@@ -121,10 +180,20 @@ class ResNet(nn.Layer):
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        if _layout.channels_last_enabled() and _can_fuse(self.bn1):
+            # trunk entry: ONE transpose; every layer below propagates the
+            # NHWC tag (exit transpose after the pool, where the map is 1x1).
+            # Gated on the fused stem: the unfused path routes through bare
+            # activations that do not carry the annotation.
+            x = _layout.to_nhwc(x)
+        if _can_fuse(self.bn1):
+            x = self.maxpool(_fused_cba(x, self.conv1, self.bn1, act="relu"))
+        else:
+            x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
         x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
         if self.with_pool:
             x = self.avgpool(x)
+        x = _layout.to_nchw(x)
         if self.num_classes > 0:
             x = self.fc(x.flatten(1))
         return x

@@ -9,6 +9,12 @@ right sharding is dp over images (windows ride along). Shifted windows use
 jnp.roll (a cheap HBM-local rotate on TPU); the shift attention mask and the
 relative-position-bias index table are static per stage and precomputed on
 host at build time, so the traced computation stays shape-static.
+
+r6 channels-last PR: window partition/merge and patch merging each run as
+ONE jit-visible op (the roll/reshape/transpose plumbing no longer fragments
+the graph into per-step eager ops), and under FLAGS_conv_channels_last the
+patch-embed conv runs NHWC — its output reshapes straight into sequence
+form, deleting the [B,C,hw]->[B,hw,C] transpose.
 """
 from __future__ import annotations
 
@@ -193,32 +199,42 @@ class SwinBlock(Layer):
                       if self.shift > 0 else None)
 
     def _windows(self, x):
-        """[B, H*W, C] -> [B*nW, ws*ws, C] (with cyclic shift)."""
-        H, W, ws, shift = self.H, self.W, self.ws, self.shift
-        b = x.shape[0]
-        x = ops.reshape(x, [b, H, W, self.dim])
-        if shift:
-            x = ops.roll(x, shifts=[-shift, -shift], axis=[1, 2])
-        x = ops.reshape(x, [b, H // ws, ws, W // ws, ws, self.dim])
-        x = ops.transpose(x, [0, 1, 3, 2, 4, 5])
-        return ops.reshape(x, [-1, ws * ws, self.dim])
+        """[B, H*W, C] -> [B*nW, ws*ws, C] (with cyclic shift).
 
-    def _unwindows(self, xw, b):
-        H, W, ws, shift = self.H, self.W, self.ws, self.shift
-        x = ops.reshape(xw, [b, H // ws, W // ws, ws, ws, self.dim])
-        x = ops.transpose(x, [0, 1, 3, 2, 4, 5])
-        x = ops.reshape(x, [b, H, W, self.dim])
-        if shift:
-            x = ops.roll(x, shifts=[shift, shift], axis=[1, 2])
-        return ops.reshape(x, [b, H * W, self.dim])
+        ONE jit-visible op: the roll + reshape + transpose chain that used
+        to be 4-5 separate eager ops (each a tape node and an XLA fusion
+        boundary) collapses into a single layout block, so all windows of
+        the image land in one batched tensor for one batched attention
+        matmul downstream."""
+        H, W, ws, shift, dim = self.H, self.W, self.ws, self.shift, self.dim
+
+        def fn(a):
+            v = a.reshape(-1, H, W, dim)
+            if shift:
+                v = jnp.roll(v, (-shift, -shift), axis=(1, 2))
+            v = v.reshape(-1, H // ws, ws, W // ws, ws, dim)
+            v = v.transpose(0, 1, 3, 2, 4, 5)
+            return v.reshape(-1, ws * ws, dim)
+        return apply_op("swin_window_partition", fn, [x])
+
+    def _unwindows(self, xw):
+        H, W, ws, shift, dim = self.H, self.W, self.ws, self.shift, self.dim
+
+        def fn(a):
+            v = a.reshape(-1, H // ws, W // ws, ws, ws, dim)
+            v = v.transpose(0, 1, 3, 2, 4, 5)
+            v = v.reshape(-1, H, W, dim)
+            if shift:
+                v = jnp.roll(v, (shift, shift), axis=(1, 2))
+            return v.reshape(-1, H * W, dim)
+        return apply_op("swin_window_merge", fn, [xw])
 
     def forward(self, x):
-        b = x.shape[0]
         shortcut = x
         xw = self._windows(self.norm1(x))
         aw = self.attn(xw, self._mask,
                        n_windows=(self.H // self.ws) * (self.W // self.ws))
-        x = shortcut + self._unwindows(aw, b)
+        x = shortcut + self._unwindows(aw)
         y = self.fc2(F.gelu(self.fc1(self.norm2(x)), approximate=True))
         if self.training and self.drop.p:
             y = self.drop(y)
@@ -236,6 +252,22 @@ class PatchMerging(Layer):
         self.reduction = Linear(4 * dim, 2 * dim, bias_attr=False)
 
     def forward(self, x):
+        H, W, dim = self.H, self.W, self.dim
+        nw, nb = self.norm.weight, self.norm.bias
+        rw = self.reduction.weight
+        eps = self.norm._epsilon
+        if nw is not None and nb is not None and self.reduction.bias is None:
+            # one jit-visible block: 2x2 gather + LN + reduction matmul —
+            # the epilogue-fused equivalent of the 5-op eager chain below
+            def fn(a, w_n, b_n, w_r):
+                v = a.reshape(-1, H // 2, 2, W // 2, 2, dim)
+                v = v.transpose(0, 1, 3, 2, 4, 5)
+                v = v.reshape(-1, (H // 2) * (W // 2), 4 * dim)
+                mu = v.mean(axis=-1, keepdims=True)
+                var = ((v - mu) ** 2).mean(axis=-1, keepdims=True)
+                v = ((v - mu) * jax.lax.rsqrt(var + eps) * w_n + b_n)
+                return (v.astype(a.dtype) @ w_r).astype(a.dtype)
+            return apply_op("swin_patch_merge", fn, [x, nw, nb, rw])
         b = x.shape[0]
         x = ops.reshape(x, [b, self.H // 2, 2, self.W // 2, 2, self.dim])
         x = ops.transpose(x, [0, 1, 3, 2, 4, 5])
@@ -290,9 +322,18 @@ class SwinTransformer(Layer):
             self.head = Linear(dim, num_classes)
 
     def forward(self, pixel_values):
-        x = self.patch_embed(pixel_values)                     # [B, C, h, w]
-        b, c = x.shape[0], x.shape[1]
-        x = ops.transpose(ops.reshape(x, [b, c, -1]), [0, 2, 1])
+        from ...nn import layout as _layout
+        if _layout.channels_last_enabled():
+            # channels-last patch embed: ONE input transpose, conv in the
+            # TPU-preferred NHWC layout, and the [B,C,hw]->[B,hw,C]
+            # transpose disappears entirely — NHWC output reshapes straight
+            # into the sequence-form the transformer trunk wants
+            x = self.patch_embed(_layout.to_nhwc(pixel_values))  # [B,h,w,C]
+            x = ops.reshape(x, [x.shape[0], -1, self.embed_dim])
+        else:
+            x = self.patch_embed(pixel_values)                 # [B, C, h, w]
+            b, c = x.shape[0], x.shape[1]
+            x = ops.transpose(ops.reshape(x, [b, c, -1]), [0, 2, 1])
         x = self.patch_norm(x)
         for i, blocks in enumerate(self.stages):
             for blk in blocks:

@@ -578,6 +578,34 @@ def test_prefill_decode_static_prefix_reuse():
         m.prefill_static(ids, max_len=16, prompt_lens=[0, 8])  # len 0
 
 
+def test_decode_static_capacity_and_stale_weight_guard():
+    """r6 (ADVICE r5): the last sampled token is never written to the KV
+    cache, so p_len + max_new_tokens - 1 == max_len is admissible; and
+    decode against parameters mutated since prefill is rejected (decode
+    replays the prefill-time snapshot)."""
+    import numpy as np
+    import pytest
+    paddle.seed(5)
+    cfg = GPTConfig(vocab_size=96, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    intermediate_size=128)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(6).randint(1, 96, (2, 8)).astype(np.int64))
+    st = m.prefill_static(ids, max_len=16)
+    out = m.decode_static(st, max_new_tokens=9)    # 8 + 9 - 1 == 16 == L
+    assert tuple(out.shape) == (2, 9)
+    with pytest.raises(ValueError):
+        m.decode_static(st, max_new_tokens=10)     # 8 + 10 - 1 > 16
+    # stale-weight replay guard: a same-dtype weight swap must be caught
+    st2 = m.prefill_static(ids, max_len=16)
+    p = next(iter(m.parameters()))
+    p.set_value(p.numpy())                         # same values, new array
+    with pytest.raises(ValueError, match="parameters changed"):
+        m.decode_static(st2, max_new_tokens=4)
+
+
 def test_attention_q8_cache_matches_dequant():
     """attention_q8_cache's factored scales (q·cᵀ·s_k; (p·s_v)·c_v) must be
     numerically equivalent to attending over explicitly dequantized K/V."""
