@@ -29,18 +29,11 @@ import sys
 import time
 
 
-# bf16 peak matmul TFLOP/s per chip by TPU generation (public spec sheets)
-_PEAK = {"v2": 46e12, "v3": 123e12, "v4": 275e12,
-         "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
-         "v5p": 459e12, "v6e": 918e12, "v6p": 918e12}
-
-
 def _chip_peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, val in _PEAK.items():
-        if key in kind:
-            return val
-    return 275e12  # assume v4 if unknown
+    """bf16 peak matmul FLOP/s (moved to paddle_tpu.device so the profiler's
+    StepMonitor shares the same MFU denominator)."""
+    from paddle_tpu.device import chip_peak_flops
+    return chip_peak_flops(device)
 
 
 def _emit(row):
@@ -50,7 +43,14 @@ def _emit(row):
 
 def _timed_steps(step, iters, *stacked):
     """Shared protocol: warm-compile + warm-shape run, then timed
-    run_steps launches (best of 2) with a host-read fence."""
+    run_steps launches (best of 2) with a host-read fence. Attaches a
+    profiler.StepMonitor to the TrainStep so every row also carries
+    measured HBM peak + recompile count alongside the analytic MFU."""
+    from paddle_tpu.device import reset_max_memory_allocated
+    from paddle_tpu.profiler import StepMonitor
+    reset_max_memory_allocated()   # row-scoped peak, not process-cumulative
+    mon = StepMonitor()
+    step.monitor = mon
     losses = step.run_steps(iters, *stacked)
     _ = float(losses.numpy()[-1])
     dt = float("inf")
@@ -59,7 +59,20 @@ def _timed_steps(step, iters, *stacked):
         losses = step.run_steps(iters, *stacked)
         final = float(losses.numpy()[-1])
         dt = min(dt, time.perf_counter() - t0)
-    return dt, final
+    return dt, final, mon
+
+
+def _mon_fields(mon):
+    """StepMonitor fields merged into a bench row's `extra`: measured peak
+    HBM and the recompile count ride along with every row. The monitor's
+    own step-time/MFU are NOT used here — its run_steps walls measure
+    launch dispatch, while the row's step_ms/mfu come from the fenced
+    protocol (_timed_steps), which stays the authoritative figure."""
+    if mon is None:
+        return {}
+    r = mon.report()
+    return {"hbm_peak_bytes": r["hbm_peak_bytes"],
+            "recompiles": r["recompiles"]}
 
 
 def _channels_last_ctx(on_tpu):
@@ -110,7 +123,7 @@ def bench_resnet50(on_tpu):
         prev_fuse = os.environ.get("PADDLE_TPU_FUSE_SMALL_UPDATES")
         os.environ.setdefault("PADDLE_TPU_FUSE_SMALL_UPDATES", "4096")
         try:
-            dt, final = _timed_steps(step, iters, imgs, lbls)
+            dt, final, mon = _timed_steps(step, iters, imgs, lbls)
         finally:
             if prev_fuse is None:
                 os.environ.pop("PADDLE_TPU_FUSE_SMALL_UPDATES", None)
@@ -133,7 +146,8 @@ def bench_resnet50(on_tpu):
         "extra": {"mfu": round(mfu, 4),
                   "step_ms": round(dt / iters * 1e3, 2),
                   "channels_last": use_cl,
-                  "loss": round(final, 4)},
+                  "loss": round(final, 4),
+                  **_mon_fields(mon)},
     })
 
 
@@ -169,7 +183,7 @@ def bench_bert(on_tpu, preset=None, B=None):
                                        (iters, B, S)).astype("int32"))
     lbl = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
                                        (iters, B, S)).astype("int64"))
-    dt, final = _timed_steps(step, iters, ids, lbl)
+    dt, final, mon = _timed_steps(step, iters, ids, lbl)
     tps = B * S * iters / dt
     n = sum(p.size for p in model.parameters())
     fpt = 6 * n + 12 * cfg.num_layers * cfg.hidden_size * S
@@ -180,7 +194,8 @@ def bench_bert(on_tpu, preset=None, B=None):
         "vs_baseline": round(fpt * tps / peak / 0.70, 4),
         "extra": {"mfu": round(fpt * tps / peak, 4),
                   "step_ms": round(dt / iters * 1e3, 2),
-                  "loss": round(final, 4), "params": n},
+                  "loss": round(final, 4), "params": n,
+                  **_mon_fields(mon)},
     })
 
 
@@ -298,7 +313,7 @@ def bench_gpt(on_tpu, preset=None, B=None, S=None, recompute=None,
     losses = step.run_steps(2, paddle.to_tensor(stacked._data[:2]),
                             paddle.to_tensor(stacked._data[:2]))
     _ = float(losses.numpy()[-1])
-    dt, final_loss = _timed_steps(step, iters, stacked, stacked)
+    dt, final_loss, mon = _timed_steps(step, iters, stacked, stacked)
 
     tokens_per_sec = B * S * iters / dt
     n_params = sum(p.size for p in model.parameters())
@@ -313,7 +328,8 @@ def bench_gpt(on_tpu, preset=None, B=None, S=None, recompute=None,
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.70, 4),
         "extra": {"mfu": round(mfu, 4), "step_ms": round(dt / iters * 1e3, 2),
-                  "loss": round(final_loss, 4), "params": n_params},
+                  "loss": round(final_loss, 4), "params": n_params,
+                  **_mon_fields(mon)},
     })
 
 
@@ -371,7 +387,7 @@ def bench_moe(on_tpu, cf=None):
         rng = np.random.RandomState(0)
         ids = paddle.to_tensor(rng.randint(
             0, cfg.vocab_size, (iters, B, S)).astype("int32"))
-        dt, final = _timed_steps(st, iters, ids, ids)
+        dt, final, mon = _timed_steps(st, iters, ids, ids)
         # measured (token, slot) drop rate at the TRAINED router state
         # (ADVICE r5: the capacity_factor disclosure needs the drop rate it
         # trades against): one eager forward with the telemetry recorder on
@@ -402,13 +418,13 @@ def bench_moe(on_tpu, cf=None):
                                            * n_moe_layers
                                            if num_experts else 0)
         fpt = 6 * n_active + 12 * L * H * S
-        res = (dt, final, n, n_active, fpt, drop)
+        res = (dt, final, n, n_active, fpt, drop, mon)
         if num_experts == 0:
             _MOE_DENSE_CACHE[dense_key] = res
         return res
 
-    dt_m, loss_m, n_m, act_m, fpt_m, drop_rate = run(8)
-    dt_d, _, _, _, fpt_d, _ = run(0)
+    dt_m, loss_m, n_m, act_m, fpt_m, drop_rate, mon_m = run(8)
+    dt_d, _, _, _, fpt_d, _, _ = run(0)
     tps_m = B * S * iters / dt_m
     tps_d = B * S * iters / dt_d
     peak = _chip_peak_flops(jax.devices()[0])
@@ -435,7 +451,8 @@ def bench_moe(on_tpu, cf=None):
                   # the capacity knob trades against padding compute
                   "drop_rate_pct": (None if drop_rate is None
                                     else round(drop_rate * 100, 2)),
-                  "params_total": n_m, "params_active": act_m},
+                  "params_total": n_m, "params_active": act_m,
+                  **_mon_fields(mon_m)},
     })
 
 
@@ -562,7 +579,7 @@ def bench_vit(on_tpu, preset=None, B=None):
     imgs = paddle.to_tensor(np.random.randn(iters, B, 3, hw, hw).astype(
         "bfloat16" if on_tpu else "float32"))
     lbls = paddle.to_tensor(np.random.randint(0, 1000, (iters, B)).astype("int64"))
-    dt, final = _timed_steps(step, iters, imgs, lbls)
+    dt, final, mon = _timed_steps(step, iters, imgs, lbls)
     ips = B * iters / dt
     n = sum(p.size for p in model.parameters())
     seq = cfg.num_patches + 1
@@ -575,7 +592,8 @@ def bench_vit(on_tpu, preset=None, B=None):
         "vs_baseline": round(fpi * ips / peak / 0.70, 4),
         "extra": {"mfu": round(fpi * ips / peak, 4),
                   "step_ms": round(dt / iters * 1e3, 2),
-                  "loss": round(final, 4), "params": n},
+                  "loss": round(final, 4), "params": n,
+                  **_mon_fields(mon)},
     })
 
 
@@ -615,7 +633,7 @@ def bench_swin(on_tpu):
         ncls = 1000 if on_tpu else 10
         lbls = paddle.to_tensor(
             np.random.randint(0, ncls, (iters, B)).astype("int64"))
-        dt, final = _timed_steps(step, iters, imgs, lbls)
+        dt, final, mon = _timed_steps(step, iters, imgs, lbls)
     finally:
         paddle.set_flags({"FLAGS_conv_channels_last": prev_cl})
     ips = B * iters / dt
@@ -638,7 +656,8 @@ def bench_swin(on_tpu):
         "extra": {"mfu": None if mfu is None else round(mfu, 4),
                   "step_ms": round(dt / iters * 1e3, 2),
                   "channels_last": use_cl,
-                  "loss": round(final, 4)},
+                  "loss": round(final, 4),
+                  **_mon_fields(mon)},
     })
 
 

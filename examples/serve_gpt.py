@@ -74,6 +74,19 @@ def main():
     dr = model.decode_static(str_, max_new_tokens=new)
     assert (dr.numpy() == r.numpy()[:, cap:]).all()
     print("ragged prefix-reuse: per-row greedy parity OK")
+
+    # 6. /metrics-style stats dump: the payload a serving frontend scrapes.
+    # A StepMonitor brackets live decode launches — steady tokens/s, device
+    # memory, and the recompile counter (a shape-unstable serving loop shows
+    # up here immediately).
+    from paddle_tpu.profiler import StepMonitor
+    mon = StepMonitor(unit="tokens/s")
+    for _ in range(3):
+        with mon.step(items=B * new):
+            out = model.generate_static(ids, max_new_tokens=new)
+            _ = out.numpy()
+    print("---- /metrics ----")
+    print(mon.metrics_text(), end="")
     print("OK")
 
 

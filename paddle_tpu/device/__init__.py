@@ -58,6 +58,122 @@ def is_compiled_with_cuda() -> bool:
     return False
 
 
+# -------- accelerator capability + memory telemetry ------------------------
+
+# bf16 peak matmul FLOP/s per chip by TPU generation (public spec sheets) —
+# the denominator of every MFU figure (bench.py, profiler.StepMonitor)
+_PEAK_FLOPS = {"v2": 46e12, "v3": 123e12, "v4": 275e12,
+               "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
+               "v5p": 459e12, "v6e": 918e12, "v6p": 918e12}
+
+
+def chip_peak_flops(device=None) -> float:
+    """Peak bf16 matmul FLOP/s of one chip (assumes v4 when unknown)."""
+    d = device if device is not None else (_current[0] or jax.devices()[0])
+    kind = getattr(d, "device_kind", "").lower()
+    for key, val in _PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 275e12
+
+
+# observed peak live bytes per device id — the fallback tracker for
+# runtimes whose allocator exposes no peak (CPU host platform); on TPU the
+# allocator's own peak_bytes_in_use wins. _peak_baseline records the
+# allocator's CUMULATIVE peak at the last reset so max_memory_allocated
+# can report a since-reset figure even though XLA's counter never resets.
+_observed_peak = {}
+_peak_baseline = {}
+_has_alloc_stats = {}
+
+
+def has_allocator_stats(device=None) -> bool:
+    """Whether the runtime exposes real allocator counters for this device
+    (cached probe — callers use it to pick a sampling rate for the
+    live-array fallback, which scans every live buffer)."""
+    d = device if device is not None else (_current[0] or jax.devices()[0])
+    cached = _has_alloc_stats.get(d.id)
+    if cached is None:
+        try:
+            cached = d.memory_stats() is not None
+        except Exception:
+            cached = False
+        _has_alloc_stats[d.id] = cached
+    return cached
+
+
+def memory_stats(device=None) -> dict:
+    """Allocator statistics for one device (reference:
+    paddle.device.cuda.memory_stats; here the XLA allocator).
+
+    TPU: the runtime's own counters (bytes_in_use, peak_bytes_in_use,
+    bytes_limit, ...). Host-platform fallback (no allocator stats): live
+    bytes are summed over jax.live_arrays() placed on the device — an
+    approximation (sharded arrays count full size), with the peak tracked
+    across memory_stats() calls."""
+    d = device if device is not None else (_current[0] or jax.devices()[0])
+    stats = None
+    try:
+        stats = d.memory_stats()
+    except Exception:
+        stats = None
+    if stats is None:
+        live = 0
+        try:
+            for a in jax.live_arrays():
+                try:
+                    if d in a.devices():
+                        live += a.nbytes
+                except Exception:
+                    continue
+        except Exception:
+            pass
+        peak = max(_observed_peak.get(d.id, 0), live)
+        _observed_peak[d.id] = peak
+        stats = {"bytes_in_use": live, "peak_bytes_in_use": peak,
+                 "source": "live_arrays"}
+    else:
+        stats = dict(stats)
+        # since-reset peak: XLA's peak_bytes_in_use is process-cumulative;
+        # after reset_max_memory_allocated it only counts if a NEW
+        # high-water mark was set, else the live figure stands in
+        raw_peak = stats.get("peak_bytes_in_use", 0)
+        base = _peak_baseline.get(d.id, 0)
+        eff = raw_peak if raw_peak > base else stats.get("bytes_in_use", 0)
+        peak = max(_observed_peak.get(d.id, 0), eff,
+                   stats.get("bytes_in_use", 0))
+        _observed_peak[d.id] = peak
+        stats["peak_bytes_in_use"] = peak
+        stats.setdefault("source", "allocator")
+    return stats
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak device bytes in use (reference:
+    paddle.device.cuda.max_memory_allocated)."""
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_allocated(device=None) -> int:
+    """Current device bytes in use."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def reset_max_memory_allocated(device=None):
+    """Start a new peak-tracking window (reference:
+    paddle.device.cuda.reset_max_memory_allocated): clears the tracked
+    peak and, on allocator-backed runtimes, baselines XLA's cumulative
+    counter so only a NEW high-water mark counts after this call."""
+    d = device if device is not None else (_current[0] or jax.devices()[0])
+    _observed_peak.pop(d.id, None)
+    try:
+        alloc = d.memory_stats()
+    except Exception:
+        alloc = None
+    _peak_baseline[d.id] = (alloc or {}).get("peak_bytes_in_use", 0)
+    return memory_stats(d)
+
+
 class Stream:
     """Compat no-op: XLA has no user-visible streams; ordering is program
     order (replaces reference stream/event machinery,

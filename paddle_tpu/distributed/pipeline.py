@@ -303,8 +303,11 @@ def pipeline_spmd(stage_fn: Callable, stacked_params, x_microbatches,
 
     buf0 = jnp.zeros((S,) + x_microbatches.shape[1:], x_microbatches.dtype)
     outs0 = jnp.zeros_like(x_microbatches)
+    # tick counters stay s32: with x64 on, an s64 scatter index reaches the
+    # transpose-of-dynamic_update_slice as s64 while SPMD partitioning emits
+    # s32 offsets — the HLO verifier rejects the mixed compare
     (buf, outs), _ = lax.scan(tick, (_shard_stagewise(buf0, axis), outs0),
-                              jnp.arange(T))
+                              jnp.arange(T, dtype=jnp.int32))
     return outs
 
 
@@ -358,7 +361,7 @@ def pipeline_scan(stage_fn: Callable, stacked_params, x_microbatches,
             buf = lax.ppermute(act, axis, perm)
             return (buf, outs), None
 
-        (buf, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(T))
+        (buf, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(T, dtype=jnp.int32))
         # broadcast final outputs from last stage to all (so out_specs can
         # be replicated); psum of one-hot contribution
         contrib = jnp.where(sid == S - 1, outs, jnp.zeros_like(outs))
@@ -457,7 +460,7 @@ def pipeline_scan_interleaved(stage_fn: Callable, stacked_params,
 
         init = (jnp.zeros(xs.shape[1:], xs.dtype), jnp.int32(-1),
                 jnp.int32(-1), jnp.int32(0), jnp.zeros_like(xs))
-        (_, _, _, _, outs), _ = lax.scan(tick, init, jnp.arange(T))
+        (_, _, _, _, outs), _ = lax.scan(tick, init, jnp.arange(T, dtype=jnp.int32))
         contrib = jnp.where(sid == S - 1, outs, jnp.zeros_like(outs))
         return lax.psum(contrib, axis)
 

@@ -9,7 +9,7 @@ import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
            "EarlyStopping", "LRScheduler", "ReduceLROnPlateau", "VisualDL",
-           "config_callbacks"]
+           "ProfilerCallback", "config_callbacks"]
 
 
 class Callback:
@@ -271,6 +271,47 @@ class VisualDL(Callback):
         self._step += 1
         with open(os.path.join(self.log_dir, "train.jsonl"), "a") as f:
             f.write(json.dumps(rec) + "\n")
+
+
+class ProfilerCallback(Callback):
+    """Drives the observability layer through Model.fit (reference analog:
+    paddle.profiler used as a fit callback).
+
+    `profiler`: a paddle_tpu.profiler.Profiler — started at train begin,
+    stepped per batch (its scheduler decides when the device trace
+    records), stopped at train end.
+    `monitor`: a profiler.StepMonitor — brackets every train batch, so fit
+    runs get step-time/MFU/HBM/recompile telemetry (and its JSONL export /
+    on_report hook) with zero changes to the training loop. The monitor's
+    report() is printed at train end when `summary=True`."""
+
+    def __init__(self, profiler=None, monitor=None, summary=True):
+        super().__init__()
+        self.profiler = profiler
+        self.monitor = monitor
+        self.summary = summary
+
+    def on_train_begin(self, logs=None):
+        if self.profiler is not None:
+            self.profiler.start()
+
+    def on_train_batch_begin(self, step, logs=None):
+        if self.monitor is not None:
+            self.monitor.begin_step()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.monitor is not None:
+            self.monitor.end_step()
+        if self.profiler is not None:
+            self.profiler.step()
+
+    def on_train_end(self, logs=None):
+        if self.profiler is not None:
+            self.profiler.stop()
+        if self.monitor is not None and self.summary:
+            import json
+            print("StepMonitor: " + json.dumps(self.monitor.report()),
+                  flush=True)
 
 
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,

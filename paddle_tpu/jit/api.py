@@ -26,6 +26,19 @@ from ..core.dtype import convert_dtype
 
 _trace_state = threading.local()
 
+# process-wide compile-cache miss counter (StaticFunction + TrainStep feed
+# it; profiler.StepMonitor reads the per-step delta)
+_compile_cache_misses = [0]
+
+
+def compile_cache_misses() -> int:
+    """Total jit compile-cache misses (new trace signatures) this process."""
+    return _compile_cache_misses[0]
+
+
+def _note_cache_miss():
+    _compile_cache_misses[0] += 1
+
 
 def _in_jit_trace() -> bool:
     return getattr(_trace_state, "depth", 0) > 0
@@ -151,6 +164,7 @@ class StaticFunction:
 
         entry = self._cache.get(cache_key)
         if entry is None:
+            _note_cache_miss()
             fn = self._fn
             out_treedef_box = []
 

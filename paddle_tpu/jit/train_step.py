@@ -12,6 +12,8 @@ single code path (SURVEY §7 design mapping).
 """
 from __future__ import annotations
 
+import logging
+import time
 from typing import Callable, Dict, Optional
 
 import numpy as np
@@ -22,7 +24,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.tensor import Tensor, Parameter
 from ..core import random as _random
 from ..core import autograd
-from .api import _swap_params, _trace_guard, _tree_unwrap, _tree_wrap
+from .api import (_swap_params, _trace_guard, _tree_unwrap, _tree_wrap,
+                  _note_cache_miss)
+
+_logger = logging.getLogger("paddle_tpu.jit.train_step")
 
 
 def _spec_or_replicated(p):
@@ -57,7 +62,8 @@ class TrainStep:
     """
 
     def __init__(self, model, optimizer, loss_fn: Callable, mesh: Optional[Mesh] = None,
-                 data_axes=("dp",), donate: bool = True, grad_accum_steps: int = 1):
+                 data_axes=("dp",), donate: bool = True, grad_accum_steps: int = 1,
+                 monitor=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -65,8 +71,12 @@ class TrainStep:
         self.data_axes = data_axes
         self.donate = donate
         self.grad_accum_steps = grad_accum_steps
+        # profiler.StepMonitor: per-step wall/MFU/HBM telemetry + the
+        # recompilation detector (assignable after construction too)
+        self.monitor = monitor
         self._step_i = 0
         self._compiled = {}
+        self._last_sig = {}     # kind -> last compiled shape signature
 
         self._param_names, self._params = [], []
         for name, p in model.named_parameters():
@@ -342,6 +352,23 @@ class TrainStep:
         return pure_step
 
     # ------------------------------------------------------------------
+    def _on_compile(self, kind: str, sig):
+        """Compile-cache miss bookkeeping: feed the global jit miss counter
+        and the recompilation detector — a second compile of the same kind
+        means the abstract shape signature changed, and the delta names the
+        offending leaf (the thing you want when a training loop silently
+        recompiles every step)."""
+        _note_cache_miss()
+        prev = self._last_sig.get(kind)
+        self._last_sig[kind] = sig
+        if self.monitor is not None:
+            self.monitor.record_compile(kind, sig, prev_sig=prev)
+        elif prev is not None and prev != sig:
+            from ..profiler.monitor import shape_delta
+            _logger.warning("recompilation of %s: %s", kind,
+                            shape_delta(prev, sig))
+
+    # ------------------------------------------------------------------
     def loss_and_grad_norm(self, *batch, key=None):
         """(loss, global grad norm) WITHOUT updating — the distributed-vs-
         single-device parity probe (reference strategy: test_dist_base.py:899
@@ -494,6 +521,10 @@ class TrainStep:
                    tuple((tuple(a.shape), str(a.dtype)) for a in flat))
         compiled = self._compiled.get((treedef, key_sig))
         if compiled is None:
+            # scan length is part of the kind: different n_steps is a
+            # deliberately different executable (warmup vs timed runs),
+            # not shape instability — only same-length re-traces count
+            self._on_compile(f"train_step.run_steps[n={n_steps}]", key_sig)
             compiled = self._build_scan(treedef, n_steps)
             self._compiled[(treedef, key_sig)] = compiled
         lr = jnp.float32(self.optimizer.get_lr())
@@ -501,9 +532,16 @@ class TrainStep:
         if self.mesh is not None:
             flat = [self._to_global(a, P(None, *self.data_axes))
                     if a.ndim > 1 else a for a in flat]
+        t0 = time.perf_counter() if self.monitor is not None else None
         losses, new_params, new_state = compiled(
             tuple(p._data for p in self._params), tuple(self._opt_state),
             jnp.int32(self._step_i + 1), lr, key, *flat)
+        if self.monitor is not None:
+            # launch wall time (includes waiting on the previous launch's
+            # donated buffers — the steady-state device rate from the 2nd
+            # launch on; fence with a host read for an exact figure)
+            self.monitor.end_step(steps=n_steps,
+                                  wall_s=time.perf_counter() - t0)
         self._step_i += n_steps
         for p, na in zip(self._params, new_params):
             p._data = na
@@ -520,6 +558,7 @@ class TrainStep:
         key_sig = tuple((tuple(a.shape), str(a.dtype)) for a in flat)
         compiled = self._compiled.get((treedef, key_sig))
         if compiled is None:
+            self._on_compile("train_step", key_sig)
             compiled = self._build(treedef, [a.ndim for a in flat])
             self._compiled[(treedef, key_sig)] = compiled
 
@@ -529,9 +568,12 @@ class TrainStep:
         if self.mesh is not None:
             flat = [self._to_global(a, P(*self.data_axes))
                     if a.ndim > 0 else a for a in flat]
+        t0 = time.perf_counter() if self.monitor is not None else None
         loss, new_params, new_state = compiled(
             tuple(p._data for p in self._params), tuple(self._opt_state),
             jnp.int32(self._step_i), lr, key, *flat)
+        if self.monitor is not None:
+            self.monitor.end_step(wall_s=time.perf_counter() - t0)
 
         for p, na in zip(self._params, new_params):
             p._data = na

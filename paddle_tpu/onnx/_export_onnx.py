@@ -10,11 +10,15 @@ TPU-native StableHLO artifact instead (paddle_tpu.inference serves it).
 
 The schema is compiled on first use from onnx_subset.proto (the public
 ONNX wire contract, subset) via protoc into real protobuf bindings — no
-hand-rolled wire encoding.
+hand-rolled wire encoding. Where the protoc BINARY is absent (the python
+google.protobuf runtime alone is enough), the same schema is built at
+runtime as a FileDescriptorProto + message_factory — identical messages,
+identical wire bytes, no generated code on disk.
 """
 from __future__ import annotations
 
 import os
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -25,10 +29,23 @@ _PB = None
 
 
 def _proto():
-    """Compile + import the ONNX subset schema (cached per process)."""
+    """The ONNX subset schema bindings (cached per process): protoc-generated
+    when the binary exists, runtime-descriptor-built otherwise."""
     global _PB
     if _PB is not None:
         return _PB
+    if shutil.which("protoc"):
+        try:
+            _PB = _proto_protoc()
+            return _PB
+        except Exception:
+            pass
+    _PB = _proto_runtime()
+    return _PB
+
+
+def _proto_protoc():
+    """Compile + import the schema via the protoc binary."""
     here = os.path.dirname(os.path.abspath(__file__))
     out = os.path.join(tempfile.gettempdir(),
                        f"ptpu_onnx_pb_{os.getuid()}")
@@ -44,8 +61,135 @@ def _proto():
     if out not in sys.path:
         sys.path.insert(0, out)
     import onnx_subset_pb2 as PB  # noqa: E402
-    _PB = PB
     return PB
+
+
+class _Namespace:
+    pass
+
+
+def _proto_runtime():
+    """Pure-python bindings: the onnx_subset.proto schema expressed as a
+    FileDescriptorProto (field numbers ARE the normative ONNX wire
+    contract — keep in lockstep with the .proto file), realized through
+    google.protobuf.message_factory."""
+    from google.protobuf import descriptor_pb2 as dpb
+    from google.protobuf import message_factory
+
+    F = dpb.FieldDescriptorProto
+    pkg = "paddle_tpu_onnx"
+    ref = f".{pkg}."
+    f = dpb.FileDescriptorProto(name="onnx_subset_runtime.proto",
+                                package=pkg, syntax="proto3")
+
+    def field(m, name, num, ftype, repeated=False, type_name=None,
+              oneof=None):
+        fd = m.field.add()
+        fd.name, fd.number, fd.type = name, num, ftype
+        fd.label = F.LABEL_REPEATED if repeated else F.LABEL_OPTIONAL
+        if type_name:
+            fd.type_name = type_name
+        if oneof is not None:
+            fd.oneof_index = oneof
+
+    def enum(m, name, values):
+        e = m.enum_type.add()
+        e.name = name
+        for i, nm in enumerate(values):
+            v = e.value.add()
+            v.name, v.number = nm, i
+
+    a = f.message_type.add(); a.name = "AttributeProto"  # noqa: E702
+    enum(a, "AttributeType", ("UNDEFINED", "FLOAT", "INT", "STRING",
+                              "TENSOR", "GRAPH", "FLOATS", "INTS",
+                              "STRINGS"))
+    field(a, "name", 1, F.TYPE_STRING)
+    field(a, "f", 2, F.TYPE_FLOAT)
+    field(a, "i", 3, F.TYPE_INT64)
+    field(a, "s", 4, F.TYPE_BYTES)
+    field(a, "t", 5, F.TYPE_MESSAGE, type_name=ref + "TensorProto")
+    field(a, "floats", 7, F.TYPE_FLOAT, repeated=True)
+    field(a, "ints", 8, F.TYPE_INT64, repeated=True)
+    field(a, "strings", 9, F.TYPE_BYTES, repeated=True)
+    field(a, "type", 20, F.TYPE_ENUM,
+          type_name=ref + "AttributeProto.AttributeType")
+
+    vi = f.message_type.add(); vi.name = "ValueInfoProto"  # noqa: E702
+    field(vi, "name", 1, F.TYPE_STRING)
+    field(vi, "type", 2, F.TYPE_MESSAGE, type_name=ref + "TypeProto")
+
+    nd = f.message_type.add(); nd.name = "NodeProto"  # noqa: E702
+    field(nd, "input", 1, F.TYPE_STRING, repeated=True)
+    field(nd, "output", 2, F.TYPE_STRING, repeated=True)
+    field(nd, "name", 3, F.TYPE_STRING)
+    field(nd, "op_type", 4, F.TYPE_STRING)
+    field(nd, "attribute", 5, F.TYPE_MESSAGE, repeated=True,
+          type_name=ref + "AttributeProto")
+    field(nd, "doc_string", 6, F.TYPE_STRING)
+    field(nd, "domain", 7, F.TYPE_STRING)
+
+    mo = f.message_type.add(); mo.name = "ModelProto"  # noqa: E702
+    field(mo, "ir_version", 1, F.TYPE_INT64)
+    field(mo, "producer_name", 2, F.TYPE_STRING)
+    field(mo, "producer_version", 3, F.TYPE_STRING)
+    field(mo, "domain", 4, F.TYPE_STRING)
+    field(mo, "model_version", 5, F.TYPE_INT64)
+    field(mo, "doc_string", 6, F.TYPE_STRING)
+    field(mo, "graph", 7, F.TYPE_MESSAGE, type_name=ref + "GraphProto")
+    field(mo, "opset_import", 8, F.TYPE_MESSAGE, repeated=True,
+          type_name=ref + "OperatorSetIdProto")
+
+    g = f.message_type.add(); g.name = "GraphProto"  # noqa: E702
+    field(g, "node", 1, F.TYPE_MESSAGE, repeated=True,
+          type_name=ref + "NodeProto")
+    field(g, "name", 2, F.TYPE_STRING)
+    field(g, "initializer", 5, F.TYPE_MESSAGE, repeated=True,
+          type_name=ref + "TensorProto")
+    field(g, "doc_string", 10, F.TYPE_STRING)
+    field(g, "input", 11, F.TYPE_MESSAGE, repeated=True,
+          type_name=ref + "ValueInfoProto")
+    field(g, "output", 12, F.TYPE_MESSAGE, repeated=True,
+          type_name=ref + "ValueInfoProto")
+    field(g, "value_info", 13, F.TYPE_MESSAGE, repeated=True,
+          type_name=ref + "ValueInfoProto")
+
+    t = f.message_type.add(); t.name = "TensorProto"  # noqa: E702
+    enum(t, "DataType", ("UNDEFINED", "FLOAT", "UINT8", "INT8", "UINT16",
+                         "INT16", "INT32", "INT64", "STRING", "BOOL",
+                         "FLOAT16", "DOUBLE"))
+    field(t, "dims", 1, F.TYPE_INT64, repeated=True)
+    field(t, "data_type", 2, F.TYPE_INT32)
+    field(t, "float_data", 4, F.TYPE_FLOAT, repeated=True)
+    field(t, "int32_data", 5, F.TYPE_INT32, repeated=True)
+    field(t, "int64_data", 7, F.TYPE_INT64, repeated=True)
+    field(t, "name", 8, F.TYPE_STRING)
+    field(t, "raw_data", 9, F.TYPE_BYTES)
+
+    ts = f.message_type.add(); ts.name = "TensorShapeProto"  # noqa: E702
+    dim = ts.nested_type.add(); dim.name = "Dimension"  # noqa: E702
+    od = dim.oneof_decl.add(); od.name = "value"  # noqa: E702
+    field(dim, "dim_value", 1, F.TYPE_INT64, oneof=0)
+    field(dim, "dim_param", 2, F.TYPE_STRING, oneof=0)
+    field(ts, "dim", 1, F.TYPE_MESSAGE, repeated=True,
+          type_name=ref + "TensorShapeProto.Dimension")
+
+    tp = f.message_type.add(); tp.name = "TypeProto"  # noqa: E702
+    tpt = tp.nested_type.add(); tpt.name = "Tensor"  # noqa: E702
+    field(tpt, "elem_type", 1, F.TYPE_INT32)
+    field(tpt, "shape", 2, F.TYPE_MESSAGE,
+          type_name=ref + "TensorShapeProto")
+    field(tp, "tensor_type", 1, F.TYPE_MESSAGE,
+          type_name=ref + "TypeProto.Tensor")
+
+    op = f.message_type.add(); op.name = "OperatorSetIdProto"  # noqa: E702
+    field(op, "domain", 1, F.TYPE_STRING)
+    field(op, "version", 2, F.TYPE_INT64)
+
+    msgs = message_factory.GetMessages([f])
+    ns = _Namespace()
+    for full_name, cls in msgs.items():
+        setattr(ns, full_name.rsplit(".", 1)[-1], cls)
+    return ns
 
 
 def _np(t):

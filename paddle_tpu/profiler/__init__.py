@@ -154,6 +154,7 @@ class Profiler:
         self._device_tracing = False
         self._host_events = []
         self._step_times = []
+        self._recorded_steps = 0
         self._step_t0 = None
         self._last_export = None
 
@@ -181,6 +182,10 @@ class Profiler:
         now = time.perf_counter()
         if self._step_t0 is not None:
             self._step_times.append(now - self._step_t0)
+        if self._recording:
+            # the step just closed ran under RECORD — these are the steps
+            # inside the device capture (summary's per-step denominator)
+            self._recorded_steps += num_steps
         self._step_t0 = now
         self.step_num += num_steps
         new_state = self._scheduler(self.step_num)
@@ -195,6 +200,10 @@ class Profiler:
                                       ProfilerState.RECORD_AND_RETURN)
         if want_record and not self._recording:
             self._recording = True
+            # new capture window: each RECORD phase writes its own trace
+            # dump and summary(views=) parses only the newest, so the
+            # per-step denominator restarts with it
+            self._recorded_steps = 0
             if not self.timer_only:
                 try:
                     os.makedirs(self._trace_dir, exist_ok=True)
@@ -217,9 +226,33 @@ class Profiler:
 
     # -- reporting ------------------------------------------------------
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms") -> str:
-        """Host-event summary table (reference: profiler_statistic.py
-        summaries; device-op breakdown lives in the exported XLA trace)."""
+                time_unit="ms", views=None, steps=None) -> str:
+        """Summary tables (reference: profiler_statistic.py summaries).
+
+        Default: host-event table. With `views` (SummaryView members or
+        names), device-trace tables are parsed from the capture under
+        `trace_dir` via profiler.trace_analysis — KernelView gives per-op
+        device time, DeviceView per-lane busy + category split,
+        DistributedView collectives + the compute/comm overlap ratio.
+        `steps` divides device totals into per-step figures (defaults to
+        the steps counted while recording)."""
+        if views is not None:
+            from . import trace_analysis
+            want = views if isinstance(views, (list, tuple)) else [views]
+            parts = []
+            device_views = [v for v in want
+                            if getattr(v, "name", str(v)) != "OverView"]
+            if any(getattr(v, "name", str(v)) == "OverView" for v in want):
+                parts.append(self.summary(time_unit=time_unit))
+            if device_views:
+                if steps is None and self._recorded_steps:
+                    steps = self._recorded_steps
+                try:
+                    parts.append(trace_analysis.summarize(
+                        self._trace_dir, views=device_views, steps=steps))
+                except FileNotFoundError as e:
+                    parts.append(f"(no device trace: {e})")
+            return "\n\n".join(parts)
         unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
         agg = defaultdict(lambda: [0, 0.0])
         for e in self._host_events:
@@ -281,3 +314,58 @@ def load_profiler_result(filename: str):
     import json
     with open(filename) as f:
         return json.load(f)
+
+
+# -- module-scoped tracing ----------------------------------------------
+
+
+class _AnnotationHandle:
+    """Returned by annotate_layers; .remove() restores original forwards."""
+
+    def __init__(self, entries, paths):
+        self._entries = entries
+        self.paths = paths
+
+    def remove(self):
+        for layer, prev in self._entries:
+            if prev is None:
+                layer.__dict__.pop("forward", None)
+            else:
+                layer.__dict__["forward"] = prev
+        self._entries = []
+
+
+def annotate_layers(model, root: str = None) -> _AnnotationHandle:
+    """Wrap every sublayer's forward in a jax.profiler.TraceAnnotation named
+    by its qualified layer path (e.g. `ResNet/layer1/0/conv1`) so device
+    traces attribute op time to model modules — the XLA trace viewer nests
+    ops under these scopes, and trace_analysis sees them as lanes.
+
+    Returns a handle: `.paths` lists the annotation names, `.remove()`
+    restores the original forwards (annotation adds a (cheap) host call per
+    layer per step — remove it outside profiling windows if the model is
+    sublayer-heavy)."""
+    root = root or type(model).__name__
+    entries, paths = [], []
+    for name, layer in model.named_sublayers(include_self=True):
+        path = root if not name else f"{root}/{name.replace('.', '/')}"
+        prev = layer.__dict__.get("forward")  # instance-level override, if any
+        fwd = layer.forward                   # bound method or override
+        if getattr(fwd, "_pt_annotation", None):
+            continue
+
+        def _make(f, p):
+            def annotated_forward(*args, **kwargs):
+                with jax.profiler.TraceAnnotation(p):
+                    return f(*args, **kwargs)
+            annotated_forward._pt_annotation = p
+            return annotated_forward
+
+        layer.__dict__["forward"] = _make(fwd, path)
+        entries.append((layer, prev))
+        paths.append(path)
+    return _AnnotationHandle(entries, paths)
+
+
+from .monitor import StepMonitor, shape_delta  # noqa: E402,F401
+from . import trace_analysis  # noqa: E402,F401

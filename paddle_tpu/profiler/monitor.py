@@ -1,0 +1,268 @@
+"""StepMonitor — always-on, low-overhead per-step training telemetry.
+
+The profiler (trace capture + trace_analysis) is the deep-dive tool; this is
+the steady-state gauge cluster a production run keeps on every step:
+
+  - per-step wall time and items/sec (tokens or images — caller configures
+    `items_per_step` or passes `items=` per step)
+  - achieved MFU against the chip's peak matmul FLOP/s
+    (paddle_tpu.device.chip_peak_flops)
+  - live/peak HBM via paddle_tpu.device.memory_stats()
+  - jit cache-miss counts and a RECOMPILATION DETECTOR: when a traced step
+    compiles again, the offending abstract-shape delta (old vs new
+    shape/dtype signature) is logged and recorded
+
+Each step appends one JSONL row when `jsonl_path` is set, and `on_report`
+(if given) is called with the row dict — the hook a metrics exporter or a
+live dashboard attaches to. `jit.TrainStep(monitor=...)` wires this in
+automatically; `hapi` exposes it as `callbacks.ProfilerCallback`.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from typing import Callable, Optional
+
+logger = logging.getLogger("paddle_tpu.profiler.monitor")
+
+
+def shape_delta(old_sig, new_sig) -> str:
+    """Human-readable delta between two abstract-shape signatures (tuples of
+    (shape, dtype) leaves) — the payload of a recompilation log line."""
+    if old_sig is None:
+        return "first compile"
+    old, new = list(old_sig), list(new_sig)
+    if len(old) != len(new):
+        return f"leaf count {len(old)} -> {len(new)}"
+    diffs = []
+    for i, (o, n) in enumerate(zip(old, new)):
+        if o != n:
+            diffs.append(f"leaf[{i}]: {o} -> {n}")
+    return "; ".join(diffs) if diffs else "signature changed (non-shape key)"
+
+
+def _jit_cache_misses() -> int:
+    from ..jit.api import compile_cache_misses
+    return compile_cache_misses()
+
+
+class StepMonitor:
+    """Record per-step metrics; see module docstring.
+
+    flops_per_step / flops_per_item: model FLOPs for the MFU figure (set
+    either; `flops_per_item` multiplies the per-step item count). May be
+    assigned after the run, before report() — MFU is computed at report
+    time. `peak_flops` defaults to the chip's bf16 peak.
+    """
+
+    def __init__(self, *, flops_per_step: Optional[float] = None,
+                 flops_per_item: Optional[float] = None,
+                 items_per_step: Optional[float] = None,
+                 unit: str = "items/s", peak_flops: Optional[float] = None,
+                 jsonl_path: Optional[str] = None,
+                 on_report: Optional[Callable[[dict], None]] = None,
+                 track_memory: bool = True,
+                 memory_sample_every: Optional[int] = None,
+                 log_recompiles: bool = True):
+        self.flops_per_step = flops_per_step
+        self.flops_per_item = flops_per_item
+        self.items_per_step = items_per_step
+        self.unit = unit
+        self.peak_flops = peak_flops
+        self.jsonl_path = jsonl_path
+        self.on_report = on_report
+        self.track_memory = track_memory
+        # allocator counters are cheap to read every step; the live-array
+        # fallback (host platforms) scans every live buffer, so it samples
+        # every 10th step unless overridden
+        self.memory_sample_every = memory_sample_every
+        self._mem_every = None
+        self.log_recompiles = log_recompiles
+        self.records = []          # one dict per end_step
+        self.compiles = 0          # traced-step compiles observed
+        self.recompiles = 0        # compiles beyond the first per kind
+        self.recompile_events = []  # {step, kind, delta}
+        self._steps = 0
+        self._t0 = None
+        self._jit_miss_0 = None
+        self._compiled_this_step = 0
+
+    # ------------------------------------------------------------- steps
+    def begin_step(self):
+        self._jit_miss_0 = _jit_cache_misses()
+        self._compiled_this_step = 0
+        self._t0 = time.perf_counter()
+
+    def end_step(self, items: Optional[float] = None, steps: int = 1,
+                 wall_s: Optional[float] = None):
+        """Close the step opened by begin_step (or record an externally
+        timed window via `wall_s`). `steps` > 1 amortizes one fused
+        multi-step launch (TrainStep.run_steps) over its step count."""
+        if wall_s is None:
+            if self._t0 is None:
+                return
+            wall_s = time.perf_counter() - self._t0
+        self._t0 = None
+        self._steps += steps
+        if items is None and self.items_per_step is not None:
+            items = self.items_per_step * steps
+        rec = {"step": self._steps, "wall_s": wall_s, "steps": steps,
+               "step_ms": wall_s / max(steps, 1) * 1e3,
+               "compiled": self._compiled_this_step > 0,
+               "recompiles_total": self.recompiles,
+               "ts": time.time()}
+        if items is not None:
+            rec["items"] = items
+            rec["items_per_s"] = items / wall_s if wall_s > 0 else None
+            mfu = self._mfu(items / max(steps, 1),
+                            wall_s / max(steps, 1))
+            if mfu is not None:
+                rec["mfu"] = round(mfu, 4)
+        if self._jit_miss_0 is not None:
+            rec["jit_cache_misses"] = _jit_cache_misses() - self._jit_miss_0
+        self._jit_miss_0 = None
+        self._compiled_this_step = 0
+        if self.track_memory and self._memory_due():
+            mem = self._memory()
+            if mem is not None:
+                rec["hbm_bytes_in_use"] = mem.get("bytes_in_use")
+                rec["hbm_peak_bytes"] = mem.get("peak_bytes_in_use")
+        self.records.append(rec)
+        if self.jsonl_path:
+            with open(self.jsonl_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        if self.on_report is not None:
+            self.on_report(rec)
+        return rec
+
+    @contextlib.contextmanager
+    def step(self, items: Optional[float] = None, steps: int = 1):
+        self.begin_step()
+        try:
+            yield self
+        finally:
+            self.end_step(items=items, steps=steps)
+
+    # ----------------------------------------------------------- compiles
+    def record_compile(self, kind: str, sig, prev_sig=None):
+        """Called by the traced-step owner on a compile-cache miss. A miss
+        with a prior signature is a RECOMPILE: log the shape delta."""
+        self.compiles += 1
+        self._compiled_this_step += 1
+        if prev_sig is not None:
+            self.recompiles += 1
+            delta = shape_delta(prev_sig, sig)
+            self.recompile_events.append(
+                {"step": self._steps + 1, "kind": kind, "delta": delta})
+            if self.log_recompiles:
+                logger.warning("recompilation of %s at step %d: %s",
+                               kind, self._steps + 1, delta)
+
+    # ------------------------------------------------------------ internals
+    def _peak(self) -> Optional[float]:
+        if self.peak_flops is not None:
+            return self.peak_flops
+        try:
+            from ..device import chip_peak_flops
+            self.peak_flops = chip_peak_flops()
+        except Exception:
+            self.peak_flops = None
+        return self.peak_flops
+
+    def _mfu(self, items_per_step, step_s) -> Optional[float]:
+        flops = self.flops_per_step
+        if flops is None and self.flops_per_item is not None \
+                and items_per_step is not None:
+            flops = self.flops_per_item * items_per_step
+        peak = self._peak()
+        if flops is None or peak is None or not step_s:
+            return None
+        return flops / step_s / peak
+
+    def _memory_due(self) -> bool:
+        if self._mem_every is None:
+            every = self.memory_sample_every
+            if every is None:
+                try:
+                    from ..device import has_allocator_stats
+                    every = 1 if has_allocator_stats() else 10
+                except Exception:
+                    every = 10
+            self._mem_every = max(1, int(every))
+        n = len(self.records) + 1   # this end_step call's ordinal
+        return n == 1 or n % self._mem_every == 0
+
+    def _memory(self) -> Optional[dict]:
+        try:
+            from ..device import memory_stats
+            return memory_stats()
+        except Exception:
+            return None
+
+    # ------------------------------------------------------------- report
+    def report(self) -> dict:
+        """Aggregate summary. Steady step time is the median over steps
+        with no compile in them (compile steps fold XLA compilation into
+        wall time and would poison the figure)."""
+        steady = [r for r in self.records if not r["compiled"]] or self.records
+        step_ms = sorted(r["step_ms"] for r in steady) if steady else []
+        med = step_ms[len(step_ms) // 2] if step_ms else None
+        items_s = None
+        tot_items = sum(r.get("items", 0) for r in steady)
+        tot_wall = sum(r["wall_s"] for r in steady)
+        if tot_items and tot_wall:
+            items_s = tot_items / tot_wall
+        mfu = self._mfu(
+            tot_items / max(sum(r["steps"] for r in steady), 1) if tot_items
+            else None,
+            med / 1e3 if med else None)
+        peak_hbm = max((r.get("hbm_peak_bytes") or 0 for r in self.records),
+                       default=0) or None
+        last_hbm = next((r.get("hbm_bytes_in_use") for r in
+                         reversed(self.records)
+                         if r.get("hbm_bytes_in_use") is not None), None)
+        return {"steps": self._steps,
+                "step_ms": round(med, 3) if med is not None else None,
+                "items_per_s": round(items_s, 1) if items_s else None,
+                "unit": self.unit,
+                "mfu": round(mfu, 4) if mfu is not None else None,
+                "hbm_bytes_in_use": last_hbm,
+                "hbm_peak_bytes": peak_hbm,
+                "compiles": self.compiles,
+                "recompiles": self.recompiles,
+                "jit_cache_misses": (
+                    sum(r.get("jit_cache_misses", 0) for r in self.records)
+                    if any("jit_cache_misses" in r for r in self.records)
+                    else None)}
+
+    def metrics_text(self, prefix: str = "paddle_tpu") -> str:
+        """Prometheus-exposition-style dump of report() — the `/metrics`
+        payload a serving endpoint returns."""
+        r = self.report()
+        lines = []
+
+        def gauge(name, val, help_):
+            if val is None:
+                return
+            lines.append(f"# HELP {prefix}_{name} {help_}")
+            lines.append(f"# TYPE {prefix}_{name} gauge")
+            lines.append(f"{prefix}_{name} {val}")
+
+        gauge("steps_total", r["steps"], "steps recorded")
+        if r["step_ms"] is not None:
+            gauge("step_seconds", round(r["step_ms"] / 1e3, 6),
+                  "median steady step wall time")
+        gauge("throughput", r["items_per_s"],
+              f"steady throughput ({r['unit']})")
+        gauge("mfu", r["mfu"], "achieved model FLOPs utilization")
+        gauge("hbm_bytes_in_use", r["hbm_bytes_in_use"],
+              "live device memory")
+        gauge("hbm_peak_bytes", r["hbm_peak_bytes"], "peak device memory")
+        gauge("compiles_total", r["compiles"], "traced-step compiles")
+        gauge("recompiles_total", r["recompiles"],
+              "recompilations (shape-signature changes)")
+        gauge("jit_cache_misses_total", r["jit_cache_misses"],
+              "jit compile-cache misses during monitored steps")
+        return "\n".join(lines) + "\n"

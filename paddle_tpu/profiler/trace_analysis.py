@@ -1,0 +1,296 @@
+"""Device-trace analytics — XLA trace.json.gz → op/collective summary tables.
+
+The reference profiler fuses a host tracer and a CUPTI device tracer into one
+event tree and renders op-level summary tables (profiler_statistic.py). Here
+the device tracer is jax.profiler: `jax.profiler.start_trace` captures an
+XPlane that lands on disk as a perfetto/chrome `*.trace.json.gz`. This module
+parses that capture into the same summary surface:
+
+  - KernelView:      per-op-name device-time totals (the only trustworthy
+                     per-component timing on remote-dispatch runtimes — host
+                     timers measure dispatch, not device work)
+  - DeviceView:      per-device-lane busy time + a fusion/collective/copy
+                     category split
+  - DistributedView: per-collective totals and the compute/communication
+                     overlap ratio (fraction of collective time hidden under
+                     device compute)
+
+Used by `Profiler.summary(views=...)` and the `tools/profile_step.py` CLI.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# op-name markers for the communication category (XLA HLO collective ops;
+# -start/-done async pairs share the prefix)
+_COLLECTIVE_MARKERS = ("all-reduce", "all-gather", "reduce-scatter",
+                       "all-to-all", "collective-permute",
+                       "collective-broadcast")
+_COPY_MARKERS = ("copy", "bitcast", "transpose", "reshape")
+
+
+def classify_op(name: str) -> str:
+    """Category of an XLA device op name: collective|fusion|copy|compute."""
+    low = name.lower()
+    if any(m in low for m in _COLLECTIVE_MARKERS):
+        return "collective"
+    if low.startswith("fusion") or ".fusion" in low or "_fusion" in low:
+        return "fusion"
+    if any(low.startswith(m) for m in _COPY_MARKERS):
+        return "copy"
+    return "compute"
+
+
+def find_trace_file(path: str) -> Optional[str]:
+    """Newest `*.trace.json.gz` (or `.trace.json`) under a file/dir path."""
+    if os.path.isfile(path):
+        return path
+    hits = []
+    for pat in ("**/*.trace.json.gz", "**/*.trace.json"):
+        hits.extend(glob.glob(os.path.join(path, pat), recursive=True))
+    return max(hits, key=os.path.getmtime) if hits else None
+
+
+def load_events(path: str) -> List[dict]:
+    """traceEvents list of a chrome-tracing capture (.json or .json.gz)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    return data.get("traceEvents", [])
+
+
+def _union(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Union of [start, end) intervals, sorted and merged."""
+    if not intervals:
+        return []
+    intervals = sorted(intervals)
+    out = [list(intervals[0])]
+    for s, e in intervals[1:]:
+        if s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return [(s, e) for s, e in out]
+
+def _overlap_us(a: List[Tuple[float, float]],
+                b: List[Tuple[float, float]]) -> float:
+    """Total length of the intersection of two merged interval lists."""
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+class TraceAnalysis:
+    """Parsed device lanes of one captured trace.
+
+    `steps` (optional) divides totals into per-step figures — the caller
+    knows how many training steps ran inside the capture. `window=(lo, hi)`
+    keeps only events whose start falls into that fraction of the capture
+    span (steady-window trimming: drop warmup/drain at the edges).
+    """
+
+    def __init__(self, events: Iterable[dict], steps: Optional[int] = None,
+                 window: Tuple[float, float] = (0.0, 1.0)):
+        events = list(events)   # two passes below; a generator would drain
+        self.steps = steps
+        self.pid_name: Dict[int, str] = {}
+        self.tid_name: Dict[Tuple[int, int], str] = {}
+        for e in events:
+            if e.get("ph") == "M":
+                if e.get("name") == "process_name":
+                    self.pid_name[e.get("pid")] = e.get("args", {}).get("name", "")
+                elif e.get("name") == "thread_name":
+                    self.tid_name[(e.get("pid"), e.get("tid"))] = \
+                        e.get("args", {}).get("name", "")
+
+        def lane_of(e):
+            pname = self.pid_name.get(e.get("pid"), "")
+            tname = self.tid_name.get((e.get("pid"), e.get("tid")), "")
+            return pname, tname
+
+        # device op lanes: device pids, minus whole-module envelopes and
+        # step-marker lanes (those double-count every op under them)
+        def is_device_op(e):
+            pname, tname = lane_of(e)
+            if not any(k in pname for k in ("TPU", "device", "Device")):
+                return False
+            skip = ("XLA Modules", "Steps", "Framework")
+            return not any(k in pname or k in tname for k in skip)
+
+        raw = [e for e in events
+               if e.get("ph") == "X" and "dur" in e and is_device_op(e)]
+        if raw and window != (0.0, 1.0):
+            t0 = min(e["ts"] for e in raw)
+            t1 = max(e["ts"] + e["dur"] for e in raw)
+            span = max(t1 - t0, 1e-9)
+            lo, hi = t0 + window[0] * span, t0 + window[1] * span
+            raw = [e for e in raw if lo <= e["ts"] <= hi]
+        self.device_events = raw
+
+    # ---------------------------------------------------------------- ops
+    def op_totals(self) -> List[dict]:
+        """Per-op-name rows sorted by total device time (descending)."""
+        agg = defaultdict(lambda: {"dur_us": 0.0, "calls": 0})
+        for e in self.device_events:
+            a = agg[e["name"]]
+            a["dur_us"] += e["dur"]
+            a["calls"] += 1
+        total = sum(a["dur_us"] for a in agg.values()) or 1.0
+        rows = [{"name": n, "dur_us": a["dur_us"], "calls": a["calls"],
+                 "pct": 100.0 * a["dur_us"] / total,
+                 "category": classify_op(n)}
+                for n, a in agg.items()]
+        rows.sort(key=lambda r: -r["dur_us"])
+        return rows
+
+    def total_device_us(self) -> float:
+        return sum(e["dur"] for e in self.device_events)
+
+    def category_totals(self) -> Dict[str, float]:
+        out = defaultdict(float)
+        for e in self.device_events:
+            out[classify_op(e["name"])] += e["dur"]
+        return dict(out)
+
+    # ------------------------------------------------------------- lanes
+    def lane_busy(self) -> List[dict]:
+        """Per device lane: merged busy time (overlap-free) and op count."""
+        lanes = defaultdict(list)
+        for e in self.device_events:
+            lanes[(e.get("pid"), e.get("tid"))].append(
+                (e["ts"], e["ts"] + e["dur"]))
+        rows = []
+        for (pid, tid), iv in sorted(lanes.items()):
+            merged = _union(iv)
+            busy = sum(e - s for s, e in merged)
+            name = self.pid_name.get(pid, f"pid{pid}")
+            tname = self.tid_name.get((pid, tid), "")
+            rows.append({"lane": f"{name}/{tname}" if tname else name,
+                         "busy_us": busy, "ops": len(iv)})
+        return rows
+
+    # --------------------------------------------------------- distributed
+    def overlap(self) -> dict:
+        """Compute/communication overlap over the device lanes.
+
+        collective_us:   union span of collective ops
+        compute_busy_us: union span of non-collective device ops
+        overlapped_us:   collective time with compute running concurrently
+        ratio:           overlapped / collective (1.0 = fully hidden)
+        """
+        coll, comp = [], []
+        for e in self.device_events:
+            iv = (e["ts"], e["ts"] + e["dur"])
+            (coll if classify_op(e["name"]) == "collective" else comp).append(iv)
+        coll_u, comp_u = _union(coll), _union(comp)
+        coll_us = sum(e - s for s, e in coll_u)
+        comp_us = sum(e - s for s, e in comp_u)
+        ovl = _overlap_us(coll_u, comp_u)
+        return {"collective_us": coll_us, "compute_busy_us": comp_us,
+                "overlapped_us": ovl,
+                "ratio": (ovl / coll_us) if coll_us > 0 else None}
+
+    # -------------------------------------------------------------- views
+    def _per_step(self, us: float) -> float:
+        return us / (self.steps or 1)
+
+    def kernel_view(self, top: int = 45) -> str:
+        """Per-op device-time table (reference KernelView)."""
+        rows = self.op_totals()
+        n = self.steps
+        hdr = (f"{'ms/step' if n else 'ms':>10}  {'%':>5}  {'calls':>6}  "
+               f"{'category':<10}  op")
+        lines = ["---- KernelView (device op time"
+                 + (f", {n} steps" if n else "") + ") ----", hdr]
+        for r in rows[:top]:
+            lines.append(f"{self._per_step(r['dur_us']) / 1e3:10.3f}  "
+                         f"{r['pct']:5.1f}  {r['calls']:6d}  "
+                         f"{r['category']:<10}  {r['name'][:100]}")
+        tot = self.total_device_us()
+        lines.append(f"{'total':>10}  {self._per_step(tot) / 1e3:.3f} ms"
+                     + (f"/step over {n} steps" if n else ""))
+        return "\n".join(lines)
+
+    def device_view(self) -> str:
+        """Per-lane busy time + category split (reference DeviceView)."""
+        lines = ["---- DeviceView (device lanes) ----",
+                 f"{'busy ms':>10}  {'ops':>7}  lane"]
+        for r in self.lane_busy():
+            lines.append(f"{self._per_step(r['busy_us']) / 1e3:10.3f}  "
+                         f"{r['ops']:7d}  {r['lane'][:90]}")
+        cats = self.category_totals()
+        total = sum(cats.values()) or 1.0
+        lines.append("category split: " + ", ".join(
+            f"{k} {v / total * 100:.1f}%" for k, v in
+            sorted(cats.items(), key=lambda kv: -kv[1])))
+        return "\n".join(lines)
+
+    def distributed_view(self, top: int = 20) -> str:
+        """Collective totals + overlap ratio (reference DistributedView)."""
+        rows = [r for r in self.op_totals() if r["category"] == "collective"]
+        lines = ["---- DistributedView (collectives) ----"]
+        if not rows:
+            lines.append("no collective ops in capture (single-chip step)")
+        else:
+            lines.append(f"{'ms/step' if self.steps else 'ms':>10}  "
+                         f"{'calls':>6}  op")
+            for r in rows[:top]:
+                lines.append(f"{self._per_step(r['dur_us']) / 1e3:10.3f}  "
+                             f"{r['calls']:6d}  {r['name'][:100]}")
+        ov = self.overlap()
+        if ov["ratio"] is not None:
+            lines.append(
+                f"collective {self._per_step(ov['collective_us']) / 1e3:.3f} ms"
+                f", overlapped with compute "
+                f"{self._per_step(ov['overlapped_us']) / 1e3:.3f} ms "
+                f"(overlap ratio {ov['ratio']:.2f})")
+        return "\n".join(lines)
+
+
+def analyze(path_or_events, steps: Optional[int] = None,
+            window: Tuple[float, float] = (0.0, 1.0)) -> TraceAnalysis:
+    """TraceAnalysis from a trace file, a directory of captures (newest
+    wins), or an already-loaded traceEvents list."""
+    if isinstance(path_or_events, str):
+        f = find_trace_file(path_or_events)
+        if f is None:
+            raise FileNotFoundError(
+                f"no *.trace.json[.gz] under {path_or_events!r} — was the "
+                "device trace captured? (Profiler(timer_only=True) and "
+                "failed start_trace skip the device tracer)")
+        events = load_events(f)
+    else:
+        events = list(path_or_events)
+    return TraceAnalysis(events, steps=steps, window=window)
+
+
+def summarize(path: str, views=None, steps: Optional[int] = None) -> str:
+    """Render the requested views (names or SummaryView members) from the
+    newest capture under `path`."""
+    an = analyze(path, steps=steps)
+    parts = []
+    for v in views or ("kernel",):
+        name = getattr(v, "name", str(v)).lower()
+        if "kernel" in name or "operator" in name:
+            parts.append(an.kernel_view())
+        elif "device" in name:
+            parts.append(an.device_view())
+        elif "dist" in name:
+            parts.append(an.distributed_view())
+        else:
+            parts.append(f"(view {name!r} has no device-trace table)")
+    return "\n\n".join(parts)

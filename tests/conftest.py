@@ -100,6 +100,13 @@ _HEAVY_PATTERNS = (
     "test_inference_capi.py::test_c_error_paths",
     "test_inference_capi.py::test_c_runs_int8_payload_artifact",
     "test_launch_elastic.py::test_launch_two_procs_single_node",
+    # r7 audit: the onnx numpy-evaluator parities went from protoc-skip to
+    # RUNNING on this image (runtime-descriptor fallback) — the python-loop
+    # conv/attention evaluators are the slow part (25s + 9s + 8s); the
+    # format/wire tests stay in smoke
+    "test_onnx_export.py::TestOnnxTransformerExport::test_bert_base_encoder",
+    "test_onnx_export.py::TestOnnxTransformerExport::test_gpt_decoder_block",
+    "test_onnx_export.py::TestOnnxExport::test_convnet_roundtrip",
 )
 
 

@@ -1,19 +1,16 @@
-"""Trace one training-step executable on TPU and print a device-time table.
+"""Trace one training-step executable on TPU and print device-time tables.
 
-Mirrors bench.py's model configs (vit / bert / gpt / swin); runs a few steps
-under jax.profiler.trace and aggregates XLA-op durations from the device
-lanes of the captured .trace.json.gz — per-op-name totals over the steady
-window, sorted. This is the only trustworthy per-component timing on the
-axon tunnel (host-side timers measure dispatch, not device work).
+Thin CLI over `paddle_tpu.profiler.trace_analysis` (where the
+.trace.json.gz parser now lives): mirrors bench.py's model configs
+(vit / bert / gpt / swin / resnet50), runs a few steps under
+jax.profiler.trace, then prints the KernelView / DistributedView tables —
+the only trustworthy per-component timing on remote-dispatch runtimes
+(host-side timers measure dispatch, not device work).
 
 Usage: python tools/profile_step.py vit [outdir]
 """
-import glob
-import gzip
-import json
 import os
 import sys
-from collections import defaultdict
 
 sys.path.insert(0, ".")
 
@@ -105,41 +102,18 @@ def build_step(which):
 
 
 def aggregate(outdir, n_steps):
-    files = glob.glob(os.path.join(outdir, "**", "*.trace.json.gz"),
-                      recursive=True)
-    if not files:
+    """Parse + print the capture via profiler.trace_analysis."""
+    from paddle_tpu.profiler import trace_analysis as ta
+    path = ta.find_trace_file(outdir)
+    if path is None:
         raise SystemExit(f"no trace files under {outdir}")
-    path = max(files, key=os.path.getmtime)
-    with gzip.open(path, "rt") as f:
-        data = json.load(f)
-    events = data.get("traceEvents", [])
-    # device lanes: pids whose process_name mentions TPU/device; XLA ops
-    # carry 'dur'. Build pid->name map first.
-    pid_name = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            pid_name[e.get("pid")] = e.get("args", {}).get("name", "")
-    per_op = defaultdict(float)
-    total = 0.0
-    for e in events:
-        if e.get("ph") != "X" or "dur" not in e:
-            continue
-        pname = pid_name.get(e.get("pid"), "")
-        if not any(k in pname for k in ("TPU", "device", "Device")):
-            continue
-        if "XLA Modules" in pname:  # whole-module envelope, skip
-            continue
-        per_op[e["name"]] += e["dur"]
-        total += e["dur"]
-    rows = sorted(per_op.items(), key=lambda kv: -kv[1])
+    an = ta.analyze(path, steps=n_steps)
     print(f"\ntrace: {path}")
-    print(f"device op time total: {total / 1e3 / n_steps:.2f} ms/step "
-          f"over {n_steps} steps\n")
-    print(f"{'ms/step':>9}  {'%':>5}  op")
-    for name, us in rows[:45]:
-        print(f"{us / 1e3 / n_steps:9.3f}  {us / total * 100:5.1f}  "
-              f"{name[:100]}")
-    return rows, total
+    print(an.kernel_view())
+    print()
+    print(an.distributed_view())
+    rows = [(r["name"], r["dur_us"]) for r in an.op_totals()]
+    return rows, an.total_device_us()
 
 
 def main():
