@@ -1,39 +1,113 @@
-"""paddle.amp.debugging — numeric debugging helpers.
+"""paddle.amp.debugging — numeric debugging helpers (compatibility facade).
 
 Reference: python/paddle/amp/debugging.py (check_numerics,
-enable_operator_stats_collection, TensorCheckerConfig) over the C++
-check_numerics kernels. Here check_numerics is an eager scan (the
-FLAGS_check_nan_inf machinery, SURVEY §5.2) and the collection toggles
-flip the same flag.
+check_layer_numerics, enable_operator_stats_collection, TensorCheckerConfig)
+over the C++ check_numerics kernels / FLAGS_check_nan_inf machinery
+(SURVEY §5.2).
+
+As of r8 this module is a FACADE over paddle_tpu.debugging — the in-graph
+numerics-observability subsystem. The reference semantics are kept
+(check_numerics raises FloatingPointError with NaN/Inf counts; the
+enable/disable toggles flip FLAGS_check_nan_inf), but the counting is one
+on-device reduction (debugging.sentinel.array_stats) instead of a host
+numpy scan, check_layer_numerics exists and instruments real per-layer
+sentinels, and TensorCheckerConfig translates into a
+debugging.NumericsConfig usable with jit.TrainStep(numerics=...) — the
+path that works INSIDE a compiled step, where the eager scan never could.
 """
 from __future__ import annotations
+
+from typing import Optional
 
 import numpy as np
 
 from ..core.tensor import Tensor
 from ..core import flags as _flags
+from .. import debugging as _dbg
+
+
+class DebugMode:
+    """reference: paddle.amp.debugging.DebugMode values."""
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
 
 
 def check_numerics(tensor, op_type: str = "", var_name: str = "",
                    debug_mode=None):
     """Raise on NaN/Inf in `tensor` (reference: amp/debugging.py
-    check_numerics)."""
-    arr = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
-    if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
-        n_nan = int(np.isnan(arr).sum())
-        n_inf = int(np.isinf(arr).sum())
+    check_numerics). One device reduction + one host read — not an
+    elementwise numpy scan. Inside a jit trace this cannot branch on data;
+    use TrainStep(numerics=...) / check_layer_numerics there instead."""
+    import jax
+    import jax.numpy as jnp
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    arr = np.asarray(arr) if not hasattr(arr, "dtype") else arr
+    # jnp.floating (not np.) so bfloat16 tensors are checked too
+    if not jnp.issubdtype(arr.dtype, jnp.floating):
+        return tensor
+    if isinstance(arr, jax.core.Tracer):
+        return tensor   # trace-time: covered by the in-graph sentinels
+    row = np.asarray(_dbg.array_stats(arr))
+    n_nan, n_inf = int(row[1]), int(row[2])
+    if n_nan or n_inf:
         raise FloatingPointError(
             f"check_numerics: {op_type or 'tensor'} {var_name} contains "
             f"{n_nan} NaN and {n_inf} Inf values")
     return tensor
 
 
-def enable_tensor_checker(config=None):
+def check_layer_numerics(model, root: Optional[str] = None):
+    """Instrument `model`'s sublayers with the in-graph numerics sentinels
+    (reference: paddle.amp.debugging.check_layer_numerics decorator). Works
+    eagerly (wrap forwards in debugging.collect_stats()) AND under jit
+    (TrainStep's numerics mode reads the same hooks). Returns the handle
+    (`.paths`, `.remove()`)."""
+    return _dbg.check_layer_numerics(model, root=root)
+
+
+class TensorCheckerConfig:
+    """reference: paddle.amp.debugging.TensorCheckerConfig — kept as the
+    legacy configuration bag; `to_numerics_config()` maps it onto the new
+    subsystem for use with jit.TrainStep(numerics=...)."""
+
+    def __init__(self, enable=True, debug_mode=None, output_dir=None,
+                 checked_op_list=None, skipped_op_list=None, **kw):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+    def to_numerics_config(self) -> Optional[_dbg.NumericsConfig]:
+        if not self.enable:
+            return None
+        abort = self.debug_mode in (None, DebugMode.CHECK_NAN_INF_AND_ABORT)
+        return _dbg.NumericsConfig(
+            every_n_steps=1, dump_dir=self.output_dir,
+            raise_on_nonfinite=abort)
+
+
+_checker_config: Optional[TensorCheckerConfig] = None
+
+
+def enable_tensor_checker(config: Optional[TensorCheckerConfig] = None):
+    """reference semantics: turn the per-op NaN/Inf scan on. Also stashes
+    `config` so TrainStep(numerics=True) picks up its abort/dump policy via
+    get_tensor_checker_config()."""
+    global _checker_config
+    _checker_config = config
     _flags.set_flags({"FLAGS_check_nan_inf": True})
 
 
 def disable_tensor_checker():
+    global _checker_config
+    _checker_config = None
     _flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def get_tensor_checker_config() -> Optional[TensorCheckerConfig]:
+    return _checker_config
 
 
 def enable_operator_stats_collection():
@@ -42,9 +116,3 @@ def enable_operator_stats_collection():
 
 def disable_operator_stats_collection():
     _flags.set_flags({"FLAGS_benchmark": False})
-
-
-class TensorCheckerConfig:
-    def __init__(self, enable=True, debug_mode=None, checked_op_list=None,
-                 skipped_op_list=None, **kw):
-        self.enable = enable

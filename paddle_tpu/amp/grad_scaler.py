@@ -3,6 +3,17 @@
 Dynamic loss scaling for fp16; with bf16 (TPU default) scaling is disabled by
 default since bf16 shares fp32's exponent range — the API still works so
 reference training scripts run unchanged.
+
+Numerics-observability rewrite (r8): the found-inf decision is the IN-GRAPH
+sentinel ``debugging.found_inf`` — one fused reduction over the whole grad
+pytree instead of the old per-parameter ``bool(jnp.all(...))`` scan that
+paid a device->host sync per parameter. The scale/good/bad bookkeeping is a
+pure ``jnp.where`` rule (``_update_rule``) shared verbatim by the eager
+``update()`` path and by ``jit.TrainStep(scaler=...)``, which threads
+(scale, good_steps, bad_steps) through the compiled step as carry — so the
+loss-scale trajectory is identical eager vs jit (tested), and under jit the
+whole decision stays on device: the update is select-skipped on overflow
+with zero host round trips.
 """
 from __future__ import annotations
 
@@ -10,7 +21,12 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from ..core.ops import multiply, isfinite, all as _all
+
+
+def _f(x) -> float:
+    """Host float of a maybe-device scalar (the only sync points are the
+    explicit user reads that call this)."""
+    return float(np.asarray(x))
 
 
 class GradScaler:
@@ -24,7 +40,7 @@ class GradScaler:
         self._dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
         self._bad_steps = 0
-        self._found_inf = False
+        self._found_inf_arr = None   # device bool scalar from the sentinel
         self._unscaled_opts = set()
 
     def is_enable(self):
@@ -33,7 +49,23 @@ class GradScaler:
     def scale(self, loss):
         if not self._enable:
             return loss
+        from ..core.ops import multiply
         return multiply(loss, Tensor(jnp.asarray(self._scale, loss._data.dtype)))
+
+    # ------------------------------------------------------------------
+    # found-inf: ONE in-graph reduction, read lazily
+    @property
+    def _found_inf(self):
+        """Host view of the sentinel (one sync, memoized per step)."""
+        if self._found_inf_arr is None:
+            return False
+        if not isinstance(self._found_inf_arr, bool):
+            self._found_inf_arr = bool(np.asarray(self._found_inf_arr))
+        return self._found_inf_arr
+
+    @_found_inf.setter
+    def _found_inf(self, v):
+        self._found_inf_arr = v
 
     def unscale_(self, optimizer):
         if not self._enable:
@@ -41,16 +73,16 @@ class GradScaler:
         if id(optimizer) in self._unscaled_opts:
             return  # already unscaled this step (e.g. user clipped grads first)
         self._unscaled_opts.add(id(optimizer))
-        inv = 1.0 / self._scale
-        found = False
+        inv = jnp.float32(1.0) / jnp.asarray(self._scale, jnp.float32)
+        grads = []
         for p in optimizer._param_list:
             if p.grad is None:
                 continue
-            g = p.grad._data * inv
-            if not bool(jnp.all(jnp.isfinite(g))):
-                found = True
-            p.grad._data = g
-        self._found_inf = found
+            p.grad._data = p.grad._data * inv.astype(p.grad._data.dtype)
+            grads.append(p.grad._data)
+        from ..debugging import found_inf
+        # device scalar; NOT synced here — step() reads it once
+        self._found_inf_arr = found_inf(grads)
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
@@ -66,34 +98,67 @@ class GradScaler:
         if not self._found_inf:
             optimizer.step()
 
+    # ------------------------------------------------------------------
+    # the pure scale-update rule, shared by eager update() and TrainStep's
+    # in-graph path (reference semantics: update_loss_scaling_op)
+    @staticmethod
+    def _update_rule(scale, good, bad, found, *, incr_ratio, decr_ratio,
+                     incr_every, decr_every):
+        """(scale, good, bad, found) -> (scale', good', bad'); all jnp
+        scalars, trace-safe (pure jnp.where)."""
+        found = jnp.asarray(found)
+        bad2 = jnp.where(found, bad + 1, 0)
+        good2 = jnp.where(found, 0, good + 1)
+        dec = bad2 >= decr_every
+        inc = jnp.logical_and(jnp.logical_not(found), good2 >= incr_every)
+        scale2 = jnp.where(
+            dec, jnp.maximum(scale * decr_ratio, 1.0),
+            jnp.where(inc, scale * incr_ratio, scale))
+        return (scale2.astype(jnp.float32),
+                jnp.where(inc, 0, good2).astype(jnp.int32),
+                jnp.where(dec, 0, bad2).astype(jnp.int32))
+
+    def _hyper(self) -> dict:
+        return dict(incr_ratio=self._incr_ratio, decr_ratio=self._decr_ratio,
+                    incr_every=self._incr_every, decr_every=self._decr_every)
+
+    # state threading for jit.TrainStep(scaler=...)
+    def state_arrays(self):
+        """(scale f32, good i32, bad i32) jnp scalars for the compiled step."""
+        return (jnp.asarray(self._scale, jnp.float32),
+                jnp.asarray(self._good_steps, jnp.int32),
+                jnp.asarray(self._bad_steps, jnp.int32))
+
+    def set_state_arrays(self, state, found_inf=None):
+        """Adopt the step's output state WITHOUT a host sync (device scalars
+        are kept; user reads like get_loss_scaling() sync lazily)."""
+        self._scale, self._good_steps, self._bad_steps = state
+        if found_inf is not None:
+            self._found_inf_arr = found_inf
+
     def update(self):
         if not (self._enable and self._dynamic):
             return
-        if self._found_inf:
-            self._bad_steps += 1
-            self._good_steps = 0
-            if self._bad_steps >= self._decr_every:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
-                self._bad_steps = 0
-        else:
-            self._good_steps += 1
-            self._bad_steps = 0
-            if self._good_steps >= self._incr_every:
-                self._scale *= self._incr_ratio
-                self._good_steps = 0
-        self._found_inf = False
+        found = self._found_inf_arr if self._found_inf_arr is not None else False
+        self._scale, self._good_steps, self._bad_steps = self._update_rule(
+            jnp.asarray(self._scale, jnp.float32),
+            jnp.asarray(self._good_steps, jnp.int32),
+            jnp.asarray(self._bad_steps, jnp.int32),
+            found, **self._hyper())
+        self._found_inf_arr = None
         self._unscaled_opts.clear()
 
     def get_loss_scaling(self):
-        return self._scale
+        return _f(self._scale)
 
     def state_dict(self):
-        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
-                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
-                "bad_steps": self._bad_steps}
+        return {"scale": _f(self._scale), "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "good_steps": int(_f(self._good_steps)),
+                "bad_steps": int(_f(self._bad_steps))}
 
     def set_state_dict(self, state):
-        self._scale = state.get("scale", self._scale)
+        self._scale = state.get("scale", _f(self._scale))
         self._good_steps = state.get("good_steps", 0)
         self._bad_steps = state.get("bad_steps", 0)
 

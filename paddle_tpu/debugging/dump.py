@@ -1,0 +1,254 @@
+"""Anomaly dumps — persist a failing step so it replays standalone.
+
+When a NumericsEvent fires, the step that produced it is written to
+``<dump_dir>/step<K>_<kind>/``:
+
+  meta.json      — step index, the firing event(s), the RNG key (raw key
+                   data words), loss, stats-row paths, batch tree spec
+  batch.npz      — the offending batch's array leaves (leaf0, leaf1, ...)
+  params.npz     — parameter arrays by qualified name
+  opt_state.npz  — optimizer-state arrays as "<param>::<slot>"
+  stats.npz      — the fetched [rows, N_STATS] stats array
+
+Because TrainStep's numerics mode selects AWAY non-finite updates
+(skip_nonfinite_updates), the params on disk are the exact pre-step values
+— replaying the dump re-runs the very computation that blew up, not its
+aftermath. ``tools/replay_dump.py`` is the CLI; ``replay()`` is the
+library entry (rebuild the model, load params, re-run forward+backward
+under the dumped RNG key with sentinels installed, return the reproduced
+stats tree + events).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .sentinel import (StatsTree, N_STATS, check_layer_numerics,
+                       collect_stats, array_stats, grad_layer_groups,
+                       grad_stat_rows)
+from .anomaly import AnomalyDetector, NumericsEvent
+
+
+# -- tiny tree spec: tuple/list/dict/leaf, enough for batch pytrees ----------
+
+def tree_spec(obj):
+    if isinstance(obj, (list, tuple)):
+        return {"t": "tuple" if isinstance(obj, tuple) else "list",
+                "c": [tree_spec(o) for o in obj]}
+    if isinstance(obj, dict):
+        return {"t": "dict", "k": sorted(obj),
+                "c": [tree_spec(obj[k]) for k in sorted(obj)]}
+    if obj is None:
+        return {"t": "none"}
+    return {"t": "leaf"}
+
+
+def tree_build(spec, leaves: List):
+    """Inverse of tree_spec; consumes `leaves` left-to-right (same order as
+    jax.tree.flatten, which sorts dict keys)."""
+    t = spec["t"]
+    if t == "leaf":
+        return leaves.pop(0)
+    if t == "none":
+        return None
+    if t == "dict":
+        return {k: tree_build(c, leaves) for k, c in zip(spec["k"], spec["c"])}
+    seq = [tree_build(c, leaves) for c in spec["c"]]
+    return tuple(seq) if t == "tuple" else seq
+
+
+# -- writer ------------------------------------------------------------------
+
+def _key_data(key) -> Optional[list]:
+    if key is None:
+        return None
+    try:
+        return np.asarray(jax.random.key_data(key)).tolist()
+    except Exception:
+        return np.asarray(key).tolist()
+
+
+def write_dump(dump_dir: str, *, step: int, events: Sequence[NumericsEvent],
+               batch_leaves: Sequence, batch_spec: dict,
+               param_names: Sequence[str], param_arrays: Sequence,
+               opt_state: Optional[Sequence] = None, key=None,
+               loss: Optional[float] = None,
+               stats: Optional[StatsTree] = None,
+               extra_meta: Optional[dict] = None) -> str:
+    """Persist one failing step; returns the dump directory path."""
+    kind = events[0].kind if events else "manual"
+    out = os.path.join(dump_dir, f"step{step}_{kind}")
+    os.makedirs(out, exist_ok=True)
+
+    meta = {
+        "step": step,
+        "events": [e.to_dict() for e in events],
+        "rng_key_data": _key_data(key),
+        "loss": None if loss is None else float(loss),
+        "batch_spec": batch_spec,
+        "n_batch_leaves": len(batch_leaves),
+        "param_names": list(param_names),
+        "stats_paths": stats.paths if stats is not None else None,
+    }
+    meta.update(extra_meta or {})
+    with open(os.path.join(out, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+
+    np.savez(os.path.join(out, "batch.npz"),
+             **{f"leaf{i}": np.asarray(a) for i, a in enumerate(batch_leaves)})
+    np.savez(os.path.join(out, "params.npz"),
+             **{n: np.asarray(a) for n, a in zip(param_names, param_arrays)})
+    if opt_state is not None:
+        slots = {}
+        for n, st in zip(param_names, opt_state):
+            for k, v in (st or {}).items():
+                slots[f"{n}::{k}"] = np.asarray(v)
+        np.savez(os.path.join(out, "opt_state.npz"), **slots)
+    if stats is not None:
+        np.savez(os.path.join(out, "stats.npz"), stats=stats.values)
+    return out
+
+
+# -- loader / replay ---------------------------------------------------------
+
+class Dump:
+    """A loaded anomaly dump."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(os.path.join(path, "meta.json")) as f:
+            self.meta = json.load(f)
+        bz = np.load(os.path.join(path, "batch.npz"))
+        self.batch_leaves = [bz[f"leaf{i}"]
+                             for i in range(self.meta["n_batch_leaves"])]
+        pz = np.load(os.path.join(path, "params.npz"))
+        self.params = {n: pz[n] for n in pz.files}
+        op = os.path.join(path, "opt_state.npz")
+        self.opt_state = None
+        if os.path.exists(op):
+            oz = np.load(op)
+            self.opt_state = {n: oz[n] for n in oz.files}
+        sp = os.path.join(path, "stats.npz")
+        self.stats = None
+        if os.path.exists(sp) and self.meta.get("stats_paths"):
+            self.stats = StatsTree(self.meta["stats_paths"],
+                                   np.load(sp)["stats"],
+                                   step=self.meta["step"])
+
+    @property
+    def step(self) -> int:
+        return self.meta["step"]
+
+    @property
+    def events(self) -> List[dict]:
+        return self.meta["events"]
+
+    def batch(self):
+        """The batch pytree, rebuilt from its spec (arrays, not Tensors)."""
+        leaves = [jnp.asarray(a) for a in self.batch_leaves]
+        return tree_build(self.meta["batch_spec"], list(leaves))
+
+    def rng_key(self):
+        kd = self.meta.get("rng_key_data")
+        if kd is None:
+            return None
+        data = jnp.asarray(np.asarray(kd, dtype=np.uint32))
+        try:
+            return jax.random.wrap_key_data(data)
+        except Exception:
+            return data
+
+
+def load_dump(path: str) -> Dump:
+    return Dump(path)
+
+
+class ReplayResult:
+    def __init__(self, loss, stats: Optional[StatsTree],
+                 events: List[NumericsEvent], matches: Optional[bool]):
+        self.loss = loss
+        self.stats = stats
+        self.events = events
+        self.matches = matches   # reproduced stats == dumped stats (where both exist)
+
+
+def replay(dump: Dump, model, loss_fn: Callable,
+           detector: Optional[AnomalyDetector] = None,
+           compute_grads: bool = True) -> ReplayResult:
+    """Re-run the dumped step against a freshly built `model`.
+
+    Loads the dumped params into the model by qualified name, installs the
+    numerics sentinels, replays ``loss_fn(*batch)`` (and its backward when
+    `compute_grads`) under the dumped RNG key, and returns the reproduced
+    stats tree + the events a fresh detector raises on it. `matches` is True
+    when every dumped stats row that exists in the replay reproduces its
+    nan/inf counts — "the same bad value", modulo rows the eager replay
+    doesn't emit (e.g. in-graph grad rows when compute_grads=False)."""
+    from ..core.tensor import Tensor
+    from ..core import random as _random
+
+    # load params by name (subset-tolerant: extra model params keep init)
+    name_to_param = dict(model.named_parameters())
+    for n, arr in dump.params.items():
+        if n in name_to_param:
+            p = name_to_param[n]
+            p._data = jnp.asarray(arr).astype(p._data.dtype)
+            p._node = None
+
+    handle = check_layer_numerics(model)
+    root = type(model).__name__
+    batch = dump.batch()
+    leaves, _ = jax.tree.flatten(batch)
+    tensors = jax.tree.unflatten(jax.tree.structure(batch),
+                                 [Tensor(l) for l in leaves])
+    key = dump.rng_key()
+
+    try:
+        import contextlib
+        scope = _random.trace_key_scope(key) if key is not None \
+            else contextlib.nullcontext()
+        with scope, collect_stats() as col:
+            if isinstance(tensors, (list, tuple)):
+                out = loss_fn(*tensors)
+            else:
+                out = loss_fn(tensors)
+            loss = out
+            if compute_grads and isinstance(out, Tensor) \
+                    and not out.stop_gradient:
+                out.backward()
+        paths = list(col.paths)
+        rows = list(col.rows)
+        if compute_grads:
+            names = [n for n, p in model.named_parameters()
+                     if p.grad is not None]
+            grads = [name_to_param[n].grad._data for n in names]
+            if grads:
+                gpaths, grows = grad_stat_rows(
+                    grads, grad_layer_groups(names, root))
+                paths += gpaths
+                rows += grows
+        stats = StatsTree(paths, np.asarray(jnp.stack(rows)),
+                          step=dump.step) if rows else None
+    finally:
+        handle.remove()
+
+    det = detector or AnomalyDetector()
+    events = det.observe(dump.step, tree=stats) if stats is not None else []
+
+    matches = None
+    if stats is not None and dump.stats is not None:
+        matches = True
+        for p, r in stats.rows():
+            if p in dump.stats.paths:
+                ref = dump.stats.row(p)
+                if (r["nan"] > 0) != (ref["nan"] > 0) or \
+                        (r["inf"] > 0) != (ref["inf"] > 0):
+                    matches = False
+    loss_val = float(np.asarray(loss._data).astype(np.float64)) \
+        if isinstance(loss, Tensor) and loss.size == 1 else None
+    return ReplayResult(loss_val, stats, events, matches)

@@ -1,0 +1,336 @@
+"""In-graph numerics sentinels — per-tensor stats that live INSIDE jit.
+
+The reference's training-health surface (FLAGS_check_nan_inf,
+paddle.amp.debugging.check_numerics, nan_inf_utils.cc per-op scans) is an
+eager host-side scan: every check is a device->host round trip, and none of
+it exists once the step is one compiled XLA program. Here the check IS part
+of the program: each instrumented layer reduces its output to a 6-float
+stats row on device, the rows stack into one compact [rows, 6] float32
+array threaded out of the jitted step as an ordinary output, and the host
+only reads it when asked (every N steps or on demand) — zero per-step
+syncs, a few scalar reductions of cost.
+
+Stats columns (STAT_NAMES order):
+  finite  — count of finite elements
+  nan     — count of NaNs
+  inf     — count of +/-Inf
+  absmax  — max |x| over finite elements (0 if none)
+  mean    — mean over finite elements
+  l2      — sqrt(sum x^2) over finite elements
+
+Non-finite values are masked out of absmax/mean/l2 so one NaN doesn't
+poison the magnitudes the anomaly detectors (dead-layer, grad explosion)
+read — the nan/inf counts carry the non-finite signal on their own.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+STAT_NAMES = ("finite", "nan", "inf", "absmax", "mean", "l2")
+N_STATS = len(STAT_NAMES)
+
+_tls = threading.local()
+
+
+def array_stats(a) -> jnp.ndarray:
+    """[N_STATS] float32 stats row for one array (trace-safe).
+
+    Five reductions per tensor (nan, inf, absmax, sum, sumsq — the finite
+    count is derived), elementwise masks fused into them by XLA. Everything
+    downstream (found-inf, the global grad norm) derives from these rows so
+    the hot path never re-scans a tensor it already statted.
+
+    The nan/inf masks are computed in the tensor's NATIVE dtype, so a
+    finite float64 value beyond float32 range counts as finite; only the
+    magnitude columns (absmax/mean/l2) reduce in float32 and may saturate
+    to inf for such values."""
+    x = a if jnp.issubdtype(a.dtype, jnp.floating) else a.astype(jnp.float32)
+    isn = x != x
+    absx = jnp.abs(x)
+    isi = absx == jnp.inf
+    nonfin = jnp.logical_or(isn, isi)
+    n_nan = jnp.sum(isn, dtype=jnp.float32)
+    n_inf = jnp.sum(isi, dtype=jnp.float32)
+    n_fin = jnp.float32(x.size) - n_nan - n_inf
+    xz = jnp.where(nonfin, 0.0, x).astype(jnp.float32)
+    absmax = jnp.max(jnp.where(nonfin, 0.0, absx).astype(jnp.float32)) \
+        if x.size else jnp.float32(0.0)
+    mean = jnp.sum(xz) / jnp.maximum(n_fin, 1.0)
+    l2 = jnp.sqrt(jnp.sum(xz * xz))
+    return jnp.stack([n_fin, n_nan, n_inf, absmax, mean, l2])
+
+
+def merge_stat_rows(rows: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Combine stats rows of DISJOINT arrays into one row (e.g. the grads of
+    all params under one layer): counts add, absmax maxes, mean re-weights
+    by finite count, l2 combines in quadrature."""
+    st = jnp.stack(list(rows))                       # [k, N_STATS]
+    n_fin = jnp.sum(st[:, 0])
+    mean = jnp.sum(st[:, 0] * st[:, 4]) / jnp.maximum(n_fin, 1.0)
+    return jnp.stack([n_fin, jnp.sum(st[:, 1]), jnp.sum(st[:, 2]),
+                      jnp.max(st[:, 3]), mean,
+                      jnp.sqrt(jnp.sum(st[:, 5] ** 2))])
+
+
+def merge_stacked(stacked) -> jnp.ndarray:
+    """Reduce [k, R, N_STATS] microbatch/step-stacked stats to [R, N_STATS]
+    with merge_stat_rows semantics along axis 0 (grad-accum scan output)."""
+    n_fin = jnp.sum(stacked[..., 0], axis=0)
+    mean = jnp.sum(stacked[..., 0] * stacked[..., 4], axis=0) \
+        / jnp.maximum(n_fin, 1.0)
+    return jnp.stack([
+        n_fin,
+        jnp.sum(stacked[..., 1], axis=0),
+        jnp.sum(stacked[..., 2], axis=0),
+        jnp.max(stacked[..., 3], axis=0),
+        mean,
+        jnp.sqrt(jnp.sum(stacked[..., 5] ** 2, axis=0)),
+    ], axis=-1)
+
+
+class StatsTree:
+    """Host-side view of one fetched stats array: named rows of STAT_NAMES
+    columns. Activation rows are qualified layer paths (the
+    profiler.annotate_layers naming, e.g. ``GPT/decoder/layers/0/mlp``);
+    gradient rows carry a ``grad:`` prefix."""
+
+    def __init__(self, paths: Sequence[str], values, step: Optional[int] = None):
+        self.paths = list(paths)
+        self.values = np.asarray(values, dtype=np.float32)
+        self.step = step
+        if self.values.ndim != 2 or self.values.shape[0] != len(self.paths) \
+                or self.values.shape[1] != N_STATS:
+            raise ValueError(
+                f"stats shape {self.values.shape} does not match "
+                f"{len(self.paths)} paths x {N_STATS} stats")
+
+    def __len__(self):
+        return len(self.paths)
+
+    def row(self, path: str) -> Dict[str, float]:
+        i = self.paths.index(path)
+        return dict(zip(STAT_NAMES, (float(v) for v in self.values[i])))
+
+    def rows(self):
+        for p, v in zip(self.paths, self.values):
+            yield p, dict(zip(STAT_NAMES, (float(x) for x in v)))
+
+    def nonfinite_rows(self) -> List[Tuple[str, Dict[str, float]]]:
+        return [(p, r) for p, r in self.rows() if r["nan"] or r["inf"]]
+
+    def first_nonfinite(self) -> Optional[Tuple[str, Dict[str, float]]]:
+        bad = self.nonfinite_rows()
+        return bad[0] if bad else None
+
+    def to_dict(self) -> dict:
+        return {"step": self.step, "stat_names": list(STAT_NAMES),
+                "rows": {p: [float(x) for x in v]
+                         for p, v in zip(self.paths, self.values)}}
+
+    def format(self) -> str:
+        w = max((len(p) for p in self.paths), default=4)
+        head = f"{'row':<{w}}  " + "".join(f"{s:>12}" for s in STAT_NAMES)
+        lines = [head]
+        for p, v in zip(self.paths, self.values):
+            cells = "".join(
+                f"{int(x):>12}" if i < 3 else f"{x:>12.4g}"
+                for i, x in enumerate(v))
+            lines.append(f"{p:<{w}}  {cells}")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# collection scope: instrumented layers record (path, stats_row) here while a
+# scope is active — eagerly OR under a jit trace (rows are then tracers that
+# become part of the compiled program's outputs)
+
+
+class StatsCollector:
+    def __init__(self):
+        self.paths: List[str] = []
+        self.rows: List[jnp.ndarray] = []
+        self._counts: Dict[str, int] = {}
+
+    def record(self, path: str, stats_row):
+        # a layer called twice in one forward (weight-tied decode, recompute)
+        # gets distinct rows: path, path#2, ...
+        n = self._counts.get(path, 0) + 1
+        self._counts[path] = n
+        self.paths.append(path if n == 1 else f"{path}#{n}")
+        self.rows.append(stats_row)
+
+    def stacked(self) -> Optional[jnp.ndarray]:
+        return jnp.stack(self.rows) if self.rows else None
+
+    def tree(self, step: Optional[int] = None) -> Optional[StatsTree]:
+        if not self.rows:
+            return None
+        return StatsTree(self.paths, np.asarray(self.stacked()), step=step)
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def active_collector() -> Optional[StatsCollector]:
+    s = getattr(_tls, "stack", None)
+    return s[-1] if s else None
+
+
+@contextlib.contextmanager
+def collect_stats():
+    """Open a collection scope: instrumented layers (check_layer_numerics)
+    record their output stats into the yielded collector. Nestable;
+    tracer-safe (inside jit the rows are traced values)."""
+    col = StatsCollector()
+    _stack().append(col)
+    try:
+        yield col
+    finally:
+        _stack().pop()
+
+
+# ---------------------------------------------------------------------------
+# layer instrumentation
+
+
+class _SentinelHandle:
+    """Returned by check_layer_numerics; .remove() uninstalls the hooks."""
+
+    def __init__(self, removers, paths):
+        self._removers = removers
+        self.paths = paths
+
+    def remove(self):
+        for r in self._removers:
+            r.remove()
+        self._removers = []
+
+
+def _first_float_leaves(outputs):
+    """The jax arrays to stat in a layer output (Tensor / tuple / dict)."""
+    from ..core.tensor import Tensor
+    leaves = jax.tree.leaves(
+        outputs, is_leaf=lambda o: isinstance(o, Tensor))
+    arrs = []
+    for o in leaves:
+        a = o._data if isinstance(o, Tensor) else o
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+            arrs.append(a)
+    return arrs
+
+
+def check_layer_numerics(model, root: Optional[str] = None) -> _SentinelHandle:
+    """Instrument every sublayer of `model` so that, while a collect_stats()
+    scope is active (TrainStep's numerics mode opens one inside the traced
+    step), each forward reduces its floating outputs to one stats row named
+    by the layer's qualified path — the same ``Type/attr/...`` naming
+    profiler.annotate_layers stamps on device traces.
+
+    Outside a scope the hook is a dict lookup and a None check — safe to
+    leave installed. Idempotent per layer. Returns a handle whose
+    ``.remove()`` uninstalls."""
+    root = root or type(model).__name__
+    removers, paths = [], []
+    for name, layer in model.named_sublayers(include_self=True):
+        path = root if not name else f"{root}/{name.replace('.', '/')}"
+        if getattr(layer, "_numerics_path", None) is not None:
+            continue
+
+        def _hook(lyr, inputs, outputs, _path=path):
+            col = active_collector()
+            if col is None:
+                return None
+            arrs = _first_float_leaves(outputs)
+            if not arrs:
+                return None
+            row = array_stats(arrs[0]) if len(arrs) == 1 else \
+                merge_stat_rows([array_stats(a) for a in arrs])
+            col.record(_path, row)
+            return None
+
+        h = layer.register_forward_post_hook(_hook)
+        layer._numerics_path = path
+
+        class _Remover:
+            def __init__(self, lyr, hook_handle):
+                self._lyr, self._h = lyr, hook_handle
+
+            def remove(self):
+                self._h.remove()
+                self._lyr._numerics_path = None
+
+        removers.append(_Remover(layer, h))
+        paths.append(path)
+    return _SentinelHandle(removers, paths)
+
+
+# ---------------------------------------------------------------------------
+# gradient rows
+
+
+def grad_layer_groups(param_names: Sequence[str], root: str
+                      ) -> List[Tuple[str, List[int]]]:
+    """Group param indices by owning layer path: 'moe.w1' -> 'Root/moe';
+    a root-level param -> 'Root'. Order: first appearance."""
+    groups: Dict[str, List[int]] = {}
+    order: List[str] = []
+    for i, name in enumerate(param_names):
+        head = name.rsplit(".", 1)[0] if "." in name else ""
+        path = root if not head else f"{root}/{head.replace('.', '/')}"
+        key = f"grad:{path}"
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(i)
+    return [(k, groups[k]) for k in order]
+
+
+def grad_stat_rows(grads, groups) -> Tuple[List[str], List[jnp.ndarray]]:
+    """Per-layer grad stats rows (trace-safe) for grad_layer_groups output."""
+    paths, rows = [], []
+    for key, idxs in groups:
+        per = [array_stats(grads[i]) for i in idxs]
+        rows.append(per[0] if len(per) == 1 else merge_stat_rows(per))
+        paths.append(key)
+    return paths, rows
+
+
+def found_inf(grads) -> jnp.ndarray:
+    """ONE fused reduction: True iff any grad leaf holds a non-finite value.
+    Trace-safe — this is the in-graph sentinel dynamic loss scaling keys off
+    (vs the reference's per-tensor eager check_finite_and_unscale)."""
+    flags = [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]
+    if not flags:
+        return jnp.asarray(False)
+    return jnp.logical_not(jnp.all(jnp.stack(flags)))
+
+
+def model_param_stats(model, root: Optional[str] = None,
+                      grads: bool = False) -> StatsTree:
+    """Eager stats tree over a model's parameters (and optionally their
+    .grad) — the host-side fallback NumericsCallback uses when the training
+    loop is not a TrainStep. One device->host fetch for the whole tree."""
+    root = root or type(model).__name__
+    paths, rows = [], []
+    for name, p in model.named_parameters():
+        head = name.rsplit(".", 1)[0] if "." in name else ""
+        path = root if not head else f"{root}/{head.replace('.', '/')}"
+        src = p.grad if grads else p
+        if src is None:
+            continue
+        paths.append((f"grad:{path}/{name.rsplit('.', 1)[-1]}" if grads
+                      else f"param:{path}/{name.rsplit('.', 1)[-1]}"))
+        rows.append(array_stats(src._data))
+    values = np.asarray(jnp.stack(rows)) if rows else \
+        np.zeros((0, N_STATS), np.float32)
+    return StatsTree(paths, values)

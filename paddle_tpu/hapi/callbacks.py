@@ -9,7 +9,7 @@ import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
            "EarlyStopping", "LRScheduler", "ReduceLROnPlateau", "VisualDL",
-           "ProfilerCallback", "config_callbacks"]
+           "ProfilerCallback", "NumericsCallback", "config_callbacks"]
 
 
 class Callback:
@@ -312,6 +312,93 @@ class ProfilerCallback(Callback):
             import json
             print("StepMonitor: " + json.dumps(self.monitor.report()),
                   flush=True)
+
+
+class NumericsCallback(Callback):
+    """Training-health sibling of ProfilerCallback: drives the
+    paddle_tpu.debugging numerics layer through Model.fit.
+
+    Two regimes, picked automatically per batch:
+
+      - fused (Model's TrainStep path): the compiled step already carries
+        the in-graph stats tree; this callback just attaches the
+        NumericsConfig (detector/dump/monitor cadence) to that TrainStep.
+      - eager tape loop: every `every_n_steps` batches the callback reduces
+        the model's parameter grads to a stats tree on device (one fetch)
+        and feeds the same detector.
+
+    In both regimes the per-batch loss feeds the loss-spike detector and
+    events land in `detector.events` (+ the StepMonitor JSONL stream when a
+    monitor is attached). `raise_on_event=True` aborts training on any
+    event — the FLAGS_check_nan_inf abort policy."""
+
+    def __init__(self, numerics=None, every_n_steps=1, dump_dir=None,
+                 monitor=None, raise_on_event=False):
+        super().__init__()
+        from ..debugging import NumericsConfig
+        if numerics is None:
+            numerics = NumericsConfig(every_n_steps=every_n_steps,
+                                      dump_dir=dump_dir, monitor=monitor)
+        self.numerics = NumericsConfig.coerce(numerics)
+        self.raise_on_event = raise_on_event
+        self._step = 0
+        self._attached = None
+
+    @property
+    def detector(self):
+        return self.numerics.detector
+
+    @property
+    def events(self):
+        return self.numerics.detector.events
+
+    def _train_step(self):
+        return getattr(self.model, "_fused_step", None)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        ts = self._train_step()
+        if ts is not None and self._attached is not ts:
+            # adopt the fused step: its compiled executables are rebuilt
+            # with the stats tree as outputs on the next batch
+            ts.set_numerics(self.numerics)
+            self._attached = ts
+            return
+        if ts is not None:
+            if self.raise_on_event and self.numerics.detector.events:
+                raise FloatingPointError(
+                    f"numerics anomaly: {self.numerics.detector.events[-1]!r}")
+            return
+        # eager tape regime
+        n = max(1, self.numerics.every_n_steps or 1)
+        if self._step % n:
+            return
+        from ..debugging import model_param_stats
+        net = getattr(self.model, "network", self.model)
+        # grads if the loop kept them; else the params themselves (the
+        # eager fit clears grads before callbacks run — a poisoned update
+        # still shows as non-finite PARAMS on the next batch)
+        tree = model_param_stats(net, grads=True)
+        gn = None
+        if len(tree):
+            gn = float(np.sqrt(sum(r["l2"] ** 2 for _, r in tree.rows())))
+        else:
+            tree = model_param_stats(net, grads=False)
+        loss = (logs or {}).get("loss")
+        loss = float(np.asarray(loss).reshape(-1)[0]) if loss is not None \
+            else None
+        events = self.numerics.detector.observe(
+            self._step, tree=tree if len(tree) else None,
+            loss=loss, grad_norm=gn)
+        mon = self.numerics.monitor
+        if mon is not None and hasattr(mon, "record_numerics"):
+            mon.record_numerics(step=self._step, loss=loss, grad_norm=gn,
+                                events=events)
+        for e in events:
+            if self.numerics.on_event is not None:
+                self.numerics.on_event(e)
+        if events and self.raise_on_event:
+            raise FloatingPointError(f"numerics anomaly: {events[0]!r}")
 
 
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,

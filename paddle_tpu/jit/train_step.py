@@ -9,6 +9,17 @@ donated parameter/optimizer buffers: XLA fuses, schedules, overlaps
 collectives, and reuses memory. Sharding comes from PartitionSpec annotations
 on parameters (`Tensor.pspec`), so DP/TP/FSDP are all configurations of this
 single code path (SURVEY §7 design mapping).
+
+Numerics observability (r8): with `numerics=` enabled the step also carries
+a per-layer stats tree (debugging.sentinel) — activation rows recorded by
+instrumented sublayers while tracing, per-layer grad rows, the global
+grad-norm, and an in-graph found-inf scalar — reduced on device to one
+compact [rows, 6] float32 array returned as an ordinary output. The host
+fetches it every N steps or on demand; the hot path pays a few reductions
+and ZERO device->host syncs. `scaler=` threads GradScaler's
+(scale, good, bad) through the step so dynamic loss scaling works under
+jit: loss scaled in-graph, grads unscaled, the update select-skipped on
+overflow, state advanced by the same pure rule the eager path uses.
 """
 from __future__ import annotations
 
@@ -59,11 +70,16 @@ class TrainStep:
 
     With `mesh`, parameters/optimizer state are placed by their pspec
     annotations and batch inputs are sharded over `data_axes`.
+
+    `numerics`: True or a debugging.NumericsConfig — thread the per-layer
+    stats tree through the compiled step (see module docstring);
+    `train_step.numerics_stats()` fetches the latest tree on demand.
+    `scaler`: an amp.GradScaler — dynamic loss scaling entirely in-graph.
     """
 
     def __init__(self, model, optimizer, loss_fn: Callable, mesh: Optional[Mesh] = None,
                  data_axes=("dp",), donate: bool = True, grad_accum_steps: int = 1,
-                 monitor=None):
+                 monitor=None, numerics=None, scaler=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -78,6 +94,17 @@ class TrainStep:
         self._compiled = {}
         self._last_sig = {}     # kind -> last compiled shape signature
 
+        self._scaler = scaler if (scaler is not None
+                                  and scaler.is_enable()) else None
+        self._numerics = None
+        self._sentinel_handle = None
+        self._act_paths = []      # activation row paths, filled at 1st trace
+        self._grad_groups = []    # [(path, [param indices])]
+        self._last_aux = None     # latest step's aux pytree (device arrays)
+        self._last_loss_arr = None
+        self._last_key = None
+        self._last_batch_struct = None   # nested python batch (array leaves)
+
         self._param_names, self._params = [], []
         for name, p in model.named_parameters():
             if not p.stop_gradient:
@@ -85,8 +112,32 @@ class TrainStep:
                 self._params.append(p)
         self._buffers = [b for _, b in model.named_buffers()]
 
+        if numerics is not None:
+            self.set_numerics(numerics)
+
         # optimizer state as pytree (init lazily so shapes match cast params)
         self._opt_state = None
+
+    def set_numerics(self, numerics):
+        """(Re)configure the numerics mode after construction: installs the
+        layer sentinels + per-layer grad grouping and invalidates compiled
+        executables so the stats tree joins the step outputs on the next
+        compile. Pass None/False to disable."""
+        from ..debugging import (NumericsConfig, check_layer_numerics,
+                                 grad_layer_groups)
+        self._numerics = NumericsConfig.coerce(numerics)
+        if self._numerics is not None:
+            if self._sentinel_handle is None:
+                # idempotent: reuses hooks another handle already installed
+                self._sentinel_handle = check_layer_numerics(self.model)
+            if self._numerics.grad_stats and not self._grad_groups:
+                self._grad_groups = grad_layer_groups(
+                    self._param_names, type(self.model).__name__)
+        if self._compiled:
+            self._compiled.clear()
+            # deliberate re-trace, not shape instability: reset the
+            # recompile detector's signatures so it stays quiet
+            self._last_sig.clear()
 
     # ------------------------------------------------------------------
     def _init_opt_state(self):
@@ -188,13 +239,14 @@ class TrainStep:
             in_shardings = (
                 tuple(self._placement(s) for s in pspecs),
                 tuple({k: self._placement(s[k]) for k in s} for s in state_specs),
-                None, None, None,
+                None, None, None, None,
                 *[self._placement(s) for s in flat_specs],
             )
             out_shardings = (
                 None,
                 tuple(self._placement(s) for s in pspecs),
                 tuple({k: self._placement(s[k]) for k in s} for s in state_specs),
+                None, None,
             )
             kwargs = dict(in_shardings=in_shardings, out_shardings=out_shardings)
         donate = (0, 1) if self.donate else ()
@@ -206,22 +258,26 @@ class TrainStep:
         batches [n, ...]. Amortizes host dispatch (one launch per N steps)
         and lets XLA overlap step boundaries — the analog of the reference's
         gradient_merge/program-level multi-batch execution, and the honest
-        way to benchmark on remote-dispatch runtimes."""
+        way to benchmark on remote-dispatch runtimes. Numerics stats and the
+        scaler state ride the scan (stats stacked [n, rows, 6]; scaler state
+        as carry — per-step overflow decisions, same as N eager updates)."""
         single = self._build_pure(treedef)
 
-        def multi(param_arrays, opt_state, step0, lr, key, *flat_batches):
+        def multi(param_arrays, opt_state, scaler_state, step0, lr, key,
+                  *flat_batches):
             def body(carry, xs):
-                params, state, i = carry
+                params, state, sstate, i = carry
                 ks, batch_leaves = xs[0], xs[1:]
-                loss, new_p, new_s = single(params, state, i, lr, ks,
-                                            *batch_leaves)
-                return (new_p, new_s, i + 1), loss
+                loss, new_p, new_s, new_ss, aux = single(
+                    params, state, sstate, i, lr, ks, *batch_leaves)
+                return (new_p, new_s, new_ss, i + 1), (loss, aux)
 
             keys = jax.random.split(key, n_steps)
-            (pa, st, _), losses = jax.lax.scan(
-                body, (tuple(param_arrays), tuple(opt_state), step0),
+            (pa, st, ss, _), (losses, auxs) = jax.lax.scan(
+                body,
+                (tuple(param_arrays), tuple(opt_state), scaler_state, step0),
                 (keys, *flat_batches))
-            return losses, pa, st
+            return losses, pa, st, ss, auxs
 
         return jax.jit(multi, donate_argnums=(0, 1))
 
@@ -233,20 +289,43 @@ class TrainStep:
         wds = [opt._wd_for(p) for p in params]
         grad_clip = opt._grad_clip
         accum = max(1, int(self.grad_accum_steps))
+        numerics = self._numerics
+        scaler = self._scaler
+        grad_groups = self._grad_groups
+        act_paths_box = self._act_paths
+        if numerics is not None or scaler is not None:
+            from ..debugging import sentinel as _sentinel
+        else:
+            _sentinel = None
 
-        def pure_step(param_arrays, opt_state, step_i, lr, key, *flat_batch):
+        def pure_step(param_arrays, opt_state, scaler_state, step_i, lr, key,
+                      *flat_batch):
             batch = jax.tree.unflatten(treedef, flat_batch)
+            scale = scaler_state[0] if scaler_state is not None else None
 
             def loss_of(pa, microbatch, k):
+                import contextlib
+                col_cm = _sentinel.collect_stats() if numerics is not None \
+                    else contextlib.nullcontext()
                 with _trace_guard(), _swap_params(params, list(pa)), \
-                        _random.trace_key_scope(k), autograd.no_grad():
+                        _random.trace_key_scope(k), autograd.no_grad(), \
+                        col_cm as col:
                     out = loss_fn(*_tree_wrap(microbatch))
                 loss_arr = out._data if isinstance(out, Tensor) else out
-                return loss_arr.astype(jnp.float32)
+                loss_arr = loss_arr.astype(jnp.float32)
+                act_rows = None
+                if numerics is not None:
+                    act_rows = col.stacked()
+                    if col.paths and not act_paths_box:
+                        act_paths_box.extend(col.paths)
+                # loss scaling happens in-graph: autodiff sees the SCALED
+                # loss, the aux carries the true loss back out
+                scaled = loss_arr * scale if scale is not None else loss_arr
+                return scaled, (loss_arr, act_rows)
 
             if accum == 1:
-                loss, grads = jax.value_and_grad(loss_of)(
-                    list(param_arrays), batch, key)
+                (_, (loss, act_rows)), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(list(param_arrays), batch, key)
             else:
                 # gradient accumulation (reference: gradient_merge /
                 # GradientMergeOptimizer): split the batch dim into `accum`
@@ -269,11 +348,11 @@ class TrainStep:
                 def acc_body(carry, xs):
                     loss_acc, g_acc = carry
                     mb, k = xs
-                    l, g = jax.value_and_grad(loss_of)(
-                        list(param_arrays), mb, k)
+                    (_, (l, rows)), g = jax.value_and_grad(
+                        loss_of, has_aux=True)(list(param_arrays), mb, k)
                     return (loss_acc + l / accum,
                             [ga + (gi / accum).astype(ga.dtype)
-                             for ga, gi in zip(g_acc, g)]), None
+                             for ga, gi in zip(g_acc, g)]), rows
 
                 # accumulate in the PARAM dtype: autodiff grads already come
                 # out in param dtype (bf16 for bf16 models), and an f32
@@ -281,13 +360,55 @@ class TrainStep:
                 # memory the microbatching exists to save
                 zeros = [jnp.zeros(p.shape, p.dtype)
                          for p in param_arrays]
-                (loss, grads), _ = jax.lax.scan(
+                (loss, grads), micro_rows = jax.lax.scan(
                     acc_body, (jnp.float32(0.0), zeros), (micro, keys))
+                act_rows = None if micro_rows is None else \
+                    _sentinel.merge_stacked(micro_rows)
+
+            # unscale BEFORE clip/sentinels so grad stats and the update see
+            # true gradients (found-inf is scale-invariant)
+            if scale is not None:
+                inv = jnp.float32(1.0) / scale
+                grads = [g * inv.astype(g.dtype) for g in grads]
+
+            aux = {}
+            found = None
+            need_found = scaler is not None or (
+                numerics is not None and numerics.skip_nonfinite_updates)
+            if numerics is not None:
+                rows = list(act_rows) if act_rows is not None else []
+                grow_mat = None
+                if grad_groups:
+                    _, grows = _sentinel.grad_stat_rows(grads, grad_groups)
+                    rows += grows
+                    grow_mat = jnp.stack(grows)
+                if rows:
+                    aux["stats"] = jnp.stack(rows)
+                if grow_mat is not None:
+                    # found-inf and the global grad-norm DERIVE from the
+                    # grad rows — no second scan over grad memory (the rows
+                    # mask non-finites out of l2, so the norm stays finite
+                    # and the nan/inf counts carry the overflow signal)
+                    if need_found:
+                        found = jnp.sum(grow_mat[:, 1] + grow_mat[:, 2]) > 0
+                    aux["grad_norm"] = jnp.sqrt(
+                        jnp.sum(grow_mat[:, 5] ** 2))
+                else:
+                    if need_found:
+                        found = _sentinel.found_inf(grads)
+                    aux["grad_norm"] = jnp.sqrt(
+                        sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                            for g in grads))
+                if found is not None:
+                    aux["found_inf"] = found
+            elif need_found:
+                found = _sentinel.found_inf(grads)
+                aux["found_inf"] = found
             if grad_clip is not None and type(grad_clip).__name__ == "ClipGradByGlobalNorm":
                 total = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                                      for g in grads))
-                scale = jnp.minimum(1.0, grad_clip.clip_norm / jnp.maximum(total, 1e-12))
-                grads = [g * scale.astype(g.dtype) for g in grads]
+                scale_c = jnp.minimum(1.0, grad_clip.clip_norm / jnp.maximum(total, 1e-12))
+                grads = [g * scale_c.astype(g.dtype) for g in grads]
             new_params = [None] * len(param_arrays)
             new_state = [None] * len(param_arrays)
             # fused multi-tensor apply (reference analog:
@@ -347,7 +468,23 @@ class TrainStep:
                 np_, ns_ = opt.update(pa, g, st, lr, step_i, wd)
                 new_params[i] = np_
                 new_state[i] = ns_
-            return loss, tuple(new_params), tuple(new_state)
+            # select-skip the update on overflow: params/opt-state never
+            # ingest a non-finite value (GradScaler semantics; also what
+            # makes an anomaly dump hold the exact pre-step state)
+            if found is not None:
+                new_params = [jnp.where(found, pa, np_)
+                              for pa, np_ in zip(param_arrays, new_params)]
+                new_state = [
+                    ({k: jnp.where(found, st[k], ns_[k]) for k in ns_}
+                     if ns_ and st else ns_)
+                    for st, ns_ in zip(opt_state, new_state)]
+            new_scaler_state = None
+            if scaler_state is not None:
+                from ..amp.grad_scaler import GradScaler
+                new_scaler_state = GradScaler._update_rule(
+                    *scaler_state, found, **scaler._hyper())
+            return (loss, tuple(new_params), tuple(new_state),
+                    new_scaler_state, aux)
 
         return pure_step
 
@@ -367,6 +504,91 @@ class TrainStep:
             from ..profiler.monitor import shape_delta
             _logger.warning("recompilation of %s: %s", kind,
                             shape_delta(prev, sig))
+
+    # ------------------------------------------------------------------
+    # numerics: fetch / detect / dump
+    @property
+    def numerics_paths(self):
+        """Stats-tree row names: activation paths (trace order) then
+        per-layer grad rows. Populated after the first compile."""
+        return list(self._act_paths) + [k for k, _ in self._grad_groups]
+
+    def numerics_stats(self, sync: bool = True):
+        """The latest step's StatsTree (device->host fetch happens HERE, not
+        in the step). None before the first numerics-enabled step."""
+        if self._last_aux is None or "stats" not in self._last_aux:
+            return None
+        from ..debugging import StatsTree
+        vals = self._last_aux["stats"]
+        return StatsTree(self.numerics_paths,
+                         np.asarray(vals) if sync else vals,
+                         step=self._step_i)
+
+    def _scaler_state_in(self):
+        return self._scaler.state_arrays() if self._scaler is not None else None
+
+    def _after_step(self, loss_arr, new_scaler_state, aux, *, steps=1):
+        if self._scaler is not None and new_scaler_state is not None:
+            self._scaler.set_state_arrays(
+                new_scaler_state, found_inf=aux.get("found_inf"))
+        if self._numerics is None:
+            return
+        self._last_aux = aux
+        self._last_loss_arr = loss_arr
+        cfg = self._numerics
+        n = cfg.every_n_steps
+        if n and (self._step_i % n == 0
+                  or (steps > 1 and self._step_i % n < steps)):
+            self._fetch_and_detect()
+
+    def _fetch_and_detect(self):
+        """One host fetch of the latest stats + loss/grad-norm scalars, run
+        the detectors, route events (monitor / on_event / dump / raise)."""
+        cfg = self._numerics
+        tree = self.numerics_stats()
+        loss = None
+        if self._last_loss_arr is not None:
+            la = np.asarray(self._last_loss_arr)
+            loss = float(la.reshape(-1)[-1])  # run_steps: last step's loss
+        gn = self._last_aux.get("grad_norm") if self._last_aux else None
+        gn = float(np.asarray(gn).reshape(-1)[-1]) if gn is not None else None
+        events = cfg.detector.observe(self._step_i, tree=tree, loss=loss,
+                                      grad_norm=gn)
+        monitor = cfg.monitor or self.monitor
+        if monitor is not None and hasattr(monitor, "record_numerics"):
+            monitor.record_numerics(step=self._step_i, loss=loss,
+                                    grad_norm=gn, events=events)
+        for e in events:
+            _logger.warning("numerics: %r", e)
+            if cfg.on_event is not None:
+                cfg.on_event(e)
+        if events and cfg.dump_dir:
+            self._write_dump(events, tree, loss)
+        if cfg.raise_on_nonfinite and any(
+                e.kind in ("nan", "inf") for e in events):
+            bad = next(e for e in events if e.kind in ("nan", "inf"))
+            raise FloatingPointError(
+                f"non-finite values detected at step {self._step_i} in "
+                f"{bad.path}: {bad.message} (numerics.raise_on_nonfinite)")
+        return events
+
+    def _write_dump(self, events, tree, loss):
+        from ..debugging import dump as _dump
+        leaves, _ = jax.tree.flatten(self._last_batch_struct)
+        spec = _dump.tree_spec(self._last_batch_struct)
+        path = _dump.write_dump(
+            self._numerics.dump_dir, step=self._step_i, events=events,
+            batch_leaves=leaves, batch_spec=spec,
+            param_names=self._param_names,
+            param_arrays=[p._data for p in self._params],
+            opt_state=self._opt_state, key=self._last_key, loss=loss,
+            stats=tree,
+            extra_meta={"model": type(self.model).__name__,
+                        "skip_nonfinite_updates":
+                            self._numerics.skip_nonfinite_updates})
+        _logger.warning("numerics: dumped failing step %d to %s",
+                        self._step_i, path)
+        return path
 
     # ------------------------------------------------------------------
     def loss_and_grad_norm(self, *batch, key=None):
@@ -502,8 +724,12 @@ class TrainStep:
                           for p in self._params)
             s_sds = tuple(abstract_state)
             key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+            sstate = None
+            if self._scaler is not None:
+                sstate = tuple(jax.ShapeDtypeStruct((), d)
+                               for d in (jnp.float32, jnp.int32, jnp.int32))
             lowered = built.lower(
-                p_sds, s_sds, jax.ShapeDtypeStruct((), jnp.int32),
+                p_sds, s_sds, sstate, jax.ShapeDtypeStruct((), jnp.int32),
                 jax.ShapeDtypeStruct((), jnp.float32), key, *flat)
             return lowered.compile().memory_analysis()
         finally:
@@ -533,9 +759,10 @@ class TrainStep:
             flat = [self._to_global(a, P(None, *self.data_axes))
                     if a.ndim > 1 else a for a in flat]
         t0 = time.perf_counter() if self.monitor is not None else None
-        losses, new_params, new_state = compiled(
+        losses, new_params, new_state, new_sstate, auxs = compiled(
             tuple(p._data for p in self._params), tuple(self._opt_state),
-            jnp.int32(self._step_i + 1), lr, key, *flat)
+            self._scaler_state_in(), jnp.int32(self._step_i + 1), lr, key,
+            *flat)
         if self.monitor is not None:
             # launch wall time (includes waiting on the previous launch's
             # donated buffers — the steady-state device rate from the 2nd
@@ -547,6 +774,16 @@ class TrainStep:
             p._data = na
             p._node = None
         self._opt_state = list(new_state)
+        if self._numerics is not None:
+            # the fetched stats (and hence any dump) describe the LAST step
+            # of the launch — record that step's batch slice and the key the
+            # scan actually used for it, so the dump replays that step
+            self._last_batch_struct = jax.tree.map(lambda a: a[-1], arrays)
+            self._last_key = jax.random.split(key, n_steps)[-1]
+        # aux leaves are stacked [n_steps, ...]; keep the last step's view
+        # (still device arrays — no sync)
+        last_aux = jax.tree.map(lambda v: v[-1], auxs) if auxs else auxs
+        self._after_step(losses, new_sstate, last_aux, steps=n_steps)
         return Tensor(losses)
 
     def __call__(self, *batch):
@@ -569,9 +806,9 @@ class TrainStep:
             flat = [self._to_global(a, P(*self.data_axes))
                     if a.ndim > 0 else a for a in flat]
         t0 = time.perf_counter() if self.monitor is not None else None
-        loss, new_params, new_state = compiled(
+        loss, new_params, new_state, new_sstate, aux = compiled(
             tuple(p._data for p in self._params), tuple(self._opt_state),
-            jnp.int32(self._step_i), lr, key, *flat)
+            self._scaler_state_in(), jnp.int32(self._step_i), lr, key, *flat)
         if self.monitor is not None:
             self.monitor.end_step(wall_s=time.perf_counter() - t0)
 
@@ -579,6 +816,9 @@ class TrainStep:
             p._data = na
             p._node = None
         self._opt_state = list(new_state)
+        self._last_batch_struct = arrays
+        self._last_key = key
+        self._after_step(loss, new_sstate, aux)
         if isinstance(self.optimizer._lr, object) and hasattr(self.optimizer._lr, "step") \
                 and not isinstance(self.optimizer._lr, (int, float)):
             pass  # user drives scheduler.step() per reference convention

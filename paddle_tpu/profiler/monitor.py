@@ -84,6 +84,8 @@ class StepMonitor:
         self.compiles = 0          # traced-step compiles observed
         self.recompiles = 0        # compiles beyond the first per kind
         self.recompile_events = []  # {step, kind, delta}
+        self.numerics_events = []   # NumericsEvent dicts (debugging layer)
+        self._last_numerics = {}    # latest fetched loss/grad_norm scalars
         self._steps = 0
         self._t0 = None
         self._jit_miss_0 = None
@@ -160,6 +162,34 @@ class StepMonitor:
                 logger.warning("recompilation of %s at step %d: %s",
                                kind, self._steps + 1, delta)
 
+    # ----------------------------------------------------------- numerics
+    def record_numerics(self, step: int, loss: Optional[float] = None,
+                        grad_norm: Optional[float] = None, events=()):
+        """Called by the debugging layer at each stats fetch: loss/grad-norm
+        land in the JSONL stream (one `numerics` row per fetch), and every
+        NumericsEvent is recorded + logged. Cheap: only runs at the fetch
+        cadence, never per step."""
+        row = {"numerics": {"step": step, "loss": loss,
+                            "grad_norm": grad_norm},
+               "ts": time.time()}
+        self._last_numerics = {"step": step, "loss": loss,
+                               "grad_norm": grad_norm}
+        evs = [e.to_dict() if hasattr(e, "to_dict") else dict(e)
+               for e in events]
+        if evs:
+            row["numerics"]["events"] = evs
+            self.numerics_events.extend(evs)
+            for e in evs:
+                logger.warning("numerics event at step %s: %s %s — %s",
+                               e.get("step"), e.get("kind"),
+                               e.get("path") or "", e.get("message"))
+        if self.jsonl_path:
+            with open(self.jsonl_path, "a") as f:
+                f.write(json.dumps(row) + "\n")
+        if self.on_report is not None:
+            self.on_report(row)
+        return row
+
     # ------------------------------------------------------------ internals
     def _peak(self) -> Optional[float]:
         if self.peak_flops is not None:
@@ -223,7 +253,12 @@ class StepMonitor:
         last_hbm = next((r.get("hbm_bytes_in_use") for r in
                          reversed(self.records)
                          if r.get("hbm_bytes_in_use") is not None), None)
+        num = {"numerics_events": len(self.numerics_events)}
+        if self._last_numerics:
+            num["loss"] = self._last_numerics.get("loss")
+            num["grad_norm"] = self._last_numerics.get("grad_norm")
         return {"steps": self._steps,
+                **num,
                 "step_ms": round(med, 3) if med is not None else None,
                 "items_per_s": round(items_s, 1) if items_s else None,
                 "unit": self.unit,
@@ -265,4 +300,9 @@ class StepMonitor:
               "recompilations (shape-signature changes)")
         gauge("jit_cache_misses_total", r["jit_cache_misses"],
               "jit compile-cache misses during monitored steps")
+        gauge("numerics_events_total", r["numerics_events"],
+              "numerics anomalies detected (nan/inf/grad/loss/dead-layer)")
+        gauge("loss", r.get("loss"), "latest fetched training loss")
+        gauge("grad_norm", r.get("grad_norm"),
+              "latest fetched global gradient norm")
         return "\n".join(lines) + "\n"

@@ -110,10 +110,35 @@ _HEAVY_PATTERNS = (
 )
 
 
+# nodeid -> marker names, filled at collection; consumed by the duration
+# recorder below (report objects don't carry the item)
+_ITEM_MARKERS = {}
+
+
 def pytest_collection_modifyitems(config, items):
     for item in items:
         if any(p in item.nodeid for p in _HEAVY_PATTERNS):
             item.add_marker(pytest.mark.heavy)
+        _ITEM_MARKERS[item.nodeid] = sorted(
+            {m.name for m in item.iter_markers()})
+
+
+def pytest_runtest_logreport(report):
+    """Wall-time ledger for tools/check_tiers.py: with
+    PADDLE_TPU_TIER_DURATIONS=<path> set, append one JSONL row per test
+    call (nodeid, duration, markers, outcome). tools/run_tier1.sh sets the
+    env around the canonical tier-1 command and runs the checker on the
+    result — the guard that keeps tier-1 under its 870s cap."""
+    path = os.environ.get("PADDLE_TPU_TIER_DURATIONS")
+    if not path or report.when != "call":
+        return
+    import json
+    row = {"nodeid": report.nodeid,
+           "duration": round(report.duration, 3),
+           "markers": _ITEM_MARKERS.get(report.nodeid, []),
+           "outcome": report.outcome}
+    with open(path, "a") as f:
+        f.write(json.dumps(row) + "\n")
 
 
 def pytest_configure(config):

@@ -1,0 +1,578 @@
+"""Numerics observability (paddle_tpu.debugging): in-graph sentinels,
+anomaly detection, dump/replay, GradScaler-under-jit, facades, tier guard.
+
+Reference surfaces: FLAGS_check_nan_inf / eager nan_inf_utils.cc scans,
+paddle.amp.debugging.{check_numerics, check_layer_numerics,
+TensorCheckerConfig}, update_loss_scaling_op — all reimplemented to work
+INSIDE a compiled TrainStep (SURVEY §5.2)."""
+import importlib.util
+import json
+import os
+import shutil
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import debugging
+from paddle_tpu.amp import GradScaler
+from paddle_tpu.jit.train_step import TrainStep
+
+TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(16, 1)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def _replay_factory():
+    """Model+loss factory for tools/replay_dump.py (imported by name)."""
+    paddle.seed(0)
+    net = Net()
+    return net, (lambda x, y: nn.MSELoss()(net(x), y))
+
+
+def _batch(rng=None, n=4):
+    rng = rng or np.random.RandomState(0)
+    return (paddle.to_tensor(rng.randn(n, 8).astype("float32")),
+            paddle.to_tensor(rng.randn(n, 1).astype("float32")))
+
+
+# ---------------------------------------------------------------- sentinel
+
+class TestSentinel:
+    def test_array_stats_matches_numpy(self):
+        a = np.array([[1.0, -3.0, np.nan], [np.inf, 0.5, -np.inf]],
+                     np.float32)
+        row = np.asarray(debugging.array_stats(jnp.asarray(a)))
+        finite = a[np.isfinite(a)]
+        assert row[0] == finite.size
+        assert row[1] == 1 and row[2] == 2
+        np.testing.assert_allclose(row[3], np.abs(finite).max(), rtol=1e-6)
+        np.testing.assert_allclose(row[4], finite.mean(), rtol=1e-6)
+        np.testing.assert_allclose(row[5], np.sqrt((finite ** 2).sum()),
+                                   rtol=1e-6)
+
+    def test_merge_rows_equals_stats_of_concat(self):
+        rng = np.random.RandomState(1)
+        a = rng.randn(13).astype(np.float32)
+        b = rng.randn(7).astype(np.float32) * 10
+        merged = np.asarray(debugging.merge_stat_rows(
+            [debugging.array_stats(jnp.asarray(a)),
+             debugging.array_stats(jnp.asarray(b))]))
+        whole = np.asarray(debugging.array_stats(
+            jnp.asarray(np.concatenate([a, b]))))
+        np.testing.assert_allclose(merged, whole, rtol=1e-5, atol=1e-6)
+
+    def test_eager_collection_parity_with_numpy(self):
+        paddle.seed(0)
+        net = Net()
+        h = debugging.check_layer_numerics(net)
+        x, _ = _batch()
+        with debugging.collect_stats() as col:
+            y = net(x)
+        tree = col.tree()
+        h.remove()
+        # fc1 row must equal numpy stats of x @ W1 + b1
+        z = np.asarray(x._data) @ np.asarray(net.fc1.weight._data) \
+            + np.asarray(net.fc1.bias._data)
+        r = tree.row("Net/fc1")
+        assert r["finite"] == z.size and r["nan"] == 0 and r["inf"] == 0
+        np.testing.assert_allclose(r["absmax"], np.abs(z).max(), rtol=1e-5)
+        np.testing.assert_allclose(r["mean"], z.mean(), rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(r["l2"], np.sqrt((z ** 2).sum()),
+                                   rtol=1e-5)
+        # root row == stats of the model output
+        np.testing.assert_allclose(
+            tree.row("Net")["l2"],
+            np.sqrt((np.asarray(y._data) ** 2).sum()), rtol=1e-5)
+        # removal: no rows recorded afterwards
+        with debugging.collect_stats() as col2:
+            net(x)
+        assert col2.tree() is None
+
+    def test_instrumentation_idempotent(self):
+        net = Net()
+        h1 = debugging.check_layer_numerics(net)
+        h2 = debugging.check_layer_numerics(net)   # second install: no-op
+        assert h2.paths == []
+        x, _ = _batch()
+        with debugging.collect_stats() as col:
+            net(x)
+        assert len(col.paths) == len(set(col.paths))  # no duplicate rows
+        h1.remove()
+
+
+# ---------------------------------------------------------------- TrainStep
+
+class TestTrainStepNumerics:
+    def test_stats_tree_parity_and_lazy_fetch(self):
+        paddle.seed(0)
+        net = Net()
+        w1 = np.asarray(net.fc1.weight._data).copy()
+        b1 = np.asarray(net.fc1.bias._data).copy()
+        opt = paddle.optimizer.SGD(learning_rate=0.0,
+                                   parameters=net.parameters())
+        cfg = debugging.NumericsConfig(every_n_steps=0)   # manual fetch only
+        step = TrainStep(net, opt, lambda x, y: nn.MSELoss()(net(x), y),
+                         numerics=cfg)
+        x, y = _batch()
+        step(x, y)
+        # not fetching: the aux stays a device array and no detector ran —
+        # the "zero per-step host syncs" contract
+        assert isinstance(step._last_aux["stats"], jax.Array)
+        assert cfg.detector.events == []
+        tree = step.numerics_stats()
+        # lr=0: the traced forward used exactly the initial params
+        z = np.asarray(x._data) @ w1 + b1
+        r = tree.row("Net/fc1")
+        np.testing.assert_allclose(r["absmax"], np.abs(z).max(), rtol=1e-5)
+        np.testing.assert_allclose(r["l2"], np.sqrt((z ** 2).sum()),
+                                   rtol=1e-5)
+        # grad rows exist and the global grad norm is finite
+        assert any(p.startswith("grad:") for p in tree.paths)
+        assert np.isfinite(float(np.asarray(step._last_aux["grad_norm"])))
+
+    def test_injected_nan_names_layer_dumps_and_replays(self, tmp_path):
+        dump_dir = str(tmp_path / "dumps")
+        paddle.seed(0)
+        net = Net()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=net.parameters())
+        cfg = debugging.NumericsConfig(every_n_steps=1, dump_dir=dump_dir)
+        step = TrainStep(net, opt, lambda x, y: nn.MSELoss()(net(x), y),
+                         numerics=cfg)
+        x, y = _batch()
+        step(x, y)
+        assert cfg.detector.events == []
+        # poison fc1: the sentinel must name THAT layer first
+        w = np.asarray(net.fc1.weight._data).copy()
+        w[0, 0] = np.nan
+        net.fc1.weight._data = jnp.asarray(w)
+        net.fc1.weight._node = None
+        step(x, y)
+        kinds = [(e.kind, e.path) for e in cfg.detector.events]
+        assert kinds[0] == ("nan", "Net/fc1")
+        assert ("nan", "grad:Net/fc1") in kinds
+        # skip_nonfinite_updates held: params did NOT ingest the NaN'd grads
+        w_after = np.asarray(net.fc1.weight._data)
+        assert np.isnan(w_after[0, 0])          # the injected one persists
+        assert np.isfinite(w_after[1:]).all()   # but the update was skipped
+        # dump written with pre-step state; replay reproduces the same rows
+        dumps = os.listdir(dump_dir)
+        assert len(dumps) == 1 and dumps[0].startswith("step2_nan")
+        d = debugging.load_dump(os.path.join(dump_dir, dumps[0]))
+        assert np.isnan(d.params["fc1.weight"][0, 0])
+        net2, loss2 = _replay_factory()
+        res = debugging.replay(d, net2, loss2)
+        assert res.matches is True
+        bad = [p for p, _ in res.stats.nonfinite_rows()]
+        assert "Net/fc1" in bad and "grad:Net/fc1" in bad
+        assert not np.isfinite(res.loss)
+
+    def test_replay_cli(self, tmp_path):
+        dump_dir = str(tmp_path / "dumps")
+        paddle.seed(0)
+        net = Net()
+        opt = paddle.optimizer.SGD(learning_rate=1e-2,
+                                   parameters=net.parameters())
+        cfg = debugging.NumericsConfig(every_n_steps=1, dump_dir=dump_dir)
+        step = TrainStep(net, opt, lambda x, y: nn.MSELoss()(net(x), y),
+                         numerics=cfg)
+        w = np.asarray(net.fc2.weight._data).copy()
+        w[0, 0] = np.inf
+        net.fc2.weight._data = jnp.asarray(w)
+        net.fc2.weight._node = None
+        step(*_batch())
+        dump_path = os.path.join(dump_dir, os.listdir(dump_dir)[0])
+        spec = importlib.util.spec_from_file_location(
+            "replay_dump", os.path.join(TOOLS, "replay_dump.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rc = mod.main([dump_path, "--model",
+                       "test_numerics_debug:_replay_factory", "--json"])
+        assert rc == 0
+
+    def test_run_steps_carries_stats(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=net.parameters())
+        step = TrainStep(net, opt, lambda x, y: nn.MSELoss()(net(x), y),
+                         numerics=debugging.NumericsConfig())
+        rng = np.random.RandomState(0)
+        xs = paddle.to_tensor(rng.randn(3, 8, 4).astype("float32"))
+        ys = paddle.to_tensor(rng.randn(3, 8, 2).astype("float32"))
+        losses = step.run_steps(3, xs, ys)
+        assert losses.shape == [3]
+        tree = step.numerics_stats()
+        assert tree is not None and "Linear" in tree.paths
+        assert tree.row("Linear")["nan"] == 0
+
+    def test_grad_accum_merges_micro_stats(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.0,
+                                   parameters=net.parameters())
+        step = TrainStep(net, opt, lambda x, y: nn.MSELoss()(net(x), y),
+                         numerics=debugging.NumericsConfig(),
+                         grad_accum_steps=2)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(8, 4).astype("float32"))
+        y = paddle.to_tensor(rng.randn(8, 2).astype("float32"))
+        step(x, y)
+        tree = step.numerics_stats()
+        r = tree.row("Linear")
+        # both microbatches' outputs counted: 8*2 elements total
+        assert r["finite"] == 16
+        z = np.asarray(x._data) @ np.asarray(net.weight._data) \
+            + np.asarray(net.bias._data)
+        np.testing.assert_allclose(r["l2"], np.sqrt((z ** 2).sum()),
+                                   rtol=1e-5)
+
+    def test_no_host_transfers_in_compiled_step(self):
+        """The 'zero per-step host syncs' contract, verified on the lowered
+        HLO: enabling numerics adds the stats array to the step's RESULTS
+        (fetched lazily by the host) but no outfeed/host custom-calls into
+        the program body."""
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        step = TrainStep(net, opt, lambda x, y: nn.MSELoss()(net(x), y),
+                         numerics=debugging.NumericsConfig())
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(4, 4).astype(np.float32))
+        y = jnp.asarray(rng.randn(4, 2).astype(np.float32))
+        flat, treedef = jax.tree.flatten((x, y))
+        pure = step._build_pure(treedef)
+        if step._opt_state is None:
+            step._opt_state = step._init_opt_state()
+        key = jax.random.PRNGKey(0)
+        hlo = jax.jit(pure).lower(
+            tuple(p._data for p in step._params), tuple(step._opt_state),
+            None, jnp.int32(1), jnp.float32(0.1), key, x, y).as_text()
+        for marker in ("outfeed", "infeed", "send", "recv",
+                       "host_callback", "io_callback"):
+            assert marker not in hlo.lower(), f"host transfer: {marker}"
+
+    def test_raise_on_nonfinite(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=1e-2,
+                                   parameters=net.parameters())
+        cfg = debugging.NumericsConfig(every_n_steps=1,
+                                       raise_on_nonfinite=True)
+        step = TrainStep(net, opt, lambda x, y: nn.MSELoss()(net(x), y),
+                         numerics=cfg)
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 4).astype("float32")
+        x[0, 0] = np.nan
+        with pytest.raises(FloatingPointError, match="Linear"):
+            step(paddle.to_tensor(x),
+                 paddle.to_tensor(rng.randn(4, 2).astype("float32")))
+
+
+# ---------------------------------------------------------------- detector
+
+class TestAnomalyDetector:
+    def test_grad_explosion_zscore(self):
+        det = debugging.AnomalyDetector(grad_z=4.0, min_history=5)
+        for i in range(8):
+            assert det.observe(i, grad_norm=1.0 + 0.01 * i) == []
+        evs = det.observe(9, grad_norm=100.0)
+        assert [e.kind for e in evs] == ["grad_explosion"]
+        assert evs[0].details["zscore"] > 4.0
+
+    def test_loss_spike_and_nonfinite_loss(self):
+        det = debugging.AnomalyDetector(loss_z=4.0, min_history=4)
+        for i in range(6):
+            assert det.observe(i, loss=2.0 - 0.1 * i) == []
+        assert [e.kind for e in det.observe(7, loss=50.0)] == ["loss_spike"]
+        det2 = debugging.AnomalyDetector()
+        evs = det2.observe(0, loss=float("nan"))
+        assert evs and evs[0].kind == "loss_spike"
+
+    def test_dead_layer_fires_once(self):
+        det = debugging.AnomalyDetector(dead_absmax=1e-8)
+        dead = debugging.StatsTree(
+            ["M/a", "grad:M/a"],
+            np.array([[10, 0, 0, 0.0, 0.0, 0.0],
+                      [10, 0, 0, 0.0, 0.0, 0.0]], np.float32))
+        evs = det.observe(1, tree=dead)
+        # grad rows are exempt from dead-layer (zero grads are normal)
+        assert [(e.kind, e.path) for e in evs] == [("dead_layer", "M/a")]
+        assert det.observe(2, tree=dead) == []     # fires once
+        alive = debugging.StatsTree(
+            ["M/a", "grad:M/a"],
+            np.array([[10, 0, 0, 1.0, 0.1, 1.0],
+                      [10, 0, 0, 1.0, 0.1, 1.0]], np.float32))
+        assert det.observe(3, tree=alive) == []
+        assert [e.kind for e in det.observe(4, tree=dead)] == ["dead_layer"]
+
+    def test_monitor_records_numerics(self, tmp_path):
+        from paddle_tpu.profiler import StepMonitor
+        jsonl = str(tmp_path / "m.jsonl")
+        mon = StepMonitor(jsonl_path=jsonl)
+        ev = debugging.NumericsEvent("nan", 7, path="M/a", message="boom")
+        mon.record_numerics(step=7, loss=1.5, grad_norm=2.5, events=[ev])
+        assert len(mon.numerics_events) == 1
+        rows = [json.loads(l) for l in open(jsonl)]
+        assert rows[0]["numerics"]["loss"] == 1.5
+        assert rows[0]["numerics"]["events"][0]["kind"] == "nan"
+        txt = mon.metrics_text()
+        assert "numerics_events_total 1" in txt
+        assert "paddle_tpu_grad_norm 2.5" in txt
+
+
+# ---------------------------------------------------------------- GradScaler
+
+class TestGradScalerJit:
+    def _data(self):
+        rng = np.random.RandomState(0)
+        xs = [rng.randn(8, 4).astype("float32") for _ in range(6)]
+        ys = [rng.randn(8, 2).astype("float32") for _ in range(6)]
+        xs[2][0, 0] = np.inf    # force one overflow step
+        return xs, ys
+
+    def _scaler(self):
+        return GradScaler(init_loss_scaling=2.0 ** 8, incr_every_n_steps=3,
+                          decr_every_n_nan_or_inf=1)
+
+    def test_trajectory_parity_eager_vs_jit(self):
+        xs, ys = self._data()
+
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        sc = self._scaler()
+        eager_scales = []
+        for x, y in zip(xs, ys):
+            loss = nn.MSELoss()(net(paddle.to_tensor(x)), paddle.to_tensor(y))
+            sc.scale(loss).backward()
+            sc.step(opt)
+            sc.update()
+            opt.clear_grad()
+            eager_scales.append(sc.get_loss_scaling())
+
+        paddle.seed(0)
+        net2 = nn.Linear(4, 2)
+        opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=net2.parameters())
+        sc2 = self._scaler()
+        step = TrainStep(net2, opt2,
+                         lambda x, y: nn.MSELoss()(net2(x), y), scaler=sc2)
+        jit_scales = []
+        for x, y in zip(xs, ys):
+            step(paddle.to_tensor(x), paddle.to_tensor(y))
+            jit_scales.append(sc2.get_loss_scaling())
+
+        # the decrease at the overflow step and the increase after
+        # incr_every_n good steps land identically
+        assert jit_scales == eager_scales
+        assert 128.0 in jit_scales and 256.0 in jit_scales
+        np.testing.assert_allclose(np.asarray(net.weight._data),
+                                   np.asarray(net2.weight._data),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_eager_unscale_is_one_fused_reduction(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        sc = GradScaler(init_loss_scaling=4.0)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 4).astype("float32"))
+        loss = nn.MSELoss()(net(x), paddle.to_tensor(
+            np.zeros((4, 2), np.float32)))
+        sc.scale(loss).backward()
+        sc.unscale_(opt)
+        # the sentinel is a DEVICE scalar until someone reads it
+        assert isinstance(sc._found_inf_arr, jax.Array)
+        assert sc._found_inf is False
+        sc.update()
+        assert sc.get_loss_scaling() == 4.0   # good step, no change yet
+
+
+# ---------------------------------------------------------------- facades
+
+class TestAmpDebuggingFacade:
+    def test_check_numerics_counts(self):
+        from paddle_tpu.amp import debugging as amp_dbg
+        t = paddle.to_tensor(np.array([1.0, np.nan, np.inf, np.nan],
+                                      np.float32))
+        with pytest.raises(FloatingPointError, match="2 NaN and 1 Inf"):
+            amp_dbg.check_numerics(t, "relu", "out")
+        clean = paddle.to_tensor(np.ones((3,), np.float32))
+        assert amp_dbg.check_numerics(clean) is clean
+        ints = paddle.to_tensor(np.arange(3, dtype=np.int32))
+        assert amp_dbg.check_numerics(ints) is ints
+
+    def test_tensor_checker_config_maps_to_numerics(self):
+        from paddle_tpu.amp import debugging as amp_dbg
+        cfg = amp_dbg.TensorCheckerConfig(
+            enable=True, debug_mode=amp_dbg.DebugMode.CHECK_NAN_INF_AND_ABORT,
+            output_dir="/tmp/x")
+        ncfg = cfg.to_numerics_config()
+        assert isinstance(ncfg, debugging.NumericsConfig)
+        assert ncfg.raise_on_nonfinite and ncfg.dump_dir == "/tmp/x"
+        assert amp_dbg.TensorCheckerConfig(enable=False) \
+            .to_numerics_config() is None
+
+    def test_enable_tensor_checker_flags(self):
+        from paddle_tpu.amp import debugging as amp_dbg
+        from paddle_tpu.core import flags
+        cfg = amp_dbg.TensorCheckerConfig(enable=True)
+        amp_dbg.enable_tensor_checker(cfg)
+        try:
+            assert flags.get_flags("FLAGS_check_nan_inf")[
+                "FLAGS_check_nan_inf"] is True
+            assert amp_dbg.get_tensor_checker_config() is cfg
+        finally:
+            amp_dbg.disable_tensor_checker()
+        assert amp_dbg.get_tensor_checker_config() is None
+
+    def test_check_layer_numerics_alias(self):
+        from paddle_tpu.amp import debugging as amp_dbg
+        net = Net()
+        h = amp_dbg.check_layer_numerics(net)
+        assert "Net/fc1" in h.paths
+        h.remove()
+
+
+# ---------------------------------------------------------------- callback
+
+class TestNumericsCallback:
+    def test_eager_regime_detects_poisoned_params(self):
+        from paddle_tpu.hapi.callbacks import NumericsCallback
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        model = paddle.Model(net)
+        model.prepare(optimizer=paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net.parameters()),
+            loss=nn.MSELoss(), use_fused_step=False)
+        cb = NumericsCallback(every_n_steps=1)
+        cb.set_model(model)
+        cb.on_train_batch_end(0, {"loss": 1.0})
+        assert cb.events == []
+        w = np.asarray(net.weight._data).copy()
+        w[0, 0] = np.nan
+        net.weight._data = jnp.asarray(w)
+        net.weight._node = None
+        cb.on_train_batch_end(1, {"loss": 1.0})
+        assert any(e.kind == "nan" and "Linear" in (e.path or "")
+                   for e in cb.events)
+
+    def test_fused_regime_attaches_to_trainstep(self):
+        from paddle_tpu.hapi.callbacks import NumericsCallback
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        model = paddle.Model(net)
+        model.prepare(optimizer=paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net.parameters()),
+            loss=nn.MSELoss())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 4).astype("float32"))
+        y = paddle.to_tensor(rng.randn(4, 2).astype("float32"))
+        cb = NumericsCallback(every_n_steps=1)
+        cb.set_model(model)
+        model.train_batch([x], y)            # builds the fused TrainStep
+        cb.on_train_batch_end(0, {"loss": 1.0})
+        ts = model._fused_step
+        assert ts is not None and ts._numerics is cb.numerics
+        model.train_batch([x], y)            # recompiles with stats outputs
+        assert ts.numerics_stats() is not None
+
+
+# ---------------------------------------------------------------- dump bits
+
+class TestDumpFormat:
+    def test_tree_spec_roundtrip(self):
+        from paddle_tpu.debugging import tree_spec, tree_build
+        obj = ({"b": 1, "a": (2, [3, None])}, 4)
+        leaves, _ = jax.tree.flatten(obj)
+        rebuilt = tree_build(tree_spec(obj), list(leaves))
+        assert rebuilt == ({"a": (2, [3, None]), "b": 1}, 4)
+
+
+# ---------------------------------------------------------------- tier guard
+
+class TestCheckTiers:
+    def _mod(self):
+        spec = importlib.util.spec_from_file_location(
+            "check_tiers", os.path.join(TOOLS, "check_tiers.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_flags_unmarked_slow_and_budget(self, tmp_path):
+        ct = self._mod()
+        f = tmp_path / "dur.jsonl"
+        rows = [
+            {"nodeid": "t.py::fast", "duration": 1.0, "markers": []},
+            {"nodeid": "t.py::big_unmarked", "duration": 120.0,
+             "markers": ["heavy"]},
+            {"nodeid": "t.py::big_marked", "duration": 500.0,
+             "markers": ["slow"]},
+        ]
+        f.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        res = ct.check(ct.load_records([str(f)]), budget=780.0,
+                       slow_threshold=60.0)
+        assert not res["ok"]
+        assert [r["nodeid"] for r in res["unmarked_slow"]] == \
+            ["t.py::big_unmarked"]
+        # slow-marked tests are excluded from the tier-1 sum
+        assert res["tier1_total_s"] == 121.0
+        assert ct.main([str(f)]) == 1
+
+    def test_budget_overflow_and_merge(self, tmp_path):
+        ct = self._mod()
+        f1, f2 = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        f1.write_text(json.dumps(
+            {"nodeid": "t.py::x", "duration": 10.0, "markers": []}) + "\n")
+        f2.write_text(json.dumps(
+            {"nodeid": "t.py::x", "duration": 30.0, "markers": []}) + "\n")
+        recs = ct.load_records([str(f1), str(f2)])
+        assert recs[0]["duration"] == 30.0       # max across runs
+        res = ct.check(recs, budget=20.0, slow_threshold=60.0)
+        assert res["over_budget"] and not res["ok"]
+        ok = ct.check(recs, budget=40.0, slow_threshold=60.0)
+        assert ok["ok"]
+
+    @pytest.mark.slow
+    def test_conftest_records_durations(self, tmp_path):
+        """The recording hook end-to-end: run one trivial test under the
+        env var (tests/conftest.py loaded via PYTEST_PLUGINS) and feed the
+        ledger to the checker."""
+        import subprocess
+        import sys
+        dur = tmp_path / "d.jsonl"
+        test = tmp_path / "test_tiny.py"
+        test.write_text("def test_ok():\n    assert True\n")
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env = dict(os.environ, PADDLE_TPU_TIER_DURATIONS=str(dur),
+                   JAX_PLATFORMS="cpu", PYTEST_PLUGINS="conftest",
+                   PYTHONPATH=repo_root + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", str(test), "-q", "-p",
+             "no:cacheprovider"],
+            cwd=os.path.dirname(__file__), env=env, capture_output=True,
+            text=True, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        rows = [json.loads(l) for l in open(dur)]
+        assert rows and rows[0]["nodeid"].endswith("test_ok")
+        ct = self._mod()
+        assert ct.main([str(dur)]) == 0

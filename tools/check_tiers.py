@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Tier-budget checker — keep the tier-1 suite inside its wall-time cap.
+
+The tier-1 wrapper runs ``pytest -m 'not slow'`` under a hard timeout
+(ROADMAP.md: 870 s). Tests drift slower over PRs; when one quietly crosses
+the line the whole tier starts truncating and DOTS_PASSED collapses. This
+tool enforces the tier contract from MEASURED durations:
+
+  1. every test whose recorded wall time exceeds --slow-threshold must
+     carry the ``slow`` marker (it does not belong in tier-1), and
+  2. the summed duration of all non-slow tests must stay under --budget.
+
+Durations come from JSONL files the test harness records when
+``PADDLE_TPU_TIER_DURATIONS=<path>`` is set (see tests/conftest.py):
+one ``{"nodeid", "duration", "markers", "outcome"}`` row per test call.
+Multiple files merge (max duration per nodeid — the safe estimate across
+runs). ``tools/run_tier1.sh`` wires recording + checking around the
+canonical tier-1 command.
+
+    python tools/check_tiers.py /tmp/tier_durations.jsonl \
+        [--budget 780] [--slow-threshold 60] [--json]
+
+Exit status: 0 = contract holds, 1 = violations, 2 = no usable records.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_records(paths):
+    """Merge duration rows: max duration per nodeid, union of markers."""
+    recs = {}
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                nid = row.get("nodeid")
+                if not nid or "duration" not in row:
+                    continue
+                cur = recs.get(nid)
+                if cur is None or row["duration"] > cur["duration"]:
+                    markers = set(row.get("markers") or [])
+                    if cur:
+                        markers |= set(cur.get("markers") or [])
+                    recs[nid] = {"nodeid": nid,
+                                 "duration": float(row["duration"]),
+                                 "markers": sorted(markers),
+                                 "outcome": row.get("outcome")}
+                else:
+                    cur["markers"] = sorted(
+                        set(cur.get("markers") or [])
+                        | set(row.get("markers") or []))
+    return list(recs.values())
+
+
+def check(records, *, budget: float, slow_threshold: float) -> dict:
+    unmarked_slow = []       # should carry `slow` but don't
+    tier1 = []               # everything tier-1 actually collects
+    for r in records:
+        marks = set(r["markers"])
+        if "slow" in marks:
+            continue
+        tier1.append(r)
+        if r["duration"] > slow_threshold:
+            unmarked_slow.append(r)
+    tier1_total = sum(r["duration"] for r in tier1)
+    return {
+        "n_records": len(records),
+        "n_tier1": len(tier1),
+        "tier1_total_s": round(tier1_total, 1),
+        "budget_s": budget,
+        "over_budget": tier1_total > budget,
+        "slow_threshold_s": slow_threshold,
+        "unmarked_slow": sorted(unmarked_slow,
+                                key=lambda r: -r["duration"]),
+        "slowest_tier1": sorted(tier1, key=lambda r: -r["duration"])[:10],
+        "ok": tier1_total <= budget and not unmarked_slow,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("durations", nargs="+",
+                    help="JSONL duration files (PADDLE_TPU_TIER_DURATIONS)")
+    ap.add_argument("--budget", type=float, default=780.0,
+                    help="max summed seconds for non-slow tests "
+                         "(default 780 = 90%% of the 870s tier-1 cap)")
+    ap.add_argument("--slow-threshold", type=float, default=60.0,
+                    help="a single test over this must be marked slow")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    records = load_records(args.durations)
+    if not records:
+        print("check_tiers: no duration records found", file=sys.stderr)
+        return 2
+    result = check(records, budget=args.budget,
+                   slow_threshold=args.slow_threshold)
+
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(f"check_tiers: {result['n_tier1']} tier-1 tests, "
+              f"{result['tier1_total_s']}s total "
+              f"(budget {result['budget_s']}s)")
+        for r in result["unmarked_slow"]:
+            print(f"  VIOLATION: {r['nodeid']} took {r['duration']:.1f}s "
+                  f"(> {args.slow_threshold}s) without the `slow` marker")
+        if result["over_budget"]:
+            print(f"  VIOLATION: non-slow total {result['tier1_total_s']}s "
+                  f"exceeds budget {result['budget_s']}s — slowest:")
+            for r in result["slowest_tier1"]:
+                print(f"    {r['duration']:8.1f}s  {r['nodeid']}")
+        if result["ok"]:
+            print("  OK: tier contract holds")
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

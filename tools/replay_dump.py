@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Replay a paddle_tpu numerics anomaly dump standalone.
+
+When a TrainStep with numerics enabled hits a NaN/Inf (or any other
+NumericsEvent) it writes the offending batch, parameters, optimizer state,
+RNG key and stats tree to ``<dump_dir>/step<K>_<kind>/``. This CLI rebuilds
+the model, loads that state and re-runs the step's forward+backward with
+the per-layer sentinels installed — reproducing the same bad value and
+printing which layer produced it.
+
+    python tools/replay_dump.py dumps/step7312_nan \
+        --model my_project.train:build_model [--no-grads] [--json]
+
+``--model pkg.mod:factory`` names a zero-arg callable returning either
+``(model, loss_fn)`` or just the model (then --loss names the loss factory
+``pkg.mod:fn`` where fn(model) -> loss_fn, or the model itself is called
+as ``loss = model(*batch)``).
+
+Exit status: 0 when the replay reproduces the dumped non-finite rows
+(or the dump had none), 1 on a mismatch.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _resolve(spec: str):
+    mod, _, attr = spec.partition(":")
+    if not attr:
+        raise SystemExit(f"--model/--loss must be 'pkg.mod:callable', got {spec!r}")
+    return getattr(importlib.import_module(mod), attr)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("dump_dir", help="one dump directory (step<K>_<kind>/)")
+    ap.add_argument("--model", required=True,
+                    help="pkg.mod:factory -> model or (model, loss_fn)")
+    ap.add_argument("--loss", default=None,
+                    help="pkg.mod:fn with fn(model) -> loss_fn(*batch)")
+    ap.add_argument("--no-grads", action="store_true",
+                    help="forward only (skip backward / grad rows)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu import debugging
+
+    dump = debugging.load_dump(args.dump_dir)
+    factory = _resolve(args.model)
+    built = factory()
+    if isinstance(built, tuple):
+        model, loss_fn = built
+    else:
+        model = built
+        if args.loss:
+            loss_fn = _resolve(args.loss)(model)
+        else:
+            loss_fn = model
+    res = debugging.replay(dump, model, loss_fn,
+                           compute_grads=not args.no_grads)
+
+    if args.json:
+        print(json.dumps({
+            "dump": args.dump_dir,
+            "step": dump.step,
+            "dumped_events": dump.events,
+            "replay_loss": res.loss,
+            "matches": res.matches,
+            "stats": res.stats.to_dict() if res.stats else None,
+            "replay_events": [e.to_dict() for e in res.events],
+        }, indent=2))
+    else:
+        print(f"dump {args.dump_dir} (step {dump.step})")
+        print(f"  dumped events : " + "; ".join(
+            f"{e['kind']}@{e.get('path')}" for e in dump.events))
+        print(f"  replay loss   : {res.loss}")
+        if res.stats is not None:
+            bad = res.stats.nonfinite_rows()
+            if bad:
+                print("  reproduced non-finite rows:")
+                for p, r in bad:
+                    print(f"    {p}: {int(r['nan'])} NaN / {int(r['inf'])} Inf")
+            else:
+                print("  no non-finite rows reproduced")
+            print()
+            print(res.stats.format())
+        print(f"  matches dump  : {res.matches}")
+    return 0 if res.matches in (True, None) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Tier-1 wrapper: the canonical ROADMAP.md tier-1 run, plus the tier-budget
+# guard. Records per-test wall times (tests/conftest.py JSONL hook) and then
+# runs tools/check_tiers.py so a test that outgrew the 870s cap fails the
+# wrapper loudly instead of silently truncating the suite.
+#
+#   tools/run_tier1.sh [extra pytest args...]
+#
+# Exit status: the pytest status, OR the checker's when pytest passed.
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+# only reset the ledger when it's our scratch default — a user-provided
+# PADDLE_TPU_TIER_DURATIONS accumulates across runs (check_tiers merges by
+# max duration per test)
+if [ -z "${PADDLE_TPU_TIER_DURATIONS:-}" ]; then
+    DUR=/tmp/_tier1_durations.jsonl
+    rm -f "$DUR"
+else
+    DUR="$PADDLE_TPU_TIER_DURATIONS"
+fi
+rm -f /tmp/_t1.log
+
+timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
+    PADDLE_TPU_TIER_DURATIONS="$DUR" \
+    python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@" 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+
+if [ -s "$DUR" ]; then
+    python tools/check_tiers.py "$DUR" \
+        --budget "${TIER1_BUDGET:-780}" \
+        --slow-threshold "${TIER1_SLOW_THRESHOLD:-60}"
+    crc=$?
+    [ "$rc" -eq 0 ] && rc=$crc
+else
+    echo "check_tiers: no durations recorded (suite killed before any test?)"
+fi
+exit $rc
