@@ -81,6 +81,11 @@ class Config:
         self._glog_info = False
 
     def enable_profile(self):
+        """reference: AnalysisConfig::EnableProfile — per-run latency
+        profiling. Here it attaches request-level ServingMetrics to the
+        predictor: every run() observes its (synced) wall time into a
+        log-bucket latency histogram; read `predictor.profile_summary()`
+        (p50/p90/p99 + counters) or scrape `predictor.metrics_text()`."""
         self._profile = True
 
     def model_dir(self):
@@ -95,7 +100,8 @@ class Config:
     def summary(self) -> str:
         rows = [("model_prefix", self._prefix), ("device", self._use_device),
                 ("ir_optim", self._ir_optim), ("memory_optim", self._memory_optim),
-                ("cpu_math_threads", self._cpu_math_threads)]
+                ("cpu_math_threads", self._cpu_math_threads),
+                ("profile", self._profile)]
         return "\n".join(f"{k:>20}: {v}" for k, v in rows)
 
 
@@ -155,6 +161,10 @@ class Predictor:
                                self._meta["feed_shapes"],
                                self._meta["feed_dtypes"])}
         self._outputs = {n: Tensor(n) for n in self._meta["fetch_names"]}
+        self._metrics = None
+        if config._profile:
+            from .serving import ServingMetrics
+            self._metrics = ServingMetrics()
 
     def get_input_names(self) -> List[str]:
         return list(self._meta["feed_names"])
@@ -172,6 +182,8 @@ class Predictor:
         """ZeroCopyRun (analysis_predictor.cc:885): executes the AOT module
         on the bound input buffers. With `inputs` given, behaves like the
         legacy run(feeds)->fetches API."""
+        import time as _time
+        t0 = _time.perf_counter() if self._metrics is not None else None
         if inputs is not None:
             for n, a in zip(self._meta["feed_names"], inputs):
                 self._inputs[n].copy_from_cpu(a)
@@ -185,9 +197,29 @@ class Predictor:
         outs = outs if isinstance(outs, (tuple, list)) else (outs,)
         for n, o in zip(self._meta["fetch_names"], outs):
             self._outputs[n]._buf = o
+        if self._metrics is not None:
+            # profile mode measures the DEVICE-complete call, not the
+            # dispatch: sync before closing the span (outside profile mode
+            # run() stays fully async until copy_to_cpu)
+            jax.block_until_ready(outs)
+            items = int(feeds[0].shape[0]) if feeds and feeds[0].ndim else 1
+            self._metrics.observe_call(_time.perf_counter() - t0,
+                                       items=items)
         if inputs is not None:
             return [np.asarray(o) for o in outs]
         return True
+
+    # -- enable_profile surface (reference: AnalysisConfig profiling) ----
+    def profile_summary(self) -> Optional[dict]:
+        """Aggregate run() latency/counters (Config.enable_profile());
+        None when profiling is off."""
+        return None if self._metrics is None else self._metrics.summary()
+
+    def metrics_text(self, prefix: str = "paddle_tpu_infer") -> str:
+        """Prometheus exposition of the per-run latency histogram +
+        counters — empty string when profiling is off."""
+        return "" if self._metrics is None else \
+            self._metrics.metrics_text(prefix=prefix)
 
     def clone(self):
         """Share-weights clone (reference AnalysisPredictor::Clone): the
@@ -198,6 +230,11 @@ class Predictor:
         p._meta = self._meta
         p._inputs = {n: Tensor(n, t._aval) for n, t in self._inputs.items()}
         p._outputs = {n: Tensor(n) for n in self._outputs}
+        if self._metrics is not None:     # profiling is per-predictor
+            from .serving import ServingMetrics
+            p._metrics = ServingMetrics()
+        else:
+            p._metrics = None
         return p
 
 
@@ -293,3 +330,10 @@ def _get_phi_kernel_name(op_name: str) -> str:
     """reference: internal helper mapping fluid op names to phi kernels;
     here ops ARE their kernel (one XLA lowering per op)."""
     return op_name
+
+
+# ---- request-level serving (exceeds reference: the reference snapshot has
+# no serving engine — fused_multi_transformer is driven by external
+# frontends; see inference/serving.py) ----
+from .serving import (ServingEngine, ServingConfig, ServingMetrics,  # noqa: E402,F401
+                      Request, RequestTrace, synthetic_traffic)

@@ -831,11 +831,22 @@ class GPTForCausalLM(Layer):
     def decode_static(self, state, max_new_tokens: int,
                       temperature: float = 0.0, top_k: int = 0,
                       top_p: float = 1.0, seed: int = 0,
-                      eos_token_id: int = None):
+                      eos_token_id: int = None, return_state: bool = False):
         """Continue from a `prefill_static` state: ONE compiled lax.scan of
         fixed-shape decode steps. Repeated calls (different seeds /
         sampling configs) reuse the SAME prefill — greedy output equals
-        the tail of `generate_static` on the same prompt."""
+        the tail of `generate_static` on the same prompt.
+
+        return_state=True additionally returns a RESUMABLE state: the next
+        decode_static call on it continues exactly where this one stopped
+        (the un-written last token rides along as `pending`, the EOS mask
+        as `done`, and ragged wpe positions offset by `generated`). Chunked
+        greedy decode is bit-identical to one decode of the summed length
+        — the serving engine decodes [1, chunk, chunk, ...] to measure
+        time-to-first-token truthfully and to stop early once every row
+        finished, with each chunk size compiling once. Sampled
+        (temperature > 0) chunked output differs from one-shot by design:
+        every call seeds its own PRNG stream."""
         import jax
         from jax import lax
         from ..jit.api import _swap_params, _trace_guard
@@ -843,6 +854,8 @@ class GPTForCausalLM(Layer):
 
         b, p_len = state["prompt"].shape
         L = state["max_len"]
+        resume = state.get("pending") is not None
+        gen0 = int(state.get("generated", 0))
         if max_new_tokens <= 0:
             raise ValueError("decode_static needs max_new_tokens >= 1 "
                              "(the state already holds the prompt)")
@@ -850,12 +863,15 @@ class GPTForCausalLM(Layer):
         # the KV cache (scan steps 1..max_new_tokens-1 write positions
         # p_len..p_len+max_new_tokens-2), so a state sized L admits
         # p_len + max_new_tokens - 1 cache rows — not p_len + max_new_tokens
-        # (ADVICE r5: the stricter check wasted the buffer's last row)
-        if p_len + max_new_tokens - 1 > L:
+        # (ADVICE r5: the stricter check wasted the buffer's last row).
+        # A resumed state's pending token occupies the cursor row first, so
+        # its `generated` count joins the prompt on the left side.
+        if p_len + gen0 + max_new_tokens - 1 > L:
             raise ValueError(
-                f"decode_static: prompt ({p_len}) + max_new_tokens "
-                f"({max_new_tokens}) needs {p_len + max_new_tokens - 1} "
-                f"cache rows, exceeding the prefill state's max_len ({L})")
+                f"decode_static: prompt ({p_len}) + generated ({gen0}) + "
+                f"max_new_tokens ({max_new_tokens}) needs "
+                f"{p_len + gen0 + max_new_tokens - 1} cache rows, "
+                f"exceeding the prefill state's max_len ({L})")
         params = list(self.parameters())
         cdt = self.gpt.wte.weight._data.dtype
         if str(cdt) != state["cdt"]:
@@ -903,43 +919,85 @@ class GPTForCausalLM(Layer):
             return sample_logits(last, key, temperature=temperature,
                                  top_k=top_k, top_p=top_p)
 
-        def run(pa, caches, last_logits, lens, key0):
-            key0, k1 = jax.random.split(key0)
-            nxt = pick(last_logits, k1)
-            done = (jnp.zeros((b,), bool) if eos_token_id is None
-                    else nxt == eos_token_id)
-
+        def body_fn(pa, lens):
+            # shared scan body: `step` counts generated tokens 1-indexed, so
+            # the token fed at `step` sits at sequence position
+            # lens + step - 1 in its (ragged) row
             def body(carry, step):
                 caches, cur, key, done = carry
-                # ragged rows continue from their TRUE length: the step-th
-                # generated token sits at position lens + step - 1
                 pos = None if lens is None else (lens + step - 1)[:, None]
                 logits, caches = model_step(pa, cur[:, None], caches, pos)
                 key, kk = jax.random.split(key)
                 new = pick(logits[:, -1].astype(jnp.float32), kk)
+                new = new.astype(jnp.int32)
                 if eos_token_id is not None:
                     new = jnp.where(done, jnp.asarray(eos_token_id,
                                                       new.dtype), new)
                     done = done | (new == eos_token_id)
                 return (caches, new, key, done), new
+            return body
 
-            (_, _, _, _), toks = lax.scan(
-                body, (caches, nxt, key0, done),
+        def run(pa, caches, last_logits, lens, done0, key0):
+            key0, k1 = jax.random.split(key0)
+            nxt = pick(last_logits, k1).astype(jnp.int32)
+            done = done0 if eos_token_id is None else \
+                (done0 | (nxt == eos_token_id))
+            (caches, _, _, done), toks = lax.scan(
+                body_fn(pa, lens), (caches, nxt, key0, done),
                 jnp.arange(1, max_new_tokens, dtype=jnp.int32))
-            return jnp.concatenate([nxt[:, None],
-                                    jnp.moveaxis(toks, 0, 1)],
-                                   axis=1).astype(jnp.int64)
+            out = jnp.concatenate([nxt[:, None], jnp.moveaxis(toks, 0, 1)],
+                                  axis=1).astype(jnp.int64)
+            # stateless callers get a tokens-only executable — the cache
+            # pytree must not ride out as live output buffers they drop
+            return (out, caches, done) if return_state else out
 
+        def run_resume(pa, caches, pending, lens, g0, done0, key0):
+            # the resumed chunk has no un-sampled logits to start from: it
+            # FEEDS the previous chunk's pending token first. The body's
+            # invariant is `step s feeds the s-th generated token` (at row
+            # position lens + s - 1); pending is token gen0, so this
+            # chunk's steps are gen0 .. gen0+max_new_tokens-1. gen0 rides
+            # in as a DATA input (g0), not a trace constant: one resume
+            # executable per chunk SIZE serves every resume depth, so a
+            # serving loop decoding [1, c, c, ...] compiles two decode
+            # programs total however long the schedule is.
+            (caches, _, _, done), toks = lax.scan(
+                body_fn(pa, lens),
+                (caches, pending.astype(jnp.int32), key0, done0),
+                g0 + jnp.arange(max_new_tokens, dtype=jnp.int32))
+            out = jnp.moveaxis(toks, 0, 1).astype(jnp.int64)
+            return (out, caches, done) if return_state else out
+
+        # return_state is part of the signature: the stateless executable
+        # returns ONLY the tokens (as before resume existed), the stateful
+        # one adds the cache pytree + done mask it hands to the next chunk
         sig = ("decode", b, p_len, L, int(max_new_tokens),
                float(temperature), int(top_k), float(top_p),
                None if eos_token_id is None else int(eos_token_id),
                str(cdt), "q8" if q8 else "full",
                "c8" if state["c8"] else "cfull",
-               "ragged" if ragged else "fixed")
-        fn = self._gen_cache_get(sig, lambda: jax.jit(run))
-        toks = fn(state["payload"], state["caches"], state["last_logits"],
-                  state.get("lens"), jax.random.PRNGKey(seed))
-        return Tensor(toks)
+               "ragged" if ragged else "fixed",
+               "resume" if resume else "fresh",
+               "st" if return_state else "nost")
+        fn = self._gen_cache_get(
+            sig, lambda: jax.jit(run_resume if resume else run))
+        done0 = state.get("done")
+        if done0 is None:
+            done0 = jnp.zeros((b,), bool)
+        args = (state["payload"], state["caches"],
+                state["pending"] if resume else state["last_logits"],
+                state.get("lens"))
+        if resume:
+            args += (jnp.int32(gen0),)
+        res = fn(*args, done0, jax.random.PRNGKey(seed))
+        if not return_state:
+            return Tensor(res)
+        toks, caches, done = res
+        new_state = dict(state)
+        new_state.update(caches=caches, pending=toks[:, -1], done=done,
+                         generated=gen0 + int(max_new_tokens),
+                         last_logits=None)
+        return Tensor(toks), new_state
 
     def _make_expand(self, q8, cdt):
         """The shared mixed-payload expander (full arrays pass through;
@@ -964,14 +1022,23 @@ class GPTForCausalLM(Layer):
 
     def _gen_cache_get(self, sig, build):
         """LRU-capped compiled-runner cache shared by every static-serving
-        entry point (generate_static/_ragged, prefill/decode_static)."""
+        entry point (generate_static/_ragged, prefill/decode_static). A
+        build here is a new serving executable — it feeds the process-wide
+        jit cache-miss counter so StepMonitor (and the serving engine's
+        steady-state guard) see serving compiles exactly like training
+        recompiles."""
         import collections
+        from ..jit.api import _note_cache_miss
         cache = getattr(self, "_gen_static_cache", None)
         if cache is None:
             cache = self._gen_static_cache = collections.OrderedDict()
         fn = cache.get(sig)
         if fn is None:
+            _note_cache_miss()
             fn = cache[sig] = build()
+            # 16 comfortably holds a serving engine's working set: one
+            # prefill + one fresh-decode + one resume-decode executable
+            # per chunk size (resume depth is a data input, not a sig key)
             while len(cache) > 16:
                 cache.popitem(last=False)
         else:

@@ -368,4 +368,5 @@ def annotate_layers(model, root: str = None) -> _AnnotationHandle:
 
 
 from .monitor import StepMonitor, shape_delta  # noqa: E402,F401
+from ._metrics import LogHistogram  # noqa: E402,F401
 from . import trace_analysis  # noqa: E402,F401

@@ -1,0 +1,159 @@
+"""Shared Prometheus-exposition primitives for the observability layer.
+
+One renderer serves every `/metrics` surface in the package —
+`StepMonitor.metrics_text()` (training step gauges, r7) and the serving
+layer's `ServingMetrics` (request histograms/gauges/counters) — so the
+exposition format cannot drift between them. The format is the Prometheus
+text format 0.0.4: `# HELP` + `# TYPE` headers, one sample per line,
+histograms as cumulative `_bucket{le="..."}` lines plus `_sum`/`_count`.
+
+`LogHistogram` is the latency aggregate the serving layer records into:
+log-spaced buckets (no per-observation retention — a serving process
+observes millions of requests), with p50/p90/p99 DERIVED from the bucket
+counts by linear interpolation inside the containing bucket. The relative
+error of a derived percentile is bounded by the bucket ratio
+(10^(1/per_decade) − 1: ~26% at the default 10/decade, ~12% at 20/decade);
+`tests/test_serving.py` checks the math against numpy on known samples.
+"""
+from __future__ import annotations
+
+import math
+from bisect import bisect_left
+from typing import Iterable, List, Optional, Sequence
+
+
+def format_value(v) -> str:
+    """One sample value: integers stay integral, floats use repr-shortest."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _header(prefix: str, name: str, kind: str, help_: str) -> List[str]:
+    full = f"{prefix}_{name}" if prefix else name
+    return [f"# HELP {full} {help_}", f"# TYPE {full} {kind}"]
+
+
+def gauge_lines(prefix: str, name: str, value, help_: str,
+                labels: Optional[dict] = None) -> List[str]:
+    """Render one gauge (or nothing when value is None)."""
+    if value is None:
+        return []
+    full = f"{prefix}_{name}" if prefix else name
+    lab = ""
+    if labels:
+        lab = "{" + ",".join(f'{k}="{v}"' for k, v in labels.items()) + "}"
+    return _header(prefix, name, "gauge", help_) + \
+        [f"{full}{lab} {format_value(value)}"]
+
+
+def counter_lines(prefix: str, name: str, value, help_: str) -> List[str]:
+    """Render one counter; by convention `name` should end in `_total`."""
+    if value is None:
+        return []
+    full = f"{prefix}_{name}" if prefix else name
+    return _header(prefix, name, "counter", help_) + \
+        [f"{full} {format_value(value)}"]
+
+
+def histogram_lines(prefix: str, name: str, hist: "LogHistogram",
+                    help_: str) -> List[str]:
+    """Render one histogram: cumulative le-buckets, +Inf, _sum, _count.
+    Empty buckets are elided (scrape size), but cumulativity and the
+    +Inf == _count invariant hold regardless."""
+    full = f"{prefix}_{name}" if prefix else name
+    lines = _header(prefix, name, "histogram", help_)
+    cum = 0
+    for bound, count in zip(hist.bounds, hist.counts):
+        cum += count
+        if count:
+            lines.append(
+                f'{full}_bucket{{le="{format_value(bound)}"}} {cum}')
+    lines.append(f'{full}_bucket{{le="+Inf"}} {hist.count}')
+    lines.append(f"{full}_sum {format_value(hist.sum)}")
+    lines.append(f"{full}_count {hist.count}")
+    return lines
+
+
+class LogHistogram:
+    """Fixed-memory latency histogram with log-spaced buckets.
+
+    Bucket upper bounds are lo·10^(k/per_decade) for k = 0..n (n chosen so
+    the last bound covers `hi`), plus an implicit +Inf overflow bucket.
+    `observe()` is O(log buckets); percentiles interpolate linearly inside
+    the containing bucket and clamp to the observed min/max so the edges
+    (p0/p100) are exact.
+    """
+
+    def __init__(self, lo: float = 1e-4, hi: float = 1e3,
+                 per_decade: int = 10,
+                 bounds: Optional[Sequence[float]] = None):
+        if bounds is not None:
+            self.bounds = [float(b) for b in bounds]
+        else:
+            if not (0 < lo < hi):
+                raise ValueError(f"need 0 < lo < hi, got {lo}, {hi}")
+            n = int(math.ceil(per_decade * math.log10(hi / lo))) + 1
+            self.bounds = [lo * 10.0 ** (k / per_decade) for k in range(n)]
+        self.counts = [0] * (len(self.bounds) + 1)   # last = overflow
+        self.count = 0
+        self.sum = 0.0
+        self._min: Optional[float] = None
+        self._max: Optional[float] = None
+
+    def observe(self, v: float):
+        v = float(v)
+        if v != v:       # refuse NaN loudly: it would poison sum/mean
+            raise ValueError("cannot observe NaN")
+        self.counts[bisect_left(self.bounds, v)] += 1
+        self.count += 1
+        self.sum += v
+        self._min = v if self._min is None else min(self._min, v)
+        self._max = v if self._max is None else max(self._max, v)
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+    def percentile(self, q: float) -> Optional[float]:
+        """q in [0, 1]. Derived from buckets — see class docstring for the
+        error bound."""
+        if not self.count:
+            return None
+        if not (0.0 <= q <= 1.0):
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        target = q * self.count
+        cum = 0.0
+        for i, c in enumerate(self.counts):
+            if not c:
+                continue
+            if cum + c >= target:
+                lower = self.bounds[i - 1] if i > 0 else \
+                    min(self._min, self.bounds[0])
+                upper = self.bounds[i] if i < len(self.bounds) else self._max
+                frac = (target - cum) / c
+                val = lower + frac * (upper - lower)
+                return min(max(val, self._min), self._max)
+            cum += c
+        return self._max
+
+    def quantiles(self, qs: Iterable[float]) -> dict:
+        return {q: self.percentile(q) for q in qs}
+
+    def summary(self) -> dict:
+        """The standard percentile triplet + count/mean — what a serving
+        report() embeds per latency series."""
+        return {"count": self.count,
+                "mean": self.mean,
+                "p50": self.percentile(0.50),
+                "p90": self.percentile(0.90),
+                "p99": self.percentile(0.99)}

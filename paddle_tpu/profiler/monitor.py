@@ -148,18 +148,29 @@ class StepMonitor:
             self.end_step(items=items, steps=steps)
 
     # ----------------------------------------------------------- compiles
-    def record_compile(self, kind: str, sig, prev_sig=None):
+    def record_compile(self, kind: str, sig, prev_sig=None,
+                       count: bool = True):
         """Called by the traced-step owner on a compile-cache miss. A miss
-        with a prior signature is a RECOMPILE: log the shape delta."""
-        self.compiles += 1
-        self._compiled_this_step += 1
+        with a prior signature is a RECOMPILE: log the shape delta.
+
+        count=False logs/records the shape-delta WARNING without feeding
+        the compiles/recompiles counters — for events where no executable
+        was actually (re)built, e.g. a serving request REFUSED because it
+        would have forced one. The numeric counters stay a pure signal of
+        real executable churn; the event stream carries the warning."""
+        if count:
+            self.compiles += 1
+            self._compiled_this_step += 1
         if prev_sig is not None:
-            self.recompiles += 1
+            if count:
+                self.recompiles += 1
             delta = shape_delta(prev_sig, sig)
             self.recompile_events.append(
                 {"step": self._steps + 1, "kind": kind, "delta": delta})
             if self.log_recompiles:
-                logger.warning("recompilation of %s at step %d: %s",
+                logger.warning("%s of %s at step %d: %s",
+                               "recompilation" if count
+                               else "refused shape change",
                                kind, self._steps + 1, delta)
 
     # ----------------------------------------------------------- numerics
@@ -273,17 +284,16 @@ class StepMonitor:
                     else None)}
 
     def metrics_text(self, prefix: str = "paddle_tpu") -> str:
-        """Prometheus-exposition-style dump of report() — the `/metrics`
-        payload a serving endpoint returns."""
+        """Prometheus-exposition dump of report() — the `/metrics` payload a
+        serving endpoint returns. Rendered by the shared profiler._metrics
+        formatter (the serving layer's ServingMetrics uses the same one, so
+        a frontend scrapes both blocks as one page)."""
+        from ._metrics import gauge_lines
         r = self.report()
         lines = []
 
         def gauge(name, val, help_):
-            if val is None:
-                return
-            lines.append(f"# HELP {prefix}_{name} {help_}")
-            lines.append(f"# TYPE {prefix}_{name} gauge")
-            lines.append(f"{prefix}_{name} {val}")
+            lines.extend(gauge_lines(prefix, name, val, help_))
 
         gauge("steps_total", r["steps"], "steps recorded")
         if r["step_ms"] is not None:

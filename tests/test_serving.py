@@ -1,0 +1,576 @@
+"""Serving observability: ServingEngine over the static decode stack,
+request metrics (histograms/gauges/counters + JSONL records), the shared
+Prometheus renderer, resumable decode_static, and the wired
+inference.Config.enable_profile().
+
+Engine acceptance (ISSUE 4): greedy outputs bit-identical to
+generate_static_ragged on the same prompts; ZERO jit cache misses across a
+steady-state serving loop after warmup; metrics_text() a valid Prometheus
+exposition carrying TTFT/TPOT/e2e histograms + queue/batch/KV gauges.
+"""
+import json
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import (Request, ServingConfig, ServingEngine,
+                                  ServingMetrics, synthetic_traffic)
+from paddle_tpu.jit.api import compile_cache_misses
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.profiler import LogHistogram, StepMonitor
+
+
+# ---------------------------------------------------------- LogHistogram
+
+class TestLogHistogram:
+    def test_percentiles_match_numpy_on_known_samples(self):
+        rng = np.random.RandomState(0)
+        xs = np.exp(rng.randn(2000) * 0.8 - 2.5)       # lognormal latencies
+        h = LogHistogram(lo=1e-4, hi=10.0, per_decade=20)
+        for x in xs:
+            h.observe(float(x))
+        for q in (0.5, 0.9, 0.99):
+            got = h.percentile(q)
+            want = float(np.percentile(xs, q * 100))
+            # derived-from-buckets error bound: one bucket's relative width
+            assert abs(got - want) / want < 10 ** (1 / 20) - 1, (q, got, want)
+
+    def test_edges_clamp_to_observed_extremes(self):
+        h = LogHistogram(lo=0.01, hi=10, per_decade=4)
+        for v in (0.5, 1.5, 3.0):
+            h.observe(v)
+        assert h.percentile(0.0) == 0.5
+        assert h.percentile(1.0) == 3.0
+        assert h.count == 3 and abs(h.sum - 5.0) < 1e-12
+        assert abs(h.mean - 5.0 / 3) < 1e-12
+
+    def test_overflow_and_underflow_buckets(self):
+        h = LogHistogram(lo=0.1, hi=1.0, per_decade=2)
+        h.observe(1e-5)                # below lo -> first bucket
+        h.observe(50.0)                # beyond hi -> +Inf bucket
+        assert h.counts[0] == 1 and h.counts[-1] == 1
+        assert h.percentile(1.0) == 50.0
+
+    def test_empty_histogram(self):
+        h = LogHistogram()
+        assert h.percentile(0.5) is None and h.mean is None
+        assert h.summary()["count"] == 0
+
+    def test_rejects_nan_and_bad_q(self):
+        h = LogHistogram()
+        with pytest.raises(ValueError):
+            h.observe(float("nan"))
+        h.observe(1.0)
+        with pytest.raises(ValueError):
+            h.percentile(1.5)
+
+
+# ------------------------------------------- Prometheus exposition format
+
+_SAMPLE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{le="[^"]+"\})? '
+    r'(-?\d+(\.\d+)?([eE][-+]?\d+)?|\+Inf|NaN)$')
+
+
+def _check_exposition(text):
+    """Validate Prometheus text format 0.0.4 invariants; returns
+    {metric_name: type}."""
+    types, helped = {}, set()
+    for line in text.strip().split("\n"):
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+        elif line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            types[name] = kind
+        else:
+            m = _SAMPLE.match(line)
+            assert m, f"malformed sample line: {line!r}"
+            base = m.group(1)
+            root = re.sub(r"_(bucket|sum|count)$", "", base)
+            assert base in types or root in types, f"no TYPE for {line!r}"
+    assert set(types) == helped, "HELP/TYPE mismatch"
+    return types
+
+
+def _histogram_invariants(text, name):
+    """Bucket lines cumulative + ascending le; +Inf equals _count."""
+    bucket_re = re.compile(
+        rf'^{re.escape(name)}_bucket{{le="([^"]+)"}} (\d+)$', re.M)
+    rows = [(le, int(c)) for le, c in bucket_re.findall(text)]
+    assert rows and rows[-1][0] == "+Inf"
+    counts = [c for _, c in rows]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    les = [float(le) for le, _ in rows[:-1]]
+    assert les == sorted(les), "le bounds must ascend"
+    count = int(re.search(rf"^{re.escape(name)}_count (\d+)$", text,
+                          re.M).group(1))
+    assert rows[-1][1] == count, "+Inf bucket must equal _count"
+
+
+class TestExpositionFormat:
+    def test_serving_metrics_text_is_valid(self):
+        met = ServingMetrics()
+        rng = np.random.RandomState(1)
+        for _ in range(50):
+            r = Request(id=0, prompt=np.arange(4), max_new_tokens=4,
+                        status="done", n_out=4)
+            t = float(rng.uniform(0.001, 2.0))
+            r.trace.t_enqueue, r.trace.t_admit = 0.0, 0.1 * t
+            r.trace.t_first_token, r.trace.t_finish = 0.5 * t, t
+            met.record_request(r)
+        met.record_batch(n_real=3, capacity=4, kv_used=30, kv_capacity=64,
+                         queue_depth=2)
+        text = met.metrics_text()
+        types = _check_exposition(text)
+        for h in ("ttft_seconds", "tpot_seconds", "e2e_seconds",
+                  "queue_seconds"):
+            assert types[f"paddle_tpu_serving_{h}"] == "histogram"
+            _histogram_invariants(text, f"paddle_tpu_serving_{h}")
+        for g in ("queue_depth", "batch_fill_ratio", "kv_slot_occupancy"):
+            assert types[f"paddle_tpu_serving_{g}"] == "gauge"
+        for c in ("requests_total", "rejected_total", "timeout_total",
+                  "tokens_in_total", "tokens_out_total"):
+            assert types[f"paddle_tpu_serving_{c}"] == "counter"
+        assert "paddle_tpu_serving_requests_total 50" in text
+
+    def test_step_monitor_shares_the_renderer(self):
+        mon = StepMonitor(items_per_step=4, track_memory=False)
+        with mon.step():
+            pass
+        types = _check_exposition(mon.metrics_text())
+        assert types["paddle_tpu_steps_total"] == "gauge"
+
+    def test_summary_percentile_triplets(self):
+        met = ServingMetrics()
+        met.observe_call(0.25, items=8)
+        s = met.summary()
+        assert s["completed_total"] == 1 and s["items_total"] == 8
+        assert s["tokens_out_total"] == 0          # rows are not tokens
+        assert abs(s["e2e_seconds"]["p50"] - 0.25) < 0.05
+
+
+# --------------------------------------------------- engine test fixtures
+
+CAP, NEW, BATCH = 8, 6, 2
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+                    max_position_embeddings=64, intermediate_size=64)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _engine(m, **kw):
+    base = dict(max_batch=BATCH, prompt_cap=CAP, max_new_tokens=NEW,
+                decode_chunk=3)
+    base.update(kw)
+    return ServingEngine(m, ServingConfig(**base))
+
+
+def _prompts(cfg, lens, seed=1):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(1, cfg.vocab_size, (len(lens), CAP)).astype(np.int64)
+    for r, ln in enumerate(lens):
+        ids[r, ln:] = 0
+    return ids
+
+
+# ------------------------------------------------------- resumable decode
+
+def test_decode_static_resume_greedy_parity(served_model):
+    """Chunked decode over return_state must replay the one-shot argmax
+    chain bit-for-bit (ragged positions offset by `generated`)."""
+    m, cfg = served_model
+    lens = [CAP, 5]
+    ids = _prompts(cfg, lens)
+    t = paddle.to_tensor(ids)
+    ref = m.generate_static_ragged(t, lens, max_new_tokens=NEW).numpy()[:, CAP:]
+    st = m.prefill_static(t, max_len=CAP + NEW, prompt_lens=np.int32(lens))
+    t1, st = m.decode_static(st, 1, return_state=True)
+    t2, st = m.decode_static(st, 2, return_state=True)
+    t3, st = m.decode_static(st, 3, return_state=True)
+    got = np.concatenate([t1.numpy(), t2.numpy(), t3.numpy()], axis=1)
+    np.testing.assert_array_equal(got, ref)
+    assert st["generated"] == NEW
+    with pytest.raises(ValueError, match="cache rows"):
+        m.decode_static(st, 100)       # resumed capacity accounting
+
+
+def test_decode_static_resume_carries_eos_mask(served_model):
+    m, cfg = served_model
+    lens = [CAP, 5]
+    ids = _prompts(cfg, lens)
+    t = paddle.to_tensor(ids)
+    ref = m.generate_static_ragged(t, lens, max_new_tokens=NEW).numpy()
+    eos = int(ref[0, CAP])             # row 0 "emits EOS" on token 1
+    refe = m.generate_static_ragged(t, lens, max_new_tokens=NEW,
+                                    eos_token_id=eos).numpy()[:, CAP:]
+    st = m.prefill_static(t, max_len=CAP + NEW, prompt_lens=np.int32(lens))
+    a, st = m.decode_static(st, 1, eos_token_id=eos, return_state=True)
+    b, st = m.decode_static(st, NEW - 1, eos_token_id=eos,
+                            return_state=True)
+    got = np.concatenate([a.numpy(), b.numpy()], axis=1)
+    np.testing.assert_array_equal(got, refe)
+    assert (got[0] == eos).all()       # done row kept emitting EOS
+
+
+# ------------------------------------------------------------ the engine
+
+def test_engine_greedy_parity_with_ragged(served_model):
+    """Acceptance: ServingEngine output == generate_static_ragged
+    bit-for-bit on identical prompts."""
+    m, cfg = served_model
+    lens = [CAP, 5]
+    ids = _prompts(cfg, lens)
+    eng = _engine(m)
+    for i in range(len(lens)):
+        eng.submit(ids[i, :lens[i]])
+    done = eng.drain()
+    assert [r.status for r in done] == ["done", "done"]
+    ref = m.generate_static_ragged(paddle.to_tensor(ids), lens,
+                                   max_new_tokens=NEW).numpy()[:, CAP:]
+    np.testing.assert_array_equal(np.stack([r.tokens for r in done]), ref)
+    # spans are complete and ordered for served requests
+    for r in done:
+        tr = r.trace
+        assert tr.t_enqueue <= tr.t_admit <= tr.t_prefill_done \
+            <= tr.t_first_token <= tr.t_finish
+        assert tr.ttft_s >= 0 and tr.e2e_s >= tr.ttft_s
+
+
+def test_engine_zero_recompiles_after_warmup(served_model):
+    """Acceptance: a steady-state serving loop adds ZERO jit cache misses
+    after the warmup batch — including partial batches (padded rows keep
+    every shape pinned)."""
+    m, cfg = served_model
+    eng = _engine(m)
+    ids = _prompts(cfg, [CAP, 5])
+    eng.submit(ids[0, :CAP])
+    eng.submit(ids[1, :5])
+    eng.drain()                        # warmup: compiles prefill + chunks
+    miss0 = compile_cache_misses()
+    for i in range(3):
+        eng.submit(ids[0, :CAP])
+        if i != 1:
+            eng.submit(ids[1, :5])     # batch 2 is partial: dummy-padded
+        eng.drain()
+    assert compile_cache_misses() - miss0 == 0
+    assert eng.monitor.recompiles == 0
+    assert all(r.get("jit_cache_misses", 0) == 0
+               for r in eng.monitor.records[1:])
+
+
+def test_engine_batch_gauges_and_counters(served_model):
+    m, cfg = served_model
+    eng = _engine(m)
+    ids = _prompts(cfg, [4])
+    eng.submit(ids[0, :4])             # 1 of 2 slots used
+    eng.drain()
+    s = eng.summary()
+    assert s["batch_fill_ratio"] == 0.5
+    assert 0 < s["kv_slot_occupancy"] <= 1.0
+    assert s["tokens_in_total"] == 4 and s["tokens_out_total"] == NEW
+    assert s["batches_total"] == 1 and s["completed_total"] == 1
+    assert s["batch_step"]["steps"] == 1
+
+
+def test_engine_rejects_overlong_prompt_with_shape_delta(served_model):
+    """A prompt beyond the cap would force a new prefill executable: the
+    engine refuses and logs the would-be shape delta through
+    StepMonitor.record_compile."""
+    m, cfg = served_model
+    eng = _engine(m)
+    req = eng.submit(np.arange(1, CAP + 3))
+    assert req.status == "rejected" and req.reason == "prompt_shape"
+    assert eng.summary()["rejected_total"] == 1
+    ev = eng.monitor.recompile_events[0]
+    assert ev["kind"] == "serving_reject"
+    assert str(CAP) in ev["delta"] and str(CAP + 2) in ev["delta"]
+    # the warning must NOT feed the numeric churn counters: nothing was
+    # built (the request was refused precisely so nothing would be)
+    assert eng.monitor.recompiles == 0 and eng.monitor.compiles == 0
+    assert eng.queue_depth == 0        # never admitted
+    # repeat offenders count as rejections but warn only once per shape
+    assert eng.submit(np.arange(1, CAP + 3)).status == "rejected"
+    assert eng.summary()["rejected_total"] == 2
+    assert len(eng.monitor.recompile_events) == 1
+
+
+def test_engine_queue_full_rejection(served_model):
+    m, cfg = served_model
+    eng = _engine(m, queue_capacity=2)
+    ids = _prompts(cfg, [3, 3, 3])
+    assert eng.submit(ids[0, :3]).status == "queued"
+    assert eng.submit(ids[1, :3]).status == "queued"
+    r = eng.submit(ids[2, :3])
+    assert r.status == "rejected" and r.reason == "queue_full"
+    assert eng.summary()["rejected_total"] == 1
+    assert eng.queue_depth == 2
+
+
+def test_engine_deadline_timeout(served_model):
+    """Requests whose queue wait blows their deadline expire at admission
+    (deterministic via the injectable clock)."""
+    m, cfg = served_model
+    fake = {"t": 0.0}
+    eng = ServingEngine(m, ServingConfig(max_batch=BATCH, prompt_cap=CAP,
+                                         max_new_tokens=NEW, decode_chunk=3,
+                                         deadline_s=0.5),
+                        clock=lambda: fake["t"])
+    ids = _prompts(cfg, [3, 3])
+    eng.submit(ids[0, :3])                        # will expire
+    eng.submit(ids[1, :3], deadline_s=10.0)       # per-request override
+    fake["t"] = 1.0
+    done = eng.drain()
+    # expired traffic is a terminal RESULT, not silently dropped
+    assert sorted(r.status for r in done) == ["done", "timeout"]
+    timed = next(r for r in done if r.status == "timeout")
+    assert timed.reason == "queue_deadline" and timed.tokens is None
+    s = eng.summary()
+    assert s["timeout_total"] == 1 and s["completed_total"] == 1
+    # its queue wait (1.0s on the fake clock) lands in the histogram —
+    # the longest waits must not vanish from the distribution at expiry
+    assert abs(s["queue_seconds"]["p99"] - 1.0) < 0.2
+
+
+def test_engine_eos_early_exit_and_token_counts(served_model):
+    """With a forced-EOS vocabulary walk, finished rows report n_out up to
+    and including EOS, and the chunk loop stops once every row is done."""
+    m, cfg = served_model
+    lens = [CAP, 5]
+    ids = _prompts(cfg, lens)
+    ref = m.generate_static_ragged(paddle.to_tensor(ids), lens,
+                                   max_new_tokens=NEW).numpy()
+    eos = int(ref[0, CAP])
+    eng = _engine(m, eos_token_id=eos)
+    eng.submit(ids[0, :CAP])
+    eng.submit(ids[1, :5])
+    done = eng.drain()
+    by_id = {r.id: r for r in done}
+    assert by_id[0].n_out == 1                     # EOS was its 1st token
+    assert by_id[0].tokens[0] == eos
+    assert by_id[1].n_out >= 1
+    s = eng.summary()
+    assert s["tokens_out_total"] == sum(r.n_out for r in done)
+    # per-row finish is chunk-granular: the EOS-on-token-1 row is stamped
+    # at its own chunk, not charged for the batch's remaining chunks
+    if by_id[1].n_out > 1:
+        assert by_id[0].trace.t_finish < by_id[1].trace.t_finish
+        assert by_id[1].trace.tpot_s(by_id[1].n_out) > 0
+
+
+def test_warmup_depth_extension_is_not_a_recompile(served_model):
+    """An EOS early-exit can truncate the warmup batch before the deeper
+    chunk executables ever compiled; their eventual first compile is NOT
+    shape churn and must not trip the steady-state recompile guard."""
+    m, cfg = served_model
+    lens = [CAP, 5]
+    ids = _prompts(cfg, lens)
+    ref = m.generate_static_ragged(paddle.to_tensor(ids), lens,
+                                   max_new_tokens=NEW).numpy()
+    eos = int(ref[0, CAP])             # row 0 greedily emits EOS first
+    eng = _engine(m, eos_token_id=eos)
+    eng.submit(ids[0, :CAP])
+    eng.drain()                        # warmup stops after chunk 1
+    assert eng._max_depth == 2         # prefill + first-token chunk only
+    eng.submit(ids[1, :5])             # decodes deeper than warmup did
+    eng.drain()
+    assert eng._max_depth > 2
+    assert eng.monitor.recompiles == 0
+
+
+def test_engine_respects_per_request_budget(served_model):
+    m, cfg = served_model
+    eng = _engine(m)
+    ids = _prompts(cfg, [4, 4])
+    eng.submit(ids[0, :4], max_new_tokens=2)
+    eng.submit(ids[1, :4], max_new_tokens=100)     # clamped to engine max
+    done = eng.drain()
+    by_id = {r.id: r for r in done}
+    assert by_id[0].tokens.shape[0] == 2
+    assert by_id[1].tokens.shape[0] == NEW
+    # a zero budget is unservable, not "serve 1 anyway"
+    r = eng.submit(ids[0, :4], max_new_tokens=0)
+    assert r.status == "rejected" and r.reason == "max_new_tokens"
+
+
+def test_engine_exception_records_inflight_requests(served_model):
+    """A batch dying mid-flight must not lose the admitted requests from
+    the accounting: they land as status='error' before the raise."""
+    m, cfg = served_model
+    eng = _engine(m)
+    eng.submit(_prompts(cfg, [4])[0, :4])
+    real_prefill = m.prefill_static
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected device failure")
+
+    m.prefill_static = boom
+    try:
+        with pytest.raises(RuntimeError, match="injected"):
+            eng.step()
+    finally:
+        m.prefill_static = real_prefill
+    s = eng.summary()
+    assert s["errors_total"] == 1 and s["inflight"] == 0
+    assert eng.queue_depth == 0
+
+
+def test_request_jsonl_schema(served_model, tmp_path):
+    """One JSONL row per terminal request: nested "request" payload +
+    "ts", spans and derived latencies present for served requests."""
+    m, cfg = served_model
+    jsonl = str(tmp_path / "requests.jsonl")
+    eng = ServingEngine(m, ServingConfig(max_batch=BATCH, prompt_cap=CAP,
+                                         max_new_tokens=NEW,
+                                         decode_chunk=3),
+                        metrics=ServingMetrics(jsonl_path=jsonl))
+    ids = _prompts(cfg, [CAP, 5])
+    eng.submit(ids[0, :CAP])
+    eng.submit(ids[1, :5])
+    eng.submit(np.arange(1, CAP + 5))              # rejected -> also a row
+    eng.drain()
+    rows = [json.loads(l) for l in open(jsonl)]
+    assert len(rows) == 3
+    for row in rows:
+        assert set(row) == {"request", "ts"}
+        r = row["request"]
+        assert {"id", "status", "prompt_tokens", "output_tokens",
+                "spans"} <= set(r)
+    served = [r["request"] for r in rows if r["request"]["status"] == "done"]
+    assert len(served) == 2
+    for r in served:
+        assert {"queue_s", "ttft_s", "tpot_s", "e2e_s"} <= set(r)
+        assert {"t_enqueue", "t_admit", "t_prefill_done", "t_first_token",
+                "t_finish", "batch_id"} <= set(r["spans"])
+    rej = next(r["request"] for r in rows
+               if r["request"]["status"] == "rejected")
+    assert rej["reason"] == "prompt_shape" and rej["output_tokens"] == 0
+
+
+def test_on_record_hook(served_model):
+    m, cfg = served_model
+    seen = []
+    eng = ServingEngine(m, ServingConfig(max_batch=BATCH, prompt_cap=CAP,
+                                         max_new_tokens=NEW,
+                                         decode_chunk=3),
+                        metrics=ServingMetrics(on_record=seen.append))
+    eng.submit(_prompts(cfg, [4])[0, :4])
+    eng.drain()
+    assert len(seen) == 1 and seen[0]["request"]["status"] == "done"
+
+
+def test_engine_metrics_text_is_valid_exposition(served_model):
+    m, cfg = served_model
+    eng = _engine(m)
+    ids = _prompts(cfg, [CAP, 5])
+    eng.submit(ids[0, :CAP])
+    eng.submit(ids[1, :5])
+    eng.drain()
+    text = eng.metrics_text()
+    types = _check_exposition(text)
+    # request metrics and the batch StepMonitor block share one page
+    assert "paddle_tpu_serving_ttft_seconds" in types
+    assert "paddle_tpu_serving_batch_steps_total" in types
+    _histogram_invariants(text, "paddle_tpu_serving_ttft_seconds")
+
+
+def test_synthetic_traffic_shape():
+    tr = synthetic_traffic(16, prompt_cap=8, vocab_size=64, rate=100.0,
+                           seed=0)
+    assert len(tr) == 16
+    ats = [t["at"] for t in tr]
+    assert ats == sorted(ats) and ats[0] == 0.0
+    assert all(1 <= t["prompt"].shape[0] <= 8 for t in tr)
+    assert all(t["prompt"].min() >= 1 and t["prompt"].max() < 64
+               for t in tr)
+
+
+@pytest.mark.slow
+def test_engine_under_load_open_loop(served_model):
+    """Load generation: open-loop replay of 24 requests; everything
+    completes, latency histograms fill, and the steady loop never
+    recompiles (the serve_bench path minus the CLI)."""
+    m, cfg = served_model
+    eng = _engine(m)
+    traffic = synthetic_traffic(24, prompt_cap=CAP,
+                                vocab_size=cfg.vocab_size, rate=500.0,
+                                seed=7)
+    eng.submit(traffic[0]["prompt"])
+    eng.drain()                        # warmup
+    miss0 = compile_cache_misses()
+    t0 = eng.clock()
+    finished = []
+    for item in traffic:
+        eng.submit(item["prompt"], enqueue_at=t0 + item["at"])
+        while eng.queue_depth >= BATCH:
+            finished.extend(eng.step())
+    finished.extend(eng.drain())
+    assert sum(1 for r in finished if r.status == "done") == 24
+    assert compile_cache_misses() - miss0 == 0
+    s = eng.summary()
+    assert s["ttft_seconds"]["count"] == 25        # incl. warmup request
+    assert s["e2e_seconds"]["p99"] > 0
+
+
+# -------------------------------------- inference.Config.enable_profile()
+
+class TestPredictorProfile:
+    def _export(self, tmp_path):
+        from paddle_tpu import static
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data("x", [-1, 8], "float32")
+                y = static.nn.fc(x, 4)
+            exe = static.Executor()
+            prefix = str(tmp_path / "model")
+            static.save_inference_model(prefix, [x], [y], exe, program=main)
+            return prefix
+        finally:
+            paddle.disable_static()
+
+    def test_run_latency_lands_in_metrics(self, tmp_path):
+        from paddle_tpu import inference
+        prefix = self._export(tmp_path)
+        config = inference.Config(prefix)
+        config.enable_profile()
+        assert "profile" in config.summary()
+        p = inference.create_predictor(config)
+        for _ in range(3):
+            p.run([np.random.randn(2, 8).astype(np.float32)])
+        s = p.profile_summary()
+        assert s["requests_total"] == 3 and s["completed_total"] == 3
+        assert s["items_total"] == 6               # batch rows, not tokens
+        assert s["e2e_seconds"]["p50"] > 0
+        text = p.metrics_text()
+        _check_exposition(text)
+        assert "paddle_tpu_infer_requests_total 3" in text
+        _histogram_invariants(text, "paddle_tpu_infer_e2e_seconds")
+
+    def test_profile_off_by_default(self, tmp_path):
+        from paddle_tpu import inference
+        prefix = self._export(tmp_path)
+        p = inference.create_predictor(inference.Config(prefix))
+        p.run([np.random.randn(2, 8).astype(np.float32)])
+        assert p.profile_summary() is None and p.metrics_text() == ""
+
+    def test_clone_gets_fresh_metrics(self, tmp_path):
+        from paddle_tpu import inference
+        prefix = self._export(tmp_path)
+        config = inference.Config(prefix)
+        config.enable_profile()
+        p = inference.create_predictor(config)
+        p.run([np.random.randn(2, 8).astype(np.float32)])
+        c = p.clone()
+        assert c.profile_summary()["requests_total"] == 0
+        c.run([np.random.randn(2, 8).astype(np.float32)])
+        assert c.profile_summary()["requests_total"] == 1
+        assert p.profile_summary()["requests_total"] == 1

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Serving benchmark — replay open-loop traffic through the ServingEngine
+and report latency percentiles + SLO attainment.
+
+The serving analog of bench.py: where bench rows measure training
+step-time/MFU, this measures the signals a serving deployment is judged by
+(PAPERS.md serving studies): TTFT / per-output-token / end-to-end latency
+distributions under load, queue wait, batch fill, KV occupancy, and the
+fraction of requests meeting their SLOs. Traffic is OPEN-LOOP (Poisson
+arrivals at --rate req/s, scheduled independently of service speed) so
+queueing shows up honestly: a single-threaded replayer submits each
+request with its SCHEDULED arrival timestamp (`enqueue_at`), then serves
+whatever is queued — exactly the accounting a load balancer would see.
+
+    PYTHONPATH=. python tools/serve_bench.py \
+        [--preset gpt3-125m] --requests 64 --rate 100 \
+        --batch 4 --prompt-cap 16 --new 8 \
+        --slo-ttft-ms 500 --slo-e2e-ms 2000 [--json] [--metrics]
+
+Without --preset a 2-layer toy GPT runs on CPU (CI-sized); with a preset
+set PADDLE_TPU_EXAMPLE_TPU=1 to run real-chip sizes.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+
+if not os.environ.get("PADDLE_TPU_EXAMPLE_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_model(preset):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, GPTConfig, gpt_config
+    paddle.seed(0)
+    if preset:
+        cfg = gpt_config(preset)
+    else:
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_position_embeddings=128,
+                        intermediate_size=128)
+    model = GPTForCausalLM(cfg)
+    if os.environ.get("PADDLE_TPU_EXAMPLE_TPU"):
+        model.to(dtype="bfloat16")
+    model.eval()
+    return model, cfg
+
+
+def run_bench(args):
+    """Returns (report_dict, engine) — the engine rides along for the
+    optional --metrics exposition dump."""
+    from paddle_tpu.inference import (ServingEngine, ServingConfig,
+                                      synthetic_traffic)
+    model, cfg = build_model(args.preset)
+    sc = ServingConfig(max_batch=args.batch, prompt_cap=args.prompt_cap,
+                       max_new_tokens=args.new,
+                       decode_chunk=args.decode_chunk,
+                       queue_capacity=args.queue_capacity,
+                       eos_token_id=args.eos,
+                       weight_dtype="int8" if args.int8_weights else None,
+                       cache_dtype="int8" if args.int8_cache else None)
+    engine = ServingEngine(model, sc)
+
+    # warmup batch: compiles the (prefill + chunk) executables once, so the
+    # measured replay is the steady state a long-lived server sits in
+    warm = synthetic_traffic(args.batch, prompt_cap=args.prompt_cap,
+                             vocab_size=cfg.vocab_size, rate=1e9, seed=1)
+    for item in warm:
+        engine.submit(item["prompt"])
+    engine.drain()
+    warm_metrics = type(engine.metrics)()       # fresh aggregates
+    engine.metrics = warm_metrics
+
+    traffic = synthetic_traffic(args.requests, prompt_cap=args.prompt_cap,
+                                vocab_size=cfg.vocab_size, rate=args.rate,
+                                seed=args.seed)
+    t0 = engine.clock()
+    finished = []
+    for item in traffic:
+        due = t0 + item["at"]
+        wait = due - engine.clock()
+        if wait > 0:                   # open loop: arrivals keep schedule
+            time.sleep(wait)
+        # when serving fell BEHIND the schedule, enqueue_at backdates the
+        # queue-wait span to the scheduled arrival — the load-balancer view
+        engine.submit(item["prompt"], enqueue_at=due)
+        while engine.queue_depth >= args.batch:
+            finished.extend(engine.step())
+    finished.extend(engine.drain())
+    wall = engine.clock() - t0
+
+    done = [r for r in finished if r.status == "done"]
+    # timed-out traffic counts as an SLO MISS, not a dropped sample —
+    # excluding it would report 100% attainment exactly under overload
+    n_expired = sum(1 for r in finished if r.status == "timeout")
+    ttfts = [r.trace.ttft_s for r in done if r.trace.ttft_s is not None]
+    e2es = [r.trace.e2e_s for r in done if r.trace.e2e_s is not None]
+
+    def attainment(vals, limit_ms):
+        denom = len(vals) + n_expired
+        if not denom:
+            return None
+        return sum(1 for t in vals if t * 1e3 <= limit_ms) / denom
+
+    slo = {
+        "ttft_ms": args.slo_ttft_ms,
+        "e2e_ms": args.slo_e2e_ms,
+        "expired": n_expired,
+        "ttft_attainment": attainment(ttfts, args.slo_ttft_ms),
+        "e2e_attainment": attainment(e2es, args.slo_e2e_ms),
+    }
+    s = engine.summary()
+    out = {"preset": args.preset or "toy", "requests": args.requests,
+           "rate_req_s": args.rate, "wall_s": round(wall, 3),
+           "completed": len(done),
+           "throughput_tok_s": round(s["tokens_out_total"] / wall, 1)
+           if wall > 0 else None,
+           "slo": slo, "serving": s}
+    # the recompiles counter is a pure churn signal: refused requests log
+    # their shape delta without feeding it (record_compile count=False)
+    out["steady_recompiles"] = engine.monitor.recompiles
+    return out, engine
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--preset", default=None,
+                    help="gpt3-125m … gpt3-13b (default: 2-layer toy)")
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="open-loop arrival rate, requests/s")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--prompt-cap", type=int, default=16)
+    ap.add_argument("--new", type=int, default=8,
+                    help="max new tokens per request")
+    ap.add_argument("--decode-chunk", type=int, default=None)
+    ap.add_argument("--queue-capacity", type=int, default=256)
+    ap.add_argument("--eos", type=int, default=None)
+    ap.add_argument("--int8-weights", action="store_true")
+    ap.add_argument("--int8-cache", action="store_true")
+    ap.add_argument("--slo-ttft-ms", type=float, default=500.0)
+    ap.add_argument("--slo-e2e-ms", type=float, default=5000.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--metrics", action="store_true",
+                    help="also dump the Prometheus /metrics payload")
+    args = ap.parse_args(argv)
+
+    out, engine = run_bench(args)
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        s = out["serving"]
+        tput = out["throughput_tok_s"]
+        print(f"serve_bench: {out['completed']}/{out['requests']} requests "
+              f"at {out['rate_req_s']} req/s -> "
+              f"{'n/a' if tput is None else tput} tok/s "
+              f"over {out['wall_s']}s")
+        for name in ("ttft_seconds", "tpot_seconds", "e2e_seconds",
+                     "queue_seconds"):
+            h = s.get(name)
+            if h:
+                print(f"  {name:<14} p50 {h['p50'] * 1e3:8.2f} ms   "
+                      f"p90 {h['p90'] * 1e3:8.2f} ms   "
+                      f"p99 {h['p99'] * 1e3:8.2f} ms")
+        fill, kv = s["batch_fill_ratio"], s["kv_slot_occupancy"]
+        print(f"  batch fill {'n/a' if fill is None else f'{fill:.2f}'}   "
+              f"kv occupancy {'n/a' if kv is None else f'{kv:.2f}'}   "
+              f"batches {s['batches_total']}")
+        slo = out["slo"]
+        if slo["ttft_attainment"] is not None:
+            print(f"  SLO: TTFT<= {slo['ttft_ms']:.0f}ms "
+                  f"{slo['ttft_attainment'] * 100:.1f}%   "
+                  f"e2e<= {slo['e2e_ms']:.0f}ms "
+                  f"{slo['e2e_attainment'] * 100:.1f}%")
+        print(f"  steady-state recompiles: {out['steady_recompiles']}")
+    if args.metrics:
+        print(engine.metrics_text(), end="")
+    return 0 if out["steady_recompiles"] == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
