@@ -543,6 +543,112 @@ def bench_decode(on_tpu, B=None, w8=None, c8=None, marginal=False):
     })
 
 
+def bench_decode_paged(on_tpu):
+    """Paged-vs-padded serving decode on long-tail mixed-length traffic
+    (ISSUE 5): the same open-loop workload replayed through the padded
+    static engine and the block-pool engine with slot-level continuous
+    batching. The row value is the PAGED tok/s; extras carry the padded
+    twin, the true-KV-occupancy gap, and the decode_static buffer-donation
+    saving (satellite: donated caches skip the per-chunk cache re-thread)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (ServingConfig, ServingEngine,
+                                      synthetic_traffic)
+    from paddle_tpu.models import GPTForCausalLM, GPTConfig, gpt_config
+
+    if on_tpu:
+        preset, B, cap, new, chunk, n_req = "gpt3-1.3b", 8, 128, 128, 32, 48
+    else:
+        preset, B, cap, new, chunk, n_req = None, 2, 16, 8, 4, 10
+    preset = os.environ.get("PADDLE_TPU_BENCH_PRESET", preset) \
+        if on_tpu else preset
+    paddle.seed(0)
+    if preset:
+        cfg = gpt_config(preset)
+        model = GPTForCausalLM(cfg)
+        model.to(dtype="bfloat16")
+    else:
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_position_embeddings=128,
+                        intermediate_size=128)
+        model = GPTForCausalLM(cfg)
+    model.eval()
+    traffic = synthetic_traffic(n_req, prompt_cap=cap,
+                                vocab_size=cfg.vocab_size, rate=1e9,
+                                seed=3, length_dist="longtail")
+
+    def run(paged):
+        eng = ServingEngine(model, ServingConfig(
+            max_batch=B, prompt_cap=cap, max_new_tokens=new,
+            decode_chunk=chunk, paged=paged))
+        for item in traffic[:B]:            # warmup: compile the pair
+            eng.submit(item["prompt"])
+        eng.drain()
+        eng.metrics = type(eng.metrics)()
+        peak = 0.0
+
+        def track():
+            nonlocal peak
+            peak = max(peak, eng.metrics.gauges.get("kv_occupancy") or 0.0)
+
+        t0 = time.perf_counter()
+        for item in traffic:
+            eng.submit(item["prompt"])
+            while eng.queue_depth >= B:
+                eng.step()
+                track()
+        while eng.busy:           # the drain tail is where occupancy peaks
+            eng.step()
+            track()
+        dt = time.perf_counter() - t0
+        toks = eng.metrics.counters["tokens_out"]
+        return toks / dt, peak, eng.monitor.recompiles
+
+    padded_tps, padded_kv, rc0 = run(False)
+    paged_tps, paged_kv, rc1 = run(True)
+
+    # decode_static donation saving: the same chunked decode with the KV
+    # tuples donated (in-place) vs re-threaded by value — the per-chunk
+    # fixed-cost delta the satellite asks the row to record
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(1, cfg.vocab_size, (B, cap)).astype("int64"))
+    lens = np.full((B,), cap, np.int32)
+    n_chunks = max(2, new // chunk)
+    times = {}
+    for donate in (False, True):
+        best = float("inf")
+        for _rep in range(3):
+            st = model.prefill_static(ids, max_len=cap + new,
+                                      prompt_lens=lens)
+            t0 = time.perf_counter()
+            for _ in range(n_chunks):
+                toks, st = model.decode_static(st, chunk,
+                                               return_state=True,
+                                               donate_cache=donate)
+            _ = toks.numpy()
+            best = min(best, time.perf_counter() - t0)
+        times[donate] = best / n_chunks
+    donate_saving_ms = (times[False] - times[True]) * 1e3
+
+    return _emit({
+        "metric": f"paged serving decode tokens/sec/chip "
+                  f"({preset or 'toy'} longtail traffic, B={B} cap={cap} "
+                  f"new={new} chunk={chunk})",
+        "value": round(paged_tps, 1), "unit": "tokens/s",
+        "vs_baseline": None,
+        "extra": {"padded_tok_s": round(padded_tps, 1),
+                  "paged_vs_padded": round(paged_tps / padded_tps, 3)
+                  if padded_tps else None,
+                  "kv_occupancy_paged": round(paged_kv, 3),
+                  "kv_occupancy_padded": round(padded_kv, 3),
+                  "steady_recompiles": rc0 + rc1,
+                  "donate_saving_ms_per_chunk": round(donate_saving_ms, 3),
+                  "decode_chunk_ms_donated": round(times[True] * 1e3, 2),
+                  "decode_chunk_ms_copied": round(times[False] * 1e3, 2)},
+    })
+
+
 def bench_vit(on_tpu, preset=None, B=None):
     """ViT (BASELINE.md config) training throughput — fused whole-sequence
     MHA kernel at the ragged patch-sequence length."""
@@ -674,6 +780,7 @@ _SINGLE = {
     "bert": bench_bert,
     "vit": bench_vit,
     "decode": bench_decode,
+    "decode-paged": bench_decode_paged,
     "swin": bench_swin,
     "moe": bench_moe,
     "gpt": bench_gpt,
@@ -706,6 +813,9 @@ def _ladder(on_tpu):
                                                 c8=True, marginal=True),
          220),
         ("decode-b32", lambda: bench_decode(on_tpu, B=32, w8=False), 120),
+        # paged KV serving (ISSUE 5): block-pool engine vs the padded
+        # twin on long-tail traffic + the decode_static donation saving
+        ("decode-paged", lambda: bench_decode_paged(on_tpu), 180),
         ("moe", lambda: bench_moe(on_tpu), 240),
         # the SHIPPED default capacity (GShard 1.25) stays driver-tracked;
         # its dense twin is reused from the cf=1.0 row, so this pays only

@@ -120,7 +120,7 @@ def main():
     s = engine.summary()
     print(f"engine: {n_ok} requests over {s['batches_total']} batches, "
           f"fill {s['batch_fill_ratio']:.2f}, "
-          f"kv occupancy {s['kv_slot_occupancy']:.2f}")
+          f"kv occupancy {s['kv_occupancy']:.2f} (true tokens)")
     if s.get("ttft_seconds"):
         print(f"TTFT p50/p99: {s['ttft_seconds']['p50'] * 1e3:.1f} / "
               f"{s['ttft_seconds']['p99'] * 1e3:.1f} ms")

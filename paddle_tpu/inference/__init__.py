@@ -337,3 +337,4 @@ def _get_phi_kernel_name(op_name: str) -> str:
 # frontends; see inference/serving.py) ----
 from .serving import (ServingEngine, ServingConfig, ServingMetrics,  # noqa: E402,F401
                       Request, RequestTrace, synthetic_traffic)
+from .kv_cache import BlockPool  # noqa: E402,F401

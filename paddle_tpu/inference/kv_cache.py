@@ -1,0 +1,179 @@
+"""paddle_tpu.inference.kv_cache — block-paged KV-cache pool for serving.
+
+The static serving stack (generate_static_ragged / prefill_static +
+decode_static) right-pads every ragged prompt to a fixed cap and reserves a
+full [B, max_len] KV slab per batch slot, so mixed-length traffic holds HBM
+hostage for padding and a finished row's slot stays pinned until the whole
+micro-batch drains. The TPU-idiomatic fix (Ragged Paged Attention,
+arxiv 2604.15464; PAPERS.md serving studies) is a BLOCK pool:
+
+  * device state is ONE fixed-shape tensor per layer —
+    ``[num_blocks, block_size, num_heads, head_dim]`` — plus an int32 block
+    table ``[B, max_blocks]`` and a length vector ``[B]``. Every shape is
+    pinned, so a single compiled executable serves ANY mix of request
+    lengths (the whole point: zero steady-state recompiles);
+  * a request owns ``ceil(tokens / block_size)`` blocks, scattered anywhere
+    in the pool — blocks free the moment the request finishes, and a queued
+    request is spliced into the vacated batch slot mid-flight.
+
+``BlockPool`` is the HOST-side allocator: free-list bookkeeping, per-owner
+block lists, occupancy accounting. The device pool arrays it creates are
+handed to the caller (ServingEngine / prefill_paged), which threads them
+through jitted steps with the buffers DONATED — XLA updates the pool in
+place instead of round-tripping a copy.
+
+Block 0 is reserved as the TRASH block: block-table padding entries and
+masked writes (right-padded prompt garbage, post-EOS decode steps of a
+fixed-shape chunk) all land there, so scatter updates never need a mask and
+can never corrupt another request's blocks. Usable capacity is therefore
+``(num_blocks - 1) * block_size`` tokens.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class BlockPool:
+    """Fixed-size KV block allocator (host bookkeeping + device pools).
+
+    Parameters
+    ----------
+    num_blocks : total blocks in the pool, INCLUDING the reserved trash
+        block 0 (usable capacity is ``(num_blocks - 1) * block_size``).
+    block_size : KV rows (token positions) per block.
+    num_layers / num_heads / head_dim / dtype : pool tensor geometry —
+        normally taken from the model via :meth:`for_model`.
+    """
+
+    def __init__(self, *, num_blocks: int, block_size: int,
+                 num_layers: int, num_heads: int, head_dim: int,
+                 dtype="float32"):
+        if num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is the "
+                             "reserved trash block)")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = dtype
+        # LIFO free list: recently freed blocks are re-issued first, which
+        # keeps the hot working set of pool pages small
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._rows: Dict[int, List[int]] = {}
+
+    @classmethod
+    def for_model(cls, model, *, num_blocks: int, block_size: int):
+        """Geometry from a GPTForCausalLM-style model (config + dtype)."""
+        cfg = model.config
+        dtype = model.gpt.wte.weight._data.dtype
+        return cls(num_blocks=num_blocks, block_size=block_size,
+                   num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+                   head_dim=cfg.head_dim, dtype=dtype)
+
+    def make_pools(self):
+        """Fresh zeroed device pools: per layer ``(k_pool, v_pool)``, each
+        ``[num_blocks, block_size, num_heads, head_dim]``. The caller owns
+        them from here — jitted steps donate and replace them, so the
+        allocator deliberately does NOT keep a reference."""
+        import jax.numpy as jnp
+        shape = (self.num_blocks, self.block_size,
+                 self.num_heads, self.head_dim)
+        return [(jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype))
+                for _ in range(self.num_layers)]
+
+    # ------------------------------------------------------------- sizing
+    def blocks_needed(self, tokens: int) -> int:
+        return max(0, math.ceil(int(tokens) / self.block_size))
+
+    @property
+    def capacity_blocks(self) -> int:
+        """Allocatable blocks (trash block excluded)."""
+        return self.num_blocks - 1
+
+    @property
+    def capacity_tokens(self) -> int:
+        return self.capacity_blocks * self.block_size
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.capacity_blocks - len(self._free)
+
+    def fits_ever(self, tokens: int) -> bool:
+        """Could a request needing `tokens` KV rows EVER be served by this
+        pool (i.e. with every other request drained)? False means reject —
+        waiting in the queue would never help."""
+        return self.blocks_needed(tokens) <= self.capacity_blocks
+
+    # --------------------------------------------------------- alloc/free
+    def alloc(self, owner: int, tokens: int) -> Optional[np.ndarray]:
+        """Reserve blocks covering `tokens` KV rows for `owner`.
+
+        Returns the block-id vector (int32) on success, None when the pool
+        has too few FREE blocks right now (the caller decides whether to
+        wait or reject — see `fits_ever` for the never-fits case). An owner
+        can hold only one reservation; double-alloc raises."""
+        if owner in self._rows:
+            raise ValueError(f"owner {owner} already holds "
+                             f"{len(self._rows[owner])} blocks; free first")
+        n = self.blocks_needed(tokens)
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._rows[owner] = blocks
+        return np.asarray(blocks, dtype=np.int32)
+
+    def free(self, owner: int) -> int:
+        """Release every block `owner` holds; returns how many. Freeing an
+        unknown owner is a no-op (0) — finish paths may race a reject."""
+        blocks = self._rows.pop(owner, None)
+        if not blocks:
+            return 0
+        self._free.extend(reversed(blocks))
+        return len(blocks)
+
+    def owned(self, owner: int) -> List[int]:
+        return list(self._rows.get(owner, ()))
+
+    def table_row(self, owner: int, width: int) -> np.ndarray:
+        """The owner's int32 block-table row, zero-padded (trash block) to
+        `width` entries — the fixed-shape row a [B, max_blocks] device
+        table carries per batch slot."""
+        blocks = self._rows.get(owner, ())
+        if len(blocks) > width:
+            raise ValueError(f"owner {owner} holds {len(blocks)} blocks "
+                             f"> table width {width}")
+        row = np.zeros((width,), dtype=np.int32)
+        row[:len(blocks)] = blocks
+        return row
+
+    # --------------------------------------------------------- accounting
+    def occupancy(self, live_tokens: int) -> float:
+        """TRUE-token occupancy: live (attended) KV rows over pooled
+        capacity. This is the gauge that proves paging — padded-slot
+        accounting can't go above the padding ratio."""
+        return live_tokens / max(self.capacity_tokens, 1)
+
+    def slots_occupancy(self) -> float:
+        """Block-granular occupancy: allocated blocks over capacity (the
+        continuity analog of the old padded-slot gauge — includes
+        within-block padding and worst-case reservations)."""
+        return self.used_blocks / max(self.capacity_blocks, 1)
+
+    def reset(self):
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._rows.clear()
+
+    def __repr__(self):
+        return (f"BlockPool(blocks={self.num_blocks}x{self.block_size}, "
+                f"free={self.free_blocks}/{self.capacity_blocks}, "
+                f"owners={len(self._rows)})")

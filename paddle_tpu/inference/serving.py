@@ -44,6 +44,23 @@ on the same prompts (tested): padding rows to the fixed batch and chunking
 the decode change nothing — attention masks make cache length and batch
 company value-invariant, and chunked greedy decode replays the same
 argmax chain.
+
+`ServingConfig(paged=True)` (ISSUE 5) swaps the per-slot padded KV slabs
+for a BLOCK POOL (inference/kv_cache.py + the ragged paged attention op):
+each batch slot runs its own request against blocks it owns, EOS/budget
+frees those blocks immediately, and `_admit_paged` splices a queued
+request into the vacated slot mid-flight — prefill into fresh blocks
+([1, cap], one executable), then the row simply joins the next decode
+chunk. No waiting for the batch to drain, no bucket-mismatch rejection
+for anything that fits the pool, and the same two guarantees hold:
+greedy output bit-identical to generate_static_ragged per row, zero jit
+cache misses after the {prefill, decode} pair compiles once. The pool
+buffers are DONATED through every call, so XLA updates KV in place.
+(Bit-identity caveat: bf16 models on TPU route through the f32-score
+Pallas paged kernel while the static path stores bf16 scores, so parity
+there is approximate near argmax ties — exact whenever both sides share
+a numerics class: f32 models anywhere, or the CPU reference path; see
+ops/pallas/paged_attention.py and tools/validate_paged_tpu.py.)
 """
 from __future__ import annotations
 
@@ -179,7 +196,8 @@ class ServingMetrics:
                          "timeout": 0, "errors": 0, "tokens_in": 0,
                          "tokens_out": 0, "items": 0, "batches": 0}
         self.gauges = {"queue_depth": 0, "inflight": 0,
-                       "batch_fill_ratio": None, "kv_slot_occupancy": None}
+                       "batch_fill_ratio": None, "kv_occupancy": None,
+                       "kv_slots_occupancy": None}
 
     # -- recording ------------------------------------------------------
     def observe_call(self, e2e_s: float, items: int = 1):
@@ -225,10 +243,18 @@ class ServingMetrics:
         return row
 
     def record_batch(self, *, n_real: int, capacity: int,
-                     kv_used: int, kv_capacity: int, queue_depth: int):
+                     kv_tokens: int, kv_slots: int, kv_capacity: int,
+                     queue_depth: int):
+        """kv_tokens = LIVE (attendable) KV rows; kv_slots = rows the
+        allocation granularity pins (padded slots / reserved blocks);
+        kv_capacity = total pooled rows. kv_occupancy is the true-token
+        gauge (ISSUE 5 satellite — padded-slot accounting could not go
+        above the padding ratio); kv_slots_occupancy keeps the old
+        slot-granular value for dashboard continuity."""
         self.counters["batches"] += 1
         self.gauges["batch_fill_ratio"] = n_real / max(capacity, 1)
-        self.gauges["kv_slot_occupancy"] = kv_used / max(kv_capacity, 1)
+        self.gauges["kv_occupancy"] = kv_tokens / max(kv_capacity, 1)
+        self.gauges["kv_slots_occupancy"] = kv_slots / max(kv_capacity, 1)
         self.gauges["queue_depth"] = queue_depth
 
     # -- reporting ------------------------------------------------------
@@ -265,8 +291,11 @@ class ServingMetrics:
                  "inflight": "requests currently being served",
                  "batch_fill_ratio": "real rows / batch capacity of the "
                                      "last micro-batch",
-                 "kv_slot_occupancy": "used / allocated KV cache rows of "
-                                      "the last micro-batch"}
+                 "kv_occupancy": "live (attendable) KV rows / pooled "
+                                 "capacity — true-token occupancy",
+                 "kv_slots_occupancy": "allocation-granular KV rows "
+                                       "(padded slots / reserved blocks) "
+                                       "/ pooled capacity"}
         for name, value in self.gauges.items():
             lines.extend(gauge_lines(prefix, name, value, ghelp[name]))
         for name, help_ in self.HISTS:
@@ -298,6 +327,11 @@ class ServingConfig:
     seed: int = 0
     weight_dtype: Optional[str] = None   # "int8" -> weight-only int8 GEMMs
     cache_dtype: Optional[str] = None    # "int8" -> int8 KV cache
+    # --- paged KV pool (ISSUE 5): slot-level continuous batching ---
+    paged: bool = False             # block-pool KV + mid-flight admission
+    kv_block: int = 16              # KV rows per pool block
+    kv_blocks: Optional[int] = None  # total pool blocks INCL. trash block;
+    #                            default = worst case for max_batch rows
 
     def __post_init__(self):
         if self.max_batch < 1 or self.prompt_cap < 1 \
@@ -309,6 +343,30 @@ class ServingConfig:
         elif self.decode_chunk < 1:
             raise ValueError(f"decode_chunk must be >= 1, "
                              f"got {self.decode_chunk}")
+        if self.paged:
+            if self.cache_dtype is not None:
+                raise ValueError("paged=True has no int8 KV-cache mode "
+                                 "yet (the pool carries the model dtype)")
+            if self.kv_block < 1:
+                raise ValueError(f"kv_block must be >= 1, "
+                                 f"got {self.kv_block}")
+            if self.kv_blocks is None:
+                # worst case: every slot holds a cap prompt decoding its
+                # full budget (+1 for the reserved trash block). Smaller
+                # pools oversubscribe deliberately — admission then waits
+                # on freed blocks.
+                self.kv_blocks = self.max_batch * self.table_width + 1
+
+    @property
+    def row_kv_rows(self) -> int:
+        """Worst-case KV rows one request can write: cap prompt + full
+        budget, minus the never-written last sampled token."""
+        return self.prompt_cap + self.max_new_tokens - 1
+
+    @property
+    def table_width(self) -> int:
+        """Block-table columns per batch slot (worst-case blocks/row)."""
+        return -(-self.row_kv_rows // self.kv_block)
 
     @property
     def chunk_schedule(self) -> List[int]:
@@ -364,11 +422,41 @@ class ServingEngine:
         # StepMonitor.record_compile expects for shape_delta rendering)
         self._shape_sig = (((config.max_batch, config.prompt_cap), "int64"),
                            ((config.max_batch,), "int32"))
+        if config.paged:
+            # slot-level continuous batching over a paged block pool: each
+            # batch slot runs its own request; EOS/budget frees the slot's
+            # blocks immediately and _admit_paged splices a queued request
+            # into the vacancy mid-flight. Device state is the donated
+            # per-layer pools; tables/lens/pending/done are tiny host
+            # vectors edited per slot and shipped with every chunk.
+            from .kv_cache import BlockPool
+            B, MB = config.max_batch, config.table_width
+            self._pool = BlockPool.for_model(model,
+                                             num_blocks=config.kv_blocks,
+                                             block_size=config.kv_block)
+            self._pools = self._pool.make_pools()
+            self._slots: List[Optional[Request]] = [None] * B
+            self._tables = np.zeros((B, MB), np.int32)
+            self._lens = np.zeros((B,), np.int32)
+            self._pending = np.zeros((B,), np.int32)
+            self._done = np.ones((B,), bool)
+            self._calls = 0            # PRNG stream cursor (sampling mode)
+            self._paged_seen = set()   # executables already compiled
+            self._kv_snapshot = (0, 0)  # (live tokens, slot rows) at the
+            #                             last step's decode entry
 
     # -- admission ------------------------------------------------------
     @property
     def queue_depth(self) -> int:
         return len(self._queue)
+
+    @property
+    def busy(self) -> bool:
+        """Work remains: queued requests, or (paged) live batch slots
+        still decoding — the public loop condition drain() and external
+        replayers (tools/serve_bench.py) share."""
+        return bool(self._queue) or \
+            (self.config.paged and bool(self._live()))
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None,
@@ -425,6 +513,15 @@ class ServingEngine:
                     prev_sig=self._shape_sig, count=False)
             self.metrics.record_request(req)
             return req
+        if cfg.paged and not self._pool.fits_ever(
+                prompt.shape[0] + want - 1):
+            # the pool could not hold this request even fully drained —
+            # waiting in the queue would never help. Anything smaller is
+            # ADMITTABLE (it waits for freed blocks at worst): the paged
+            # engine has no bucket-mismatch rejection inside the cap.
+            req.status, req.reason = "rejected", "kv_oom"
+            self.metrics.record_request(req)
+            return req
         if len(self._queue) >= cfg.queue_capacity:
             req.status, req.reason = "rejected", "queue_full"
             self.metrics.record_request(req)
@@ -467,6 +564,8 @@ class ServingEngine:
         If the batch dies mid-flight (device OOM, interrupt), the admitted
         requests are recorded as status="error" before the exception
         propagates — an accounting layer must not lose in-flight requests."""
+        if self.config.paged:
+            return self._step_paged()
         reqs, expired = self._admit()
         if not reqs:
             return expired
@@ -518,11 +617,16 @@ class ServingEngine:
                 # per-(batch, chunk) seed: every decode_static call builds
                 # a fresh PRNG stream from its seed, so reusing one seed
                 # across chunks would replay the same draws
+                # donate_cache: the state is used LINEARLY here (st is
+                # replaced every chunk, the prefill state never reused),
+                # so XLA updates the KV tuples in place instead of
+                # re-threading them by value each chunk
                 toks, st = self.model.decode_static(
                     st, chunk, temperature=cfg.temperature,
                     top_k=cfg.top_k, top_p=cfg.top_p,
                     seed=cfg.seed + batch_id * len(schedule) + ci,
-                    eos_token_id=cfg.eos_token_id, return_state=True)
+                    eos_token_id=cfg.eos_token_id, return_state=True,
+                    donate_cache=True)
                 part = np.asarray(toks.numpy())     # host sync per chunk
             parts.append(part)
             t_chunk = self.clock()
@@ -559,12 +663,16 @@ class ServingEngine:
                 r.trace.t_finish = t_chunk  # loop exits finish every row
             out_tokens += r.n_out
             self.metrics.record_request(r)
-        # per-row cache rows actually written: prompt + produced - 1 (the
-        # last sampled token is returned but never written)
-        kv_used = int(lens[:len(reqs)].sum()) + \
+        # true live tokens: real prompt rows + decode rows actually
+        # written (prompt + produced - 1 each; the last sampled token is
+        # returned but never written). Slots accounting: every admitted
+        # row pins a FULL padded [max_len] slab — that gap between the two
+        # gauges is exactly what the paged engine exists to close.
+        kv_tokens = int(lens[:len(reqs)].sum()) + \
             int((gen.shape[1] - 1) * len(reqs))
         self.metrics.record_batch(
-            n_real=len(reqs), capacity=B, kv_used=kv_used,
+            n_real=len(reqs), capacity=B, kv_tokens=kv_tokens,
+            kv_slots=len(reqs) * cfg.max_len,
             kv_capacity=B * cfg.max_len, queue_depth=len(self._queue))
         self.metrics.gauges["inflight"] = 0
 
@@ -589,16 +697,226 @@ class ServingEngine:
         self.monitor.end_step(items=out_tokens)
         return reqs
 
+    # ------------------------------------- paged slot-level batching loop
+    def _live(self) -> List[int]:
+        return [i for i, r in enumerate(self._slots) if r is not None]
+
+    def _step_paged(self) -> List[Request]:
+        """One paged engine step: splice queued requests into free slots
+        (per-slot prefill into fresh blocks), then run ONE decode chunk
+        over the live batch; rows hitting EOS/budget free their blocks
+        immediately. Executable set = {prefill [1, cap], decode [B, c]} —
+        both compile once, so a steady mixed-length loop adds zero jit
+        cache misses however requests arrive."""
+        miss0 = _jit_cache_misses()
+        ran = set()
+        self.monitor.begin_step()
+        out_tokens = 0
+        try:
+            finished, expired, n_prefills = self._admit_paged()
+            if n_prefills:
+                ran.add("prefill")
+            live_entry = self._live()
+            if live_entry:
+                chunk_done, out_tokens = self._decode_chunk_paged(
+                    live_entry)
+                ran.add("decode")
+                finished.extend(chunk_done)
+        except BaseException:
+            now = self.clock()
+            for i, r in enumerate(self._slots):
+                if r is not None:
+                    r.status, r.reason = "error", "engine_exception"
+                    r.trace.t_finish = now
+                    self.metrics.record_request(r)
+                    self._slots[i] = None
+                    self._pool.free(r.id)
+                    self._clear_slot(i)
+            # the failed call may have CONSUMED the donated pools — rebuild
+            # so the engine stays usable (the padded engine's contract)
+            self._pool.reset()
+            self._pools = self._pool.make_pools()
+            self.metrics.gauges["inflight"] = 0
+            self.monitor.end_step(items=0)
+            raise
+        self.metrics.gauges["inflight"] = len(self._live())
+        if ran:
+            # gauges describe the step's micro-batch: fill = rows live at
+            # decode-chunk entry (instant admission-finishes recycle one
+            # slot sequentially, so cap admission-only steps at capacity);
+            # occupancy is snapshotted at chunk entry too — the state the
+            # step actually served, not the post-free emptiness
+            n_real = len(live_entry) if live_entry else \
+                min(len(finished), len(self._slots))
+            kv_tokens, kv_slots = self._kv_snapshot
+            self.metrics.record_batch(
+                n_real=n_real, capacity=len(self._slots),
+                kv_tokens=kv_tokens, kv_slots=kv_slots,
+                kv_capacity=self._pool.capacity_tokens,
+                queue_depth=len(self._queue))
+        # compile accounting, same convention as the static engine: a miss
+        # while every executable this step ran was already seen is shape
+        # churn — log it through the r7 recompile detector
+        dm = _jit_cache_misses() - miss0
+        if dm:
+            self.monitor.record_compile(
+                "serving_batch", (("jit_cache_misses", dm),),
+                prev_sig=(("jit_cache_misses", 0),)
+                if ran and ran <= self._paged_seen else None)
+        self._paged_seen |= ran
+        self.monitor.end_step(items=out_tokens)
+        return expired + finished
+
+    def _clear_slot(self, slot: int):
+        self._tables[slot] = 0         # trash block: writes go nowhere
+        self._lens[slot] = 0
+        self._pending[slot] = 0
+        self._done[slot] = True
+
+    def _admit_paged(self):
+        """Fill every free slot from the queue: allocate blocks, prefill
+        the prompt into them ([1, cap] — one fixed executable), splice the
+        row into the live decode batch. Returns (finished, expired,
+        n_prefills) — a budget-1 or instant-EOS request can finish here
+        without ever joining a decode chunk."""
+        cfg = self.config
+        finished: List[Request] = []
+        expired: List[Request] = []
+        n_prefills = 0
+        free = [i for i, r in enumerate(self._slots) if r is None]
+        while self._queue and free:
+            now = self.clock()
+            req = self._queue[0]
+            if req.deadline_s is not None and \
+                    now - req.trace.t_enqueue > req.deadline_s:
+                self._queue.popleft()
+                req.status, req.reason = "timeout", "queue_deadline"
+                req.trace.t_finish = now
+                self.metrics.record_request(req)
+                expired.append(req)
+                continue
+            blocks = self._pool.alloc(req.id,
+                                      req.prompt_len +
+                                      req.max_new_tokens - 1)
+            if blocks is None:
+                break            # wait for live rows to free their blocks
+            self._queue.popleft()
+            slot = free.pop(0)
+            req.status = "active"
+            req.trace.t_admit = now
+            req.trace.batch_id = self._batch_id
+            # install into the slot BEFORE the device call: if prefill
+            # dies mid-flight, _step_paged's handler finds the request
+            # here and records it as status="error" — the engine's
+            # in-flight accounting contract
+            self._slots[slot] = req
+            ids = np.full((1, cfg.prompt_cap), cfg.pad_token_id,
+                          dtype=np.int64)
+            ids[0, :req.prompt_len] = req.prompt
+            table_row = self._pool.table_row(req.id, self._tables.shape[1])
+            with jax.profiler.TraceAnnotation("serving/prefill"):
+                self._pools, first = self.model.prefill_paged(
+                    ids, np.asarray([req.prompt_len], np.int32),
+                    self._pools, table_row[None],
+                    temperature=cfg.temperature, top_k=cfg.top_k,
+                    top_p=cfg.top_p, seed=cfg.seed + self._calls,
+                    weight_dtype=cfg.weight_dtype)
+                tok = int(np.asarray(first.numpy())[0])
+            self._calls += 1
+            n_prefills += 1
+            t = self.clock()
+            req.trace.t_prefill_done = t
+            req.trace.t_first_token = t   # sampled with the prefill call
+            self._tables[slot] = table_row
+            self._lens[slot] = req.prompt_len
+            self._pending[slot] = tok
+            hit_eos = (cfg.eos_token_id is not None
+                       and tok == cfg.eos_token_id)
+            self._done[slot] = hit_eos
+            req._chunks = [np.asarray([tok], np.int64)]
+            req._produced = 1
+            if req._produced >= req.max_new_tokens or hit_eos:
+                self._finish_paged_row(slot, t)
+                finished.append(req)
+                free.insert(0, slot)
+            self._batch_id += 1
+        self.metrics.gauges["queue_depth"] = len(self._queue)
+        if n_prefills:
+            # admission-only steps (budget-1 / instant-EOS traffic) still
+            # report the post-admission pool state; a following decode
+            # chunk overwrites this with its own entry snapshot
+            self._kv_snapshot = (
+                int(self._lens.sum()),
+                self._pool.used_blocks * self._pool.block_size)
+        return finished, expired, n_prefills
+
+    def _decode_chunk_paged(self, live: List[int]):
+        """One fixed-shape decode chunk over the whole slot batch (dummy
+        rows write the trash block and are ignored); finish + free every
+        row that hit EOS or its budget. Returns (finished, real tokens)."""
+        cfg = self.config
+        c = cfg.decode_chunk
+        self._kv_snapshot = (int(self._lens.sum()),
+                             self._pool.used_blocks * self._pool.block_size)
+        with jax.profiler.TraceAnnotation("serving/decode"):
+            toks, self._pools, _, done_d = self.model.decode_paged(
+                self._pools, self._tables, self._lens, self._pending,
+                self._done, c, temperature=cfg.temperature,
+                top_k=cfg.top_k, top_p=cfg.top_p,
+                seed=cfg.seed + self._calls,
+                eos_token_id=cfg.eos_token_id,
+                weight_dtype=cfg.weight_dtype)
+            arr = np.asarray(toks.numpy())          # host sync per chunk
+        self._calls += 1
+        t = self.clock()
+        self._pending = arr[:, -1].astype(np.int32)
+        self._done = np.array(done_d)      # copy: slot edits need a
+        #                                    writable host array
+        finished: List[Request] = []
+        out_tokens = 0
+        for slot in live:
+            req = self._slots[slot]
+            take = min(c, req.max_new_tokens - req._produced)
+            req._chunks.append(arr[slot, :take])
+            req._produced += take
+            out_tokens += take
+            self._lens[slot] += c     # device wrote c rows regardless
+            # EOS scan covers only the FRESH slice: earlier chunks were
+            # checked when they landed (an EOS there already finished the
+            # row), so the per-generation host cost stays O(n)
+            row_done = req._produced >= req.max_new_tokens or \
+                _hit_eos(arr[slot, :take], cfg.eos_token_id)
+            if row_done:
+                self._finish_paged_row(slot, t)
+                finished.append(req)
+        return finished, out_tokens
+
+    def _finish_paged_row(self, slot: int, t: float):
+        """Terminal bookkeeping for one slot: blocks free IMMEDIATELY (the
+        next _admit_paged can splice a queued request into this slot
+        mid-flight — no waiting for the batch to drain)."""
+        req = self._slots[slot]
+        row = np.concatenate(req._chunks)[:req.max_new_tokens]
+        req.tokens = row.astype(np.int64)
+        req.n_out = _n_out(req.tokens, self.config.eos_token_id)
+        req.status = "done"
+        req.trace.t_finish = t
+        self._pool.free(req.id)
+        self._slots[slot] = None
+        self._clear_slot(slot)
+        self.metrics.record_request(req)
+
     def drain(self, max_batches: Optional[int] = None) -> List[Request]:
-        """step() until the queue empties (or max_batches)."""
+        """step() until the queue empties and every live slot finishes
+        (or max_batches)."""
         out: List[Request] = []
         n = 0
-        while self._queue:
+        while self.busy:
             if max_batches is not None and n >= max_batches:
                 break
             got = self.step()
             n += 1
-            if not got and not self._queue:
+            if not got and not self.busy:
                 break
             out.extend(got)
         return out
@@ -630,17 +948,31 @@ def _n_out(row: np.ndarray, eos: Optional[int]) -> int:
 
 def synthetic_traffic(n_requests: int, *, prompt_cap: int, vocab_size: int,
                       rate: float = 50.0, seed: int = 0,
-                      min_len: int = 1) -> List[dict]:
+                      min_len: int = 1,
+                      length_dist: str = "uniform") -> List[dict]:
     """Open-loop synthetic workload: Poisson arrivals at `rate` req/s,
-    uniform ragged prompt lengths in [min_len, prompt_cap]. Returns
+    ragged prompt lengths in [min_len, prompt_cap]. Returns
     [{"at": arrival_offset_s, "prompt": ids}] sorted by arrival — shared
-    by examples/serve_gpt.py and tools/serve_bench.py."""
+    by examples/serve_gpt.py and tools/serve_bench.py.
+
+    length_dist:
+      "uniform"  — lengths uniform over [min_len, prompt_cap];
+      "longtail" — Pareto-shaped (alpha≈1.1) lengths clipped to the cap:
+                   mostly-short traffic with a heavy tail of cap-length
+                   prompts, the mix where right-padding wastes the most
+                   HBM and the paged pool shows its gap (serve_bench's
+                   padded-vs-paged comparison profile)."""
+    if length_dist not in ("uniform", "longtail"):
+        raise ValueError(f"unknown length_dist {length_dist!r}")
     rng = np.random.RandomState(seed)
     gaps = rng.exponential(1.0 / max(rate, 1e-9), size=n_requests)
     at = np.cumsum(gaps) - gaps[0]
     out = []
     for i in range(n_requests):
-        ln = int(rng.randint(min_len, prompt_cap + 1))
+        if length_dist == "longtail":
+            ln = min(prompt_cap, min_len + int(rng.pareto(1.1) * min_len))
+        else:
+            ln = int(rng.randint(min_len, prompt_cap + 1))
         out.append({"at": float(at[i]),
                     "prompt": rng.randint(1, vocab_size,
                                           (ln,)).astype(np.int64)})

@@ -172,7 +172,58 @@ class GPTSelfAttention(Layer):
         b, s = qkv.shape[0], qkv.shape[1]
 
         new_cache = None
-        if cache is not None and _is_q8_cache(cache):
+        if cache is not None and isinstance(cache[0], str):
+            # PAGED KV-cache serving (ISSUE 5): ("paged", k_pool, v_pool,
+            # block_tables, lens). KV lives in a fixed [NB, bs, nh, hd]
+            # block pool shared by every request; each row owns blocks
+            # named by its table row. One executable serves ANY mix of
+            # request lengths — the table/lens vectors are data, never
+            # shape. `lens` means: true prompt length during prefill
+            # (s > 1), tokens already in the cache during decode (s == 1).
+            if cache[0] != "paged":
+                raise ValueError(f"unknown tagged KV-cache kind "
+                                 f"{cache[0]!r} (expected 'paged')")
+            qkv = ops.reshape(qkv, [b, s, 3, nh, hd])
+            kp, vp, tables, lens = cache[1], cache[2], cache[3], cache[4]
+            q = qkv[:, :, 0]
+            from ..ops.attention import (paged_cache_write,
+                                         paged_prefill_write,
+                                         paged_prefill_mask,
+                                         paged_attention,
+                                         attention_reference)
+            if s == 1:
+                # decode step: the token lands at row position lens[b] and
+                # attends to cols <= itself (lens + 1 attendable rows)
+                kp2 = apply_op("paged_cache_k", paged_cache_write,
+                               [kp, qkv[:, :, 1], tables, lens])
+                vp2 = apply_op("paged_cache_v", paged_cache_write,
+                               [vp, qkv[:, :, 2], tables, lens])
+
+                def _attend_paged(qa, kpa, vpa, t, l):
+                    return paged_attention(qa, kpa, vpa, t, l + 1,
+                                           score_dtype=qa.dtype)
+
+                ctx = apply_op("paged_attend", _attend_paged,
+                               [q, kp2, vp2, tables, lens])
+            else:
+                # prefill: write the padded prompt's K/V into the row's
+                # blocks (padding past a row's reservation lands in the
+                # trash block), attend over the prompt itself — ragged
+                # causal, identical numerics class to the static prefill
+                kp2 = apply_op("paged_prefill_k", paged_prefill_write,
+                               [kp, qkv[:, :, 1], tables])
+                vp2 = apply_op("paged_prefill_v", paged_prefill_write,
+                               [vp, qkv[:, :, 2], tables])
+
+                def _attend_prompt(qa, ka, va, l):
+                    mask = paged_prefill_mask(qa.shape[1], l)
+                    return attention_reference(qa, ka, va, mask=mask,
+                                               score_dtype=qa.dtype)
+
+                ctx = apply_op("paged_prefill_attend", _attend_prompt,
+                               [q, qkv[:, :, 1], qkv[:, :, 2], lens])
+            new_cache = ("paged", kp2.detach(), vp2.detach(), tables, lens)
+        elif cache is not None and _is_q8_cache(cache):
             # INT8 static-cache decode (cache_dtype="int8"): the bf16 path
             # below is KV-bandwidth-bound at small batch — storing the
             # cache as int8 codes + per-(pos,head) scales halves the KV
@@ -420,7 +471,20 @@ class GPTModel(Layer):
         if position_ids is None:
             # int32: positions fit trivially and i64 gathers are 2x-emulated
             # on TPU (MIGRATION.md "Integer dtypes")
-            if caches and len(caches[0]) >= 3:
+            if caches and isinstance(caches[0][0], str):
+                # paged caches (prefill_paged/decode_paged pass positions
+                # explicitly; this covers direct forward() callers): in
+                # prefill (s > 1) the cache's lens vector holds PROMPT
+                # lengths and positions start at 0; in decode (s == 1) a
+                # row's next position IS its current length
+                if s > 1:
+                    position_ids = ops.unsqueeze(
+                        ops.arange(0, s, dtype="int32"), 0)
+                else:
+                    lens = caches[0][4]
+                    position_ids = ops.unsqueeze(lens, -1) + \
+                        ops.arange(0, s, dtype="int32")
+            elif caches and len(caches[0]) >= 3:
                 # static-cache decode: the write position IS the offset
                 # (int8 tuples carry it at index 4, bf16 at index 2)
                 pos0 = (caches[0][4] if _is_q8_cache(caches[0])
@@ -520,6 +584,15 @@ def _unwrap_ragged_caches(new_caches):
     returns: flatten the nested (lens, cap) back to a trailing lens."""
     return [tuple(e._data for e in c[:-1]) + (c[-1][0]._data,)
             for c in new_caches]
+
+
+def _check_pool_dtype(pools, cdt):
+    """Paged pools must carry the model dtype (the paged path has no int8
+    cache mode yet — pools ARE the cache; see README Serving)."""
+    pdt = pools[0][0].dtype
+    if jnp.dtype(pdt) != jnp.dtype(cdt):
+        raise ValueError(f"paged KV pools are {pdt}, model is {cdt}; "
+                         f"rebuild the pool after model.to(dtype=...)")
 
 
 def _make_static_caches(c8, nl, b, L, nh, hd, cdt, lens=None):
@@ -831,7 +904,8 @@ class GPTForCausalLM(Layer):
     def decode_static(self, state, max_new_tokens: int,
                       temperature: float = 0.0, top_k: int = 0,
                       top_p: float = 1.0, seed: int = 0,
-                      eos_token_id: int = None, return_state: bool = False):
+                      eos_token_id: int = None, return_state: bool = False,
+                      donate_cache: bool = False):
         """Continue from a `prefill_static` state: ONE compiled lax.scan of
         fixed-shape decode steps. Repeated calls (different seeds /
         sampling configs) reuse the SAME prefill — greedy output equals
@@ -846,7 +920,16 @@ class GPTForCausalLM(Layer):
         time-to-first-token truthfully and to stop early once every row
         finished, with each chunk size compiling once. Sampled
         (temperature > 0) chunked output differs from one-shot by design:
-        every call seeds its own PRNG stream."""
+        every call seeds its own PRNG stream.
+
+        donate_cache=True (requires return_state) DONATES the state's KV
+        buffers to XLA, which then updates them in place instead of
+        re-threading the whole cache tuple by value every chunk — the
+        serving engine's chunk loop sets it. It CONSUMES the input state:
+        the passed-in state's cache arrays are invalid afterwards, so the
+        prefill fan-out pattern (one prefill, many continuations) must
+        keep the default. Tokens are bit-identical either way (donation is
+        an aliasing hint, not a numerics change)."""
         import jax
         from jax import lax
         from ..jit.api import _swap_params, _trace_guard
@@ -856,6 +939,10 @@ class GPTForCausalLM(Layer):
         L = state["max_len"]
         resume = state.get("pending") is not None
         gen0 = int(state.get("generated", 0))
+        if donate_cache and not return_state:
+            raise ValueError("donate_cache=True needs return_state=True: "
+                             "without the returned state the donated "
+                             "buffers would simply be destroyed")
         if max_new_tokens <= 0:
             raise ValueError("decode_static needs max_new_tokens >= 1 "
                              "(the state already holds the prompt)")
@@ -978,9 +1065,12 @@ class GPTForCausalLM(Layer):
                "c8" if state["c8"] else "cfull",
                "ragged" if ragged else "fixed",
                "resume" if resume else "fresh",
-               "st" if return_state else "nost")
+               "st" if return_state else "nost",
+               "don" if donate_cache else "nodon")
         fn = self._gen_cache_get(
-            sig, lambda: jax.jit(run_resume if resume else run))
+            sig, lambda: jax.jit(
+                run_resume if resume else run,
+                donate_argnums=(1,) if donate_cache else ()))
         done0 = state.get("done")
         if done0 is None:
             done0 = jnp.zeros((b,), bool)
@@ -998,6 +1088,182 @@ class GPTForCausalLM(Layer):
                          generated=gen0 + int(max_new_tokens),
                          last_logits=None)
         return Tensor(toks), new_state
+
+    # ------------------------------------------------ paged-pool serving
+    def prefill_paged(self, input_ids, prompt_lens, pools, block_tables,
+                      temperature: float = 0.0, top_k: int = 0,
+                      top_p: float = 1.0, seed: int = 0,
+                      weight_dtype: str = None):
+        """Prefill ragged prompts INTO a paged KV block pool (ISSUE 5).
+
+        input_ids [n, P_cap] right-padded prompts; prompt_lens [n] true
+        lengths; pools = per-layer (k_pool, v_pool) from
+        `inference.kv_cache.BlockPool.make_pools()`; block_tables [n, MB]
+        int32 rows naming each prompt's allocated blocks (0 = trash).
+
+        Writes every prompt's K/V into its blocks and returns
+        ``(pools', first_token [n] int32)`` — the pools are DONATED
+        (updated in place by XLA; the passed-in arrays are invalid after
+        the call) and first_token is already sampled from each row's
+        last-real-position logits, so TTFT is known the moment this call
+        syncs. One executable serves any prompt lengths <= P_cap: the
+        table/lens vectors are data inputs, and the serving engine uses a
+        fixed n (1 per spliced admission) so steady-state traffic adds
+        zero compilations."""
+        import jax
+        from ..jit.api import _swap_params, _trace_guard
+        from ..core import autograd
+
+        ids = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(input_ids)
+        b, p_cap = ids.shape
+        lens_arr = _coerce_prompt_lens(prompt_lens, p_cap, "prefill_paged")
+        tables = jnp.asarray(
+            block_tables._data if isinstance(block_tables, Tensor)
+            else block_tables, jnp.int32)
+        if tables.shape[0] != b:
+            raise ValueError(f"prefill_paged: block_tables rows "
+                             f"({tables.shape[0]}) != batch ({b})")
+        params = list(self.parameters())
+        cdt = self.gpt.wte.weight._data.dtype
+        _check_pool_dtype(pools, cdt)
+        q8 = weight_dtype == "int8"
+        qmap = self._decode_quantized_params() if q8 else {}
+        expand = self._make_expand(q8, cdt)
+
+        def run(pa, pools, prompt, lens, tbl, key0):
+            ex, pays = expand(pa)
+            with _trace_guard(), _swap_params(params, ex), \
+                    _q8_bind(params, pays), autograd.no_grad():
+                caches = [("paged", Tensor(kp), Tensor(vp), Tensor(tbl),
+                           Tensor(lens)) for kp, vp in pools]
+                pos0 = jnp.broadcast_to(
+                    jnp.arange(p_cap, dtype=jnp.int32)[None], (b, p_cap))
+                logits, nc = self.forward(
+                    Tensor(prompt), position_ids=Tensor(pos0),
+                    caches=caches)
+            new_pools = [(c[1]._data, c[2]._data) for c in nc]
+            last = logits._data[jnp.arange(b), lens - 1].astype(jnp.float32)
+            key0, k1 = jax.random.split(key0)
+            nxt = sample_logits(last, k1, temperature=temperature,
+                                top_k=top_k, top_p=top_p).astype(jnp.int32)
+            return new_pools, nxt
+
+        nb, bs = pools[0][0].shape[0], pools[0][0].shape[1]
+        sig = ("paged_prefill", b, p_cap, nb, bs, int(tables.shape[1]),
+               float(temperature), int(top_k), float(top_p), str(cdt),
+               "q8" if q8 else "full")
+        fn = self._gen_cache_get(
+            sig, lambda: jax.jit(run, donate_argnums=(1,)))
+        payload = tuple(qmap[i] if i in qmap else p._data
+                        for i, p in enumerate(params)) if q8 else \
+            tuple(p._data for p in params)
+        pools2, nxt = fn(payload, pools, ids._data, lens_arr, tables,
+                         jax.random.PRNGKey(seed))
+        return pools2, Tensor(nxt)
+
+    def decode_paged(self, pools, block_tables, lens, pending, done,
+                     max_new_tokens: int, temperature: float = 0.0,
+                     top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                     eos_token_id: int = None, weight_dtype: str = None):
+        """One compiled chunk of ragged decode against the paged pool.
+
+        Feeds `pending` (each row's last sampled-but-unwritten token,
+        same resume convention as decode_static's return_state), writes
+        its K/V at each row's own position `lens[b]`, and scans
+        `max_new_tokens` fixed-shape steps. block_tables/lens/pending/done
+        are DATA inputs — the serving engine edits them per batch slot
+        between chunks (slot-level splicing) without ever changing a
+        compiled signature; one executable per chunk SIZE serves every mix
+        of request lengths and every resume depth. The pools are DONATED
+        (in-place update; the passed-in arrays are invalid afterwards).
+
+        Returns ``(tokens [B, max_new_tokens] int64, pools', lens',
+        done')``. Greedy chains are bit-identical per row to
+        generate_static_ragged — attention masks make batch company and
+        chunking value-invariant, and each row's positions are its own
+        true lengths. (Caveat: bf16 models on TPU route through the
+        f32-score Pallas kernel while the static path stores bf16 scores,
+        so parity there is approximate near argmax ties; exact when both
+        sides share a numerics class — f32 models, or the CPU reference
+        path.)"""
+        import jax
+        from jax import lax
+        from ..jit.api import _swap_params, _trace_guard
+        from ..core import autograd
+
+        if max_new_tokens <= 0:
+            raise ValueError("decode_paged needs max_new_tokens >= 1")
+        tables = jnp.asarray(
+            block_tables._data if isinstance(block_tables, Tensor)
+            else block_tables, jnp.int32)
+        b = tables.shape[0]
+        lens_arr = jnp.asarray(
+            lens._data if isinstance(lens, Tensor) else lens, jnp.int32)
+        pending_arr = jnp.asarray(
+            pending._data if isinstance(pending, Tensor) else pending,
+            jnp.int32)
+        done_arr = jnp.asarray(
+            done._data if isinstance(done, Tensor) else done, bool)
+        params = list(self.parameters())
+        cdt = self.gpt.wte.weight._data.dtype
+        _check_pool_dtype(pools, cdt)
+        q8 = weight_dtype == "int8"
+        qmap = self._decode_quantized_params() if q8 else {}
+        expand = self._make_expand(q8, cdt)
+
+        def pick(last, key):
+            return sample_logits(last, key, temperature=temperature,
+                                 top_k=top_k, top_p=top_p)
+
+        def run(pa, pools, tbl, lens_, pending_, done_, key0):
+            def model_step(tokens, pools, ln):
+                ex, pays = expand(pa)
+                with _trace_guard(), _swap_params(params, ex), \
+                        _q8_bind(params, pays), autograd.no_grad():
+                    caches = [("paged", Tensor(kp), Tensor(vp),
+                               Tensor(tbl), Tensor(ln))
+                              for kp, vp in pools]
+                    logits, nc = self.forward(
+                        Tensor(tokens), position_ids=Tensor(ln[:, None]),
+                        caches=caches)
+                return (logits._data,
+                        [(c[1]._data, c[2]._data) for c in nc])
+
+            def body(carry, _):
+                pools, ln, cur, key, dn = carry
+                logits, pools = model_step(cur[:, None], pools, ln)
+                ln = ln + 1
+                key, kk = jax.random.split(key)
+                new = pick(logits[:, -1].astype(jnp.float32),
+                           kk).astype(jnp.int32)
+                if eos_token_id is not None:
+                    new = jnp.where(dn, jnp.asarray(eos_token_id,
+                                                    new.dtype), new)
+                    dn = dn | (new == eos_token_id)
+                return (pools, ln, new, key, dn), new
+
+            (pools, lens_, _, _, done_), toks = lax.scan(
+                body, (pools, lens_, pending_, key0, done_), None,
+                length=max_new_tokens)
+            out = jnp.moveaxis(toks, 0, 1).astype(jnp.int64)
+            return out, pools, lens_, done_
+
+        nb, bs = pools[0][0].shape[0], pools[0][0].shape[1]
+        sig = ("paged_decode", b, nb, bs, int(tables.shape[1]),
+               int(max_new_tokens), float(temperature), int(top_k),
+               float(top_p),
+               None if eos_token_id is None else int(eos_token_id),
+               str(cdt), "q8" if q8 else "full")
+        fn = self._gen_cache_get(
+            sig, lambda: jax.jit(run, donate_argnums=(1,)))
+        payload = tuple(qmap[i] if i in qmap else p._data
+                        for i, p in enumerate(params)) if q8 else \
+            tuple(p._data for p in params)
+        toks, pools2, lens2, done2 = fn(payload, pools, tables, lens_arr,
+                                        pending_arr, done_arr,
+                                        jax.random.PRNGKey(seed))
+        return Tensor(toks), pools2, lens2, done2
 
     def _make_expand(self, q8, cdt):
         """The shared mixed-payload expander (full arrays pass through;

@@ -232,6 +232,122 @@ def static_cache_update_q8(codes_buf, scale_buf, new, pos):
             static_cache_update(scale_buf, scale, pos))
 
 
+# ------------------------------------------------- paged KV cache (serving)
+# Block-pool serving path (ISSUE 5; Ragged Paged Attention, arxiv
+# 2604.15464): KV lives in a fixed [num_blocks, block, H, D] pool, each
+# request owns a list of blocks named by an int32 block table, and ONE
+# fixed-shape executable serves any mix of request lengths. Block 0 is the
+# reserved TRASH block (inference/kv_cache.py) — table padding entries and
+# out-of-budget writes land there, so the scatter updates below never need
+# a mask and can never touch another request's blocks.
+
+def paged_cache_write(pool, new, tables, lens):
+    """Write one decode-step row per batch entry into its pool block.
+
+    pool [NB, bs, H, D]; new [B, 1, H, D]; tables [B, MB] i32; lens [B]
+    i32 = tokens already in each row's cache, so row b's new token lands at
+    global position lens[b] → block tables[b, lens[b]//bs], offset
+    lens[b]%bs. Rows past their table width clamp into their own last
+    block (their outputs are already ignored by then); trash-table rows
+    (dummy slots) write block 0."""
+    nb, bs = pool.shape[0], pool.shape[1]
+    li = lens.astype(jnp.int32)
+    bidx = jnp.take_along_axis(tables.astype(jnp.int32),
+                               (li // bs)[:, None], axis=1,
+                               mode="clip")[:, 0]
+    dest = bidx * bs + (li % bs)
+    flat = pool.reshape((nb * bs,) + pool.shape[2:])
+    flat = flat.at[dest].set(new[:, 0].astype(pool.dtype))
+    return flat.reshape(pool.shape)
+
+
+def paged_prefill_write(pool, new, tables):
+    """Write a whole (right-padded) prompt's K/V rows into pool blocks.
+
+    new [B, S, H, D] holds the PADDED prompt projection; position p of row
+    b goes to block tables[b, p//bs], offset p%bs. Padding columns beyond a
+    row's allocated blocks hit table entries of 0 — the trash block — and
+    padding columns inside the row's own reservation are plain garbage the
+    attention masks exclude until decode overwrites them."""
+    nb, bs = pool.shape[0], pool.shape[1]
+    b, s = new.shape[0], new.shape[1]
+    pos = jnp.arange(s, dtype=jnp.int32)[None, :]
+    bidx = jnp.take_along_axis(tables.astype(jnp.int32),
+                               jnp.broadcast_to(pos // bs, (b, s)),
+                               axis=1, mode="clip")
+    dest = (bidx * bs + pos % bs).reshape(-1)
+    flat = pool.reshape((nb * bs,) + pool.shape[2:])
+    flat = flat.at[dest].set(
+        new.reshape((b * s,) + new.shape[2:]).astype(pool.dtype))
+    return flat.reshape(pool.shape)
+
+
+def paged_prefill_mask(s, lens):
+    """[B, 1, S, S] keep-mask for prompt self-attention over a right-padded
+    ragged batch: causal AND key column < the row's true length — exactly
+    static_cache_mask's ragged form at pos=0 over a buffer the size of the
+    prompt itself (one definition of the ragged-causal semantics)."""
+    return static_cache_mask(s, s, jnp.int32(0), prompt_lens=lens,
+                             prefill_cap=s)
+
+
+def paged_attention_reference(q, k_pool, v_pool, tables, lens, *,
+                              scale=None, score_dtype=None):
+    """Pure-jnp ragged paged decode attention — the CPU/tier-1 path and
+    the parity oracle for the Pallas kernel.
+
+    q [B, 1, H, D] (single decode token per row); pools [NB, bs, H, D];
+    tables [B, MB]; lens [B] = ATTENDABLE rows per batch entry (callers
+    pass tokens-in-cache + 1 so the just-written token sees itself).
+    Gathers each row's blocks into a contiguous [B, MB*bs, H, D] view and
+    defers to `attention_reference` with the ragged keep-mask — same
+    softmax/accumulation conventions as the static-cache path. Rows with
+    lens == 0 (dummy batch slots) produce garbage, not NaN: the masked
+    softmax degrades to uniform, and callers drop those rows."""
+    if q.shape[1] != 1:
+        raise ValueError(f"paged_attention_reference serves single-token "
+                         f"decode; got q seq len {q.shape[1]}")
+    nb, bs = k_pool.shape[0], k_pool.shape[1]
+    b, mb = tables.shape
+    t = tables.astype(jnp.int32)
+    k = jnp.take(k_pool, t, axis=0).reshape((b, mb * bs) + k_pool.shape[2:])
+    v = jnp.take(v_pool, t, axis=0).reshape((b, mb * bs) + v_pool.shape[2:])
+    col = jnp.arange(mb * bs, dtype=jnp.int32)[None, None, None, :]
+    mask = col < lens.astype(jnp.int32)[:, None, None, None]
+    return attention_reference(q, k, v, mask=mask, scale=scale,
+                               score_dtype=score_dtype)
+
+
+def _use_paged_kernel():
+    """Kernel-vs-reference routing, mirroring `_use_pallas`:
+    PADDLE_TPU_PAGED=0 forces the jnp reference, =1 forces the Pallas
+    kernel (opting a capable host in), unforced requires a TPU-class
+    platform. No shape constraints — the kernel is VPU-only."""
+    import os
+    force = os.environ.get("PADDLE_TPU_PAGED")
+    if force == "0":
+        return False
+    if force == "1":
+        return True
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except RuntimeError:
+        return False
+
+
+def paged_attention(q, k_pool, v_pool, tables, lens, *, scale=None,
+                    score_dtype=None):
+    """Ragged paged decode attention: Pallas kernel on TPU (block-table
+    indexed fetches, online softmax, nothing gathered to HBM), jnp gather
+    reference elsewhere — selected exactly like flash_attention is."""
+    if _use_paged_kernel():
+        from .pallas.paged_attention import paged_attention_kernel
+        return paged_attention_kernel(q, k_pool, v_pool, tables, lens,
+                                      scale=scale)
+    return paged_attention_reference(q, k_pool, v_pool, tables, lens,
+                                     scale=scale, score_dtype=score_dtype)
+
+
 def static_cache_mask(kv_capacity, s, pos, prompt_lens=None,
                       prefill_cap=None):
     """Bool keep-mask for fixed-buffer decode.

@@ -1,0 +1,138 @@
+"""Pallas TPU kernel: single-token decode attention over a paged KV pool.
+
+The serving decode problem (ISSUE 5; Ragged Paged Attention, arxiv
+2604.15464): each batch row's KV cache is a list of fixed-size blocks
+scattered through one [num_blocks, block, H, D] pool, named by an int32
+block table. The XLA-visible alternative — gather the blocks into a
+contiguous [B, L, H, D] buffer, then attend — materializes the whole
+working set in HBM twice per step (`paged_attention_reference`, the
+CPU/tier-1 path). This kernel instead walks the block table directly:
+
+  grid (B, MB)   one program per (batch row, table slot), MB innermost so
+                 the online-softmax state lives in VMEM scratch across a
+                 row's blocks (same accumulator pattern as
+                 flash_attention.py);
+  block fetch    the K/V BlockSpec index maps read the SCALAR-PREFETCHED
+                 block table — Pallas DMAs exactly the pool page the row
+                 needs next, so HBM traffic is the true KV bytes, not the
+                 padded envelope. Table padding entries are 0 (the trash
+                 block), and consecutive same-index fetches collapse in
+                 the pipeline, so invalid tail slots cost ~nothing;
+  masking        global column j*bs + i is attendable iff < lens[row];
+                 blocks entirely past lens skip their accumulate
+                 (`pl.when`), partial blocks mask per column.
+
+Compute is deliberately VPU-only (broadcast-multiply-reduce per head, the
+q vector is 1 token — there is no MXU shape here worth a relayout); decode
+attention is KV-bandwidth-bound, so the fetch pattern IS the optimization.
+Numerics: f32 scores/softmax/accumulation whatever the pool dtype (like
+the other Pallas kernels here — the XLA static-cache path instead stores
+scores in the model dtype, so bf16 models' kernel-vs-reference parity is
+approximate; see tools/validate_paged_tpu.py).
+
+Rows with lens == 0 (dummy batch slots) output zeros (the reference path
+outputs masked-uniform garbage instead — both are dropped by callers, and
+the parity tests compare live rows).
+
+CPU validation runs this kernel in interpret mode (tests); on-chip
+compiled parity is tools/validate_paged_tpu.py, same split as the other
+Pallas kernels here.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+
+
+def _kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+            m_sc, l_sc, acc_sc, *, scale, nh, bs, n_slots):
+    b, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    ln = lens_ref[b]
+
+    @pl.when(j * bs < ln)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)            # [nh, hd]
+        k = k_ref[0].astype(jnp.float32)            # [bs, nh, hd]
+        v = v_ref[0].astype(jnp.float32)
+        col = j * bs + lax.broadcasted_iota(jnp.int32, (bs, 1), 0)
+        keep = col < ln
+        # per-head online softmax on the VPU: q is one token, so the
+        # "matmul" is a broadcast multiply + lane reduction; nh unrolls
+        # statically (serving configs keep nh <= 40)
+        for h in range(nh):
+            s = jnp.sum(k[:, h, :] * q[h:h + 1, :], axis=-1,
+                        keepdims=True) * scale      # [bs, 1]
+            s = jnp.where(keep, s, jnp.asarray(_NEG, s.dtype))
+            m_prev = m_sc[h:h + 1, :]               # [1, 1]
+            l_prev = l_sc[h:h + 1, :]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=0, keepdims=True))
+            p = jnp.exp(s - m_new)                  # [bs, 1]
+            corr = jnp.exp(m_prev - m_new)
+            m_sc[h:h + 1, :] = m_new
+            l_sc[h:h + 1, :] = corr * l_prev + jnp.sum(p, axis=0,
+                                                       keepdims=True)
+            acc_sc[h:h + 1, :] = corr * acc_sc[h:h + 1, :] + jnp.sum(
+                p * v[:, h, :], axis=0, keepdims=True)
+
+    @pl.when(j == n_slots - 1)
+    def _finish():
+        l = jnp.maximum(l_sc[...], 1e-30)           # lens==0 rows -> zeros
+        o_ref[0] = (acc_sc[...] / l).astype(o_ref.dtype)
+
+
+def paged_attention_kernel(q, k_pool, v_pool, tables, lens, *, scale=None,
+                           interpret=False):
+    """q [B, 1, H, D] (or [B, H, D]); pools [NB, bs, H, D]; tables
+    [B, MB] i32; lens [B] = attendable rows per batch entry. Returns the
+    same layout as q."""
+    squeezed = q.ndim == 4
+    if squeezed:
+        if q.shape[1] != 1:
+            raise ValueError(f"paged decode kernel serves one token per "
+                             f"row; got q seq len {q.shape[1]}")
+        q3 = q[:, 0]
+    else:
+        q3 = q
+    b, nh, hd = q3.shape
+    nb, bs = k_pool.shape[0], k_pool.shape[1]
+    mb = tables.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((1, nh, hd), lambda bi, j, T, L: (bi, 0, 0)),
+            pl.BlockSpec((1, bs, nh, hd),
+                         lambda bi, j, T, L: (T[bi, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, nh, hd),
+                         lambda bi, j, T, L: (T[bi, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nh, hd), lambda bi, j, T, L: (bi, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((nh, 1), jnp.float32),
+                        pltpu.VMEM((nh, 1), jnp.float32),
+                        pltpu.VMEM((nh, hd), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, nh=nh, bs=bs, n_slots=mb),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nh, hd), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), lens.astype(jnp.int32), q3, k_pool, v_pool)
+    return out[:, None] if squeezed else out

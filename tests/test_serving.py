@@ -120,15 +120,16 @@ class TestExpositionFormat:
             r.trace.t_enqueue, r.trace.t_admit = 0.0, 0.1 * t
             r.trace.t_first_token, r.trace.t_finish = 0.5 * t, t
             met.record_request(r)
-        met.record_batch(n_real=3, capacity=4, kv_used=30, kv_capacity=64,
-                         queue_depth=2)
+        met.record_batch(n_real=3, capacity=4, kv_tokens=30, kv_slots=48,
+                         kv_capacity=64, queue_depth=2)
         text = met.metrics_text()
         types = _check_exposition(text)
         for h in ("ttft_seconds", "tpot_seconds", "e2e_seconds",
                   "queue_seconds"):
             assert types[f"paddle_tpu_serving_{h}"] == "histogram"
             _histogram_invariants(text, f"paddle_tpu_serving_{h}")
-        for g in ("queue_depth", "batch_fill_ratio", "kv_slot_occupancy"):
+        for g in ("queue_depth", "batch_fill_ratio", "kv_occupancy",
+                  "kv_slots_occupancy"):
             assert types[f"paddle_tpu_serving_{g}"] == "gauge"
         for c in ("requests_total", "rejected_total", "timeout_total",
                   "tokens_in_total", "tokens_out_total"):
@@ -274,7 +275,10 @@ def test_engine_batch_gauges_and_counters(served_model):
     eng.drain()
     s = eng.summary()
     assert s["batch_fill_ratio"] == 0.5
-    assert 0 < s["kv_slot_occupancy"] <= 1.0
+    assert 0 < s["kv_occupancy"] <= 1.0
+    # padded engine: each admitted row pins a full max_len slab
+    assert s["kv_slots_occupancy"] == 1 * eng.config.max_len / \
+        (BATCH * eng.config.max_len)
     assert s["tokens_in_total"] == 4 and s["tokens_out_total"] == NEW
     assert s["batches_total"] == 1 and s["completed_total"] == 1
     assert s["batch_step"]["steps"] == 1

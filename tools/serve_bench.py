@@ -17,6 +17,14 @@ whatever is queued — exactly the accounting a load balancer would see.
         --batch 4 --prompt-cap 16 --new 8 \
         --slo-ttft-ms 500 --slo-e2e-ms 2000 [--json] [--metrics]
 
+Paged serving (ISSUE 5): ``--paged`` runs the block-pool engine
+(slot-level continuous batching, mid-flight admission); ``--compare``
+replays the SAME traffic through both engines and prints the
+padded-vs-paged table (tok/s, p99 TTFT, true KV occupancy).
+``--length-dist longtail`` draws Pareto-shaped prompt lengths — the
+mostly-short-with-heavy-tail mix where right-padding wastes the most HBM
+and paging shows its gap.
+
 Without --preset a 2-layer toy GPT runs on CPU (CI-sized); with a preset
 set PADDLE_TPU_EXAMPLE_TPU=1 to run real-chip sizes.
 """
@@ -53,20 +61,24 @@ def build_model(preset):
     return model, cfg
 
 
-def run_bench(args):
-    """Returns (report_dict, engine) — the engine rides along for the
-    optional --metrics exposition dump."""
-    from paddle_tpu.inference import (ServingEngine, ServingConfig,
-                                      synthetic_traffic)
-    model, cfg = build_model(args.preset)
-    sc = ServingConfig(max_batch=args.batch, prompt_cap=args.prompt_cap,
-                       max_new_tokens=args.new,
-                       decode_chunk=args.decode_chunk,
-                       queue_capacity=args.queue_capacity,
-                       eos_token_id=args.eos,
-                       weight_dtype="int8" if args.int8_weights else None,
-                       cache_dtype="int8" if args.int8_cache else None)
-    engine = ServingEngine(model, sc)
+def _serving_config(args, paged):
+    from paddle_tpu.inference import ServingConfig
+    return ServingConfig(max_batch=args.batch, prompt_cap=args.prompt_cap,
+                         max_new_tokens=args.new,
+                         decode_chunk=args.decode_chunk,
+                         queue_capacity=args.queue_capacity,
+                         eos_token_id=args.eos,
+                         weight_dtype="int8" if args.int8_weights else None,
+                         cache_dtype="int8" if (args.int8_cache and
+                                                not paged) else None,
+                         paged=paged, kv_block=args.kv_block,
+                         kv_blocks=args.kv_blocks)
+
+
+def run_engine(model, cfg, args, *, paged):
+    """Replay the workload through one engine; returns (report, engine)."""
+    from paddle_tpu.inference import ServingEngine, synthetic_traffic
+    engine = ServingEngine(model, _serving_config(args, paged))
 
     # warmup batch: compiles the (prefill + chunk) executables once, so the
     # measured replay is the steady state a long-lived server sits in
@@ -75,14 +87,22 @@ def run_bench(args):
     for item in warm:
         engine.submit(item["prompt"])
     engine.drain()
-    warm_metrics = type(engine.metrics)()       # fresh aggregates
-    engine.metrics = warm_metrics
+    engine.metrics = type(engine.metrics)()     # fresh aggregates
 
     traffic = synthetic_traffic(args.requests, prompt_cap=args.prompt_cap,
                                 vocab_size=cfg.vocab_size, rate=args.rate,
-                                seed=args.seed)
+                                seed=args.seed,
+                                length_dist=args.length_dist)
     t0 = engine.clock()
     finished = []
+    peak_kv = 0.0
+
+    def _track():
+        nonlocal peak_kv
+        kv = engine.metrics.gauges.get("kv_occupancy")
+        if kv is not None:
+            peak_kv = max(peak_kv, kv)
+
     for item in traffic:
         due = t0 + item["at"]
         wait = due - engine.clock()
@@ -93,7 +113,10 @@ def run_bench(args):
         engine.submit(item["prompt"], enqueue_at=due)
         while engine.queue_depth >= args.batch:
             finished.extend(engine.step())
-    finished.extend(engine.drain())
+            _track()
+    while engine.busy:
+        finished.extend(engine.step())
+        _track()
     wall = engine.clock() - t0
 
     done = [r for r in finished if r.status == "done"]
@@ -117,16 +140,75 @@ def run_bench(args):
         "e2e_attainment": attainment(e2es, args.slo_e2e_ms),
     }
     s = engine.summary()
-    out = {"preset": args.preset or "toy", "requests": args.requests,
-           "rate_req_s": args.rate, "wall_s": round(wall, 3),
+    out = {"mode": "paged" if paged else "padded",
+           "preset": args.preset or "toy", "requests": args.requests,
+           "rate_req_s": args.rate, "length_dist": args.length_dist,
+           "wall_s": round(wall, 3),
            "completed": len(done),
            "throughput_tok_s": round(s["tokens_out_total"] / wall, 1)
            if wall > 0 else None,
+           "kv_occupancy_peak": round(peak_kv, 4),
            "slo": slo, "serving": s}
     # the recompiles counter is a pure churn signal: refused requests log
     # their shape delta without feeding it (record_compile count=False)
     out["steady_recompiles"] = engine.monitor.recompiles
     return out, engine
+
+
+def run_bench(args):
+    """Returns ([report, ...], engine_of_last_run) — one report per engine
+    mode (two under --compare)."""
+    model, cfg = build_model(args.preset)
+    modes = [False, True] if args.compare else [args.paged]
+    reports = []
+    engine = None
+    for paged in modes:
+        rep, engine = run_engine(model, cfg, args, paged=paged)
+        reports.append(rep)
+    return reports, engine
+
+
+def _print_report(out):
+    s = out["serving"]
+    tput = out["throughput_tok_s"]
+    print(f"serve_bench[{out['mode']}]: {out['completed']}/"
+          f"{out['requests']} requests at {out['rate_req_s']} req/s "
+          f"({out['length_dist']}) -> "
+          f"{'n/a' if tput is None else tput} tok/s over {out['wall_s']}s")
+    for name in ("ttft_seconds", "tpot_seconds", "e2e_seconds",
+                 "queue_seconds"):
+        h = s.get(name)
+        if h:
+            print(f"  {name:<14} p50 {h['p50'] * 1e3:8.2f} ms   "
+                  f"p90 {h['p90'] * 1e3:8.2f} ms   "
+                  f"p99 {h['p99'] * 1e3:8.2f} ms")
+    fill, kv = s["batch_fill_ratio"], out["kv_occupancy_peak"]
+    print(f"  batch fill {'n/a' if fill is None else f'{fill:.2f}'}   "
+          f"true kv occupancy (peak) {kv:.2f}   "
+          f"batches {s['batches_total']}")
+    slo = out["slo"]
+    if slo["ttft_attainment"] is not None:
+        print(f"  SLO: TTFT<= {slo['ttft_ms']:.0f}ms "
+              f"{slo['ttft_attainment'] * 100:.1f}%   "
+              f"e2e<= {slo['e2e_ms']:.0f}ms "
+              f"{slo['e2e_attainment'] * 100:.1f}%")
+    print(f"  steady-state recompiles: {out['steady_recompiles']}")
+
+
+def _print_comparison(padded, paged):
+    def p99(rep):
+        h = rep["serving"].get("ttft_seconds")
+        return f"{h['p99'] * 1e3:10.2f}" if h else "       n/a"
+
+    print("\npadded vs paged (same traffic):")
+    print(f"  {'mode':<8} {'tok/s':>10} {'p99 TTFT ms':>12} "
+          f"{'true KV occ':>12}")
+    for rep in (padded, paged):
+        print(f"  {rep['mode']:<8} {str(rep['throughput_tok_s']):>10} "
+              f"{p99(rep):>12} {rep['kv_occupancy_peak']:>12.2f}")
+    if padded["throughput_tok_s"] and paged["throughput_tok_s"]:
+        print(f"  paged speedup: "
+              f"{paged['throughput_tok_s'] / padded['throughput_tok_s']:.2f}x")
 
 
 def main(argv=None) -> int:
@@ -144,46 +226,48 @@ def main(argv=None) -> int:
     ap.add_argument("--queue-capacity", type=int, default=256)
     ap.add_argument("--eos", type=int, default=None)
     ap.add_argument("--int8-weights", action="store_true")
-    ap.add_argument("--int8-cache", action="store_true")
+    ap.add_argument("--int8-cache", action="store_true",
+                    help="int8 KV cache (padded engine only)")
+    ap.add_argument("--paged", action="store_true",
+                    help="block-pool KV + slot-level continuous batching")
+    ap.add_argument("--kv-block", type=int, default=16,
+                    help="KV rows per pool block (paged)")
+    ap.add_argument("--kv-blocks", type=int, default=None,
+                    help="total pool blocks incl. trash (paged; default "
+                         "= worst case for the batch)")
+    ap.add_argument("--length-dist", choices=("uniform", "longtail"),
+                    default="uniform",
+                    help="prompt-length mix; longtail = Pareto-shaped "
+                         "mostly-short traffic")
+    ap.add_argument("--compare", action="store_true",
+                    help="replay the same traffic padded AND paged, "
+                         "print the comparison table")
     ap.add_argument("--slo-ttft-ms", type=float, default=500.0)
     ap.add_argument("--slo-e2e-ms", type=float, default=5000.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--metrics", action="store_true",
-                    help="also dump the Prometheus /metrics payload")
+                    help="also dump the Prometheus /metrics payload "
+                         "(last engine run)")
     args = ap.parse_args(argv)
+    if args.paged and args.int8_cache:
+        # --compare drops int8 KV on its paged LEG by design; an explicit
+        # --paged --int8-cache run must not silently measure fp KV
+        ap.error("--int8-cache is padded-only: the paged pool carries the "
+                 "model dtype (drop --paged or --int8-cache)")
 
-    out, engine = run_bench(args)
+    reports, engine = run_bench(args)
     if args.json:
-        print(json.dumps(out, indent=2))
+        print(json.dumps(reports if len(reports) > 1 else reports[0],
+                         indent=2))
     else:
-        s = out["serving"]
-        tput = out["throughput_tok_s"]
-        print(f"serve_bench: {out['completed']}/{out['requests']} requests "
-              f"at {out['rate_req_s']} req/s -> "
-              f"{'n/a' if tput is None else tput} tok/s "
-              f"over {out['wall_s']}s")
-        for name in ("ttft_seconds", "tpot_seconds", "e2e_seconds",
-                     "queue_seconds"):
-            h = s.get(name)
-            if h:
-                print(f"  {name:<14} p50 {h['p50'] * 1e3:8.2f} ms   "
-                      f"p90 {h['p90'] * 1e3:8.2f} ms   "
-                      f"p99 {h['p99'] * 1e3:8.2f} ms")
-        fill, kv = s["batch_fill_ratio"], s["kv_slot_occupancy"]
-        print(f"  batch fill {'n/a' if fill is None else f'{fill:.2f}'}   "
-              f"kv occupancy {'n/a' if kv is None else f'{kv:.2f}'}   "
-              f"batches {s['batches_total']}")
-        slo = out["slo"]
-        if slo["ttft_attainment"] is not None:
-            print(f"  SLO: TTFT<= {slo['ttft_ms']:.0f}ms "
-                  f"{slo['ttft_attainment'] * 100:.1f}%   "
-                  f"e2e<= {slo['e2e_ms']:.0f}ms "
-                  f"{slo['e2e_attainment'] * 100:.1f}%")
-        print(f"  steady-state recompiles: {out['steady_recompiles']}")
+        for rep in reports:
+            _print_report(rep)
+        if len(reports) == 2:
+            _print_comparison(reports[0], reports[1])
     if args.metrics:
         print(engine.metrics_text(), end="")
-    return 0 if out["steady_recompiles"] == 0 else 1
+    return 0 if all(r["steady_recompiles"] == 0 for r in reports) else 1
 
 
 if __name__ == "__main__":

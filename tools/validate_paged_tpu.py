@@ -1,0 +1,116 @@
+"""Hardware validation for the paged decode attention kernel (run on TPU).
+
+CPU CI exercises the Pallas kernel in interpret mode only (tests/
+test_paged_kv.py); Mosaic compilation and the scalar-prefetched
+block-table fetch path are checked here on the real chip:
+  1. compiled kernel parity vs `paged_attention_reference` across ragged
+     lengths (incl. a row at an exact block boundary and a dummy row)
+  2. serving-shape sweep (gpt3-1.3b geometry: nh=16 hd=128, bf16 pool)
+  3. end-to-end: paged engine greedy == generate_static_ragged per row
+  4. a steady mixed-length engine loop adds zero jit cache misses
+
+Usage: python tools/validate_paged_tpu.py
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+
+def check(name, ok, detail=""):
+    print(f"[{'PASS' if ok else 'FAIL'}] {name} {detail}")
+    if not ok:
+        sys.exit(1)
+
+
+def kernel_parity(dtype, nh, hd, bs, tol):
+    from paddle_tpu.ops.attention import paged_attention_reference
+    from paddle_tpu.ops.pallas.paged_attention import paged_attention_kernel
+    rng = np.random.RandomState(0)
+    B, NB, MB = 4, 32, 6
+    kp = jnp.asarray(rng.randn(NB, bs, nh, hd).astype(np.float32) * 0.3,
+                     dtype)
+    vp = jnp.asarray(rng.randn(NB, bs, nh, hd).astype(np.float32) * 0.3,
+                     dtype)
+    lens = jnp.asarray([1, bs, 2 * bs + 3, 0], jnp.int32)  # boundary + dummy
+    tables = np.zeros((B, MB), np.int32)
+    tables[0, :1] = [1]
+    tables[1, :1] = [2]
+    tables[2, :3] = [3, 4, 5]
+    tables = jnp.asarray(tables)
+    q = jnp.asarray(rng.randn(B, 1, nh, hd).astype(np.float32) * 0.3, dtype)
+    got = np.asarray(paged_attention_kernel(q, kp, vp, tables, lens),
+                     np.float32)
+    want = np.asarray(paged_attention_reference(q, kp, vp, tables, lens),
+                      np.float32)
+    live = slice(0, 3)        # dummy row: kernel zeros vs reference garbage
+    err = np.abs(got[live] - want[live]).max()
+    check(f"kernel parity {dtype} nh={nh} hd={hd} bs={bs}", err < tol,
+          f"max err {err:.2e}")
+
+
+def engine_parity():
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+    from paddle_tpu.jit.api import compile_cache_misses
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=256, num_layers=2,
+                    num_heads=2, max_position_embeddings=512,
+                    intermediate_size=512)
+    m = GPTForCausalLM(cfg)
+    # f32 deliberately: the static reference stores scores in the MODEL
+    # dtype (bf16 under .to("bfloat16")) while the paged kernel always
+    # keeps f32 scores — bit-exact greedy comparison needs both sides in
+    # the same numerics class. bf16 KERNEL numerics are covered by the
+    # kernel_parity sweeps above.
+    m.eval()
+    CAP, NEW = 64, 16
+    lens = [64, 17, 3, 40, 1, 33]
+    rng = np.random.RandomState(1)
+    ids = rng.randint(1, cfg.vocab_size, (len(lens), CAP)).astype(np.int64)
+    for r, ln in enumerate(lens):
+        ids[r, ln:] = 0
+    ref = m.generate_static_ragged(paddle.to_tensor(ids), lens,
+                                   max_new_tokens=NEW).numpy()[:, CAP:]
+    eng = ServingEngine(m, ServingConfig(max_batch=2, prompt_cap=CAP,
+                                         max_new_tokens=NEW,
+                                         decode_chunk=4, paged=True,
+                                         kv_block=16))
+    eng.submit(ids[0, :lens[0]])
+    eng.drain()
+    miss0 = compile_cache_misses()
+    for i in range(len(lens)):
+        eng.submit(ids[i, :lens[i]])
+    done = eng.drain()
+    ok = all(r.status == "done" for r in done)
+    for r in done:
+        row = next(i for i in range(len(lens))
+                   if np.array_equal(ids[i, :lens[i]], r.prompt))
+        ok = ok and np.array_equal(r.tokens, ref[row])
+    check("paged engine greedy == generate_static_ragged", ok)
+    check("steady mixed-length loop: zero jit cache misses",
+          compile_cache_misses() - miss0 == 0,
+          f"recompiles={eng.monitor.recompiles}")
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev)
+    if dev.platform not in ("tpu", "axon"):
+        print("no TPU — run this on the chip (CPU CI covers interpret "
+              "mode)")
+        sys.exit(2)
+    kernel_parity(jnp.float32, nh=4, hd=64, bs=16, tol=2e-5)
+    kernel_parity(jnp.bfloat16, nh=16, hd=128, bs=16, tol=2e-2)
+    kernel_parity(jnp.bfloat16, nh=12, hd=64, bs=32, tol=2e-2)
+    engine_parity()
+    print("all paged serving validations passed")
+
+
+if __name__ == "__main__":
+    main()
