@@ -1,0 +1,28 @@
+"""paddle_tpu.analysis — static analysis over jaxprs and lowered HLO.
+
+Proves the framework's serving/training invariants at BUILD time instead
+of detecting their violation at runtime:
+
+  zero host syncs     host_transfer pass + transfer_guard()
+  donation honored    donation pass (input_output_alias cross-check)
+  bf16 stays bf16     dtype_promotion pass (+ documented f32 allowlist)
+  no baked constants  baked_const pass (closure-captured HBM duplication)
+  zero recompiles     recompile module (abstract signature differ — the
+                      ServingEngine pre-flight reject)
+
+Entry points: GraphLint.check(fn, *args) for one executable,
+lint_capture()+check_calls for the framework's own serving executables,
+jit.TrainStep(lint=...) / inference.ServingConfig(lint=...) opt-ins, and
+the tools/graph_lint.py CLI over the standard model set.
+"""
+from .findings import (Allowlist, ConfigValidationError,  # noqa: F401
+                       DEFAULT_ALLOWLIST, Finding, Findings,
+                       GraphLintError)
+from .passes import (baked_const_pass, donation_pass,  # noqa: F401
+                     dtype_promotion_pass, host_transfer_pass,
+                     parse_io_aliases)
+from .recompile import (abstract_signature, diff_signatures,  # noqa: F401
+                        explain_recompile)
+from .transfer import (HostTransferError, current_layer_path,  # noqa: F401
+                       transfer_guard)
+from .lint import ALL_PASSES, GraphLint, lint_capture  # noqa: F401

@@ -1,0 +1,123 @@
+"""Recompile-hazard lint — statically diff two abstract call signatures
+and explain which argument will force a recompile.
+
+jax.jit keys its executable cache on: the pytree STRUCTURE of the
+arguments, each leaf's (shape, dtype, weak_type), and the values of
+static arguments. Any delta in that key is a retrace + XLA compile —
+the r7 StepMonitor detects this at runtime (the executable already
+built); this module makes the same judgment BEFORE tracing, so a
+serving frontend can refuse a request (or a pre-flight check can fail
+a job) while the explanation still names the offending leaf.
+
+    sig = abstract_signature(ids, lens)         # what the executable keys on
+    findings = diff_signatures(sig, abstract_signature(ids2, lens))
+    explain_recompile(sig_a, sig_b)             # one human string
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+
+from .findings import Finding, Findings
+
+
+def _leaf_key(a) -> Tuple:
+    """(shape, dtype, weak_type) for an array-like leaf; repr for a
+    static (non-array) leaf — exactly the distinctions jit keys on."""
+    if hasattr(a, "shape") and hasattr(a, "dtype"):
+        weak = bool(getattr(a, "weak_type", False)
+                    or getattr(getattr(a, "aval", None), "weak_type",
+                               False))
+        return ("array", tuple(a.shape), str(np.dtype(a.dtype)), weak)
+    return ("static", repr(a))
+
+
+def abstract_signature(*args, **kwargs):
+    """The abstract cache key of a call: (treedef string, leaf keys).
+    Accepts arrays, Tensors (unwrapped via ._data), ShapeDtypeStructs,
+    numpy arrays, and static python values."""
+    from ..core.tensor import Tensor
+
+    def unwrap(x):
+        return x._data if isinstance(x, Tensor) else x
+
+    args = jax.tree.map(unwrap, args,
+                        is_leaf=lambda x: isinstance(x, Tensor))
+    kwargs = jax.tree.map(unwrap, kwargs,
+                          is_leaf=lambda x: isinstance(x, Tensor))
+    leaves, treedef = jax.tree.flatten((args, kwargs))
+    return (str(treedef), tuple(_leaf_key(a) for a in leaves))
+
+
+def diff_signatures(old, new, executable: str = "",
+                    names: Optional[Sequence[str]] = None) -> Findings:
+    """Findings for every component of the cache key that changed —
+    each one names the leaf and the kind of delta (shape / dtype /
+    weak_type / static value / structure) that will force a recompile."""
+    out = Findings()
+    old_tree, old_leaves = old
+    new_tree, new_leaves = new
+    if old_tree != new_tree:
+        out.add(Finding(
+            "recompile_hazard", "structure", "error",
+            "argument pytree structure changed — different executable "
+            "unconditionally", executable=executable,
+            data={"old": old_tree, "new": new_tree}))
+        return out
+    if len(old_leaves) != len(new_leaves):
+        out.add(Finding(
+            "recompile_hazard", "structure", "error",
+            f"leaf count changed ({len(old_leaves)} -> "
+            f"{len(new_leaves)})", executable=executable))
+        return out
+    for i, (o, n) in enumerate(zip(old_leaves, new_leaves)):
+        if o == n:
+            continue
+        name = names[i] if names and i < len(names) else f"leaf[{i}]"
+        if o[0] != n[0]:
+            out.add(Finding(
+                "recompile_hazard", "structure", "error",
+                f"{name} changed kind ({o[0]} -> {n[0]})",
+                where=name, executable=executable))
+            continue
+        if o[0] == "static":
+            out.add(Finding(
+                "recompile_hazard", "static", "error",
+                f"{name}: static value {o[1]} -> {n[1]} — static args "
+                f"are baked into the executable",
+                where=name, executable=executable))
+            continue
+        _, oshape, odt, oweak = o
+        _, nshape, ndt, nweak = n
+        if oshape != nshape:
+            out.add(Finding(
+                "recompile_hazard", "shape", "error",
+                f"{name}: shape {list(oshape)} -> {list(nshape)} forces "
+                f"a retrace + compile",
+                where=name, executable=executable,
+                data={"old": list(oshape), "new": list(nshape)}))
+        if odt != ndt:
+            out.add(Finding(
+                "recompile_hazard", "dtype", "error",
+                f"{name}: dtype {odt} -> {ndt} forces a retrace + "
+                f"compile",
+                where=name, executable=executable,
+                data={"old": odt, "new": ndt}))
+        if oweak != nweak:
+            out.add(Finding(
+                "recompile_hazard", "weak_type", "warn",
+                f"{name}: weak_type {oweak} -> {nweak} — a python "
+                f"scalar vs array input distinction recompiles even at "
+                f"identical shape/dtype",
+                where=name, executable=executable))
+    return out
+
+
+def explain_recompile(old, new, names: Optional[Sequence[str]] = None
+                      ) -> str:
+    """One human-readable line: why `new` cannot reuse `old`'s
+    executable (empty string = it can — same cache key)."""
+    fs = diff_signatures(old, new, names=names)
+    return "; ".join(f.message for f in fs)

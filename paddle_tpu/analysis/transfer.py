@@ -1,0 +1,175 @@
+"""transfer_guard — catch implicit device->host syncs AT TRACE TIME and
+name the layer they came from.
+
+The r8 zero-sync claim ("no per-step host transfers in the compiled
+step") was proven by inspecting HLO in tests; this makes it a reusable
+guard: inside ``with transfer_guard():`` any implicit ``bool()`` /
+``float()`` / ``int()`` / ``.item()`` / ``.numpy()`` / ``np.asarray()``
+on a TRACER-backed Tensor raises (or records) a HostTransferError that
+names the layer path being traced (e.g. ``GPTForCausalLM/gpt/h/0/attn``)
+— instead of jax's anonymous ConcretizationTypeError three frames deep.
+
+Mechanics: core.tensor's host-interop methods call a module hook before
+touching the data; the guard installs the hook AND wraps
+``nn.Layer.__call__`` with a thread-local layer stack so the error can
+say WHERE. Both patches are nest-counted and removed when the outermost
+guard exits; with no guard active the hook is None and the Tensor
+methods pay one ``is None`` check.
+
+Eager tensors are untouched — ``.item()`` on concrete data is a
+legitimate host read; the hazard is exactly a tracer-backed one, which
+would either crash (control flow) or silently force a per-step transfer
+(callbacks).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import List, Optional
+
+import jax
+
+from .findings import Finding, Findings
+
+_tls = threading.local()
+
+
+class HostTransferError(RuntimeError):
+    """An implicit device->host transfer happened on a traced value."""
+
+    def __init__(self, message: str, finding: Optional[Finding] = None):
+        self.finding = finding
+        super().__init__(message)
+
+
+# ----------------------------------------------------------- layer stack
+
+def _stack() -> List:
+    st = getattr(_tls, "layers", None)
+    if st is None:
+        st = _tls.layers = []
+    return st
+
+
+def _child_name(parent, child) -> Optional[str]:
+    """Dotted name of `child` inside `parent` (named_sublayers scan,
+    cached per parent — tracing visits each layer once per signature, so
+    the scan cost is a trace-time constant)."""
+    cache = getattr(_tls, "name_cache", None)
+    if cache is None:
+        cache = _tls.name_cache = {}
+    key = id(parent)
+    m = cache.get(key)
+    if m is None:
+        m = {id(l): n for n, l in parent.named_sublayers()}
+        cache[key] = m
+    return m.get(id(child))
+
+
+def current_layer_path() -> str:
+    """Qualified path of the layer currently executing forward() under
+    the guard ('' when no layer is on the stack — e.g. a bare loss fn)."""
+    st = _stack()
+    if not st:
+        return ""
+    parts = [type(st[0]).__name__]
+    for i in range(1, len(st)):
+        name = _child_name(st[i - 1], st[i])
+        parts.append(name.replace(".", "/") if name
+                     else type(st[i]).__name__)
+    return "/".join(parts)
+
+
+# ------------------------------------------------------------- patching
+
+_lock = threading.Lock()
+_depth = 0
+_orig_call = None
+
+
+def _patched_call(self, *inputs, **kwargs):
+    st = _stack()
+    st.append(self)
+    try:
+        return _orig_call(self, *inputs, **kwargs)
+    finally:
+        st.pop()
+
+
+def _is_tracer(data) -> bool:
+    return isinstance(data, jax.core.Tracer)
+
+
+def _hook(kind: str, data):
+    guard = getattr(_tls, "guard", None)
+    if guard is None or not _is_tracer(data):
+        return
+    guard._on_transfer(kind, data)
+
+
+class TransferGuard:
+    """The active guard object (returned by the context manager).
+
+    Always raises at the offending call — a tracer cannot actually be
+    concretized, so the call could never have succeeded; the guard's
+    value is the NAMED error (layer path + transfer kind) and the
+    Finding it records on ``guard.findings`` before raising (GraphLint
+    catches the error and keeps the finding)."""
+
+    def __init__(self):
+        self.findings = Findings()
+
+    def _on_transfer(self, kind: str, data):
+        path = current_layer_path()
+        aval = getattr(data, "aval", None)
+        desc = (f"{aval.dtype}{list(aval.shape)}"
+                if aval is not None else "traced value")
+        f = Finding(
+            "host_transfer", f"tracer_{kind}", "error",
+            f"implicit host transfer: `{kind}()` on a traced Tensor "
+            f"({desc}) — inside a compiled region this is either a "
+            f"crash or a per-step device->host sync",
+            where=path or "(no layer on stack)")
+        self.findings.add(f)
+        raise HostTransferError(
+            f"transfer_guard: {kind}() called on a tracer-backed Tensor "
+            f"({desc}) in layer path "
+            f"{path or '<outside any Layer.forward>'} — keep host reads "
+            f"out of traced code (use jnp ops / lax.cond), or read after "
+            f"the compiled call returns", finding=f)
+
+
+@contextlib.contextmanager
+def transfer_guard():
+    """Guard a tracing region (or a whole program) against implicit
+    host transfers. Re-entrant; thread-local. Yields the TransferGuard
+    (``guard.findings`` holds what was caught before the raise)."""
+    global _depth, _orig_call
+    from ..core import tensor as _tensor
+    from ..nn.layer import Layer
+
+    guard = TransferGuard()
+    prev = getattr(_tls, "guard", None)
+    with _lock:
+        if _depth == 0:
+            _orig_call = Layer.__call__
+            Layer.__call__ = _patched_call
+            _tensor._concretization_hook = _hook
+        _depth += 1
+    _tls.guard = guard
+    try:
+        yield guard
+    finally:
+        _tls.guard = prev
+        with _lock:
+            _depth -= 1
+            if _depth == 0:
+                Layer.__call__ = _orig_call
+                _tensor._concretization_hook = None
+                # _orig_call stays set: a thread mid-_patched_call when
+                # the unpatch lands must still reach the real __call__
+                # (NULLing it would crash an unrelated forward)
+                # drop the sublayer-name caches with the session: id()s
+                # recycle across models, and a stale id->name map would
+                # mislabel the very layer path this guard exists to name
+                _tls.name_cache = {}

@@ -43,6 +43,17 @@ def _scan_nan_inf(name, outs):
 
 _PRINT_OPTS = {"precision": 8, "threshold": 1000, "edgeitems": 3, "linewidth": 80}
 
+# installed by paddle_tpu.analysis.transfer (transfer_guard): called with
+# (kind, raw data) before every host-interop read so an implicit transfer
+# on a TRACER-backed Tensor raises a named error instead of jax's
+# anonymous concretization failure. None (the default) costs one check.
+_concretization_hook = None
+
+
+def _note_host_read(kind, data):
+    if _concretization_hook is not None:
+        _concretization_hook(kind, data)
+
 
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx", "name",
@@ -100,28 +111,39 @@ class Tensor:
         return is_floating_point(self.dtype)
 
     # ---- host interop -----------------------------------------------------
+    # every entry point notifies the analysis concretization hook first:
+    # under analysis.transfer_guard a tracer-backed read raises a named
+    # HostTransferError (layer path + kind) instead of jax's anonymous
+    # concretization failure
     def numpy(self):
+        _note_host_read("numpy", self._data)
         return np.asarray(self._data)
 
     def item(self, *args):
+        _note_host_read("item", self._data)
         if args:
             return self.numpy().item(*args)
         return self.numpy().item()
 
     def tolist(self):
+        _note_host_read("tolist", self._data)
         return self.numpy().tolist()
 
     def __array__(self, dtype=None):
+        _note_host_read("asarray", self._data)
         a = self.numpy()
         return a.astype(dtype) if dtype is not None else a
 
     def __float__(self):
+        _note_host_read("float", self._data)
         return float(self.item())
 
     def __int__(self):
+        _note_host_read("int", self._data)
         return int(self.item())
 
     def __bool__(self):
+        _note_host_read("bool", self._data)
         if self.size != 1:
             raise ValueError("The truth value of a multi-element Tensor is ambiguous")
         return bool(self.item())

@@ -130,7 +130,7 @@ class BlockPool:
             return None
         blocks = [self._free.pop() for _ in range(n)]
         self._rows[owner] = blocks
-        return np.asarray(blocks, dtype=np.int32)
+        return np.asarray(blocks, dtype=np.int32)  # lint: allow(tracer-asarray)
 
     def free(self, owner: int) -> int:
         """Release every block `owner` holds; returns how many. Freeing an

@@ -332,8 +332,15 @@ class ServingConfig:
     kv_block: int = 16              # KV rows per pool block
     kv_blocks: Optional[int] = None  # total pool blocks INCL. trash block;
     #                            default = worst case for max_batch rows
+    # --- static analysis (ISSUE 6): True / "error" / analysis.GraphLint —
+    # the engine audits each of its {prefill, decode} executables with
+    # the graph lint once, the first step it is built (findings
+    # accumulate on engine.lint_findings; guard mode raises before the
+    # steady-state loop proceeds)
+    lint: object = None
 
     def __post_init__(self):
+        from ..analysis.findings import ConfigValidationError, Finding
         if self.max_batch < 1 or self.prompt_cap < 1 \
                 or self.max_new_tokens < 1:
             raise ValueError("max_batch, prompt_cap and max_new_tokens "
@@ -345,8 +352,22 @@ class ServingConfig:
                              f"got {self.decode_chunk}")
         if self.paged:
             if self.cache_dtype is not None:
-                raise ValueError("paged=True has no int8 KV-cache mode "
-                                 "yet (the pool carries the model dtype)")
+                # structured config-validation finding (same schema as the
+                # graph passes) so tools print WHY paged+int8-KV is
+                # refused, not just that it is — ConfigValidationError is
+                # a ValueError, existing callers keep working
+                raise ConfigValidationError(Finding(
+                    "config", "paged_cache_dtype", "error",
+                    f"cache_dtype={self.cache_dtype!r} with paged=True is "
+                    f"not supported: the paged block pools carry the MODEL "
+                    f"dtype (int8 paged KV is an open ROADMAP item — the "
+                    f"factored-scale int8 trick of the static path has "
+                    f"not been ported to the paged kernel). Use "
+                    f"paged=False with cache_dtype={self.cache_dtype!r}, "
+                    f"or paged=True with cache_dtype=None",
+                    executable="ServingConfig",
+                    data={"cache_dtype": str(self.cache_dtype),
+                          "paged": True}))
             if self.kv_block < 1:
                 raise ValueError(f"kv_block must be >= 1, "
                                  f"got {self.kv_block}")
@@ -413,6 +434,19 @@ class ServingEngine:
         self.monitor = monitor or StepMonitor(unit="tokens/s",
                                               track_memory=False)
         self.clock = clock
+        from ..analysis import GraphLint
+        from ..analysis.recompile import abstract_signature
+        # graph lint (ISSUE 6): audit the engine's {prefill, decode}
+        # executables right after the warmup batch builds them
+        self._lint = GraphLint.coerce(config.lint)
+        self._lint_seen = set()   # executables already audited
+        self.lint_findings = None
+        # the abstract batch signature the engine's executables key on —
+        # the "old" side of the preflight recompile differ
+        self._engine_abstract = abstract_signature(
+            jax.ShapeDtypeStruct((config.max_batch, config.prompt_cap),
+                                 np.int64),
+            jax.ShapeDtypeStruct((config.max_batch,), np.int32))
         self._queue: deque = deque()
         self._next_id = 0
         self._batch_id = 0
@@ -455,8 +489,58 @@ class ServingEngine:
         """Work remains: queued requests, or (paged) live batch slots
         still decoding — the public loop condition drain() and external
         replayers (tools/serve_bench.py) share."""
+        # host-side deque/slot-list reads  # lint: allow(tracer-bool)
         return bool(self._queue) or \
-            (self.config.paged and bool(self._live()))
+            (self.config.paged and bool(self._live()))  # lint: allow(tracer-bool)
+
+    def preflight(self, prompt, max_new_tokens: Optional[int] = None):
+        """Static admission check (analysis.recompile): Findings for
+        everything about this request that would force a new executable
+        or is statically unservable — BEFORE any tracing happens. Empty
+        findings = admissible (dynamic conditions like queue capacity
+        are submit()'s business). `submit` rejects through this, so the
+        refusal reason and the would-be recompile explanation come from
+        the same differ the lint suite uses."""
+        from ..analysis.findings import Finding, Findings
+        from ..analysis.recompile import (abstract_signature,
+                                          diff_signatures)
+        cfg = self.config
+        p = np.asarray(prompt, dtype=np.int64).reshape(-1)  # lint: allow(tracer-asarray)
+        want = cfg.max_new_tokens if max_new_tokens is None \
+            else min(int(max_new_tokens), cfg.max_new_tokens)
+        out = Findings()
+        if want < 1:
+            out.add(Finding(
+                "config", "max_new_tokens", "error",
+                f"token budget {want} < 1 is unservable (the caller "
+                f"asked to pay for nothing)", executable="serving"))
+        plen = int(p.shape[0])
+        if plen < 1 or plen > cfg.prompt_cap:
+            # ShapeDtypeStructs, not real arrays: the rejection path must
+            # not allocate a [max_batch, plen] buffer for an oversized
+            # prompt just to describe its shape
+            req_sig = abstract_signature(
+                jax.ShapeDtypeStruct((cfg.max_batch, plen), np.int64),
+                jax.ShapeDtypeStruct((cfg.max_batch,), np.int32))
+            diffs = diff_signatures(
+                self._engine_abstract, req_sig,
+                executable="serving_batch",
+                names=("input_ids", "prompt_lens"))
+            why = "; ".join(f.message for f in diffs) \
+                or f"prompt length {plen} outside [1, {cfg.prompt_cap}]"
+            out.add(Finding(
+                "recompile_hazard", "prompt_shape", "error",
+                f"prompt length {plen} would force a new prefill "
+                f"executable: {why}", executable="serving_batch",
+                data={"prompt_len": plen, "cap": cfg.prompt_cap}))
+        if cfg.paged and plen >= 1 and want >= 1 \
+                and not self._pool.fits_ever(plen + want - 1):
+            out.add(Finding(
+                "config", "kv_oom", "error",
+                f"request needs {plen + want - 1} KV rows — more than "
+                f"the whole pool holds even fully drained",
+                executable="serving", data={"rows": plen + want - 1}))
+        return out
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None,
@@ -473,7 +557,7 @@ class ServingEngine:
         served before it arrives; negative queue waits would corrupt the
         accounting this engine exists to make honest)."""
         cfg = self.config
-        prompt = np.asarray(prompt, dtype=np.int64).reshape(-1)
+        prompt = np.asarray(prompt, dtype=np.int64).reshape(-1)  # lint: allow(tracer-asarray)
         want = cfg.max_new_tokens if max_new_tokens is None \
             else min(int(max_new_tokens), cfg.max_new_tokens)
         req = Request(id=self._next_id, prompt=prompt,
@@ -484,42 +568,34 @@ class ServingEngine:
         now = self.clock()
         req.trace.t_enqueue = now if enqueue_at is None \
             else min(enqueue_at, now)
-        if want < 1:
-            # a zero/negative budget is unservable, not "serve 1 anyway" —
-            # the caller explicitly asked to pay for nothing
-            req.status, req.reason = "rejected", "max_new_tokens"
-            self.metrics.record_request(req)
-            return req
-        if prompt.shape[0] < 1 or prompt.shape[0] > cfg.prompt_cap:
-            # serving this prompt would need a new prefill executable —
-            # refuse, and log the would-be shape delta where recompile
-            # warnings already go (ISSUE 4 satellite). count=False keeps
-            # the compiles/recompiles COUNTERS a pure signal of real
-            # executable churn (nothing was built — the request was
-            # refused precisely so nothing would be); the delta still
-            # lands in the warning log and recompile_events under the
-            # "serving_reject" kind. Each offending shape WARNS once per
-            # engine — abusive traffic must not spam the recompile
-            # log/event stream. Every refusal still counts in
-            # rejected_total and gets its per-request JSONL record: the
-            # request stream is the audit log, deliberately complete.
-            req.status, req.reason = "rejected", "prompt_shape"
-            plen = int(prompt.shape[0])
-            if plen not in self._rejected_shapes:
-                self._rejected_shapes.add(plen)
-                self.monitor.record_compile(
-                    "serving_reject",
-                    (((cfg.max_batch, plen), "int64"), self._shape_sig[1]),
-                    prev_sig=self._shape_sig, count=False)
-            self.metrics.record_request(req)
-            return req
-        if cfg.paged and not self._pool.fits_ever(
-                prompt.shape[0] + want - 1):
-            # the pool could not hold this request even fully drained —
-            # waiting in the queue would never help. Anything smaller is
-            # ADMITTABLE (it waits for freed blocks at worst): the paged
-            # engine has no bucket-mismatch rejection inside the cap.
-            req.status, req.reason = "rejected", "kv_oom"
+        # static admission: the recompile-hazard differ decides BEFORE any
+        # tracing whether this request fits the engine's one executable
+        # set (preflight's findings carry the exact would-be shape delta).
+        # A "prompt_shape" refusal additionally logs through the r7
+        # recompile channel — count=False keeps the compiles/recompiles
+        # COUNTERS a pure signal of real executable churn (nothing was
+        # built — the request was refused precisely so nothing would be);
+        # each offending shape WARNS once per engine, abusive traffic must
+        # not spam the recompile log/event stream. Every refusal still
+        # counts in rejected_total and gets its per-request JSONL record:
+        # the request stream is the audit log, deliberately complete.
+        # A "kv_oom" refusal means the pool could not hold the request
+        # even fully drained — anything smaller is ADMITTABLE (it waits
+        # for freed blocks at worst; no bucket-mismatch rejection inside
+        # the cap).
+        pf = self.preflight(prompt, want)
+        if pf:
+            finding = pf[0]
+            req.status, req.reason = "rejected", finding.code
+            if finding.code == "prompt_shape":
+                plen = int(prompt.shape[0])
+                if plen not in self._rejected_shapes:
+                    self._rejected_shapes.add(plen)
+                    self.monitor.record_compile(
+                        "serving_reject",
+                        (((cfg.max_batch, plen), "int64"),
+                         self._shape_sig[1]),
+                        prev_sig=self._shape_sig, count=False)
             self.metrics.record_request(req)
             return req
         if len(self._queue) >= cfg.queue_capacity:
@@ -563,7 +639,38 @@ class ServingEngine:
 
         If the batch dies mid-flight (device OOM, interrupt), the admitted
         requests are recorded as status="error" before the exception
-        propagates — an accounting layer must not lose in-flight requests."""
+        propagates — an accounting layer must not lose in-flight requests.
+
+        With `ServingConfig(lint=...)`, every step runs under
+        `analysis.lint_capture` and each executable the engine builds is
+        audited by GraphLint ONCE, the first step it appears — covering
+        the whole {prefill, decode} set even when early traffic finishes
+        at prefill (budget-1 / instant-EOS) and decode only compiles
+        later. Findings accumulate on `self.lint_findings` (stored BEFORE
+        the guard fires, so a caller catching GraphLintError can still
+        read them); a guard-mode lint raises as soon as an audited
+        executable violates — after that batch was served, since the
+        program must exist to be lowered."""
+        if self._lint is None:
+            return self._step_dispatch()
+        from ..analysis import lint_capture
+        from ..analysis.findings import Findings
+        from ..analysis.lint import _kind_name
+        with lint_capture() as calls:
+            out = self._step_dispatch()
+        new = [c for c in calls
+               if (id(c[1]), _kind_name(c[0])) not in self._lint_seen]
+        if new:
+            for kind, fn, _ in new:
+                self._lint_seen.add((id(fn), _kind_name(kind)))
+            if self.lint_findings is None:
+                self.lint_findings = Findings()
+            fs = self._lint.check_calls(new, guard=False)
+            self.lint_findings.extend(fs)
+            self._lint._guard(fs, "serving executables")
+        return out
+
+    def _step_dispatch(self) -> List[Request]:
         if self.config.paged:
             return self._step_paged()
         reqs, expired = self._admit()
@@ -627,7 +734,7 @@ class ServingEngine:
                     seed=cfg.seed + batch_id * len(schedule) + ci,
                     eos_token_id=cfg.eos_token_id, return_state=True,
                     donate_cache=True)
-                part = np.asarray(toks.numpy())     # host sync per chunk
+                part = np.asarray(toks.numpy())     # host sync per chunk  # lint: allow(tracer-asarray)
             parts.append(part)
             t_chunk = self.clock()
             if ci == 0:
@@ -648,7 +755,7 @@ class ServingEngine:
             if produced >= need:
                 break
             if cfg.eos_token_id is not None:
-                done = np.asarray(st["done"])
+                done = np.asarray(st["done"])  # lint: allow(tracer-asarray)
                 if done[:len(reqs)].all():
                     break               # every real row hit EOS: stop early
 
@@ -816,12 +923,12 @@ class ServingEngine:
             table_row = self._pool.table_row(req.id, self._tables.shape[1])
             with jax.profiler.TraceAnnotation("serving/prefill"):
                 self._pools, first = self.model.prefill_paged(
-                    ids, np.asarray([req.prompt_len], np.int32),
+                    ids, np.asarray([req.prompt_len], np.int32),  # lint: allow(tracer-asarray)
                     self._pools, table_row[None],
                     temperature=cfg.temperature, top_k=cfg.top_k,
                     top_p=cfg.top_p, seed=cfg.seed + self._calls,
                     weight_dtype=cfg.weight_dtype)
-                tok = int(np.asarray(first.numpy())[0])
+                tok = int(np.asarray(first.numpy())[0])  # lint: allow(tracer-asarray)
             self._calls += 1
             n_prefills += 1
             t = self.clock()
@@ -833,7 +940,7 @@ class ServingEngine:
             hit_eos = (cfg.eos_token_id is not None
                        and tok == cfg.eos_token_id)
             self._done[slot] = hit_eos
-            req._chunks = [np.asarray([tok], np.int64)]
+            req._chunks = [np.asarray([tok], np.int64)]  # lint: allow(tracer-asarray)
             req._produced = 1
             if req._produced >= req.max_new_tokens or hit_eos:
                 self._finish_paged_row(slot, t)
@@ -866,7 +973,7 @@ class ServingEngine:
                 seed=cfg.seed + self._calls,
                 eos_token_id=cfg.eos_token_id,
                 weight_dtype=cfg.weight_dtype)
-            arr = np.asarray(toks.numpy())          # host sync per chunk
+            arr = np.asarray(toks.numpy())          # host sync per chunk  # lint: allow(tracer-asarray)
         self._calls += 1
         t = self.clock()
         self._pending = arr[:, -1].astype(np.int32)
@@ -935,7 +1042,7 @@ class ServingEngine:
 
 
 def _hit_eos(row: np.ndarray, eos: Optional[int]) -> bool:
-    return eos is not None and bool((row == eos).any())
+    return eos is not None and bool((row == eos).any())  # lint: allow(tracer-bool)
 
 
 def _n_out(row: np.ndarray, eos: Optional[int]) -> int:
@@ -973,7 +1080,7 @@ def synthetic_traffic(n_requests: int, *, prompt_cap: int, vocab_size: int,
             ln = min(prompt_cap, min_len + int(rng.pareto(1.1) * min_len))
         else:
             ln = int(rng.randint(min_len, prompt_cap + 1))
-        out.append({"at": float(at[i]),
+        out.append({"at": float(at[i]),  # lint: allow(tracer-float)
                     "prompt": rng.randint(1, vocab_size,
                                           (ln,)).astype(np.int64)})
     return out

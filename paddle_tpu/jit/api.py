@@ -30,6 +30,24 @@ _trace_state = threading.local()
 # it; profiler.StepMonitor reads the per-step delta)
 _compile_cache_misses = [0]
 
+# analysis.lint_capture sink: while set (a list), serving executables
+# fetched through the models' compiled-runner caches are wrapped so each
+# call records (kind, jitted_fn, abstract args) for GraphLint.check_calls
+_lint_capture_sink = None
+
+
+def _maybe_wrap_lint_capture(fn, kind):
+    """Identity unless a lint_capture() context is active."""
+    sink = _lint_capture_sink
+    if sink is None:
+        return fn
+
+    def wrapper(*args, **kwargs):
+        from ..analysis.lint import _capture_record
+        _capture_record(sink, kind, fn, args, kwargs)
+        return fn(*args, **kwargs)
+    return wrapper
+
 
 def compile_cache_misses() -> int:
     """Total jit compile-cache misses (new trace signatures) this process."""

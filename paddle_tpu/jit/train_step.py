@@ -79,7 +79,7 @@ class TrainStep:
 
     def __init__(self, model, optimizer, loss_fn: Callable, mesh: Optional[Mesh] = None,
                  data_axes=("dp",), donate: bool = True, grad_accum_steps: int = 1,
-                 monitor=None, numerics=None, scaler=None):
+                 monitor=None, numerics=None, scaler=None, lint=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -114,6 +114,15 @@ class TrainStep:
 
         if numerics is not None:
             self.set_numerics(numerics)
+
+        # static analysis (analysis.GraphLint): True/"error"/GraphLint —
+        # the step's pure function is audited ABSTRACTLY (no execution)
+        # before its first compile; findings land on `lint_findings` and
+        # guard mode raises GraphLintError pre-compile
+        from ..analysis import GraphLint as _GraphLint
+        self._lint = _GraphLint.coerce(lint)
+        self._lint_done = False
+        self.lint_findings = None
 
         # optimizer state as pytree (init lazily so shapes match cast params)
         self._opt_state = None
@@ -198,7 +207,7 @@ class TrainStep:
         sh = NamedSharding(self.mesh, fspec)
         if jax.process_count() > 1:
             import numpy as _np
-            host = _np.asarray(arr)
+            host = _np.asarray(arr)  # lint: allow(tracer-asarray)
             return jax.make_array_from_callback(host.shape, sh,
                                                 lambda idx: host[idx])
         return jax.device_put(arr, sh)
@@ -451,7 +460,7 @@ class TrainStep:
                         [opt_state[i][k].reshape(-1) for i in idxs])
                     for k in fkeys}
                 wd_vec = jnp.concatenate(
-                    [jnp.full((param_arrays[i].size,), float(wds[i]),
+                    [jnp.full((param_arrays[i].size,), float(wds[i]),  # lint: allow(tracer-float)
                               jnp.float32) for i in idxs])
                 fp, fs = opt.update(flat_p, flat_g, flat_st, lr, step_i,
                                     wd_vec)
@@ -521,7 +530,7 @@ class TrainStep:
         from ..debugging import StatsTree
         vals = self._last_aux["stats"]
         return StatsTree(self.numerics_paths,
-                         np.asarray(vals) if sync else vals,
+                         np.asarray(vals) if sync else vals,  # lint: allow(tracer-asarray)
                          step=self._step_i)
 
     def _scaler_state_in(self):
@@ -548,10 +557,10 @@ class TrainStep:
         tree = self.numerics_stats()
         loss = None
         if self._last_loss_arr is not None:
-            la = np.asarray(self._last_loss_arr)
-            loss = float(la.reshape(-1)[-1])  # run_steps: last step's loss
+            la = np.asarray(self._last_loss_arr)  # lint: allow(tracer-asarray)
+            loss = float(la.reshape(-1)[-1])  # run_steps: last step's loss  # lint: allow(tracer-float)
         gn = self._last_aux.get("grad_norm") if self._last_aux else None
-        gn = float(np.asarray(gn).reshape(-1)[-1]) if gn is not None else None
+        gn = float(np.asarray(gn).reshape(-1)[-1]) if gn is not None else None  # lint: allow(tracer-float, tracer-asarray)
         events = cfg.detector.observe(self._step_i, tree=tree, loss=loss,
                                       grad_norm=gn)
         monitor = cfg.monitor or self.monitor
@@ -589,6 +598,63 @@ class TrainStep:
         _logger.warning("numerics: dumped failing step %d to %s",
                         self._step_i, path)
         return path
+
+    # ------------------------------------------------------------------
+    def lint(self, *batch, lint=None):
+        """Statically audit the compiled step over this batch's shapes:
+        trace (never execute) the pure step function through the
+        analysis suite — host-transfer, dtype-promotion, baked-const and
+        donation passes, with tracing under the transfer guard so an
+        implicit `.item()` in a layer names its path. `batch` leaves may
+        be Tensors, arrays, or jax.ShapeDtypeStructs. Returns Findings
+        (also stored on `self.lint_findings`); a guard-mode linter
+        raises GraphLintError. Works standalone (`TrainStep(...).lint(x,
+        y)`) — `TrainStep(lint=...)` runs the same audit automatically
+        before the first compile."""
+        from ..analysis import GraphLint
+        linter = GraphLint.coerce(lint) or self._lint or GraphLint()
+        arrays = _tree_unwrap(batch)
+        flat, treedef = jax.tree.flatten(arrays)
+        return self._lint_check(linter, treedef, flat)
+
+    def _lint_check(self, linter, treedef, flat):
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state()
+            self._apply_param_shardings()
+        pure = self._build_pure(treedef)
+
+        def sds(a):
+            return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype) \
+                if hasattr(a, "shape") else a
+
+        p_sds = tuple(sds(p._data) for p in self._params)
+        s_sds = tuple({k: sds(v) for k, v in (st or {}).items()}
+                      for st in self._opt_state)
+        sstate = None
+        if self._scaler is not None:
+            sstate = tuple(jax.ShapeDtypeStruct((), d)
+                           for d in (jnp.float32, jnp.int32, jnp.int32))
+        findings = linter.check(
+            pure, p_sds, s_sds, sstate, jnp.int32(1), jnp.float32(1e-3),
+            jax.random.PRNGKey(0), *[sds(a) for a in flat],
+            # audit the donation config the REAL executable uses — with
+            # donate=False the pass must report the donatable params/state,
+            # not prove an aliasing the step doesn't have
+            donate_argnums=(0, 1) if self.donate else (),
+            name="train_step", guard=False)
+        # stored BEFORE the guard fires: a caller catching GraphLintError
+        # can still read step.lint_findings post-mortem
+        self.lint_findings = findings
+        linter._guard(findings, "train_step")
+        return findings
+
+    def _maybe_lint(self, treedef, flat):
+        """TrainStep(lint=...): one audit before the first compile (the
+        guard-mode raise happens while nothing has executed yet)."""
+        if self._lint is None or self._lint_done:
+            return
+        self._lint_done = True
+        self._lint_check(self._lint, treedef, flat)
 
     # ------------------------------------------------------------------
     def loss_and_grad_norm(self, *batch, key=None):
@@ -747,6 +813,11 @@ class TrainStep:
                    tuple((tuple(a.shape), str(a.dtype)) for a in flat))
         compiled = self._compiled.get((treedef, key_sig))
         if compiled is None:
+            # lint audits the SINGLE-step pure function with per-step
+            # batch slices — the scan wrapper adds only the loop carry
+            self._maybe_lint(treedef, [
+                jax.ShapeDtypeStruct(tuple(a.shape[1:]), a.dtype)
+                for a in flat])
             # scan length is part of the kind: different n_steps is a
             # deliberately different executable (warmup vs timed runs),
             # not shape instability — only same-length re-traces count
@@ -795,6 +866,7 @@ class TrainStep:
         key_sig = tuple((tuple(a.shape), str(a.dtype)) for a in flat)
         compiled = self._compiled.get((treedef, key_sig))
         if compiled is None:
+            self._maybe_lint(treedef, flat)
             self._on_compile("train_step", key_sig)
             compiled = self._build(treedef, [a.ndim for a in flat])
             self._compiled[(treedef, key_sig)] = compiled

@@ -61,7 +61,8 @@ def load_records(paths):
     return list(recs.values())
 
 
-def check(records, *, budget: float, slow_threshold: float) -> dict:
+def check(records, *, budget: float, slow_threshold: float,
+          lint_seconds: float = None, lint_budget: float = 15.0) -> dict:
     unmarked_slow = []       # should carry `slow` but don't
     tier1 = []               # everything tier-1 actually collects
     for r in records:
@@ -72,6 +73,12 @@ def check(records, *, budget: float, slow_threshold: float) -> dict:
         if r["duration"] > slow_threshold:
             unmarked_slow.append(r)
     tier1_total = sum(r["duration"] for r in tier1)
+    # the lint budget line: tools/lint_source.py runs inside the tier-1
+    # wrapper and must stay trivial (default cap 15s) — a lint pass that
+    # grows into real wall time belongs in its own tier, not ahead of
+    # every tier-1 run
+    lint_over = (lint_seconds is not None
+                 and lint_seconds > lint_budget)
     return {
         "n_records": len(records),
         "n_tier1": len(tier1),
@@ -79,10 +86,14 @@ def check(records, *, budget: float, slow_threshold: float) -> dict:
         "budget_s": budget,
         "over_budget": tier1_total > budget,
         "slow_threshold_s": slow_threshold,
+        "lint_seconds": lint_seconds,
+        "lint_budget_s": lint_budget,
+        "lint_over_budget": lint_over,
         "unmarked_slow": sorted(unmarked_slow,
                                 key=lambda r: -r["duration"]),
         "slowest_tier1": sorted(tier1, key=lambda r: -r["duration"])[:10],
-        "ok": tier1_total <= budget and not unmarked_slow,
+        "ok": (tier1_total <= budget and not unmarked_slow
+               and not lint_over),
     }
 
 
@@ -95,6 +106,11 @@ def main(argv=None) -> int:
                          "(default 780 = 90%% of the 870s tier-1 cap)")
     ap.add_argument("--slow-threshold", type=float, default=60.0,
                     help="a single test over this must be marked slow")
+    ap.add_argument("--lint-seconds", type=float, default=None,
+                    help="measured wall time of the tier-1 source-lint "
+                         "pass (tools/run_tier1.sh records it)")
+    ap.add_argument("--lint-budget", type=float, default=15.0,
+                    help="max seconds the lint pass may take on tier-1")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -103,7 +119,9 @@ def main(argv=None) -> int:
         print("check_tiers: no duration records found", file=sys.stderr)
         return 2
     result = check(records, budget=args.budget,
-                   slow_threshold=args.slow_threshold)
+                   slow_threshold=args.slow_threshold,
+                   lint_seconds=args.lint_seconds,
+                   lint_budget=args.lint_budget)
 
     if args.json:
         print(json.dumps(result, indent=2))
@@ -111,6 +129,13 @@ def main(argv=None) -> int:
         print(f"check_tiers: {result['n_tier1']} tier-1 tests, "
               f"{result['tier1_total_s']}s total "
               f"(budget {result['budget_s']}s)")
+        if result["lint_seconds"] is not None:
+            print(f"  lint: {result['lint_seconds']:.2f}s "
+                  f"(budget {result['lint_budget_s']}s)")
+        if result["lint_over_budget"]:
+            print(f"  VIOLATION: lint pass took "
+                  f"{result['lint_seconds']:.2f}s, over the "
+                  f"{result['lint_budget_s']}s lint budget")
         for r in result["unmarked_slow"]:
             print(f"  VIOLATION: {r['nodeid']} took {r['duration']:.1f}s "
                   f"(> {args.slow_threshold}s) without the `slow` marker")

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Audit the framework's standard executables with the static-analysis
+suite (paddle_tpu.analysis) and print a findings table.
+
+Targets (--all = every one):
+
+  gpt-static   the padded serving engine's {prefill_static, decode_static}
+               executables, captured from a real warmup batch (bf16 model:
+               the serving dtype story the dtype-promotion pass audits)
+  gpt-paged    the paged engine's {prefill_paged, decode_paged} pair —
+               donated block pools cross-checked against the lowered
+               modules' input_output_alias tables
+  train-step   TrainStep(gpt) — traced abstractly (never executed):
+               host-transfer / dtype / baked-const / donation over the
+               fused fwd+bwd+optimizer step
+  resnet50     the vision forward executable (+ its TrainStep with
+               --vision-train), channels-last flag as configured
+
+Exit status: 0 = clean (allowlisted findings are clean — each carries its
+documented reason), 1 = active findings at/above --fail-on, 2 = bad usage.
+
+    python tools/graph_lint.py --all
+    python tools/graph_lint.py --target gpt-paged --json
+    python tools/graph_lint.py --all --fail-on error --allow my_allow.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+TARGETS = ("gpt-static", "gpt-paged", "train-step", "resnet50")
+
+
+def _tiny_gpt(dtype="bfloat16"):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=128,
+                    intermediate_size=128, param_dtype=dtype)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model, cfg
+
+
+def audit_gpt_engine(lint, *, paged: bool):
+    """Serve one warmup batch through the real engine with lint enabled;
+    the engine captures + audits its executables itself."""
+    import numpy as np
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+    model, _ = _tiny_gpt()
+    cfg = ServingConfig(max_batch=2, prompt_cap=8, max_new_tokens=6,
+                        decode_chunk=2, eos_token_id=None, paged=paged,
+                        kv_block=4, lint=lint)
+    eng = ServingEngine(model, cfg)
+    rng = np.random.RandomState(0)
+    eng.submit(rng.randint(1, 100, (5,)))
+    eng.submit(rng.randint(1, 100, (8,)))
+    eng.drain()
+    return eng.lint_findings
+
+
+def audit_train_step(lint):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.jit.train_step import TrainStep
+    model, cfg = _tiny_gpt()
+    model.train()
+    o = opt.AdamW(parameters=model.parameters(), learning_rate=1e-4)
+
+    def loss_fn(ids, labels):
+        return model.loss(ids, labels)
+
+    ts = TrainStep(model, o, loss_fn)
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 16)))
+    return ts.lint(ids, ids, lint=lint)
+
+
+def audit_resnet50(lint, train: bool = False):
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.core import autograd
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.api import _swap_params, _trace_guard
+    from paddle_tpu.vision.models.resnet import resnet50
+    paddle.seed(0)
+    model = resnet50()
+    model.eval()
+    params = [p for _, p in model.named_parameters()]
+    buffers = [b for _, b in model.named_buffers()]
+
+    def fwd(pa, ba, x):
+        with _trace_guard(), _swap_params(params + buffers,
+                                          list(pa) + list(ba)), \
+                autograd.no_grad():
+            return model(Tensor(x))._data
+
+    sds = lambda t: jax.ShapeDtypeStruct(tuple(t.shape), t.dtype)  # noqa
+    findings = lint.check(
+        fwd, tuple(sds(p._data) for p in params),
+        tuple(sds(b._data) for b in buffers),
+        jax.ShapeDtypeStruct((2, 3, 224, 224), "float32"),
+        name="resnet50_forward")
+    if train:
+        from paddle_tpu import optimizer as opt, nn
+        from paddle_tpu.jit.train_step import TrainStep
+        model.train()
+        o = opt.Momentum(parameters=model.parameters(), learning_rate=0.1)
+        ce = nn.CrossEntropyLoss()
+
+        def loss_fn(x, y):
+            return ce(model(x), y)
+
+        ts = TrainStep(model, o, loss_fn)
+        x = jax.ShapeDtypeStruct((2, 3, 224, 224), "float32")
+        y = jax.ShapeDtypeStruct((2,), "int64")
+        findings.extend(ts.lint(x, y, lint=lint))
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--all", action="store_true",
+                    help="audit every target")
+    ap.add_argument("--target", choices=TARGETS, action="append",
+                    default=None)
+    ap.add_argument("--fail-on", choices=("info", "warn", "error"),
+                    default="warn",
+                    help="exit 1 when a non-allowlisted finding at/above "
+                         "this severity survives (default warn)")
+    ap.add_argument("--allow", default=None,
+                    help="JSON allowlist file (list of entry dicts) "
+                         "appended to the built-in allowlist")
+    ap.add_argument("--vision-train", action="store_true",
+                    help="also audit TrainStep(resnet50) — slower trace")
+    # thresholds default LOW: the audited models are CPU-sized toys, and
+    # the point is to see every site — deliberate ones arrive allowlisted
+    # with their documented reason, so low thresholds still exit 0
+    ap.add_argument("--upcast-bytes", type=int, default=256)
+    ap.add_argument("--const-bytes", type=int, default=1 << 16)
+    ap.add_argument("--donate-bytes", type=int, default=1 << 16)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    targets = list(TARGETS) if args.all or not args.target else args.target
+
+    from paddle_tpu.analysis import Allowlist, Findings, GraphLint
+    extra = Allowlist.from_json(args.allow).entries if args.allow else None
+    lint = GraphLint(allow=extra, upcast_bytes=args.upcast_bytes,
+                     const_bytes=args.const_bytes,
+                     donate_bytes=args.donate_bytes)
+
+    runners = {
+        "gpt-static": lambda: audit_gpt_engine(lint, paged=False),
+        "gpt-paged": lambda: audit_gpt_engine(lint, paged=True),
+        "train-step": lambda: audit_train_step(lint),
+        "resnet50": lambda: audit_resnet50(lint,
+                                           train=args.vision_train),
+    }
+
+    all_findings = Findings()
+    report = {}
+    for t in targets:
+        t0 = time.perf_counter()
+        findings = runners[t]() or Findings()
+        dt = time.perf_counter() - t0
+        report[t] = {"seconds": round(dt, 1),
+                     "findings": findings.to_dicts()}
+        all_findings.extend(findings)
+        if not args.json:
+            print(findings.grouped().table(f"{t} ({dt:.1f}s):"))
+
+    active = all_findings.active(args.fail_on)
+    if args.json:
+        report["active"] = len(active)
+        print(json.dumps(report, indent=2))
+    else:
+        n_allowed = sum(1 for f in all_findings if f.allowed)
+        print(f"\ngraph_lint: {len(all_findings)} finding(s), "
+              f"{n_allowed} allowlisted, {len(active)} active "
+              f"(fail-on {args.fail_on})")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Repo-level AST lint — ban known host-transfer hazards in hot modules.
+
+The graph passes (tools/graph_lint.py) prove an EXECUTABLE is clean; this
+lint keeps the SOURCE of the hot modules honest between audits: patterns
+that concretize a possible tracer (`.item()`, `float()`/`bool()` on a
+non-literal, `np.asarray(...)`) and direct `jax.device_get` in the
+serving/jit layers are flagged wherever they appear, and every deliberate
+host-sync site carries an inline escape naming the rule:
+
+    tok = int(np.asarray(first.numpy())[0])   # lint: allow(tracer-asarray)
+
+so the set of host-transfer points in the hot path is enumerable by grep.
+Rules:
+
+  tracer-item     `.item()` calls (a device->host sync, and a crash on a
+                  tracer) — annotate the deliberate post-sync reads
+  tracer-float    `float(x)` / `bool(x)` where x is a COMPUTED expression
+  tracer-bool     (attribute/call/subscript chain — where tensor reads
+                  hide; a plain name is almost always a python scalar) —
+                  the implicit-transfer spellings transfer_guard catches
+                  at trace time; the lint catches them at review time
+  tracer-asarray  `np.asarray(...)` — fine on host data, a sync on device
+                  data; annotate which one it is
+  device-get      `jax.device_get(...)` in inference/ and jit/ — the hot
+                  path fetches through documented sync points only
+
+Escape: append ``# lint: allow(<rule>)`` on the statement's first line
+(or the line above). Pure stdlib (ast) — runs in well under the tier-1
+lint budget; findings print in the analysis table format.
+
+    python tools/lint_source.py [--json] [--root .]
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+
+# the hot modules: code that runs (or assembles) traced regions on the
+# serving/training hot path. Everything else may host-sync freely.
+HOT_GLOBS = (
+    "paddle_tpu/models/gpt.py",
+    "paddle_tpu/models/gpt_stacked.py",
+    "paddle_tpu/inference/serving.py",
+    "paddle_tpu/inference/kv_cache.py",
+    "paddle_tpu/jit/api.py",
+    "paddle_tpu/jit/train_step.py",
+    "paddle_tpu/ops/attention.py",
+)
+# device-get additionally covers every file under these packages
+DEVICE_GET_DIRS = ("paddle_tpu/inference", "paddle_tpu/jit")
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
+
+
+def _allows(lines, lineno):
+    """Rules allowed at `lineno` (1-based): same line or the line above."""
+    out = set()
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines):
+            m = _ALLOW_RE.search(lines[ln - 1])
+            if m:
+                out |= {r.strip() for r in m.group(1).split(",")}
+    return out
+
+
+def _is_literalish(node) -> bool:
+    """Constants and simple arithmetic of constants — float(3), bool(0),
+    float("1e-3") are not tracer hazards."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _is_literalish(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_literalish(node.left) and _is_literalish(node.right)
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path, lines, device_get_only=False):
+        self.path = path
+        self.lines = lines
+        self.device_get_only = device_get_only
+        self.findings = []
+
+    def _flag(self, rule, node, msg):
+        if rule in _allows(self.lines, node.lineno):
+            return
+        self.findings.append({
+            "pass": "source_lint", "code": rule, "severity": "error",
+            "message": msg, "where": f"{self.path}:{node.lineno}",
+            "line": self.lines[node.lineno - 1].strip()[:100]})
+
+    def visit_Call(self, node):
+        f = node.func
+        # jax.device_get(...)
+        if isinstance(f, ast.Attribute) and f.attr == "device_get" \
+                and isinstance(f.value, ast.Name) and f.value.id == "jax":
+            self._flag("device-get", node,
+                       "direct jax.device_get in a hot module — fetch "
+                       "through a documented sync point")
+        if not self.device_get_only:
+            # .item()
+            if isinstance(f, ast.Attribute) and f.attr == "item":
+                self._flag("tracer-item", node,
+                           ".item() syncs (and crashes on a tracer) — "
+                           "annotate deliberate post-sync reads")
+            # float(x) / bool(x) on computed expressions (not plain
+            # names/literals — those are almost always python scalars)
+            if isinstance(f, ast.Name) and f.id in ("float", "bool") \
+                    and len(node.args) == 1 \
+                    and isinstance(node.args[0],
+                                   (ast.Call, ast.Attribute,
+                                    ast.Subscript, ast.Compare)):
+                self._flag(f"tracer-{f.id}", node,
+                           f"{f.id}() on a computed expression — "
+                           f"implicit host transfer if the value is "
+                           f"device-resident")
+            # np.asarray(...) / numpy.asarray(...)
+            if isinstance(f, ast.Attribute) and f.attr == "asarray" \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in ("np", "numpy", "_np"):
+                self._flag("tracer-asarray", node,
+                           "np.asarray syncs device data to host — "
+                           "annotate whether the operand is host-side")
+        self.generic_visit(node)
+
+
+def lint_file(path, root, device_get_only=False):
+    with open(os.path.join(root, path)) as fh:
+        src = fh.read()
+    tree = ast.parse(src, filename=path)
+    v = _Visitor(path, src.splitlines(), device_get_only=device_get_only)
+    v.visit(tree)
+    return v.findings
+
+
+def run(root: str):
+    findings = []
+    hot = set(HOT_GLOBS)
+    for rel in sorted(hot):
+        if os.path.exists(os.path.join(root, rel)):
+            findings += lint_file(rel, root)
+    for d in DEVICE_GET_DIRS:
+        full = os.path.join(root, d)
+        for fn in sorted(os.listdir(full)):
+            rel = f"{d}/{fn}"
+            if fn.endswith(".py") and rel not in hot:
+                findings += lint_file(rel, root, device_get_only=True)
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    findings = run(args.root)
+    if args.json:
+        print(json.dumps(findings, indent=2))
+    elif findings:
+        print(f"lint_source: {len(findings)} violation(s)")
+        for f in findings:
+            print(f"  {f['where']}: [{f['code']}] {f['line']}")
+            print(f"      {f['message']}")
+    else:
+        print("lint_source: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

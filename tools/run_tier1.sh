@@ -21,17 +21,29 @@ else
 fi
 rm -f /tmp/_t1.log
 
+# source lint first (ISSUE 6 satellite): pure-AST, fails fast on a
+# banned host-transfer pattern in the hot modules. Timed so check_tiers
+# can enforce the lint budget (the pass must stay trivial on tier-1).
+lint_t0=$(date +%s.%N)
+python tools/lint_source.py
+lrc=$?
+lint_secs=$(echo "$(date +%s.%N) $lint_t0" | awk '{printf "%.2f", $1-$2}')
+echo "lint_source: ${lint_secs}s (exit $lrc)"
+
 timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
     PADDLE_TPU_TIER_DURATIONS="$DUR" \
     python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@" 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+[ "$rc" -eq 0 ] && rc=$lrc
 
 if [ -s "$DUR" ]; then
     python tools/check_tiers.py "$DUR" \
         --budget "${TIER1_BUDGET:-780}" \
-        --slow-threshold "${TIER1_SLOW_THRESHOLD:-60}"
+        --slow-threshold "${TIER1_SLOW_THRESHOLD:-60}" \
+        --lint-seconds "$lint_secs" \
+        --lint-budget "${TIER1_LINT_BUDGET:-15}"
     crc=$?
     [ "$rc" -eq 0 ] && rc=$crc
 else

@@ -63,14 +63,18 @@ def build_model(preset):
 
 def _serving_config(args, paged):
     from paddle_tpu.inference import ServingConfig
+    # --compare drops int8 KV on its paged LEG by design (the comparison
+    # is padded-int8 vs paged-fp); an EXPLICIT --paged --int8-cache run
+    # flows into ServingConfig as asked and gets the structured
+    # config-validation finding explaining why it cannot be served
+    int8_kv = args.int8_cache and not (paged and args.compare)
     return ServingConfig(max_batch=args.batch, prompt_cap=args.prompt_cap,
                          max_new_tokens=args.new,
                          decode_chunk=args.decode_chunk,
                          queue_capacity=args.queue_capacity,
                          eos_token_id=args.eos,
                          weight_dtype="int8" if args.int8_weights else None,
-                         cache_dtype="int8" if (args.int8_cache and
-                                                not paged) else None,
+                         cache_dtype="int8" if int8_kv else None,
                          paged=paged, kv_block=args.kv_block,
                          kv_blocks=args.kv_blocks)
 
@@ -250,13 +254,19 @@ def main(argv=None) -> int:
                     help="also dump the Prometheus /metrics payload "
                          "(last engine run)")
     args = ap.parse_args(argv)
-    if args.paged and args.int8_cache:
-        # --compare drops int8 KV on its paged LEG by design; an explicit
-        # --paged --int8-cache run must not silently measure fp KV
-        ap.error("--int8-cache is padded-only: the paged pool carries the "
-                 "model dtype (drop --paged or --int8-cache)")
 
-    reports, engine = run_bench(args)
+    try:
+        reports, engine = run_bench(args)
+    except Exception as e:
+        # structured config-validation finding (analysis schema): print
+        # WHY the configuration cannot be served, not just that it failed
+        finding = getattr(e, "finding", None)
+        if finding is None:
+            raise
+        from paddle_tpu.analysis import Findings
+        print("serve_bench: invalid serving configuration")
+        print(Findings([finding]).table())
+        return 2
     if args.json:
         print(json.dumps(reports if len(reports) > 1 else reports[0],
                          indent=2))
