@@ -89,6 +89,7 @@ from . import inference  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import debugging  # noqa: F401,E402
 from . import analysis  # noqa: F401,E402
+from . import resilience  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import text  # noqa: F401,E402

@@ -66,6 +66,28 @@ def set_state(key):
     _state.key = key
 
 
+def key_state_dict() -> dict:
+    """Serializable snapshot of the global eager RNG stream — raw key bits
+    + impl name, the resilience.TrainState "rng" slot. Restoring it makes
+    every post-resume draw (dropout masks, sampling) continue the exact
+    stream the interrupted run would have produced (bit-exact resume needs
+    the key, not the seed: the key has advanced past seed() by one split
+    per draw)."""
+    import numpy as np
+    key = _get()
+    return {"data": np.asarray(jax.random.key_data(key)),
+            "impl": str(jax.random.key_impl(key))}
+
+
+def set_key_state_dict(state: dict):
+    import jax.numpy as jnp
+    data = jnp.asarray(state["data"])
+    impl = state.get("impl")
+    _state.key = jax.random.wrap_key_data(data, impl=impl) if impl \
+        else jax.random.wrap_key_data(data)
+    return _state.key
+
+
 class trace_key_scope:
     """Bind randomness to an explicit key while tracing a jitted function.
 

@@ -78,7 +78,8 @@ from .auto_parallel import (  # noqa: E402,F401
     ProcessMesh, shard_tensor, shard_op, reshard,
 )
 from . import checkpoint  # noqa: E402,F401
-from .checkpoint import save_state_dict, load_state_dict  # noqa: E402,F401
+from .checkpoint import (save_state_dict, load_state_dict,  # noqa: E402,F401
+                         dist_save, dist_load)
 from . import ps  # noqa: E402,F401
 from . import rpc  # noqa: E402,F401
 from . import stream  # noqa: E402,F401

@@ -1,4 +1,4 @@
-"""Elastic training manager — node health + membership over the TCPStore.
+"""Elastic training — restart-and-resume supervision + node membership.
 
 Reference: python/paddle/distributed/fleet/elastic/manager.py (SURVEY §5.3):
 etcd heartbeats with TTL (~60s), node join/leave triggers rank-table rebuild
@@ -8,15 +8,121 @@ failure) and ELASTIC (min:max nproc, scale in/out). TPU-native: the
 TCPStore rather than etcd; on a restart the launcher reassigns
 jax.distributed process ids and the coordination service rebuilds the world
 (replacing the reference's rank-table env rewrite).
+
+Resilience rewrite (ISSUE 7): ``run_with_restarts`` is the restart
+supervisor the preemption contract needs — a child that exits with
+``resilience.RESUME_EXIT_CODE`` ("I checkpointed, restart me") is
+restarted WITHOUT charging the crash budget; ordinary crashes restart
+with exponential backoff until ``max_crash_restarts`` is spent. Paired
+with ``resilience.PreemptionHandler`` (emergency checkpoint on SIGTERM)
+and ``CheckpointManager.restore_latest()`` in the training script, a
+preempted TPU job becomes restart → resume → continue, bit-exactly
+(tests/test_resilience.py proves the full loop with injected faults).
 """
 from __future__ import annotations
 
 import enum
+import logging
+import subprocess
 import threading
 import time
-from typing import List, Optional
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Union
 
 from ..store import TCPStore
+
+_logger = logging.getLogger(__name__)
+
+
+@dataclass
+class RestartReport:
+    """What the supervisor saw: every run's exit code, how many were
+    checkpoint-resume restarts vs crash restarts, and the final status."""
+    exit_codes: List[int] = field(default_factory=list)
+    resumes: int = 0
+    crashes: int = 0
+    final_code: int = 0
+
+    @property
+    def runs(self) -> int:
+        return len(self.exit_codes)
+
+
+def run_with_restarts(target: Union[Sequence[str], Callable[[], Optional[int]]],
+                      *, max_crash_restarts: int = 3,
+                      max_resumes: Optional[int] = None,
+                      resume_code: Optional[int] = None,
+                      backoff_s: float = 1.0, max_backoff_s: float = 30.0,
+                      sleep: Callable[[float], None] = time.sleep,
+                      on_restart: Optional[Callable] = None) -> RestartReport:
+    """Run `target` until it finishes, restarting through preemptions.
+
+    `target` is either an argv list (run as a subprocess — the production
+    launcher mode: the script saves via PreemptionHandler and exits with
+    the resume-me code) or a zero-arg callable (in-process mode — returns
+    an exit code or raises resilience.Preempted/SystemExit; the mode the
+    chaos tests drive).
+
+    Exit-code policy:
+      0                 done — return.
+      resume_code       the child checkpointed and asked to be restarted
+                        (default resilience.RESUME_EXIT_CODE): restart
+                        immediately, no backoff, crash budget untouched
+                        (a preemptible fleet may deliver these all day —
+                        `max_resumes` only exists so tests/runaway loops
+                        terminate).
+      anything else     a crash: restart after exponential backoff
+                        (backoff_s * 2^n capped at max_backoff_s) until
+                        `max_crash_restarts` is spent, then give up and
+                        return the last code.
+
+    `on_restart(kind, attempt, code)` observes every restart decision
+    ("resume" | "crash")."""
+    if resume_code is None:
+        from ...resilience import RESUME_EXIT_CODE
+        resume_code = RESUME_EXIT_CODE
+    report = RestartReport()
+    crash_budget = max_crash_restarts
+    while True:
+        code = _run_once(target)
+        report.exit_codes.append(code)
+        if code == 0:
+            report.final_code = 0
+            return report
+        if code == resume_code:
+            report.resumes += 1
+            if max_resumes is not None and report.resumes > max_resumes:
+                report.final_code = code
+                return report
+            if on_restart is not None:
+                on_restart("resume", report.resumes, code)
+            continue
+        report.crashes += 1
+        if crash_budget <= 0:
+            report.final_code = code
+            return report
+        crash_budget -= 1
+        delay = min(backoff_s * (2.0 ** (report.crashes - 1)), max_backoff_s)
+        if on_restart is not None:
+            on_restart("crash", report.crashes, code)
+        sleep(delay)
+
+
+def _run_once(target) -> int:
+    if callable(target):
+        try:
+            code = target()
+        except SystemExit as e:   # incl. resilience.Preempted
+            code = e.code if isinstance(e.code, int) else \
+                (0 if e.code is None else 1)
+        except Exception:
+            # the supervisor charges its crash budget and retries — but
+            # the operator debugging a crash loop needs the WHY
+            _logger.exception("elastic child crashed (counted as exit 1)")
+            return 1
+        return int(code or 0)
+    proc = subprocess.run(list(target))
+    return int(proc.returncode)
 
 
 class ElasticLevel:

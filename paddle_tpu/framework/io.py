@@ -20,7 +20,6 @@ reference's incubate dist_save.
 from __future__ import annotations
 
 import math
-import os
 import pickle
 
 import numpy as np
@@ -129,7 +128,15 @@ def _unpack(obj, return_numpy=False):
 
 
 def save(obj, path, protocol: int = 4):
-    """Serialize in the reference .pdparams/.pdopt wire format."""
+    """Serialize in the reference .pdparams/.pdopt wire format.
+
+    The file write is ATOMIC (tmp-then-rename, resilience.atomic_writer):
+    a kill at any byte — including a pickling error halfway through a
+    multi-GB state dict — leaves either the previous `path` contents or
+    the complete new ones, never a truncated pickle. The reference (and
+    this repo pre-r12) wrote the target path directly, so a crash during
+    a periodic `paddle.save` destroyed the very checkpoint being
+    refreshed."""
     if not (1 < protocol < 5):
         raise ValueError(f"protocol must be 2..4, got {protocol}")
     if isinstance(obj, dict):
@@ -140,10 +147,8 @@ def save(obj, path, protocol: int = 4):
     if hasattr(path, "write"):
         pickle.dump(packed, path, protocol=protocol)
         return
-    d = os.path.dirname(str(path))
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
+    from ..resilience.checkpoint import atomic_writer
+    with atomic_writer(str(path)) as f:
         pickle.dump(packed, f, protocol=protocol)
 
 

@@ -9,7 +9,8 @@ import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
            "EarlyStopping", "LRScheduler", "ReduceLROnPlateau", "VisualDL",
-           "ProfilerCallback", "NumericsCallback", "config_callbacks"]
+           "ProfilerCallback", "NumericsCallback", "PreemptionCallback",
+           "config_callbacks"]
 
 
 class Callback:
@@ -399,6 +400,90 @@ class NumericsCallback(Callback):
                 self.numerics.on_event(e)
         if events and self.raise_on_event:
             raise FloatingPointError(f"numerics anomaly: {events[0]!r}")
+
+
+class _EagerFitState:
+    """Emergency-checkpoint adapter for the eager (non-fused) fit path:
+    host snapshot of the network's parameters, the optimizer's
+    array/scalar state and the global RNG key. Without it a preemption on
+    the eager path would exit with the resume-me code having checkpointed
+    NOTHING — the supervisor would free-restart a job that loses all work
+    every cycle. Resume is Model.load-style: restore the dict and
+    set_state_dict the pieces."""
+
+    def __init__(self, model, step):
+        self._model = model
+        self._step = int(step or 0)
+
+    def state_dict(self):
+        from ..core.tensor import Tensor
+        from ..resilience.state import rng_state_dict
+        out = {"step": self._step,
+               "model": dict(self._model.network.state_dict()),
+               "rng": rng_state_dict()}
+        opt = getattr(self._model, "_optimizer", None)
+        if opt is not None and hasattr(opt, "state_dict"):
+            out["optimizer"] = {
+                k: v for k, v in opt.state_dict().items()
+                if isinstance(v, (Tensor, int, float, dict))}
+        return out
+
+
+class PreemptionCallback(Callback):
+    """Preemption handling for Model.fit (resilience layer, ISSUE 7):
+    polls a resilience.PreemptionHandler at every train-batch end, so a
+    SIGTERM delivered mid-fit finishes the in-flight batch, takes one
+    emergency checkpoint and exits with the resume-me code
+    (Preempted/SystemExit — fleet.elastic.run_with_restarts restarts and
+    the next fit resumes from the checkpoint).
+
+        handler = resilience.PreemptionHandler(manager=mgr, state=ts)
+        with handler:
+            model.fit(..., callbacks=[PreemptionCallback(handler)])
+
+    Without an explicit `state` on the handler, the emergency checkpoint
+    snapshots the Model's fused TrainStep when fit runs the fused path
+    (params/opt/step); on the eager tape path it snapshots the network's
+    parameters + optimizer state + RNG host-side — either way a
+    preempted fit makes durable progress before asking to be restarted
+    (the resume-me exit code is a promise to the restart supervisor that
+    restarting is not a lost cause)."""
+
+    def __init__(self, handler, install=True):
+        super().__init__()
+        self.handler = handler
+        self._install = install
+        self._gstep = 0
+
+    def on_train_begin(self, logs=None):
+        if self._install:
+            self.handler.install()
+        # eager-path step numbering must be MONOTONIC across epochs and
+        # restarts: fit's batch index resets to 0 every epoch, so using
+        # it raw lets an older epoch's step_00000009 shadow a newer
+        # epoch's step_00000002 in restore_latest(). Count completed
+        # batches, starting above whatever the manager already holds.
+        base = None
+        mgr = getattr(self.handler, "manager", None)
+        if mgr is not None:
+            try:
+                base = mgr.latest_step()
+            except Exception:
+                base = None
+        self._gstep = int(base or 0)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._gstep += 1
+        state = self.handler.state
+        if state is None:
+            state = getattr(self.model, "_fused_step", None)
+        if state is None:
+            state = _EagerFitState(self.model, self._gstep)
+        self.handler.poll(state=state)
+
+    def on_train_end(self, logs=None):
+        if self._install:
+            self.handler.uninstall()
 
 
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,

@@ -65,6 +65,7 @@ ops/pallas/paged_attention.py and tools/validate_paged_tpu.py.)
 from __future__ import annotations
 
 import json
+import logging
 import math
 import time
 from collections import deque
@@ -78,6 +79,8 @@ from ..profiler import StepMonitor
 from ..profiler.monitor import _jit_cache_misses
 from ..profiler._metrics import (LogHistogram, counter_lines, gauge_lines,
                                  histogram_lines)
+
+_logger = logging.getLogger("paddle_tpu.inference.serving")
 
 
 # --------------------------------------------------------------- requests
@@ -193,8 +196,9 @@ class ServingMetrics:
                                          per_decade=per_decade)
                       for name, _ in self.HISTS}
         self.counters = {"requests": 0, "completed": 0, "rejected": 0,
-                         "timeout": 0, "errors": 0, "tokens_in": 0,
-                         "tokens_out": 0, "items": 0, "batches": 0}
+                         "overloaded": 0, "timeout": 0, "errors": 0,
+                         "tokens_in": 0, "tokens_out": 0, "items": 0,
+                         "batches": 0}
         self.gauges = {"queue_depth": 0, "inflight": 0,
                        "batch_fill_ratio": None, "kv_occupancy": None,
                        "kv_slots_occupancy": None}
@@ -232,9 +236,18 @@ class ServingMetrics:
                     max(t.t_finish - t.t_enqueue, 0.0))
         elif req.status == "rejected":
             self.counters["rejected"] += 1
+            if req.reason == "overloaded":
+                # the autoscaler signal — kept in lockstep with the
+                # request record by construction, so any future shed
+                # site that sets reason="overloaded" counts too
+                self.counters["overloaded"] += 1
         elif req.status == "error":
             self.counters["errors"] += 1
-        row = {"request": req.record(), "ts": time.time()}
+        return self._emit({"request": req.record(), "ts": time.time()})
+
+    def _emit(self, row: dict) -> dict:
+        """One emission path for per-request and drain-summary rows —
+        JSONL append + exporter hook stay in lockstep."""
         if self.jsonl_path:
             with open(self.jsonl_path, "a") as f:
                 f.write(json.dumps(row) + "\n")
@@ -267,6 +280,18 @@ class ServingMetrics:
                 out[name] = h.summary()
         return out
 
+    def flush(self) -> dict:
+        """Drain-time flush: zero the liveness gauges (an empty engine
+        must not keep advertising its last batch's occupancy) and emit one
+        terminal `{"drain": summary}` row to the JSONL stream/on_record
+        hook — the scrape a collector takes after graceful shutdown."""
+        for k in ("queue_depth", "inflight"):
+            self.gauges[k] = 0
+        for k in ("batch_fill_ratio", "kv_occupancy",
+                  "kv_slots_occupancy"):
+            self.gauges[k] = None
+        return self._emit({"drain": self.summary(), "ts": time.time()})
+
     def metrics_text(self, prefix: str = "paddle_tpu_serving") -> str:
         """Prometheus text exposition — same format/renderer as
         StepMonitor.metrics_text, so one scrape handler concatenates
@@ -275,7 +300,9 @@ class ServingMetrics:
         helps = {"requests": "requests observed (all terminal statuses)",
                  "completed": "requests finished successfully",
                  "rejected": "requests refused at submit "
-                             "(queue full / shape)",
+                             "(queue full / shape / draining)",
+                 "overloaded": "requests shed at the queue high-watermark "
+                               "(subset of rejected)",
                  "timeout": "requests expired in queue past their deadline",
                  "errors": "requests lost to an engine exception "
                            "mid-batch",
@@ -318,6 +345,12 @@ class ServingConfig:
     decode_chunk: Optional[int] = None  # tokens per post-first-token call;
     #                                 default max_new_tokens-1 = one chunk
     queue_capacity: int = 256       # bounded admission queue
+    # load shedding (ISSUE 7 satellite): queue depth at/above this sheds
+    # new requests with a structured "overloaded" rejection BEFORE the
+    # queue hits capacity — the backpressure signal a frontend can act on
+    # (retry elsewhere) while the engine still has headroom; None = shed
+    # only at queue_capacity
+    queue_high_watermark: Optional[int] = None
     deadline_s: Optional[float] = None  # default queue-wait deadline
     eos_token_id: Optional[int] = None
     pad_token_id: int = 0
@@ -350,6 +383,11 @@ class ServingConfig:
         elif self.decode_chunk < 1:
             raise ValueError(f"decode_chunk must be >= 1, "
                              f"got {self.decode_chunk}")
+        if self.queue_high_watermark is not None and \
+                not (1 <= self.queue_high_watermark <= self.queue_capacity):
+            raise ValueError(
+                f"queue_high_watermark must be in [1, queue_capacity="
+                f"{self.queue_capacity}], got {self.queue_high_watermark}")
         if self.paged:
             if self.cache_dtype is not None:
                 # structured config-validation finding (same schema as the
@@ -448,6 +486,7 @@ class ServingEngine:
                                  np.int64),
             jax.ShapeDtypeStruct((config.max_batch,), np.int32))
         self._queue: deque = deque()
+        self._draining = False     # graceful drain: stop admitting
         self._next_id = 0
         self._batch_id = 0
         self._max_depth = 0        # deepest (prefill + k chunks) run so far
@@ -583,6 +622,13 @@ class ServingEngine:
         # even fully drained — anything smaller is ADMITTABLE (it waits
         # for freed blocks at worst; no bucket-mismatch rejection inside
         # the cap).
+        # graceful drain (ISSUE 7): a draining engine finishes what it has
+        # and admits nothing — the structured refusal tells the frontend
+        # to route elsewhere, not to retry here
+        if self._draining:
+            req.status, req.reason = "rejected", "draining"
+            self.metrics.record_request(req)
+            return req
         pf = self.preflight(prompt, want)
         if pf:
             finding = pf[0]
@@ -596,6 +642,15 @@ class ServingEngine:
                         (((cfg.max_batch, plen), "int64"),
                          self._shape_sig[1]),
                         prev_sig=self._shape_sig, count=False)
+            self.metrics.record_request(req)
+            return req
+        # load shedding: at the high-watermark the engine is still alive
+        # but past its SLO-holding depth — shed with a reason the metrics
+        # count separately (overloaded_total is the autoscaler signal;
+        # queue_full means the hard cap, i.e. shedding came too late)
+        if cfg.queue_high_watermark is not None and \
+                len(self._queue) >= cfg.queue_high_watermark:
+            req.status, req.reason = "rejected", "overloaded"
             self.metrics.record_request(req)
             return req
         if len(self._queue) >= cfg.queue_capacity:
@@ -1013,9 +1068,34 @@ class ServingEngine:
         self._clear_slot(slot)
         self.metrics.record_request(req)
 
-    def drain(self, max_batches: Optional[int] = None) -> List[Request]:
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self):
+        """Enter graceful-drain mode: submit() refuses new work with a
+        structured "draining" rejection while queued + in-flight requests
+        keep being served. The shutdown handshake of a preemptible
+        serving fleet: SIGTERM → begin_drain() → drain(seal=True) →
+        exit — in-flight users finish, the load balancer sees refusals
+        and moves on."""
+        self._draining = True
+        return self
+
+    def resume_admission(self):
+        """Leave drain mode (a cancelled shutdown)."""
+        self._draining = False
+        return self
+
+    def drain(self, max_batches: Optional[int] = None,
+              seal: bool = False) -> List[Request]:
         """step() until the queue empties and every live slot finishes
-        (or max_batches)."""
+        (or max_batches). `seal=True` is the graceful-shutdown form: stop
+        admitting first (begin_drain), and flush the metrics gauges +
+        emit the terminal summary row once empty — the engine then
+        refuses traffic until resume_admission()."""
+        if seal:
+            self.begin_drain()
         out: List[Request] = []
         n = 0
         while self.busy:
@@ -1026,6 +1106,19 @@ class ServingEngine:
             if not got and not self.busy:
                 break
             out.extend(got)
+        if seal:
+            if not self.busy:
+                self.metrics.flush()
+            else:
+                # bounded drain ran out of batches with work remaining:
+                # the seal did NOT complete — no terminal flush, gauges
+                # still live. Say so instead of returning as if the
+                # shutdown handshake finished.
+                _logger.warning(
+                    "drain(seal=True) hit max_batches=%s with work "
+                    "remaining (queue+slots still busy): terminal "
+                    "metrics flush skipped, engine left in drain mode — "
+                    "call drain() again to finish", max_batches)
         return out
 
     # -- reporting ------------------------------------------------------

@@ -15,7 +15,7 @@ import numpy as np
 
 from ..core.tensor import Tensor, to_tensor
 from .dataset import IterableDataset
-from .sampler import BatchSampler
+from .sampler import BatchSampler, DistributedBatchSampler
 
 
 def default_collate_fn(batch):
@@ -90,12 +90,56 @@ def _worker_fetch(indices):
     return samples
 
 
+class SeededBatchSampler(BatchSampler):
+    """Deterministically shuffled batches: epoch ``e``'s ordering is
+    ``RandomState(seed + e).permutation`` (the DistributedBatchSampler
+    idiom, minus the rank sharding). The point is RESUMABILITY: a
+    (seed, epoch, batch_idx) cursor fully determines the remaining batch
+    stream, so a restarted job sees exactly the batches the interrupted
+    one would have — the dataloader leg of bit-exact resume
+    (resilience.TrainState)."""
+
+    def __init__(self, dataset=None, batch_size=1, shuffle=False,
+                 drop_last=False, seed=0):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = int(seed)
+        self.epoch = 0
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            indices = np.random.RandomState(
+                self.seed + self.epoch).permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        batch = []
+        for idx in indices:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False, seed=None):
         self.dataset = dataset
         self._custom_collate = collate_fn is not None
         self.collate_fn = collate_fn or default_collate_fn
@@ -106,12 +150,33 @@ class DataLoader:
         self.prefetch_factor = max(2, prefetch_factor)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         self.return_list = return_list
+        if seed is not None and int(seed) < 0:
+            # -1 is the cursor's "no seed" sentinel — a negative seed
+            # would record an unreplayable-looking cursor
+            raise ValueError(f"seed must be >= 0, got {seed}")
+        self.seed = seed
+        self._shuffle = bool(shuffle)
+        # resumable cursor: epoch / batches-handed-out-this-epoch / pending
+        # fast-forward (set by set_state_dict, consumed by the next iter)
+        self._epoch = 0
+        self._batch_idx = 0
+        self._skip = 0
+        self._pending_resume = False
+        # only a sampler the loader built itself gets its epoch driven by
+        # the loader's resume cursor — a user-provided batch_sampler (the
+        # DistributedBatchSampler idiom) manages set_epoch itself and
+        # must not be clobbered from _epoch
+        self._owns_sampler = batch_sampler is None
         if self._iterable_mode:
             self.batch_sampler = None
             self.batch_size = batch_size
             self.drop_last = drop_last
         elif batch_sampler is not None:
             self.batch_sampler = batch_sampler
+        elif seed is not None:
+            self.batch_sampler = SeededBatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last, seed=seed)
         else:
             self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
                                               batch_size=batch_size, drop_last=drop_last)
@@ -120,6 +185,109 @@ class DataLoader:
         if self._iterable_mode:
             raise TypeError("IterableDataset has no len()")
         return len(self.batch_sampler)
+
+    def _cursor_seed(self) -> int:
+        """The deterministic-order source: the loader's own seed=, or a
+        seed-carrying user sampler (SeededBatchSampler /
+        DistributedBatchSampler idiom). -1 = no seed anywhere."""
+        if self.seed is not None:
+            return int(self.seed)
+        s = getattr(self.batch_sampler, "seed", None)
+        return int(s) if s is not None else -1
+
+    def _epoch_ordered(self) -> bool:
+        """True when an UNSEEDED sampler's shuffle order is nonetheless a
+        pure function of the epoch — DistributedBatchSampler permutes
+        with RandomState(epoch) — so the cursor can replay it without a
+        seed (__iter__ drives set_epoch on the resume iteration)."""
+        return isinstance(self.batch_sampler, DistributedBatchSampler)
+
+    def _cursor_geometry(self):
+        """(batch_size, drop_last) actually in force — from the sampler
+        on the map-style path, from the loader in iterable mode."""
+        src = self if self._iterable_mode else self.batch_sampler
+        bs = getattr(src, "batch_size", None)
+        return (int(bs) if bs is not None else -1,
+                bool(getattr(src, "drop_last", False)))
+
+    # -- resumable cursor (resilience.TrainState "loader" slot) ---------
+    def state_dict(self) -> dict:
+        """(epoch, batch_idx, seed) cursor. batch_idx counts batches
+        already handed out this epoch, so a snapshot taken while the
+        trainer processes batch k records k+1 — the next batch a resumed
+        run must see. Deterministic resume additionally needs a
+        deterministic order: construct with ``seed=`` (or a seeded
+        sampler); a plain shuffle=True loader draws from the global
+        numpy RNG and cannot replay its epoch order."""
+        bs, dl = self._cursor_geometry()
+        return {"epoch": self._epoch, "batch_idx": self._batch_idx,
+                "seed": self._cursor_seed(),
+                "shuffle": bool(getattr(self.batch_sampler, "shuffle",
+                                        self._shuffle)),
+                "epoch_ordered": self._epoch_ordered(),
+                "batch_size": bs, "drop_last": dl}
+
+    def set_state_dict(self, state: dict):
+        # validate BEFORE touching the cursor: a rejected restore must
+        # leave the loader exactly as it was (a caller that catches the
+        # error and trains fresh must not inherit an armed fast-forward)
+        saved = int(state.get("seed", -1))
+        here = self._cursor_seed()
+        if saved != -1 and saved != here:
+            # seed=None counts as a mismatch too: a plain shuffle=True
+            # loader draws from the global numpy RNG and cannot replay
+            # the recorded order
+            raise ValueError(
+                f"dataloader cursor was recorded with seed={saved} but "
+                f"this loader has seed={self.seed}: the shuffle orders "
+                f"differ, a resume would silently train on a different "
+                f"batch stream")
+        if saved == -1 and state.get("shuffle") and \
+                not (state.get("epoch_ordered") and self._epoch_ordered()):
+            # recorded from a shuffle=True loader with NO seed: the
+            # original permutation came from the global numpy RNG and is
+            # gone — fast-forwarding into a fresh draw would silently
+            # train on a different batch stream. Exception: an
+            # epoch-ordered sampler (DistributedBatchSampler) permutes
+            # from RandomState(epoch) — deterministic without a seed —
+            # provided the resuming loader uses one too.
+            raise ValueError(
+                "dataloader cursor was recorded from a shuffle=True "
+                "loader without seed=: its epoch order cannot be "
+                "replayed. Construct the training loader with seed= to "
+                "make the stream resumable")
+        rec_shuffle = state.get("shuffle")
+        here_shuffle = bool(getattr(self.batch_sampler, "shuffle",
+                                    self._shuffle))
+        if rec_shuffle is not None and bool(rec_shuffle) != here_shuffle:
+            # matching seeds don't help if one side shuffles and the
+            # other is sequential — the epoch orders still differ
+            raise ValueError(
+                f"dataloader cursor was recorded with "
+                f"shuffle={bool(rec_shuffle)} but this loader has "
+                f"shuffle={here_shuffle}: the epoch orders differ, a "
+                f"resume would silently train on a different batch "
+                f"stream")
+        here_bs, here_dl = self._cursor_geometry()
+        rec_bs = state.get("batch_size")
+        rec_dl = state.get("drop_last")
+        if rec_bs is not None and int(rec_bs) != -1 and here_bs != -1 and \
+                (int(rec_bs) != here_bs or
+                 (rec_dl is not None and bool(rec_dl) != here_dl)):
+            # batch_idx counts BATCHES: fast-forwarding k batches of a
+            # different size lands on a different sample offset, so the
+            # resumed stream silently diverges even with matching seeds
+            raise ValueError(
+                f"dataloader cursor was recorded with batch_size="
+                f"{int(rec_bs)}, drop_last={bool(rec_dl)} but this "
+                f"loader has batch_size={here_bs}, drop_last={here_dl}: "
+                f"the batch boundaries differ, a resume would silently "
+                f"train on a different batch stream")
+        self._epoch = int(state.get("epoch", 0))
+        self._batch_idx = int(state.get("batch_idx", 0))
+        self._skip = self._batch_idx
+        self._pending_resume = True
+        return self
 
     def __del__(self):
         pool = getattr(self, "_pool", None)
@@ -130,23 +298,51 @@ class DataLoader:
             except Exception:
                 pass
 
-    def _batches(self):
+    def _batches(self, skip: int = 0):
         if self._iterable_mode:
             batch = []
+            n_out = 0
             for item in self.dataset:
                 batch.append(item)
                 if len(batch) == self.batch_size:
-                    yield self.collate_fn(batch)
+                    n_out += 1
+                    if n_out > skip:      # fast-forward consumes items,
+                        yield self.collate_fn(batch)  # skips collation
                     batch = []
-            if batch and not self.drop_last:
+            if batch and not self.drop_last and n_out >= skip:
                 yield self.collate_fn(batch)
         else:
-            for indices in self.batch_sampler:
+            for i, indices in enumerate(self.batch_sampler):
+                if i < skip:   # resume fast-forward: sampler indices only,
+                    continue   # the dataset is never touched for them
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
+        """One epoch. A pending resume cursor (set_state_dict) fast-
+        forwards `batch_idx` batches first — the sampler's index stream
+        advances (keeping the epoch order aligned) but skipped batches
+        are neither fetched nor collated on the map-style path."""
+        if self.batch_sampler is not None and \
+                hasattr(self.batch_sampler, "set_epoch") and \
+                (self._owns_sampler or self._pending_resume):
+            # owned samplers: the loader drives the epoch every iter. A
+            # USER sampler manages set_epoch itself — except for the one
+            # iteration that replays a restored cursor, where the skip
+            # must fast-forward through the RECORDED epoch's permutation,
+            # not whatever epoch the fresh sampler happens to hold.
+            self.batch_sampler.set_epoch(self._epoch)
+        self._pending_resume = False
+        skip, self._skip = self._skip, 0
+        self._batch_idx = skip
+        for b in self._iter_impl(skip):
+            self._batch_idx += 1
+            yield b
+        self._epoch += 1
+        self._batch_idx = 0
+
+    def _iter_impl(self, skip: int = 0):
         if self.num_workers == 0:
-            yield from self._batches()
+            yield from self._batches(skip)
             return
         if not self._iterable_mode:
             # true multi-process path (reference: dataloader_iter.py:370
@@ -154,7 +350,7 @@ class DataLoader:
             # processes run __getitem__+collate off the GIL; pool.imap keeps
             # batch order. Falls back to the thread path if the dataset
             # doesn't pickle.
-            gen = self._process_worker_iter()
+            gen = self._process_worker_iter(skip)
             if gen is not None:
                 yield from gen
                 return
@@ -176,7 +372,7 @@ class DataLoader:
 
         def producer():
             try:
-                for b in self._batches():
+                for b in self._batches(skip):
                     if not _put(b):
                         return
             except BaseException as e:  # surface worker errors in the consumer
@@ -197,7 +393,7 @@ class DataLoader:
         if err:
             raise err[0]
 
-    def _process_worker_iter(self):
+    def _process_worker_iter(self, skip: int = 0):
         """Build the process-pool batch iterator, or None if unpicklable."""
         import multiprocessing as mp
         import pickle
@@ -226,7 +422,7 @@ class DataLoader:
 
         def gen():
             try:
-                indices_list = list(self.batch_sampler)
+                indices_list = list(self.batch_sampler)[skip:]
                 for payload in pool.imap(_worker_fetch, indices_list,
                                          chunksize=1):
                     if collate_in_worker:

@@ -79,7 +79,8 @@ class TrainStep:
 
     def __init__(self, model, optimizer, loss_fn: Callable, mesh: Optional[Mesh] = None,
                  data_axes=("dp",), donate: bool = True, grad_accum_steps: int = 1,
-                 monitor=None, numerics=None, scaler=None, lint=None):
+                 monitor=None, numerics=None, scaler=None, lint=None,
+                 preemption=None, chaos=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -90,6 +91,14 @@ class TrainStep:
         # profiler.StepMonitor: per-step wall/MFU/HBM telemetry + the
         # recompilation detector (assignable after construction too)
         self.monitor = monitor
+        # resilience wiring: `preemption` (a resilience.PreemptionHandler)
+        # is polled at every step boundary — the in-flight XLA launch
+        # always completes, then the handler takes its emergency
+        # checkpoint and raises Preempted. `chaos` (a resilience.Injector)
+        # fires the `step.end` fault site so kill-at-step-k tests die at
+        # exactly the boundary a real preemption would.
+        self.preemption = preemption
+        self.chaos = chaos
         self._step_i = 0
         self._compiled = {}
         self._last_sig = {}     # kind -> last compiled shape signature
@@ -600,6 +609,82 @@ class TrainStep:
         return path
 
     # ------------------------------------------------------------------
+    # resilience: step-boundary hooks + the resumable state snapshot
+    def _post_step(self):
+        """Step-boundary resilience hooks, in hazard order: the chaos
+        injector's `step.end` site first (a simulated kill must not get
+        the checkpoint a real SIGKILL wouldn't), then the preemption
+        poll (emergency checkpoint + Preempted)."""
+        if self.chaos is not None:
+            self.chaos.fire("step.end", step=self._step_i)
+        if self.preemption is not None:
+            self.preemption.poll(
+                state=self.preemption.state or self, step=self._step_i)
+
+    def state_dict(self) -> Dict:
+        """Host snapshot of everything the COMPILED step owns: step
+        counter, parameter arrays, the step's own optimizer-state pytree
+        (not optimizer._states — the jitted path never touches those),
+        host-side optimizer scalars (master step + LR-scheduler state) and
+        the GradScaler triple. The device→host gather here is the ONE
+        deliberate sync of the checkpoint path — at save time syncing is
+        the job (allowlisted in the r11 source lint)."""
+        out: Dict = {"step": int(self._step_i)}
+        out["params"] = {
+            n: np.asarray(p._data)  # lint: allow(tracer-asarray)
+            for n, p in zip(self._param_names, self._params)}
+        if self._opt_state is not None:
+            out["opt"] = {
+                n: {k: np.asarray(v)  # lint: allow(tracer-asarray)
+                    for k, v in (st or {}).items()}
+                for n, st in zip(self._param_names, self._opt_state)}
+        extra: Dict = {"master_step": int(self.optimizer._step_count)}
+        from ..optimizer.lr import LRScheduler as _LRS
+        if isinstance(self.optimizer._lr, _LRS):
+            extra["lr_sched"] = {
+                k: v for k, v in self.optimizer._lr.state_dict().items()
+                if isinstance(v, (bool, int, float, str))}
+        out["opt_extra"] = extra
+        if self._scaler is not None:
+            out["scaler"] = self._scaler.state_dict()
+        return out
+
+    def set_state_dict(self, state: Dict):
+        """Adopt a state_dict() snapshot: params/opt state land back on
+        device (re-sharded by pspec under a mesh) with their saved dtypes
+        — the compiled executables keep matching, so a resume costs one
+        re-trace of a fresh TrainStep object and zero steady-state
+        recompiles after."""
+        params = state.get("params", {})
+        missing = [n for n in self._param_names if n not in params]
+        if missing:
+            raise KeyError(f"checkpoint is missing parameters: "
+                           f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
+        for n, p in zip(self._param_names, self._params):
+            p._data = jnp.asarray(params[n])
+            p._node = None
+        opt = state.get("opt")
+        if opt is not None:
+            if self._opt_state is None:
+                self._opt_state = self._init_opt_state()
+            self._opt_state = [
+                {k: jnp.asarray(v) for k, v in opt.get(n, {}).items()}
+                or st
+                for n, st in zip(self._param_names, self._opt_state)]
+        self._apply_param_shardings()
+        self._step_i = int(state.get("step", 0))
+        extra = state.get("opt_extra", {})
+        if "master_step" in extra:
+            self.optimizer._step_count = int(extra["master_step"])
+        if "lr_sched" in extra:
+            from ..optimizer.lr import LRScheduler as _LRS
+            if isinstance(self.optimizer._lr, _LRS):
+                self.optimizer._lr.set_state_dict(dict(extra["lr_sched"]))
+        if self._scaler is not None and "scaler" in state:
+            self._scaler.set_state_dict(dict(state["scaler"]))
+        return self
+
+    # ------------------------------------------------------------------
     def lint(self, *batch, lint=None):
         """Statically audit the compiled step over this batch's shapes:
         trace (never execute) the pure step function through the
@@ -855,6 +940,7 @@ class TrainStep:
         # (still device arrays — no sync)
         last_aux = jax.tree.map(lambda v: v[-1], auxs) if auxs else auxs
         self._after_step(losses, new_sstate, last_aux, steps=n_steps)
+        self._post_step()
         return Tensor(losses)
 
     def __call__(self, *batch):
@@ -891,6 +977,7 @@ class TrainStep:
         self._last_batch_struct = arrays
         self._last_key = key
         self._after_step(loss, new_sstate, aux)
+        self._post_step()
         if isinstance(self.optimizer._lr, object) and hasattr(self.optimizer._lr, "step") \
                 and not isinstance(self.optimizer._lr, (int, float)):
             pass  # user drives scheduler.step() per reference convention

@@ -242,6 +242,23 @@ class StepMonitor:
         except Exception:
             return None
 
+    # -------------------------------------------------- resumable counters
+    def state_dict(self) -> dict:
+        """Counter continuity across a preemption/resume (the
+        resilience.TrainState "monitor" slot): steps keep accumulating and
+        the compile counters keep their pre-kill baseline, so the
+        telemetry stream shows ONE job with a resume in it — a resumed run
+        re-reporting step 0 (or a recompile storm that is really just the
+        restart's warm-up compiles) would defeat the dashboards."""
+        return {"steps": int(self._steps), "compiles": int(self.compiles),
+                "recompiles": int(self.recompiles)}
+
+    def set_state_dict(self, state: dict):
+        self._steps = int(state.get("steps", 0))
+        self.compiles = int(state.get("compiles", 0))
+        self.recompiles = int(state.get("recompiles", 0))
+        return self
+
     # ------------------------------------------------------------- report
     def report(self) -> dict:
         """Aggregate summary. Steady step time is the median over steps

@@ -1,0 +1,279 @@
+"""Deterministic fault injection + retry — the proof harness for every
+recovery claim in paddle_tpu.resilience.
+
+Reliability code rots unless its failure paths run; on preemptible TPU
+fleets the failure paths ARE the steady state (ROADMAP north star: spot
+capacity is only cheap if interruption is a non-event). This module makes
+faults a first-class, SEEDED test input:
+
+  Injector        a seeded fault scheduler. Production code calls
+                  ``injector.fire(site, **ctx)`` at named fault sites
+                  (checkpoint leaf writes, pre-commit, step boundaries);
+                  each registered Fault decides — deterministically, from
+                  the seed and its own counters — whether to trigger.
+                  ``Injector(None)``-style absence costs one ``is None``
+                  check on the hot path (managers hold ``chaos=None`` by
+                  default).
+
+  Faults          KillAfterStep / TruncateDuringSave / RaiseInStep /
+                  TransientIOErrors — the interruption taxonomy of a
+                  preemptible fleet: process death, torn writes, host
+                  exceptions, flaky storage. CorruptLeaf is post-hoc
+                  (``corrupt_leaf``): bitrot happens to data at rest, not
+                  to code in flight.
+
+  SimulatedKill   BaseException (like SystemExit): nothing should catch
+                  it accidentally — ``except Exception`` recovery blocks
+                  must NOT absorb a simulated process death, or the test
+                  would prove recovery that a real SIGKILL will not get.
+
+  retry()         generic exponential-backoff with a wall-clock deadline,
+                  used by checkpoint I/O. Deterministic delays (no
+                  jitter) so tests assert the exact schedule.
+
+Every guarantee the resilience layer states is pinned by an injected
+fault in tests/test_resilience.py — not by inspection.
+"""
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class SimulatedKill(BaseException):
+    """A simulated process death (kill -9 at this exact point). Derives
+    from BaseException so ordinary ``except Exception`` recovery code
+    cannot absorb it — a real SIGKILL is not catchable either."""
+
+    def __init__(self, site: str, detail: str = ""):
+        self.site = site
+        self.detail = detail
+        super().__init__(f"simulated kill at {site}" +
+                         (f" ({detail})" if detail else ""))
+
+
+class TransientIOError(OSError):
+    """An injected transient storage fault (the NFS hiccup / GCS 503
+    class). OSError subclass: real checkpoint I/O retries exactly the
+    errnos this models."""
+
+
+# --------------------------------------------------------------- faults
+
+class Fault:
+    """One scheduled fault. Subclasses implement ``matches`` (am I armed
+    for this site/context?) and ``trigger`` (do the damage)."""
+
+    kind = "fault"
+
+    def matches(self, site: str, ctx: dict) -> bool:
+        raise NotImplementedError
+
+    def trigger(self, injector: "Injector", site: str, ctx: dict):
+        raise NotImplementedError
+
+
+@dataclass
+class KillAfterStep(Fault):
+    """Die (SimulatedKill) at the first ``step.end`` whose step >= k —
+    the mid-training preemption/crash."""
+    step: int
+    kind: str = "kill_after_step"
+    fired: bool = field(default=False, init=False)
+
+    def matches(self, site, ctx):
+        return (not self.fired and site == "step.end"
+                and ctx.get("step", -1) >= self.step)
+
+    def trigger(self, injector, site, ctx):
+        self.fired = True
+        raise SimulatedKill(site, f"step={ctx.get('step')}")
+
+
+@dataclass
+class RaiseInStep(Fault):
+    """Raise an ordinary exception at ``step.end`` — the host-side bug /
+    OOM class that recovery code IS allowed to catch."""
+    step: int
+    exc: type = RuntimeError
+    kind: str = "raise_in_step"
+    fired: bool = field(default=False, init=False)
+
+    def matches(self, site, ctx):
+        return (not self.fired and site == "step.end"
+                and ctx.get("step", -1) >= self.step)
+
+    def trigger(self, injector, site, ctx):
+        self.fired = True
+        raise self.exc(f"injected fault at step {ctx.get('step')}")
+
+
+@dataclass
+class TruncateDuringSave(Fault):
+    """Tear the Nth leaf file written by a checkpoint save (truncate to
+    half its bytes), then optionally die — the kill-mid-write torn-page
+    case the atomic commit protocol must survive. Site: ``ckpt.leaf``
+    (fired after each leaf lands, ctx: path/index/leaf)."""
+    nth_leaf: int = 0
+    kill: bool = True
+    kind: str = "truncate_during_save"
+    fired: bool = field(default=False, init=False)
+
+    def matches(self, site, ctx):
+        return (not self.fired and site == "ckpt.leaf"
+                and ctx.get("index", -1) >= self.nth_leaf)
+
+    def trigger(self, injector, site, ctx):
+        self.fired = True
+        path = ctx["path"]
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+        if self.kill:
+            raise SimulatedKill(site, f"truncated {ctx.get('leaf')}")
+
+
+@dataclass
+class KillAtSite(Fault):
+    """Die the Nth time `site` fires — pointed at ``ckpt.pre_commit`` /
+    ``ckpt.manifest`` this walks a kill through every byte-position class
+    of the commit protocol."""
+    site: str
+    nth: int = 0
+    kind: str = "kill_at_site"
+    _seen: int = field(default=0, init=False)
+    fired: bool = field(default=False, init=False)
+
+    def matches(self, site, ctx):
+        if self.fired or site != self.site:
+            return False
+        self._seen += 1
+        return self._seen - 1 >= self.nth
+
+    def trigger(self, injector, site, ctx):
+        self.fired = True
+        raise SimulatedKill(site)
+
+
+@dataclass
+class TransientIOErrors(Fault):
+    """Fail the first `times` fires of `site` (default the checkpoint
+    write path) with TransientIOError — absorbed by ``retry``; tests
+    assert recovery happened AND the fault really fired."""
+    times: int = 2
+    site: str = "ckpt.io"
+    kind: str = "transient_io"
+    remaining: int = field(default=-1, init=False)
+
+    def __post_init__(self):
+        self.remaining = self.times
+
+    def matches(self, site, ctx):
+        return self.remaining > 0 and site == self.site
+
+    def trigger(self, injector, site, ctx):
+        self.remaining -= 1
+        raise TransientIOError(
+            f"injected transient IO fault at {ctx.get('path', site)} "
+            f"({self.times - self.remaining}/{self.times})")
+
+
+class Injector:
+    """Seeded, deterministic fault scheduler.
+
+    ``Injector(seed, faults=[...])`` arms explicit faults;
+    ``Injector.random_kill(seed, lo, hi)`` derives a kill step from the
+    seed (the chaos_train driver's mode: the seed IS the scenario, so a
+    failing run reproduces from its seed alone). ``fire(site, **ctx)``
+    consults every armed fault; ``log`` records what actually triggered
+    — tests assert the fault fired, not just that nothing broke."""
+
+    def __init__(self, seed: int = 0, faults: Sequence[Fault] = ()):
+        self.seed = int(seed)
+        self.rng = np.random.RandomState(self.seed)
+        self.faults: List[Fault] = list(faults)
+        self.log: List[Tuple[str, str, dict]] = []
+
+    @classmethod
+    def random_kill(cls, seed: int, lo: int, hi: int) -> "Injector":
+        inj = cls(seed)
+        step = int(inj.rng.randint(lo, hi + 1))
+        inj.faults.append(KillAfterStep(step))
+        return inj
+
+    @property
+    def kill_step(self) -> Optional[int]:
+        for f in self.faults:
+            if isinstance(f, KillAfterStep):
+                return f.step
+        return None
+
+    def add(self, fault: Fault) -> "Injector":
+        self.faults.append(fault)
+        return self
+
+    def fire(self, site: str, **ctx):
+        for f in self.faults:
+            if f.matches(site, ctx):
+                self.log.append((site, f.kind, dict(ctx)))
+                f.trigger(self, site, ctx)
+
+    def fired(self, kind: Optional[str] = None) -> int:
+        return sum(1 for _, k, _ in self.log if kind is None or k == kind)
+
+
+def corrupt_leaf(ckpt_dir: str, leaf: str, *, seed: int = 0) -> str:
+    """Flip bytes of ONE committed leaf's region of the data file
+    (bitrot-at-rest). `leaf` is the manifest key ("params/fc1.weight");
+    returns the corrupted file path. Restore must then raise
+    CheckpointCorruptError naming exactly `leaf` — neighboring leaves in
+    the same blob stay intact."""
+    import json
+    with open(os.path.join(ckpt_dir, "MANIFEST.json")) as f:
+        manifest = json.load(f)
+    entry = manifest["leaves"][leaf]
+    path = os.path.join(ckpt_dir, manifest.get("data_file", "leaves.bin"))
+    rng = np.random.RandomState(seed)
+    off, nbytes = entry["offset"], entry["nbytes"]
+    with open(path, "r+b") as f:
+        f.seek(off)
+        data = bytearray(f.read(nbytes))
+        n = max(1, len(data) // 64)
+        for i in rng.randint(0, len(data), size=n):
+            data[i] ^= 0xFF
+        f.seek(off)
+        f.write(bytes(data))
+    return path
+
+
+# ---------------------------------------------------------------- retry
+
+def retry(fn: Callable, *args, deadline: float = 5.0,
+          base_delay: float = 0.01, max_delay: float = 0.5,
+          factor: float = 2.0, retry_on=(OSError,),
+          sleep: Callable[[float], None] = time.sleep,
+          clock: Callable[[], float] = time.monotonic,
+          on_retry: Optional[Callable] = None, **kwargs):
+    """Call ``fn(*args, **kwargs)``; on a `retry_on` exception, back off
+    exponentially (base_delay * factor^n, capped at max_delay) and try
+    again until `deadline` seconds have elapsed, then re-raise the last
+    exception. Delays are DETERMINISTIC (no jitter): a seeded chaos run
+    replays the same schedule, and tests assert it exactly. SimulatedKill
+    (BaseException) is never retried — a dead process does not back off."""
+    t0 = clock()
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            delay = min(base_delay * (factor ** attempt), max_delay)
+            attempt += 1
+            if clock() - t0 + delay > deadline:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, delay, e)
+            sleep(delay)

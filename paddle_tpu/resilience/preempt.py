@@ -1,0 +1,203 @@
+"""Preemption handling — turn SIGTERM into a checkpoint + resume-me exit.
+
+Preemptible TPU capacity delivers SIGTERM with a grace window (spot VMs:
+~30s); the difference between "lost everything since the last manual
+save" and "interruption is a non-event" is what happens inside that
+window. The contract here:
+
+  1. the signal handler only sets a flag — the in-flight jitted step
+     ALWAYS completes (python runs handlers between bytecodes; the XLA
+     launch is never torn),
+  2. at the next step boundary ``poll()`` takes one synchronous
+     emergency checkpoint (waiting out any in-flight async save first),
+  3. the process exits with ``RESUME_EXIT_CODE`` by raising
+     ``Preempted`` — a SystemExit subclass, so an unhandled one exits
+     cleanly with the resume-me code that ``fleet.elastic``'s restart
+     supervisor recognizes.
+
+Wiring: ``TrainStep(preemption=handler)`` polls after every step /
+run_steps launch; ``hapi.callbacks.PreemptionCallback`` polls per fit
+batch. Tests deliver real signals (os.kill) and fake ones
+(``handler.request()``) — same code path either way.
+"""
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Callable, Optional, Sequence
+
+_logger = logging.getLogger("paddle_tpu.resilience.preempt")
+
+# the resume-me exit status: "I checkpointed, restart me". Distinct from
+# 0 (done) and from crash codes — fleet.elastic.run_with_restarts
+# restarts on exactly this without charging the crash budget.
+RESUME_EXIT_CODE = 42
+
+
+class Preempted(SystemExit):
+    """Raised at a step boundary after the emergency checkpoint landed.
+    SystemExit subclass: unhandled, the process exits with `.code`
+    (RESUME_EXIT_CODE) — no traceback spew, the supervisor restarts."""
+
+    def __init__(self, code: int = RESUME_EXIT_CODE, *,
+                 step: Optional[int] = None,
+                 checkpoint_path: Optional[str] = None,
+                 signum: Optional[int] = None):
+        self.step = step
+        self.checkpoint_path = checkpoint_path
+        self.signum = signum
+        super().__init__(code)
+
+
+class PreemptionHandler:
+    """Flag-setting signal handler + emergency-checkpoint policy.
+
+        handler = PreemptionHandler(manager=ckpt_mgr, state=train_state)
+        with handler:                       # installs SIGTERM/SIGINT
+            step = TrainStep(..., preemption=handler)
+            for batch in loader:            # each step polls; on a
+                step(*batch)                # signal: save + Preempted
+
+    `manager`: a CheckpointManager for the emergency save (optional —
+    without one, poll() raises Preempted immediately and the caller owns
+    persistence). `state`: anything with ``state_dict()`` (a
+    resilience.TrainState, a TrainStep, ...). A second SIGINT while
+    already draining raises KeyboardInterrupt — ctrl-C twice still
+    means NOW."""
+
+    def __init__(self, *, manager=None, state=None,
+                 signals: Sequence[int] = (signal.SIGTERM, signal.SIGINT),
+                 exit_code: int = RESUME_EXIT_CODE,
+                 on_preempt: Optional[Callable] = None):
+        self.manager = manager
+        self.state = state
+        self.signals = tuple(signals)
+        self.exit_code = exit_code
+        self.on_preempt = on_preempt
+        self._requested = threading.Event()
+        self._signum: Optional[int] = None
+        self._count = 0
+        self._sigint_count = 0
+        self._prev = {}
+        self._installed = False
+
+    # ------------------------------------------------------------ signals
+    def _handle(self, signum, frame):
+        self._count += 1
+        if signum == signal.SIGINT:
+            # count ctrl-C on its own: a SIGTERM (spot preemption)
+            # followed by ONE SIGINT must still drain gracefully — only
+            # the SECOND ctrl-C means NOW
+            self._sigint_count += 1
+            if self._sigint_count > 1:
+                raise KeyboardInterrupt
+        self._signum = signum
+        self._requested.set()
+        _logger.warning(
+            "signal %d received: finishing the in-flight step, then "
+            "emergency checkpoint + exit(%d)", signum, self.exit_code)
+
+    def install(self) -> "PreemptionHandler":
+        """Install handlers (main thread only — python's signal rule).
+        Idempotent; previous handlers are restored by uninstall()."""
+        if self._installed:
+            return self
+        for s in self.signals:
+            self._prev[s] = signal.signal(s, self._handle)
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        if not self._installed:
+            return
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, TypeError):   # non-main thread/teardown
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # ------------------------------------------------------------- state
+    @property
+    def requested(self) -> bool:
+        return self._requested.is_set()
+
+    def request(self, signum: Optional[int] = None):
+        """Programmatic preemption (tests / external orchestrators): same
+        flag the signal handler sets, same everything after."""
+        self._signum = signum
+        self._requested.set()
+
+    def clear(self):
+        self._requested.clear()
+        self._signum = None
+        self._count = 0
+        self._sigint_count = 0
+
+    # -------------------------------------------------------------- poll
+    def poll(self, state=None, step: Optional[int] = None):
+        """Call at a step boundary. No signal -> no-op (one Event read).
+        Signal pending -> take the emergency checkpoint (synchronous;
+        waits out any in-flight async save first) and raise Preempted
+        carrying the checkpoint path + step."""
+        if not self._requested.is_set():
+            return
+        # the request is consumed (clear()) only at the raise points
+        # below: a handler shared across in-process run_with_restarts
+        # cycles must not re-fire at the restarted run's first boundary
+        # — but an emergency save that FAILS (retry deadline on a
+        # transient fault) must leave the flag armed so the next
+        # boundary retries instead of training on past the grace window
+        signum = self._signum
+        state = state if state is not None else self.state
+        path = None
+        if self.manager is not None:
+            if state is None:
+                # a manager was configured — the resume-me exit code is a
+                # PROMISE that durable progress exists. With nothing to
+                # save, keeping that promise would let the supervisor
+                # free-restart (no crash budget charged) a job that loses
+                # all work every cycle. Exit as a crash instead.
+                _logger.error(
+                    "preemption: manager configured but no state to "
+                    "checkpoint — exiting as a crash (code 1), not "
+                    "resume-me, so the restart supervisor charges its "
+                    "budget instead of looping a job that makes no "
+                    "durable progress")
+                self.clear()
+                raise Preempted(1, step=step, signum=signum)
+            sd = state.state_dict()
+            if step is None:
+                step = sd.get("step", 0) if isinstance(sd, dict) else 0
+            self.manager.wait()
+            path = self.manager.save(int(step or 0), sd,
+                                     meta={"reason": "preemption",
+                                           "signum": signum})
+            _logger.warning("emergency checkpoint at step %s: %s",
+                            step, path)
+        if self.on_preempt is not None:
+            self.on_preempt(self)
+        self.clear()
+        raise Preempted(self.exit_code, step=step, checkpoint_path=path,
+                        signum=signum)
+
+
+def exit_for_resume(step: Optional[int] = None,
+                    checkpoint_path: Optional[str] = None):
+    """Explicit resume-me exit for driver scripts that already saved."""
+    raise Preempted(RESUME_EXIT_CODE, step=step,
+                    checkpoint_path=checkpoint_path)
+
+
+def is_resume_exit(code: Optional[int]) -> bool:
+    return code == RESUME_EXIT_CODE
+

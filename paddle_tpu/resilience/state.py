@@ -1,0 +1,91 @@
+"""TrainState — ONE snapshot of everything a bit-exact resume needs.
+
+"Resumable training" usually means params + optimizer; bit-exact resume
+means the full closure of the training process: miss any one of these
+and the post-resume trajectory silently diverges from the uninterrupted
+run —
+
+  step counter      jnp.int32(step) is a step input (bias correction,
+                    schedules)
+  params/opt state  TrainStep's device pytrees (NOT optimizer._states —
+                    the compiled step owns its own)
+  GradScaler        (scale, good, bad): a resume that resets loss scale
+                    replays different update-skip decisions
+  RNG key           core/random's global key — dropout masks and
+                    sampling continue the same stream
+  dataloader cursor (epoch, batch_idx, seed): the model must see the
+                    SAME remaining batches in the same order
+  StepMonitor       compiles/recompiles/steps counters — telemetry
+                    continuity (a resume is not a recompile storm)
+
+The kill-at-step-k parity oracle (tests/test_resilience.py, the r9/r10
+decode-parity style) pins the definition: resume at k must reproduce
+the uninterrupted loss trajectory BIT-identically.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+
+def rng_state_dict() -> Dict[str, Any]:
+    """Serializable snapshot of the global eager RNG stream."""
+    from ..core import random as _random
+    return _random.key_state_dict()
+
+def rng_load_state_dict(state: Dict[str, Any]):
+    from ..core import random as _random
+    _random.set_key_state_dict(state)
+
+
+class TrainState:
+    """Compose the resumable pieces; state_dict() nests their snapshots
+    under stable keys (the CheckpointManager's nested-dict format).
+
+        ts = TrainState(train_step=step, loader=loader, monitor=mon)
+        manager.save(step_i, ts.state_dict())
+        ...
+        n, sd = manager.restore_latest()
+        ts.load_state_dict(sd)       # params, opt, scaler, RNG, cursor
+
+    Every component is optional; `extra` is a (state_dict_fn,
+    load_state_dict_fn) pair for anything else that must ride along."""
+
+    def __init__(self, train_step=None, *, loader=None, monitor=None,
+                 include_rng: bool = True,
+                 extra: Optional[tuple] = None):
+        self.train_step = train_step
+        self.loader = loader
+        self.monitor = monitor
+        self.include_rng = include_rng
+        self.extra = extra
+
+    @property
+    def step(self) -> int:
+        return int(getattr(self.train_step, "_step_i", 0) or 0)
+
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"step": self.step}
+        if self.train_step is not None:
+            out["train"] = self.train_step.state_dict()
+        if self.loader is not None:
+            out["loader"] = self.loader.state_dict()
+        if self.monitor is not None:
+            out["monitor"] = self.monitor.state_dict()
+        if self.include_rng:
+            out["rng"] = rng_state_dict()
+        if self.extra is not None:
+            out["extra"] = self.extra[0]()
+        return out
+
+    def load_state_dict(self, state: Dict[str, Any]):
+        if self.train_step is not None and "train" in state:
+            self.train_step.set_state_dict(state["train"])
+        if self.loader is not None and "loader" in state:
+            self.loader.set_state_dict(state["loader"])
+        if self.monitor is not None and "monitor" in state:
+            self.monitor.set_state_dict(state["monitor"])
+        if self.include_rng and "rng" in state:
+            rng_load_state_dict(state["rng"])
+        if self.extra is not None and "extra" in state:
+            self.extra[1](state["extra"])
+        return self

@@ -1175,6 +1175,12 @@ WAIVERS = {
 }
 
 F_WAIVERS = {
+    "fused_conv_bn_act": "fused composite (r6 channels-last path); "
+                         "conv/BN parity incl. fold covered in "
+                         "test_channels_last",
+    "clear_channels_last_weight_cache": "cache-management helper, not an "
+                                        "op; exercised implicitly by "
+                                        "test_channels_last",
     "dropout": "stochastic; p=0/eval identity in test_nn_extras",
     "dropout2d": "stochastic", "dropout3d": "stochastic",
     "alpha_dropout": "stochastic", "rrelu": "stochastic; test_nn_extras",

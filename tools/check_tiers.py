@@ -62,7 +62,9 @@ def load_records(paths):
 
 
 def check(records, *, budget: float, slow_threshold: float,
-          lint_seconds: float = None, lint_budget: float = 15.0) -> dict:
+          lint_seconds: float = None, lint_budget: float = 15.0,
+          chaos_seconds: float = None,
+          chaos_budget: float = 120.0) -> dict:
     unmarked_slow = []       # should carry `slow` but don't
     tier1 = []               # everything tier-1 actually collects
     for r in records:
@@ -79,6 +81,12 @@ def check(records, *, budget: float, slow_threshold: float,
     # every tier-1 run
     lint_over = (lint_seconds is not None
                  and lint_seconds > lint_budget)
+    # the chaos budget line: tools/chaos_train.py --quick runs inside the
+    # tier-1 wrapper (ISSUE 7) — one seeded kill/resume scenario + the
+    # async-save overhead report must stay well under the tier cap; the
+    # multi-seed sweep belongs to the slow tier
+    chaos_over = (chaos_seconds is not None
+                  and chaos_seconds > chaos_budget)
     return {
         "n_records": len(records),
         "n_tier1": len(tier1),
@@ -89,11 +97,14 @@ def check(records, *, budget: float, slow_threshold: float,
         "lint_seconds": lint_seconds,
         "lint_budget_s": lint_budget,
         "lint_over_budget": lint_over,
+        "chaos_seconds": chaos_seconds,
+        "chaos_budget_s": chaos_budget,
+        "chaos_over_budget": chaos_over,
         "unmarked_slow": sorted(unmarked_slow,
                                 key=lambda r: -r["duration"]),
         "slowest_tier1": sorted(tier1, key=lambda r: -r["duration"])[:10],
         "ok": (tier1_total <= budget and not unmarked_slow
-               and not lint_over),
+               and not lint_over and not chaos_over),
     }
 
 
@@ -111,6 +122,11 @@ def main(argv=None) -> int:
                          "pass (tools/run_tier1.sh records it)")
     ap.add_argument("--lint-budget", type=float, default=15.0,
                     help="max seconds the lint pass may take on tier-1")
+    ap.add_argument("--chaos-seconds", type=float, default=None,
+                    help="measured wall time of the tier-1 chaos_train "
+                         "gate (tools/run_tier1.sh records it)")
+    ap.add_argument("--chaos-budget", type=float, default=120.0,
+                    help="max seconds the chaos gate may take on tier-1")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -121,7 +137,9 @@ def main(argv=None) -> int:
     result = check(records, budget=args.budget,
                    slow_threshold=args.slow_threshold,
                    lint_seconds=args.lint_seconds,
-                   lint_budget=args.lint_budget)
+                   lint_budget=args.lint_budget,
+                   chaos_seconds=args.chaos_seconds,
+                   chaos_budget=args.chaos_budget)
 
     if args.json:
         print(json.dumps(result, indent=2))
@@ -132,6 +150,13 @@ def main(argv=None) -> int:
         if result["lint_seconds"] is not None:
             print(f"  lint: {result['lint_seconds']:.2f}s "
                   f"(budget {result['lint_budget_s']}s)")
+        if result.get("chaos_seconds") is not None:
+            print(f"  chaos: {result['chaos_seconds']:.2f}s "
+                  f"(budget {result['chaos_budget_s']}s)")
+        if result["chaos_over_budget"]:
+            print(f"  VIOLATION: chaos gate took "
+                  f"{result['chaos_seconds']:.2f}s, over the "
+                  f"{result['chaos_budget_s']}s chaos budget")
         if result["lint_over_budget"]:
             print(f"  VIOLATION: lint pass took "
                   f"{result['lint_seconds']:.2f}s, over the "

@@ -50,6 +50,12 @@ HOT_GLOBS = (
     "paddle_tpu/jit/api.py",
     "paddle_tpu/jit/train_step.py",
     "paddle_tpu/ops/attention.py",
+    # the checkpoint path runs INSIDE the training hot loop (async save
+    # snapshots between steps): its deliberate device->host gather sites
+    # (_to_host / TrainStep.state_dict — at save time syncing is the job)
+    # are annotated, everything else must stay transfer-free
+    "paddle_tpu/resilience/checkpoint.py",
+    "paddle_tpu/resilience/state.py",
 )
 # device-get additionally covers every file under these packages
 DEVICE_GET_DIRS = ("paddle_tpu/inference", "paddle_tpu/jit")
