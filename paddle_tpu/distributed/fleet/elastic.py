@@ -54,7 +54,8 @@ def run_with_restarts(target: Union[Sequence[str], Callable[[], Optional[int]]],
                       resume_code: Optional[int] = None,
                       backoff_s: float = 1.0, max_backoff_s: float = 30.0,
                       sleep: Callable[[float], None] = time.sleep,
-                      on_restart: Optional[Callable] = None) -> RestartReport:
+                      on_restart: Optional[Callable] = None,
+                      timeline=None) -> RestartReport:
     """Run `target` until it finishes, restarting through preemptions.
 
     `target` is either an argv list (run as a subprocess — the production
@@ -77,7 +78,13 @@ def run_with_restarts(target: Union[Sequence[str], Callable[[], Optional[int]]],
                         return the last code.
 
     `on_restart(kind, attempt, code)` observes every restart decision
-    ("resume" | "crash")."""
+    ("resume" | "crash").
+
+    `timeline`: a profiler.timeline.SpanRecorder — the supervisor sees
+    the whole outage (child exit → next start, backoff included), so it
+    records each gap as an EXPLICIT `restart_downtime` span. The goodput
+    stitcher prefers these over gap-derived downtime, so supervisor-
+    recorded and derived downtime never double count."""
     if resume_code is None:
         from ...resilience import RESUME_EXIT_CODE
         resume_code = RESUME_EXIT_CODE
@@ -85,6 +92,7 @@ def run_with_restarts(target: Union[Sequence[str], Callable[[], Optional[int]]],
     crash_budget = max_crash_restarts
     while True:
         code = _run_once(target)
+        t_exit = timeline.now() if timeline is not None else None
         report.exit_codes.append(code)
         if code == 0:
             report.final_code = 0
@@ -96,6 +104,9 @@ def run_with_restarts(target: Union[Sequence[str], Callable[[], Optional[int]]],
                 return report
             if on_restart is not None:
                 on_restart("resume", report.resumes, code)
+            if timeline is not None:
+                timeline.record("restart_downtime", t_exit, timeline.now(),
+                                kind="resume", code=code)
             continue
         report.crashes += 1
         if crash_budget <= 0:
@@ -106,6 +117,9 @@ def run_with_restarts(target: Union[Sequence[str], Callable[[], Optional[int]]],
         if on_restart is not None:
             on_restart("crash", report.crashes, code)
         sleep(delay)
+        if timeline is not None:
+            timeline.record("restart_downtime", t_exit, timeline.now(),
+                            kind="crash", code=code)
 
 
 def _run_once(target) -> int:

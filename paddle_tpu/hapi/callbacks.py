@@ -284,15 +284,35 @@ class ProfilerCallback(Callback):
     `monitor`: a profiler.StepMonitor — brackets every train batch, so fit
     runs get step-time/MFU/HBM/recompile telemetry (and its JSONL export /
     on_report hook) with zero changes to the training loop. The monitor's
-    report() is printed at train end when `summary=True`."""
+    report() is printed at train end when `summary=True`; when a device
+    trace was captured, its compute/comm overlap ratio is fed into the
+    monitor (`overlap_ratio` gauge) so the number is tracked, not
+    table-only.
+    `timeline`: a profiler.timeline.SpanRecorder — installed process-wide
+    for the duration of fit, so the goodput seams (TrainStep compile/step
+    spans, DataLoader input stalls, CheckpointManager blocking/drain)
+    attribute the run's wall clock; eval passes are recorded per eval
+    batch as `eval` badput."""
 
-    def __init__(self, profiler=None, monitor=None, summary=True):
+    def __init__(self, profiler=None, monitor=None, summary=True,
+                 timeline=None):
         super().__init__()
         self.profiler = profiler
         self.monitor = monitor
         self.summary = summary
+        self.timeline = timeline
+        self._tl_prev = None
+        self._eval_t0 = None
 
     def on_train_begin(self, logs=None):
+        if self.timeline is not None:
+            from ..profiler import timeline as _tlmod
+            prev = _tlmod.install(self.timeline)
+            # a fit that died mid-epoch (Preempted, chaos) never runs
+            # on_train_end, so this callback's own recorder can still be
+            # installed from the previous cycle — restoring "prev" would
+            # then self-reference. Treat that as nothing-to-restore.
+            self._tl_prev = None if prev is self.timeline else prev
         if self.profiler is not None:
             self.profiler.start()
 
@@ -306,9 +326,42 @@ class ProfilerCallback(Callback):
         if self.profiler is not None:
             self.profiler.step()
 
+    def on_eval_batch_begin(self, step, logs=None):
+        # per-BATCH spans (not one per eval pass): the loader fetch runs
+        # between batches, so its input_wait spans never nest inside
+        # eval spans — conservation needs the seams non-overlapping
+        tl = self.timeline
+        if tl is None:
+            from ..profiler.timeline import current as _tl_current
+            tl = _tl_current()
+        self._eval_t0 = (tl, tl.now()) if tl is not None else None
+
+    def on_eval_batch_end(self, step, logs=None):
+        if self._eval_t0 is not None:
+            tl, t0 = self._eval_t0
+            tl.record("eval", t0, tl.now())
+            self._eval_t0 = None
+
     def on_train_end(self, logs=None):
+        # restore the timeline FIRST: a profiler.stop() failure must not
+        # leak this fit's recorder into the process-wide slot
+        if self.timeline is not None:
+            from ..profiler import timeline as _tlmod
+            _tlmod.install(self._tl_prev)
+            self._tl_prev = None
         if self.profiler is not None:
             self.profiler.stop()
+            if self.monitor is not None and not self.profiler.timer_only:
+                # surface the captured trace's compute/comm overlap as
+                # the tracked `overlap_ratio` gauge (best effort: CPU
+                # fit runs may capture no device lanes)
+                try:
+                    from ..profiler.trace_analysis import analyze
+                    ov = analyze(self.profiler._trace_dir).overlap()
+                    if ov.get("ratio") is not None:
+                        self.monitor.record_overlap(ov)
+                except Exception:
+                    pass
         if self.monitor is not None and self.summary:
             import json
             print("StepMonitor: " + json.dumps(self.monitor.report()),

@@ -16,7 +16,8 @@ from .sampler import (  # noqa: F401
     Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
     BatchSampler, DistributedBatchSampler,
 )
-from .dataloader import (DataLoader, SeededBatchSampler, default_collate_fn,  # noqa: F401
+from .dataloader import (DataLoader, DataLoaderTimeoutError,  # noqa: F401
+                         SeededBatchSampler, default_collate_fn,
                          get_worker_info, WorkerInfo)
 
 from .native import (  # noqa: E402,F401

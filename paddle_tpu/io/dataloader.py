@@ -10,12 +10,26 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
 
 from ..core.tensor import Tensor, to_tensor
+from ..profiler.timeline import current as _timeline_current
 from .dataset import IterableDataset
 from .sampler import BatchSampler, DistributedBatchSampler
+
+
+class DataLoaderTimeoutError(TimeoutError):
+    """`DataLoader(timeout=...)` expired while waiting on a worker. The
+    message names the stalled worker; `.worker` carries it structured
+    (`"prefetch-thread"` or `"process-pool"`), `.waited_s` how long the
+    consumer blocked."""
+
+    def __init__(self, message: str, *, worker: str, waited_s: float):
+        self.worker = worker
+        self.waited_s = waited_s
+        super().__init__(message)
 
 
 def default_collate_fn(batch):
@@ -148,6 +162,17 @@ class DataLoader:
         self.persistent_workers = persistent_workers
         self._pool = None
         self.prefetch_factor = max(2, prefetch_factor)
+        # timeout applies to the WORKER paths: how long __next__ may block
+        # on an empty prefetch buffer / a pool fetch before raising
+        # DataLoaderTimeoutError (0 = wait forever, reference semantics)
+        self.timeout = float(timeout or 0)
+        # goodput accounting (profiler.timeline): explicit recorder, or
+        # the process-wide installed one. input-stall seconds accumulate
+        # here either way — `stall_stats()` is the cheap live view
+        self.timeline = None
+        self._consumer_wait_s = 0.0   # __next__ blocked on empty buffer
+        self._producer_wait_s = 0.0   # prefetch thread blocked on full one
+        self._stalled_batches = 0     # batches the consumer waited for
         self._iterable_mode = isinstance(dataset, IterableDataset)
         self.return_list = return_list
         if seed is not None and int(seed) < 0:
@@ -209,6 +234,24 @@ class DataLoader:
         bs = getattr(src, "batch_size", None)
         return (int(bs) if bs is not None else -1,
                 bool(getattr(src, "drop_last", False)))
+
+    # -- input-stall accounting (profiler.timeline `input_wait`) --------
+    def _tl(self):
+        return self.timeline if self.timeline is not None \
+            else _timeline_current()
+
+    def stall_stats(self) -> dict:
+        """Cumulative input-pipeline stall split across this loader's
+        life: `consumer_wait_s` is TRUE input-stall time (the training
+        loop blocked on an empty prefetch buffer — badput, recorded as
+        `input_wait` spans when a timeline recorder is installed);
+        `producer_wait_s` is the prefetch thread blocked on a FULL
+        buffer (the healthy state: input runs ahead of compute — it is
+        overlap headroom, not badput, so it is a counter here and never
+        a span)."""
+        return {"consumer_wait_s": self._consumer_wait_s,
+                "producer_wait_s": self._producer_wait_s,
+                "stalled_batches": self._stalled_batches}
 
     # -- resumable cursor (resilience.TrainState "loader" slot) ---------
     def state_dict(self) -> dict:
@@ -342,7 +385,24 @@ class DataLoader:
 
     def _iter_impl(self, skip: int = 0):
         if self.num_workers == 0:
-            yield from self._batches(skip)
+            tl = self._tl()
+            if tl is None:
+                yield from self._batches(skip)
+                return
+            # synchronous path under goodput accounting: every
+            # fetch+collate runs ON the training thread and blocks it —
+            # the whole fetch is attributed as `input_wait`
+            # (split="sync"; there is no buffer whose emptiness to
+            # measure)
+            it = self._batches(skip)
+            while True:
+                t0 = tl.now()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    return
+                tl.record("input_wait", t0, tl.now(), split="sync")
+                yield b
             return
         if not self._iterable_mode:
             # true multi-process path (reference: dataloader_iter.py:370
@@ -359,16 +419,34 @@ class DataLoader:
         _END = object()
         err = []
         stop = threading.Event()
+        timeout = self.timeout
 
         def _put(item):
-            # bounded put that gives up when the consumer abandoned iteration
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+            # bounded put that gives up when the consumer abandoned
+            # iteration (check BEFORE the fast path: once stop is set,
+            # the producer must not keep fetching batches into the free
+            # queue slots). Time blocked on a FULL queue is
+            # producer-wait: input running AHEAD of compute — the
+            # healthy half of the stall split, counted but never a
+            # badput span.
+            if stop.is_set():
+                return False
+            try:
+                q.put_nowait(item)
+                return True
+            except queue.Full:
+                pass
+            w0 = time.monotonic()
+            try:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        return True
+                    except queue.Full:
+                        continue
+                return False
+            finally:
+                self._producer_wait_s += time.monotonic() - w0
 
         def producer():
             try:
@@ -380,11 +458,56 @@ class DataLoader:
             finally:
                 _put(_END)
 
+        def blocking_get():
+            # EMPTY buffer: the training loop is now stalled on input —
+            # the true `input_wait` badput. This wait is also where
+            # `timeout=` is enforced (it was accepted-but-ignored on
+            # this path before): a producer stuck in __getitem__ past
+            # the deadline raises a named error instead of hanging the
+            # job forever.
+            tl = self._tl()
+            w0 = time.monotonic()
+            t0 = tl.now() if tl is not None else None
+            while True:
+                try:
+                    item = q.get(timeout=0.05)
+                    break
+                except queue.Empty:
+                    waited = time.monotonic() - w0
+                    if timeout > 0 and waited >= timeout:
+                        self._consumer_wait_s += waited
+                        self._stalled_batches += 1
+                        if tl is not None:
+                            tl.record("input_wait", t0, tl.now(),
+                                      split="producer", timed_out=True)
+                        stop.set()
+                        raise DataLoaderTimeoutError(
+                            f"DataLoader timed out after {waited:.2f}s "
+                            f"(timeout={timeout}s) waiting on the "
+                            f"prefetch-thread worker (num_workers="
+                            f"{self.num_workers}): the producer is "
+                            f"stalled inside dataset __getitem__/collate "
+                            f"and the buffer stayed empty",
+                            worker="prefetch-thread", waited_s=waited)
+            if item is not _END:
+                # waiting out the end-of-epoch sentinel is not an input
+                # stall — no batch was late, the epoch was just over
+                self._consumer_wait_s += time.monotonic() - w0
+                self._stalled_batches += 1
+                if tl is not None:
+                    tl.record("input_wait", t0, tl.now(), split="producer")
+            return item
+
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         try:
             while True:
-                item = q.get()
+                try:
+                    # warm buffer: no wait, no span — steady-state input
+                    # that keeps ahead of compute must measure ≈0 stall
+                    item = q.get_nowait()
+                except queue.Empty:
+                    item = blocking_get()
                 if item is _END:
                     break
                 yield item
@@ -421,10 +544,43 @@ class DataLoader:
                 self._pool = pool
 
         def gen():
+            timeout = self.timeout
             try:
                 indices_list = list(self.batch_sampler)[skip:]
-                for payload in pool.imap(_worker_fetch, indices_list,
-                                         chunksize=1):
+                it = pool.imap(_worker_fetch, indices_list, chunksize=1)
+                while True:
+                    tl = self._tl()
+                    w0 = time.monotonic()
+                    t0 = tl.now() if tl is not None else None
+                    try:
+                        # IMapIterator.next(timeout) is how `timeout=`
+                        # reaches the pool path — a worker stuck in
+                        # __getitem__ raises instead of hanging the job
+                        payload = it.next(timeout) if timeout > 0 \
+                            else next(it)
+                    except StopIteration:
+                        break
+                    except mp.TimeoutError:
+                        waited = time.monotonic() - w0
+                        self._consumer_wait_s += waited
+                        self._stalled_batches += 1
+                        if tl is not None:
+                            tl.record("input_wait", t0, tl.now(),
+                                      split="producer", timed_out=True)
+                        raise DataLoaderTimeoutError(
+                            f"DataLoader timed out after {waited:.2f}s "
+                            f"(timeout={timeout}s) waiting on a "
+                            f"process-pool worker (num_workers="
+                            f"{self.num_workers}): a worker is stalled "
+                            f"inside dataset __getitem__",
+                            worker="process-pool", waited_s=waited)
+                    waited = time.monotonic() - w0
+                    if waited > 1e-3:   # warm pool: sub-ms next() is not
+                        self._consumer_wait_s += waited     # a stall
+                        self._stalled_batches += 1
+                        if tl is not None:
+                            tl.record("input_wait", t0, tl.now(),
+                                      split="producer")
                     if collate_in_worker:
                         yield _tree_to_tensor(payload)
                     else:

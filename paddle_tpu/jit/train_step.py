@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.tensor import Tensor, Parameter
 from ..core import random as _random
 from ..core import autograd
+from ..profiler.timeline import current as _tl_current
 from .api import (_swap_params, _trace_guard, _tree_unwrap, _tree_wrap,
                   _note_cache_miss)
 
@@ -80,7 +81,7 @@ class TrainStep:
     def __init__(self, model, optimizer, loss_fn: Callable, mesh: Optional[Mesh] = None,
                  data_axes=("dp",), donate: bool = True, grad_accum_steps: int = 1,
                  monitor=None, numerics=None, scaler=None, lint=None,
-                 preemption=None, chaos=None):
+                 preemption=None, chaos=None, timeline=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -99,6 +100,11 @@ class TrainStep:
         # exactly the boundary a real preemption would.
         self.preemption = preemption
         self.chaos = chaos
+        # goodput accounting (profiler.timeline): the step records every
+        # launch as a `compile` span (compile-cache miss calls — trace +
+        # XLA compile dominate their wall) or a `step` span (goodput).
+        # Falls back to the process-wide installed recorder when unset.
+        self.timeline = timeline
         self._step_i = 0
         self._compiled = {}
         self._last_sig = {}     # kind -> last compiled shape signature
@@ -889,6 +895,8 @@ class TrainStep:
     def run_steps(self, n_steps: int, *stacked_batch):
         """Run `n_steps` steps from batches stacked on dim 0 ([n, ...] per
         leaf), one compiled launch. Returns the per-step losses Tensor."""
+        tl = self.timeline if self.timeline is not None else _tl_current()
+        tl_t0 = tl.now() if tl is not None else None
         if self._opt_state is None:
             self._opt_state = self._init_opt_state()
             self._apply_param_shardings()
@@ -897,6 +905,7 @@ class TrainStep:
         key_sig = ("scan", n_steps,
                    tuple((tuple(a.shape), str(a.dtype)) for a in flat))
         compiled = self._compiled.get((treedef, key_sig))
+        was_compile = compiled is None
         if compiled is None:
             # lint audits the SINGLE-step pure function with per-step
             # batch slices — the scan wrapper adds only the loop carry
@@ -925,7 +934,14 @@ class TrainStep:
             # launch on; fence with a host read for an exact figure)
             self.monitor.end_step(steps=n_steps,
                                   wall_s=time.perf_counter() - t0)
+        tl_t1 = tl.now() if tl is not None else None
         self._step_i += n_steps
+        if tl is not None:
+            # the whole launch is one span: a cache-miss call is compile
+            # badput (trace + XLA compile dominate), a steady call is
+            # `step` goodput; `step` names the LAST step of the window
+            tl.record("compile" if was_compile else "step", tl_t0, tl_t1,
+                      step=self._step_i, steps=n_steps)
         for p, na in zip(self._params, new_params):
             p._data = na
             p._node = None
@@ -944,6 +960,8 @@ class TrainStep:
         return Tensor(losses)
 
     def __call__(self, *batch):
+        tl = self.timeline if self.timeline is not None else _tl_current()
+        tl_t0 = tl.now() if tl is not None else None
         if self._opt_state is None:
             self._opt_state = self._init_opt_state()
             self._apply_param_shardings()
@@ -951,6 +969,7 @@ class TrainStep:
         flat, treedef = jax.tree.flatten(arrays)
         key_sig = tuple((tuple(a.shape), str(a.dtype)) for a in flat)
         compiled = self._compiled.get((treedef, key_sig))
+        was_compile = compiled is None
         if compiled is None:
             self._maybe_lint(treedef, flat)
             self._on_compile("train_step", key_sig)
@@ -969,6 +988,9 @@ class TrainStep:
             self._scaler_state_in(), jnp.int32(self._step_i), lr, key, *flat)
         if self.monitor is not None:
             self.monitor.end_step(wall_s=time.perf_counter() - t0)
+        if tl is not None:
+            tl.record("compile" if was_compile else "step", tl_t0, tl.now(),
+                      step=self._step_i)
 
         for p, na in zip(self._params, new_params):
             p._data = na

@@ -370,3 +370,7 @@ def annotate_layers(model, root: str = None) -> _AnnotationHandle:
 from .monitor import StepMonitor, shape_delta  # noqa: E402,F401
 from ._metrics import LogHistogram  # noqa: E402,F401
 from . import trace_analysis  # noqa: E402,F401
+from . import timeline  # noqa: E402,F401
+from . import goodput  # noqa: E402,F401
+from .timeline import SpanRecorder  # noqa: E402,F401
+from .goodput import GoodputReport  # noqa: E402,F401

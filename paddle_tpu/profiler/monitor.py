@@ -81,6 +81,7 @@ class StepMonitor:
         self._mem_every = None
         self.log_recompiles = log_recompiles
         self.records = []          # one dict per end_step
+        self.overlap = None        # latest compute/comm overlap (dict)
         self.compiles = 0          # traced-step compiles observed
         self.recompiles = 0        # compiles beyond the first per kind
         self.recompile_events = []  # {step, kind, delta}
@@ -172,6 +173,28 @@ class StepMonitor:
                                "recompilation" if count
                                else "refused shape change",
                                kind, self._steps + 1, delta)
+
+    # ------------------------------------------------------------ overlap
+    def record_overlap(self, overlap):
+        """Adopt a compute/communication overlap measurement as a
+        first-class gauge. `overlap` is trace_analysis.TraceAnalysis
+        .overlap()'s dict (or a bare ratio float). Until now this number
+        only existed inside DistributedView's rendered table; recording
+        it here puts `overlap_ratio` into report()/metrics_text() so
+        dashboards can TRACK it — the baseline the distributed
+        compute/comm-overlap work is measured against.
+        ProfilerCallback feeds this automatically after each captured
+        trace."""
+        if overlap is None:
+            return
+        if not isinstance(overlap, dict):
+            overlap = {"ratio": float(overlap)}
+        self.overlap = dict(overlap)
+        if self.jsonl_path and overlap.get("ratio") is not None:
+            with open(self.jsonl_path, "a") as f:
+                f.write(json.dumps({"overlap": self.overlap,
+                                    "ts": time.time()}) + "\n")
+        return self.overlap
 
     # ----------------------------------------------------------- numerics
     def record_numerics(self, step: int, loss: Optional[float] = None,
@@ -287,6 +310,7 @@ class StepMonitor:
             num["grad_norm"] = self._last_numerics.get("grad_norm")
         return {"steps": self._steps,
                 **num,
+                "overlap_ratio": (self.overlap or {}).get("ratio"),
                 "step_ms": round(med, 3) if med is not None else None,
                 "items_per_s": round(items_s, 1) if items_s else None,
                 "unit": self.unit,
@@ -325,6 +349,9 @@ class StepMonitor:
         gauge("compiles_total", r["compiles"], "traced-step compiles")
         gauge("recompiles_total", r["recompiles"],
               "recompilations (shape-signature changes)")
+        gauge("overlap_ratio", r["overlap_ratio"],
+              "compute/comm overlap: fraction of collective time hidden "
+              "under device compute (latest captured trace)")
         gauge("jit_cache_misses_total", r["jit_cache_misses"],
               "jit compile-cache misses during monitored steps")
         gauge("numerics_events_total", r["numerics_events"],

@@ -220,6 +220,13 @@ class CheckpointManager:
         self.retry_deadline = retry_deadline
         self.retry_base_delay = retry_base_delay
         self._retry_sleep = _retry_sleep   # tests: no real sleeping
+        # goodput accounting (profiler.timeline): the manager records the
+        # time the CALLER pays — `ckpt_blocking` for a sync commit / the
+        # async host snapshot, `ckpt_drain` for blocking on the writer
+        # thread (wait/discard). The writer thread's own overlapped work
+        # is deliberately NOT badput. Explicit recorder here, or the
+        # process-wide installed one.
+        self.timeline = None
         self._inflight: Optional[AsyncHandle] = None
         # serializes the save()/wait()/discard_inflight() handoff of
         # _inflight — the fallback manager behind dist_save is shared
@@ -307,8 +314,19 @@ class CheckpointManager:
             return self._save_locked(step, state, async_save=async_save,
                                      meta=meta)
 
+    def _tl(self):
+        tl = self.timeline
+        if tl is not None:
+            return tl
+        # lazy: this module stays importable before jax initializes, and
+        # importing paddle_tpu.profiler pulls jax in
+        from ..profiler.timeline import current as _tl_current
+        return _tl_current()
+
     def _save_locked(self, step, state, *, async_save, meta):
-        self.wait()
+        self.wait()                      # records its own ckpt_drain
+        tl = self._tl()
+        t0 = tl.now() if tl is not None else None
         flat = _flatten(state)
         leaves: Dict[str, np.ndarray] = {}
         scalars: Dict[str, Any] = {}
@@ -324,7 +342,12 @@ class CheckpointManager:
             else:
                 scalars[key] = hv
         if not async_save:
-            return self._write_commit(int(step), leaves, scalars, meta)
+            try:
+                return self._write_commit(int(step), leaves, scalars, meta)
+            finally:
+                if tl is not None:
+                    tl.record("ckpt_blocking", t0, tl.now(),
+                              step=int(step), mode="sync")
         box: dict = {"cancel": threading.Event()}
 
         def writer():
@@ -341,6 +364,12 @@ class CheckpointManager:
         handle = AsyncHandle(t, box)
         self._inflight = handle
         t.start()
+        if tl is not None:
+            # the on-thread cost of an async save ends here: snapshot +
+            # writer dispatch. Serialization/commit overlap training on
+            # the niced writer and are not badput.
+            tl.record("ckpt_blocking", t0, tl.now(), step=int(step),
+                      mode="async_snapshot")
         return handle
 
     def wait(self):
@@ -353,7 +382,13 @@ class CheckpointManager:
             # first waiter is joining the old writer
             h, self._inflight = self._inflight, None
             if h is not None:
-                h.wait()
+                tl = self._tl()
+                t0 = tl.now() if tl is not None else None
+                try:
+                    h.wait()
+                finally:
+                    if tl is not None:
+                        tl.record("ckpt_drain", t0, tl.now())
 
     def discard_inflight(self):
         """Chaos fidelity: a SimulatedKill at step k models a SIGKILL at
@@ -372,10 +407,15 @@ class CheckpointManager:
             if h is None:
                 return
             h.cancel()
+            tl = self._tl()
+            t0 = tl.now() if tl is not None else None
             try:
                 h.wait()
             except BaseException:
                 pass                     # writer died on its own: no commit
+            finally:
+                if tl is not None:
+                    tl.record("ckpt_drain", t0, tl.now(), discarded=True)
 
     # I/O primitives: every one fires the injector and retries transients
     def _fire(self, site: str, **ctx):

@@ -143,6 +143,20 @@ class PreemptionHandler:
         self._count = 0
         self._sigint_count = 0
 
+    @staticmethod
+    def _stamp_exit(reason: str, **meta):
+        """Goodput accounting: stamp the installed timeline recorder's
+        segment end, so the stitched report attributes the gap to the
+        next segment's first span as `restart_downtime`. Best-effort —
+        a failure to stamp must never block the exit path."""
+        try:
+            from ..profiler.timeline import current as _tl_current
+            tl = _tl_current()
+            if tl is not None:
+                tl.mark_exit(reason, **meta)
+        except Exception:       # pragma: no cover - never block the exit
+            pass
+
     # -------------------------------------------------------------- poll
     def poll(self, state=None, step: Optional[int] = None):
         """Call at a step boundary. No signal -> no-op (one Event read).
@@ -174,6 +188,8 @@ class PreemptionHandler:
                     "budget instead of looping a job that makes no "
                     "durable progress")
                 self.clear()
+                self._stamp_exit("preemption-crash", step=step,
+                                 signum=signum)
                 raise Preempted(1, step=step, signum=signum)
             sd = state.state_dict()
             if step is None:
@@ -187,6 +203,7 @@ class PreemptionHandler:
         if self.on_preempt is not None:
             self.on_preempt(self)
         self.clear()
+        self._stamp_exit("preemption", step=step, signum=signum)
         raise Preempted(self.exit_code, step=step, checkpoint_path=path,
                         signum=signum)
 

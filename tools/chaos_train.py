@@ -117,8 +117,14 @@ def _run(step, loader, total_steps, losses, chaos=None, manager=None,
 
 
 def run_scenario(seed: int, args) -> dict:
-    """One oracle-vs-chaos comparison; returns the result row."""
+    """One oracle-vs-chaos comparison; returns the result row. The chaos
+    and resume phases each record a goodput timeline segment (ISSUE 8):
+    the injected kill must show up in the stitched GoodputReport as
+    `restart_downtime` + `replay` badput, with the replayed-step count
+    matching the resume delta and conservation holding — the goodput
+    verdict rides the same `ok` flag as the bit-exactness one."""
     from paddle_tpu import resilience
+    from paddle_tpu.profiler import timeline as tl_mod
 
     t0 = time.perf_counter()
     # ---- oracle -----------------------------------------------------
@@ -129,6 +135,8 @@ def run_scenario(seed: int, args) -> dict:
     # ---- chaos ------------------------------------------------------
     ckpt_dir = os.path.join(args.ckpt_root, f"seed{seed}")
     shutil.rmtree(ckpt_dir, ignore_errors=True)
+    tdir = os.path.join(args.timeline_dir, f"seed{seed}")
+    shutil.rmtree(tdir, ignore_errors=True)
     lo = args.save_every + 1
     inj = resilience.Injector.random_kill(seed, lo,
                                           max(lo, args.steps - 1))
@@ -139,35 +147,53 @@ def run_scenario(seed: int, args) -> dict:
     state = resilience.TrainState(train_step=step, loader=loader)
     chaos_losses: dict = {}
     died = False
+    rec1 = tl_mod.SpanRecorder(
+        os.path.join(tdir, "seg0.timeline.jsonl"),
+        meta={"phase": "chaos", "seed": seed,
+              "run": f"seed{seed}"})
     try:
-        _run(step, loader, args.steps, chaos_losses, chaos=inj,
-             manager=mgr, state=state, save_every=args.save_every)
+        with tl_mod.installed(rec1):
+            _run(step, loader, args.steps, chaos_losses, chaos=inj,
+                 manager=mgr, state=state, save_every=args.save_every)
     except resilience.SimulatedKill:
         died = True
+        # the timeline analog of a real SIGKILL's silence: stamp where
+        # the process died so the stitcher can attribute the gap to the
+        # next segment as restart_downtime
+        rec1.mark_exit("chaos-kill", step=kill_step)
         # fidelity: the kill models a SIGKILL at this instant — a save
         # still on the writer thread must not commit post-mortem, or the
         # "restart" below resumes from a checkpoint a real kill never
         # produced and the proof is weaker than it claims
-        mgr.discard_inflight()
+        with tl_mod.installed(rec1):
+            mgr.discard_inflight()
+    rec1.close()
     if not died:
         raise AssertionError(
             f"seed {seed}: injector never fired (kill_step={kill_step}, "
             f"steps={args.steps})")
 
     # ---- restart-and-resume (a fresh "process") ---------------------
-    step, loader, monitor = _build(seed, args)
-    state = resilience.TrainState(train_step=step, loader=loader,
-                                  monitor=monitor)
-    try:
-        resumed_at, sd = mgr.restore_latest()      # checksum-verified
-        state.load_state_dict(sd)
-    except FileNotFoundError:
-        # the kill outran every commit (possible when the only save was
-        # still in flight): a real job restarts from scratch — so do we
-        resumed_at = None
-    compiles_before = monitor.compiles
-    _run(step, loader, args.steps, chaos_losses,
-         manager=mgr, state=state, save_every=args.save_every)
+    rec2 = tl_mod.SpanRecorder(
+        os.path.join(tdir, "seg1.timeline.jsonl"),
+        meta={"phase": "resume", "seed": seed,
+              "run": f"seed{seed}"})
+    with tl_mod.installed(rec2):
+        step, loader, monitor = _build(seed, args)
+        state = resilience.TrainState(train_step=step, loader=loader,
+                                      monitor=monitor)
+        try:
+            resumed_at, sd = mgr.restore_latest()  # checksum-verified
+            state.load_state_dict(sd)
+        except FileNotFoundError:
+            # the kill outran every commit (possible when the only save
+            # was still in flight): a real job restarts from scratch —
+            # so do we
+            resumed_at = None
+        compiles_before = monitor.compiles
+        _run(step, loader, args.steps, chaos_losses,
+             manager=mgr, state=state, save_every=args.save_every)
+    rec2.close()
 
     # ---- verdicts ---------------------------------------------------
     divergences = []
@@ -194,11 +220,46 @@ def run_scenario(seed: int, args) -> dict:
         except resilience.CheckpointCorruptError as e:
             corrupt.append(f"step {s}: {e}")
 
+    # ---- goodput verdict (ISSUE 8): the kill must be VISIBLE --------
+    from paddle_tpu.profiler.goodput import ConservationError, GoodputReport
+    goodput = None
+    try:
+        rep = GoodputReport(tl_mod.load_segments(tdir))
+        rep.check_conservation()
+    except ConservationError as e:
+        divergences.append(f"goodput conservation violated: {e}")
+        rep = None
+    except Exception as e:
+        divergences.append(f"goodput report failed: {e!r}")
+        rep = None
+    if rep is not None:
+        s = rep.summary()
+        goodput = {"goodput_ratio": s["goodput_ratio"],
+                   "restart_downtime_s": s["badput_s"]["restart_downtime"],
+                   "replay_s": s["badput_s"]["replay"],
+                   "replayed_steps": s["replayed_steps"],
+                   "restarts": s["restarts"], "wall_s": s["wall_s"]}
+        if s["restarts"] != 1:
+            divergences.append(
+                f"goodput: expected 1 restart in the stitched timeline, "
+                f"got {s['restarts']}")
+        if s["badput_s"]["restart_downtime"] <= 0:
+            divergences.append(
+                "goodput: injected kill left no restart_downtime badput")
+        if resumed_at is not None:
+            want = kill_step - resumed_at
+            if s["replayed_steps"] != want:
+                divergences.append(
+                    f"goodput: replayed_steps {s['replayed_steps']} != "
+                    f"resume delta {want} (kill@{kill_step}, "
+                    f"resume@{resumed_at})")
+
     row = {"seed": seed, "kill_step": kill_step, "resumed_at": resumed_at,
            "steps": args.steps,
            "replayed": resumed_at is not None
            and kill_step - resumed_at,
            "compiles_after_resume": monitor.compiles - compiles_before,
+           "goodput": goodput,
            "divergences": divergences, "corrupt": corrupt,
            "wall_s": round(time.perf_counter() - t0, 2),
            "ok": not divergences and not corrupt}
@@ -308,6 +369,10 @@ def main(argv=None) -> int:
     ap.add_argument("--n-samples", type=int, default=64)
     ap.add_argument("--ckpt-root", default=None,
                     help="checkpoint scratch dir (default: a tempdir)")
+    ap.add_argument("--timeline-dir", default=None,
+                    help="goodput timeline segment dir (default: under "
+                         "the checkpoint scratch dir — pass a path to "
+                         "keep the segments for tools/goodput_report.py)")
     ap.add_argument("--sweep", type=int, default=0, metavar="N",
                     help="run N seeded scenarios (seed..seed+N-1); the "
                          "slow tier's mode")
@@ -338,6 +403,8 @@ def main(argv=None) -> int:
     if args.ckpt_root is None:
         tmp = tempfile.mkdtemp(prefix="chaos_train_")
         args.ckpt_root = tmp
+    if args.timeline_dir is None:
+        args.timeline_dir = os.path.join(args.ckpt_root, "timeline")
 
     try:
         seeds = range(args.seed, args.seed + max(1, args.sweep))
@@ -356,6 +423,13 @@ def main(argv=None) -> int:
                       f"kill@{r['kill_step']} resume@{r['resumed_at']} "
                       f"replayed={r['replayed']} steps={r['steps']} "
                       f"({r['wall_s']}s)")
+                g = r.get("goodput")
+                if g:
+                    print(f"  goodput: {g['goodput_ratio']:.1%} of "
+                          f"{g['wall_s']:.2f}s wall — restart_downtime "
+                          f"{g['restart_downtime_s']:.3f}s, replay "
+                          f"{g['replay_s']:.3f}s "
+                          f"({g['replayed_steps']} steps)")
                 for d in r["divergences"]:
                     print(f"  DIVERGENCE: {d}")
                 for c in r["corrupt"]:

@@ -64,7 +64,9 @@ def load_records(paths):
 def check(records, *, budget: float, slow_threshold: float,
           lint_seconds: float = None, lint_budget: float = 15.0,
           chaos_seconds: float = None,
-          chaos_budget: float = 120.0) -> dict:
+          chaos_budget: float = 120.0,
+          goodput_seconds: float = None,
+          goodput_budget: float = 30.0) -> dict:
     unmarked_slow = []       # should carry `slow` but don't
     tier1 = []               # everything tier-1 actually collects
     for r in records:
@@ -87,6 +89,11 @@ def check(records, *, budget: float, slow_threshold: float,
     # multi-seed sweep belongs to the slow tier
     chaos_over = (chaos_seconds is not None
                   and chaos_seconds > chaos_budget)
+    # the goodput budget line: tools/goodput_report.py stitches the chaos
+    # leg's timeline segments inside the tier-1 wrapper (ISSUE 8) — a
+    # pure-host JSONL parse that must stay trivial next to the suite
+    goodput_over = (goodput_seconds is not None
+                    and goodput_seconds > goodput_budget)
     return {
         "n_records": len(records),
         "n_tier1": len(tier1),
@@ -100,11 +107,14 @@ def check(records, *, budget: float, slow_threshold: float,
         "chaos_seconds": chaos_seconds,
         "chaos_budget_s": chaos_budget,
         "chaos_over_budget": chaos_over,
+        "goodput_seconds": goodput_seconds,
+        "goodput_budget_s": goodput_budget,
+        "goodput_over_budget": goodput_over,
         "unmarked_slow": sorted(unmarked_slow,
                                 key=lambda r: -r["duration"]),
         "slowest_tier1": sorted(tier1, key=lambda r: -r["duration"])[:10],
         "ok": (tier1_total <= budget and not unmarked_slow
-               and not lint_over and not chaos_over),
+               and not lint_over and not chaos_over and not goodput_over),
     }
 
 
@@ -127,6 +137,12 @@ def main(argv=None) -> int:
                          "gate (tools/run_tier1.sh records it)")
     ap.add_argument("--chaos-budget", type=float, default=120.0,
                     help="max seconds the chaos gate may take on tier-1")
+    ap.add_argument("--goodput-seconds", type=float, default=None,
+                    help="measured wall time of the tier-1 goodput_report "
+                         "smoke (tools/run_tier1.sh records it)")
+    ap.add_argument("--goodput-budget", type=float, default=30.0,
+                    help="max seconds the goodput smoke may take on "
+                         "tier-1")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -139,7 +155,9 @@ def main(argv=None) -> int:
                    lint_seconds=args.lint_seconds,
                    lint_budget=args.lint_budget,
                    chaos_seconds=args.chaos_seconds,
-                   chaos_budget=args.chaos_budget)
+                   chaos_budget=args.chaos_budget,
+                   goodput_seconds=args.goodput_seconds,
+                   goodput_budget=args.goodput_budget)
 
     if args.json:
         print(json.dumps(result, indent=2))
@@ -153,10 +171,17 @@ def main(argv=None) -> int:
         if result.get("chaos_seconds") is not None:
             print(f"  chaos: {result['chaos_seconds']:.2f}s "
                   f"(budget {result['chaos_budget_s']}s)")
+        if result.get("goodput_seconds") is not None:
+            print(f"  goodput: {result['goodput_seconds']:.2f}s "
+                  f"(budget {result['goodput_budget_s']}s)")
         if result["chaos_over_budget"]:
             print(f"  VIOLATION: chaos gate took "
                   f"{result['chaos_seconds']:.2f}s, over the "
                   f"{result['chaos_budget_s']}s chaos budget")
+        if result["goodput_over_budget"]:
+            print(f"  VIOLATION: goodput smoke took "
+                  f"{result['goodput_seconds']:.2f}s, over the "
+                  f"{result['goodput_budget_s']}s goodput budget")
         if result["lint_over_budget"]:
             print(f"  VIOLATION: lint pass took "
                   f"{result['lint_seconds']:.2f}s, over the "

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Goodput attribution CLI — render the wall-clock attribution table from
+one or more timeline segments (ISSUE 8 tentpole).
+
+Input is whatever `profiler.timeline` wrote: segment files
+(`*.timeline.jsonl`), directories of them (a whole run including its
+restarts), or glob patterns. Segments are stitched onto one absolute
+timeline: post-restart re-runs of already-executed steps become `replay`
+badput, inter-segment gaps become `restart_downtime`, and the
+conservation property (categorized + idle ≡ wall within ε) is checked on
+every invocation.
+
+CI mode: `--min-goodput R` exits 1 when goodput% lands below R (and on
+any conservation violation), so a training job's timeline can gate a
+pipeline the same way tests do. `tools/run_tier1.sh` runs this over the
+segments the chaos_train gate leaves behind.
+
+    python tools/goodput_report.py runs/job42/            # human table
+    python tools/goodput_report.py seg0.timeline.jsonl seg1.timeline.jsonl
+    python tools/goodput_report.py runs/job42 --min-goodput 0.6   # CI gate
+    python tools/goodput_report.py runs/job42 --prom      # /metrics dump
+
+Exit status: 0 = ok, 1 = below --min-goodput or conservation violated,
+2 = no usable segments.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("segments", nargs="+",
+                    help="timeline segment files, dirs or globs")
+    ap.add_argument("--min-goodput", type=float, default=None,
+                    help="exit 1 if goodput ratio is below this "
+                         "(0..1; CI gate)")
+    ap.add_argument("--eps", type=float, default=0.05,
+                    help="conservation tolerance in seconds")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary dict instead of the table")
+    ap.add_argument("--prom", action="store_true",
+                    help="print Prometheus gauges instead of the table")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.profiler.goodput import ConservationError, GoodputReport
+    from paddle_tpu.profiler.timeline import load_segments
+
+    try:
+        segs = load_segments(args.segments)
+    except FileNotFoundError as e:
+        print(f"goodput_report: {e}", file=sys.stderr)
+        return 2
+    if not segs:
+        print("goodput_report: no spans in any segment", file=sys.stderr)
+        return 2
+    try:
+        report = GoodputReport(segs, eps=args.eps)
+    except ValueError as e:     # segments from different runs
+        print(f"goodput_report: {e}", file=sys.stderr)
+        return 2
+
+    conservation_err = None
+    try:
+        report.check_conservation()
+    except ConservationError as e:
+        conservation_err = str(e)
+
+    if args.json:
+        out = report.summary()
+        out["conservation_ok"] = conservation_err is None
+        if conservation_err:
+            out["conservation_error"] = conservation_err
+        print(json.dumps(out, indent=2))
+    elif args.prom:
+        print(report.metrics_text(), end="")
+    else:
+        print(report.table())
+
+    rc = 0
+    if conservation_err is not None:
+        print(f"goodput_report: CONSERVATION VIOLATION: "
+              f"{conservation_err}", file=sys.stderr)
+        rc = 1
+    gr = report.goodput_ratio
+    if args.min_goodput is not None:
+        if gr is None or gr < args.min_goodput:
+            print(f"goodput_report: goodput "
+                  f"{'n/a' if gr is None else f'{gr:.1%}'} below the "
+                  f"--min-goodput {args.min_goodput:.1%} gate",
+                  file=sys.stderr)
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -37,13 +37,33 @@ echo "lint_source: ${lint_secs}s (exit $lrc)"
 # estimator; the tight-bar run is `chaos_train.py --overhead-max-pct 5`
 # on an unloaded host. The multi-seed sweep is the slow tier's
 # (tests/test_resilience.py::test_chaos_sweep, marked slow).
+# ISSUE 8: the scenario also records goodput timeline segments (and
+# asserts in-process that the kill shows up as restart_downtime+replay
+# with conservation holding); the segments land in $GOODPUT_TL for the
+# goodput_report smoke below.
+GOODPUT_TL="${TIER1_GOODPUT_TL:-/tmp/_tier1_timeline}"
+rm -rf "$GOODPUT_TL"
 chaos_t0=$(date +%s.%N)
 timeout -k 10 "${TIER1_CHAOS_TIMEOUT:-300}" \
     env JAX_PLATFORMS=cpu python tools/chaos_train.py --quick --overhead \
-    --overhead-max-pct "${TIER1_CHAOS_MAX_PCT:-25}"
+    --overhead-max-pct "${TIER1_CHAOS_MAX_PCT:-25}" \
+    --timeline-dir "$GOODPUT_TL"
 chrc=$?
 chaos_secs=$(echo "$(date +%s.%N) $chaos_t0" | awk '{printf "%.2f", $1-$2}')
 echo "chaos_train: ${chaos_secs}s (exit $chrc)"
+
+# goodput smoke (ISSUE 8): stitch the chaos leg's segments through the
+# real CLI — the attribution table renders, conservation holds, and the
+# goodput gate exercises the nonzero-exit path contract. The 0.1%
+# floor is a smoke threshold (the quick chaos scenario is compile-
+# dominated by design); production gates pick their own bar.
+gp_t0=$(date +%s.%N)
+timeout -k 10 "${TIER1_GOODPUT_TIMEOUT:-60}" \
+    env JAX_PLATFORMS=cpu python tools/goodput_report.py "$GOODPUT_TL" \
+    --min-goodput "${TIER1_GOODPUT_MIN:-0.001}"
+gprc=$?
+goodput_secs=$(echo "$(date +%s.%N) $gp_t0" | awk '{printf "%.2f", $1-$2}')
+echo "goodput_report: ${goodput_secs}s (exit $gprc)"
 
 timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
     PADDLE_TPU_TIER_DURATIONS="$DUR" \
@@ -53,6 +73,7 @@ rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
 [ "$rc" -eq 0 ] && rc=$lrc
 [ "$rc" -eq 0 ] && rc=$chrc
+[ "$rc" -eq 0 ] && rc=$gprc
 
 if [ -s "$DUR" ]; then
     python tools/check_tiers.py "$DUR" \
@@ -61,7 +82,9 @@ if [ -s "$DUR" ]; then
         --lint-seconds "$lint_secs" \
         --lint-budget "${TIER1_LINT_BUDGET:-15}" \
         --chaos-seconds "$chaos_secs" \
-        --chaos-budget "${TIER1_CHAOS_BUDGET:-120}"
+        --chaos-budget "${TIER1_CHAOS_BUDGET:-120}" \
+        --goodput-seconds "$goodput_secs" \
+        --goodput-budget "${TIER1_GOODPUT_BUDGET:-30}"
     crc=$?
     [ "$rc" -eq 0 ] && rc=$crc
 else
