@@ -649,6 +649,102 @@ def bench_decode_paged(on_tpu):
     })
 
 
+def bench_decode_paged_prefix(on_tpu):
+    """Prefix-cached serving on shared-prefix traffic (ISSUE 10): N system
+    prompts x random suffixes replayed through the paged engine with the
+    radix-trie prefix cache OFF and ON. The row value is the CACHED tok/s;
+    extras carry the uncached twin, the hit rate, prefill-tokens-saved and
+    the p50 TTFT both ways — the acceptance row for "a repeated prefix
+    admits with zero prefill tokens"."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (ServingConfig, ServingEngine,
+                                      shared_prefix_traffic)
+    from paddle_tpu.models import GPTForCausalLM, GPTConfig, gpt_config
+
+    if on_tpu:
+        preset, B, cap, new, chunk, n_req, kvb = \
+            "gpt3-1.3b", 8, 128, 128, 32, 48, 16
+        n_prefixes, plen = 4, 96
+    else:
+        preset, B, cap, new, chunk, n_req, kvb = None, 2, 16, 8, 4, 12, 4
+        n_prefixes, plen = 2, 8
+    preset = os.environ.get("PADDLE_TPU_BENCH_PRESET", preset) \
+        if on_tpu else preset
+    paddle.seed(0)
+    if preset:
+        cfg = gpt_config(preset)
+        model = GPTForCausalLM(cfg)
+        model.to(dtype="bfloat16")
+    else:
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_position_embeddings=256,
+                        intermediate_size=128)
+        model = GPTForCausalLM(cfg)
+    model.eval()
+    traffic = shared_prefix_traffic(n_req, n_prefixes=n_prefixes,
+                                    prefix_len=plen, prompt_cap=cap,
+                                    vocab_size=cfg.vocab_size, rate=1e9,
+                                    seed=3)
+
+    def run(prefix):
+        eng = ServingEngine(model, ServingConfig(
+            max_batch=B, prompt_cap=cap, max_new_tokens=new,
+            decode_chunk=chunk, paged=True, kv_block=kvb,
+            kv_blocks=B * (-(-(cap + new - 1) // kvb)) + 1
+            + (n_req * (cap // kvb) if prefix else 0),
+            prefix_cache=prefix))
+        # warmup: full-prefill + decode, plus (cached leg) the COW and
+        # suffix-prefill executables — then start the measured replay cold
+        if prefix:
+            eng.warmup_prefix_cache(cfg.vocab_size)
+        else:
+            rng = np.random.RandomState(1)
+            wp = rng.randint(1, cfg.vocab_size,
+                             ((cap // kvb) * kvb,)).astype(np.int64)
+            eng.submit(wp)
+            eng.drain()
+        eng.metrics = type(eng.metrics)()
+        t0 = time.perf_counter()
+        for item in traffic:
+            eng.submit(item["prompt"])
+            while eng.queue_depth >= B:
+                eng.step()
+        while eng.busy:
+            eng.step()
+        dt = time.perf_counter() - t0
+        s = eng.summary()
+        hits, misses = s["prefix_hit_total"], s["prefix_miss_total"]
+        return {"tok_s": s["tokens_out_total"] / dt,
+                "ttft_p50_ms": s["ttft_seconds"]["p50"] * 1e3
+                if "ttft_seconds" in s else None,
+                "hit_rate": hits / max(hits + misses, 1),
+                "saved": s["prefill_tokens_saved_total"],
+                "recompiles": eng.monitor.recompiles}
+
+    off = run(False)
+    on = run(True)
+    return _emit({
+        "metric": f"prefix-cached serving decode tokens/sec/chip "
+                  f"({preset or 'toy'} shared-prefix traffic, "
+                  f"{n_prefixes}x{plen}-tok prompts, B={B} cap={cap} "
+                  f"new={new})",
+        "value": round(on["tok_s"], 1), "unit": "tokens/s",
+        "vs_baseline": None,
+        "extra": {"uncached_tok_s": round(off["tok_s"], 1),
+                  "cached_vs_uncached": round(on["tok_s"] / off["tok_s"],
+                                              3) if off["tok_s"] else None,
+                  "prefix_hit_rate": round(on["hit_rate"], 3),
+                  "prefill_tokens_saved": on["saved"],
+                  "ttft_p50_ms_cached": round(on["ttft_p50_ms"], 3)
+                  if on["ttft_p50_ms"] else None,
+                  "ttft_p50_ms_uncached": round(off["ttft_p50_ms"], 3)
+                  if off["ttft_p50_ms"] else None,
+                  "steady_recompiles": off["recompiles"]
+                  + on["recompiles"]},
+    })
+
+
 def bench_vit(on_tpu, preset=None, B=None):
     """ViT (BASELINE.md config) training throughput — fused whole-sequence
     MHA kernel at the ragged patch-sequence length."""
@@ -781,6 +877,7 @@ _SINGLE = {
     "vit": bench_vit,
     "decode": bench_decode,
     "decode-paged": bench_decode_paged,
+    "decode-paged-prefix": bench_decode_paged_prefix,
     "swin": bench_swin,
     "moe": bench_moe,
     "gpt": bench_gpt,
@@ -816,6 +913,10 @@ def _ladder(on_tpu):
         # paged KV serving (ISSUE 5): block-pool engine vs the padded
         # twin on long-tail traffic + the decode_static donation saving
         ("decode-paged", lambda: bench_decode_paged(on_tpu), 180),
+        # prefix cache (ISSUE 10): shared-prefix traffic, radix-trie
+        # block sharing off vs on — hit rate + prefill-tokens-saved
+        ("decode-paged-prefix",
+         lambda: bench_decode_paged_prefix(on_tpu), 180),
         ("moe", lambda: bench_moe(on_tpu), 240),
         # the SHIPPED default capacity (GShard 1.25) stays driver-tracked;
         # its dense twin is reused from the cf=1.0 row, so this pays only

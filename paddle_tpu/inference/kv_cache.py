@@ -27,6 +27,16 @@ masked writes (right-padded prompt garbage, post-EOS decode steps of a
 fixed-shape chunk) all land there, so scatter updates never need a mask and
 can never corrupt another request's blocks. Usable capacity is therefore
 ``(num_blocks - 1) * block_size`` tokens.
+
+Blocks are REFCOUNTED (ISSUE 10): the prefix cache maps one physical
+block into many requests' tables (``alloc(..., shared=...)``) and holds
+its own reference on cached blocks (:meth:`retain`); a block returns to
+the free list only when its last reference drops (:meth:`free` /
+:meth:`release`). The trash block is never issued, never shared, never
+counted. ``cache_dtype="int8"`` pools carry int8 code payloads plus
+per-(block-row, head) f32 factored scales — same quantization scheme as
+the static int8 KV path (ops.attention.quantize_kv), so the pool holds
+~2x the resident tokens for the same HBM.
 """
 from __future__ import annotations
 
@@ -46,44 +56,64 @@ class BlockPool:
     block_size : KV rows (token positions) per block.
     num_layers / num_heads / head_dim / dtype : pool tensor geometry —
         normally taken from the model via :meth:`for_model`.
+    cache_dtype : None = pools carry the model dtype; "int8" = pools are
+        (codes int8, scale f32) pairs with per-(row, head) factored
+        scales (the static int8-KV trick ported to the paged pool).
     """
 
     def __init__(self, *, num_blocks: int, block_size: int,
                  num_layers: int, num_heads: int, head_dim: int,
-                 dtype="float32"):
+                 dtype="float32", cache_dtype=None):
         if num_blocks < 2:
             raise ValueError("num_blocks must be >= 2 (block 0 is the "
                              "reserved trash block)")
         if block_size < 1:
             raise ValueError("block_size must be >= 1")
+        if cache_dtype not in (None, "int8"):
+            raise ValueError(f"cache_dtype must be None or 'int8'; "
+                             f"got {cache_dtype!r}")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
         self.dtype = dtype
+        self.cache_dtype = cache_dtype
         # LIFO free list: recently freed blocks are re-issued first, which
         # keeps the hot working set of pool pages small
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
         self._rows: Dict[int, List[int]] = {}
+        self._refs: Dict[int, int] = {}     # block id -> reference count
 
     @classmethod
-    def for_model(cls, model, *, num_blocks: int, block_size: int):
+    def for_model(cls, model, *, num_blocks: int, block_size: int,
+                  cache_dtype=None):
         """Geometry from a GPTForCausalLM-style model (config + dtype)."""
         cfg = model.config
         dtype = model.gpt.wte.weight._data.dtype
         return cls(num_blocks=num_blocks, block_size=block_size,
                    num_layers=cfg.num_layers, num_heads=cfg.num_heads,
-                   head_dim=cfg.head_dim, dtype=dtype)
+                   head_dim=cfg.head_dim, dtype=dtype,
+                   cache_dtype=cache_dtype)
 
     def make_pools(self):
-        """Fresh zeroed device pools: per layer ``(k_pool, v_pool)``, each
-        ``[num_blocks, block_size, num_heads, head_dim]``. The caller owns
-        them from here — jitted steps donate and replace them, so the
-        allocator deliberately does NOT keep a reference."""
+        """Fresh zeroed device pools. Per layer: ``(k_pool, v_pool)``
+        each ``[num_blocks, block_size, num_heads, head_dim]`` — or, for
+        ``cache_dtype="int8"``, ``(k_codes, k_scale, v_codes, v_scale)``
+        with int8 ``[NB, bs, H, D]`` codes and f32 ``[NB, bs, H]``
+        factored scales. The caller owns them from here — jitted steps
+        donate and replace them, so the allocator deliberately does NOT
+        keep a reference."""
         import jax.numpy as jnp
         shape = (self.num_blocks, self.block_size,
                  self.num_heads, self.head_dim)
+        if self.cache_dtype == "int8":
+            sshape = shape[:3]
+            return [(jnp.zeros(shape, jnp.int8),
+                     jnp.zeros(sshape, jnp.float32),
+                     jnp.zeros(shape, jnp.int8),
+                     jnp.zeros(sshape, jnp.float32))
+                    for _ in range(self.num_layers)]
         return [(jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype))
                 for _ in range(self.num_layers)]
 
@@ -101,6 +131,18 @@ class BlockPool:
         return self.capacity_blocks * self.block_size
 
     @property
+    def bytes_per_block(self) -> int:
+        """HBM bytes ONE block pins across every layer's K+V pools — the
+        unit the prefix cache's byte budget is charged in."""
+        import numpy as np_
+        rows = self.block_size * self.num_heads
+        if self.cache_dtype == "int8":
+            per = rows * self.head_dim * 1 + rows * 4    # codes + f32 scale
+        else:
+            per = rows * self.head_dim * np_.dtype(self.dtype).itemsize
+        return 2 * per * self.num_layers                 # K and V
+
+    @property
     def free_blocks(self) -> int:
         return len(self._free)
 
@@ -115,8 +157,16 @@ class BlockPool:
         return self.blocks_needed(tokens) <= self.capacity_blocks
 
     # --------------------------------------------------------- alloc/free
-    def alloc(self, owner: int, tokens: int) -> Optional[np.ndarray]:
+    def alloc(self, owner: int, tokens: int,
+              shared=None) -> Optional[np.ndarray]:
         """Reserve blocks covering `tokens` KV rows for `owner`.
+
+        `shared` (prefix cache, ISSUE 10) maps already-populated blocks —
+        in PREFIX ORDER — into the reservation instead of allocating
+        fresh ones: each gains a reference, and only
+        ``blocks_needed(tokens) - len(shared)`` fresh blocks come off the
+        free list, appended after the shared run (so the returned vector
+        is the request's block-table row in position order).
 
         Returns the block-id vector (int32) on success, None when the pool
         has too few FREE blocks right now (the caller decides whether to
@@ -125,21 +175,70 @@ class BlockPool:
         if owner in self._rows:
             raise ValueError(f"owner {owner} already holds "
                              f"{len(self._rows[owner])} blocks; free first")
-        n = self.blocks_needed(tokens)
+        shared = [int(b) for b in (shared or ())]
+        if any(b == 0 for b in shared):
+            raise ValueError("the trash block (0) is never shared")
+        n = self.blocks_needed(tokens) - len(shared)
+        if n < 0:
+            raise ValueError(f"shared prefix ({len(shared)} blocks) longer "
+                             f"than the reservation ({tokens} tokens)")
         if n > len(self._free):
             return None
-        blocks = [self._free.pop() for _ in range(n)]
+        for b in shared:
+            if self._refs.get(b, 0) < 1:
+                raise ValueError(f"block {b} is not live; cannot share")
+            self._refs[b] += 1
+        fresh = [self._free.pop() for _ in range(n)]
+        for b in fresh:
+            self._refs[b] = 1
+        blocks = shared + fresh
         self._rows[owner] = blocks
         return np.asarray(blocks, dtype=np.int32)  # lint: allow(tracer-asarray)
 
     def free(self, owner: int) -> int:
-        """Release every block `owner` holds; returns how many. Freeing an
+        """Drop `owner`'s reference on every block it holds; returns how
+        many actually RETURNED to the free list (a block another owner or
+        the prefix cache still references stays resident). Freeing an
         unknown owner is a no-op (0) — finish paths may race a reject."""
         blocks = self._rows.pop(owner, None)
         if not blocks:
             return 0
-        self._free.extend(reversed(blocks))
-        return len(blocks)
+        return self._deref(reversed(blocks))
+
+    # ------------------------------------------------- cache references
+    def retain(self, blocks) -> None:
+        """Add one reference per block — how the prefix cache pins a
+        cached prefix independent of the request that computed it."""
+        for b in blocks:
+            b = int(b)
+            if b == 0:
+                raise ValueError("the trash block (0) is never retained")
+            if self._refs.get(b, 0) < 1:
+                raise ValueError(f"block {b} is not live; cannot retain")
+            self._refs[b] += 1
+
+    def release(self, blocks) -> int:
+        """Drop one reference per block (cache eviction path); returns
+        how many hit zero and went back to the free list."""
+        return self._deref(int(b) for b in blocks)
+
+    def refcount(self, block: int) -> int:
+        return self._refs.get(int(block), 0)
+
+    def _deref(self, blocks) -> int:
+        freed = 0
+        for b in blocks:
+            b = int(b)
+            r = self._refs.get(b, 0)
+            if r < 1:
+                raise ValueError(f"refcount underflow on block {b}")
+            if r == 1:
+                del self._refs[b]
+                self._free.append(b)
+                freed += 1
+            else:
+                self._refs[b] = r - 1
+        return freed
 
     def owned(self, owner: int) -> List[int]:
         return list(self._rows.get(owner, ()))
@@ -172,6 +271,7 @@ class BlockPool:
     def reset(self):
         self._free = list(range(self.num_blocks - 1, 0, -1))
         self._rows.clear()
+        self._refs.clear()
 
     def __repr__(self):
         return (f"BlockPool(blocks={self.num_blocks}x{self.block_size}, "

@@ -61,6 +61,20 @@ Pallas paged kernel while the static path stores bf16 scores, so parity
 there is approximate near argmax ties — exact whenever both sides share
 a numerics class: f32 models anywhere, or the CPU reference path; see
 ops/pallas/paged_attention.py and tools/validate_paged_tpu.py.)
+
+`ServingConfig(paged=True, prefix_cache=True)` (ISSUE 10) adds the
+radix-trie PREFIX CACHE (inference/prefix_cache.py): admission matches
+each prompt against cached full-block token prefixes, maps shared
+refcounted pool blocks into the request's table, and prefills only the
+uncached suffix — a full hit skips prefill entirely (the last prompt
+token re-enters as the decode pending token, so TTFT is one decode
+step, with copy-on-write of the last shared block when the hit is
+block-aligned). `cache_dtype="int8"` now composes with paged=True: the
+pools carry int8 codes + per-block factored scales (the static int8-KV
+trick ported to the paged kernel), holding ~2x the resident requests.
+Greedy output stays bit-identical with the cache on vs off, and the
+steady loop still adds zero compilations — the suffix-prefill and COW
+executables are part of the warmup set.
 """
 from __future__ import annotations
 
@@ -198,10 +212,17 @@ class ServingMetrics:
         self.counters = {"requests": 0, "completed": 0, "rejected": 0,
                          "overloaded": 0, "timeout": 0, "errors": 0,
                          "tokens_in": 0, "tokens_out": 0, "items": 0,
-                         "batches": 0}
+                         "batches": 0,
+                         # prefix cache (ISSUE 10): admissions that
+                         # mapped >= 1 cached block / that mapped none,
+                         # and prompt tokens whose prefill was skipped
+                         # because their KV was already pooled
+                         "prefix_hit": 0, "prefix_miss": 0,
+                         "prefill_tokens_saved": 0}
         self.gauges = {"queue_depth": 0, "inflight": 0,
                        "batch_fill_ratio": None, "kv_occupancy": None,
-                       "kv_slots_occupancy": None}
+                       "kv_slots_occupancy": None,
+                       "kv_shared_tokens": None}
 
     # -- recording ------------------------------------------------------
     def observe_call(self, e2e_s: float, items: int = 1):
@@ -257,17 +278,23 @@ class ServingMetrics:
 
     def record_batch(self, *, n_real: int, capacity: int,
                      kv_tokens: int, kv_slots: int, kv_capacity: int,
-                     queue_depth: int):
-        """kv_tokens = LIVE (attendable) KV rows; kv_slots = rows the
-        allocation granularity pins (padded slots / reserved blocks);
-        kv_capacity = total pooled rows. kv_occupancy is the true-token
-        gauge (ISSUE 5 satellite — padded-slot accounting could not go
-        above the padding ratio); kv_slots_occupancy keeps the old
-        slot-granular value for dashboard continuity."""
+                     queue_depth: int, kv_shared_tokens: int = 0):
+        """kv_tokens = PHYSICAL live (attendable) KV rows — a block
+        mapped into several requests' tables (prefix sharing) counts
+        ONCE; kv_slots = rows the allocation granularity pins (padded
+        slots / reserved blocks); kv_capacity = total pooled rows.
+        kv_occupancy is the true-token gauge (ISSUE 5 satellite —
+        padded-slot accounting could not go above the padding ratio);
+        kv_slots_occupancy keeps the old slot-granular value for
+        dashboard continuity. kv_shared_tokens (ISSUE 10) is the LOGICAL
+        volume served out of shared blocks — summed over requests, so
+        (kv_shared_tokens - distinct shared rows) is exactly the HBM the
+        prefix cache is saving right now."""
         self.counters["batches"] += 1
         self.gauges["batch_fill_ratio"] = n_real / max(capacity, 1)
         self.gauges["kv_occupancy"] = kv_tokens / max(kv_capacity, 1)
         self.gauges["kv_slots_occupancy"] = kv_slots / max(kv_capacity, 1)
+        self.gauges["kv_shared_tokens"] = kv_shared_tokens
         self.gauges["queue_depth"] = queue_depth
 
     # -- reporting ------------------------------------------------------
@@ -288,7 +315,7 @@ class ServingMetrics:
         for k in ("queue_depth", "inflight"):
             self.gauges[k] = 0
         for k in ("batch_fill_ratio", "kv_occupancy",
-                  "kv_slots_occupancy"):
+                  "kv_slots_occupancy", "kv_shared_tokens"):
             self.gauges[k] = None
         return self._emit({"drain": self.summary(), "ts": time.time()})
 
@@ -310,7 +337,13 @@ class ServingMetrics:
                  "tokens_out": "tokens generated (up to and incl. EOS)",
                  "items": "batch rows processed by profiled predictor "
                           "calls",
-                 "batches": "micro-batches executed"}
+                 "batches": "micro-batches executed",
+                 "prefix_hit": "admissions that mapped >= 1 cached "
+                               "prefix block",
+                 "prefix_miss": "admissions that found no cached prefix",
+                 "prefill_tokens_saved": "prompt tokens whose prefill "
+                                         "was skipped (KV already "
+                                         "pooled)"}
         for name, value in self.counters.items():
             lines.extend(counter_lines(prefix, f"{name}_total", value,
                                        helps[name]))
@@ -322,7 +355,10 @@ class ServingMetrics:
                                  "capacity — true-token occupancy",
                  "kv_slots_occupancy": "allocation-granular KV rows "
                                        "(padded slots / reserved blocks) "
-                                       "/ pooled capacity"}
+                                       "/ pooled capacity",
+                 "kv_shared_tokens": "logical KV rows served from "
+                                     "shared prefix blocks (summed over "
+                                     "requests)"}
         for name, value in self.gauges.items():
             lines.extend(gauge_lines(prefix, name, value, ghelp[name]))
         for name, help_ in self.HISTS:
@@ -365,6 +401,16 @@ class ServingConfig:
     kv_block: int = 16              # KV rows per pool block
     kv_blocks: Optional[int] = None  # total pool blocks INCL. trash block;
     #                            default = worst case for max_batch rows
+    # --- prefix cache (ISSUE 10): radix-trie prefix reuse over the pool.
+    # A full-block-aligned cached prefix maps shared (refcounted) blocks
+    # straight into the new request's table — full hit skips prefill
+    # entirely (TTFT = one decode step, COW on the last block), partial
+    # hit prefills only the suffix. Requires paged=True.
+    prefix_cache: bool = False
+    prefix_cache_bytes: Optional[int] = None  # LRU eviction budget for
+    #                            cached (refcount-free) blocks; None =
+    #                            bounded by the pool itself (admission
+    #                            reclaims cached blocks under pressure)
     # --- static analysis (ISSUE 6): True / "error" / analysis.GraphLint —
     # the engine audits each of its {prefill, decode} executables with
     # the graph lint once, the first step it is built (findings
@@ -388,21 +434,26 @@ class ServingConfig:
             raise ValueError(
                 f"queue_high_watermark must be in [1, queue_capacity="
                 f"{self.queue_capacity}], got {self.queue_high_watermark}")
+        if self.prefix_cache and not self.paged:
+            raise ValueError("prefix_cache=True requires paged=True (the "
+                             "trie shares BLOCK-pool blocks; the padded "
+                             "engine has no blocks to share)")
         if self.paged:
-            if self.cache_dtype is not None:
-                # structured config-validation finding (same schema as the
-                # graph passes) so tools print WHY paged+int8-KV is
-                # refused, not just that it is — ConfigValidationError is
-                # a ValueError, existing callers keep working
+            if self.cache_dtype not in (None, "int8"):
+                # int8 paged KV landed (ISSUE 10: per-block factored
+                # scales, the static int8 trick ported to the paged
+                # kernel); every OTHER narrow dtype is still refused with
+                # a structured config-validation finding (same schema as
+                # the graph passes) so tools print WHY — ConfigValidation-
+                # Error is a ValueError, existing callers keep working
                 raise ConfigValidationError(Finding(
                     "config", "paged_cache_dtype", "error",
                     f"cache_dtype={self.cache_dtype!r} with paged=True is "
-                    f"not supported: the paged block pools carry the MODEL "
-                    f"dtype (int8 paged KV is an open ROADMAP item — the "
-                    f"factored-scale int8 trick of the static path has "
-                    f"not been ported to the paged kernel). Use "
-                    f"paged=False with cache_dtype={self.cache_dtype!r}, "
-                    f"or paged=True with cache_dtype=None",
+                    f"not supported: paged pools carry the MODEL dtype or "
+                    f"the int8 (codes, factored-scale) form. Use "
+                    f"cache_dtype='int8' (halves resident KV), "
+                    f"cache_dtype=None, or paged=False with "
+                    f"cache_dtype={self.cache_dtype!r}",
                     executable="ServingConfig",
                     data={"cache_dtype": str(self.cache_dtype),
                           "paged": True}))
@@ -506,7 +557,8 @@ class ServingEngine:
             B, MB = config.max_batch, config.table_width
             self._pool = BlockPool.for_model(model,
                                              num_blocks=config.kv_blocks,
-                                             block_size=config.kv_block)
+                                             block_size=config.kv_block,
+                                             cache_dtype=config.cache_dtype)
             self._pools = self._pool.make_pools()
             self._slots: List[Optional[Request]] = [None] * B
             self._tables = np.zeros((B, MB), np.int32)
@@ -515,8 +567,18 @@ class ServingEngine:
             self._done = np.ones((B,), bool)
             self._calls = 0            # PRNG stream cursor (sampling mode)
             self._paged_seen = set()   # executables already compiled
-            self._kv_snapshot = (0, 0)  # (live tokens, slot rows) at the
-            #                             last step's decode entry
+            self._kv_snapshot = (0, 0, 0)  # (physical live tokens, slot
+            #                      rows, logical shared tokens) at the
+            #                      last step's decode entry
+            # prefix cache (ISSUE 10): per-slot count of lens tokens that
+            # live in blocks the request mapped SHARED from the trie —
+            # the kv_shared_tokens gauge and the hit bookkeeping
+            self._shared_tok = np.zeros((B,), np.int64)
+            self._prefix = None
+            if config.prefix_cache:
+                from .prefix_cache import PrefixCache
+                self._prefix = PrefixCache(
+                    self._pool, byte_budget=config.prefix_cache_bytes)
 
     # -- admission ------------------------------------------------------
     @property
@@ -875,9 +937,8 @@ class ServingEngine:
         self.monitor.begin_step()
         out_tokens = 0
         try:
-            finished, expired, n_prefills = self._admit_paged()
-            if n_prefills:
-                ran.add("prefill")
+            finished, expired, admit_ran = self._admit_paged()
+            ran |= admit_ran
             live_entry = self._live()
             if live_entry:
                 chunk_done, out_tokens = self._decode_chunk_paged(
@@ -895,7 +956,11 @@ class ServingEngine:
                     self._pool.free(r.id)
                     self._clear_slot(i)
             # the failed call may have CONSUMED the donated pools — rebuild
-            # so the engine stays usable (the padded engine's contract)
+            # so the engine stays usable (the padded engine's contract).
+            # pool.reset() wiped the refcounts, so the prefix cache's
+            # entries point at reissued blocks: drop them WITHOUT deref
+            if self._prefix is not None:
+                self._prefix.clear(release=False)
             self._pool.reset()
             self._pools = self._pool.make_pools()
             self.metrics.gauges["inflight"] = 0
@@ -910,12 +975,13 @@ class ServingEngine:
             # step actually served, not the post-free emptiness
             n_real = len(live_entry) if live_entry else \
                 min(len(finished), len(self._slots))
-            kv_tokens, kv_slots = self._kv_snapshot
+            kv_tokens, kv_slots, kv_shared = self._kv_snapshot
             self.metrics.record_batch(
                 n_real=n_real, capacity=len(self._slots),
                 kv_tokens=kv_tokens, kv_slots=kv_slots,
                 kv_capacity=self._pool.capacity_tokens,
-                queue_depth=len(self._queue))
+                queue_depth=len(self._queue),
+                kv_shared_tokens=kv_shared)
         # compile accounting, same convention as the static engine: a miss
         # while every executable this step ran was already seen is shape
         # churn — log it through the r7 recompile detector
@@ -934,17 +1000,135 @@ class ServingEngine:
         self._lens[slot] = 0
         self._pending[slot] = 0
         self._done[slot] = True
+        self._shared_tok[slot] = 0
+
+    def _kv_physical(self):
+        """(physical live tokens, logical shared tokens) over live slots.
+
+        Physical occupancy counts each DISTINCT block once (ISSUE 10
+        satellite — summing per-slot lens would bill a shared prefix once
+        per request): walk every live slot's owned blocks in position
+        order, credit each block its live rows, and take the max where
+        two slots map the same block (shared prefix blocks are full, so
+        the max is just bs). Logical shared tokens = the per-slot
+        shared-mapped volume summed — what the requests are READING out
+        of blocks they did not allocate."""
+        bs = self._pool.block_size
+        rows: dict = {}
+        shared = 0
+        for s in self._live():
+            ln = int(self._lens[s])
+            shared += int(self._shared_tok[s])
+            for j, blk in enumerate(self._pool.owned(self._slots[s].id)):
+                r = min(max(ln - j * bs, 0), bs)
+                if r == 0:
+                    break
+                rows[blk] = max(rows.get(blk, 0), r)
+        return sum(rows.values()), shared
+
+    def _snapshot_kv(self):
+        phys, shared = self._kv_physical()
+        self._kv_snapshot = (
+            phys, self._pool.used_blocks * self._pool.block_size, shared)
+
+    def _insert_prefix(self, req: Request, blocks, written: int):
+        """Cache the request's prompt blocks whose KV is WRITTEN — the
+        full blocks among positions [0, written). The partial tail keeps
+        taking decode writes and is never shared; a block whose rows are
+        not on device yet (the zero-prefill pending position) must not
+        be cached either. Shared runs dedup against their own nodes."""
+        if self._prefix is None:
+            return
+        bs = self._pool.block_size
+        n_full = min(int(written), req.prompt_len) // bs
+        if n_full:
+            self._prefix.insert(req.prompt[:n_full * bs], blocks[:n_full])
+
+    def warmup_prefix_cache(self, vocab_size: int, *, seed: int = 2,
+                            clear: bool = True):
+        """Compile the prefix-cache executable set before measuring: a
+        full-prefill miss, an identical block-aligned repeat (the COW
+        copy), and a mid-prefix divergence (suffix prefill), each run to
+        completion so decode compiles too. `clear=True` then drops the
+        warmup's cached prefixes so measured traffic starts cold. The
+        shared choreography serve_bench / bench.py / graph_lint use —
+        steady-state zero-recompile assertions are only meaningful after
+        this whole set has lowered."""
+        if self._prefix is None:
+            raise ValueError("warmup_prefix_cache needs "
+                             "ServingConfig(prefix_cache=True)")
+        bs = self.config.kv_block
+        aligned = (self.config.prompt_cap // bs) * bs
+        if aligned < max(bs, 2):
+            raise ValueError(f"prompt_cap {self.config.prompt_cap} holds "
+                             f"no full kv_block ({bs}); nothing to warm")
+        rng = np.random.RandomState(seed)
+        p = rng.randint(1, vocab_size, (aligned,)).astype(np.int64)
+        for prompt in (p, p):        # miss, then aligned full hit (COW)
+            self.submit(prompt)
+            self.drain()
+        if aligned > bs:             # partial hit -> suffix prefill
+            d = p.copy()
+            d[bs:] = rng.randint(1, vocab_size, (aligned - bs,))
+            self.submit(d)
+            self.drain()
+        if clear:
+            self._prefix.clear()
+        return self
+
+    def _cow_copy(self, src: int, dst: int):
+        """Copy one pool block (every layer, K and V — codes AND scales
+        in int8 mode) into a private block: the copy-on-write an aligned
+        full-prefix hit needs before its re-decode of the last prompt
+        token writes at position plen-1, INSIDE the last shared block.
+        src/dst are data inputs of one tiny donated executable — steady
+        COW traffic adds zero compilations."""
+        import jax as _jax
+        sig = ("paged_cow", self._pool.num_blocks, self._pool.block_size,
+               self._pool.num_layers, str(self._pool.dtype),
+               self._pool.cache_dtype)
+
+        def build():
+            def run(pools, s, d):
+                return _jax.tree_util.tree_map(
+                    lambda p: p.at[d].set(p[s]), pools)
+            return _jax.jit(run, donate_argnums=(0,))
+
+        fn = self.model._gen_cache_get(sig, build)
+        self._pools = fn(self._pools, np.int32(src), np.int32(dst))
 
     def _admit_paged(self):
-        """Fill every free slot from the queue: allocate blocks, prefill
-        the prompt into them ([1, cap] — one fixed executable), splice the
-        row into the live decode batch. Returns (finished, expired,
-        n_prefills) — a budget-1 or instant-EOS request can finish here
-        without ever joining a decode chunk."""
+        """Fill every free slot from the queue: consult the prefix trie,
+        map shared blocks / allocate fresh ones, prefill what the cache
+        does not already hold ([1, cap] — one fixed executable per mode),
+        splice the row into the live decode batch. Returns (finished,
+        expired, ran_tags) — a budget-1 or instant-EOS request can finish
+        here without ever joining a decode chunk.
+
+        Prefix-cache admission (ISSUE 10) splits three ways on the
+        matched full-block token count t vs the prompt length plen:
+
+          t == 0           full prefill, exactly the ISSUE-5 path;
+          0 < t < plen-1   partial hit: prefill ONLY the suffix (start=t
+                           suffix-prefill executable — attends across
+                           the shared prefix blocks);
+          t >= plen-1      zero-prefill hit: every prompt position except
+                           the last already has pooled KV. The last
+                           token re-enters as the decode `pending` token
+                           (lens = plen-1), so TTFT is ONE decode step
+                           and prefill runs on 0 tokens. When t == plen
+                           (block-aligned full hit) that re-decode would
+                           write INTO the last shared block — it is
+                           copy-on-write'd into a private block first;
+                           shared blocks are never mutated.
+
+        Every admitted prompt's full blocks are inserted into the trie
+        afterwards (dedup'd), so the NEXT identical prefix hits."""
         cfg = self.config
+        bs = self._pool.block_size
         finished: List[Request] = []
         expired: List[Request] = []
-        n_prefills = 0
+        ran = set()
         free = [i for i, r in enumerate(self._slots) if r is None]
         while self._queue and free:
             now = self.clock()
@@ -957,9 +1141,37 @@ class ServingEngine:
                 self.metrics.record_request(req)
                 expired.append(req)
                 continue
-            blocks = self._pool.alloc(req.id,
-                                      req.prompt_len +
-                                      req.max_new_tokens - 1)
+            plen = req.prompt_len
+            need_rows = plen + req.max_new_tokens - 1
+            matched, t = ([], 0) if self._prefix is None \
+                else self._prefix.match(req.prompt)
+            # COW: an aligned full hit (t == plen) shares all matched
+            # blocks EXCEPT the last, which is replaced by a private copy
+            # (the re-decode write lands in it); otherwise the shared run
+            # is the matched run and fresh blocks carry the suffix
+            cow = t == plen and t > 0
+            shared = matched[:-1] if cow else matched
+            blocks = self._pool.alloc(req.id, need_rows, shared=shared)
+            if blocks is None and self._prefix is not None:
+                # cached-but-idle prefixes are SOFT capacity: evict LRU
+                # refcount-free entries before deciding to wait —
+                # protecting the whole matched run (`shared` plus the
+                # COW source) from being reclaimed out from under this
+                # very admission
+                n_fresh = self._pool.blocks_needed(need_rows) - len(shared)
+                if self._prefix.reclaim(n_fresh, protect=matched):
+                    blocks = self._pool.alloc(req.id, need_rows,
+                                              shared=shared)
+                if blocks is None and not self._live():
+                    # nothing in flight will ever free blocks, so waiting
+                    # cannot help: a request that fits the pool alone
+                    # (preflight's fits_ever) must not starve on its own
+                    # protected cached prefix — drop the hit, reclaim
+                    # freely, full-prefill
+                    matched, t, cow, shared = [], 0, False, []
+                    if self._prefix.reclaim(
+                            self._pool.blocks_needed(need_rows)):
+                        blocks = self._pool.alloc(req.id, need_rows)
             if blocks is None:
                 break            # wait for live rows to free their blocks
             self._queue.popleft()
@@ -972,45 +1184,74 @@ class ServingEngine:
             # here and records it as status="error" — the engine's
             # in-flight accounting contract
             self._slots[slot] = req
-            ids = np.full((1, cfg.prompt_cap), cfg.pad_token_id,
-                          dtype=np.int64)
-            ids[0, :req.prompt_len] = req.prompt
             table_row = self._pool.table_row(req.id, self._tables.shape[1])
-            with jax.profiler.TraceAnnotation("serving/prefill"):
-                self._pools, first = self.model.prefill_paged(
-                    ids, np.asarray([req.prompt_len], np.int32),  # lint: allow(tracer-asarray)
-                    self._pools, table_row[None],
-                    temperature=cfg.temperature, top_k=cfg.top_k,
-                    top_p=cfg.top_p, seed=cfg.seed + self._calls,
-                    weight_dtype=cfg.weight_dtype)
-                tok = int(np.asarray(first.numpy())[0])  # lint: allow(tracer-asarray)
-            self._calls += 1
-            n_prefills += 1
-            t = self.clock()
-            req.trace.t_prefill_done = t
-            req.trace.t_first_token = t   # sampled with the prefill call
             self._tables[slot] = table_row
-            self._lens[slot] = req.prompt_len
-            self._pending[slot] = tok
-            hit_eos = (cfg.eos_token_id is not None
-                       and tok == cfg.eos_token_id)
-            self._done[slot] = hit_eos
-            req._chunks = [np.asarray([tok], np.int64)]  # lint: allow(tracer-asarray)
-            req._produced = 1
-            if req._produced >= req.max_new_tokens or hit_eos:
-                self._finish_paged_row(slot, t)
-                finished.append(req)
-                free.insert(0, slot)
+            self._shared_tok[slot] = len(shared) * bs
+            if self._prefix is not None:
+                self.metrics.counters[
+                    "prefix_hit" if t else "prefix_miss"] += 1
+            if t >= plen - 1 and t > 0:
+                # zero-prefill admission: the whole prompt (minus the
+                # re-decoded last token) is served from cached blocks
+                if cow:
+                    self._cow_copy(matched[-1], int(blocks[len(shared)]))
+                    ran.add("cow")
+                self._lens[slot] = plen - 1
+                self._pending[slot] = int(req.prompt[plen - 1])
+                self._done[slot] = False
+                req._chunks = []
+                req._produced = 0
+                req.trace.t_prefill_done = now   # nothing to prefill
+                self.metrics.counters["prefill_tokens_saved"] += plen - 1
+                # re-stamp the matched chain; only positions < t hold
+                # written KV here (the pending re-decode hasn't run), so
+                # the insert must not cache any fresh block yet
+                self._insert_prefix(req, blocks, t)
+            else:
+                suffix = plen - t
+                ids = np.full((1, cfg.prompt_cap), cfg.pad_token_id,
+                              dtype=np.int64)
+                ids[0, :suffix] = req.prompt[t:]
+                start = None if t == 0 else np.asarray([t], np.int32)  # lint: allow(tracer-asarray)
+                with jax.profiler.TraceAnnotation("serving/prefill"):
+                    self._pools, first = self.model.prefill_paged(
+                        ids, np.asarray([suffix], np.int32),  # lint: allow(tracer-asarray)
+                        self._pools, table_row[None],
+                        temperature=cfg.temperature, top_k=cfg.top_k,
+                        top_p=cfg.top_p, seed=cfg.seed + self._calls,
+                        weight_dtype=cfg.weight_dtype,
+                        cache_dtype=cfg.cache_dtype, start=start)
+                    tok = int(np.asarray(first.numpy())[0])  # lint: allow(tracer-asarray)
+                self._calls += 1
+                ran.add("prefill" if t == 0 else "prefix_prefill")
+                if t:
+                    self.metrics.counters["prefill_tokens_saved"] += t
+                tp = self.clock()
+                req.trace.t_prefill_done = tp
+                req.trace.t_first_token = tp  # sampled with the prefill
+                self._lens[slot] = plen
+                self._pending[slot] = tok
+                hit_eos = (cfg.eos_token_id is not None
+                           and tok == cfg.eos_token_id)
+                self._done[slot] = hit_eos
+                req._chunks = [np.asarray([tok], np.int64)]  # lint: allow(tracer-asarray)
+                req._produced = 1
+                # insert BEFORE any instant finish: the cache's retain
+                # must land while the request still holds its blocks
+                # (finishing frees the owner's references)
+                self._insert_prefix(req, blocks, plen)
+                if req._produced >= req.max_new_tokens or hit_eos:
+                    self._finish_paged_row(slot, tp)
+                    finished.append(req)
+                    free.insert(0, slot)
             self._batch_id += 1
         self.metrics.gauges["queue_depth"] = len(self._queue)
-        if n_prefills:
+        if ran:
             # admission-only steps (budget-1 / instant-EOS traffic) still
             # report the post-admission pool state; a following decode
             # chunk overwrites this with its own entry snapshot
-            self._kv_snapshot = (
-                int(self._lens.sum()),
-                self._pool.used_blocks * self._pool.block_size)
-        return finished, expired, n_prefills
+            self._snapshot_kv()
+        return finished, expired, ran
 
     def _decode_chunk_paged(self, live: List[int]):
         """One fixed-shape decode chunk over the whole slot batch (dummy
@@ -1018,8 +1259,7 @@ class ServingEngine:
         row that hit EOS or its budget. Returns (finished, real tokens)."""
         cfg = self.config
         c = cfg.decode_chunk
-        self._kv_snapshot = (int(self._lens.sum()),
-                             self._pool.used_blocks * self._pool.block_size)
+        self._snapshot_kv()
         with jax.profiler.TraceAnnotation("serving/decode"):
             toks, self._pools, _, done_d = self.model.decode_paged(
                 self._pools, self._tables, self._lens, self._pending,
@@ -1027,7 +1267,8 @@ class ServingEngine:
                 top_k=cfg.top_k, top_p=cfg.top_p,
                 seed=cfg.seed + self._calls,
                 eos_token_id=cfg.eos_token_id,
-                weight_dtype=cfg.weight_dtype)
+                weight_dtype=cfg.weight_dtype,
+                cache_dtype=cfg.cache_dtype)
             arr = np.asarray(toks.numpy())          # host sync per chunk  # lint: allow(tracer-asarray)
         self._calls += 1
         t = self.clock()
@@ -1042,6 +1283,11 @@ class ServingEngine:
             req._chunks.append(arr[slot, :take])
             req._produced += take
             out_tokens += take
+            if req.trace.t_first_token is None:
+                # zero-prefill admission (prefix cache): this chunk's
+                # first token IS the request's first token — TTFT was
+                # one decode step, measured not estimated
+                req.trace.t_first_token = t
             self._lens[slot] += c     # device wrote c rows regardless
             # EOS scan covers only the FRESH slice: earlier chunks were
             # checked when they landed (an EOS there already finished the
@@ -1176,4 +1422,37 @@ def synthetic_traffic(n_requests: int, *, prompt_cap: int, vocab_size: int,
         out.append({"at": float(at[i]),  # lint: allow(tracer-float)
                     "prompt": rng.randint(1, vocab_size,
                                           (ln,)).astype(np.int64)})
+    return out
+
+
+def shared_prefix_traffic(n_requests: int, *, n_prefixes: int,
+                          prefix_len: int, prompt_cap: int,
+                          vocab_size: int, rate: float = 50.0,
+                          seed: int = 0) -> List[dict]:
+    """System-prompt workload (ISSUE 10): every request draws one of
+    `n_prefixes` FIXED token prefixes (`prefix_len` tokens — the "system
+    prompt") followed by a fresh random suffix, with Poisson arrivals at
+    `rate` req/s. The traffic shape prefix caching exists for: after each
+    prefix's first request, every later request sharing it should admit
+    with only its suffix prefilled. Returns [{"at", "prompt",
+    "prefix_id"}] sorted by arrival — serve_bench's --shared-prefix
+    profile and the bench decode-paged-prefix row replay this."""
+    if not (1 <= prefix_len < prompt_cap):
+        raise ValueError(f"prefix_len must be in [1, prompt_cap), got "
+                         f"{prefix_len} vs cap {prompt_cap}")
+    if n_prefixes < 1:
+        raise ValueError(f"n_prefixes must be >= 1, got {n_prefixes}")
+    rng = np.random.RandomState(seed)
+    prefixes = rng.randint(1, vocab_size,
+                           (n_prefixes, prefix_len)).astype(np.int64)
+    gaps = rng.exponential(1.0 / max(rate, 1e-9), size=n_requests)
+    at = np.cumsum(gaps) - gaps[0]
+    out = []
+    for i in range(n_requests):
+        p = int(rng.randint(0, n_prefixes))
+        ln = int(rng.randint(1, prompt_cap - prefix_len + 1))
+        suffix = rng.randint(1, vocab_size, (ln,)).astype(np.int64)
+        out.append({"at": float(at[i]),  # lint: allow(tracer-float)
+                    "prompt": np.concatenate([prefixes[p], suffix]),
+                    "prefix_id": p})
     return out

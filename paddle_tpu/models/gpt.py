@@ -173,56 +173,155 @@ class GPTSelfAttention(Layer):
 
         new_cache = None
         if cache is not None and isinstance(cache[0], str):
-            # PAGED KV-cache serving (ISSUE 5): ("paged", k_pool, v_pool,
-            # block_tables, lens). KV lives in a fixed [NB, bs, nh, hd]
-            # block pool shared by every request; each row owns blocks
-            # named by its table row. One executable serves ANY mix of
-            # request lengths — the table/lens vectors are data, never
+            # PAGED KV-cache serving (ISSUE 5/10): ("paged", k_pool,
+            # v_pool, block_tables, lens[, start]) — or, int8 pools,
+            # ("paged8", k_codes, k_scale, v_codes, v_scale, tables,
+            # lens[, start]). KV lives in a fixed [NB, bs, nh, hd] block
+            # pool shared by every request; each row owns blocks named by
+            # its table row. One executable serves ANY mix of request
+            # lengths — the table/lens/start vectors are data, never
             # shape. `lens` means: true prompt length during prefill
             # (s > 1), tokens already in the cache during decode (s == 1).
-            if cache[0] != "paged":
+            # A trailing `start` (prefix cache) marks SUFFIX prefill:
+            # the s > 1 tokens sit at global positions start[b] + i, and
+            # attention runs over the pool (cached prefix + suffix)
+            # instead of the prompt alone.
+            if cache[0] not in ("paged", "paged8"):
                 raise ValueError(f"unknown tagged KV-cache kind "
-                                 f"{cache[0]!r} (expected 'paged')")
+                                 f"{cache[0]!r} (expected 'paged' or "
+                                 f"'paged8')")
+            q8c = cache[0] == "paged8"
             qkv = ops.reshape(qkv, [b, s, 3, nh, hd])
-            kp, vp, tables, lens = cache[1], cache[2], cache[3], cache[4]
             q = qkv[:, :, 0]
             from ..ops.attention import (paged_cache_write,
+                                         paged_cache_write_q8,
                                          paged_prefill_write,
+                                         paged_prefill_write_q8,
                                          paged_prefill_mask,
                                          paged_attention,
+                                         paged_attention_q8,
+                                         paged_prefix_attention_reference,
+                                         paged_prefix_attention_reference_q8,
+                                         quantize_kv,
+                                         attention_q8_cache,
                                          attention_reference)
-            if s == 1:
-                # decode step: the token lands at row position lens[b] and
-                # attends to cols <= itself (lens + 1 attendable rows)
-                kp2 = apply_op("paged_cache_k", paged_cache_write,
-                               [kp, qkv[:, :, 1], tables, lens])
-                vp2 = apply_op("paged_cache_v", paged_cache_write,
-                               [vp, qkv[:, :, 2], tables, lens])
+            if q8c:
+                kc, ks, vc, vs, tables, lens = cache[1:7]
+                start = cache[7] if len(cache) > 7 else None
+                if s == 1:
+                    # decode: quantize the token's K/V at row position
+                    # lens[b]; attend cols <= itself via the factored-
+                    # scale int8 math (kernel on TPU, gather reference
+                    # elsewhere)
+                    kc2, ks2 = apply_op(
+                        "paged_cache_k_q8", paged_cache_write_q8,
+                        [kc, ks, qkv[:, :, 1], tables, lens])
+                    vc2, vs2 = apply_op(
+                        "paged_cache_v_q8", paged_cache_write_q8,
+                        [vc, vs, qkv[:, :, 2], tables, lens])
 
-                def _attend_paged(qa, kpa, vpa, t, l):
-                    return paged_attention(qa, kpa, vpa, t, l + 1,
-                                           score_dtype=qa.dtype)
+                    def _attend_paged_q8(qa, kca, ksa, vca, vsa, t, l):
+                        return paged_attention_q8(qa, kca, ksa, vca, vsa,
+                                                  t, l + 1)
 
-                ctx = apply_op("paged_attend", _attend_paged,
-                               [q, kp2, vp2, tables, lens])
+                    ctx = apply_op("paged_attend_q8", _attend_paged_q8,
+                                   [q, kc2, ks2, vc2, vs2, tables, lens])
+                elif start is not None:
+                    # suffix prefill: quantized writes at start[b] + i,
+                    # attention over the pool (cached prefix + suffix)
+                    kc2, ks2 = apply_op(
+                        "paged_prefix_k_q8", paged_prefill_write_q8,
+                        [kc, ks, qkv[:, :, 1], tables, start])
+                    vc2, vs2 = apply_op(
+                        "paged_prefix_v_q8", paged_prefill_write_q8,
+                        [vc, vs, qkv[:, :, 2], tables, start])
+
+                    def _attend_prefix_q8(qa, kca, ksa, vca, vsa, t, st):
+                        return paged_prefix_attention_reference_q8(
+                            qa, kca, ksa, vca, vsa, t, st)
+
+                    ctx = apply_op(
+                        "paged_prefix_attend_q8", _attend_prefix_q8,
+                        [q, kc2, ks2, vc2, vs2, tables, start])
+                else:
+                    # prompt prefill: quantize-as-written; attention runs
+                    # over the prompt's OWN codes — the static int8
+                    # path's numerics class (attention_q8_cache), so
+                    # int8-paged chains track the static int8 chains
+                    kc2, ks2 = apply_op(
+                        "paged_prefill_k_q8", paged_prefill_write_q8,
+                        [kc, ks, qkv[:, :, 1], tables])
+                    vc2, vs2 = apply_op(
+                        "paged_prefill_v_q8", paged_prefill_write_q8,
+                        [vc, vs, qkv[:, :, 2], tables])
+
+                    def _attend_prompt_q8(qa, ka, va, l):
+                        kcod, kscl = quantize_kv(ka)
+                        vcod, vscl = quantize_kv(va)
+                        mask = paged_prefill_mask(qa.shape[1], l)
+                        return attention_q8_cache(qa, kcod, kscl,
+                                                  vcod, vscl, mask)
+
+                    ctx = apply_op(
+                        "paged_prefill_attend_q8", _attend_prompt_q8,
+                        [q, qkv[:, :, 1], qkv[:, :, 2], lens])
+                new_cache = ("paged8", kc2.detach(), ks2.detach(),
+                             vc2.detach(), vs2.detach(), tables, lens) + \
+                    (() if start is None else (start,))
             else:
-                # prefill: write the padded prompt's K/V into the row's
-                # blocks (padding past a row's reservation lands in the
-                # trash block), attend over the prompt itself — ragged
-                # causal, identical numerics class to the static prefill
-                kp2 = apply_op("paged_prefill_k", paged_prefill_write,
-                               [kp, qkv[:, :, 1], tables])
-                vp2 = apply_op("paged_prefill_v", paged_prefill_write,
-                               [vp, qkv[:, :, 2], tables])
+                kp, vp, tables, lens = cache[1], cache[2], cache[3], \
+                    cache[4]
+                start = cache[5] if len(cache) > 5 else None
+                if s == 1:
+                    # decode step: the token lands at row position
+                    # lens[b] and attends to cols <= itself (lens + 1
+                    # attendable rows)
+                    kp2 = apply_op("paged_cache_k", paged_cache_write,
+                                   [kp, qkv[:, :, 1], tables, lens])
+                    vp2 = apply_op("paged_cache_v", paged_cache_write,
+                                   [vp, qkv[:, :, 2], tables, lens])
 
-                def _attend_prompt(qa, ka, va, l):
-                    mask = paged_prefill_mask(qa.shape[1], l)
-                    return attention_reference(qa, ka, va, mask=mask,
+                    def _attend_paged(qa, kpa, vpa, t, l):
+                        return paged_attention(qa, kpa, vpa, t, l + 1,
                                                score_dtype=qa.dtype)
 
-                ctx = apply_op("paged_prefill_attend", _attend_prompt,
-                               [q, qkv[:, :, 1], qkv[:, :, 2], lens])
-            new_cache = ("paged", kp2.detach(), vp2.detach(), tables, lens)
+                    ctx = apply_op("paged_attend", _attend_paged,
+                                   [q, kp2, vp2, tables, lens])
+                elif start is not None:
+                    # suffix prefill (prefix cache): write at
+                    # start[b] + i, attend over the pool — causal across
+                    # the cached prefix plus the suffix itself
+                    kp2 = apply_op("paged_prefix_k", paged_prefill_write,
+                                   [kp, qkv[:, :, 1], tables, start])
+                    vp2 = apply_op("paged_prefix_v", paged_prefill_write,
+                                   [vp, qkv[:, :, 2], tables, start])
+
+                    def _attend_prefix(qa, kpa, vpa, t, st):
+                        return paged_prefix_attention_reference(
+                            qa, kpa, vpa, t, st, score_dtype=qa.dtype)
+
+                    ctx = apply_op("paged_prefix_attend", _attend_prefix,
+                                   [q, kp2, vp2, tables, start])
+                else:
+                    # prefill: write the padded prompt's K/V into the
+                    # row's blocks (padding past a row's reservation
+                    # lands in the trash block), attend over the prompt
+                    # itself — ragged causal, identical numerics class
+                    # to the static prefill
+                    kp2 = apply_op("paged_prefill_k", paged_prefill_write,
+                                   [kp, qkv[:, :, 1], tables])
+                    vp2 = apply_op("paged_prefill_v", paged_prefill_write,
+                                   [vp, qkv[:, :, 2], tables])
+
+                    def _attend_prompt(qa, ka, va, l):
+                        mask = paged_prefill_mask(qa.shape[1], l)
+                        return attention_reference(qa, ka, va, mask=mask,
+                                                   score_dtype=qa.dtype)
+
+                    ctx = apply_op("paged_prefill_attend", _attend_prompt,
+                                   [q, qkv[:, :, 1], qkv[:, :, 2], lens])
+                new_cache = ("paged", kp2.detach(), vp2.detach(), tables,
+                             lens) + (() if start is None else (start,))
         elif cache is not None and _is_q8_cache(cache):
             # INT8 static-cache decode (cache_dtype="int8"): the bf16 path
             # below is KV-bandwidth-bound at small batch — storing the
@@ -586,13 +685,32 @@ def _unwrap_ragged_caches(new_caches):
             for c in new_caches]
 
 
-def _check_pool_dtype(pools, cdt):
-    """Paged pools must carry the model dtype (the paged path has no int8
-    cache mode yet — pools ARE the cache; see README Serving)."""
-    pdt = pools[0][0].dtype
+def _check_pool_dtype(pools, cdt, cache_dtype=None):
+    """Paged pools carry the model dtype, or — cache_dtype="int8" — the
+    (codes int8, scale f32) 4-tuple form (BlockPool(cache_dtype="int8")).
+    Returns True for the int8 form; a pool/request mismatch raises so a
+    stale pool can never be silently misread."""
+    if cache_dtype not in (None, "int8"):
+        raise ValueError(f"paged cache_dtype must be None or 'int8'; "
+                         f"got {cache_dtype!r}")
+    entry = pools[0]
+    q8_pool = len(entry) == 4
+    if q8_pool != (cache_dtype == "int8"):
+        raise ValueError(
+            f"paged pool layout ({'int8 codes+scales' if q8_pool else 'model-dtype'}) "
+            f"does not match cache_dtype={cache_dtype!r}; rebuild the pool "
+            f"with BlockPool(cache_dtype={cache_dtype!r})")
+    if q8_pool:
+        if entry[0].dtype != jnp.int8 or entry[1].dtype != jnp.float32:
+            raise ValueError(f"int8 paged pools must be (int8 codes, f32 "
+                             f"scale) pairs; got ({entry[0].dtype}, "
+                             f"{entry[1].dtype})")
+        return True
+    pdt = entry[0].dtype
     if jnp.dtype(pdt) != jnp.dtype(cdt):
         raise ValueError(f"paged KV pools are {pdt}, model is {cdt}; "
                          f"rebuild the pool after model.to(dtype=...)")
+    return False
 
 
 def _make_static_caches(c8, nl, b, L, nh, hd, cdt, lens=None):
@@ -1093,13 +1211,16 @@ class GPTForCausalLM(Layer):
     def prefill_paged(self, input_ids, prompt_lens, pools, block_tables,
                       temperature: float = 0.0, top_k: int = 0,
                       top_p: float = 1.0, seed: int = 0,
-                      weight_dtype: str = None):
+                      weight_dtype: str = None, cache_dtype: str = None,
+                      start=None):
         """Prefill ragged prompts INTO a paged KV block pool (ISSUE 5).
 
         input_ids [n, P_cap] right-padded prompts; prompt_lens [n] true
-        lengths; pools = per-layer (k_pool, v_pool) from
-        `inference.kv_cache.BlockPool.make_pools()`; block_tables [n, MB]
-        int32 rows naming each prompt's allocated blocks (0 = trash).
+        lengths; pools = per-layer (k_pool, v_pool) — or, for
+        ``cache_dtype="int8"``, (k_codes, k_scale, v_codes, v_scale) —
+        from `inference.kv_cache.BlockPool.make_pools()`; block_tables
+        [n, MB] int32 rows naming each prompt's allocated blocks
+        (0 = trash).
 
         Writes every prompt's K/V into its blocks and returns
         ``(pools', first_token [n] int32)`` — the pools are DONATED
@@ -1109,7 +1230,14 @@ class GPTForCausalLM(Layer):
         syncs. One executable serves any prompt lengths <= P_cap: the
         table/lens vectors are data inputs, and the serving engine uses a
         fixed n (1 per spliced admission) so steady-state traffic adds
-        zero compilations."""
+        zero compilations.
+
+        `start` [n] int32 (prefix cache, ISSUE 10) switches to SUFFIX
+        prefill: input_ids then holds only the yet-uncached suffix
+        (right-padded; prompt_lens = suffix lengths), row positions run
+        start[b] + i, and attention covers the pool — the cached prefix
+        blocks mapped into the row's table plus the suffix itself. Still
+        one executable for any (start, suffix) mix: both are data."""
         import jax
         from ..jit.api import _swap_params, _trace_guard
         from ..core import autograd
@@ -1124,25 +1252,35 @@ class GPTForCausalLM(Layer):
         if tables.shape[0] != b:
             raise ValueError(f"prefill_paged: block_tables rows "
                              f"({tables.shape[0]}) != batch ({b})")
+        ofs = start is not None
+        start_arr = None if not ofs else jnp.asarray(
+            start._data if isinstance(start, Tensor) else start, jnp.int32)
         params = list(self.parameters())
         cdt = self.gpt.wte.weight._data.dtype
-        _check_pool_dtype(pools, cdt)
+        c8 = _check_pool_dtype(pools, cdt, cache_dtype)
+        tag = "paged8" if c8 else "paged"
         q8 = weight_dtype == "int8"
         qmap = self._decode_quantized_params() if q8 else {}
         expand = self._make_expand(q8, cdt)
 
-        def run(pa, pools, prompt, lens, tbl, key0):
+        def run(pa, pools, prompt, lens, tbl, key0, st=None):
             ex, pays = expand(pa)
             with _trace_guard(), _swap_params(params, ex), \
                     _q8_bind(params, pays), autograd.no_grad():
-                caches = [("paged", Tensor(kp), Tensor(vp), Tensor(tbl),
-                           Tensor(lens)) for kp, vp in pools]
+                tail = () if st is None else (Tensor(st),)
+                caches = [(tag,) + tuple(Tensor(p) for p in layer) +
+                          (Tensor(tbl), Tensor(lens)) + tail
+                          for layer in pools]
                 pos0 = jnp.broadcast_to(
                     jnp.arange(p_cap, dtype=jnp.int32)[None], (b, p_cap))
+                if st is not None:
+                    pos0 = pos0 + st.astype(jnp.int32)[:, None]
                 logits, nc = self.forward(
                     Tensor(prompt), position_ids=Tensor(pos0),
                     caches=caches)
-            new_pools = [(c[1]._data, c[2]._data) for c in nc]
+            n_pool = 4 if c8 else 2
+            new_pools = [tuple(e._data for e in c[1:1 + n_pool])
+                         for c in nc]
             last = logits._data[jnp.arange(b), lens - 1].astype(jnp.float32)
             key0, k1 = jax.random.split(key0)
             nxt = sample_logits(last, k1, temperature=temperature,
@@ -1152,20 +1290,23 @@ class GPTForCausalLM(Layer):
         nb, bs = pools[0][0].shape[0], pools[0][0].shape[1]
         sig = ("paged_prefill", b, p_cap, nb, bs, int(tables.shape[1]),
                float(temperature), int(top_k), float(top_p), str(cdt),
-               "q8" if q8 else "full")
+               "q8" if q8 else "full", "c8" if c8 else "fp",
+               "ofs" if ofs else "abs")
         fn = self._gen_cache_get(
             sig, lambda: jax.jit(run, donate_argnums=(1,)))
         payload = tuple(qmap[i] if i in qmap else p._data
                         for i, p in enumerate(params)) if q8 else \
             tuple(p._data for p in params)
-        pools2, nxt = fn(payload, pools, ids._data, lens_arr, tables,
-                         jax.random.PRNGKey(seed))
+        args = (payload, pools, ids._data, lens_arr, tables,
+                jax.random.PRNGKey(seed))
+        pools2, nxt = fn(*args, start_arr) if ofs else fn(*args)
         return pools2, Tensor(nxt)
 
     def decode_paged(self, pools, block_tables, lens, pending, done,
                      max_new_tokens: int, temperature: float = 0.0,
                      top_k: int = 0, top_p: float = 1.0, seed: int = 0,
-                     eos_token_id: int = None, weight_dtype: str = None):
+                     eos_token_id: int = None, weight_dtype: str = None,
+                     cache_dtype: str = None):
         """One compiled chunk of ragged decode against the paged pool.
 
         Feeds `pending` (each row's last sampled-but-unwritten token,
@@ -1207,7 +1348,9 @@ class GPTForCausalLM(Layer):
             done._data if isinstance(done, Tensor) else done, bool)
         params = list(self.parameters())
         cdt = self.gpt.wte.weight._data.dtype
-        _check_pool_dtype(pools, cdt)
+        c8 = _check_pool_dtype(pools, cdt, cache_dtype)
+        tag = "paged8" if c8 else "paged"
+        n_pool = 4 if c8 else 2
         q8 = weight_dtype == "int8"
         qmap = self._decode_quantized_params() if q8 else {}
         expand = self._make_expand(q8, cdt)
@@ -1221,14 +1364,15 @@ class GPTForCausalLM(Layer):
                 ex, pays = expand(pa)
                 with _trace_guard(), _swap_params(params, ex), \
                         _q8_bind(params, pays), autograd.no_grad():
-                    caches = [("paged", Tensor(kp), Tensor(vp),
-                               Tensor(tbl), Tensor(ln))
-                              for kp, vp in pools]
+                    caches = [(tag,) + tuple(Tensor(p) for p in layer) +
+                              (Tensor(tbl), Tensor(ln))
+                              for layer in pools]
                     logits, nc = self.forward(
                         Tensor(tokens), position_ids=Tensor(ln[:, None]),
                         caches=caches)
                 return (logits._data,
-                        [(c[1]._data, c[2]._data) for c in nc])
+                        [tuple(e._data for e in c[1:1 + n_pool])
+                         for c in nc])
 
             def body(carry, _):
                 pools, ln, cur, key, dn = carry
@@ -1254,7 +1398,7 @@ class GPTForCausalLM(Layer):
                int(max_new_tokens), float(temperature), int(top_k),
                float(top_p),
                None if eos_token_id is None else int(eos_token_id),
-               str(cdt), "q8" if q8 else "full")
+               str(cdt), "q8" if q8 else "full", "c8" if c8 else "fp")
         fn = self._gen_cache_get(
             sig, lambda: jax.jit(run, donate_argnums=(1,)))
         payload = tuple(qmap[i] if i in qmap else p._data

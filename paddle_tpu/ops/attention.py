@@ -261,20 +261,33 @@ def paged_cache_write(pool, new, tables, lens):
     return flat.reshape(pool.shape)
 
 
-def paged_prefill_write(pool, new, tables):
+def paged_prefill_write(pool, new, tables, start=None):
     """Write a whole (right-padded) prompt's K/V rows into pool blocks.
 
     new [B, S, H, D] holds the PADDED prompt projection; position p of row
     b goes to block tables[b, p//bs], offset p%bs. Padding columns beyond a
     row's allocated blocks hit table entries of 0 — the trash block — and
     padding columns inside the row's own reservation are plain garbage the
-    attention masks exclude until decode overwrites them."""
+    attention masks exclude until decode overwrites them.
+
+    `start` [B] int32 (prefix-cache suffix prefill, ISSUE 10) offsets row
+    b's positions to start[b] + p — the suffix lands after the shared
+    cached prefix. Padding positions past the TABLE WIDTH are routed to
+    the trash block explicitly (clipping them into the last table entry
+    would let a garbage pad column share a destination row with a real
+    suffix column and scatter-order would decide who wins); positions
+    can never reach the shared prefix blocks (start + p >= start >= the
+    prefix end for all written columns)."""
     nb, bs = pool.shape[0], pool.shape[1]
     b, s = new.shape[0], new.shape[1]
     pos = jnp.arange(s, dtype=jnp.int32)[None, :]
+    if start is not None:
+        pos = pos + start.astype(jnp.int32)[:, None]
+    slot = pos // bs
     bidx = jnp.take_along_axis(tables.astype(jnp.int32),
-                               jnp.broadcast_to(pos // bs, (b, s)),
+                               jnp.broadcast_to(slot, (b, s)),
                                axis=1, mode="clip")
+    bidx = jnp.where(slot >= tables.shape[1], 0, bidx)  # trash, not clip
     dest = (bidx * bs + pos % bs).reshape(-1)
     flat = pool.reshape((nb * bs,) + pool.shape[2:])
     flat = flat.at[dest].set(
@@ -316,6 +329,113 @@ def paged_attention_reference(q, k_pool, v_pool, tables, lens, *,
     mask = col < lens.astype(jnp.int32)[:, None, None, None]
     return attention_reference(q, k, v, mask=mask, scale=scale,
                                score_dtype=score_dtype)
+
+
+def _paged_gather(pool, tables):
+    """Gather a row's blocks into a contiguous [B, MB*bs, ...] view —
+    the XLA-visible reference form shared by every paged attention
+    reference below (the Pallas kernels walk the table instead)."""
+    nb, bs = pool.shape[0], pool.shape[1]
+    b, mb = tables.shape
+    t = tables.astype(jnp.int32)
+    return jnp.take(pool, t, axis=0).reshape((b, mb * bs) + pool.shape[2:])
+
+
+def paged_prefix_mask(s, width, start):
+    """[B, 1, S, width] keep-mask for SUFFIX prefill over a paged pool
+    (prefix cache, ISSUE 10): query row i sits at global position
+    start[b] + i and sees pool columns <= its own position — causal over
+    the shared cached prefix plus the just-written suffix. Columns past
+    the causal frontier (garbage padding writes, unwritten decode rows)
+    are excluded by the same comparison."""
+    col = jnp.arange(width, dtype=jnp.int32)[None, None, None, :]
+    row = jnp.arange(s, dtype=jnp.int32)[None, None, :, None]
+    return col <= (start.astype(jnp.int32)[:, None, None, None] + row)
+
+
+def paged_prefix_attention_reference(q, k_pool, v_pool, tables, start, *,
+                                     scale=None, score_dtype=None):
+    """Suffix-prefill attention over a paged pool: q [B, S, H, D] holds
+    the (right-padded) SUFFIX tokens at global positions start[b] + i;
+    K/V for both the cached prefix and the suffix live in the pool
+    already (prefix from the cache, suffix written by the caller).
+    Padded query rows (i >= the row's suffix length) produce garbage the
+    caller drops — same contract as paged_prefill_mask prefill."""
+    k = _paged_gather(k_pool, tables)
+    v = _paged_gather(v_pool, tables)
+    mask = paged_prefix_mask(q.shape[1], k.shape[1], start)
+    return attention_reference(q, k, v, mask=mask, scale=scale,
+                               score_dtype=score_dtype)
+
+
+# ------------------------------------------ int8 paged KV cache (serving)
+# The static int8-KV trick (quantize_kv / attention_q8_cache: int8 codes +
+# per-(position, head) f32 scales that FACTOR OUT of both contractions)
+# ported to the paged pool (ISSUE 10): code pools are int8
+# [NB, bs, H, D], scale pools f32 [NB, bs, H] — per-block factored
+# scales, one scale row per pool row. Same pool holds ~2x the resident
+# tokens; same write/gather plumbing as the fp paged path.
+
+def paged_cache_write_q8(codes_pool, scale_pool, new, tables, lens):
+    """Quantize one decode-step row per batch entry and scatter codes +
+    scales into the pools (the int8 form of paged_cache_write)."""
+    codes, scale = quantize_kv(new)
+    return (paged_cache_write(codes_pool, codes, tables, lens),
+            paged_cache_write(scale_pool, scale, tables, lens))
+
+
+def paged_prefill_write_q8(codes_pool, scale_pool, new, tables,
+                           start=None):
+    """Quantize a (padded) prompt/suffix projection and bulk-write codes
+    + scales into pool blocks (the int8 form of paged_prefill_write)."""
+    codes, scale = quantize_kv(new)
+    return (paged_prefill_write(codes_pool, codes, tables, start),
+            paged_prefill_write(scale_pool, scale, tables, start))
+
+
+def paged_attention_reference_q8(q, kc_pool, ks_pool, vc_pool, vs_pool,
+                                 tables, lens):
+    """Single-token decode attention over int8 paged pools — gathers
+    codes + scales per row and defers to `attention_q8_cache`, so the
+    numerics class is EXACTLY the static int8-KV path's (the parity
+    oracle the tests pin). CPU/tier-1 path of paged_attention_q8."""
+    if q.shape[1] != 1:
+        raise ValueError(f"paged_attention_reference_q8 serves "
+                         f"single-token decode; got q seq len {q.shape[1]}")
+    kc = _paged_gather(kc_pool, tables)
+    ks = _paged_gather(ks_pool, tables)
+    vc = _paged_gather(vc_pool, tables)
+    vs = _paged_gather(vs_pool, tables)
+    col = jnp.arange(kc.shape[1], dtype=jnp.int32)[None, None, None, :]
+    mask = col < lens.astype(jnp.int32)[:, None, None, None]
+    return attention_q8_cache(q, kc, ks, vc, vs, mask)
+
+
+def paged_prefix_attention_reference_q8(q, kc_pool, ks_pool, vc_pool,
+                                        vs_pool, tables, start):
+    """Suffix-prefill attention over int8 paged pools: the q8 form of
+    paged_prefix_attention_reference (same causal-over-global-positions
+    mask, factored-scale contraction math)."""
+    kc = _paged_gather(kc_pool, tables)
+    ks = _paged_gather(ks_pool, tables)
+    vc = _paged_gather(vc_pool, tables)
+    vs = _paged_gather(vs_pool, tables)
+    mask = paged_prefix_mask(q.shape[1], kc.shape[1], start)
+    return attention_q8_cache(q, kc, ks, vc, vs, mask)
+
+
+def paged_attention_q8(q, kc_pool, ks_pool, vc_pool, vs_pool, tables,
+                       lens):
+    """int8 ragged paged decode attention: Pallas kernel on TPU (codes
+    stream as int8 bytes, scales multiply the tiny per-block score
+    column), jnp gather reference elsewhere — routed exactly like
+    paged_attention."""
+    if _use_paged_kernel():
+        from .pallas.paged_attention import paged_attention_q8_kernel
+        return paged_attention_q8_kernel(q, kc_pool, ks_pool, vc_pool,
+                                         vs_pool, tables, lens)
+    return paged_attention_reference_q8(q, kc_pool, ks_pool, vc_pool,
+                                        vs_pool, tables, lens)
 
 
 def _use_paged_kernel():

@@ -95,6 +95,102 @@ def _kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_sc[...] / l).astype(o_ref.dtype)
 
 
+def _kernel_q8(tables_ref, lens_ref, q_ref, kc_ref, ks_ref, vc_ref,
+               vs_ref, o_ref, m_sc, l_sc, acc_sc, *, scale, nh, bs,
+               n_slots):
+    """int8 paged decode attention (ISSUE 10): the pools carry int8
+    codes + per-(row, head) f32 factored scales. Same online-softmax
+    skeleton as `_kernel`; the static int8-KV trick applies per block —
+    the scale is constant over head_dim, so it factors OUT of both
+    contractions: codes stream as bare int8->f32 converts and the scale
+    multiplies land on the [bs, 1] score / prob columns."""
+    b, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    ln = lens_ref[b]
+
+    @pl.when(j * bs < ln)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)            # [nh, hd]
+        kc = kc_ref[0].astype(jnp.float32)          # [bs, nh, hd] codes
+        ks = ks_ref[0]                              # [bs, nh] f32 scales
+        vc = vc_ref[0].astype(jnp.float32)
+        vs = vs_ref[0]
+        col = j * bs + lax.broadcasted_iota(jnp.int32, (bs, 1), 0)
+        keep = col < ln
+        for h in range(nh):
+            s = jnp.sum(kc[:, h, :] * q[h:h + 1, :], axis=-1,
+                        keepdims=True) * (ks[:, h:h + 1] * scale)
+            s = jnp.where(keep, s, jnp.asarray(_NEG, s.dtype))
+            m_prev = m_sc[h:h + 1, :]
+            l_prev = l_sc[h:h + 1, :]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=0, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            m_sc[h:h + 1, :] = m_new
+            l_sc[h:h + 1, :] = corr * l_prev + jnp.sum(p, axis=0,
+                                                       keepdims=True)
+            acc_sc[h:h + 1, :] = corr * acc_sc[h:h + 1, :] + jnp.sum(
+                (p * vs[:, h:h + 1]) * vc[:, h, :], axis=0, keepdims=True)
+
+    @pl.when(j == n_slots - 1)
+    def _finish():
+        l = jnp.maximum(l_sc[...], 1e-30)
+        o_ref[0] = (acc_sc[...] / l).astype(o_ref.dtype)
+
+
+def paged_attention_q8_kernel(q, kc_pool, ks_pool, vc_pool, vs_pool,
+                              tables, lens, *, scale=None,
+                              interpret=False):
+    """q [B, 1, H, D] (or [B, H, D]); code pools int8 [NB, bs, H, D];
+    scale pools f32 [NB, bs, H]; tables [B, MB] i32; lens [B]. Returns
+    the same layout/dtype as q."""
+    squeezed = q.ndim == 4
+    if squeezed:
+        if q.shape[1] != 1:
+            raise ValueError(f"paged decode kernel serves one token per "
+                             f"row; got q seq len {q.shape[1]}")
+        q3 = q[:, 0]
+    else:
+        q3 = q
+    b, nh, hd = q3.shape
+    nb, bs = kc_pool.shape[0], kc_pool.shape[1]
+    mb = tables.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+
+    pool_spec = pl.BlockSpec((1, bs, nh, hd),
+                             lambda bi, j, T, L: (T[bi, j], 0, 0, 0))
+    scale_spec = pl.BlockSpec((1, bs, nh),
+                              lambda bi, j, T, L: (T[bi, j], 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((1, nh, hd), lambda bi, j, T, L: (bi, 0, 0)),
+            pool_spec, scale_spec, pool_spec, scale_spec,
+        ],
+        out_specs=pl.BlockSpec((1, nh, hd), lambda bi, j, T, L: (bi, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((nh, 1), jnp.float32),
+                        pltpu.VMEM((nh, 1), jnp.float32),
+                        pltpu.VMEM((nh, hd), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel_q8, scale=scale, nh=nh, bs=bs,
+                          n_slots=mb),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nh, hd), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), lens.astype(jnp.int32), q3,
+      kc_pool, ks_pool, vc_pool, vs_pool)
+    return out[:, None] if squeezed else out
+
+
 def paged_attention_kernel(q, k_pool, v_pool, tables, lens, *, scale=None,
                            interpret=False):
     """q [B, 1, H, D] (or [B, H, D]); pools [NB, bs, H, D]; tables

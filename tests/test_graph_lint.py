@@ -505,19 +505,23 @@ def test_serving_lint_audits_late_built_executables():
 
 
 def test_paged_cache_dtype_config_finding():
-    """ISSUE 6 satellite: the paged+int8-KV rejection is a structured
-    config-validation finding (same schema as the lint), still a
-    ValueError for existing callers, and says WHY + what to do."""
+    """ISSUE 6 satellite, updated by ISSUE 10: int8+paged now SERVES
+    (the paged int8 pool landed); a cache dtype the paged engine still
+    cannot hold keeps the structured config-validation finding (same
+    schema as the lint), still a ValueError for existing callers, and
+    says WHY + what to do."""
     from paddle_tpu.inference import ServingConfig
+    cfg = ServingConfig(paged=True, cache_dtype="int8")
+    assert cfg.cache_dtype == "int8"       # the ISSUE-10 mode
     with pytest.raises(ConfigValidationError) as ei:
-        ServingConfig(paged=True, cache_dtype="int8")
+        ServingConfig(paged=True, cache_dtype="float16")
     assert isinstance(ei.value, ValueError)
     f = ei.value.finding
     assert f.pass_name == "config"
     assert f.code == "paged_cache_dtype"
     assert "model dtype" in f.message.lower()
     assert "paged=False" in f.message      # the actionable way out
-    assert f.data == {"cache_dtype": "int8", "paged": True}
+    assert f.data == {"cache_dtype": "float16", "paged": True}
 
 
 def test_lint_capture_records_serving_executables():

@@ -10,6 +10,10 @@ Targets (--all = every one):
   gpt-paged    the paged engine's {prefill_paged, decode_paged} pair —
                donated block pools cross-checked against the lowered
                modules' input_output_alias tables
+  gpt-paged-int8  the int8 paged engine WITH the prefix cache: the int8
+               {prefill, decode} pair plus the suffix-prefill and COW
+               executables (warmup traffic repeats + diverges a prompt
+               so every admission path lowers)
   train-step   TrainStep(gpt) — traced abstractly (never executed):
                host-transfer / dtype / baked-const / donation over the
                fused fwd+bwd+optimizer step
@@ -36,7 +40,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-TARGETS = ("gpt-static", "gpt-paged", "train-step", "resnet50")
+TARGETS = ("gpt-static", "gpt-paged", "gpt-paged-int8", "train-step",
+           "resnet50")
 
 
 def _tiny_gpt(dtype="bfloat16"):
@@ -51,20 +56,31 @@ def _tiny_gpt(dtype="bfloat16"):
     return model, cfg
 
 
-def audit_gpt_engine(lint, *, paged: bool):
+def audit_gpt_engine(lint, *, paged: bool, int8: bool = False,
+                     prefix: bool = False):
     """Serve one warmup batch through the real engine with lint enabled;
-    the engine captures + audits its executables itself."""
+    the engine captures + audits its executables itself. With `prefix`
+    the traffic repeats a block-aligned prompt (COW executable) and
+    diverges from it mid-prefix (suffix-prefill executable), so the
+    whole prefix-cache executable set lowers and is audited."""
     import numpy as np
     from paddle_tpu.inference import ServingConfig, ServingEngine
     model, _ = _tiny_gpt()
     cfg = ServingConfig(max_batch=2, prompt_cap=8, max_new_tokens=6,
                         decode_chunk=2, eos_token_id=None, paged=paged,
-                        kv_block=4, lint=lint)
+                        kv_block=4, lint=lint,
+                        cache_dtype="int8" if int8 else None,
+                        prefix_cache=prefix,
+                        kv_blocks=33 if prefix else None)
     eng = ServingEngine(model, cfg)
     rng = np.random.RandomState(0)
     eng.submit(rng.randint(1, 100, (5,)))
     eng.submit(rng.randint(1, 100, (8,)))
     eng.drain()
+    if prefix:
+        # the shared warmup choreography: aligned miss + COW repeat +
+        # mid-prefix divergence, so every admission executable lowers
+        eng.warmup_prefix_cache(100, clear=False)
     return eng.lint_findings
 
 
@@ -164,6 +180,8 @@ def main(argv=None) -> int:
     runners = {
         "gpt-static": lambda: audit_gpt_engine(lint, paged=False),
         "gpt-paged": lambda: audit_gpt_engine(lint, paged=True),
+        "gpt-paged-int8": lambda: audit_gpt_engine(lint, paged=True,
+                                                   int8=True, prefix=True),
         "train-step": lambda: audit_train_step(lint),
         "resnet50": lambda: audit_resnet50(lint,
                                            train=args.vision_train),

@@ -47,6 +47,7 @@ HOT_GLOBS = (
     "paddle_tpu/models/gpt_stacked.py",
     "paddle_tpu/inference/serving.py",
     "paddle_tpu/inference/kv_cache.py",
+    "paddle_tpu/inference/prefix_cache.py",
     "paddle_tpu/jit/api.py",
     "paddle_tpu/jit/train_step.py",
     "paddle_tpu/ops/attention.py",

@@ -20,10 +20,19 @@ whatever is queued — exactly the accounting a load balancer would see.
 Paged serving (ISSUE 5): ``--paged`` runs the block-pool engine
 (slot-level continuous batching, mid-flight admission); ``--compare``
 replays the SAME traffic through both engines and prints the
-padded-vs-paged table (tok/s, p99 TTFT, true KV occupancy).
-``--length-dist longtail`` draws Pareto-shaped prompt lengths — the
-mostly-short-with-heavy-tail mix where right-padding wastes the most HBM
-and paging shows its gap.
+padded-vs-paged table (tok/s, p99 TTFT, true KV occupancy) — int8 KV
+(``--int8-cache``) now runs on BOTH legs (the paged int8 pool landed in
+ISSUE 10; only non-int8 narrow dtypes still refuse with a structured
+finding). ``--length-dist longtail`` draws Pareto-shaped prompt lengths
+— the mostly-short-with-heavy-tail mix where right-padding wastes the
+most HBM and paging shows its gap.
+
+Prefix cache (ISSUE 10): ``--shared-prefix N`` switches the workload to
+N fixed system prompts (``--prefix-len`` tokens each) x Poisson-arriving
+random suffixes, and replays it through the paged engine with the prefix
+cache OFF and ON — printing hit rate, prefill-tokens-saved and the
+TTFT-with/without-cache table. ``--prefix-cache`` alone enables the
+cache on a plain ``--paged`` run.
 
 Without --preset a 2-layer toy GPT runs on CPU (CI-sized); with a preset
 set PADDLE_TPU_EXAMPLE_TPU=1 to run real-chip sizes.
@@ -61,42 +70,58 @@ def build_model(preset):
     return model, cfg
 
 
-def _serving_config(args, paged):
+def _serving_config(args, paged, prefix_cache=False):
     from paddle_tpu.inference import ServingConfig
-    # --compare drops int8 KV on its paged LEG by design (the comparison
-    # is padded-int8 vs paged-fp); an EXPLICIT --paged --int8-cache run
-    # flows into ServingConfig as asked and gets the structured
-    # config-validation finding explaining why it cannot be served
-    int8_kv = args.int8_cache and not (paged and args.compare)
+    # int8 KV runs on BOTH --compare legs now (the paged int8 pool landed
+    # in ISSUE 10); a cache dtype the paged engine still cannot serve gets
+    # the structured config-validation finding explaining why
     return ServingConfig(max_batch=args.batch, prompt_cap=args.prompt_cap,
                          max_new_tokens=args.new,
                          decode_chunk=args.decode_chunk,
                          queue_capacity=args.queue_capacity,
                          eos_token_id=args.eos,
                          weight_dtype="int8" if args.int8_weights else None,
-                         cache_dtype="int8" if int8_kv else None,
+                         cache_dtype="int8" if args.int8_cache else None,
                          paged=paged, kv_block=args.kv_block,
-                         kv_blocks=args.kv_blocks)
+                         kv_blocks=args.kv_blocks,
+                         prefix_cache=prefix_cache,
+                         prefix_cache_bytes=args.prefix_cache_bytes)
 
 
-def run_engine(model, cfg, args, *, paged):
+def _make_traffic(args, cfg, *, n, rate, seed):
+    from paddle_tpu.inference import (shared_prefix_traffic,
+                                      synthetic_traffic)
+    if args.shared_prefix:
+        return shared_prefix_traffic(
+            n, n_prefixes=args.shared_prefix, prefix_len=args.prefix_len,
+            prompt_cap=args.prompt_cap, vocab_size=cfg.vocab_size,
+            rate=rate, seed=seed)
+    return synthetic_traffic(n, prompt_cap=args.prompt_cap,
+                             vocab_size=cfg.vocab_size, rate=rate,
+                             seed=seed, length_dist=args.length_dist)
+
+
+def run_engine(model, cfg, args, *, paged, prefix_cache=False):
     """Replay the workload through one engine; returns (report, engine)."""
-    from paddle_tpu.inference import ServingEngine, synthetic_traffic
-    engine = ServingEngine(model, _serving_config(args, paged))
+    from paddle_tpu.inference import ServingEngine
+    engine = ServingEngine(model,
+                           _serving_config(args, paged, prefix_cache))
 
     # warmup batch: compiles the (prefill + chunk) executables once, so the
-    # measured replay is the steady state a long-lived server sits in
-    warm = synthetic_traffic(args.batch, prompt_cap=args.prompt_cap,
-                             vocab_size=cfg.vocab_size, rate=1e9, seed=1)
+    # measured replay is the steady state a long-lived server sits in.
+    # With the prefix cache the warmup must also touch the suffix-prefill
+    # and COW executables — engine.warmup_prefix_cache runs the whole
+    # choreography and drops its cached prefixes so the replay starts cold.
+    warm = _make_traffic(args, cfg, n=max(args.batch, 2), rate=1e9, seed=1)
     for item in warm:
         engine.submit(item["prompt"])
     engine.drain()
+    if prefix_cache:
+        engine.warmup_prefix_cache(cfg.vocab_size)
     engine.metrics = type(engine.metrics)()     # fresh aggregates
 
-    traffic = synthetic_traffic(args.requests, prompt_cap=args.prompt_cap,
-                                vocab_size=cfg.vocab_size, rate=args.rate,
-                                seed=args.seed,
-                                length_dist=args.length_dist)
+    traffic = _make_traffic(args, cfg, n=args.requests, rate=args.rate,
+                            seed=args.seed)
     t0 = engine.clock()
     finished = []
     peak_kv = 0.0
@@ -144,7 +169,10 @@ def run_engine(model, cfg, args, *, paged):
         "e2e_attainment": attainment(e2es, args.slo_e2e_ms),
     }
     s = engine.summary()
-    out = {"mode": "paged" if paged else "padded",
+    mode = "paged" if paged else "padded"
+    if prefix_cache:
+        mode += "+prefix"
+    out = {"mode": mode,
            "preset": args.preset or "toy", "requests": args.requests,
            "rate_req_s": args.rate, "length_dist": args.length_dist,
            "wall_s": round(wall, 3),
@@ -153,6 +181,14 @@ def run_engine(model, cfg, args, *, paged):
            if wall > 0 else None,
            "kv_occupancy_peak": round(peak_kv, 4),
            "slo": slo, "serving": s}
+    if paged and args.shared_prefix:
+        hits, misses = s["prefix_hit_total"], s["prefix_miss_total"]
+        out["prefix"] = {
+            "enabled": prefix_cache,
+            "hits": hits, "misses": misses,
+            "hit_rate": round(hits / max(hits + misses, 1), 4),
+            "prefill_tokens_saved": s["prefill_tokens_saved_total"],
+        }
     # the recompiles counter is a pure churn signal: refused requests log
     # their shape delta without feeding it (record_compile count=False)
     out["steady_recompiles"] = engine.monitor.recompiles
@@ -161,13 +197,21 @@ def run_engine(model, cfg, args, *, paged):
 
 def run_bench(args):
     """Returns ([report, ...], engine_of_last_run) — one report per engine
-    mode (two under --compare)."""
+    mode (two under --compare / --shared-prefix)."""
     model, cfg = build_model(args.preset)
-    modes = [False, True] if args.compare else [args.paged]
+    if args.shared_prefix:
+        # the prefix-cache A/B: same system-prompt traffic, paged engine,
+        # cache off then on
+        modes = [(True, False), (True, True)]
+    elif args.compare:
+        modes = [(False, False), (True, args.prefix_cache)]
+    else:
+        modes = [(args.paged, args.prefix_cache)]
     reports = []
     engine = None
-    for paged in modes:
-        rep, engine = run_engine(model, cfg, args, paged=paged)
+    for paged, prefix in modes:
+        rep, engine = run_engine(model, cfg, args, paged=paged,
+                                 prefix_cache=prefix)
         reports.append(rep)
     return reports, engine
 
@@ -196,7 +240,29 @@ def _print_report(out):
               f"{slo['ttft_attainment'] * 100:.1f}%   "
               f"e2e<= {slo['e2e_ms']:.0f}ms "
               f"{slo['e2e_attainment'] * 100:.1f}%")
+    pre = out.get("prefix")
+    if pre:
+        print(f"  prefix cache {'on ' if pre['enabled'] else 'off'}: "
+              f"hit rate {pre['hit_rate'] * 100:.1f}% "
+              f"({pre['hits']}/{pre['hits'] + pre['misses']})   "
+              f"prefill tokens saved {pre['prefill_tokens_saved']}")
     print(f"  steady-state recompiles: {out['steady_recompiles']}")
+
+
+def _print_prefix_comparison(off, on):
+    def ttft(rep, q):
+        h = rep["serving"].get("ttft_seconds")
+        return f"{h[q] * 1e3:10.2f}" if h else "       n/a"
+
+    print("\nprefix cache off vs on (same shared-prefix traffic):")
+    print(f"  {'mode':<14} {'tok/s':>10} {'p50 TTFT ms':>12} "
+          f"{'p99 TTFT ms':>12} {'hit rate':>9} {'saved tok':>10}")
+    for rep in (off, on):
+        pre = rep["prefix"]
+        print(f"  {rep['mode']:<14} {str(rep['throughput_tok_s']):>10} "
+              f"{ttft(rep, 'p50'):>12} {ttft(rep, 'p99'):>12} "
+              f"{pre['hit_rate'] * 100:>8.1f}% "
+              f"{pre['prefill_tokens_saved']:>10}")
 
 
 def _print_comparison(padded, paged):
@@ -231,7 +297,8 @@ def main(argv=None) -> int:
     ap.add_argument("--eos", type=int, default=None)
     ap.add_argument("--int8-weights", action="store_true")
     ap.add_argument("--int8-cache", action="store_true",
-                    help="int8 KV cache (padded engine only)")
+                    help="int8 KV cache (padded engine AND the paged "
+                         "int8 pool)")
     ap.add_argument("--paged", action="store_true",
                     help="block-pool KV + slot-level continuous batching")
     ap.add_argument("--kv-block", type=int, default=16,
@@ -239,6 +306,17 @@ def main(argv=None) -> int:
     ap.add_argument("--kv-blocks", type=int, default=None,
                     help="total pool blocks incl. trash (paged; default "
                          "= worst case for the batch)")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="radix-trie prefix cache over the paged pool")
+    ap.add_argument("--prefix-cache-bytes", type=int, default=None,
+                    help="LRU byte budget for cached prefixes")
+    ap.add_argument("--shared-prefix", type=int, default=0, metavar="N",
+                    help="replay N system prompts x Poisson suffixes "
+                         "through the paged engine with the prefix cache "
+                         "off AND on; prints hit rate + TTFT table")
+    ap.add_argument("--prefix-len", type=int, default=None,
+                    help="system-prompt length for --shared-prefix "
+                         "(default: half the prompt cap)")
     ap.add_argument("--length-dist", choices=("uniform", "longtail"),
                     default="uniform",
                     help="prompt-length mix; longtail = Pareto-shaped "
@@ -254,6 +332,8 @@ def main(argv=None) -> int:
                     help="also dump the Prometheus /metrics payload "
                          "(last engine run)")
     args = ap.parse_args(argv)
+    if args.prefix_len is None:
+        args.prefix_len = max(1, args.prompt_cap // 2)
 
     try:
         reports, engine = run_bench(args)
@@ -273,7 +353,9 @@ def main(argv=None) -> int:
     else:
         for rep in reports:
             _print_report(rep)
-        if len(reports) == 2:
+        if len(reports) == 2 and args.shared_prefix:
+            _print_prefix_comparison(reports[0], reports[1])
+        elif len(reports) == 2:
             _print_comparison(reports[0], reports[1])
     if args.metrics:
         print(engine.metrics_text(), end="")
