@@ -337,6 +337,7 @@ def _get_phi_kernel_name(op_name: str) -> str:
 # frontends; see inference/serving.py) ----
 from .serving import (ServingEngine, ServingConfig, ServingMetrics,  # noqa: E402,F401
                       Request, RequestTrace, synthetic_traffic,
-                      shared_prefix_traffic)
+                      shared_prefix_traffic, repeated_traffic,
+                      model_draft_fn)
 from .kv_cache import BlockPool  # noqa: E402,F401
 from .prefix_cache import PrefixCache  # noqa: E402,F401

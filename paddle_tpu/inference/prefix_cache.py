@@ -108,6 +108,45 @@ class PrefixCache:
             node = child
         return blocks, len(blocks) * self.pool.block_size
 
+    def lookup_continuation(self, tokens, n: int):
+        """Prompt-lookup drafting (ISSUE 11): the next up-to-``n`` tokens
+        the trie remembers AFTER the prefix ``tokens``.
+
+        Walks the full blocks of ``tokens`` exactly like :meth:`match`,
+        then follows children whose keys extend the partial tail — a
+        matched node's cached token key IS the continuation, so repeated
+        / agentic traffic (identical prompts, retries, multi-turn
+        histories) drafts its own future from what earlier requests
+        already computed, with no draft model at all. Returns a list of
+        ints (possibly empty; shorter than ``n`` when the cached path
+        runs out). Read-only: does NOT stamp the LRU clock — peeking for
+        a draft must not pin a prefix resident the way serving KV from
+        it does. When several cached paths extend the same tail the
+        first child wins (dict insertion order — deterministic within a
+        process); a wrong guess costs one rejected draft token, nothing
+        more."""
+        bs = self.pool.block_size
+        node = self._root
+        n_full = int(len(tokens)) // bs
+        for i in range(n_full):
+            child = node.children.get(self._key(tokens, i))
+            if child is None:
+                return []             # history diverged from every cache
+            node = child
+        tail = tuple(int(t) for t in tokens[n_full * bs:])
+        out: List[int] = []
+        while len(out) < n:
+            nxt = None
+            for key, child in node.children.items():
+                if key[:len(tail)] == tail:
+                    out.extend(key[len(tail):])
+                    nxt = child
+                    break
+            if nxt is None:
+                break
+            node, tail = nxt, ()
+        return out[:n]
+
     # ----------------------------------------------------------- insert
     def insert(self, tokens, blocks) -> int:
         """Cache the full-block prefix of `tokens`, whose K/V already

@@ -75,6 +75,24 @@ trick ported to the paged kernel), holding ~2x the resident requests.
 Greedy output stays bit-identical with the cache on vs off, and the
 steady loop still adds zero compilations — the suffix-prefill and COW
 executables are part of the warmup set.
+
+`ServingConfig(spec_decode=True)` (ISSUE 11) turns each decode step into
+a DRAFT-VERIFY window through the ragged [B, k] multi-token
+paged-attention kernel: a draft proposes `spec_k` tokens per row, the
+target model scores pending + drafts in ONE fixed-shape call
+(`model.verify_paged`), and the longest-accepted-prefix rule emits
+1..spec_k+1 tokens per launch with greedy output BIT-IDENTICAL to the
+plain chain. The default drafter is prompt-lookup from the prefix radix
+trie — a matched node's cached continuation tokens ARE the draft, and
+finished requests cache their generated chains too, so repeated /
+agentic traffic drafts its own future with no draft model at all
+(`spec_draft` also takes a callable; `model_draft_fn` adapts a tiny
+GPT). Rejected-position KV writes land below the next window's start
+(or in the trash block past a row's budget), so acceptance is data, not
+shape: one verify executable per window size, zero steady recompiles.
+`prefill_chunk=N` additionally caps per-step prefill work at [1, N]
+tokens through the same start-offset executable, so a cap-length prompt
+no longer monopolizes the engine for one monolithic prefill call.
 """
 from __future__ import annotations
 
@@ -158,6 +176,10 @@ class Request:
     deadline_s: Optional[float] = None      # max queue wait before admit
     tokens: Optional[np.ndarray] = None     # generated ids (done only)
     n_out: int = 0                          # tokens up to & incl. EOS
+    # speculative decoding (ISSUE 11): draft tokens proposed for this
+    # request across its verify windows, and how many the target accepted
+    spec_proposed: int = 0
+    spec_accepted: int = 0
     trace: RequestTrace = field(default_factory=RequestTrace)
 
     @property
@@ -173,6 +195,11 @@ class Request:
                "spans": t.to_dict()}
         if self.reason:
             rec["reason"] = self.reason
+        if self.spec_proposed:
+            rec["spec"] = {"proposed": self.spec_proposed,
+                           "accepted": self.spec_accepted,
+                           "accept_rate": round(
+                               self.spec_accepted / self.spec_proposed, 4)}
         for key, val in (("queue_s", t.queue_s), ("ttft_s", t.ttft_s),
                          ("tpot_s", t.tpot_s(self.n_out)),
                          ("e2e_s", t.e2e_s)):
@@ -198,7 +225,10 @@ class ServingMetrics:
     HISTS = (("ttft_seconds", "time to first token (enqueue -> token 1)"),
              ("tpot_seconds", "per-output-token time after the first"),
              ("e2e_seconds", "end-to-end request latency"),
-             ("queue_seconds", "queue wait (enqueue -> admit)"))
+             ("queue_seconds", "queue wait (enqueue -> admit)"),
+             ("spec_accept_len", "tokens emitted per speculative verify "
+                                 "window (accepted drafts + the bonus "
+                                 "token)"))
 
     def __init__(self, *, jsonl_path: Optional[str] = None,
                  on_record: Optional[Callable[[dict], None]] = None,
@@ -208,7 +238,13 @@ class ServingMetrics:
         self.on_record = on_record
         self.hists = {name: LogHistogram(lo=hist_lo, hi=hist_hi,
                                          per_decade=per_decade)
-                      for name, _ in self.HISTS}
+                      for name, _ in self.HISTS
+                      if name != "spec_accept_len"}
+        # the accept-length series (ISSUE 11) counts 1..spec_k+1 tokens,
+        # not latencies: half-integer bounds resolve every integer
+        # exactly, so the derived percentiles are exact, not interpolated
+        self.hists["spec_accept_len"] = LogHistogram(
+            bounds=[i + 0.5 for i in range(33)])
         self.counters = {"requests": 0, "completed": 0, "rejected": 0,
                          "overloaded": 0, "timeout": 0, "errors": 0,
                          "tokens_in": 0, "tokens_out": 0, "items": 0,
@@ -218,7 +254,13 @@ class ServingMetrics:
                          # and prompt tokens whose prefill was skipped
                          # because their KV was already pooled
                          "prefix_hit": 0, "prefix_miss": 0,
-                         "prefill_tokens_saved": 0}
+                         "prefill_tokens_saved": 0,
+                         # speculative decoding (ISSUE 11): draft tokens
+                         # proposed / accepted across verify windows, and
+                         # where each window's draft came from
+                         "spec_windows": 0, "spec_proposed": 0,
+                         "spec_accepted": 0, "spec_drafts_trie": 0,
+                         "spec_drafts_model": 0}
         self.gauges = {"queue_depth": 0, "inflight": 0,
                        "batch_fill_ratio": None, "kv_occupancy": None,
                        "kv_slots_occupancy": None,
@@ -343,7 +385,18 @@ class ServingMetrics:
                  "prefix_miss": "admissions that found no cached prefix",
                  "prefill_tokens_saved": "prompt tokens whose prefill "
                                          "was skipped (KV already "
-                                         "pooled)"}
+                                         "pooled)",
+                 "spec_windows": "speculative verify windows run "
+                                 "(drafted rows only)",
+                 "spec_proposed": "draft tokens proposed to the target "
+                                  "model",
+                 "spec_accepted": "draft tokens the target accepted "
+                                  "(longest matching prefix)",
+                 "spec_drafts_trie": "verify windows whose draft came "
+                                     "from the prefix-trie prompt "
+                                     "lookup",
+                 "spec_drafts_model": "verify windows whose draft came "
+                                      "from the draft-model hook"}
         for name, value in self.counters.items():
             lines.extend(counter_lines(prefix, f"{name}_total", value,
                                        helps[name]))
@@ -411,6 +464,34 @@ class ServingConfig:
     #                            cached (refcount-free) blocks; None =
     #                            bounded by the pool itself (admission
     #                            reclaims cached blocks under pressure)
+    # --- speculative decoding (ISSUE 11): draft-verify through the
+    # ragged [B, k] multi-token paged-attention kernel. Each decode step
+    # scores `spec_k` drafted tokens + the pending token in ONE
+    # fixed-shape verify call; the longest-accepted-prefix rule keeps
+    # greedy output bit-identical to the plain chain, and rows advance
+    # 1..spec_k+1 tokens per launch. Requires paged=True and greedy
+    # sampling (temperature 0 — acceptance IS argmax equality).
+    spec_decode: bool = False
+    spec_k: int = 4                 # draft tokens per verify window
+    # draft source: "trie" = prompt-lookup from the prefix radix trie (a
+    # matched node's cached continuation tokens ARE the draft — requires
+    # prefix_cache=True; finished requests' generated chains are cached
+    # too, so repeated/agentic traffic drafts its own future. NOTE
+    # drafts are BLOCK-granular: a finished chain contributes drafts
+    # only once its generated tokens fill at least one pool block past
+    # the prompt — keep kv_block below the typical generation length);
+    # or a callable (context_tokens: np.ndarray, k: int) -> up-to-k
+    # token ids (see `model_draft_fn` for the tiny-GPT adapter). A
+    # callable composes with the trie: the trie drafts when it can, the
+    # callable fills the misses.
+    spec_draft: object = "trie"
+    # --- chunked prefill (ISSUE 11 satellite): cap per-step prefill work
+    # at [1, prefill_chunk] tokens so one long prompt never monopolizes
+    # the engine for a whole prefill — offsets are DATA through the
+    # start-form prefill executable (zero new executables per prompt
+    # length). None = whole-prompt/suffix prefill at admission (the
+    # ISSUE-5/10 behavior).
+    prefill_chunk: Optional[int] = None
     # --- static analysis (ISSUE 6): True / "error" / analysis.GraphLint —
     # the engine audits each of its {prefill, decode} executables with
     # the graph lint once, the first step it is built (findings
@@ -438,6 +519,46 @@ class ServingConfig:
             raise ValueError("prefix_cache=True requires paged=True (the "
                              "trie shares BLOCK-pool blocks; the padded "
                              "engine has no blocks to share)")
+        if self.spec_decode:
+            if not self.paged:
+                raise ValueError("spec_decode=True requires paged=True "
+                                 "(the verify call runs the [B, k] "
+                                 "multi-token kernel over the block "
+                                 "pool)")
+            if not (1 <= self.spec_k <= 31):
+                # the upper bound keeps the spec_accept_len histogram's
+                # exact-integer buckets (bounds cover counts <= 32 =
+                # spec_k + 1) honest; windows wider than that are far
+                # past any useful acceptance length anyway
+                raise ValueError(f"spec_k must be in [1, 31], "
+                                 f"got {self.spec_k}")
+            if self.temperature > 0.0:
+                raise ValueError(
+                    "spec_decode=True requires greedy sampling "
+                    "(temperature=0): the bit-exact acceptance rule is "
+                    "argmax equality; sampled speculative decoding needs "
+                    "a rejection-sampling rule this engine does not "
+                    "implement")
+            if self.spec_draft == "trie":
+                if not self.prefix_cache:
+                    raise ValueError(
+                        "spec_draft='trie' requires prefix_cache=True "
+                        "(prompt-lookup drafts are the radix trie's "
+                        "cached continuation tokens); pass a callable "
+                        "spec_draft to use a draft model instead")
+            elif not callable(self.spec_draft):
+                raise ValueError(f"spec_draft must be 'trie' or a "
+                                 f"callable (context, k) -> tokens; got "
+                                 f"{self.spec_draft!r}")
+        if self.prefill_chunk is not None:
+            if not self.paged:
+                raise ValueError("prefill_chunk requires paged=True (the "
+                                 "chunk windows write pool blocks via "
+                                 "the start-offset executable)")
+            if not (1 <= self.prefill_chunk <= self.prompt_cap):
+                raise ValueError(
+                    f"prefill_chunk must be in [1, prompt_cap="
+                    f"{self.prompt_cap}], got {self.prefill_chunk}")
         if self.paged:
             if self.cache_dtype not in (None, "int8"):
                 # int8 paged KV landed (ISSUE 10: per-block factored
@@ -579,6 +700,13 @@ class ServingEngine:
                 from .prefix_cache import PrefixCache
                 self._prefix = PrefixCache(
                     self._pool, byte_budget=config.prefix_cache_bytes)
+            # chunked prefill (ISSUE 11): next prompt position to prefill
+            # per slot; -1 = not mid-prefill (a plain decode row)
+            self._prefill_pos = np.full((B,), -1, np.int64)
+            # spec decoding (ISSUE 11): the optional draft-model hook —
+            # the trie (when present) drafts first, the hook fills misses
+            self._draft_fn = config.spec_draft \
+                if callable(config.spec_draft) else None
 
     # -- admission ------------------------------------------------------
     @property
@@ -939,11 +1067,19 @@ class ServingEngine:
         try:
             finished, expired, admit_ran = self._admit_paged()
             ran |= admit_ran
-            live_entry = self._live()
+            pf_done, pf_ran = self._advance_prefill()
+            ran |= pf_ran
+            finished.extend(pf_done)
+            live_entry = self._decodable()
             if live_entry:
-                chunk_done, out_tokens = self._decode_chunk_paged(
-                    live_entry)
-                ran.add("decode")
+                if self.config.spec_decode:
+                    chunk_done, out_tokens, dec_ran = \
+                        self._decode_chunk_spec(live_entry)
+                    ran |= dec_ran
+                else:
+                    chunk_done, out_tokens = self._decode_chunk_paged(
+                        live_entry)
+                    ran.add("decode")
                 finished.extend(chunk_done)
         except BaseException:
             now = self.clock()
@@ -1001,6 +1137,31 @@ class ServingEngine:
         self._pending[slot] = 0
         self._done[slot] = True
         self._shared_tok[slot] = 0
+        self._prefill_pos[slot] = -1
+
+    def _decodable(self) -> List[int]:
+        """Live slots whose prefill completed — the decode batch. Rows
+        still mid-(chunked-)prefill are excluded and neutralized in the
+        shipped device state (`_ship_decode_state`)."""
+        return [i for i in self._live() if self._prefill_pos[i] < 0]
+
+    def _ship_decode_state(self):
+        """The decode/verify-call view of the slot state: rows still
+        mid-chunked-prefill ship a trash table row + done=True so the
+        fixed-[B] call cannot write into (or attend) their in-progress
+        blocks; their real host state is untouched."""
+        pf = self._prefill_pos >= 0
+        if not pf.any():
+            return self._tables, self._lens, self._pending, self._done
+        tables = self._tables.copy()
+        tables[pf] = 0
+        lens = self._lens.copy()
+        lens[pf] = 0
+        pending = self._pending.copy()
+        pending[pf] = 0
+        done = self._done.copy()
+        done[pf] = True
+        return tables, lens, pending, done
 
     def _kv_physical(self):
         """(physical live tokens, logical shared tokens) over live slots.
@@ -1031,25 +1192,35 @@ class ServingEngine:
         self._kv_snapshot = (
             phys, self._pool.used_blocks * self._pool.block_size, shared)
 
-    def _insert_prefix(self, req: Request, blocks, written: int):
-        """Cache the request's prompt blocks whose KV is WRITTEN — the
-        full blocks among positions [0, written). The partial tail keeps
+    def _insert_prefix(self, req: Request, blocks, written: int,
+                       tokens=None):
+        """Cache the request's blocks whose KV is WRITTEN — the full
+        blocks among positions [0, written). The partial tail keeps
         taking decode writes and is never shared; a block whose rows are
         not on device yet (the zero-prefill pending position) must not
-        be cached either. Shared runs dedup against their own nodes."""
+        be cached either. Shared runs dedup against their own nodes.
+        `tokens` defaults to the prompt; the spec-decode finish path
+        passes the prompt + generated chain (ISSUE 11) so later
+        identical traffic can zero-prefill AND prompt-lookup-draft its
+        continuation from these blocks' token keys."""
         if self._prefix is None:
             return
         bs = self._pool.block_size
-        n_full = min(int(written), req.prompt_len) // bs
+        toks = req.prompt if tokens is None else tokens
+        n_full = min(int(written), len(toks)) // bs
         if n_full:
-            self._prefix.insert(req.prompt[:n_full * bs], blocks[:n_full])
+            self._prefix.insert(toks[:n_full * bs], blocks[:n_full])
 
     def warmup_prefix_cache(self, vocab_size: int, *, seed: int = 2,
                             clear: bool = True):
         """Compile the prefix-cache executable set before measuring: a
         full-prefill miss, an identical block-aligned repeat (the COW
         copy), and a mid-prefix divergence (suffix prefill), each run to
-        completion so decode compiles too. `clear=True` then drops the
+        completion so decode compiles too. With spec_decode the same
+        choreography also lowers the verify executable — the repeated
+        prompt's decode drafts the first run's cached chain from the
+        trie — and with prefill_chunk the chunked-window executable
+        replaces the one-shot prefill pair. `clear=True` then drops the
         warmup's cached prefixes so measured traffic starts cold. The
         shared choreography serve_bench / bench.py / graph_lint use —
         steady-state zero-recompile assertions are only meaningful after
@@ -1207,6 +1378,20 @@ class ServingEngine:
                 # written KV here (the pending re-decode hasn't run), so
                 # the insert must not cache any fresh block yet
                 self._insert_prefix(req, blocks, t)
+            elif cfg.prefill_chunk is not None:
+                # chunked prefill (ISSUE 11 satellite): admission only
+                # installs the slot — _advance_prefill runs one
+                # [1, prefill_chunk] window per engine step from position
+                # t, so a cap-length prompt costs cap/chunk STEPS of
+                # bounded work instead of one monopolizing call, and the
+                # decode batch keeps stepping between windows. The slot's
+                # decode state stays neutral (lens 0 / done) until the
+                # final window samples the first token.
+                self._prefill_pos[slot] = t
+                req._chunks = []
+                req._produced = 0
+                if t:
+                    self.metrics.counters["prefill_tokens_saved"] += t
             else:
                 suffix = plen - t
                 ids = np.full((1, cfg.prompt_cap), cfg.pad_token_id,
@@ -1226,22 +1411,7 @@ class ServingEngine:
                 ran.add("prefill" if t == 0 else "prefix_prefill")
                 if t:
                     self.metrics.counters["prefill_tokens_saved"] += t
-                tp = self.clock()
-                req.trace.t_prefill_done = tp
-                req.trace.t_first_token = tp  # sampled with the prefill
-                self._lens[slot] = plen
-                self._pending[slot] = tok
-                hit_eos = (cfg.eos_token_id is not None
-                           and tok == cfg.eos_token_id)
-                self._done[slot] = hit_eos
-                req._chunks = [np.asarray([tok], np.int64)]  # lint: allow(tracer-asarray)
-                req._produced = 1
-                # insert BEFORE any instant finish: the cache's retain
-                # must land while the request still holds its blocks
-                # (finishing frees the owner's references)
-                self._insert_prefix(req, blocks, plen)
-                if req._produced >= req.max_new_tokens or hit_eos:
-                    self._finish_paged_row(slot, tp)
+                if self._complete_prefill(slot, req, tok, self.clock()):
                     finished.append(req)
                     free.insert(0, slot)
             self._batch_id += 1
@@ -1260,10 +1430,11 @@ class ServingEngine:
         cfg = self.config
         c = cfg.decode_chunk
         self._snapshot_kv()
+        tables, lens, pending, done = self._ship_decode_state()
         with jax.profiler.TraceAnnotation("serving/decode"):
             toks, self._pools, _, done_d = self.model.decode_paged(
-                self._pools, self._tables, self._lens, self._pending,
-                self._done, c, temperature=cfg.temperature,
+                self._pools, tables, lens, pending,
+                done, c, temperature=cfg.temperature,
                 top_k=cfg.top_k, top_p=cfg.top_p,
                 seed=cfg.seed + self._calls,
                 eos_token_id=cfg.eos_token_id,
@@ -1272,9 +1443,14 @@ class ServingEngine:
             arr = np.asarray(toks.numpy())          # host sync per chunk  # lint: allow(tracer-asarray)
         self._calls += 1
         t = self.clock()
-        self._pending = arr[:, -1].astype(np.int32)
-        self._done = np.array(done_d)      # copy: slot edits need a
+        pend_new = arr[:, -1].astype(np.int32)
+        done_new = np.array(done_d)        # copy: slot edits need a
         #                                    writable host array
+        pf = self._prefill_pos >= 0        # mid-prefill rows rode as
+        pend_new[pf] = self._pending[pf]   # neutralized dummies — their
+        done_new[pf] = self._done[pf]      # real state must survive
+        self._pending = pend_new
+        self._done = done_new
         finished: List[Request] = []
         out_tokens = 0
         for slot in live:
@@ -1299,6 +1475,198 @@ class ServingEngine:
                 finished.append(req)
         return finished, out_tokens
 
+    def _advance_prefill(self):
+        """One [1, prefill_chunk] prefill window for every slot mid-
+        chunked-prefill (ISSUE 11 satellite). The window offset is DATA
+        through the start-form prefill executable, so ONE [1, chunk]
+        program serves every (offset, remainder) of every prompt length
+        — zero new executables however prompts are sized. The final
+        window's sampled token is the request's first token (its last
+        real column is the prompt's last token) and the row joins the
+        next decode chunk. Returns (finished, ran_tags) — a budget-1 /
+        instant-EOS request can finish the moment its prefill lands."""
+        cfg = self.config
+        finished: List[Request] = []
+        ran = set()
+        if cfg.prefill_chunk is None:
+            return finished, ran
+        pc = cfg.prefill_chunk
+        for slot in self._live():
+            off = int(self._prefill_pos[slot])
+            if off < 0:
+                continue
+            req = self._slots[slot]
+            plen = req.prompt_len
+            clen = min(pc, plen - off)
+            final = off + clen >= plen
+            ids = np.full((1, pc), cfg.pad_token_id, dtype=np.int64)
+            ids[0, :clen] = req.prompt[off:off + clen]
+            with jax.profiler.TraceAnnotation("serving/prefill"):
+                self._pools, first = self.model.prefill_paged(
+                    ids, np.asarray([clen], np.int32),  # lint: allow(tracer-asarray)
+                    self._pools, self._tables[slot][None],
+                    temperature=cfg.temperature, top_k=cfg.top_k,
+                    top_p=cfg.top_p, seed=cfg.seed + self._calls,
+                    weight_dtype=cfg.weight_dtype,
+                    cache_dtype=cfg.cache_dtype,
+                    start=np.asarray([off], np.int32))  # lint: allow(tracer-asarray)
+                # only the FINAL window's sampled token is meaningful —
+                # syncing the intermediate ones would serialize every
+                # window on a host round-trip for a value that gets
+                # discarded (exactly the long-prompt stall chunked
+                # prefill exists to remove)
+                tok = int(np.asarray(first.numpy())[0]) if final else 0  # lint: allow(tracer-asarray)
+            self._calls += 1
+            ran.add("prefill_chunk")
+            off += clen
+            if not final:
+                self._prefill_pos[slot] = off
+                continue
+            # prefill complete: the slot becomes a decode row
+            self._prefill_pos[slot] = -1
+            if self._complete_prefill(slot, req, tok, self.clock()):
+                finished.append(req)
+        return finished, ran
+
+    def _complete_prefill(self, slot: int, req: Request, tok: int,
+                          tp: float) -> bool:
+        """Shared prefill-completion bookkeeping (one-shot admission AND
+        the final chunked-prefill window): the sampled token becomes the
+        row's pending/first token, the prompt's full blocks enter the
+        trie, and a budget-1 / instant-EOS request finishes on the spot.
+        Returns True when the request instant-finished (the slot is free
+        again)."""
+        cfg = self.config
+        plen = req.prompt_len
+        req.trace.t_prefill_done = tp
+        req.trace.t_first_token = tp  # sampled with the prefill
+        self._lens[slot] = plen
+        self._pending[slot] = tok
+        hit_eos = (cfg.eos_token_id is not None
+                   and tok == cfg.eos_token_id)
+        self._done[slot] = hit_eos
+        req._chunks = [np.asarray([tok], np.int64)]  # lint: allow(tracer-asarray)
+        req._produced = 1
+        # insert BEFORE any instant finish: the cache's retain must land
+        # while the request still holds its blocks (finishing frees the
+        # owner's references)
+        self._insert_prefix(req, self._pool.owned(req.id), plen)
+        if req._produced >= req.max_new_tokens or hit_eos:
+            self._finish_paged_row(slot, tp)
+            return True
+        return False
+
+    def _draft_context(self, req: Request):
+        """The slot's draft context — prompt plus every emitted token
+        (the pending token INCLUDED, since drafts continue after it) —
+        maintained INCREMENTALLY: chunks land once each, so per-window
+        host cost is O(new tokens), not O(history) re-concatenation."""
+        ctx = getattr(req, "_ctx", None)
+        if ctx is None:
+            ctx = req._ctx = [int(t) for t in req.prompt]
+            req._ctx_chunks = 0
+        for c in req._chunks[req._ctx_chunks:]:
+            ctx.extend(int(t) for t in c)
+        req._ctx_chunks = len(req._chunks)
+        return ctx
+
+    def _draft_for_slot(self, slot: int):
+        """Up to spec_k draft tokens for the slot's next positions + the
+        source tag ("trie" | "model" | None)."""
+        cfg = self.config
+        req = self._slots[slot]
+        context = self._draft_context(req)
+        if self._prefix is not None:
+            d = self._prefix.lookup_continuation(context, cfg.spec_k)
+            if d:
+                return np.asarray(d, np.int32), "trie"  # lint: allow(tracer-asarray)
+        if self._draft_fn is not None:
+            d = np.asarray(self._draft_fn(context,  # lint: allow(tracer-asarray)
+                                          cfg.spec_k)).reshape(-1)
+            if d.size:
+                return d[:cfg.spec_k].astype(np.int32), "model"
+        return None, None
+
+    def _decode_chunk_spec(self, live: List[int]):
+        """One speculative verify window over the slot batch (ISSUE 11):
+        a fixed-shape [B, spec_k + 1] call through model.verify_paged.
+        Rows with a draft advance by their accepted length + 1; rows
+        without one ride along on pad drafts and advance by >= 1 (a pad
+        column that happens to match the chain is a REAL acceptance —
+        every emitted token is argmax-correct by construction). Steps
+        where NO row has a draft fall back to the plain decode chunk —
+        both executables are in the warm set, so the per-step choice is
+        host data, never a compile. Returns (finished, real tokens,
+        ran_tags)."""
+        cfg = self.config
+        B = len(self._slots)
+        drafts = np.full((B, cfg.spec_k), cfg.pad_token_id, np.int32)
+        src = {}
+        for slot in live:
+            d, tag = self._draft_for_slot(slot)
+            if d is not None:
+                drafts[slot, :len(d)] = d
+                src[slot] = (tag, len(d))
+        if not src:
+            finished, out_tokens = self._decode_chunk_paged(live)
+            return finished, out_tokens, {"decode"}
+        self._snapshot_kv()
+        tables, lens, pending, done = self._ship_decode_state()
+        with jax.profiler.TraceAnnotation("serving/decode"):
+            toks, n_acc, self._pools, done_d = self.model.verify_paged(
+                self._pools, tables, lens, pending, drafts, done,
+                eos_token_id=cfg.eos_token_id,
+                weight_dtype=cfg.weight_dtype,
+                cache_dtype=cfg.cache_dtype)
+            arr = np.asarray(toks.numpy())          # host sync per window  # lint: allow(tracer-asarray)
+            acc = np.asarray(n_acc)  # lint: allow(tracer-asarray)
+        self._calls += 1
+        t = self.clock()
+        done_new = np.array(done_d)
+        finished: List[Request] = []
+        out_tokens = 0
+        mt = self.metrics
+        for slot in live:
+            req = self._slots[slot]
+            n_emit = int(acc[slot]) + 1
+            take = min(n_emit, req.max_new_tokens - req._produced)
+            fresh = arr[slot, :take]
+            req._chunks.append(fresh)
+            req._produced += take
+            out_tokens += take
+            if req.trace.t_first_token is None:
+                # zero-prefill admission: this window's first token IS
+                # the request's first token
+                req.trace.t_first_token = t
+            self._lens[slot] += n_emit   # the accepted frontier
+            self._pending[slot] = np.int32(arr[slot, n_emit - 1])
+            self._done[slot] = bool(done_new[slot])  # lint: allow(tracer-bool)
+            if slot in src:
+                # acceptance accounting covers DRAFTED rows only and
+                # REAL draft tokens only: a short trie draft's pad
+                # filler counts neither as proposed nor (if a pad
+                # accidentally matches) as accepted. A budget-truncated
+                # final window credits only the accepted drafts it
+                # actually EMITTED, so sum over windows ties out against
+                # speculative tokens out and the rate stays honest on
+                # short-budget / block-granular-draft traffic.
+                tag, dlen = src[slot]
+                used = min(int(acc[slot]), take, dlen)
+                req.spec_proposed += dlen
+                req.spec_accepted += used
+                mt.counters["spec_windows"] += 1
+                mt.counters["spec_proposed"] += dlen
+                mt.counters["spec_accepted"] += used
+                mt.counters["spec_drafts_trie" if tag == "trie"
+                            else "spec_drafts_model"] += 1
+                mt.hists["spec_accept_len"].observe(take)
+            row_done = req._produced >= req.max_new_tokens or \
+                _hit_eos(fresh, cfg.eos_token_id)
+            if row_done:
+                self._finish_paged_row(slot, t)
+                finished.append(req)
+        return finished, out_tokens, {"spec_verify"}
+
     def _finish_paged_row(self, slot: int, t: float):
         """Terminal bookkeeping for one slot: blocks free IMMEDIATELY (the
         next _admit_paged can splice a queued request into this slot
@@ -1309,6 +1677,19 @@ class ServingEngine:
         req.n_out = _n_out(req.tokens, self.config.eos_token_id)
         req.status = "done"
         req.trace.t_finish = t
+        if self.config.spec_decode and self._prefix is not None:
+            # cache the WRITTEN chain (prompt + generated minus the
+            # never-written last token), not just the prompt: the next
+            # identical request then zero-prefills the whole history AND
+            # prompt-lookup-drafts its continuation from these blocks'
+            # token keys — the agentic/retry free lunch. Insert BEFORE
+            # free: the trie's retain must land while the request still
+            # holds its block references.
+            chain = np.concatenate([req.prompt,
+                                    req.tokens[:req.n_out]])
+            self._insert_prefix(req, self._pool.owned(req.id),
+                                req.prompt_len + req._produced - 1,
+                                tokens=chain)
         self._pool.free(req.id)
         self._slots[slot] = None
         self._clear_slot(slot)
@@ -1456,3 +1837,56 @@ def shared_prefix_traffic(n_requests: int, *, n_prefixes: int,
                     "prompt": np.concatenate([prefixes[p], suffix]),
                     "prefix_id": p})
     return out
+
+
+def repeated_traffic(n_requests: int, *, n_prompts: int, prompt_len: int,
+                     vocab_size: int, rate: float = 50.0,
+                     seed: int = 0) -> List[dict]:
+    """Agentic / retry workload (ISSUE 11): every request is one of
+    `n_prompts` FIXED prompts repeated VERBATIM, Poisson arrivals at
+    `rate` req/s. The degenerate shared-prefix shape (suffix shared too)
+    — and the one where speculative prompt-lookup drafting pays in full:
+    after each prompt's first completion, every later identical request
+    zero-prefills its KV from the trie AND drafts its entire greedy
+    continuation from the cached chain, so verify windows accept
+    end-to-end. Returns [{"at", "prompt", "prompt_id"}] sorted by
+    arrival — the bench decode-spec row and serve_bench --repeat replay
+    this."""
+    if n_prompts < 1 or prompt_len < 1:
+        raise ValueError(f"need n_prompts >= 1 and prompt_len >= 1, got "
+                         f"{n_prompts}, {prompt_len}")
+    rng = np.random.RandomState(seed)
+    prompts = rng.randint(1, vocab_size,
+                          (n_prompts, prompt_len)).astype(np.int64)
+    gaps = rng.exponential(1.0 / max(rate, 1e-9), size=n_requests)
+    at = np.cumsum(gaps) - gaps[0]
+    out = []
+    for i in range(n_requests):
+        p = int(rng.randint(0, n_prompts))
+        out.append({"at": float(at[i]),  # lint: allow(tracer-float)
+                    "prompt": prompts[p].copy(), "prompt_id": p})
+    return out
+
+
+def model_draft_fn(draft_model, *, window: int = 32):
+    """Adapter turning a (small) GPTForCausalLM into a speculative draft
+    source for ``ServingConfig(spec_draft=...)`` (ISSUE 11).
+
+    The returned callable greedily continues the last ``window`` context
+    tokens through ``draft_model.generate_static_ragged`` — fixed
+    [1, window] shape, ragged length as data, so ONE draft executable
+    per spec_k serves every request at every depth (it compiles on the
+    first draft call; include a drafted request in warmup before
+    asserting zero steady-state misses). Each call pays a full
+    window-prefill in the draft model: cheap when the drafter is 10-50x
+    smaller than the target, which is the configuration speculative
+    decoding wants anyway."""
+    def fn(context, k):
+        ctx = np.asarray(context, dtype=np.int64)[-window:]  # lint: allow(tracer-asarray)
+        ln = int(ctx.shape[0])
+        ids = np.zeros((1, window), np.int64)
+        ids[0, :ln] = ctx
+        out = draft_model.generate_static_ragged(ids, [ln],
+                                                 max_new_tokens=int(k))
+        return np.asarray(out.numpy())[0, window:window + int(k)]  # lint: allow(tracer-asarray)
+    return fn

@@ -200,15 +200,20 @@ class GPTSelfAttention(Layer):
                                          paged_prefill_mask,
                                          paged_attention,
                                          paged_attention_q8,
-                                         paged_prefix_attention_reference,
-                                         paged_prefix_attention_reference_q8,
+                                         paged_prefix_attention,
+                                         paged_prefix_attention_q8,
                                          quantize_kv,
                                          attention_q8_cache,
                                          attention_reference)
             if q8c:
                 kc, ks, vc, vs, tables, lens = cache[1:7]
                 start = cache[7] if len(cache) > 7 else None
-                if s == 1:
+                # dispatch on start-presence BEFORE width: a [B, 1]
+                # window WITH a start offset is a 1-token suffix-prefill
+                # chunk (write at start[b], attend the pool), not a
+                # decode step (write at lens[b]) — prefill_chunk=1
+                # would otherwise silently corrupt the pool
+                if s == 1 and start is None:
                     # decode: quantize the token's K/V at row position
                     # lens[b]; attend cols <= itself via the factored-
                     # scale int8 math (kernel on TPU, gather reference
@@ -237,7 +242,9 @@ class GPTSelfAttention(Layer):
                         [vc, vs, qkv[:, :, 2], tables, start])
 
                     def _attend_prefix_q8(qa, kca, ksa, vca, vsa, t, st):
-                        return paged_prefix_attention_reference_q8(
+                        # multi-token selector (ISSUE 11): Pallas kernel
+                        # on TPU, gather reference on CPU/parity path
+                        return paged_prefix_attention_q8(
                             qa, kca, ksa, vca, vsa, t, st)
 
                     ctx = apply_op(
@@ -272,7 +279,8 @@ class GPTSelfAttention(Layer):
                 kp, vp, tables, lens = cache[1], cache[2], cache[3], \
                     cache[4]
                 start = cache[5] if len(cache) > 5 else None
-                if s == 1:
+                # same start-before-width dispatch as the q8 branch
+                if s == 1 and start is None:
                     # decode step: the token lands at row position
                     # lens[b] and attends to cols <= itself (lens + 1
                     # attendable rows)
@@ -297,7 +305,9 @@ class GPTSelfAttention(Layer):
                                    [vp, qkv[:, :, 2], tables, start])
 
                     def _attend_prefix(qa, kpa, vpa, t, st):
-                        return paged_prefix_attention_reference(
+                        # multi-token selector (ISSUE 11): Pallas kernel
+                        # on TPU, gather reference on CPU/parity path
+                        return paged_prefix_attention(
                             qa, kpa, vpa, t, st, score_dtype=qa.dtype)
 
                     ctx = apply_op("paged_prefix_attend", _attend_prefix,
@@ -1408,6 +1418,129 @@ class GPTForCausalLM(Layer):
                                         pending_arr, done_arr,
                                         jax.random.PRNGKey(seed))
         return Tensor(toks), pools2, lens2, done2
+
+    def verify_paged(self, pools, block_tables, lens, pending, draft,
+                     done, eos_token_id: int = None,
+                     weight_dtype: str = None, cache_dtype: str = None):
+        """One speculative-decode VERIFY step against the paged pool
+        (ISSUE 11): score a [B, k] token window in ONE fixed-shape call
+        through the ragged multi-token paged-attention primitive and
+        apply the longest-accepted-prefix rule.
+
+        The window per row is ``[pending, draft[0], ..., draft[k-2]]`` —
+        each row's sampled-but-unwritten token followed by ``k - 1``
+        drafted guesses (prompt-lookup from the prefix trie, or any other
+        drafter). The call writes all k tokens' K/V at positions
+        ``lens[b] + i`` (the suffix-prefill scatter: writes past a row's
+        block budget land in the trash block), attends causally across
+        the cached prefix + the window, and takes the greedy argmax at
+        every position. Acceptance is DATA, not shape: draft token i is
+        accepted iff it equals the chain token the target emits at window
+        position i - 1, and the emitted row is the chain ``e`` with EOS
+        forcing applied exactly like decode_paged's per-step masking — so
+        greedy output is BIT-IDENTICAL per row to the non-speculative
+        chain however many drafts hit or miss. Rejected-position KV
+        writes are garbage BELOW the next window's start: every later
+        window rewrites them before they become attendable, so no
+        cleanup pass exists.
+
+        Returns ``(emitted [B, k] int64, n_accept [B] int32, pools',
+        done')``: row b emitted ``n_accept[b] + 1`` valid tokens
+        (``emitted[b, :n_accept[b] + 1]``, the accepted drafts re-stated
+        by the target plus the bonus token); its next pending token is
+        ``emitted[b, n_accept[b]]`` and its cache frontier advanced by
+        ``n_accept[b] + 1``. The pools are DONATED. One executable per
+        window size k serves every accept/reject mix — tables / lens /
+        pending / draft / done are all data inputs.
+
+        Greedy only: the bit-exact acceptance rule IS argmax equality;
+        sampled speculative decoding needs a rejection-sampling rule
+        this engine does not implement."""
+        import jax
+        from ..jit.api import _swap_params, _trace_guard
+        from ..core import autograd
+
+        tables = jnp.asarray(
+            block_tables._data if isinstance(block_tables, Tensor)
+            else block_tables, jnp.int32)
+        b = tables.shape[0]
+        lens_arr = jnp.asarray(
+            lens._data if isinstance(lens, Tensor) else lens, jnp.int32)
+        pending_arr = jnp.asarray(
+            pending._data if isinstance(pending, Tensor) else pending,
+            jnp.int32)
+        draft_arr = jnp.asarray(
+            draft._data if isinstance(draft, Tensor) else draft, jnp.int32)
+        if draft_arr.ndim != 2 or draft_arr.shape[0] != b:
+            raise ValueError(f"draft must be [B, k-1]; got "
+                             f"{draft_arr.shape} for batch {b}")
+        k = int(draft_arr.shape[1]) + 1
+        done_arr = jnp.asarray(
+            done._data if isinstance(done, Tensor) else done, bool)
+        params = list(self.parameters())
+        cdt = self.gpt.wte.weight._data.dtype
+        c8 = _check_pool_dtype(pools, cdt, cache_dtype)
+        tag = "paged8" if c8 else "paged"
+        n_pool = 4 if c8 else 2
+        q8 = weight_dtype == "int8"
+        qmap = self._decode_quantized_params() if q8 else {}
+        expand = self._make_expand(q8, cdt)
+
+        def run(pa, pools, tbl, lens_, pending_, draft_, done_):
+            window = jnp.concatenate([pending_[:, None], draft_], axis=1)
+            ex, pays = expand(pa)
+            with _trace_guard(), _swap_params(params, ex), \
+                    _q8_bind(params, pays), autograd.no_grad():
+                # the suffix-prefill cache form: writes at lens + i,
+                # attention across the pool — the [B, k] multi-token
+                # primitive; `lens` rides both as the branch's lens slot
+                # (unused for s > 1) and as the start offset
+                caches = [(tag,) + tuple(Tensor(p) for p in layer) +
+                          (Tensor(tbl), Tensor(lens_), Tensor(lens_))
+                          for layer in pools]
+                pos = lens_[:, None] + jnp.arange(k, dtype=jnp.int32)[None]
+                logits, nc = self.forward(
+                    Tensor(window), position_ids=Tensor(pos),
+                    caches=caches)
+            new_pools = [tuple(e._data for e in c[1:1 + n_pool])
+                         for c in nc]
+            raw = jnp.argmax(logits._data.astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)        # [B, k]
+            if eos_token_id is None:
+                e = raw
+                match = (draft_ == raw[:, :-1]).astype(jnp.int32)
+                n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                done_out = done_
+            else:
+                eos = jnp.asarray(eos_token_id, raw.dtype)
+                # a row is "done" at window position i iff it was done on
+                # entry or the chain emitted EOS strictly before i — the
+                # sequential rule decode_paged applies per step, closed
+                # into one cumulative form
+                hit = (raw == eos).astype(jnp.int32)
+                seen_before = jnp.cumsum(hit, axis=1) - hit
+                done_i = done_[:, None] | (seen_before > 0)
+                e = jnp.where(done_i, eos, raw)
+                match = (draft_ == e[:, :-1]).astype(jnp.int32)
+                n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                emitted = jnp.arange(k, dtype=jnp.int32)[None] <= \
+                    n_acc[:, None]
+                done_out = done_ | jnp.any((e == eos) & emitted, axis=1)
+            return (e.astype(jnp.int64), n_acc.astype(jnp.int32),
+                    new_pools, done_out)
+
+        nb, bs = pools[0][0].shape[0], pools[0][0].shape[1]
+        sig = ("paged_verify", b, k, nb, bs, int(tables.shape[1]),
+               None if eos_token_id is None else int(eos_token_id),
+               str(cdt), "q8" if q8 else "full", "c8" if c8 else "fp")
+        fn = self._gen_cache_get(
+            sig, lambda: jax.jit(run, donate_argnums=(1,)))
+        payload = tuple(qmap[i] if i in qmap else p._data
+                        for i, p in enumerate(params)) if q8 else \
+            tuple(p._data for p in params)
+        toks, n_acc, pools2, done2 = fn(payload, pools, tables, lens_arr,
+                                        pending_arr, draft_arr, done_arr)
+        return Tensor(toks), n_acc, pools2, done2
 
     def _make_expand(self, q8, cdt):
         """The shared mixed-payload expander (full arrays pass through;

@@ -455,6 +455,41 @@ def _use_paged_kernel():
         return False
 
 
+def paged_prefix_attention(q, k_pool, v_pool, tables, start, *, scale=None,
+                           score_dtype=None):
+    """Ragged MULTI-TOKEN paged attention (ISSUE 11; Ragged Paged
+    Attention, arxiv 2604.15464): q [B, S, H, D] holds S query tokens per
+    row at global positions start[b] + i, each attending every pool
+    column <= its own position — causal over the cached prefix plus the
+    window itself. One primitive serves suffix prefill after a partial
+    prefix hit, chunked prefill, and speculative-decode verification;
+    S = 1 with start = lens is the decode case. Pallas kernel on TPU
+    (block-table walk, MXU-shaped per-block dots), jnp gather reference
+    elsewhere — routed exactly like paged_attention."""
+    if _use_paged_kernel():
+        from .pallas.paged_attention import paged_prefix_attention_kernel
+        return paged_prefix_attention_kernel(q, k_pool, v_pool, tables,
+                                             start, scale=scale)
+    return paged_prefix_attention_reference(q, k_pool, v_pool, tables,
+                                            start, scale=scale,
+                                            score_dtype=score_dtype)
+
+
+def paged_prefix_attention_q8(q, kc_pool, ks_pool, vc_pool, vs_pool,
+                              tables, start):
+    """int8 ragged multi-token paged attention: the q8-pool form of
+    paged_prefix_attention (factored-scale contraction math), routed
+    kernel-vs-reference like every other paged op."""
+    if _use_paged_kernel():
+        from .pallas.paged_attention import paged_prefix_attention_q8_kernel
+        return paged_prefix_attention_q8_kernel(q, kc_pool, ks_pool,
+                                                vc_pool, vs_pool, tables,
+                                                start)
+    return paged_prefix_attention_reference_q8(q, kc_pool, ks_pool,
+                                               vc_pool, vs_pool, tables,
+                                               start)
+
+
 def paged_attention(q, k_pool, v_pool, tables, lens, *, scale=None,
                     score_dtype=None):
     """Ragged paged decode attention: Pallas kernel on TPU (block-table

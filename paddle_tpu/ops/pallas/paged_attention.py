@@ -1,4 +1,5 @@
-"""Pallas TPU kernel: single-token decode attention over a paged KV pool.
+"""Pallas TPU kernels: decode and ragged multi-token attention over a
+paged KV pool.
 
 The serving decode problem (ISSUE 5; Ragged Paged Attention, arxiv
 2604.15464): each batch row's KV cache is a list of fixed-size blocks
@@ -189,6 +190,194 @@ def paged_attention_q8_kernel(q, kc_pool, ks_pool, vc_pool, vs_pool,
     )(tables.astype(jnp.int32), lens.astype(jnp.int32), q3,
       kc_pool, ks_pool, vc_pool, vs_pool)
     return out[:, None] if squeezed else out
+
+
+# ------------------------------------------- ragged multi-token kernels
+# ISSUE 11 (Ragged Paged Attention, arxiv 2604.15464): one kernel serving
+# k >= 1 query tokens per row against that row's block-table KV with a
+# per-row START offset — query row i of batch row b sits at global
+# position start[b] + i and attends pool columns <= its own position
+# (causal within the window, over the cached prefix + the window itself).
+# This is the [B, k] primitive behind suffix prefill after a partial
+# prefix hit, chunked prefill, and speculative-decode verification; k = 1
+# with start = lens degenerates to the decode kernel above (parity
+# pinned in tests). Unlike the 1-token kernel the per-block math here IS
+# an MXU shape where k permits: scores are a [k, hd] x [hd, bs] dot and
+# the value accumulate a [k, bs] x [bs, hd] dot, so wide windows (suffix
+# prefill at k = prompt_cap, spec verify at k = spec window) run on the
+# MXU while the fetch pattern stays the block-table walk.
+
+def _kernel_multi(tables_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_sc, l_sc, acc_sc, *, scale, nh, bs, s, n_slots):
+    b, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    st = start_ref[b]
+
+    @pl.when(j * bs <= st + s - 1)       # block wholly past the window's
+    def _step():                         # causal frontier: skip the fetch
+        q = q_ref[0].astype(jnp.float32)            # [s, nh, hd]
+        k = k_ref[0].astype(jnp.float32)            # [bs, nh, hd]
+        v = v_ref[0].astype(jnp.float32)
+        col = j * bs + lax.broadcasted_iota(jnp.int32, (s, bs), 1)
+        row = lax.broadcasted_iota(jnp.int32, (s, bs), 0)
+        keep = col <= st + row           # causal across prefix + window
+        for h in range(nh):
+            sc = lax.dot_general(q[:, h, :], k[:, h, :],
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            sc = sc * scale                          # [s, bs]
+            sc = jnp.where(keep, sc, jnp.asarray(_NEG, sc.dtype))
+            m_prev = m_sc[h]                         # [s, 1]
+            l_prev = l_sc[h]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+            p = jnp.exp(sc - m_new)                  # [s, bs]
+            corr = jnp.exp(m_prev - m_new)
+            m_sc[h] = m_new
+            l_sc[h] = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            acc_sc[h] = corr * acc_sc[h] + lax.dot_general(
+                p, v[:, h, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [s, hd]
+
+    @pl.when(j == n_slots - 1)
+    def _finish():
+        for h in range(nh):
+            l = jnp.maximum(l_sc[h], 1e-30)
+            o_ref[0, :, h, :] = (acc_sc[h] / l).astype(o_ref.dtype)
+
+
+def _kernel_multi_q8(tables_ref, start_ref, q_ref, kc_ref, ks_ref, vc_ref,
+                     vs_ref, o_ref, m_sc, l_sc, acc_sc, *, scale, nh, bs,
+                     s, n_slots):
+    """int8 form of `_kernel_multi`: codes stream as bare int8->f32
+    converts into the dots; the per-(row, head) factored scales multiply
+    the [s, bs] score / probability tiles (same trick as `_kernel_q8`,
+    MXU-shaped like `_kernel_multi`)."""
+    b, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    st = start_ref[b]
+
+    @pl.when(j * bs <= st + s - 1)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)            # [s, nh, hd]
+        kc = kc_ref[0].astype(jnp.float32)          # [bs, nh, hd] codes
+        ks = ks_ref[0]                              # [bs, nh] f32 scales
+        vc = vc_ref[0].astype(jnp.float32)
+        vs = vs_ref[0]
+        col = j * bs + lax.broadcasted_iota(jnp.int32, (s, bs), 1)
+        row = lax.broadcasted_iota(jnp.int32, (s, bs), 0)
+        keep = col <= st + row
+        for h in range(nh):
+            sc = lax.dot_general(q[:, h, :], kc[:, h, :],
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            sc = sc * (ks[:, h][None, :] * scale)    # [s, bs]
+            sc = jnp.where(keep, sc, jnp.asarray(_NEG, sc.dtype))
+            m_prev = m_sc[h]
+            l_prev = l_sc[h]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+            p = jnp.exp(sc - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            m_sc[h] = m_new
+            l_sc[h] = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            acc_sc[h] = corr * acc_sc[h] + lax.dot_general(
+                p * vs[:, h][None, :], vc[:, h, :],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_slots - 1)
+    def _finish():
+        for h in range(nh):
+            l = jnp.maximum(l_sc[h], 1e-30)
+            o_ref[0, :, h, :] = (acc_sc[h] / l).astype(o_ref.dtype)
+
+
+def paged_prefix_attention_kernel(q, k_pool, v_pool, tables, start, *,
+                                  scale=None, interpret=False):
+    """Ragged multi-token paged attention: q [B, S, H, D] query tokens at
+    global positions start[b] + i; pools [NB, bs, H, D]; tables [B, MB]
+    i32; start [B] i32. Each query row attends every pool column <= its
+    own position — the kernel form of `paged_prefix_attention_reference`
+    (suffix prefill, chunked prefill, spec-decode verify; S = 1 with
+    start = lens is exactly the decode case). Returns q's layout."""
+    b, s, nh, hd = q.shape
+    nb, bs = k_pool.shape[0], k_pool.shape[1]
+    mb = tables.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+
+    pool_spec = pl.BlockSpec((1, bs, nh, hd),
+                             lambda bi, j, T, S_: (T[bi, j], 0, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((1, s, nh, hd), lambda bi, j, T, S_: (bi, 0, 0, 0)),
+            pool_spec, pool_spec,
+        ],
+        out_specs=pl.BlockSpec((1, s, nh, hd),
+                               lambda bi, j, T, S_: (bi, 0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((nh, s, 1), jnp.float32),
+                        pltpu.VMEM((nh, s, 1), jnp.float32),
+                        pltpu.VMEM((nh, s, hd), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_kernel_multi, scale=scale, nh=nh, bs=bs, s=s,
+                          n_slots=mb),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, s, nh, hd), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), start.astype(jnp.int32), q, k_pool, v_pool)
+
+
+def paged_prefix_attention_q8_kernel(q, kc_pool, ks_pool, vc_pool, vs_pool,
+                                     tables, start, *, scale=None,
+                                     interpret=False):
+    """int8 ragged multi-token paged attention: the q8 pools form of
+    `paged_prefix_attention_kernel` (codes int8 [NB, bs, H, D], factored
+    scales f32 [NB, bs, H])."""
+    b, s, nh, hd = q.shape
+    nb, bs = kc_pool.shape[0], kc_pool.shape[1]
+    mb = tables.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+
+    pool_spec = pl.BlockSpec((1, bs, nh, hd),
+                             lambda bi, j, T, S_: (T[bi, j], 0, 0, 0))
+    scale_spec = pl.BlockSpec((1, bs, nh),
+                              lambda bi, j, T, S_: (T[bi, j], 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((1, s, nh, hd), lambda bi, j, T, S_: (bi, 0, 0, 0)),
+            pool_spec, scale_spec, pool_spec, scale_spec,
+        ],
+        out_specs=pl.BlockSpec((1, s, nh, hd),
+                               lambda bi, j, T, S_: (bi, 0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((nh, s, 1), jnp.float32),
+                        pltpu.VMEM((nh, s, 1), jnp.float32),
+                        pltpu.VMEM((nh, s, hd), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_kernel_multi_q8, scale=scale, nh=nh, bs=bs, s=s,
+                          n_slots=mb),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, s, nh, hd), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), start.astype(jnp.int32), q,
+      kc_pool, ks_pool, vc_pool, vs_pool)
 
 
 def paged_attention_kernel(q, k_pool, v_pool, tables, lens, *, scale=None,

@@ -14,6 +14,12 @@ Targets (--all = every one):
                {prefill, decode} pair plus the suffix-prefill and COW
                executables (warmup traffic repeats + diverges a prompt
                so every admission path lowers)
+  gpt-paged-spec  the SPECULATIVE engine (ISSUE 11): prefix cache + trie
+               drafting, so the [B, k] verify executable lowers alongside
+               prefill / decode / COW / suffix-prefill — donation and
+               host-transfer audited over the whole spec set, and the
+               run asserts the steady loop added zero jit cache misses
+               (the zero-recompile invariant, proven not claimed)
   train-step   TrainStep(gpt) — traced abstractly (never executed):
                host-transfer / dtype / baked-const / donation over the
                fused fwd+bwd+optimizer step
@@ -40,8 +46,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-TARGETS = ("gpt-static", "gpt-paged", "gpt-paged-int8", "train-step",
-           "resnet50")
+TARGETS = ("gpt-static", "gpt-paged", "gpt-paged-int8", "gpt-paged-spec",
+           "train-step", "resnet50")
 
 
 def _tiny_gpt(dtype="bfloat16"):
@@ -57,12 +63,17 @@ def _tiny_gpt(dtype="bfloat16"):
 
 
 def audit_gpt_engine(lint, *, paged: bool, int8: bool = False,
-                     prefix: bool = False):
+                     prefix: bool = False, spec: bool = False):
     """Serve one warmup batch through the real engine with lint enabled;
     the engine captures + audits its executables itself. With `prefix`
     the traffic repeats a block-aligned prompt (COW executable) and
     diverges from it mid-prefix (suffix-prefill executable), so the
-    whole prefix-cache executable set lowers and is audited."""
+    whole prefix-cache executable set lowers and is audited. With `spec`
+    (ISSUE 11) the repeated prompt's decode drafts the first run's
+    cached chain from the trie, so the [B, k] verify executable lowers
+    too — and the target additionally PROVES the zero-recompile
+    invariant: a steady spec loop after warmup must add zero jit cache
+    misses."""
     import numpy as np
     from paddle_tpu.inference import ServingConfig, ServingEngine
     model, _ = _tiny_gpt()
@@ -71,7 +82,9 @@ def audit_gpt_engine(lint, *, paged: bool, int8: bool = False,
                         kv_block=4, lint=lint,
                         cache_dtype="int8" if int8 else None,
                         prefix_cache=prefix,
-                        kv_blocks=33 if prefix else None)
+                        kv_blocks=65 if spec else
+                        (33 if prefix else None),
+                        spec_decode=spec)
     eng = ServingEngine(model, cfg)
     rng = np.random.RandomState(0)
     eng.submit(rng.randint(1, 100, (5,)))
@@ -81,6 +94,27 @@ def audit_gpt_engine(lint, *, paged: bool, int8: bool = False,
         # the shared warmup choreography: aligned miss + COW repeat +
         # mid-prefix divergence, so every admission executable lowers
         eng.warmup_prefix_cache(100, clear=False)
+    if spec:
+        from paddle_tpu.jit.api import compile_cache_misses
+        miss0 = compile_cache_misses()
+        for _ in range(2):                 # steady repeats: trie-drafted
+            eng.submit(rng.randint(1, 100, (8,)))
+            eng.drain()
+        p = rng.randint(1, 100, (8,))
+        for _ in range(2):
+            eng.submit(p)
+            eng.drain()
+        dm = compile_cache_misses() - miss0
+        if dm:
+            raise SystemExit(f"gpt-paged-spec: steady speculative loop "
+                             f"added {dm} jit cache miss(es) — the "
+                             f"zero-recompile invariant is broken")
+        if eng.metrics.counters["spec_windows"] < 1:
+            # not an assert: under python -O it would vanish and the
+            # target would silently audit only the non-spec executables
+            raise SystemExit("gpt-paged-spec: warmup never ran a verify "
+                             "window — the speculative executable was "
+                             "never lowered, nothing was audited")
     return eng.lint_findings
 
 
@@ -182,6 +216,8 @@ def main(argv=None) -> int:
         "gpt-paged": lambda: audit_gpt_engine(lint, paged=True),
         "gpt-paged-int8": lambda: audit_gpt_engine(lint, paged=True,
                                                    int8=True, prefix=True),
+        "gpt-paged-spec": lambda: audit_gpt_engine(lint, paged=True,
+                                                   prefix=True, spec=True),
         "train-step": lambda: audit_train_step(lint),
         "resnet50": lambda: audit_resnet50(lint,
                                            train=args.vision_train),

@@ -34,6 +34,13 @@ cache OFF and ON — printing hit rate, prefill-tokens-saved and the
 TTFT-with/without-cache table. ``--prefix-cache`` alone enables the
 cache on a plain ``--paged`` run.
 
+Speculative decoding (ISSUE 11): ``--spec`` replays the workload through
+the paged+prefix engine with speculative decode OFF and ON (``--spec-k``
+drafts per verify window, prompt-lookup drafting from the trie) and
+prints the acceptance table. ``--repeat N`` switches the workload to N
+fixed prompts repeated verbatim — the agentic/retry shape where trie
+drafting accepts end-to-end.
+
 Without --preset a 2-layer toy GPT runs on CPU (CI-sized); with a preset
 set PADDLE_TPU_EXAMPLE_TPU=1 to run real-chip sizes.
 """
@@ -70,7 +77,7 @@ def build_model(preset):
     return model, cfg
 
 
-def _serving_config(args, paged, prefix_cache=False):
+def _serving_config(args, paged, prefix_cache=False, spec=False):
     from paddle_tpu.inference import ServingConfig
     # int8 KV runs on BOTH --compare legs now (the paged int8 pool landed
     # in ISSUE 10); a cache dtype the paged engine still cannot serve gets
@@ -85,12 +92,23 @@ def _serving_config(args, paged, prefix_cache=False):
                          paged=paged, kv_block=args.kv_block,
                          kv_blocks=args.kv_blocks,
                          prefix_cache=prefix_cache,
-                         prefix_cache_bytes=args.prefix_cache_bytes)
+                         prefix_cache_bytes=args.prefix_cache_bytes,
+                         spec_decode=spec, spec_k=args.spec_k,
+                         # paged-only knob: --compare's padded leg must
+                         # not trip the config validation on it
+                         prefill_chunk=args.prefill_chunk if paged
+                         else None)
 
 
 def _make_traffic(args, cfg, *, n, rate, seed):
-    from paddle_tpu.inference import (shared_prefix_traffic,
+    from paddle_tpu.inference import (repeated_traffic,
+                                      shared_prefix_traffic,
                                       synthetic_traffic)
+    if args.repeat:
+        return repeated_traffic(n, n_prompts=args.repeat,
+                                prompt_len=args.prompt_cap,
+                                vocab_size=cfg.vocab_size, rate=rate,
+                                seed=seed)
     if args.shared_prefix:
         return shared_prefix_traffic(
             n, n_prefixes=args.shared_prefix, prefix_len=args.prefix_len,
@@ -101,11 +119,13 @@ def _make_traffic(args, cfg, *, n, rate, seed):
                              seed=seed, length_dist=args.length_dist)
 
 
-def run_engine(model, cfg, args, *, paged, prefix_cache=False):
+def run_engine(model, cfg, args, *, paged, prefix_cache=False,
+               spec=False):
     """Replay the workload through one engine; returns (report, engine)."""
     from paddle_tpu.inference import ServingEngine
     engine = ServingEngine(model,
-                           _serving_config(args, paged, prefix_cache))
+                           _serving_config(args, paged, prefix_cache,
+                                           spec))
 
     # warmup batch: compiles the (prefill + chunk) executables once, so the
     # measured replay is the steady state a long-lived server sits in.
@@ -172,6 +192,8 @@ def run_engine(model, cfg, args, *, paged, prefix_cache=False):
     mode = "paged" if paged else "padded"
     if prefix_cache:
         mode += "+prefix"
+    if spec:
+        mode += "+spec"
     out = {"mode": mode,
            "preset": args.preset or "toy", "requests": args.requests,
            "rate_req_s": args.rate, "length_dist": args.length_dist,
@@ -189,6 +211,17 @@ def run_engine(model, cfg, args, *, paged, prefix_cache=False):
             "hit_rate": round(hits / max(hits + misses, 1), 4),
             "prefill_tokens_saved": s["prefill_tokens_saved_total"],
         }
+    if spec:
+        prop = s["spec_proposed_total"]
+        out["spec"] = {
+            "windows": s["spec_windows_total"],
+            "proposed": prop, "accepted": s["spec_accepted_total"],
+            "accept_rate": round(s["spec_accepted_total"] / prop, 4)
+            if prop else None,
+            "drafts_trie": s["spec_drafts_trie_total"],
+            "drafts_model": s["spec_drafts_model_total"],
+            "accept_len": s.get("spec_accept_len"),
+        }
     # the recompiles counter is a pure churn signal: refused requests log
     # their shape delta without feeding it (record_compile count=False)
     out["steady_recompiles"] = engine.monitor.recompiles
@@ -199,19 +232,23 @@ def run_bench(args):
     """Returns ([report, ...], engine_of_last_run) — one report per engine
     mode (two under --compare / --shared-prefix)."""
     model, cfg = build_model(args.preset)
-    if args.shared_prefix:
+    if args.spec:
+        # the speculative A/B (ISSUE 11): same traffic, paged+prefix
+        # engine, spec decode off then on
+        modes = [(True, True, False), (True, True, True)]
+    elif args.shared_prefix:
         # the prefix-cache A/B: same system-prompt traffic, paged engine,
         # cache off then on
-        modes = [(True, False), (True, True)]
+        modes = [(True, False, False), (True, True, False)]
     elif args.compare:
-        modes = [(False, False), (True, args.prefix_cache)]
+        modes = [(False, False, False), (True, args.prefix_cache, False)]
     else:
-        modes = [(args.paged, args.prefix_cache)]
+        modes = [(args.paged, args.prefix_cache, False)]
     reports = []
     engine = None
-    for paged, prefix in modes:
+    for paged, prefix, spec in modes:
         rep, engine = run_engine(model, cfg, args, paged=paged,
-                                 prefix_cache=prefix)
+                                 prefix_cache=prefix, spec=spec)
         reports.append(rep)
     return reports, engine
 
@@ -246,7 +283,29 @@ def _print_report(out):
               f"hit rate {pre['hit_rate'] * 100:.1f}% "
               f"({pre['hits']}/{pre['hits'] + pre['misses']})   "
               f"prefill tokens saved {pre['prefill_tokens_saved']}")
+    sp = out.get("spec")
+    if sp:
+        rate = sp["accept_rate"]
+        print(f"  speculative: {sp['windows']} windows, accepted "
+              f"{sp['accepted']}/{sp['proposed']} drafts "
+              f"({'n/a' if rate is None else f'{rate * 100:.1f}%'})   "
+              f"trie {sp['drafts_trie']} / model {sp['drafts_model']}")
     print(f"  steady-state recompiles: {out['steady_recompiles']}")
+
+
+def _print_spec_comparison(off, on):
+    print("\nspeculative decode off vs on (same traffic):")
+    print(f"  {'mode':<18} {'tok/s':>10} {'accept rate':>12} "
+          f"{'windows':>8}")
+    for rep in (off, on):
+        sp = rep.get("spec")
+        acc = "n/a" if not sp or sp["accept_rate"] is None \
+            else f"{sp['accept_rate'] * 100:.1f}%"
+        print(f"  {rep['mode']:<18} {str(rep['throughput_tok_s']):>10} "
+              f"{acc:>12} {sp['windows'] if sp else 0:>8}")
+    if off["throughput_tok_s"] and on["throughput_tok_s"]:
+        print(f"  speculative speedup: "
+              f"{on['throughput_tok_s'] / off['throughput_tok_s']:.2f}x")
 
 
 def _print_prefix_comparison(off, on):
@@ -317,6 +376,18 @@ def main(argv=None) -> int:
     ap.add_argument("--prefix-len", type=int, default=None,
                     help="system-prompt length for --shared-prefix "
                          "(default: half the prompt cap)")
+    ap.add_argument("--spec", action="store_true",
+                    help="replay through the paged+prefix engine with "
+                         "speculative decode off AND on; prints the "
+                         "acceptance table")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens per speculative verify window")
+    ap.add_argument("--repeat", type=int, default=0, metavar="N",
+                    help="workload = N fixed prompts repeated verbatim "
+                         "(the agentic/retry shape trie drafting wants)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="cap per-step prefill work at [1, N] tokens "
+                         "(chunked prefill)")
     ap.add_argument("--length-dist", choices=("uniform", "longtail"),
                     default="uniform",
                     help="prompt-length mix; longtail = Pareto-shaped "
@@ -353,7 +424,9 @@ def main(argv=None) -> int:
     else:
         for rep in reports:
             _print_report(rep)
-        if len(reports) == 2 and args.shared_prefix:
+        if len(reports) == 2 and args.spec:
+            _print_spec_comparison(reports[0], reports[1])
+        elif len(reports) == 2 and args.shared_prefix:
             _print_prefix_comparison(reports[0], reports[1])
         elif len(reports) == 2:
             _print_comparison(reports[0], reports[1])

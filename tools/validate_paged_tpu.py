@@ -1,13 +1,16 @@
-"""Hardware validation for the paged decode attention kernel (run on TPU).
+"""Hardware validation for the paged attention kernels (run on TPU).
 
-CPU CI exercises the Pallas kernel in interpret mode only (tests/
-test_paged_kv.py); Mosaic compilation and the scalar-prefetched
-block-table fetch path are checked here on the real chip:
+CPU CI exercises the Pallas kernels in interpret mode only (tests/
+test_paged_kv.py, tests/test_spec_decode.py); Mosaic compilation and the
+scalar-prefetched block-table fetch path are checked here on the chip:
   1. compiled kernel parity vs `paged_attention_reference` across ragged
      lengths (incl. a row at an exact block boundary and a dummy row)
-  2. serving-shape sweep (gpt3-1.3b geometry: nh=16 hd=128, bf16 pool)
-  3. end-to-end: paged engine greedy == generate_static_ragged per row
-  4. a steady mixed-length engine loop adds zero jit cache misses
+  2. MULTI-TOKEN kernel parity (ISSUE 11) vs the gather reference across
+     (k, block, start) shapes — k=1 degenerate, windows starting at and
+     crossing block boundaries, serving-scale geometry
+  3. serving-shape sweep (gpt3-1.3b geometry: nh=16 hd=128, bf16 pool)
+  4. end-to-end: paged engine greedy == generate_static_ragged per row
+     (plain AND speculative), zero steady jit cache misses
 
 Usage: python tools/validate_paged_tpu.py
 """
@@ -51,6 +54,92 @@ def kernel_parity(dtype, nh, hd, bs, tol):
     err = np.abs(got[live] - want[live]).max()
     check(f"kernel parity {dtype} nh={nh} hd={hd} bs={bs}", err < tol,
           f"max err {err:.2e}")
+
+
+def kernel_prefix_parity(dtype, nh, hd, bs, s, starts, tol):
+    """Multi-token [B, k] kernel vs the gather reference (ISSUE 11):
+    per-row start offsets as data, causal-within-window masking."""
+    from paddle_tpu.ops.attention import (paged_prefill_write,
+                                          paged_prefix_attention_reference)
+    from paddle_tpu.ops.pallas.paged_attention import (
+        paged_prefix_attention_kernel)
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    B, MB = len(starts), 6
+    nb = 1 + B * MB
+    kp = jnp.zeros((nb, bs, nh, hd), dtype)
+    vp = jnp.zeros_like(kp)
+    tables = jnp.asarray(
+        np.arange(1, nb, dtype=np.int32).reshape(B, MB))
+    K = rng.randn(B, MB * bs, nh, hd).astype(np.float32) * 0.3
+    V = rng.randn(B, MB * bs, nh, hd).astype(np.float32) * 0.3
+    for b in range(B):
+        kp = paged_prefill_write(kp, jnp.asarray(K[b:b + 1], dtype),
+                                 tables[b:b + 1])
+        vp = paged_prefill_write(vp, jnp.asarray(V[b:b + 1], dtype),
+                                 tables[b:b + 1])
+    q = jnp.asarray(rng.randn(B, s, nh, hd).astype(np.float32) * 0.3,
+                    dtype)
+    st = jnp.asarray(starts, jnp.int32)
+    got = np.asarray(paged_prefix_attention_kernel(q, kp, vp, tables, st),
+                     np.float32)
+    want = np.asarray(
+        paged_prefix_attention_reference(q, kp, vp, tables, st),
+        np.float32)
+    err = np.abs(got - want).max()
+    check(f"multi-token kernel parity {dtype} nh={nh} hd={hd} bs={bs} "
+          f"k={s} starts={list(starts)}", err < tol, f"max err {err:.2e}")
+
+
+def spec_engine_parity():
+    """Speculative engine greedy == generate_static_ragged on repeated
+    traffic, full trie acceptance, zero steady jit cache misses."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (ServingConfig, ServingEngine,
+                                      repeated_traffic)
+    from paddle_tpu.jit.api import compile_cache_misses
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=256, num_layers=2,
+                    num_heads=2, max_position_embeddings=512,
+                    intermediate_size=512)
+    m = GPTForCausalLM(cfg)
+    m.eval()                     # f32: same numerics-class note as above
+    CAP, NEW = 64, 16
+    # kv_block=8 < NEW: trie drafts are block-granular, so a finished
+    # chain only contributes drafts once its generated tokens fill at
+    # least one pool block past the prompt
+    eng = ServingEngine(m, ServingConfig(
+        max_batch=2, prompt_cap=CAP, max_new_tokens=NEW, decode_chunk=4,
+        paged=True, kv_block=8, kv_blocks=256, prefix_cache=True,
+        spec_decode=True, spec_k=4))
+    eng.warmup_prefix_cache(cfg.vocab_size, clear=False)
+    traffic = repeated_traffic(8, n_prompts=2, prompt_len=CAP,
+                               vocab_size=cfg.vocab_size, rate=1e9,
+                               seed=5)
+    prompts = {t["prompt_id"]: t["prompt"] for t in traffic}
+    ids = np.stack([prompts[i] for i in sorted(prompts)])
+    ref = m.generate_static_ragged(paddle.to_tensor(ids),
+                                   [CAP] * len(ids),
+                                   max_new_tokens=NEW).numpy()[:, CAP:]
+    miss0 = compile_cache_misses()
+    for t in traffic:
+        eng.submit(t["prompt"])
+    done = eng.drain()
+    ok = all(r.status == "done" for r in done)
+    for r in done:
+        row = next(i for i in sorted(prompts)
+                   if np.array_equal(prompts[i], r.prompt))
+        ok = ok and np.array_equal(r.tokens, ref[row])
+    check("spec engine greedy == generate_static_ragged", ok)
+    s = eng.metrics.counters
+    check("spec windows drafted from the trie",
+          s["spec_windows"] > 0 and s["spec_drafts_trie"] > 0,
+          f"windows={s['spec_windows']} accepted={s['spec_accepted']}/"
+          f"{s['spec_proposed']}")
+    check("steady speculative loop: zero jit cache misses",
+          compile_cache_misses() - miss0 == 0,
+          f"recompiles={eng.monitor.recompiles}")
 
 
 def engine_parity():
@@ -108,7 +197,18 @@ def main():
     kernel_parity(jnp.float32, nh=4, hd=64, bs=16, tol=2e-5)
     kernel_parity(jnp.bfloat16, nh=16, hd=128, bs=16, tol=2e-2)
     kernel_parity(jnp.bfloat16, nh=12, hd=64, bs=32, tol=2e-2)
+    # multi-token (ISSUE 11): k=1 degenerate, boundary-start, boundary-
+    # crossing windows, serving-scale geometry + a wide prefill window
+    kernel_prefix_parity(jnp.float32, nh=4, hd=64, bs=16, s=1,
+                         starts=(40, 16, 0), tol=2e-5)
+    kernel_prefix_parity(jnp.float32, nh=4, hd=64, bs=16, s=8,
+                         starts=(16, 13, 0), tol=2e-5)
+    kernel_prefix_parity(jnp.bfloat16, nh=16, hd=128, bs=16, s=8,
+                         starts=(32, 5, 0), tol=2e-2)
+    kernel_prefix_parity(jnp.bfloat16, nh=16, hd=128, bs=16, s=64,
+                         starts=(16, 0, 7), tol=2e-2)
     engine_parity()
+    spec_engine_parity()
     print("all paged serving validations passed")
 
 
