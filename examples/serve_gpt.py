@@ -13,6 +13,11 @@ analog of the reference's fused_multi_transformer CacheKV serving):
      executables, driven by open-loop synthetic traffic, ending in the
      real /metrics payload a frontend scrapes (TTFT/TPOT/e2e histograms,
      queue/batch/KV gauges, zero-recompile steady state)
+  7. the telemetry SERVER (obs, ISSUE 12) — the same engine scraped over
+     HTTP: `curl /metrics` (collision-checked Prometheus page),
+     `/healthz` (the autoscaler inputs: drain state + queue depth +
+     overloaded_total; HTTP 503 once begin_drain() flips the replica
+     out of rotation), `/statusz`, and `/tracez` tail-sampled traces
 
 Usage: PYTHONPATH=. python examples/serve_gpt.py
        PADDLE_TPU_EXAMPLE_TPU=1 ... [gpt3-1.3b] for real-chip sizes.
@@ -102,6 +107,9 @@ def main():
     engine = ServingEngine(model, ServingConfig(
         max_batch=B, prompt_cap=cap, max_new_tokens=new,
         decode_chunk=max(1, new // 2)))
+    # boot the ops surface FIRST (ISSUE 12) — a real replica's telemetry
+    # server is up before traffic lands, so /tracez sees every request
+    srv = engine.serve_telemetry()
     traffic = synthetic_traffic(4 * B, prompt_cap=cap,
                                 vocab_size=cfg.vocab_size, rate=200.0,
                                 seed=3, min_len=max(1, cap // 3))
@@ -125,6 +133,43 @@ def main():
         print(f"TTFT p50/p99: {s['ttft_seconds']['p50'] * 1e3:.1f} / "
               f"{s['ttft_seconds']['p99'] * 1e3:.1f} ms")
     assert s["batch_step"]["recompiles"] == 0   # steady loop never reshapes
+
+    # 8. the ops surface over the wire (ISSUE 12): what a router /
+    # autoscaler / dashboard actually scrapes. serve_telemetry() wires
+    # /metrics (unified registry), /healthz, /statusz and /tracez around
+    # the live engine on an ephemeral port — this is the in-process
+    # `curl`, byte-for-byte what the network sees.
+    import json as _json
+    from urllib.request import urlopen
+    from urllib.error import HTTPError
+    print(f"---- telemetry server on {srv.url()} ----")
+    metrics = urlopen(srv.url("/metrics")).read().decode()
+    print(f"$ curl /metrics        -> {len(metrics.splitlines())} lines, "
+          f"e.g.:")
+    for line in metrics.splitlines():
+        if line.startswith("paddle_tpu_serving_ttft_seconds_count") or \
+                line.startswith("paddle_tpu_serving_completed_total"):
+            print(f"    {line}")
+    health = _json.loads(urlopen(srv.url("/healthz")).read())
+    print(f"$ curl /healthz        -> 200 {health}")
+    tz = _json.loads(urlopen(srv.url("/tracez?order=slowest&limit=1")).read())
+    print(f"$ curl /tracez         -> {tz['summary']['retained']} traces "
+          f"retained (tail-sampled), slowest trace_id "
+          f"{tz['traces'][0]['trace_id']}")
+    # graceful drain flips the replica out of rotation: /healthz turns
+    # 503/"draining" the moment begin_drain() runs — the load balancer
+    # ejects it while in-flight work finishes
+    engine.begin_drain()
+    try:
+        urlopen(srv.url("/healthz"))
+        raise AssertionError("draining replica must fail its health check")
+    except HTTPError as e:
+        print(f"$ curl /healthz        -> {e.code} "
+              f"{_json.loads(e.read())['status']} (after begin_drain)")
+    engine.drain(seal=True)
+    srv.close()
+    engine.resume_admission()
+
     print("---- /metrics ----")
     print(engine.metrics_text(), end="")
     print("OK")

@@ -87,6 +87,7 @@ from . import static  # noqa: F401,E402
 from .static.program import enable_static, disable_static  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import obs  # noqa: F401,E402
 from . import debugging  # noqa: F401,E402
 from . import analysis  # noqa: F401,E402
 from . import resilience  # noqa: F401,E402

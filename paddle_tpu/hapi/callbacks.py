@@ -292,19 +292,57 @@ class ProfilerCallback(Callback):
     for the duration of fit, so the goodput seams (TrainStep compile/step
     spans, DataLoader input stalls, CheckpointManager blocking/drain)
     attribute the run's wall clock; eval passes are recorded per eval
-    batch as `eval` badput."""
+    batch as `eval` badput.
+    `telemetry`: an obs.TelemetryServer (ISSUE 12) — for the duration of
+    fit the callback registers this run's exposition producers into the
+    server's collision-checked registry, so a TRAINING job is scrapeable
+    over the wire exactly like a serving replica: the StepMonitor gauges,
+    and (when a timeline is attached) LIVE goodput gauges stitched from
+    the in-memory recorder on every scrape — no waiting for the segment
+    files. Producers unregister at train end; the server's lifecycle
+    (start/close) stays with the caller."""
 
     def __init__(self, profiler=None, monitor=None, summary=True,
-                 timeline=None):
+                 timeline=None, telemetry=None):
         super().__init__()
         self.profiler = profiler
         self.monitor = monitor
         self.summary = summary
         self.timeline = timeline
+        self.telemetry = telemetry
         self._tl_prev = None
         self._eval_t0 = None
+        self._tele_registered = []
+
+    def _live_goodput_text(self):
+        """One scrape = one stitch of the live recorder's ring. A young
+        recorder (no spans yet) renders nothing rather than failing the
+        whole /metrics page."""
+        from ..profiler.goodput import GoodputReport
+        if not self.timeline.spans():
+            return ""
+        return GoodputReport(self.timeline).metrics_text()
 
     def on_train_begin(self, logs=None):
+        if self.telemetry is not None:
+            reg = self.telemetry.registry
+            for name, producer in (
+                    ("train_monitor",
+                     self.monitor.metrics_text if self.monitor is not None
+                     else None),
+                    ("train_goodput",
+                     self._live_goodput_text if self.timeline is not None
+                     else None)):
+                if producer is None:
+                    continue
+                # a fit that died mid-epoch (Preempted, chaos) never ran
+                # on_train_end: its stale producer may still be
+                # registered — adopt the slot rather than erroring the
+                # new cycle (same contract as the timeline restore below)
+                reg.unregister(name)
+                reg.register(name, producer)
+                if name not in self._tele_registered:
+                    self._tele_registered.append(name)
         if self.timeline is not None:
             from ..profiler import timeline as _tlmod
             prev = _tlmod.install(self.timeline)
@@ -343,6 +381,12 @@ class ProfilerCallback(Callback):
             self._eval_t0 = None
 
     def on_train_end(self, logs=None):
+        # drop the telemetry producers FIRST (the monitor/timeline they
+        # read outlive fit, but a dead run must not keep advertising)
+        if self.telemetry is not None:
+            for name in self._tele_registered:
+                self.telemetry.registry.unregister(name)
+            self._tele_registered = []
         # restore the timeline FIRST: a profiler.stop() failure must not
         # leak this fit's recorder into the process-wide slot
         if self.timeline is not None:

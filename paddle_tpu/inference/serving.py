@@ -100,6 +100,7 @@ import json
 import logging
 import math
 import time
+import uuid
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
@@ -119,20 +120,31 @@ _logger = logging.getLogger("paddle_tpu.inference.serving")
 
 @dataclass
 class RequestTrace:
-    """Span timestamps of one request's life (engine clock seconds).
+    """Span TREE of one request's life (engine clock seconds).
 
     enqueue → admit is queue wait; admit → prefill_done is the batched
     prefill; first_token lands after the 1-token decode chunk; finish is
     stamped at the end of the decode CHUNK in which the row hit EOS or its
     budget (every chunk ends in a host sync, so chunk granularity is free
     — a short request co-batched with long ones is not charged for decode
-    chunks past its own completion)."""
+    chunks past its own completion).
+
+    `trace_id` names the request across export surfaces (JSONL rows, the
+    /tracez ring, logs); `events` are the engine-call WINDOWS the request
+    rode, appended as (name, t0, t1) tuples — "prefill",
+    "suffix_prefill", "prefill_chunk", "decode", "spec_verify" — so an
+    exported trace explains WHERE a slow e2e went (ISSUE 12: one window
+    per device call the row participated in; a zero-prefill cache hit
+    shows no prefill window at all, which is the point). `span_tree()`
+    renders the stamps + windows as one structured tree."""
     t_enqueue: Optional[float] = None
     t_admit: Optional[float] = None
     t_prefill_done: Optional[float] = None
     t_first_token: Optional[float] = None
     t_finish: Optional[float] = None
     batch_id: Optional[int] = None
+    trace_id: Optional[str] = None
+    events: List[tuple] = field(default_factory=list)
 
     @property
     def queue_s(self) -> Optional[float]:
@@ -164,6 +176,21 @@ class RequestTrace:
               "t_finish", "batch_id")}
         return {k: v for k, v in d.items() if v is not None}
 
+    def span_tree(self) -> dict:
+        """The structured trace a /tracez consumer renders: the request
+        root span plus its children — the derived queue span and every
+        engine-call window this request rode, in time order."""
+        spans = []
+        if self.t_enqueue is not None and self.t_admit is not None:
+            spans.append({"name": "queue", "t0": self.t_enqueue,
+                          "t1": self.t_admit})
+        for name, a, b in self.events:
+            spans.append({"name": name, "t0": a, "t1": b})
+        spans.sort(key=lambda s: s["t0"])
+        return {"trace_id": self.trace_id,
+                "t0": self.t_enqueue, "t1": self.t_finish,
+                "spans": spans}
+
 
 @dataclass(eq=False)     # holds an ndarray: identity, not value, equality
 class Request:
@@ -193,6 +220,13 @@ class Request:
                "prompt_tokens": self.prompt_len,
                "output_tokens": self.n_out,
                "spans": t.to_dict()}
+        if t.trace_id is not None:
+            rec["trace_id"] = t.trace_id
+        if t.events:
+            # the engine-call windows (ISSUE 12): rounded for the wire,
+            # ordering preserved — span_tree() derives the tree view
+            rec["events"] = [[n, round(a, 6), round(b, 6)]
+                             for n, a, b in t.events]
         if self.reason:
             rec["reason"] = self.reason
         if self.spec_proposed:
@@ -232,10 +266,14 @@ class ServingMetrics:
 
     def __init__(self, *, jsonl_path: Optional[str] = None,
                  on_record: Optional[Callable[[dict], None]] = None,
+                 trace_buffer=None,
                  hist_lo: float = 1e-4, hist_hi: float = 1e3,
                  per_decade: int = 10):
         self.jsonl_path = jsonl_path
         self.on_record = on_record
+        # obs.TraceBuffer (ISSUE 12): every terminal request record also
+        # lands in the tail-sampling ring the /tracez endpoint snapshots
+        self.trace_buffer = trace_buffer
         self.hists = {name: LogHistogram(lo=hist_lo, hi=hist_hi,
                                          per_decade=per_decade)
                       for name, _ in self.HISTS
@@ -306,7 +344,10 @@ class ServingMetrics:
                 self.counters["overloaded"] += 1
         elif req.status == "error":
             self.counters["errors"] += 1
-        return self._emit({"request": req.record(), "ts": time.time()})
+        rec = req.record()
+        if self.trace_buffer is not None:
+            self.trace_buffer.add(rec)
+        return self._emit({"request": rec, "ts": time.time()})
 
     def _emit(self, row: dict) -> dict:
         """One emission path for per-request and drain-summary rows —
@@ -661,6 +702,11 @@ class ServingEngine:
         self._draining = False     # graceful drain: stop admitting
         self._next_id = 0
         self._batch_id = 0
+        self._t_start = self.clock()    # statusz uptime anchor
+        # trace ids are unique across engine incarnations: a fleet's
+        # collectors merge many replicas' JSONL/tracez streams, where a
+        # bare per-engine request counter would collide instantly
+        self._run_id = uuid.uuid4().hex[:8]
         self._max_depth = 0        # deepest (prefill + k chunks) run so far
         self._rejected_shapes = set()   # shape-delta warned once per shape
         # the engine's one-and-only batch signature (leaves shaped like
@@ -794,6 +840,7 @@ class ServingEngine:
                       deadline_s=cfg.deadline_s if deadline_s is None
                       else deadline_s)
         self._next_id += 1
+        req.trace.trace_id = f"{self._run_id}-{req.id}"
         now = self.clock()
         req.trace.t_enqueue = now if enqueue_at is None \
             else min(enqueue_at, now)
@@ -953,6 +1000,7 @@ class ServingEngine:
         miss0 = _jit_cache_misses()
         need = max(r.max_new_tokens for r in reqs)
         self.monitor.begin_step()
+        t_pf0 = self.clock()
         with jax.profiler.TraceAnnotation("serving/prefill"):
             st = self.model.prefill_static(
                 ids, max_len=cfg.max_len, prompt_lens=lens,
@@ -961,10 +1009,12 @@ class ServingEngine:
         t_prefill = self.clock()
         for r in reqs:
             r.trace.t_prefill_done = t_prefill
+            r.trace.events.append(("prefill", t_pf0, t_prefill))
 
         parts: List[np.ndarray] = []
         schedule = cfg.chunk_schedule
         for ci, chunk in enumerate(schedule):
+            t_c0 = self.clock()
             with jax.profiler.TraceAnnotation("serving/decode"):
                 # per-(batch, chunk) seed: every decode_static call builds
                 # a fresh PRNG stream from its seed, so reusing one seed
@@ -985,6 +1035,12 @@ class ServingEngine:
             if ci == 0:
                 for r in reqs:
                     r.trace.t_first_token = t_chunk
+            # the decode window rides every row still in flight at chunk
+            # entry — a row finished in an EARLIER chunk is not charged
+            # this one (same rule as the t_finish stamp below)
+            for r in reqs:
+                if r.trace.t_finish is None:
+                    r.trace.events.append(("decode", t_c0, t_chunk))
             # per-row finish at chunk granularity: a row is complete once
             # it hit EOS or its own budget — its e2e/TPOT must not be
             # charged for chunks the batch ran for OTHER rows
@@ -1398,6 +1454,7 @@ class ServingEngine:
                               dtype=np.int64)
                 ids[0, :suffix] = req.prompt[t:]
                 start = None if t == 0 else np.asarray([t], np.int32)  # lint: allow(tracer-asarray)
+                t_pf0 = self.clock()
                 with jax.profiler.TraceAnnotation("serving/prefill"):
                     self._pools, first = self.model.prefill_paged(
                         ids, np.asarray([suffix], np.int32),  # lint: allow(tracer-asarray)
@@ -1409,6 +1466,9 @@ class ServingEngine:
                     tok = int(np.asarray(first.numpy())[0])  # lint: allow(tracer-asarray)
                 self._calls += 1
                 ran.add("prefill" if t == 0 else "prefix_prefill")
+                req.trace.events.append(
+                    ("prefill" if t == 0 else "suffix_prefill",
+                     t_pf0, self.clock()))
                 if t:
                     self.metrics.counters["prefill_tokens_saved"] += t
                 if self._complete_prefill(slot, req, tok, self.clock()):
@@ -1431,6 +1491,7 @@ class ServingEngine:
         c = cfg.decode_chunk
         self._snapshot_kv()
         tables, lens, pending, done = self._ship_decode_state()
+        t_c0 = self.clock()
         with jax.profiler.TraceAnnotation("serving/decode"):
             toks, self._pools, _, done_d = self.model.decode_paged(
                 self._pools, tables, lens, pending,
@@ -1455,6 +1516,7 @@ class ServingEngine:
         out_tokens = 0
         for slot in live:
             req = self._slots[slot]
+            req.trace.events.append(("decode", t_c0, t))
             take = min(c, req.max_new_tokens - req._produced)
             req._chunks.append(arr[slot, :take])
             req._produced += take
@@ -1501,6 +1563,7 @@ class ServingEngine:
             final = off + clen >= plen
             ids = np.full((1, pc), cfg.pad_token_id, dtype=np.int64)
             ids[0, :clen] = req.prompt[off:off + clen]
+            t_pf0 = self.clock()
             with jax.profiler.TraceAnnotation("serving/prefill"):
                 self._pools, first = self.model.prefill_paged(
                     ids, np.asarray([clen], np.int32),  # lint: allow(tracer-asarray)
@@ -1518,6 +1581,8 @@ class ServingEngine:
                 tok = int(np.asarray(first.numpy())[0]) if final else 0  # lint: allow(tracer-asarray)
             self._calls += 1
             ran.add("prefill_chunk")
+            req.trace.events.append(("prefill_chunk", t_pf0,
+                                     self.clock()))
             off += clen
             if not final:
                 self._prefill_pos[slot] = off
@@ -1612,6 +1677,7 @@ class ServingEngine:
             return finished, out_tokens, {"decode"}
         self._snapshot_kv()
         tables, lens, pending, done = self._ship_decode_state()
+        t_c0 = self.clock()
         with jax.profiler.TraceAnnotation("serving/decode"):
             toks, n_acc, self._pools, done_d = self.model.verify_paged(
                 self._pools, tables, lens, pending, drafts, done,
@@ -1628,6 +1694,7 @@ class ServingEngine:
         mt = self.metrics
         for slot in live:
             req = self._slots[slot]
+            req.trace.events.append(("spec_verify", t_c0, t))
             n_emit = int(acc[slot]) + 1
             take = min(n_emit, req.max_new_tokens - req._produced)
             fresh = arr[slot, :take]
@@ -1759,6 +1826,97 @@ class ServingEngine:
         StepMonitor block (steady tokens/s, recompile counters)."""
         return self.metrics.metrics_text(prefix=prefix) + \
             self.monitor.metrics_text(prefix=f"{prefix}_batch")
+
+    # -- ops surface (ISSUE 12) -----------------------------------------
+    def health(self) -> dict:
+        """The /healthz payload — exactly the autoscaler/router inputs
+        the r12 load-shedding work named: drain state, queue depth vs its
+        shed thresholds, inflight rows, and the overloaded counter. Pure
+        host-side reads; safe from any thread at scrape rate."""
+        cfg, m = self.config, self.metrics
+        inflight = len(self._live()) if cfg.paged \
+            else m.gauges["inflight"]
+        return {"status": "draining" if self._draining else "ok",
+                "draining": self._draining,
+                "queue_depth": len(self._queue),
+                "queue_capacity": cfg.queue_capacity,
+                "queue_high_watermark": cfg.queue_high_watermark,
+                "inflight": inflight,
+                "overloaded_total": m.counters["overloaded"],
+                "rejected_total": m.counters["rejected"],
+                "kv_occupancy": m.gauges["kv_occupancy"]}
+
+    def statusz(self) -> dict:
+        """The /statusz payload: engine identity + config envelope,
+        compile/recompile accounting, KV/prefix-cache occupancy, and the
+        full counter/gauge snapshot — the page a human (or a fleet
+        inventory) reads to understand WHAT this replica is."""
+        out = {"engine": {"run_id": self._run_id,
+                          "uptime_s": round(self.clock() - self._t_start,
+                                            3),
+                          "draining": self._draining,
+                          "paged": self.config.paged,
+                          "requests_submitted": self._next_id,
+                          "batches": self._batch_id},
+               "config": {k: (v if isinstance(v, (int, float, str, bool,
+                                                  type(None)))
+                              else repr(v))
+                          for k, v in vars(self.config).items()},
+               "compile": {"compiles": self.monitor.compiles,
+                           "recompiles": self.monitor.recompiles,
+                           "jit_cache_misses": _jit_cache_misses()},
+               "counters": dict(self.metrics.counters),
+               "gauges": dict(self.metrics.gauges)}
+        if self.config.paged:
+            pool = self._pool
+            kv_tokens, kv_slots, kv_shared = self._kv_snapshot
+            out["kv"] = {"blocks_total": pool.num_blocks,
+                         "block_size": pool.block_size,
+                         "used_blocks": pool.used_blocks,
+                         "capacity_tokens": pool.capacity_tokens,
+                         "live_tokens": kv_tokens,
+                         "slot_tokens": kv_slots,
+                         "shared_tokens": kv_shared,
+                         "cache_dtype": pool.cache_dtype}
+            if self._prefix is not None:
+                out["prefix_cache"] = {
+                    "cached_blocks": self._prefix.cached_blocks,
+                    "cached_bytes": self._prefix.cached_bytes,
+                    "byte_budget": self._prefix.byte_budget}
+        return out
+
+    def metrics_registry(self, prefix: str = "paddle_tpu_serving"):
+        """The engine's exposition producers composed through the
+        collision-checked obs.MetricsRegistry — the /metrics source
+        `serve_telemetry` scrapes (callers add more producers: an SLO
+        monitor, a co-hosted training monitor, ...)."""
+        from ..obs import MetricsRegistry
+        reg = MetricsRegistry()
+        reg.register("serving",
+                     lambda: self.metrics.metrics_text(prefix=prefix))
+        reg.register("serving_batch",
+                     lambda: self.monitor.metrics_text(
+                         prefix=f"{prefix}_batch"))
+        return reg
+
+    def serve_telemetry(self, *, host: str = "127.0.0.1", port: int = 0,
+                        slo=None, registry=None, trace_capacity: int = 256):
+        """Boot the replica's ops surface: a started obs.TelemetryServer
+        wired to this engine — /metrics from `metrics_registry()` (+ the
+        SLO monitor's burn gauges when one is passed), /healthz from
+        `health()`, /statusz from `statusz()`, /tracez from the metrics'
+        tail-sampling TraceBuffer (created and attached here when the
+        metrics don't carry one yet). Returns the server; `.close()` it
+        on shutdown."""
+        from ..obs import TelemetryServer, TraceBuffer
+        if self.metrics.trace_buffer is None:
+            self.metrics.trace_buffer = TraceBuffer(trace_capacity)
+        reg = registry if registry is not None else self.metrics_registry()
+        if slo is not None:
+            reg.register("slo", slo.metrics_text)
+        return TelemetryServer(reg, host=host, port=port,
+                               health=self.health, status=self.statusz,
+                               tracez=self.metrics.trace_buffer).start()
 
 
 def _hit_eos(row: np.ndarray, eos: Optional[int]) -> bool:

@@ -1,0 +1,39 @@
+"""paddle_tpu.obs — the fleet's sensory layer (ISSUE 12).
+
+Everything the repo measures (serving request metrics, step telemetry,
+goodput attribution, prefix-cache/spec counters) was reachable only by
+in-process Python calls; before a router or autoscaler can act on a
+replica, the replica needs an ops surface over the wire. This package is
+that surface, stdlib-only:
+
+  MetricsRegistry    composes every exposition producer into ONE
+                     collision-checked, lint-clean Prometheus page
+                     (registry.py; the promtool-style `lint_exposition`
+                     rides the shared profiler/_metrics parser).
+  TelemetryServer    threaded HTTP server: /metrics, /healthz (drain +
+                     queue depth + overloaded_total — the autoscaler
+                     inputs), /statusz (config/occupancy snapshot),
+                     /tracez (server.py).
+  TraceBuffer        bounded per-request trace retention with TAIL
+                     sampling: every failure + the slowest decile always
+                     kept (tracez.py).
+  SLOMonitor         declarative TTFT/TPOT/e2e/goodput objectives
+                     evaluated as multi-window burn rates over the
+                     existing log-bucket histograms, alerting through
+                     the structured JSONL path (slo.py; `parse_slo` /
+                     `evaluate_slo` back the serve_bench --slo gate).
+
+`ServingEngine.serve_telemetry()` wires all four around a live engine;
+`hapi.callbacks.ProfilerCallback(telemetry=...)` exports a TRAINING
+loop's StepMonitor + live goodput gauges through the same server.
+"""
+from .registry import (ExpositionError, MetricsCollisionError,  # noqa: F401
+                       MetricsRegistry, lint_exposition)
+from .server import TelemetryServer  # noqa: F401
+from .slo import (SLOMonitor, SLOTarget, evaluate_slo,  # noqa: F401
+                  format_slo_table, parse_slo)
+from .tracez import TraceBuffer  # noqa: F401
+
+__all__ = ["ExpositionError", "MetricsCollisionError", "MetricsRegistry",
+           "lint_exposition", "TelemetryServer", "SLOMonitor", "SLOTarget",
+           "parse_slo", "evaluate_slo", "format_slo_table", "TraceBuffer"]

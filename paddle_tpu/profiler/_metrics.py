@@ -18,6 +18,7 @@ error of a derived percentile is bounded by the bucket ratio
 from __future__ import annotations
 
 import math
+import re
 from bisect import bisect_left
 from typing import Iterable, List, Optional, Sequence
 
@@ -82,6 +83,93 @@ def histogram_lines(prefix: str, name: str, hist: "LogHistogram",
     lines.append(f"{full}_sum {format_value(hist.sum)}")
     lines.append(f"{full}_count {hist.count}")
     return lines
+
+
+# ------------------------------------------------------------- parsing
+
+class ExpositionError(ValueError):
+    """The text does not conform to the Prometheus exposition format the
+    renderers above promise (malformed sample, missing/duplicated HELP or
+    TYPE, interleaved families, ...)."""
+
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'            # metric name
+    r'(\{[^}]*\})? '                          # optional label set
+    r'(-?\d+(\.\d+)?([eE][-+]?\d+)?|[+-]Inf|NaN)$')
+
+_FAMILY_SUFFIX_RE = re.compile(r"_(bucket|sum|count)$")
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse text-format 0.0.4 output from the renderers above into an
+    ordered ``{family: {"type", "help", "samples"}}`` dict, enforcing the
+    structural invariants a scraper relies on:
+
+      - every sample line matches the sample grammar,
+      - every family declares HELP then TYPE, exactly once, BEFORE its
+        first sample,
+      - a family's lines are contiguous (no interleaving — the producer
+        of the merged page must not shuffle blocks line-wise),
+      - no duplicate sample (same name + label set).
+
+    Histogram-specific invariants (cumulative buckets, +Inf == _count)
+    are the job of `obs.registry.lint_exposition`, which builds on this.
+    Raises ExpositionError; an empty/whitespace text parses to {}.
+    """
+    families: dict = {}
+    current: Optional[str] = None
+    seen_samples = set()
+    for ln, line in enumerate(text.split("\n"), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) < 4:
+                raise ExpositionError(f"line {ln}: truncated {parts[1]} "
+                                      f"line: {line!r}")
+            kind, name, rest = parts[1], parts[2], parts[3]
+            fam = families.get(name)
+            if fam is None:
+                if kind == "TYPE":
+                    raise ExpositionError(
+                        f"line {ln}: TYPE for {name} before its HELP")
+                families[name] = {"help": rest, "type": None, "samples": []}
+            else:
+                if fam["samples"] or (kind == "HELP") \
+                        or (kind == "TYPE" and fam["type"] is not None):
+                    raise ExpositionError(
+                        f"line {ln}: duplicate {kind} for family {name}")
+                fam["type"] = rest.strip()
+            current = name
+            continue
+        if line.startswith("#"):
+            continue                         # comments are legal noise
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ExpositionError(f"line {ln}: malformed sample: {line!r}")
+        base, labels = m.group(1), m.group(2) or ""
+        fam_name = base if base in families \
+            else _FAMILY_SUFFIX_RE.sub("", base)
+        fam = families.get(fam_name)
+        if fam is None or fam["type"] is None:
+            raise ExpositionError(
+                f"line {ln}: sample {base!r} has no preceding HELP/TYPE "
+                f"declaration")
+        if fam_name != current:
+            raise ExpositionError(
+                f"line {ln}: family {fam_name} resumed after other "
+                f"families — samples must be contiguous per family")
+        key = (base, labels)
+        if key in seen_samples:
+            raise ExpositionError(
+                f"line {ln}: duplicate sample {base}{labels}")
+        seen_samples.add(key)
+        fam["samples"].append((base, labels, m.group(3)))
+    for name, fam in families.items():
+        if fam["type"] is None:
+            raise ExpositionError(f"family {name} has HELP but no TYPE")
+    return families
 
 
 class LogHistogram:

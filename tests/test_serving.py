@@ -483,6 +483,13 @@ def test_engine_metrics_text_is_valid_exposition(served_model):
     assert "paddle_tpu_serving_ttft_seconds" in types
     assert "paddle_tpu_serving_batch_steps_total" in types
     _histogram_invariants(text, "paddle_tpu_serving_ttft_seconds")
+    # the same page through the unified registry path (ISSUE 12): the
+    # promtool-style lint covers everything _check_exposition pins plus
+    # family contiguity/collisions — obs tests extend this to merged
+    # multi-producer pages
+    from paddle_tpu.obs import lint_exposition
+    fams = lint_exposition(eng.metrics_registry().render())
+    assert set(types) <= set(fams)
 
 
 def test_synthetic_traffic_shape():

@@ -66,7 +66,9 @@ def check(records, *, budget: float, slow_threshold: float,
           chaos_seconds: float = None,
           chaos_budget: float = 120.0,
           goodput_seconds: float = None,
-          goodput_budget: float = 30.0) -> dict:
+          goodput_budget: float = 30.0,
+          obs_seconds: float = None,
+          obs_budget: float = 60.0) -> dict:
     unmarked_slow = []       # should carry `slow` but don't
     tier1 = []               # everything tier-1 actually collects
     for r in records:
@@ -94,6 +96,11 @@ def check(records, *, budget: float, slow_threshold: float,
     # pure-host JSONL parse that must stay trivial next to the suite
     goodput_over = (goodput_seconds is not None
                     and goodput_seconds > goodput_budget)
+    # the obs budget line: tools/obs_smoke.py boots a toy engine + the
+    # telemetry server inside the tier-1 wrapper (ISSUE 12) — four
+    # endpoint validations plus the paired overhead estimate must stay a
+    # small fraction of the tier cap
+    obs_over = (obs_seconds is not None and obs_seconds > obs_budget)
     return {
         "n_records": len(records),
         "n_tier1": len(tier1),
@@ -110,11 +117,15 @@ def check(records, *, budget: float, slow_threshold: float,
         "goodput_seconds": goodput_seconds,
         "goodput_budget_s": goodput_budget,
         "goodput_over_budget": goodput_over,
+        "obs_seconds": obs_seconds,
+        "obs_budget_s": obs_budget,
+        "obs_over_budget": obs_over,
         "unmarked_slow": sorted(unmarked_slow,
                                 key=lambda r: -r["duration"]),
         "slowest_tier1": sorted(tier1, key=lambda r: -r["duration"])[:10],
         "ok": (tier1_total <= budget and not unmarked_slow
-               and not lint_over and not chaos_over and not goodput_over),
+               and not lint_over and not chaos_over and not goodput_over
+               and not obs_over),
     }
 
 
@@ -143,6 +154,11 @@ def main(argv=None) -> int:
     ap.add_argument("--goodput-budget", type=float, default=30.0,
                     help="max seconds the goodput smoke may take on "
                          "tier-1")
+    ap.add_argument("--obs-seconds", type=float, default=None,
+                    help="measured wall time of the tier-1 obs_smoke "
+                         "leg (tools/run_tier1.sh records it)")
+    ap.add_argument("--obs-budget", type=float, default=60.0,
+                    help="max seconds the obs smoke may take on tier-1")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -157,7 +173,9 @@ def main(argv=None) -> int:
                    chaos_seconds=args.chaos_seconds,
                    chaos_budget=args.chaos_budget,
                    goodput_seconds=args.goodput_seconds,
-                   goodput_budget=args.goodput_budget)
+                   goodput_budget=args.goodput_budget,
+                   obs_seconds=args.obs_seconds,
+                   obs_budget=args.obs_budget)
 
     if args.json:
         print(json.dumps(result, indent=2))
@@ -174,6 +192,9 @@ def main(argv=None) -> int:
         if result.get("goodput_seconds") is not None:
             print(f"  goodput: {result['goodput_seconds']:.2f}s "
                   f"(budget {result['goodput_budget_s']}s)")
+        if result.get("obs_seconds") is not None:
+            print(f"  obs: {result['obs_seconds']:.2f}s "
+                  f"(budget {result['obs_budget_s']}s)")
         if result["chaos_over_budget"]:
             print(f"  VIOLATION: chaos gate took "
                   f"{result['chaos_seconds']:.2f}s, over the "
@@ -182,6 +203,10 @@ def main(argv=None) -> int:
             print(f"  VIOLATION: goodput smoke took "
                   f"{result['goodput_seconds']:.2f}s, over the "
                   f"{result['goodput_budget_s']}s goodput budget")
+        if result["obs_over_budget"]:
+            print(f"  VIOLATION: obs smoke took "
+                  f"{result['obs_seconds']:.2f}s, over the "
+                  f"{result['obs_budget_s']}s obs budget")
         if result["lint_over_budget"]:
             print(f"  VIOLATION: lint pass took "
                   f"{result['lint_seconds']:.2f}s, over the "

@@ -79,7 +79,15 @@ def main(argv=None) -> int:
             out["conservation_error"] = conservation_err
         print(json.dumps(out, indent=2))
     elif args.prom:
-        print(report.metrics_text(), end="")
+        # route through the unified obs registry (ISSUE 12): the same
+        # collision-checked, lint-clean composition path the telemetry
+        # server scrapes — a drifting renderer fails HERE, not on the
+        # dashboard. (Live jobs scrape the same gauges from a running
+        # fit via hapi ProfilerCallback(telemetry=...).)
+        from paddle_tpu.obs import MetricsRegistry
+        reg = MetricsRegistry()
+        reg.register("goodput", report.metrics_text)
+        print(reg.render(), end="")
     else:
         print(report.table())
 

@@ -65,6 +65,20 @@ gprc=$?
 goodput_secs=$(echo "$(date +%s.%N) $gp_t0" | awk '{printf "%.2f", $1-$2}')
 echo "goodput_report: ${goodput_secs}s (exit $gprc)"
 
+# obs smoke (ISSUE 12): toy engine + telemetry server, all four
+# endpoints curled and validated concurrently with decode, zero
+# post-warmup jit misses with the server attached, drain handshake, and
+# the paired server-on/off overhead backstop (10% here — box noise; the
+# <1% paper bar is `obs_smoke.py --overhead-max-pct 1` on an unloaded
+# host).
+obs_t0=$(date +%s.%N)
+timeout -k 10 "${TIER1_OBS_TIMEOUT:-120}" \
+    env JAX_PLATFORMS=cpu python tools/obs_smoke.py \
+    --overhead-max-pct "${TIER1_OBS_MAX_PCT:-10}"
+obsrc=$?
+obs_secs=$(echo "$(date +%s.%N) $obs_t0" | awk '{printf "%.2f", $1-$2}')
+echo "obs_smoke: ${obs_secs}s (exit $obsrc)"
+
 timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
     PADDLE_TPU_TIER_DURATIONS="$DUR" \
     python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
@@ -74,6 +88,7 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -c
 [ "$rc" -eq 0 ] && rc=$lrc
 [ "$rc" -eq 0 ] && rc=$chrc
 [ "$rc" -eq 0 ] && rc=$gprc
+[ "$rc" -eq 0 ] && rc=$obsrc
 
 if [ -s "$DUR" ]; then
     python tools/check_tiers.py "$DUR" \
@@ -84,7 +99,9 @@ if [ -s "$DUR" ]; then
         --chaos-seconds "$chaos_secs" \
         --chaos-budget "${TIER1_CHAOS_BUDGET:-120}" \
         --goodput-seconds "$goodput_secs" \
-        --goodput-budget "${TIER1_GOODPUT_BUDGET:-30}"
+        --goodput-budget "${TIER1_GOODPUT_BUDGET:-30}" \
+        --obs-seconds "$obs_secs" \
+        --obs-budget "${TIER1_OBS_BUDGET:-60}"
     crc=$?
     [ "$rc" -eq 0 ] && rc=$crc
 else

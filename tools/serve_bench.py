@@ -41,6 +41,13 @@ prints the acceptance table. ``--repeat N`` switches the workload to N
 fixed prompts repeated verbatim — the agentic/retry shape where trie
 drafting accepts end-to-end.
 
+SLO gate (ISSUE 12): ``--slo "ttft_p99=500ms,e2e_p99=2s,goodput=0.95"``
+evaluates the declarative targets as whole-run burn rates over the
+replayed traffic's log-bucket histograms (obs.slo), prints the burn-rate
+table, and exits NONZERO on any breach — the same exit-code convention
+as the steady-state-recompile gate, so BENCH rows carry SLO attainment.
+Under an A/B mode the gate judges the LAST leg (the feature-on engine).
+
 Without --preset a 2-layer toy GPT runs on CPU (CI-sized); with a preset
 set PADDLE_TPU_EXAMPLE_TPU=1 to run real-chip sizes.
 """
@@ -397,6 +404,12 @@ def main(argv=None) -> int:
                          "print the comparison table")
     ap.add_argument("--slo-ttft-ms", type=float, default=500.0)
     ap.add_argument("--slo-e2e-ms", type=float, default=5000.0)
+    ap.add_argument("--slo", default=None, metavar="SPEC",
+                    help="declarative SLO gate, e.g. "
+                         "'ttft_p99=500ms,e2e_p99=2s,goodput=0.95': "
+                         "prints the burn-rate table and exits nonzero "
+                         "on breach (obs.slo; judges the last engine "
+                         "run)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--metrics", action="store_true",
@@ -418,6 +431,20 @@ def main(argv=None) -> int:
         print("serve_bench: invalid serving configuration")
         print(Findings([finding]).table())
         return 2
+    # the SLO gate evaluates BEFORE any printing so --json stays ONE
+    # parseable document (slo_gate rides the last report; the human
+    # table prints after the reports)
+    slo_rows = None
+    if args.slo:
+        from paddle_tpu.obs import (evaluate_slo, format_slo_table,
+                                    parse_slo)
+        try:
+            targets = parse_slo(args.slo)
+        except ValueError as e:
+            print(f"serve_bench: bad --slo spec: {e}", file=sys.stderr)
+            return 2
+        slo_rows = evaluate_slo(targets, engine.metrics)
+        reports[-1]["slo_gate"] = slo_rows
     if args.json:
         print(json.dumps(reports if len(reports) > 1 else reports[0],
                          indent=2))
@@ -432,7 +459,18 @@ def main(argv=None) -> int:
             _print_comparison(reports[0], reports[1])
     if args.metrics:
         print(engine.metrics_text(), end="")
-    return 0 if all(r["steady_recompiles"] == 0 for r in reports) else 1
+    rc = 0 if all(r["steady_recompiles"] == 0 for r in reports) else 1
+    if slo_rows is not None:
+        if not args.json:
+            print(format_slo_table(
+                slo_rows, title=f"serve_bench[{reports[-1]['mode']}]"))
+        if not all(r["ok"] for r in slo_rows):
+            breached = ", ".join(r["target"] for r in slo_rows
+                                 if not r["ok"])
+            print(f"serve_bench: SLO BREACH on {breached}",
+                  file=sys.stderr)
+            rc = 1
+    return rc
 
 
 if __name__ == "__main__":
