@@ -14,7 +14,7 @@ from .mesh import (  # noqa: F401
 )
 from .parallel import (  # noqa: F401
     init_parallel_env, get_rank, get_world_size, is_initialized, barrier,
-    ParallelEnv,
+    shard_identity, ParallelEnv,
 )
 from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, get_group,

@@ -90,6 +90,23 @@ def get_world_size(group=None) -> int:
     return len(jax.devices())
 
 
+def shard_identity() -> tuple:
+    """(shard_id, world_size) of THIS process for per-shard telemetry
+    (ISSUE 13): the stable name a shard's StepMonitor JSONL stream and
+    its straggler gauges carry. Launcher env wins (spawn/launch set
+    PADDLE_TPU_PROCESS_ID before jax initializes — reading
+    jax.process_index() here would trigger backend init, the classic
+    ordering trap init_parallel_env documents); an already-initialized
+    multi-process runtime falls back to its process index."""
+    pid = os.environ.get("PADDLE_TPU_PROCESS_ID",
+                         os.environ.get("PADDLE_TRAINER_ID"))
+    world = os.environ.get("PADDLE_TPU_NUM_PROCESSES",
+                           os.environ.get("PADDLE_TRAINERS_NUM"))
+    if pid is not None:
+        return int(pid), int(world or 1)
+    return jax.process_index(), jax.process_count()
+
+
 def is_initialized() -> bool:
     return _mesh.get_mesh() is not None
 

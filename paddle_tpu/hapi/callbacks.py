@@ -397,13 +397,20 @@ class ProfilerCallback(Callback):
             self.profiler.stop()
             if self.monitor is not None and not self.profiler.timer_only:
                 # surface the captured trace's compute/comm overlap as
-                # the tracked `overlap_ratio` gauge (best effort: CPU
-                # fit runs may capture no device lanes)
+                # the tracked `overlap_ratio` gauge, and its per-
+                # collective ledger rows (ISSUE 13) as the labeled
+                # collective_* gauges — the decomposition dashboards
+                # track per op (best effort: CPU fit runs may capture no
+                # device lanes)
                 try:
                     from ..profiler.trace_analysis import analyze
-                    ov = analyze(self.profiler._trace_dir).overlap()
+                    an = analyze(self.profiler._trace_dir)
+                    ov = an.overlap()
                     if ov.get("ratio") is not None:
                         self.monitor.record_overlap(ov)
+                    rows = an.collective_rows()
+                    if rows:
+                        self.monitor.record_collectives(rows)
                 except Exception:
                     pass
         if self.monitor is not None and self.summary:

@@ -1900,23 +1900,41 @@ class ServingEngine:
         return reg
 
     def serve_telemetry(self, *, host: str = "127.0.0.1", port: int = 0,
-                        slo=None, registry=None, trace_capacity: int = 256):
+                        slo=None, poll_interval: Optional[float] = None,
+                        registry=None, trace_capacity: int = 256):
         """Boot the replica's ops surface: a started obs.TelemetryServer
         wired to this engine — /metrics from `metrics_registry()` (+ the
         SLO monitor's burn gauges when one is passed), /healthz from
         `health()`, /statusz from `statusz()`, /tracez from the metrics'
         tail-sampling TraceBuffer (created and attached here when the
         metrics don't carry one yet). Returns the server; `.close()` it
-        on shutdown."""
-        from ..obs import TelemetryServer, TraceBuffer
+        on shutdown.
+
+        `slo` is an obs.SLOMonitor or a parse_slo spec string
+        ("ttft_p99=500ms,goodput=0.95" — built over this engine's
+        metrics). With `poll_interval` (seconds) the SERVER owns the
+        burn-rate cadence: a timer thread drives slo.poll() for the
+        server's lifetime, so alerts fire without any external driver
+        and the thread shuts down with the server (the r15 NOTE
+        follow-up). The monitor rides `srv.slo` for introspection."""
+        from ..obs import SLOMonitor, TelemetryServer, TraceBuffer
         if self.metrics.trace_buffer is None:
             self.metrics.trace_buffer = TraceBuffer(trace_capacity)
         reg = registry if registry is not None else self.metrics_registry()
+        if isinstance(slo, str):
+            slo = SLOMonitor(slo, self.metrics)
         if slo is not None:
             reg.register("slo", slo.metrics_text)
-        return TelemetryServer(reg, host=host, port=port,
-                               health=self.health, status=self.statusz,
-                               tracez=self.metrics.trace_buffer).start()
+        elif poll_interval is not None:
+            raise ValueError("poll_interval needs an slo monitor/spec "
+                             "to poll")
+        srv = TelemetryServer(reg, host=host, port=port,
+                              health=self.health, status=self.statusz,
+                              tracez=self.metrics.trace_buffer)
+        srv.slo = slo
+        if slo is not None and poll_interval is not None:
+            srv.add_poller(slo.poll, poll_interval, name="slo")
+        return srv.start()
 
 
 def _hit_eos(row: np.ndarray, eos: Optional[int]) -> bool:

@@ -23,10 +23,29 @@ that surface, stdlib-only:
                      the structured JSONL path (slo.py; `parse_slo` /
                      `evaluate_slo` back the serve_bench --slo gate).
 
-`ServingEngine.serve_telemetry()` wires all four around a live engine;
+Fleet scope (ISSUE 13) — one replica's surface is not a fleet's:
+
+  FleetAggregator    scrapes N TelemetryServers into ONE merged,
+                     lint-clean page (counters summed, gauges labeled
+                     {replica=...}, histograms pooled bucket-wise) plus
+                     the /fleet/healthz roll-up and the trace_id-merged
+                     /fleet/tracez; a dead member goes stale and is
+                     degraded around, never a scrape 500 (fleet.py).
+  CollectiveLedger   per-collective comm attribution (bytes, bus
+                     bandwidth, exposed-vs-overlapped time) from a
+                     captured trace — the decomposition of the r13
+                     overlap_ratio gauge — plus shard-wall stitching for
+                     the StepMonitor straggler gauges (collectives.py).
+
+`ServingEngine.serve_telemetry()` wires all four around a live engine
+(and owns the SLO burn-rate poll cadence via `poll_interval=`);
 `hapi.callbacks.ProfilerCallback(telemetry=...)` exports a TRAINING
 loop's StepMonitor + live goodput gauges through the same server.
 """
+from .collectives import (CollectiveLedger, feed_shard_walls,  # noqa: F401
+                          load_shard_walls)
+from .fleet import (FleetAggregator, FleetMergeError,  # noqa: F401
+                    bucket_percentile, merge_exposition)
 from .registry import (ExpositionError, MetricsCollisionError,  # noqa: F401
                        MetricsRegistry, lint_exposition)
 from .server import TelemetryServer  # noqa: F401
@@ -36,4 +55,7 @@ from .tracez import TraceBuffer  # noqa: F401
 
 __all__ = ["ExpositionError", "MetricsCollisionError", "MetricsRegistry",
            "lint_exposition", "TelemetryServer", "SLOMonitor", "SLOTarget",
-           "parse_slo", "evaluate_slo", "format_slo_table", "TraceBuffer"]
+           "parse_slo", "evaluate_slo", "format_slo_table", "TraceBuffer",
+           "FleetAggregator", "FleetMergeError", "merge_exposition",
+           "bucket_percentile", "CollectiveLedger", "load_shard_walls",
+           "feed_shard_walls"]

@@ -1,0 +1,194 @@
+"""Collective ledger + shard-wall stitching — per-collective and per-shard
+attribution for multi-chip runs (ISSUE 13).
+
+The r13 `overlap_ratio` gauge answers "is communication hidden under
+compute, in aggregate?" — one scalar. The two consumers the distributed
+scale-out work needs answer finer questions:
+
+  CollectiveLedger   WHICH collective pays the exposed time. Wraps
+                     `profiler.trace_analysis.collective_rows()` (name,
+                     calls, bytes, bus bandwidth, overlapped-vs-EXPOSED
+                     time per op) with the reporting surface every other
+                     telemetry block has: `table()` for humans,
+                     `metrics_text()` for the registry/scrape path, and
+                     `summary()` for JSON. The T3 result (PAPERS.md arxiv
+                     2401.16677) is that comm/compute scheduling wins live
+                     at individual-collective granularity — this ledger is
+                     the budget that work is judged against.
+
+  shard walls        WHICH shard pays the step time. In single-controller
+                     SPMD every host runs the same program and the
+                     collective-synchronized step ends when the SLOWEST
+                     shard does; each shard's own StepMonitor already
+                     writes per-step JSONL rows, so `load_shard_walls`
+                     stitches N shard files into per-step wall maps and
+                     `feed_shard_walls` replays them through
+                     `StepMonitor.record_shard_steps` — skew gauges plus
+                     the transition-based structured straggler event.
+
+Both are pure host-side accounting: build them from a captured trace or
+from JSONL files after (or during) the run; nothing here touches device
+state.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from ..profiler._metrics import gauge_lines
+
+__all__ = ["CollectiveLedger", "load_shard_walls", "feed_shard_walls"]
+
+
+class CollectiveLedger:
+    """Per-collective attribution rows from one captured device trace.
+
+        ledger = CollectiveLedger.from_trace(trace_dir, steps=N)
+        print(ledger.table())
+        registry.register("collectives", ledger.metrics_text)
+
+    `rows` is `trace_analysis.collective_rows()` output: one dict per
+    collective op with dur_us/busy_us/overlapped_us/exposed_us,
+    exposed_frac, bytes and bus_gbps (None when the capture carries no
+    byte stats). `steps` divides the rendered table into per-step
+    figures; the exposition always reports whole-capture seconds.
+    """
+
+    def __init__(self, rows: List[dict], *, steps: Optional[int] = None,
+                 overlap: Optional[dict] = None):
+        self.rows = [dict(r) for r in rows]
+        self.steps = steps
+        self.overlap = dict(overlap) if overlap else None
+
+    # ------------------------------------------------------- construction
+    @classmethod
+    def from_analysis(cls, analysis, steps: Optional[int] = None
+                      ) -> "CollectiveLedger":
+        """From a trace_analysis.TraceAnalysis (steps defaults to its)."""
+        return cls(analysis.collective_rows(),
+                   steps=steps if steps is not None else analysis.steps,
+                   overlap=analysis.overlap())
+
+    @classmethod
+    def from_trace(cls, path_or_events, steps: Optional[int] = None
+                   ) -> "CollectiveLedger":
+        """From a trace file / capture directory / traceEvents list."""
+        from ..profiler.trace_analysis import analyze
+        return cls.from_analysis(analyze(path_or_events, steps=steps))
+
+    # ---------------------------------------------------------- reporting
+    def totals(self) -> dict:
+        busy = sum(r["busy_us"] for r in self.rows)
+        exposed = sum(r["exposed_us"] for r in self.rows)
+        nbytes = [r["bytes"] for r in self.rows if r["bytes"] is not None]
+        return {"collectives": len(self.rows),
+                "busy_us": busy,
+                "exposed_us": exposed,
+                "exposed_frac": exposed / busy if busy else 0.0,
+                "bytes": sum(nbytes) if nbytes else None}
+
+    def summary(self) -> dict:
+        return {"rows": [dict(r) for r in self.rows],
+                "totals": self.totals(),
+                "overlap": self.overlap,
+                "steps": self.steps}
+
+    def table(self, top: int = 20) -> str:
+        from ..profiler.trace_analysis import format_collective_rows
+        n = self.steps
+        div = max(n or 1, 1)
+        unit = "ms/step" if n else "ms"
+        lines = ["---- Collective ledger ----"]
+        if not self.rows:
+            lines.append("no collective ops in capture "
+                         "(single-chip step)")
+            return "\n".join(lines)
+        lines += format_collective_rows(self.rows, steps=n, top=top)
+        t = self.totals()
+        lines.append(f"exposed total {t['exposed_us'] / div / 1e3:.3f} "
+                     f"{unit} ({t['exposed_frac'] * 100:.1f}% of "
+                     f"collective busy time)")
+        return "\n".join(lines)
+
+    def metrics_text(self, prefix: str = "paddle_tpu_comm") -> str:
+        """Registry-composable exposition: per-op labeled gauges + the
+        exposed-time roll-up, rendered from the series table shared with
+        StepMonitor (trace_analysis.collective_series_lines). The
+        default prefix keeps these family names
+        (`paddle_tpu_comm_collective_*`) disjoint from the monitor's
+        adopted block (`paddle_tpu_collective_*`), so a process may
+        register a standalone ledger AND a monitor that has
+        record_collectives'd the same rows without a registry
+        collision."""
+        from ..profiler.trace_analysis import collective_series_lines
+        lines = collective_series_lines(self.rows, prefix)
+        t = self.totals()
+        lines += gauge_lines(prefix, "collective_exposed_ratio",
+                             t["exposed_frac"],
+                             "exposed collective time / collective busy "
+                             "time (0 = fully hidden)")
+        if self.overlap and self.overlap.get("ratio") is not None:
+            lines += gauge_lines(prefix, "collective_overlap_ratio",
+                                 self.overlap["ratio"],
+                                 "fraction of collective time hidden "
+                                 "under device compute")
+        return "\n".join(lines) + "\n" if lines else ""
+
+
+# ------------------------------------------------------------ shard walls
+
+def load_shard_walls(path_or_paths, *, pattern: str = ".jsonl"
+                     ) -> Dict[int, Dict[str, float]]:
+    """Stitch per-shard StepMonitor JSONL streams into per-step wall maps.
+
+    `path_or_paths`: a directory (every ``*<pattern>`` file inside is one
+    shard's stream, shard id = the file's stem) or an explicit
+    ``{shard_id: path}`` mapping. Rows are StepMonitor step records —
+    anything with both ``step`` and ``wall_s`` counts; overlap/numerics/
+    straggler rows in the same stream are skipped. Returns
+    ``{step: {shard_id: wall_s}}`` with steps ascending — feed each value
+    to `StepMonitor.record_shard_steps` (or use `feed_shard_walls`).
+    """
+    if isinstance(path_or_paths, dict):
+        files = {str(k): v for k, v in path_or_paths.items()}
+    else:
+        files = {}
+        for fn in sorted(os.listdir(path_or_paths)):
+            if fn.endswith(pattern):
+                shard = fn[:-len(pattern)]
+                for pre in ("shard_", "shard-"):
+                    if shard.startswith(pre):
+                        shard = shard[len(pre):]
+                files[shard] = os.path.join(path_or_paths, fn)
+    by_step: Dict[int, Dict[str, float]] = {}
+    for shard, path in files.items():
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "step" not in row or "wall_s" not in row:
+                    continue
+                by_step.setdefault(int(row["step"]), {})[shard] = \
+                    float(row["wall_s"])
+    return dict(sorted(by_step.items()))
+
+
+def feed_shard_walls(monitor, walls_by_step: Dict[int, Dict[str, float]],
+                     *, complete_only: bool = True) -> List[dict]:
+    """Replay stitched shard walls through a StepMonitor's skew state
+    machine, in step order. `complete_only` skips steps where some shard
+    has no record yet (a shard mid-step or a torn tail line would read as
+    an infinite-skew ghost straggler). Returns the skew dicts recorded."""
+    out = []
+    world = max((len(w) for w in walls_by_step.values()), default=0)
+    for step, walls in walls_by_step.items():
+        if complete_only and len(walls) < world:
+            continue
+        out.append(monitor.record_shard_steps(walls, step=step))
+    return out

@@ -1,0 +1,591 @@
+"""FleetAggregator — N replica ops surfaces scraped into ONE (ISSUE 13).
+
+r15 made every replica scrapeable; a router or autoscaler consuming N
+separate pages re-implements aggregation badly (averaged percentiles, a
+dead replica 500ing the dashboard). This module is the aggregation layer,
+stdlib-only like the rest of obs/:
+
+Merge semantics per metric TYPE (`merge_exposition`):
+
+  counter     SUMMED across replicas per (family, label set) — fleet
+              requests_total is the sum, exactly what a rate() wants.
+  gauge       NEVER summed or averaged: each replica's sample is kept and
+              labeled ``{replica="<name>"}`` (a fleet-mean queue depth of
+              2 hides one replica at 0 and one at 4 — the router needs
+              both; `/fleet/healthz` carries the sums that ARE meaningful,
+              chosen by hand). Untyped families merge like gauges.
+  histogram   merged BUCKET-WISE: the log-bucket histograms are mergeable
+              by construction (same bucket layout on every replica since
+              they run the same code), so per-`le` cumulative counts and
+              `_sum`/`_count` just add. The fleet p99 then derives from
+              the POOLED buckets — never from averaging per-replica
+              percentiles, which is statistically meaningless. Replicas
+              whose populated bounds cannot belong to one shared layout
+              are rejected with a structured `FleetMergeError` naming the
+              family and replicas (the check accepts any bound sets that
+              fit one common geometric OR arithmetic grid — exposition
+              pages elide empty buckets, so layout equality can only be
+              checked up to the populated bounds).
+
+Staleness (the degrade rule): a replica whose scrape fails (connection
+refused / timeout / bad payload) is marked ``stale`` and EXCLUDED from
+the merge — the merged page keeps serving from the live replicas and the
+fleet block reports the stale count; a scrape of the fleet endpoint never
+500s because a member died. A stale replica rejoins automatically on its
+next successful scrape. `/fleet/healthz` rolls the member healthz pages
+into the autoscaler/router input: serving/draining/stale counts plus
+summed queue depth, inflight and `overloaded_total`. `/fleet/tracez`
+merges the members' tail-sampled trace rings on `trace_id` (unique
+fleet-wide by construction: engine-run-uuid8 + request id), so two
+aggregation layers — or one aggregator scraping twice — cannot
+double-count a trace.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
+from urllib.error import HTTPError
+from urllib.request import urlopen
+
+from ..profiler._metrics import (ExpositionError, format_value,
+                                 parse_exposition)
+from .registry import MetricsRegistry, lint_exposition
+
+__all__ = ["FleetAggregator", "FleetMergeError", "merge_exposition",
+           "bucket_percentile"]
+
+_REL_TOL = 1e-6
+
+
+class FleetMergeError(ExpositionError):
+    """Replica pages cannot be merged (structured: .family, .replicas,
+    .detail survive for programmatic handling)."""
+
+    def __init__(self, family: str, detail: str, replicas=()):
+        self.family = family
+        self.detail = detail
+        self.replicas = sorted(replicas)
+        super().__init__(f"cannot merge family {family!r} across "
+                         f"replicas {self.replicas}: {detail}")
+
+    def to_dict(self) -> dict:
+        return {"error": "fleet_merge", "family": self.family,
+                "replicas": self.replicas, "detail": self.detail}
+
+
+# ------------------------------------------------------------- merge math
+
+def _common_step(gaps: List[float]) -> float:
+    """Approximate real GCD of the gaps (symmetric-remainder Euclid with
+    a relative tolerance): the candidate grid step the bounds sit on.
+    Incommensurable gaps (bounds from two different layouts) drive this
+    toward zero instead of a sensible step."""
+    tol = max(gaps) * _REL_TOL
+    g = gaps[0]
+    for d in gaps[1:]:
+        a, b = max(g, d), min(g, d)
+        while b > tol:
+            r = math.fmod(a, b)
+            r = min(r, abs(b - r))      # nearest-integer quotient:
+            #                             2.0 % 0.5 must read as 0, not
+            #                             ~0.4999 fp noise
+            a, b = b, r
+        g = a
+    return g
+
+
+def _grid_consistent(bounds: List[float]) -> bool:
+    """Can these populated bucket bounds all belong to ONE layout?
+
+    Exposition pages elide empty buckets, so the full layout is not
+    observable; the necessary condition checked here is that the union
+    fits a single geometric grid (log-spaced latency histograms: gaps in
+    log10 space share a common step) or a single arithmetic grid (the
+    half-integer spec_accept_len bounds: linear gaps share one). The
+    common step comes from a real-GCD of the gaps; bounds from disjoint
+    layouts (a shifted lo, a log grid mixed into a linear one) drive the
+    GCD toward zero, detected as a step implausibly finer than the
+    smallest observed gap. Nested refinements of one grid pass — merging
+    them is still a valid cumulative histogram, each replica contributing
+    at its own bucket resolution."""
+    if len(bounds) <= 2:
+        return True
+
+    def fits(gaps: List[float]) -> bool:
+        if min(gaps) <= 0:
+            return False
+        g = _common_step(gaps)
+        # a real layout's populated bounds sit a handful of grid steps
+        # apart; a pseudo-step 64x finer than the closest observed pair
+        # is the incommensurable case converging toward zero
+        return g >= min(gaps) / 64.0
+
+    lin = [b - a for a, b in zip(bounds, bounds[1:])]
+    if fits(lin):
+        return True
+    if all(b > 0 for b in bounds):
+        logs = [math.log10(b) for b in bounds]
+        if fits([b - a for a, b in zip(logs, logs[1:])]):
+            return True
+    return False
+
+
+def _hist_parts(name: str, fam: dict) -> Tuple[List[Tuple[float, float]],
+                                               float, float]:
+    """(finite (le, cumulative) buckets ascending, count, sum) of one
+    replica's histogram family."""
+    buckets: List[Tuple[float, float]] = []
+    count = total = 0.0
+    for base, labels, value in fam["samples"]:
+        if base == f"{name}_bucket":
+            le = labels[1:-1].split("=", 1)[1].strip('"')
+            if le != "+Inf":
+                buckets.append((float(le), float(value)))
+        elif base == f"{name}_count":
+            count = float(value)
+        elif base == f"{name}_sum":
+            total = float(value)
+    buckets.sort()
+    return buckets, count, total
+
+
+def _merge_histogram(name: str, per_replica: Dict[str, dict]) -> List[str]:
+    parts = {rep: _hist_parts(name, fam)
+             for rep, fam in per_replica.items()}
+    bounds = sorted({b for bks, _, _ in parts.values() for b, _ in bks})
+    if not _grid_consistent(bounds):
+        raise FleetMergeError(
+            name, f"populated bucket bounds {bounds} do not fit one "
+                  f"layout — replicas must run the same histogram config "
+                  f"(lo/hi/per_decade) for bucket-wise pooling to be "
+                  f"meaningful", per_replica)
+    count = sum(c for _, c, _ in parts.values())
+    total = sum(s for _, _, s in parts.values())
+    lines: List[str] = []
+    prev_cum = 0.0
+    for u in bounds:
+        cum = 0.0
+        for bks, _, _ in parts.values():
+            # cumulative at u = the replica's cumulative at its largest
+            # populated bound <= u (elided buckets held zero, so the
+            # cumulative count is flat between populated bounds)
+            at = 0.0
+            for b, c in bks:
+                if b <= u:
+                    at = c
+                else:
+                    break
+            cum += at
+        if cum > prev_cum:      # elide empty merged buckets like the
+            #                     renderer does; cumulativity unaffected
+            lines.append(f'{name}_bucket{{le="{format_value(u)}"}} '
+                         f'{format_value(cum)}')
+        prev_cum = cum
+    lines.append(f'{name}_bucket{{le="+Inf"}} {format_value(count)}')
+    lines.append(f"{name}_sum {format_value(total)}")
+    lines.append(f"{name}_count {format_value(count)}")
+    return lines
+
+
+def _with_replica(labels: str, replica: str) -> str:
+    inner = labels[1:-1].strip() if labels else ""
+    parts = [f'replica="{replica}"'] + ([inner] if inner else [])
+    return "{" + ",".join(parts) + "}"
+
+
+def merge_exposition(pages: Dict[str, str], *,
+                     validate: bool = True) -> str:
+    """Merge per-replica exposition pages into one (module docstring for
+    the per-type semantics). `pages` maps replica name -> page text; an
+    empty/blank page contributes nothing (a young replica is not an
+    error). The result is family-contiguous and lint-clean by
+    construction; `validate=True` lints each input page first so a broken
+    REPLICA page is named rather than corrupting the merge."""
+    parsed: Dict[str, dict] = {}
+    for rep, text in pages.items():
+        if text is None or not text.strip():
+            continue
+        try:
+            parsed[rep] = lint_exposition(text) if validate \
+                else parse_exposition(text)
+        except ExpositionError as e:
+            raise FleetMergeError("<page>", f"replica page does not "
+                                  f"lint: {e}", [rep]) from e
+    order: List[str] = []
+    owners: Dict[str, Dict[str, dict]] = {}
+    for rep, fams in parsed.items():
+        for name, fam in fams.items():
+            if name not in owners:
+                owners[name] = {}
+                order.append(name)
+            owners[name][rep] = fam
+    out: List[str] = []
+    for name in order:
+        per = owners[name]
+        kinds = {fam["type"] for fam in per.values()}
+        if len(kinds) > 1:
+            raise FleetMergeError(name, f"replicas disagree on TYPE "
+                                  f"({sorted(kinds)})", per)
+        kind = kinds.pop()
+        first = next(iter(per.values()))
+        out.append(f"# HELP {name} {first['help']}")
+        out.append(f"# TYPE {name} {kind}")
+        if kind == "counter":
+            sums: Dict[str, float] = {}
+            key_order: List[str] = []
+            for rep, fam in per.items():
+                for base, labels, value in fam["samples"]:
+                    key = f"{base}{labels}"
+                    if key not in sums:
+                        sums[key] = 0.0
+                        key_order.append(key)
+                    sums[key] += float(value)
+            out += [f"{key} {format_value(sums[key])}"
+                    for key in key_order]
+        elif kind == "histogram":
+            out += _merge_histogram(name, per)
+        else:                    # gauge / untyped: label per replica
+            for rep, fam in per.items():
+                out += [f"{base}{_with_replica(labels, rep)} "
+                        f"{value}"
+                        for base, labels, value in fam["samples"]]
+    return "\n".join(out) + "\n" if out else ""
+
+
+def bucket_percentile(buckets: List[Tuple[float, float]], count: float,
+                      q: float) -> Optional[float]:
+    """Percentile from parsed cumulative (le, cum) exposition buckets —
+    the read-side twin of LogHistogram.percentile for a scraped page
+    (without the recorder's min/max clamp, so edges resolve to bucket
+    bounds; relative error stays bounded by the bucket ratio). `buckets`
+    ascending with the +Inf bucket as float('inf')."""
+    if not count:
+        return None
+    target = q * count
+    prev_bound = None
+    prev_cum = 0.0
+    for bound, cum in buckets:
+        if cum >= target and cum > prev_cum:
+            if math.isinf(bound):
+                return prev_bound
+            lo = prev_bound if prev_bound is not None else 0.0
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return lo + frac * (bound - lo)
+        if cum > prev_cum:
+            prev_bound = bound
+            prev_cum = cum
+    return prev_bound
+
+
+# ------------------------------------------------------------- aggregator
+
+class _Replica:
+    def __init__(self, name: str, base_url: str):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.stale = False
+        self.consecutive_failures = 0
+        self.last_ok: Optional[float] = None
+        self.last_error: Optional[str] = None
+
+    def mark_ok(self):
+        self.stale = False
+        self.consecutive_failures = 0
+        self.last_ok = time.time()
+        self.last_error = None
+
+    def mark_failed(self, err: str):
+        self.stale = True
+        self.consecutive_failures += 1
+        self.last_error = err
+
+    def state(self) -> dict:
+        return {"url": self.base_url, "stale": self.stale,
+                "consecutive_failures": self.consecutive_failures,
+                "last_ok_ts": self.last_ok,
+                "last_error": self.last_error}
+
+
+class FleetAggregator:
+    """Scrape N TelemetryServer replicas, serve ONE merged surface.
+
+        fleet = FleetAggregator({"r0": srv0.url(), "r1": srv1.url()})
+        page = fleet.merged_metrics()      # lint-clean, pooled
+        fleet.fleet_healthz()              # the autoscaler roll-up
+        agg_srv = fleet.serve()            # /metrics /healthz
+                                           # /fleet/healthz /fleet/tracez
+
+    `replicas`: {name: base_url} (or an iterable of (name, url) /
+    TelemetryServer instances — a server contributes its url() under the
+    name replicaN). Scrapes run concurrently (one slow member must not
+    serialize the page) with `timeout` seconds per request; failures mark
+    the member stale per the module-docstring degrade rule.
+    """
+
+    def __init__(self, replicas=None, *, timeout: float = 2.0,
+                 prefix: str = "paddle_tpu_fleet"):
+        self.timeout = float(timeout)
+        self.prefix = prefix
+        self.scrapes_total = 0
+        self.scrape_errors_total = 0
+        self._replicas: Dict[str, _Replica] = {}
+        self._lock = threading.Lock()
+        # one long-lived scrape pool: the fleet /metrics route is pull-
+        # through, so a per-call executor would churn threads on every
+        # scrape of every route (close() tears it down; workers are
+        # urlopen calls with timeouts, so shutdown is bounded)
+        self._pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="paddle-tpu-fleet-scrape")
+        for name, url in self._coerce(replicas):
+            self.add_replica(name, url)
+
+    def close(self):
+        """Release the scrape thread pool. Safe to call more than once;
+        a served aggregator should close AFTER its TelemetryServer."""
+        self._pool.shutdown(wait=False)
+
+    @staticmethod
+    def _coerce(replicas) -> List[tuple]:
+        """(name, url-or-TelemetryServer) pairs; add_replica finishes the
+        coercion so servers work in every container shape."""
+        if replicas is None:
+            return []
+        if isinstance(replicas, dict):
+            return [(str(k), v) for k, v in replicas.items()]
+        out = []
+        for i, item in enumerate(replicas):
+            if isinstance(item, tuple):
+                out.append((str(item[0]), item[1]))
+            else:
+                out.append((f"replica{i}", item))
+        return out
+
+    def add_replica(self, name: str, url_or_server) -> "FleetAggregator":
+        url = url_or_server.url("/") if hasattr(url_or_server, "url") \
+            else str(url_or_server)
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"replica {name!r} already registered")
+            self._replicas[name] = _Replica(name, url)
+        return self
+
+    def remove_replica(self, name: str) -> bool:
+        with self._lock:
+            return self._replicas.pop(name, None) is not None
+
+    @property
+    def replicas(self) -> List[str]:
+        with self._lock:
+            return list(self._replicas)
+
+    def replica_states(self) -> Dict[str, dict]:
+        with self._lock:
+            return {n: r.state() for n, r in self._replicas.items()}
+
+    # ------------------------------------------------------------ scraping
+    def _get(self, url: str, ok_codes: Tuple[int, ...] = ()) -> bytes:
+        """GET with the per-route error policy: some HTTPError bodies ARE
+        the payload (a draining replica's /healthz is a 503 WITH the JSON
+        the roll-up needs; a bufferless /tracez is a 404 saying so) —
+        those codes pass through; anything else raises and the member
+        degrades to stale (a replica whose /metrics 500s is dead for
+        metrics purposes — its broken producer must not take the FLEET
+        page down)."""
+        try:
+            with urlopen(url, timeout=self.timeout) as resp:
+                return resp.read()
+        except HTTPError as e:
+            body = e.read()
+            if e.code in ok_codes and body:
+                return body
+            raise
+
+    def _scrape_route(self, route: str,
+                      decode: Callable[[bytes], object],
+                      ok_codes: Tuple[int, ...] = ()) -> Dict[str, object]:
+        """GET one route from every replica concurrently; successes update
+        liveness, failures mark stale. Returns {name: decoded} for the
+        replicas that answered."""
+        with self._lock:
+            members = list(self._replicas.values())
+            self.scrapes_total += 1
+        if not members:
+            return {}
+        results: Dict[str, object] = {}
+
+        def one(rep: _Replica):
+            return decode(self._get(rep.base_url + route, ok_codes))
+
+        futs = {self._pool.submit(one, rep): rep for rep in members}
+        for fut, rep in futs.items():
+            try:
+                payload = fut.result()
+            except Exception as e:          # noqa: BLE001 — the degrade
+                # rule: a dead member goes stale; the fleet surface
+                # keeps serving from the rest
+                with self._lock:            # counters are exposed on the
+                    # fleet block and handlers run on many server
+                    # threads: unsynchronized += drops increments
+                    self.scrape_errors_total += 1
+                rep.mark_failed(f"{type(e).__name__}: {e}")
+                continue
+            rep.mark_ok()
+            results[rep.name] = payload
+        return results
+
+    # ------------------------------------------------------------- surface
+    def merged_metrics(self) -> str:
+        """One fresh scrape of every member's /metrics, merged + the
+        aggregator's own fleet block, linted before it leaves. Stale
+        members are degraded around; a FleetMergeError (mismatched
+        layouts, TYPE disagreement) is a REAL error and propagates —
+        silently dropping a replica's data would be worse than failing
+        the scrape visibly."""
+        pages = self._scrape_route(
+            "/metrics", lambda b: b.decode("utf-8", "replace"))
+        merged = merge_exposition(pages)
+        page = self._fleet_block() + merged
+        lint_exposition(page)
+        return page
+
+    def _fleet_block(self) -> str:
+        states = self.replica_states()
+        stale = sum(1 for s in states.values() if s["stale"])
+        p = self.prefix
+        lines = [
+            f"# HELP {p}_replicas registered replicas by liveness",
+            f"# TYPE {p}_replicas gauge",
+            f'{p}_replicas{{state="live"}} {len(states) - stale}',
+            f'{p}_replicas{{state="stale"}} {stale}',
+            f"# HELP {p}_up replica answered its last scrape",
+            f"# TYPE {p}_up gauge"]
+        lines += [f'{p}_up{{replica="{n}"}} '
+                  f'{0 if s["stale"] else 1}'
+                  for n, s in sorted(states.items())]
+        lines += [
+            f"# HELP {p}_scrape_errors_total failed member scrapes",
+            f"# TYPE {p}_scrape_errors_total counter",
+            f"{p}_scrape_errors_total {self.scrape_errors_total}"]
+        return "\n".join(lines) + "\n"
+
+    def fleet_healthz(self, _query: Optional[dict] = None) -> dict:
+        """The roll-up a router/autoscaler consumes: member healthz pages
+        summed where summing means something (queue depth, inflight,
+        overloaded/rejected totals) and counted where it does not
+        (serving/draining/stale states). `status` is "ok" while at least
+        one member serves; "unserviceable" (-> HTTP 503 through a
+        TelemetryServer health route) when none does — the fleet-level LB
+        ejection signal."""
+        payloads = self._scrape_route("/healthz", json.loads,
+                                      ok_codes=(503,))
+        states = self.replica_states()
+        serving = draining = 0
+        sums = {"queue_depth": 0, "queue_capacity": 0, "inflight": 0,
+                "overloaded_total": 0, "rejected_total": 0}
+        per: Dict[str, dict] = {}
+        for name, state in sorted(states.items()):
+            h = payloads.get(name)
+            if h is None:
+                per[name] = {"state": "stale", **state}
+                continue
+            is_draining = bool(h.get("draining")) \
+                or h.get("status") == "draining"
+            draining += 1 if is_draining else 0
+            serving += 0 if is_draining else 1
+            for key in sums:
+                v = h.get(key)
+                if isinstance(v, (int, float)):
+                    sums[key] += v
+            per[name] = {"state": "draining" if is_draining
+                         else "serving", **{k: h.get(k) for k in
+                                            ("queue_depth", "inflight",
+                                             "overloaded_total")}}
+        return {"status": "ok" if serving else "unserviceable",
+                "replicas": len(states),
+                "serving": serving, "draining": draining,
+                "stale": len(states) - serving - draining,
+                **sums,
+                "per_replica": per}
+
+    def fleet_tracez(self, query: Optional[dict] = None) -> dict:
+        """Member /tracez rings merged on trace_id. Query params (the
+        /fleet/tracez route forwards them): limit (per the MERGED view,
+        default 64), status, order=recent|slowest. Each retained trace
+        carries its `replica`; duplicates (same trace_id seen via two
+        scrape paths) keep the first copy."""
+        query = query or {}
+        limit = int(query.get("limit", 64))
+        status = query.get("status")
+        order = query.get("order", "recent")
+        if order not in ("recent", "slowest"):
+            raise ValueError(f"order must be 'recent' or 'slowest', "
+                             f"got {order!r}")
+        member_q = f"/tracez?limit={max(limit, 1)}" \
+            + (f"&status={status}" if status else "") \
+            + (f"&order={order}" if order else "")
+        payloads = self._scrape_route(member_q, json.loads,
+                                      ok_codes=(404,))
+        seen = set()
+        merged: List[dict] = []
+        summaries: Dict[str, dict] = {}
+        # round-robin over members preserves each ring's newest-first
+        # order in the "recent" view without a shared clock
+        iters = {name: iter(p.get("traces", []))
+                 for name, p in sorted(payloads.items())}
+        for name, p in payloads.items():
+            summaries[name] = p.get("summary", {})
+        while iters:
+            for name in list(iters):
+                try:
+                    rec = next(iters[name])
+                except StopIteration:
+                    del iters[name]
+                    continue
+                tid = rec.get("trace_id") or f"{name}/{rec.get('id')}"
+                if tid in seen:
+                    continue
+                seen.add(tid)
+                merged.append(dict(rec, replica=name))
+        if order == "slowest":
+            merged.sort(key=lambda r: -(r.get("e2e_s") or 0.0))
+        merged = merged[:max(limit, 0)]
+        retained = sum(s.get("retained", 0) for s in summaries.values())
+        return {"summary": {"replicas": len(self.replica_states()),
+                            "answered": len(payloads),
+                            "retained": retained,
+                            "merged": len(merged),
+                            "per_replica": summaries},
+                "traces": merged}
+
+    def fleet_statusz(self, _query: Optional[dict] = None) -> dict:
+        return {"replicas": self.replica_states(),
+                "scrapes_total": self.scrapes_total,
+                "scrape_errors_total": self.scrape_errors_total,
+                "timeout_s": self.timeout}
+
+    # -------------------------------------------------------------- serve
+    def serve(self, *, host: str = "127.0.0.1", port: int = 0):
+        """A started TelemetryServer over this aggregator: /metrics = the
+        merged page (scraped fresh per request), /healthz = the roll-up
+        (503 when zero members serve), /statusz = member liveness, plus
+        the explicit /fleet/healthz and /fleet/tracez routes the ISSUE
+        names (handy when the aggregator page is mounted next to a
+        replica's behind one proxy)."""
+        from .server import TelemetryServer
+        reg = MetricsRegistry()
+        # the merged page is already one fully-rendered exposition; keep
+        # the registry as the composition point (a co-hosted SLO/goodput
+        # producer can still be registered beside it)
+        reg.register("fleet", self.merged_metrics)
+        srv = TelemetryServer(
+            reg, host=host, port=port,
+            health=self.fleet_healthz, status=self.fleet_statusz,
+            routes={"/fleet/healthz": self.fleet_healthz,
+                    "/fleet/tracez": self.fleet_tracez,
+                    "/fleet/statusz": self.fleet_statusz})
+        srv.fleet = self
+        return srv.start()

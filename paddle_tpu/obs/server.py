@@ -21,14 +21,29 @@ the trace ring, config scalars) — they never touch device state or the
 engine's serving loop, so a scrape cannot trigger a compile, a sync or a
 lock-order inversion with the serving thread. That is the whole design:
 the ops surface rides the accounting the engine already keeps.
+
+Two fleet-era extensions (ISSUE 13):
+
+  `routes={...}`   extra JSON GET routes — a handler is a callable taking
+                   the (single-valued) query-param dict and returning a
+                   JSON-able payload; `FleetAggregator.serve()` mounts
+                   /fleet/healthz and /fleet/tracez this way.
+  `add_poller()`   a server-OWNED timer thread calling `fn()` every
+                   `interval` seconds between start() and close() — the
+                   cadence owner the SLOMonitor NOTE asked for: burn-rate
+                   evaluation (and its push alerts) run without any
+                   external driver, and the thread dies with the server.
 """
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlsplit
+
+_logger = logging.getLogger("paddle_tpu.obs.server")
 
 from .registry import MetricsRegistry
 from .tracez import TraceBuffer
@@ -74,7 +89,20 @@ class _Handler(BaseHTTPRequestHandler):
         route = "/" + url.path.strip("/")
         srv: "TelemetryServer" = self.server.telemetry
         try:
-            if route == "/metrics":
+            extra = srv.routes.get(route)
+            if extra is not None:
+                q = parse_qs(url.query)
+                try:
+                    payload = extra({k: v[0] for k, v in q.items() if v})
+                except ValueError as e:
+                    # handler contract: ValueError = bad CLIENT input
+                    # (?limit=abc) — a 400, not a 500 a monitor would
+                    # page on as an aggregator failure
+                    self._send_json(400, {"error": str(e)})
+                    return
+                self._send_json(200, payload if payload is not None
+                                else {})
+            elif route == "/metrics":
                 body = srv.registry.render().encode()
                 self._send(200, body, _CONTENT_PROM)
             elif route == "/healthz":
@@ -102,7 +130,8 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send_json(404, {"error": f"unknown route {route}",
                                       "routes": ["/metrics", "/healthz",
-                                                 "/statusz", "/tracez"]})
+                                                 "/statusz", "/tracez"]
+                                      + sorted(srv.routes)})
         except BrokenPipeError:
             pass                                # scraper hung up; its call
         except Exception as e:                  # noqa: BLE001 — a broken
@@ -132,16 +161,21 @@ class TelemetryServer:
                  host: str = "127.0.0.1", port: int = 0,
                  health: Optional[Callable[[], dict]] = None,
                  status: Optional[Callable[[], dict]] = None,
-                 tracez: Optional[TraceBuffer] = None):
+                 tracez: Optional[TraceBuffer] = None,
+                 routes: Optional[Dict[str, Callable]] = None):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.health = health
         self.status = status
         self.tracez = tracez
+        # extra JSON routes: "/fleet/healthz" -> fn(query_dict) -> payload
+        self.routes = {("/" + r.strip("/")): fn
+                       for r, fn in (routes or {}).items()}
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.telemetry = self
         self._thread: Optional[threading.Thread] = None
+        self._pollers: list = []
 
     @staticmethod
     def _call(fn):
@@ -158,6 +192,51 @@ class TelemetryServer:
     def url(self, route: str = "/") -> str:
         return f"http://{self.host}:{self.port}/{route.lstrip('/')}"
 
+    # ------------------------------------------------------------ pollers
+    def add_poller(self, fn: Callable[[], object], interval: float,
+                   name: str = "poller") -> "TelemetryServer":
+        """Own a timer thread calling `fn()` every `interval` seconds
+        for the server's lifetime (first call one interval after start —
+        a burn-rate window needs traffic before it means anything). A
+        raising poll is logged and counted on the poller record, never
+        fatal: the alerting loop must not die on one transient. Threads
+        start with start() and stop with close()."""
+        if interval is None or interval <= 0:
+            raise ValueError(f"poller interval must be > 0, "
+                             f"got {interval}")
+        rec = {"fn": fn, "interval": float(interval), "name": name,
+               "stop": threading.Event(), "thread": None,
+               "polls": 0, "errors": 0}
+        self._pollers.append(rec)
+        if self._thread is not None:        # server already serving
+            self._start_poller(rec)
+        return self
+
+    def _start_poller(self, rec):
+        if rec["thread"] is not None:
+            return
+        if rec["stop"].is_set():            # server re-started post-close
+            rec["stop"] = threading.Event()
+
+        def loop():
+            while not rec["stop"].wait(rec["interval"]):
+                try:
+                    rec["fn"]()
+                    rec["polls"] += 1
+                except Exception:           # noqa: BLE001 — see docstring
+                    rec["errors"] += 1
+                    _logger.exception("telemetry poller %r failed",
+                                      rec["name"])
+        rec["thread"] = threading.Thread(
+            target=loop, name=f"paddle-tpu-telemetry-{rec['name']}",
+            daemon=True)
+        rec["thread"].start()
+
+    @property
+    def pollers(self) -> list:
+        return [{k: r[k] for k in ("name", "interval", "polls", "errors")}
+                for r in self._pollers]
+
     def start(self) -> "TelemetryServer":
         if self._thread is None:
             self._thread = threading.Thread(
@@ -165,9 +244,17 @@ class TelemetryServer:
                 kwargs={"poll_interval": 0.1},
                 name="paddle-tpu-telemetry", daemon=True)
             self._thread.start()
+        for rec in self._pollers:
+            self._start_poller(rec)
         return self
 
     def close(self):
+        for rec in self._pollers:
+            rec["stop"].set()
+        for rec in self._pollers:
+            if rec["thread"] is not None:
+                rec["thread"].join(timeout=5.0)
+                rec["thread"] = None
         if self._thread is not None:
             self._httpd.shutdown()
             self._thread.join(timeout=5.0)

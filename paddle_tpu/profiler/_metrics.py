@@ -57,6 +57,21 @@ def gauge_lines(prefix: str, name: str, value, help_: str,
         [f"{full}{lab} {format_value(value)}"]
 
 
+def labeled_gauge_lines(prefix: str, name: str, label_key: str,
+                        samples, help_: str) -> List[str]:
+    """Render one gauge family with MULTIPLE labeled samples (gauge_lines
+    renders exactly one): `samples` is an iterable of (label_value,
+    value) pairs; pairs with a None value are skipped, and a family with
+    no surviving samples renders nothing."""
+    kept = [(lv, v) for lv, v in samples if v is not None]
+    if not kept:
+        return []
+    full = f"{prefix}_{name}" if prefix else name
+    return _header(prefix, name, "gauge", help_) + \
+        [f'{full}{{{label_key}="{lv}"}} {format_value(v)}'
+         for lv, v in kept]
+
+
 def counter_lines(prefix: str, name: str, value, help_: str) -> List[str]:
     """Render one counter; by convention `name` should end in `_total`."""
     if value is None:

@@ -11,10 +11,20 @@ the steady-state gauge cluster a production run keeps on every step:
   - jit cache-miss counts and a RECOMPILATION DETECTOR: when a traced step
     compiles again, the offending abstract-shape delta (old vs new
     shape/dtype signature) is logged and recorded
+  - per-shard step-wall SKEW (`record_shard_steps`, ISSUE 13): in a
+    multi-shard job every shard times its own step; feeding the walls here
+    yields slowest-shard / skew-ratio gauges and a structured straggler
+    event on the TRANSITION into sustained skew — the fleet-level signal
+    that one host/chip is dragging the collective-synchronized step.
+  - the per-collective comm ledger (`record_collectives`, ISSUE 13):
+    trace_analysis.collective_rows() adopted as tracked gauges, labeled
+    per op — the decomposition of `overlap_ratio` the quantized-collective
+    and comm-scheduling work is judged against.
 
 Each step appends one JSONL row when `jsonl_path` is set, and `on_report`
 (if given) is called with the row dict — the hook a metrics exporter or a
-live dashboard attaches to. `jit.TrainStep(monitor=...)` wires this in
+live dashboard attaches to (`_emit` is the shared path, mirroring
+ServingMetrics). `jit.TrainStep(monitor=...)` wires this in
 automatically; `hapi` exposes it as `callbacks.ProfilerCallback`.
 """
 from __future__ import annotations
@@ -65,7 +75,8 @@ class StepMonitor:
                  on_report: Optional[Callable[[dict], None]] = None,
                  track_memory: bool = True,
                  memory_sample_every: Optional[int] = None,
-                 log_recompiles: bool = True):
+                 log_recompiles: bool = True,
+                 straggler_threshold: float = 1.5):
         self.flops_per_step = flops_per_step
         self.flops_per_item = flops_per_item
         self.items_per_step = items_per_step
@@ -82,6 +93,16 @@ class StepMonitor:
         self.log_recompiles = log_recompiles
         self.records = []          # one dict per end_step
         self.overlap = None        # latest compute/comm overlap (dict)
+        self.collectives = []      # latest per-collective ledger rows
+        # shard-skew state (ISSUE 13): `straggler_threshold` is the skew
+        # ratio (slowest shard wall / median shard wall) at/above which a
+        # shard counts as straggling; the structured event fires once per
+        # TRANSITION into (and out of) that state, never per step
+        self.straggler_threshold = float(straggler_threshold)
+        self.shard_skew = None     # latest record_shard_steps figures
+        self.straggler_events = []  # straggler/straggler_clear rows
+        self.stragglers_total = 0   # transitions INTO straggling
+        self._straggling = False
         self.compiles = 0          # traced-step compiles observed
         self.recompiles = 0        # compiles beyond the first per kind
         self.recompile_events = []  # {step, kind, delta}
@@ -133,12 +154,7 @@ class StepMonitor:
                 rec["hbm_bytes_in_use"] = mem.get("bytes_in_use")
                 rec["hbm_peak_bytes"] = mem.get("peak_bytes_in_use")
         self.records.append(rec)
-        if self.jsonl_path:
-            with open(self.jsonl_path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
-        if self.on_report is not None:
-            self.on_report(rec)
-        return rec
+        return self._emit(rec)
 
     @contextlib.contextmanager
     def step(self, items: Optional[float] = None, steps: int = 1):
@@ -147,6 +163,21 @@ class StepMonitor:
             yield self
         finally:
             self.end_step(items=items, steps=steps)
+
+    # ----------------------------------------------------------- emission
+    def _emit(self, row: dict, report: bool = True) -> dict:
+        """One emission path for every structured row this monitor
+        produces (step records, numerics, overlap, straggler events) —
+        JSONL append + the on_report exporter hook stay in lockstep,
+        mirroring ServingMetrics._emit. `report=False` keeps a row
+        JSONL-only (rows that predate the shared path and whose on_report
+        delivery would change existing consumers' row counts)."""
+        if self.jsonl_path:
+            with open(self.jsonl_path, "a") as f:
+                f.write(json.dumps(row) + "\n")
+        if report and self.on_report is not None:
+            self.on_report(row)
+        return row
 
     # ----------------------------------------------------------- compiles
     def record_compile(self, kind: str, sig, prev_sig=None,
@@ -190,11 +221,84 @@ class StepMonitor:
         if not isinstance(overlap, dict):
             overlap = {"ratio": float(overlap)}
         self.overlap = dict(overlap)
-        if self.jsonl_path and overlap.get("ratio") is not None:
-            with open(self.jsonl_path, "a") as f:
-                f.write(json.dumps({"overlap": self.overlap,
-                                    "ts": time.time()}) + "\n")
+        if overlap.get("ratio") is not None:
+            self._emit({"overlap": self.overlap, "ts": time.time()},
+                       report=False)
         return self.overlap
+
+    def record_collectives(self, rows):
+        """Adopt a per-collective ledger (trace_analysis.collective_rows()
+        or obs.collectives.CollectiveLedger.rows) as tracked gauges. Where
+        record_overlap keeps ONE scalar — "is comm hidden overall" — this
+        keeps the decomposition: per-collective seconds / exposed seconds /
+        bytes / bus bandwidth land in report() and metrics_text() labeled
+        by op, so a dashboard tracks WHICH collective's exposed time the
+        comm-scheduling work shrinks. ProfilerCallback feeds this after
+        each captured trace, right next to record_overlap."""
+        self.collectives = [dict(r) for r in (rows or [])]
+        if self.collectives:
+            self._emit({"collectives": self.collectives,
+                        "ts": time.time()}, report=False)
+        return self.collectives
+
+    # --------------------------------------------------------- shard skew
+    def record_shard_steps(self, walls, step: Optional[int] = None):
+        """Per-shard step walls for ONE step (or fused-step window):
+        `walls` maps shard id -> wall seconds. In a collective-synchronized
+        step every shard waits for the slowest, so the job's step time IS
+        max(walls); the skew ratio max/median says how much wall the
+        straggler costs everyone else.
+
+        Updates the `shard_skew` gauges (slowest shard, skew ratio,
+        per-shard walls) and runs the straggler state machine: skew at or
+        above `straggler_threshold` marks the run straggling, and the
+        structured {"straggler": ...} row goes through `_emit` (JSONL +
+        on_report) exactly ONCE per transition — with a matching
+        {"straggler_clear": ...} when the skew recovers — never a row per
+        step (a sustained straggler would otherwise spam the stream at
+        step rate)."""
+        walls = {str(k): float(v) for k, v in dict(walls).items()}
+        if not walls:
+            return None
+        slowest = max(walls, key=walls.get)
+        # baseline = median of the OTHER shards: including the slowest in
+        # its own baseline mutes the signal exactly where it matters most
+        # (2 shards: max/median-of-all is identically 1.0 or the upper
+        # middle — the straggler would judge itself)
+        rest = sorted(v for k, v in walls.items() if k != slowest) \
+            or [walls[slowest]]
+        n = len(rest)
+        median = rest[n // 2] if n % 2 \
+            else (rest[n // 2 - 1] + rest[n // 2]) / 2.0
+        skew = walls[slowest] / median if median > 0 else 1.0
+        self.shard_skew = {"step": step, "shards": len(walls),
+                           "walls": walls,
+                           "slowest_shard": slowest,
+                           "slowest_wall_s": walls[slowest],
+                           "median_wall_s": median,
+                           "skew_ratio": skew}
+        straggling = len(walls) > 1 and skew >= self.straggler_threshold
+        if straggling != self._straggling:
+            self._straggling = straggling
+            kind = "straggler" if straggling else "straggler_clear"
+            if straggling:
+                self.stragglers_total += 1
+            event = {kind: dict(self.shard_skew,
+                                threshold=self.straggler_threshold),
+                     "ts": time.time()}
+            self.straggler_events.append(event)
+            if straggling:
+                logger.warning(
+                    "straggler at step %s: shard %s at %.4fs vs median "
+                    "%.4fs (skew %.2fx >= %.2fx)", step, slowest,
+                    walls[slowest], median, skew,
+                    self.straggler_threshold)
+            self._emit(event)
+        return self.shard_skew
+
+    @property
+    def straggling(self) -> bool:
+        return self._straggling
 
     # ----------------------------------------------------------- numerics
     def record_numerics(self, step: int, loss: Optional[float] = None,
@@ -217,12 +321,7 @@ class StepMonitor:
                 logger.warning("numerics event at step %s: %s %s — %s",
                                e.get("step"), e.get("kind"),
                                e.get("path") or "", e.get("message"))
-        if self.jsonl_path:
-            with open(self.jsonl_path, "a") as f:
-                f.write(json.dumps(row) + "\n")
-        if self.on_report is not None:
-            self.on_report(row)
-        return row
+        return self._emit(row)
 
     # ------------------------------------------------------------ internals
     def _peak(self) -> Optional[float]:
@@ -274,12 +373,14 @@ class StepMonitor:
         re-reporting step 0 (or a recompile storm that is really just the
         restart's warm-up compiles) would defeat the dashboards."""
         return {"steps": int(self._steps), "compiles": int(self.compiles),
-                "recompiles": int(self.recompiles)}
+                "recompiles": int(self.recompiles),
+                "stragglers": int(self.stragglers_total)}
 
     def set_state_dict(self, state: dict):
         self._steps = int(state.get("steps", 0))
         self.compiles = int(state.get("compiles", 0))
         self.recompiles = int(state.get("recompiles", 0))
+        self.stragglers_total = int(state.get("stragglers", 0))
         return self
 
     # ------------------------------------------------------------- report
@@ -308,9 +409,27 @@ class StepMonitor:
         if self._last_numerics:
             num["loss"] = self._last_numerics.get("loss")
             num["grad_norm"] = self._last_numerics.get("grad_norm")
+        shard = {}
+        if self.shard_skew is not None:
+            shard = {"shard_skew_ratio": round(
+                         self.shard_skew["skew_ratio"], 4),
+                     "slowest_shard": self.shard_skew["slowest_shard"],
+                     "stragglers_total": self.stragglers_total,
+                     "straggling": self._straggling}
+        coll = {}
+        if self.collectives:
+            coll = {"collectives": [
+                {"name": r["name"],
+                 "ms": round(r["dur_us"] / 1e3, 3),
+                 "exposed_ms": round(r["exposed_us"] / 1e3, 3),
+                 "bytes": r.get("bytes"),
+                 "bus_gbps": (round(r["bus_gbps"], 2)
+                              if r.get("bus_gbps") is not None else None)}
+                for r in self.collectives]}
         return {"steps": self._steps,
                 **num,
                 "overlap_ratio": (self.overlap or {}).get("ratio"),
+                **shard, **coll,
                 "step_ms": round(med, 3) if med is not None else None,
                 "items_per_s": round(items_s, 1) if items_s else None,
                 "unit": self.unit,
@@ -352,6 +471,32 @@ class StepMonitor:
         gauge("overlap_ratio", r["overlap_ratio"],
               "compute/comm overlap: fraction of collective time hidden "
               "under device compute (latest captured trace)")
+        # per-collective ledger (ISSUE 13): one labeled sample per op per
+        # series — the decomposition of overlap_ratio; series definition
+        # shared with obs.CollectiveLedger
+        if self.collectives:
+            from .trace_analysis import collective_series_lines
+            lines += collective_series_lines(self.collectives, prefix)
+        # shard-skew gauges (ISSUE 13)
+        if self.shard_skew is not None:
+            from ._metrics import labeled_gauge_lines
+            lines += labeled_gauge_lines(
+                prefix, "shard_step_seconds", "shard",
+                sorted(self.shard_skew["walls"].items()),
+                "latest per-shard step wall time")
+            gauge("shard_skew_ratio", r.get("shard_skew_ratio"),
+                  "slowest shard step wall / median shard step wall")
+            slowest = self.shard_skew["slowest_shard"]
+            try:
+                gauge("slowest_shard", int(slowest),
+                      "shard id with the slowest latest step wall")
+            except (TypeError, ValueError):
+                pass                    # non-numeric shard names: the
+            #                             labeled walls carry the identity
+            gauge("straggling", 1 if self._straggling else 0,
+                  "a shard is currently straggling (skew over threshold)")
+            gauge("stragglers_total", self.stragglers_total,
+                  "transitions into straggling state")
         gauge("jit_cache_misses_total", r["jit_cache_misses"],
               "jit compile-cache misses during monitored steps")
         gauge("numerics_events_total", r["numerics_events"],

@@ -11,11 +11,18 @@ parses that capture into the same summary surface:
                      timers measure dispatch, not device work)
   - DeviceView:      per-device-lane busy time + a fusion/collective/copy
                      category split
-  - DistributedView: per-collective totals and the compute/communication
-                     overlap ratio (fraction of collective time hidden under
-                     device compute)
+  - DistributedView: a per-collective LEDGER (name, calls, bytes moved, bus
+                     bandwidth, overlapped-vs-EXPOSED time) plus the whole-
+                     step compute/communication overlap ratio. The single
+                     `overlap_ratio` scalar says whether comm is hidden in
+                     aggregate; the ledger says WHICH collective is paying
+                     the exposed time — the granularity the T3-style
+                     per-layer comm/compute scheduling work is judged at
+                     (PAPERS.md arxiv 2401.16677).
 
-Used by `Profiler.summary(views=...)` and the `tools/profile_step.py` CLI.
+Used by `Profiler.summary(views=...)`, the `tools/profile_step.py` CLI, and
+`obs.collectives.CollectiveLedger` (the exposition/JSONL surface of the
+per-collective rows).
 """
 from __future__ import annotations
 
@@ -44,6 +51,31 @@ def classify_op(name: str) -> str:
     if any(low.startswith(m) for m in _COPY_MARKERS):
         return "copy"
     return "compute"
+
+
+# trace-event arg keys that carry the op's data volume. XLA/XPlane exports
+# are inconsistent across versions ("bytes accessed" in newer XProf stat
+# names, snake_case in chrome-trace re-exports); take the first present.
+_BYTES_ARG_KEYS = ("bytes_accessed", "bytes accessed", "bytes",
+                   "size_bytes", "shape_bytes")
+
+
+def event_bytes(e: dict) -> Optional[int]:
+    """Data volume an event's args declare, or None when the capture
+    carries no byte stat (older jax versions — the ledger then reports
+    bytes/bandwidth as unknown rather than guessing from op names)."""
+    args = e.get("args")
+    if not isinstance(args, dict):
+        return None
+    for key in _BYTES_ARG_KEYS:
+        v = args.get(key)
+        if v is None:
+            continue
+        try:
+            return int(float(v))
+        except (TypeError, ValueError):
+            continue
+    return None
 
 
 def find_trace_file(path: str) -> Optional[str]:
@@ -92,6 +124,59 @@ def _overlap_us(a: List[Tuple[float, float]],
         else:
             j += 1
     return total
+
+
+# the exposition series derived from collective_rows() — ONE definition
+# shared by StepMonitor.metrics_text (prefix "paddle_tpu" -> adopted
+# gauges) and obs.collectives.CollectiveLedger.metrics_text (prefix
+# "paddle_tpu_comm" -> the standalone ledger block); two copies of the
+# (name, help, getter) tuples had already drifted help-text-wise
+_COLLECTIVE_SERIES = (
+    ("collective_seconds", "device seconds per collective op",
+     lambda r: r["dur_us"] / 1e6),
+    ("collective_exposed_seconds", "collective seconds NOT hidden under "
+     "compute — the wall the step pays", lambda r: r["exposed_us"] / 1e6),
+    ("collective_bytes", "bytes moved per collective op",
+     lambda r: r.get("bytes")),
+    ("collective_bus_gbps", "achieved bus bandwidth per collective op",
+     lambda r: r.get("bus_gbps")),
+)
+
+
+def collective_series_lines(rows: List[dict], prefix: str) -> List[str]:
+    """Per-op labeled gauge families for a set of collective_rows()."""
+    from ._metrics import labeled_gauge_lines
+    lines: List[str] = []
+    for name, help_, get in _COLLECTIVE_SERIES:
+        lines += labeled_gauge_lines(
+            prefix, name, "op", [(r["name"], get(r)) for r in rows],
+            help_)
+    return lines
+
+
+def format_collective_rows(rows: List[dict],
+                           steps: Optional[int] = None,
+                           top: int = 20) -> List[str]:
+    """Render collective_rows() as table lines — the ONE formatter both
+    DistributedView and obs.collectives.CollectiveLedger.table() print
+    (two renderers over the same row dicts would drift column by
+    column). Header + one line per op; the caller adds its own title and
+    totals/overlap footer."""
+    div = max(steps or 1, 1)
+    unit = "ms/step" if steps else "ms"
+    lines = [f"{unit:>10}  {'exposed':>9}  {'hidden%':>7}  {'calls':>6}  "
+             f"{'MB':>9}  {'GB/s':>7}  op"]
+    for r in rows[:top]:
+        mb = f"{r['bytes'] / 1e6:9.2f}" if r["bytes"] is not None \
+            else f"{'-':>9}"
+        bus = f"{r['bus_gbps']:7.1f}" if r["bus_gbps"] is not None \
+            else f"{'-':>7}"
+        hidden = (1.0 - r["exposed_frac"]) * 100.0
+        lines.append(f"{r['dur_us'] / div / 1e3:10.3f}  "
+                     f"{r['exposed_us'] / div / 1e3:9.3f}  "
+                     f"{hidden:7.1f}  {r['calls']:6d}  {mb}  {bus}  "
+                     f"{r['name'][:70]}")
+    return lines
 
 
 class TraceAnalysis:
@@ -184,6 +269,65 @@ class TraceAnalysis:
         return rows
 
     # --------------------------------------------------------- distributed
+    def collective_rows(self) -> List[dict]:
+        """The per-collective ledger: one row per collective op name.
+
+        Each row decomposes that collective's device time against the
+        union of ALL non-collective device compute:
+
+          dur_us         summed durations of the op's events
+          busy_us        overlap-free union span of the op's events (the
+                         denominator for exposure — back-to-back async
+                         chunks must not double-count)
+          overlapped_us  busy time with compute running concurrently
+          exposed_us     busy - overlapped: wall time the step PAYS for
+                         this collective (the number scheduling work must
+                         drive to zero)
+          bytes          data volume from the capture's byte stats (None
+                         when the capture carries none)
+          bus_gbps       bytes / busy_us — achieved bus bandwidth (None
+                         without bytes)
+
+        Sorted by exposed_us descending: the top row is the collective to
+        attack first. sum(overlapped_us)/sum(busy_us) over the rows equals
+        overlap()'s whole-step ratio up to interval-union bookkeeping, so
+        the ledger IS the decomposition of the overlap_ratio gauge."""
+        comp: List[Tuple[float, float]] = []
+        groups: Dict[str, dict] = {}
+        for e in self.device_events:
+            iv = (e["ts"], e["ts"] + e["dur"])
+            if classify_op(e["name"]) != "collective":
+                comp.append(iv)
+                continue
+            g = groups.setdefault(e["name"],
+                                  {"intervals": [], "dur_us": 0.0,
+                                   "calls": 0, "bytes": None})
+            g["intervals"].append(iv)
+            g["dur_us"] += e["dur"]
+            g["calls"] += 1
+            b = event_bytes(e)
+            if b is not None:
+                g["bytes"] = (g["bytes"] or 0) + b
+        comp_u = _union(comp)
+        rows = []
+        for name, g in groups.items():
+            iv_u = _union(g["intervals"])
+            busy = sum(e - s for s, e in iv_u)
+            ovl = _overlap_us(iv_u, comp_u)
+            exposed = max(busy - ovl, 0.0)
+            nbytes = g["bytes"]
+            bus = None
+            if nbytes is not None and busy > 0:
+                bus = nbytes / (busy * 1e-6) / 1e9     # bytes/s -> GB/s
+            rows.append({"name": name, "calls": g["calls"],
+                         "dur_us": g["dur_us"], "busy_us": busy,
+                         "overlapped_us": ovl, "exposed_us": exposed,
+                         "exposed_frac": exposed / busy if busy else 0.0,
+                         "bytes": nbytes,
+                         "bus_gbps": bus})
+        rows.sort(key=lambda r: (-r["exposed_us"], -r["busy_us"]))
+        return rows
+
     def overlap(self) -> dict:
         """Compute/communication overlap over the device lanes.
 
@@ -240,17 +384,17 @@ class TraceAnalysis:
         return "\n".join(lines)
 
     def distributed_view(self, top: int = 20) -> str:
-        """Collective totals + overlap ratio (reference DistributedView)."""
-        rows = [r for r in self.op_totals() if r["category"] == "collective"]
-        lines = ["---- DistributedView (collectives) ----"]
+        """Per-collective ledger + overlap ratio (reference
+        DistributedView). Columns: total device ms, EXPOSED ms (the part
+        compute does not hide — the actionable number), bytes moved and
+        achieved bus bandwidth where the capture carries byte stats."""
+        rows = self.collective_rows()
+        lines = ["---- DistributedView (collective ledger) ----"]
         if not rows:
             lines.append("no collective ops in capture (single-chip step)")
         else:
-            lines.append(f"{'ms/step' if self.steps else 'ms':>10}  "
-                         f"{'calls':>6}  op")
-            for r in rows[:top]:
-                lines.append(f"{self._per_step(r['dur_us']) / 1e3:10.3f}  "
-                             f"{r['calls']:6d}  {r['name'][:100]}")
+            lines += format_collective_rows(rows, steps=self.steps,
+                                            top=top)
         ov = self.overlap()
         if ov["ratio"] is not None:
             lines.append(

@@ -1,0 +1,736 @@
+"""Fleet-scope observability (ISSUE 13): exposition merge math against a
+pooled-numpy oracle, FleetAggregator staleness/degrade semantics over live
+servers, the server-owned SLO poll timer, the per-collective ledger from
+the checked-in trace fixture, and the shard-wall straggler state machine —
+including a real 2-process CPU-mesh run with an injected slow shard."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.obs import (CollectiveLedger, FleetAggregator,
+                            FleetMergeError, MetricsRegistry,
+                            TelemetryServer, TraceBuffer, bucket_percentile,
+                            feed_shard_walls, lint_exposition,
+                            load_shard_walls, merge_exposition)
+from paddle_tpu.obs.fleet import _grid_consistent
+from paddle_tpu.profiler._metrics import (LogHistogram, counter_lines,
+                                          gauge_lines, histogram_lines)
+from paddle_tpu.profiler.monitor import StepMonitor
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _hist_page(name, hist, prefix="t", extra_lines=()):
+    lines = list(extra_lines) + histogram_lines(prefix, name, hist,
+                                                f"{name} help")
+    return "\n".join(lines) + "\n"
+
+
+def _parse_hist(families, full_name):
+    fam = families[full_name]
+    buckets, count = [], 0.0
+    for base, labels, val in fam["samples"]:
+        if base.endswith("_bucket"):
+            le = labels[1:-1].split("=", 1)[1].strip('"')
+            buckets.append((float("inf") if le == "+Inf" else float(le),
+                            float(val)))
+        elif base.endswith("_count"):
+            count = float(val)
+    return sorted(buckets), count
+
+
+class TestExpositionMerge:
+    """merge_exposition: counters sum, gauges label, histograms pool."""
+
+    def test_counters_summed_across_replicas(self):
+        pages = {f"r{i}": "\n".join(counter_lines(
+            "t", "requests_total", 10 * (i + 1), "reqs")) + "\n"
+            for i in range(3)}
+        fams = lint_exposition(merge_exposition(pages))
+        assert fams["t_requests_total"]["samples"] == [
+            ("t_requests_total", "", "60")]
+
+    def test_gauges_labeled_not_summed(self):
+        pages = {f"r{i}": "\n".join(gauge_lines(
+            "t", "queue_depth", i + 1, "depth")) + "\n" for i in range(2)}
+        fams = lint_exposition(merge_exposition(pages))
+        samples = fams["t_queue_depth"]["samples"]
+        assert {(s[1], s[2]) for s in samples} == {
+            ('{replica="r0"}', "1"), ('{replica="r1"}', "2")}
+
+    def test_labeled_gauge_keeps_its_labels(self):
+        page = ('# HELP t_burn burn\n# TYPE t_burn gauge\n'
+                't_burn{target="ttft",window="long"} 0.5\n')
+        fams = lint_exposition(merge_exposition({"rA": page}))
+        assert fams["t_burn"]["samples"][0][1] == \
+            '{replica="rA",target="ttft",window="long"}'
+
+    def test_merged_histogram_percentiles_match_pooled_numpy_oracle(self):
+        rng = np.random.RandomState(7)
+        streams = [rng.lognormal(-2.0, 0.7, 400),
+                   rng.lognormal(-1.2, 0.4, 250),
+                   rng.lognormal(-2.5, 1.0, 150)]
+        pages, pooled_hist = {}, LogHistogram(per_decade=10)
+        for i, s in enumerate(streams):
+            h = LogHistogram(per_decade=10)
+            for v in s:
+                h.observe(v)
+                pooled_hist.observe(v)
+            pages[f"r{i}"] = _hist_page("e2e_seconds", h)
+        merged = merge_exposition(pages)
+        fams = lint_exposition(merged)
+        buckets, count = _parse_hist(fams, "t_e2e_seconds")
+        pooled = np.concatenate(streams)
+        assert count == pooled.size
+        ratio = 10 ** (1 / 10)          # one bucket of relative error
+        for q in (0.5, 0.9, 0.99):
+            got = bucket_percentile(buckets, count, q)
+            # exact vs the pooled histogram's own bucket estimate (same
+            # buckets, same counts — only min/max clamping can differ)
+            want_hist = pooled_hist.percentile(q)
+            assert got == pytest.approx(want_hist, rel=0.27)
+            # and within bucket resolution of the raw numpy stream
+            want_np = float(np.percentile(pooled, q * 100))
+            assert want_np / ratio ** 2 <= got <= want_np * ratio ** 2
+
+    def test_histogram_sum_and_count_added(self):
+        h1, h2 = LogHistogram(per_decade=10), LogHistogram(per_decade=10)
+        for v in (0.1, 0.2):
+            h1.observe(v)
+        h2.observe(0.4)
+        fams = lint_exposition(merge_exposition(
+            {"a": _hist_page("e2e_seconds", h1),
+             "b": _hist_page("e2e_seconds", h2)}))
+        fam = fams["t_e2e_seconds"]
+        total = [v for b, _, v in fam["samples"]
+                 if b == "t_e2e_seconds_sum"][0]
+        assert float(total) == pytest.approx(0.7)
+        _, count = _parse_hist(fams, "t_e2e_seconds")
+        assert count == 3
+
+    def test_empty_and_blank_pages_contribute_nothing(self):
+        h = LogHistogram(per_decade=10)
+        h.observe(0.1)
+        pages = {"live": _hist_page("e2e_seconds", h), "young": "",
+                 "blank": "   \n"}
+        fams = lint_exposition(merge_exposition(pages))
+        _, count = _parse_hist(fams, "t_e2e_seconds")
+        assert count == 1
+
+    def test_partial_replica_missing_family_is_fine(self):
+        h = LogHistogram(per_decade=10)
+        h.observe(0.1)
+        pages = {"a": _hist_page("e2e_seconds", h),
+                 "b": "\n".join(counter_lines("t", "requests_total", 5,
+                                              "reqs")) + "\n"}
+        fams = lint_exposition(merge_exposition(pages))
+        _, count = _parse_hist(fams, "t_e2e_seconds")
+        assert count == 1
+        assert fams["t_requests_total"]["samples"][0][2] == "5"
+
+    def test_mismatched_bucket_layouts_rejected_structured(self):
+        good = LogHistogram(lo=1e-4, per_decade=10)
+        bad = LogHistogram(lo=1.5e-4, per_decade=10)   # shifted grid
+        for v in (0.003, 0.02, 0.4):
+            good.observe(v)
+            bad.observe(v * 1.1)
+        with pytest.raises(FleetMergeError) as ei:
+            merge_exposition({"a": _hist_page("e2e_seconds", good),
+                              "b": _hist_page("e2e_seconds", bad)})
+        err = ei.value
+        assert err.family == "t_e2e_seconds"
+        assert err.replicas == ["a", "b"]
+        assert "layout" in err.detail
+        assert err.to_dict()["error"] == "fleet_merge"
+
+    def test_type_disagreement_rejected(self):
+        pages = {"a": "# HELP t_x x\n# TYPE t_x gauge\nt_x 1\n",
+                 "b": "# HELP t_x x\n# TYPE t_x counter\nt_x 2\n"}
+        with pytest.raises(FleetMergeError):
+            merge_exposition(pages)
+
+    def test_non_linting_member_page_named(self):
+        with pytest.raises(FleetMergeError) as ei:
+            merge_exposition({"broken": "t_x 1\n"})   # sample, no TYPE
+        assert ei.value.replicas == ["broken"]
+
+    def test_grid_consistency_rules(self):
+        g10 = [1e-4 * 10 ** (k / 10) for k in range(0, 40, 3)]
+        g20 = [1e-4 * 10 ** (k / 20) for k in range(1, 50, 7)]
+        assert _grid_consistent(g10)
+        assert _grid_consistent(sorted(set(g10 + g20)))  # nested refines
+        assert _grid_consistent([0.5, 1.5, 3.5, 7.5])    # arithmetic
+        shifted = sorted(set(
+            g10[:5] + [1.5e-4 * 10 ** (k / 10) for k in range(2, 20, 5)]))
+        assert not _grid_consistent(shifted)
+        mixed = sorted(set([1e-2 * 10 ** (k / 10) for k in range(0, 12, 2)]
+                           + [0.5, 1.5, 2.5]))
+        assert not _grid_consistent(mixed)
+
+    def test_bucket_percentile_empty(self):
+        assert bucket_percentile([], 0, 0.99) is None
+
+
+def _page_producer(i):
+    def produce():
+        return "\n".join(
+            counter_lines("s", "requests_total", 10 * (i + 1), "reqs")
+            + gauge_lines("s", "queue_depth", i, "depth")) + "\n"
+    return produce
+
+
+def _mk_server(i, health=None, tracez=None, broken=False):
+    reg = MetricsRegistry()
+    if broken:
+        def produce():
+            raise RuntimeError("boom")
+        reg.register("m", produce)
+    else:
+        reg.register("m", _page_producer(i))
+    return TelemetryServer(reg, health=health, status=lambda: {"i": i},
+                           tracez=tracez).start()
+
+
+class TestFleetAggregator:
+    def test_merge_staleness_and_rejoin(self):
+        def health(n, draining=False):
+            return lambda: {"status": "draining" if draining else "ok",
+                            "draining": draining, "queue_depth": n,
+                            "queue_capacity": 8, "inflight": 1,
+                            "overloaded_total": 2 * n,
+                            "rejected_total": 0}
+        srvs = [_mk_server(i, health=health(i)) for i in range(3)]
+        try:
+            fleet = FleetAggregator(
+                {f"r{i}": s for i, s in enumerate(srvs)}, timeout=1.0)
+            page = fleet.merged_metrics()
+            lint_exposition(page)
+            assert "s_requests_total 60" in page
+            assert 'paddle_tpu_fleet_replicas{state="stale"} 0' in page
+            h = fleet.fleet_healthz()
+            assert (h["status"], h["serving"], h["queue_depth"],
+                    h["overloaded_total"]) == ("ok", 3, 3, 6)
+            # kill r1: stale + degraded around, never an exception
+            srvs[1].close()
+            page = fleet.merged_metrics()
+            lint_exposition(page)
+            assert "s_requests_total 40" in page
+            assert 'paddle_tpu_fleet_up{replica="r1"} 0' in page
+            h = fleet.fleet_healthz()
+            assert h["serving"] == 2 and h["stale"] == 1
+            assert h["per_replica"]["r1"]["state"] == "stale"
+            assert h["per_replica"]["r1"]["consecutive_failures"] >= 1
+            # a replacement replica rejoins under a fresh name
+            assert fleet.remove_replica("r1")
+            srv_new = _mk_server(1, health=health(1))
+            srvs.append(srv_new)
+            fleet.add_replica("r1b", srv_new)
+            page = fleet.merged_metrics()
+            assert "s_requests_total 60" in page
+            assert fleet.fleet_healthz()["serving"] == 3
+        finally:
+            for s in srvs:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+
+    def test_draining_member_counted_not_stale(self):
+        # a draining replica answers /healthz with 503 + the JSON body;
+        # the rollup must read the body, not mark the member dead
+        srv = _mk_server(0, health=lambda: {
+            "status": "draining", "draining": True, "queue_depth": 4,
+            "queue_capacity": 8, "inflight": 2, "overloaded_total": 1,
+            "rejected_total": 3})
+        try:
+            fleet = FleetAggregator({"d": srv}, timeout=1.0)
+            h = fleet.fleet_healthz()
+            assert h["draining"] == 1 and h["stale"] == 0
+            assert h["status"] == "unserviceable"   # zero members serving
+            assert h["queue_depth"] == 4
+        finally:
+            srv.close()
+
+    def test_broken_member_metrics_degrades_not_500(self):
+        srvs = [_mk_server(0), _mk_server(1, broken=True)]
+        try:
+            fleet = FleetAggregator(
+                {"ok": srvs[0], "broken": srvs[1]}, timeout=1.0)
+            page = fleet.merged_metrics()
+            lint_exposition(page)
+            assert "s_requests_total 10" in page
+            assert 'paddle_tpu_fleet_up{replica="broken"} 0' in page
+        finally:
+            for s in srvs:
+                s.close()
+
+    def test_fleet_server_routes_and_tracez_merge(self):
+        from urllib.request import urlopen
+        bufs = [TraceBuffer(capacity=8) for _ in range(2)]
+        recs = [
+            {"id": 1, "status": "done", "trace_id": "aaa-1", "e2e_s": 0.5},
+            {"id": 2, "status": "done", "trace_id": "aaa-2", "e2e_s": 0.1},
+            {"id": 1, "status": "timeout", "trace_id": "bbb-1",
+             "e2e_s": None},
+            # a trace_id seen by BOTH members must merge to one row
+            {"id": 2, "status": "done", "trace_id": "aaa-2",
+             "e2e_s": 0.1},
+        ]
+        bufs[0].add(recs[0]).add(recs[1])
+        bufs[1].add(recs[2]).add(recs[3])
+        srvs = [_mk_server(i, tracez=bufs[i]) for i in range(2)]
+        fsrv = None
+        try:
+            fleet = FleetAggregator(
+                {f"r{i}": s for i, s in enumerate(srvs)}, timeout=1.0)
+            tz = fleet.fleet_tracez({"order": "slowest"})
+            ids = [t["trace_id"] for t in tz["traces"]]
+            assert ids[0] == "aaa-1"          # slowest first
+            assert ids.count("aaa-2") == 1    # deduped on trace_id
+            assert {t["replica"] for t in tz["traces"]} == {"r0", "r1"}
+            assert tz["summary"]["answered"] == 2
+            # and over HTTP through the fleet server's extra routes
+            fsrv = fleet.serve()
+            body = json.loads(urlopen(
+                fsrv.url("/fleet/tracez?order=slowest&limit=2"),
+                timeout=5).read())
+            assert len(body["traces"]) == 2
+            assert body["traces"][0]["trace_id"] == "aaa-1"
+            h = json.loads(urlopen(fsrv.url("/fleet/healthz"),
+                                   timeout=5).read())
+            assert h["replicas"] == 2
+            mx = urlopen(fsrv.url("/metrics"), timeout=5).read().decode()
+            lint_exposition(mx)
+            assert "s_requests_total 30" in mx
+            # malformed client input on an extra route is a 400, not the
+            # 500 a monitor would page on as an aggregator failure
+            from urllib.error import HTTPError
+            with pytest.raises(HTTPError) as ei:
+                urlopen(fsrv.url("/fleet/tracez?limit=abc"), timeout=5)
+            assert ei.value.code == 400
+        finally:
+            if fsrv is not None:
+                fsrv.close()
+            for s in srvs:
+                s.close()
+
+    def test_fleet_healthz_503_when_no_member_serves(self):
+        from urllib.error import HTTPError
+        from urllib.request import urlopen
+        srv = _mk_server(0)     # no health fn -> scrape of /healthz is
+        fsrv = None             # the default {"status": "ok"} ... so use
+        try:                    # a dead member instead
+            fleet = FleetAggregator({"r0": srv}, timeout=0.5)
+            srv.close()
+            fsrv = fleet.serve()
+            with pytest.raises(HTTPError) as ei:
+                urlopen(fsrv.url("/healthz"), timeout=5)
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read())
+            assert body["status"] == "unserviceable"
+            assert body["stale"] == 1
+        finally:
+            if fsrv is not None:
+                fsrv.close()
+            try:
+                srv.close()
+            except Exception:
+                pass
+
+
+class TestServerPoller:
+    def test_poller_runs_and_stops_with_server(self):
+        calls = []
+        srv = TelemetryServer(MetricsRegistry())
+        srv.add_poller(lambda: calls.append(time.monotonic()), 0.02,
+                       name="tick")
+        srv.start()
+        deadline = time.time() + 5.0
+        while len(calls) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(calls) >= 3
+        srv.close()
+        n = len(calls)
+        time.sleep(0.08)
+        assert len(calls) == n          # thread died with the server
+        assert srv.pollers[0]["polls"] >= 3
+
+    def test_poller_survives_exceptions(self):
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] == 1:
+                raise RuntimeError("transient")
+        srv = TelemetryServer(MetricsRegistry())
+        srv.add_poller(flaky, 0.02, name="flaky")
+        srv.start()
+        deadline = time.time() + 5.0
+        while state["n"] < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        srv.close()
+        assert state["n"] >= 3
+        rec = srv.pollers[0]
+        assert rec["errors"] == 1 and rec["polls"] >= 2
+
+    def test_bad_interval_rejected(self):
+        srv = TelemetryServer(MetricsRegistry())
+        with pytest.raises(ValueError):
+            srv.add_poller(lambda: None, 0)
+        srv.close()
+
+
+@pytest.fixture(scope="module")
+def toy_engine():
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=32,
+                    intermediate_size=64)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    eng = ServingEngine(model, ServingConfig(
+        max_batch=2, prompt_cap=8, max_new_tokens=4))
+    rng = np.random.RandomState(0)
+    for _ in range(2):
+        eng.submit(rng.randint(1, 64, (5,)).astype(np.int64))
+    eng.drain()
+    return eng
+
+
+class TestSLOServerTimer:
+    """The r15 NOTE follow-up: serve_telemetry owns the poll cadence."""
+
+    def test_server_side_poll_timer_drives_burn_eval(self, toy_engine):
+        srv = toy_engine.serve_telemetry(
+            slo="e2e_p99=60s,goodput=0.5", poll_interval=0.03)
+        try:
+            assert srv.slo is not None
+            deadline = time.time() + 5.0
+            while not srv.slo._snaps and time.time() < deadline:
+                time.sleep(0.01)
+            # burn evaluation happened with NO external poll() driver
+            assert srv.slo._snaps
+            assert srv.pollers[0]["name"] == "slo"
+            assert srv.pollers[0]["polls"] >= 1
+            # the slo block rides the scrape page
+            from urllib.request import urlopen
+            text = urlopen(srv.url("/metrics"), timeout=5).read().decode()
+            lint_exposition(text)
+            assert "paddle_tpu_slo_alerts_total" in text
+        finally:
+            srv.close()
+        n_snaps = len(srv.slo._snaps)
+        time.sleep(0.1)
+        assert len(srv.slo._snaps) == n_snaps   # timer stopped with server
+
+    def test_poll_interval_without_slo_rejected(self, toy_engine):
+        with pytest.raises(ValueError):
+            toy_engine.serve_telemetry(poll_interval=1.0)
+
+
+class TestCollectiveLedger:
+    def test_rows_from_checked_in_fixture(self):
+        ledger = CollectiveLedger.from_trace(FIXTURES, steps=2)
+        assert len(ledger.rows) == 1
+        r = ledger.rows[0]
+        assert r["name"] == "all-reduce.3" and r["calls"] == 2
+        assert r["dur_us"] == 200 and r["busy_us"] == 200
+        # per step: all-reduce [450,550) overlaps convolution [300,500)
+        # by 50us -> half the collective time is EXPOSED
+        assert r["overlapped_us"] == 100 and r["exposed_us"] == 100
+        assert r["exposed_frac"] == pytest.approx(0.5)
+        # 2 x 1 MiB at 100us busy each -> ~10.5 GB/s bus bandwidth
+        assert r["bytes"] == 2 * 1048576
+        assert r["bus_gbps"] == pytest.approx(10.48576)
+        # the ledger IS the decomposition of the overlap gauge
+        assert ledger.overlap["ratio"] == pytest.approx(0.5)
+        t = ledger.totals()
+        assert t["exposed_frac"] == pytest.approx(0.5)
+
+    def test_table_and_exposition_render(self):
+        ledger = CollectiveLedger.from_trace(FIXTURES, steps=2)
+        table = ledger.table()
+        assert "all-reduce.3" in table and "GB/s" in table
+        assert "exposed" in table
+        text = ledger.metrics_text()
+        fams = lint_exposition(text)
+        assert 'paddle_tpu_comm_collective_exposed_seconds' in fams
+        sample = [s for s in fams[
+            "paddle_tpu_comm_collective_bus_gbps"]["samples"]][0]
+        assert sample[1] == '{op="all-reduce.3"}'
+
+    def test_registry_composes_ledger_with_monitor(self):
+        # the collision case the docstring promises away: a monitor that
+        # ADOPTED the same rows and a standalone ledger on one page
+        mon = StepMonitor(track_memory=False)
+        ledger = CollectiveLedger.from_trace(FIXTURES)
+        mon.record_collectives(ledger.rows)
+        reg = MetricsRegistry()
+        reg.register("monitor", mon.metrics_text)
+        reg.register("collectives", ledger.metrics_text)
+        fams = lint_exposition(reg.render())
+        assert "paddle_tpu_collective_seconds" in fams          # monitor
+        assert "paddle_tpu_comm_collective_seconds" in fams     # ledger
+
+    def test_bytes_absent_renders_unknown(self):
+        events = [
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 1, "tid": 0, "name": "all-gather.9",
+             "ts": 0, "dur": 100},
+            {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.1",
+             "ts": 0, "dur": 50}]
+        ledger = CollectiveLedger.from_trace(events)
+        r = ledger.rows[0]
+        assert r["bytes"] is None and r["bus_gbps"] is None
+        assert r["overlapped_us"] == 50 and r["exposed_us"] == 50
+        assert "-" in ledger.table()
+        lint_exposition(ledger.metrics_text())
+
+    def test_monitor_adopts_ledger_rows(self):
+        mon = StepMonitor(track_memory=False)
+        ledger = CollectiveLedger.from_trace(FIXTURES, steps=2)
+        mon.record_collectives(ledger.rows)
+        mon.record_overlap(ledger.overlap)
+        rep = mon.report()
+        assert rep["overlap_ratio"] == pytest.approx(0.5)
+        assert rep["collectives"][0]["name"] == "all-reduce.3"
+        assert rep["collectives"][0]["exposed_ms"] == pytest.approx(0.1)
+        text = mon.metrics_text()
+        lint_exposition(text)
+        assert 'paddle_tpu_collective_seconds{op="all-reduce.3"}' in text
+
+    def test_distributed_view_renders_ledger_columns(self):
+        from paddle_tpu.profiler.trace_analysis import analyze
+        view = analyze(FIXTURES, steps=2).distributed_view()
+        assert "exposed" in view and "GB/s" in view
+        assert "overlap ratio 0.50" in view
+
+
+class TestStragglerStateMachine:
+    def test_single_event_per_sustained_straggler(self):
+        rows = []
+        mon = StepMonitor(track_memory=False, on_report=rows.append,
+                          straggler_threshold=1.5)
+        for step in range(8):
+            slow = 0.03 if step >= 3 else 0.01
+            mon.record_shard_steps({"0": 0.01, "1": 0.01, "2": slow},
+                                   step=step)
+        events = [r for r in rows if "straggler" in r]
+        assert len(events) == 1                  # transition, not per-step
+        ev = events[0]["straggler"]
+        assert ev["slowest_shard"] == "2"
+        assert ev["skew_ratio"] == pytest.approx(3.0)
+        assert mon.stragglers_total == 1 and mon.straggling
+
+    def test_clear_event_on_recovery(self):
+        rows = []
+        mon = StepMonitor(track_memory=False, on_report=rows.append)
+        mon.record_shard_steps({"0": 0.01, "1": 0.05}, step=0)
+        mon.record_shard_steps({"0": 0.01, "1": 0.011}, step=1)
+        kinds = [next(iter(r)) for r in rows]
+        assert kinds == ["straggler", "straggler_clear"]
+        assert not mon.straggling and mon.stragglers_total == 1
+
+    def test_two_shard_skew_uses_other_shard_baseline(self):
+        mon = StepMonitor(track_memory=False)
+        skew = mon.record_shard_steps({"0": 0.01, "1": 0.025})
+        assert skew["skew_ratio"] == pytest.approx(2.5)
+        assert skew["slowest_shard"] == "1"
+
+    def test_even_rest_uses_true_median(self):
+        # 3 shards -> 2-element baseline: the TRUE median (mean of the
+        # middle pair), not the upper element — review regression pin
+        # (upper-middle read 2.0/1.4 = 1.43 and never fired at 1.5)
+        rows = []
+        mon = StepMonitor(track_memory=False, on_report=rows.append,
+                          straggler_threshold=1.5)
+        skew = mon.record_shard_steps({"0": 1.0, "1": 1.4, "2": 2.0},
+                                      step=0)
+        assert skew["skew_ratio"] == pytest.approx(2.0 / 1.2)
+        assert mon.straggling and len(rows) == 1
+
+    def test_single_shard_never_straggles(self):
+        mon = StepMonitor(track_memory=False)
+        mon.record_shard_steps({"0": 5.0}, step=0)
+        assert not mon.straggling and mon.stragglers_total == 0
+
+    def test_gauges_in_exposition(self):
+        mon = StepMonitor(track_memory=False)
+        mon.record_shard_steps({"0": 0.01, "1": 0.04}, step=0)
+        text = mon.metrics_text()
+        lint_exposition(text)
+        assert 'paddle_tpu_shard_step_seconds{shard="1"} 0.04' in text
+        assert "paddle_tpu_shard_skew_ratio 4" in text
+        assert "paddle_tpu_slowest_shard 1" in text
+        assert "paddle_tpu_straggling 1" in text
+
+    def test_counter_survives_state_dict_roundtrip(self):
+        mon = StepMonitor(track_memory=False)
+        mon.record_shard_steps({"0": 0.01, "1": 0.05}, step=0)
+        fresh = StepMonitor(track_memory=False)
+        fresh.set_state_dict(mon.state_dict())
+        assert fresh.stragglers_total == 1
+
+    def test_stitch_and_feed_from_jsonl(self, tmp_path):
+        for shard in range(2):
+            mon = StepMonitor(track_memory=False, jsonl_path=str(
+                tmp_path / f"shard_{shard}.jsonl"))
+            for step in range(5):
+                wall = 0.04 if shard == 1 and step >= 2 else 0.01
+                mon.end_step(wall_s=wall)
+        # shard 0 ran one extra (incomplete) step: must be skipped
+        mon0 = StepMonitor(track_memory=False, jsonl_path=str(
+            tmp_path / "shard_0.jsonl"))
+        mon0._steps = 5
+        mon0.end_step(wall_s=0.01)
+        walls = load_shard_walls(str(tmp_path))
+        assert set(walls) == {1, 2, 3, 4, 5, 6}
+        assert walls[1] == {"0": 0.01, "1": 0.01}
+        rows = []
+        agg = StepMonitor(track_memory=False, on_report=rows.append)
+        fed = feed_shard_walls(agg, walls)
+        assert len(fed) == 5                    # step 6 incomplete
+        events = [r for r in rows if "straggler" in r]
+        assert len(events) == 1
+        assert events[0]["straggler"]["slowest_shard"] == "1"
+
+
+_WORKER = r"""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+sys.path.insert(0, {repo!r})
+import jax
+import jax.numpy as jnp
+from paddle_tpu.distributed import build_mesh, shard_identity
+from paddle_tpu.profiler import StepMonitor
+
+shard, world = shard_identity()
+assert world == 2, world
+mesh = build_mesh({{"dp": 2}})          # each process runs the same
+#                                         2-shard CPU-mesh program —
+#                                         single-controller SPMD's shape
+from jax.sharding import NamedSharding, PartitionSpec as P
+x = jax.device_put(jnp.ones((4, 64)), NamedSharding(mesh, P("dp", None)))
+step = jax.jit(lambda a: (a @ a.T).sum())
+step(x).block_until_ready()             # warm up outside the timing
+mon = StepMonitor(track_memory=False,
+                  jsonl_path=os.path.join({out!r}, f"shard_{{shard}}.jsonl"))
+for i in range(6):
+    mon.begin_step()
+    step(x).block_until_ready()
+    if shard == 1 and i >= 2:
+        time.sleep(0.08)                # the injected slow shard
+    mon.end_step()
+print("worker", shard, "done")
+"""
+
+
+@pytest.mark.parametrize("nshards", [2])
+def test_multiprocess_mesh_straggler_event(tmp_path, nshards):
+    """ISSUE 13 acceptance: a 2-process (2-shard CPU mesh) run with an
+    injected slow shard produces skew gauges + exactly ONE structured
+    straggler event after stitching the shards' JSONL streams."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = _WORKER.format(repo=repo, out=str(tmp_path))
+    procs = []
+    for shard in range(nshards):
+        env = dict(os.environ,
+                   PADDLE_TPU_PROCESS_ID=str(shard),
+                   PADDLE_TPU_NUM_PROCESSES=str(nshards))
+        env.pop("PADDLE_TPU_TIER_DURATIONS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+    walls = load_shard_walls(str(tmp_path))
+    assert len(walls) == 6
+    assert all(set(w) == {"0", "1"} for w in walls.values())
+    rows = []
+    mon = StepMonitor(track_memory=False, on_report=rows.append,
+                      straggler_threshold=1.5)
+    feed_shard_walls(mon, walls)
+    events = [r for r in rows if "straggler" in r]
+    assert len(events) == 1, events
+    ev = events[0]["straggler"]
+    assert ev["slowest_shard"] == "1"
+    assert ev["skew_ratio"] >= 1.5
+    assert mon.straggling and mon.stragglers_total == 1
+    text = mon.metrics_text()
+    lint_exposition(text)
+    assert 'paddle_tpu_shard_step_seconds{shard="1"}' in text
+
+
+class TestBenchHistory:
+    def _load_tool(self):
+        import importlib.util
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "bench_history", os.path.join(repo, "tools",
+                                          "bench_history.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _write(self, tmp_path, rev, tail):
+        p = tmp_path / f"BENCH_{rev}.json"
+        p.write_text(json.dumps({"n": 1, "cmd": "x", "rc": 0,
+                                 "tail": tail}))
+        return str(p)
+
+    def test_trend_and_regression_gate(self, tmp_path):
+        bh = self._load_tool()
+        row = {"metric": "tok/s (gpt)", "value": 100.0, "unit": "tokens/s",
+               "extra": {"row": "gpt", "step_ms": 10.0, "recompiles": 0}}
+        f1 = self._write(tmp_path, "r01", json.dumps(row) + "\n")
+        row2 = dict(row, value=80.0)
+        f2 = self._write(tmp_path, "r02", json.dumps(row2) + "\n")
+        hist = bh.load_history([f1, f2])
+        assert set(hist) == {"gpt"}
+        assert hist["gpt"]["r01"]["value"] == 100.0
+        table = bh.trend_table(hist, ["r01", "r02"])
+        assert "gpt" in table and "100.0" in table and "80.0" in table
+        v = bh.check_regressions(hist, ["r01", "r02"], regress_pct=10.0)
+        assert len(v) == 1 and v[0]["drop_pct"] == pytest.approx(20.0)
+        assert not bh.check_regressions(hist, ["r01", "r02"],
+                                        regress_pct=25.0)
+        assert bh.main([f1, f2, "--regress-pct", "10"]) == 1
+        assert bh.main([f1, f2, "--regress-pct", "25"]) == 0
+
+    def test_truncated_array_tail_parses(self, tmp_path):
+        bh = self._load_tool()
+        # the r05 shape: head-truncated JSON array fragment
+        tail = ('"row": "lost", "metric": "m", "value": 1.0}, '
+                '{"row": "kept", "metric": "tok/s", "value": 5.0, '
+                '"step_ms": 2.0}]')
+        f = self._write(tmp_path, "r05", tail)
+        hist = bh.load_history([f])
+        assert "kept" in hist
+        assert hist["kept"]["r05"]["value"] == 5.0
+
+    def test_real_bench_files_parse(self):
+        bh = self._load_tool()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        import glob as g
+        files = sorted(g.glob(os.path.join(repo, "BENCH_r*.json")))
+        hist = bh.load_history(files)
+        assert "gpt-cpu-smoke" in hist          # r06 row
+        assert "resnet50" in hist               # r05 row
+        # and the repo's own gate passes at head (no row regressed
+        # against its previous recorded revision)
+        assert bh.check_regressions(
+            hist, sorted({r for v in hist.values() for r in v}),
+            regress_pct=50.0) == []

@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Bench trajectory — fold BENCH_r*.json into one per-row trend table.
+
+Every PR's bench run lands in its own BENCH_rNN.json; reading the ladder's
+history means opening six loose files and eyeballing. This tool makes the
+trajectory a first-class artifact: one row per bench target (gpt-1.3b,
+resnet50, decode-paged, ...), one column per revision, showing tokens/sec
+(the row's `value`), ms/step and recompiles — plus a regression gate:
+
+    python tools/bench_history.py                # table over BENCH_r*.json
+    python tools/bench_history.py --row resnet50 --json
+    python tools/bench_history.py --regress-pct 10   # exit 1 when any
+        # row's newest value dropped more than 10% vs its previous
+        # recorded revision
+
+Bench rows are identified by their `extra.row` / `row` key when present
+(r04+), else by the metric string (r01-r03 predate row names). The files
+are driver snapshots whose `tail` holds the bench's JSONL lines — and, for
+some revisions, a truncated JSON array — so extraction scans for balanced
+JSON objects carrying `metric` + `value` rather than trusting any one
+format. Values are throughput-like by convention (tokens/s / images/s:
+HIGHER is better); the gate only fires on drops.
+
+Exit status: 0 = ok (or no gate requested), 1 = regression over the gate,
+2 = no bench rows found.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+
+def _scan_objects(text: str) -> List[dict]:
+    """Every balanced {...} JSON object in `text` that parses. Handles
+    whole JSONL lines, objects embedded in a (possibly head-truncated)
+    JSON array, and noise between them."""
+    out = []
+    depth = 0
+    start = None
+    in_str = False
+    esc = False
+    for i, ch in enumerate(text):
+        if in_str:
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == '"':
+                in_str = False
+            continue
+        if ch == '"':
+            in_str = True
+        elif ch == "{":
+            if depth == 0:
+                start = i
+            depth += 1
+        elif ch == "}":
+            if depth > 0:
+                depth -= 1
+                if depth == 0 and start is not None:
+                    try:
+                        obj = json.loads(text[start:i + 1])
+                        if isinstance(obj, dict):
+                            out.append(obj)
+                    except json.JSONDecodeError:
+                        pass
+                    start = None
+    return out
+
+
+def _bench_rows(obj: dict) -> List[dict]:
+    """Normalize one scanned object into 0+ bench rows. A row needs
+    `metric` + numeric `value`; nested shapes (the `parsed` snapshot, an
+    `extra` dict) are flattened into one flat row dict."""
+    rows = []
+    queue = [obj]
+    while queue:
+        o = queue.pop()
+        if not isinstance(o, dict):
+            continue
+        if "metric" in o and isinstance(o.get("value"), (int, float)):
+            extra = o.get("extra") if isinstance(o.get("extra"), dict) \
+                else {}
+            flat = {**extra, **{k: v for k, v in o.items()
+                                if k != "extra"}}
+            rows.append(flat)
+        else:
+            queue.extend(v for v in o.values() if isinstance(v, dict))
+    return rows
+
+
+def _row_key(row: dict) -> str:
+    name = row.get("row")
+    if name:
+        return str(name)
+    # r01-r03 predate row names: normalize the metric string down to a
+    # stable key (strip the parenthesized config, collapse spaces)
+    metric = str(row.get("metric", "?"))
+    return re.sub(r"\s+", " ", re.sub(r"\(.*?\)", "", metric)).strip()
+
+
+def load_history(paths: List[str]) -> Dict[str, Dict[str, dict]]:
+    """{row_key: {revision: row}} over the given BENCH files; revision =
+    the file's rNN stem (BENCH_r04.json -> r04), ordered by name."""
+    history: Dict[str, Dict[str, dict]] = {}
+    for path in sorted(paths):
+        rev = os.path.splitext(os.path.basename(path))[0]
+        rev = rev[len("BENCH_"):] if rev.startswith("BENCH_") else rev
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except json.JSONDecodeError:
+                doc = None
+        texts = []
+        if isinstance(doc, dict):
+            texts.append(doc.get("tail") or "")
+            if isinstance(doc.get("parsed"), dict):
+                texts.append(json.dumps(doc["parsed"]))
+        else:
+            with open(path) as f:
+                texts.append(f.read())
+        seen_keys = set()
+        for text in texts:
+            for obj in _scan_objects(text):
+                for row in _bench_rows(obj):
+                    key = _row_key(row)
+                    if (key, rev) in seen_keys:
+                        continue    # tail + parsed double-report a row
+                    seen_keys.add((key, rev))
+                    history.setdefault(key, {})[rev] = row
+    return history
+
+
+def _fmt(v, nd=1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def trend_table(history: Dict[str, Dict[str, dict]],
+                revisions: List[str]) -> str:
+    lines = ["---- bench trajectory "
+             f"({len(history)} rows x {len(revisions)} revisions) ----"]
+    width = max(len(k) for k in history) if history else 4
+    hdr = f"  {'row':<{width}}  " + "  ".join(f"{r:>12}"
+                                              for r in revisions)
+    lines.append(hdr)
+    for key in sorted(history):
+        revs = history[key]
+        cells = []
+        for r in revisions:
+            row = revs.get(r)
+            cells.append(f"{_fmt(row.get('value')):>12}" if row
+                         else f"{'-':>12}")
+        lines.append(f"  {key:<{width}}  " + "  ".join(cells))
+        sub = []
+        for metric, nd in (("step_ms", 2), ("recompiles", 0),
+                           ("steady_recompiles", 0)):
+            vals = [revs.get(r, {}).get(metric) for r in revisions]
+            if any(v is not None for v in vals):
+                sub.append((metric, [f"{_fmt(v, nd):>12}"
+                                     if v is not None else f"{'-':>12}"
+                                     for v in vals]))
+        for metric, cells in sub:
+            lines.append(f"    {metric:<{width - 2}}  " + "  ".join(cells))
+    return "\n".join(lines)
+
+
+def check_regressions(history: Dict[str, Dict[str, dict]],
+                      revisions: List[str],
+                      regress_pct: float) -> List[dict]:
+    """Newest recorded value per row vs the previous recorded revision:
+    a drop beyond `regress_pct` percent is a violation. Rows recorded at
+    only one revision have no baseline and pass."""
+    violations = []
+    for key in sorted(history):
+        revs = [(r, history[key][r]) for r in revisions
+                if r in history[key]]
+        if len(revs) < 2:
+            continue
+        (prev_rev, prev), (new_rev, new) = revs[-2], revs[-1]
+        pv, nv = prev.get("value"), new.get("value")
+        if not pv or nv is None:
+            continue
+        drop_pct = (pv - nv) / pv * 100.0
+        if drop_pct > regress_pct:
+            violations.append({"row": key, "prev_rev": prev_rev,
+                               "new_rev": new_rev,
+                               "prev_value": pv, "new_value": nv,
+                               "drop_pct": round(drop_pct, 2)})
+    return violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("files", nargs="*",
+                    help="BENCH json files (default: BENCH_r*.json next "
+                         "to the repo root)")
+    ap.add_argument("--row", help="only this bench row")
+    ap.add_argument("--regress-pct", type=float, default=None,
+                    help="fail (exit 1) when a row's newest value drops "
+                         "more than this percent vs its previous "
+                         "recorded revision")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    files = args.files or sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_r*.json")))
+    if not files:
+        print("bench_history: no BENCH files found", file=sys.stderr)
+        return 2
+    history = load_history(files)
+    if args.row:
+        history = {k: v for k, v in history.items() if k == args.row}
+    if not history:
+        print("bench_history: no bench rows parsed", file=sys.stderr)
+        return 2
+    revisions = sorted({r for revs in history.values() for r in revs})
+
+    violations = []
+    if args.regress_pct is not None:
+        violations = check_regressions(history, revisions,
+                                       args.regress_pct)
+
+    if args.json:
+        print(json.dumps({"revisions": revisions,
+                          "rows": {k: {r: row for r, row in revs.items()}
+                                   for k, revs in history.items()},
+                          "violations": violations}, indent=2))
+    else:
+        print(trend_table(history, revisions))
+        for v in violations:
+            print(f"bench_history: REGRESSION: {v['row']} "
+                  f"{v['prev_value']} ({v['prev_rev']}) -> "
+                  f"{v['new_value']} ({v['new_rev']}): "
+                  f"-{v['drop_pct']}% over the "
+                  f"{args.regress_pct}% gate", file=sys.stderr)
+        if args.regress_pct is not None and not violations:
+            print(f"bench_history: no row dropped more than "
+                  f"{args.regress_pct}% at head")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

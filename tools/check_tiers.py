@@ -68,7 +68,9 @@ def check(records, *, budget: float, slow_threshold: float,
           goodput_seconds: float = None,
           goodput_budget: float = 30.0,
           obs_seconds: float = None,
-          obs_budget: float = 60.0) -> dict:
+          obs_budget: float = 60.0,
+          fleet_seconds: float = None,
+          fleet_budget: float = 60.0) -> dict:
     unmarked_slow = []       # should carry `slow` but don't
     tier1 = []               # everything tier-1 actually collects
     for r in records:
@@ -101,6 +103,11 @@ def check(records, *, budget: float, slow_threshold: float,
     # endpoint validations plus the paired overhead estimate must stay a
     # small fraction of the tier cap
     obs_over = (obs_seconds is not None and obs_seconds > obs_budget)
+    # the fleet budget line: tools/fleet_smoke.py aggregates three toy
+    # replicas inside the tier-1 wrapper (ISSUE 13) — merge + kill-one
+    # + oracle checks must stay a small fraction of the tier cap
+    fleet_over = (fleet_seconds is not None
+                  and fleet_seconds > fleet_budget)
     return {
         "n_records": len(records),
         "n_tier1": len(tier1),
@@ -120,12 +127,15 @@ def check(records, *, budget: float, slow_threshold: float,
         "obs_seconds": obs_seconds,
         "obs_budget_s": obs_budget,
         "obs_over_budget": obs_over,
+        "fleet_seconds": fleet_seconds,
+        "fleet_budget_s": fleet_budget,
+        "fleet_over_budget": fleet_over,
         "unmarked_slow": sorted(unmarked_slow,
                                 key=lambda r: -r["duration"]),
         "slowest_tier1": sorted(tier1, key=lambda r: -r["duration"])[:10],
         "ok": (tier1_total <= budget and not unmarked_slow
                and not lint_over and not chaos_over and not goodput_over
-               and not obs_over),
+               and not obs_over and not fleet_over),
     }
 
 
@@ -159,6 +169,11 @@ def main(argv=None) -> int:
                          "leg (tools/run_tier1.sh records it)")
     ap.add_argument("--obs-budget", type=float, default=60.0,
                     help="max seconds the obs smoke may take on tier-1")
+    ap.add_argument("--fleet-seconds", type=float, default=None,
+                    help="measured wall time of the tier-1 fleet_smoke "
+                         "leg (tools/run_tier1.sh records it)")
+    ap.add_argument("--fleet-budget", type=float, default=60.0,
+                    help="max seconds the fleet smoke may take on tier-1")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -175,7 +190,9 @@ def main(argv=None) -> int:
                    goodput_seconds=args.goodput_seconds,
                    goodput_budget=args.goodput_budget,
                    obs_seconds=args.obs_seconds,
-                   obs_budget=args.obs_budget)
+                   obs_budget=args.obs_budget,
+                   fleet_seconds=args.fleet_seconds,
+                   fleet_budget=args.fleet_budget)
 
     if args.json:
         print(json.dumps(result, indent=2))
@@ -195,6 +212,9 @@ def main(argv=None) -> int:
         if result.get("obs_seconds") is not None:
             print(f"  obs: {result['obs_seconds']:.2f}s "
                   f"(budget {result['obs_budget_s']}s)")
+        if result.get("fleet_seconds") is not None:
+            print(f"  fleet: {result['fleet_seconds']:.2f}s "
+                  f"(budget {result['fleet_budget_s']}s)")
         if result["chaos_over_budget"]:
             print(f"  VIOLATION: chaos gate took "
                   f"{result['chaos_seconds']:.2f}s, over the "
@@ -207,6 +227,10 @@ def main(argv=None) -> int:
             print(f"  VIOLATION: obs smoke took "
                   f"{result['obs_seconds']:.2f}s, over the "
                   f"{result['obs_budget_s']}s obs budget")
+        if result["fleet_over_budget"]:
+            print(f"  VIOLATION: fleet smoke took "
+                  f"{result['fleet_seconds']:.2f}s, over the "
+                  f"{result['fleet_budget_s']}s fleet budget")
         if result["lint_over_budget"]:
             print(f"  VIOLATION: lint pass took "
                   f"{result['lint_seconds']:.2f}s, over the "

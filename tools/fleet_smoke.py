@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Fleet-observability smoke (ISSUE 13) — the tier-1 gate for the fleet
+aggregation layer: boot THREE in-process toy serving replicas, each with
+its own TelemetryServer, aggregate them through a FleetAggregator, and
+prove the fleet surface end-to-end:
+
+  1. the merged exposition page stays LINT-CLEAN while a scraper thread
+     re-aggregates at 10 Hz concurrently with live decode traffic on all
+     three replicas (counters summed, gauges replica-labeled, histograms
+     pooled bucket-wise);
+  2. the fleet p99 (e2e) derived from the MERGED page's pooled buckets
+     matches the pooled oracle: a single LogHistogram fed every raw
+     latency from every replica (bucket-exact), which itself sits within
+     bucket resolution of the raw numpy percentile;
+  3. one replica KILLED mid-run is reported stale in /fleet/healthz and
+     the fleet block while the merged page keeps serving from the two
+     survivors — the aggregator never answers a scrape with a 500
+     because a member died;
+  4. zero post-warmup jit cache misses across every replica with both
+     telemetry layers attached (replica scrape + fleet re-scrape must
+     never compile);
+  5. the /fleet/tracez merge answers with trace_id-unique rows from the
+     surviving members.
+
+Exit 0 = all gates hold; 1 = any violation (named on stderr).
+
+    PYTHONPATH=. python tools/fleet_smoke.py [--batches 8] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+class FleetScraper(threading.Thread):
+    """Re-aggregate + validate the fleet surface in a loop: merged page
+    lints, /fleet/healthz parses with the rollup keys, /fleet/tracez
+    answers. Runs for the duration of the traffic."""
+
+    def __init__(self, fleet_srv, interval: float = 0.1):
+        super().__init__(name="fleet-smoke-scraper", daemon=True)
+        self.srv = fleet_srv
+        self.interval = interval
+        self.stop = threading.Event()
+        self.scrapes = 0
+        self.errors = []
+
+    def _one_pass(self):
+        from urllib.error import HTTPError
+        from urllib.request import urlopen
+        from paddle_tpu.obs import lint_exposition
+        try:
+            text = urlopen(self.srv.url("/metrics"),
+                           timeout=5).read().decode()
+        except HTTPError as e:
+            raise AssertionError(f"fleet /metrics {e.code}: "
+                                 f"{e.read().decode()[:300]}") from e
+        lint_exposition(text)
+        h = json.loads(urlopen(self.srv.url("/fleet/healthz"),
+                               timeout=5).read())
+        for key in ("status", "replicas", "serving", "stale",
+                    "queue_depth", "overloaded_total"):
+            if key not in h:
+                raise AssertionError(f"/fleet/healthz missing {key}")
+        t = json.loads(urlopen(self.srv.url("/fleet/tracez?limit=8"),
+                               timeout=5).read())
+        if "summary" not in t or "traces" not in t:
+            raise AssertionError("/fleet/tracez missing summary/traces")
+
+    def run(self):
+        while not self.stop.is_set():
+            try:
+                self._one_pass()
+                self.scrapes += 1
+            except Exception as e:             # noqa: BLE001 — the gate
+                self.errors.append(f"{type(e).__name__}: {e}")
+                return
+            if self.stop.wait(timeout=self.interval):
+                return
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--batches", type=int, default=8,
+                    help="full micro-batches of traffic per replica "
+                         "(half before the kill, half after)")
+    ap.add_argument("--scrape-interval", type=float, default=0.1,
+                    help="seconds between fleet aggregation passes")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+    from paddle_tpu.inference.serving import ServingMetrics
+    from paddle_tpu.jit.api import compile_cache_misses
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.obs import (FleetAggregator, bucket_percentile,
+                                lint_exposition)
+    from paddle_tpu.profiler._metrics import LogHistogram
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    intermediate_size=128)
+    # one toy model, three replicas: identical executables, so warmup on
+    # the first replica warms them all and the global compile-miss
+    # counter covers every replica at once
+    model = GPTForCausalLM(cfg)
+    model.eval()
+
+    raw_e2e = [[], [], []]      # per-replica raw latency streams — the
+    #                             pooled-numpy oracle's input
+
+    def hook_for(i):
+        def hook(row):
+            e2e = (row.get("request") or {}).get("e2e_s")
+            if e2e is not None:
+                raw_e2e[i].append(float(e2e))
+        return hook
+
+    engines, servers = [], []
+    for i in range(3):
+        eng = ServingEngine(model, ServingConfig(
+            max_batch=2, prompt_cap=12, max_new_tokens=8, decode_chunk=4),
+            metrics=ServingMetrics(on_record=hook_for(i)))
+        engines.append(eng)
+        servers.append(eng.serve_telemetry())
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           (int(rng.randint(3, 13)),)).astype(np.int64)
+               for _ in range(16)]
+
+    # warmup the shared executable set through every replica (each
+    # replica still runs its own warmup batch: per-engine host state)
+    for eng in engines:
+        for p in prompts[:2]:
+            eng.submit(p)
+        eng.drain()
+
+    failures = []
+    miss0 = compile_cache_misses()
+
+    fleet = FleetAggregator(
+        {f"replica{i}": srv for i, srv in enumerate(servers)},
+        timeout=2.0)
+    fleet_srv = fleet.serve()
+    scraper = FleetScraper(fleet_srv, interval=args.scrape_interval)
+    scraper.start()
+
+    def run_block(live, batches):
+        B = live[0].config.max_batch
+        for b in range(batches):
+            for eng in live:
+                for i in range(B):
+                    eng.submit(prompts[(b * B + i) % len(prompts)])
+                eng.drain()
+
+    half = max(args.batches // 2, 1)
+    run_block(engines, half)
+
+    # kill replica1 mid-run: its server goes away, its engine stops
+    # taking traffic; the fleet must degrade, not 500
+    servers[1].close()
+    run_block([engines[0], engines[2]], half)
+    # give the scraper at least one pass over the degraded fleet
+    deadline = time.time() + 5.0
+    post_kill = scraper.scrapes
+    while scraper.scrapes < post_kill + 2 and not scraper.errors \
+            and time.time() < deadline:
+        time.sleep(0.02)
+
+    scraper.stop.set()
+    scraper.join(timeout=5)
+    if scraper.errors:
+        failures.append(f"fleet surface validation failed: "
+                        f"{scraper.errors[0]}")
+    if scraper.scrapes < 2:
+        failures.append(f"fleet scraper completed {scraper.scrapes} "
+                        f"passes (need >= 2: before and after the kill)")
+
+    dm = compile_cache_misses() - miss0
+    if dm:
+        failures.append(f"{dm} jit cache misses post-warmup across the "
+                        f"fleet (must be 0)")
+
+    # stale reporting + merged page still serving, straight from the
+    # aggregator (not the HTTP loop, so failures name themselves)
+    page = fleet.merged_metrics()
+    try:
+        lint_exposition(page)
+    except Exception as e:                      # noqa: BLE001 — the gate
+        failures.append(f"merged page does not lint after kill: {e}")
+    if 'paddle_tpu_fleet_up{replica="replica1"} 0' not in page:
+        failures.append("killed replica not reported down in fleet block")
+    health = fleet.fleet_healthz()
+    if health.get("stale") != 1 or health.get("serving") != 2:
+        failures.append(f"fleet healthz rollup wrong after kill: "
+                        f"{ {k: health.get(k) for k in ('serving', 'draining', 'stale')} }")
+
+    # fleet p99 from the merged page's POOLED buckets vs the oracle:
+    # one histogram holding the SURVIVORS' pooled buckets (replica1's
+    # page is stale/excluded from the merge), min/max carried so the
+    # oracle percentile clamps like a single-recorder stream would
+    oracle = LogHistogram(lo=1e-4, hi=1e3, per_decade=10)
+    n_oracle = 0
+    for eng in (engines[0], engines[2]):
+        h = eng.metrics.hists["e2e_seconds"]
+        for i, c in enumerate(h.counts):
+            oracle.counts[i] += c
+        oracle.count += h.count
+        oracle.sum += h.sum
+        n_oracle += h.count
+        oracle._min = h._min if oracle._min is None else \
+            min(oracle._min, h._min)
+        oracle._max = h._max if oracle._max is None else \
+            max(oracle._max, h._max)
+    fams = lint_exposition(page)
+    fam = fams.get("paddle_tpu_serving_e2e_seconds")
+    merged_p99 = oracle_p99 = None
+    if fam is None:
+        failures.append("merged page missing the pooled e2e histogram")
+    else:
+        buckets, count = [], 0.0
+        for base, labels, val in fam["samples"]:
+            if base.endswith("_bucket"):
+                le = labels[1:-1].split("=", 1)[1].strip('"')
+                buckets.append((float("inf") if le == "+Inf"
+                                else float(le), float(val)))
+            elif base.endswith("_count"):
+                count = float(val)
+        merged_p99 = bucket_percentile(sorted(buckets), count, 0.99)
+        oracle_p99 = oracle.percentile(0.99)
+        if count != n_oracle:
+            failures.append(f"merged e2e count {count} != pooled oracle "
+                            f"count {n_oracle}")
+        # same buckets, same counts -> the derived percentiles may only
+        # differ by the recorder's min/max clamp: allow one bucket ratio
+        ratio = 10 ** (1 / 10)
+        if not (oracle_p99 / ratio <= merged_p99 <= oracle_p99 * ratio):
+            failures.append(f"fleet p99 {merged_p99:.6f}s not within one "
+                            f"bucket of pooled oracle {oracle_p99:.6f}s")
+        # and the pooled-numpy-stream backstop: the merged-page figure
+        # must sit within bucket resolution of the raw percentile over
+        # the survivors' pooled streams (one bucket for the recorder's
+        # quantization + one for interpolation)
+        pooled = np.asarray(raw_e2e[0] + raw_e2e[2])
+        np_p99 = float(np.percentile(pooled, 99)) if pooled.size else None
+        if np_p99 and not (np_p99 / ratio ** 2 <= merged_p99
+                           <= np_p99 * ratio ** 2):
+            failures.append(f"fleet p99 {merged_p99:.6f}s vs raw pooled "
+                            f"numpy p99 {np_p99:.6f}s: outside two "
+                            f"bucket ratios")
+    out = {"scrapes": scraper.scrapes,
+           "requests_pooled": int(n_oracle),
+           "merged_p99_s": merged_p99,
+           "oracle_p99_s": oracle_p99,
+           "post_warmup_jit_misses": dm,
+           "stale_replicas": health.get("stale"),
+           "ok": not failures, "failures": failures}
+
+    fleet_srv.close()
+    fleet.close()
+    for srv in (servers[0], servers[2]):
+        srv.close()
+
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        print(f"fleet_smoke: {out['scrapes']} aggregation passes over 3 "
+              f"replicas ({out['requests_pooled']} pooled requests); "
+              f"fleet p99 {out['merged_p99_s']}s vs oracle "
+              f"{out['oracle_p99_s']}s; post-warmup jit misses {dm}; "
+              f"replica1 killed -> {out['stale_replicas']} stale")
+    for f in failures:
+        print(f"fleet_smoke: VIOLATION: {f}", file=sys.stderr)
+    if not failures:
+        print("fleet_smoke: OK")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -79,6 +79,18 @@ obsrc=$?
 obs_secs=$(echo "$(date +%s.%N) $obs_t0" | awk '{printf "%.2f", $1-$2}')
 echo "obs_smoke: ${obs_secs}s (exit $obsrc)"
 
+# fleet smoke (ISSUE 13): three in-process toy replicas aggregated by a
+# FleetAggregator — merged page lint-clean under concurrent scrape +
+# decode, fleet p99 vs the pooled-bucket oracle, one replica killed
+# mid-run degrades to stale (never a fleet scrape 500), zero post-warmup
+# jit misses across every replica.
+fleet_t0=$(date +%s.%N)
+timeout -k 10 "${TIER1_FLEET_TIMEOUT:-120}" \
+    env JAX_PLATFORMS=cpu python tools/fleet_smoke.py
+fleetrc=$?
+fleet_secs=$(echo "$(date +%s.%N) $fleet_t0" | awk '{printf "%.2f", $1-$2}')
+echo "fleet_smoke: ${fleet_secs}s (exit $fleetrc)"
+
 timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
     PADDLE_TPU_TIER_DURATIONS="$DUR" \
     python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
@@ -89,6 +101,7 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -c
 [ "$rc" -eq 0 ] && rc=$chrc
 [ "$rc" -eq 0 ] && rc=$gprc
 [ "$rc" -eq 0 ] && rc=$obsrc
+[ "$rc" -eq 0 ] && rc=$fleetrc
 
 if [ -s "$DUR" ]; then
     python tools/check_tiers.py "$DUR" \
@@ -101,7 +114,9 @@ if [ -s "$DUR" ]; then
         --goodput-seconds "$goodput_secs" \
         --goodput-budget "${TIER1_GOODPUT_BUDGET:-30}" \
         --obs-seconds "$obs_secs" \
-        --obs-budget "${TIER1_OBS_BUDGET:-60}"
+        --obs-budget "${TIER1_OBS_BUDGET:-60}" \
+        --fleet-seconds "$fleet_secs" \
+        --fleet-budget "${TIER1_FLEET_BUDGET:-60}"
     crc=$?
     [ "$rc" -eq 0 ] && rc=$crc
 else
