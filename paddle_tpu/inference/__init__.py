@@ -339,5 +339,7 @@ from .serving import (ServingEngine, ServingConfig, ServingMetrics,  # noqa: E40
                       Request, RequestTrace, synthetic_traffic,
                       shared_prefix_traffic, repeated_traffic,
                       model_draft_fn)
-from .kv_cache import BlockPool  # noqa: E402,F401
+from .kv_cache import BlockPool, HostSpillTier  # noqa: E402,F401
 from .prefix_cache import PrefixCache  # noqa: E402,F401
+from .fleet import (ReplicaHandle, ReplicaRegistry, FleetRouter,  # noqa: E402,F401
+                    FleetRequest, AutoscaleController)

@@ -205,6 +205,23 @@ class BlockPool:
             return 0
         return self._deref(reversed(blocks))
 
+    def take(self, n: int = 1) -> Optional[List[int]]:
+        """Reserve `n` OWNERLESS blocks at refcount 1 — the rehydrate
+        path's allocation (ISSUE 14): a spilled prefix block coming back
+        from host RAM belongs to the cache, not to any request, exactly
+        like a retained block whose computing owner already finished.
+        Balanced by :meth:`release`. Returns the block ids, or None when
+        the free list is short (the caller evicts/reclaims and retries
+        or drops the rehydrate)."""
+        if n < 1 or n > len(self._free):
+            return None
+        out = []
+        for _ in range(n):
+            b = self._free.pop()
+            self._refs[b] = 1
+            out.append(b)
+        return out
+
     # ------------------------------------------------- cache references
     def retain(self, blocks) -> None:
         """Add one reference per block — how the prefix cache pins a
@@ -273,7 +290,157 @@ class BlockPool:
         self._rows.clear()
         self._refs.clear()
 
+    # ------------------------------------------- spill payloads (ISSUE 14)
+    def _spill_sig(self) -> tuple:
+        return ("spill_scatter", self.num_blocks, self.block_size,
+                self.num_layers, self.num_heads, self.head_dim,
+                str(self.dtype), self.cache_dtype)
+
+    def read_block(self, pools, block: int) -> tuple:
+        """ONE block's payload gathered to host — the spill tier's
+        device→host serialization. Every layer's planes for `block` are
+        stacked device-side into one array per storage dtype (f32 pools:
+        one [2L, bs, H, D] stack; int8 pools: an int8 code stack plus an
+        f32 scale stack) and fetched in a single `jax.device_get` call,
+        so a spill costs one transfer per payload array, not one per
+        layer. Returns the tuple of host ndarrays `write_block` takes
+        back verbatim — the round trip is bit-identical by construction
+        (same bytes, no recompute)."""
+        import jax
+        import jax.numpy as jnp
+        if self.cache_dtype == "int8":
+            codes = jnp.stack([layer[i][block] for layer in pools
+                               for i in (0, 2)])
+            scales = jnp.stack([layer[i][block] for layer in pools
+                                for i in (1, 3)])
+            return tuple(jax.device_get((codes, scales)))  # lint: allow(device-get)
+        planes = jnp.stack([p[block] for layer in pools for p in layer])
+        return (jax.device_get(planes),)  # lint: allow(device-get)
+
+    def write_block(self, pools, block: int, payload: tuple):
+        """Scatter one spilled payload back into pool position `block` —
+        the REHYDRATE path: one host→device copy per payload array (the
+        stacked planes ship as a single jit input), one donated in-place
+        executable shared by every pool of this geometry. The block id
+        is a data input, so rehydrating any block reuses the same
+        compiled program. Returns the replaced pools (the old ones are
+        donated/consumed)."""
+        import jax
+        sig = self._spill_sig()
+        fn = _SPILL_SCATTER_CACHE.get(sig)
+        if fn is None:
+            from ..jit.api import _note_cache_miss
+            _note_cache_miss()     # a new serving executable, counted
+            # exactly like the models' compiled-runner builds
+            if self.cache_dtype == "int8":
+                def run(pools, blk, codes, scales):
+                    return [(kc.at[blk].set(codes[2 * i]),
+                             ks.at[blk].set(scales[2 * i]),
+                             vc.at[blk].set(codes[2 * i + 1]),
+                             vs.at[blk].set(scales[2 * i + 1]))
+                            for i, (kc, ks, vc, vs) in enumerate(pools)]
+            else:
+                def run(pools, blk, planes):
+                    return [(k.at[blk].set(planes[2 * i]),
+                             v.at[blk].set(planes[2 * i + 1]))
+                            for i, (k, v) in enumerate(pools)]
+            fn = _SPILL_SCATTER_CACHE[sig] = jax.jit(
+                run, donate_argnums=(0,))
+        return fn(pools, np.int32(block), *payload)
+
     def __repr__(self):
         return (f"BlockPool(blocks={self.num_blocks}x{self.block_size}, "
                 f"free={self.free_blocks}/{self.capacity_blocks}, "
                 f"owners={len(self._rows)})")
+
+
+# one scatter executable per pool geometry, shared across engines (all
+# replicas of one model share shapes, so one compile serves the fleet)
+_SPILL_SCATTER_CACHE: Dict[tuple, object] = {}
+
+
+class HostSpillTier:
+    """Host-RAM budget + stats for spilled prefix blocks (ISSUE 14).
+
+    The PrefixCache owns the trie-side mechanics (which node spills,
+    where payloads live, LRU ordering); this class is the ACCOUNTING the
+    capacity model and the metrics surface need: a byte budget charged
+    at ``bytes_per_block`` per spilled block (the host copy carries the
+    same payload bytes as the device block), occupancy, and the
+    spill/rehydrate/drop/copy counters the smoke tests pin. Cached-
+    prefix capacity becomes host-memory-sized instead of HBM-sized: an
+    LRU-evicted full block serializes here instead of vanishing, and a
+    later trie hit rehydrates it with one host→device copy — orders
+    cheaper than recomputing its prefill."""
+
+    def __init__(self, *, bytes_per_block: int, byte_budget: int):
+        if byte_budget < bytes_per_block:
+            raise ValueError(
+                f"spill byte_budget {byte_budget} holds zero blocks "
+                f"(one block = {bytes_per_block} bytes)")
+        self.bytes_per_block = int(bytes_per_block)
+        self.byte_budget = int(byte_budget)
+        self.spilled_blocks = 0       # resident in the tier right now
+        self.spilled_total = 0        # blocks ever serialized to host
+        self.rehydrated_total = 0     # blocks copied back to device
+        self.dropped_total = 0        # tier-LRU final deaths (payload
+        #                               discarded for good)
+        self.upgraded_total = 0       # spilled entries replaced in
+        #                               place by a recomputed device
+        #                               block (prefix survives — NOT a
+        #                               drop)
+        self.d2h_copies = 0           # host arrays fetched (spill side)
+        self.h2d_copies = 0           # host arrays shipped (rehydrate)
+
+    @property
+    def capacity_blocks(self) -> int:
+        return self.byte_budget // self.bytes_per_block
+
+    @property
+    def host_bytes(self) -> int:
+        return self.spilled_blocks * self.bytes_per_block
+
+    @property
+    def over_budget_blocks(self) -> int:
+        """Blocks the tier must drop to get back under budget."""
+        return max(0, self.spilled_blocks - self.capacity_blocks)
+
+    def stats(self) -> dict:
+        return {"spilled_blocks": self.spilled_blocks,
+                "host_bytes": self.host_bytes,
+                "byte_budget": self.byte_budget,
+                "spilled_total": self.spilled_total,
+                "rehydrated_total": self.rehydrated_total,
+                "dropped_total": self.dropped_total,
+                "upgraded_total": self.upgraded_total,
+                "d2h_copies": self.d2h_copies,
+                "h2d_copies": self.h2d_copies}
+
+    def metrics_text(self, prefix: str = "paddle_tpu_spill") -> str:
+        """Prometheus exposition of the tier — registered beside the
+        serving producers in `ServingEngine.metrics_registry()`."""
+        from ..profiler._metrics import counter_lines, gauge_lines
+        lines: List[str] = []
+        for name, help_ in (
+                ("spilled", "prefix blocks serialized to host RAM"),
+                ("rehydrated", "spilled blocks copied back to device"),
+                ("dropped", "spilled blocks evicted from the host tier "
+                            "(payload lost for good)"),
+                ("upgraded", "spilled entries replaced in place by a "
+                             "recomputed device block"),
+                ("d2h_copies", "device->host payload arrays (spill)"),
+                ("h2d_copies", "host->device payload arrays (rehydrate)")):
+            attr = name if name.endswith("copies") else f"{name}_total"
+            lines.extend(counter_lines(prefix, f"{name}_total",
+                                       getattr(self, attr), help_))
+        lines.extend(gauge_lines(prefix, "host_blocks",
+                                 self.spilled_blocks,
+                                 "spilled blocks resident in host RAM"))
+        lines.extend(gauge_lines(prefix, "host_bytes", self.host_bytes,
+                                 "host RAM the spill tier pins"))
+        return "\n".join(lines) + "\n"
+
+    def __repr__(self):
+        return (f"HostSpillTier(blocks={self.spilled_blocks}/"
+                f"{self.capacity_blocks}, bytes={self.host_bytes}/"
+                f"{self.byte_budget})")

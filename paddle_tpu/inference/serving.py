@@ -200,6 +200,12 @@ class Request:
     max_new_tokens: int
     status: str = "queued"   # queued|active|done|rejected|timeout
     reason: Optional[str] = None            # rejection/timeout detail
+    # rejection taxonomy (ISSUE 14 satellite): True = the refusal is
+    # replica-local (overloaded/draining/queue_full — retry ELSEWHERE),
+    # False = terminal everywhere (kv_oom never fits, shape-recompile
+    # rejects) so a router cannot hot-loop a request no replica will
+    # ever accept; None until a rejection stamps it
+    retriable: Optional[bool] = None
     deadline_s: Optional[float] = None      # max queue wait before admit
     tokens: Optional[np.ndarray] = None     # generated ids (done only)
     n_out: int = 0                          # tokens up to & incl. EOS
@@ -222,6 +228,8 @@ class Request:
                "spans": t.to_dict()}
         if t.trace_id is not None:
             rec["trace_id"] = t.trace_id
+        if self.retriable is not None:
+            rec["retriable"] = self.retriable
         if t.events:
             # the engine-call windows (ISSUE 12): rounded for the wire,
             # ordering preserved — span_tree() derives the tree view
@@ -240,6 +248,19 @@ class Request:
             if val is not None:
                 rec[key] = round(val, 6)
         return rec
+
+
+# submit() rejection-reason taxonomy (ISSUE 14 satellite): which
+# refusals a fleet router may retry on ANOTHER replica vs which are
+# terminal everywhere (identically-configured replicas refuse them too)
+_REJECT_RETRIABLE = {
+    "draining": True,         # this replica is shutting down; others serve
+    "overloaded": True,       # load shed — exactly the retry-elsewhere hint
+    "queue_full": True,       # hard cap here; another queue may have room
+    "prompt_shape": False,    # would force a new executable on any replica
+    "kv_oom": False,          # never fits the pool even fully drained
+    "max_new_tokens": False,  # unservable by construction
+}
 
 
 # ---------------------------------------------------------------- metrics
@@ -505,6 +526,12 @@ class ServingConfig:
     #                            cached (refcount-free) blocks; None =
     #                            bounded by the pool itself (admission
     #                            reclaims cached blocks under pressure)
+    # --- host-RAM spill tier (ISSUE 14): LRU-evicted full prefix blocks
+    # serialize to pinned host arrays instead of vanishing; a later trie
+    # hit rehydrates via ONE host→device copy — cached-prefix capacity
+    # becomes host-memory-sized instead of HBM-sized. The value is the
+    # host byte budget; None disables (eviction stays final).
+    spill_host_bytes: Optional[int] = None
     # --- speculative decoding (ISSUE 11): draft-verify through the
     # ragged [B, k] multi-token paged-attention kernel. Each decode step
     # scores `spec_k` drafted tokens + the pending token in ONE
@@ -560,6 +587,11 @@ class ServingConfig:
             raise ValueError("prefix_cache=True requires paged=True (the "
                              "trie shares BLOCK-pool blocks; the padded "
                              "engine has no blocks to share)")
+        if self.spill_host_bytes is not None and not self.prefix_cache:
+            raise ValueError("spill_host_bytes requires prefix_cache="
+                             "True (the spill tier holds EVICTED trie "
+                             "blocks; without the trie nothing is ever "
+                             "evicted into it)")
         if self.spec_decode:
             if not self.paged:
                 raise ValueError("spec_decode=True requires paged=True "
@@ -713,6 +745,7 @@ class ServingEngine:
         # StepMonitor.record_compile expects for shape_delta rendering)
         self._shape_sig = (((config.max_batch, config.prompt_cap), "int64"),
                            ((config.max_batch,), "int32"))
+        self._spill = None     # host spill tier (paged + prefix + spill)
         if config.paged:
             # slot-level continuous batching over a paged block pool: each
             # batch slot runs its own request; EOS/budget frees the slot's
@@ -746,6 +779,19 @@ class ServingEngine:
                 from .prefix_cache import PrefixCache
                 self._prefix = PrefixCache(
                     self._pool, byte_budget=config.prefix_cache_bytes)
+                if config.spill_host_bytes is not None:
+                    # host-RAM spill tier (ISSUE 14): the cache owns the
+                    # trie mechanics; the engine owns the device pools,
+                    # so both transfer directions are closures over it
+                    from .kv_cache import HostSpillTier
+                    self._spill = HostSpillTier(
+                        bytes_per_block=self._pool.bytes_per_block,
+                        byte_budget=config.spill_host_bytes)
+                    self._prefix.attach_spill(
+                        self._spill,
+                        reader=lambda blk: self._pool.read_block(
+                            self._pools, blk),
+                        writer=self._spill_write)
             # chunked prefill (ISSUE 11): next prompt position to prefill
             # per slot; -1 = not mid-prefill (a plain decode row)
             self._prefill_pos = np.full((B,), -1, np.int64)
@@ -864,12 +910,14 @@ class ServingEngine:
         # to route elsewhere, not to retry here
         if self._draining:
             req.status, req.reason = "rejected", "draining"
+            req.retriable = _REJECT_RETRIABLE["draining"]
             self.metrics.record_request(req)
             return req
         pf = self.preflight(prompt, want)
         if pf:
             finding = pf[0]
             req.status, req.reason = "rejected", finding.code
+            req.retriable = _REJECT_RETRIABLE.get(finding.code, False)
             if finding.code == "prompt_shape":
                 plen = int(prompt.shape[0])
                 if plen not in self._rejected_shapes:
@@ -888,10 +936,12 @@ class ServingEngine:
         if cfg.queue_high_watermark is not None and \
                 len(self._queue) >= cfg.queue_high_watermark:
             req.status, req.reason = "rejected", "overloaded"
+            req.retriable = _REJECT_RETRIABLE["overloaded"]
             self.metrics.record_request(req)
             return req
         if len(self._queue) >= cfg.queue_capacity:
             req.status, req.reason = "rejected", "queue_full"
+            req.retriable = _REJECT_RETRIABLE["queue_full"]
             self.metrics.record_request(req)
             return req
         self._queue.append(req)
@@ -1120,6 +1170,11 @@ class ServingEngine:
         ran = set()
         self.monitor.begin_step()
         out_tokens = 0
+        # spill/rehydrate device calls ride admission (match/evict): tag
+        # them into `ran` so their one-time compiles are warmup, not
+        # shape churn, in the recompile accounting below
+        spill0 = (self._spill.spilled_total, self._spill.rehydrated_total) \
+            if self._spill is not None else (0, 0)
         try:
             finished, expired, admit_ran = self._admit_paged()
             ran |= admit_ran
@@ -1174,6 +1229,11 @@ class ServingEngine:
                 kv_capacity=self._pool.capacity_tokens,
                 queue_depth=len(self._queue),
                 kv_shared_tokens=kv_shared)
+        if self._spill is not None:
+            if self._spill.spilled_total > spill0[0]:
+                ran.add("spill")
+            if self._spill.rehydrated_total > spill0[1]:
+                ran.add("rehydrate")
         # compile accounting, same convention as the static engine: a miss
         # while every executable this step ran was already seen is shape
         # churn — log it through the r7 recompile detector
@@ -1299,9 +1359,24 @@ class ServingEngine:
             d[bs:] = rng.randint(1, vocab_size, (aligned - bs,))
             self.submit(d)
             self.drain()
+        if self._spill is not None:
+            # spill + rehydrate leg: force every cached block through
+            # the host tier and back so the stacked d2h gather and the
+            # donated h2d scatter executables lower during warmup too —
+            # the zero-post-warmup-miss assertions cover them
+            self._prefix.evict(self._prefix.cached_blocks)
+            self.submit(p)
+            self.drain()
         if clear:
             self._prefix.clear()
         return self
+
+    def _spill_write(self, blk: int, payload):
+        """Rehydrate one spilled payload into pool block `blk`: the ONE
+        host→device copy (the stacked payload ships as a single jit
+        input) through the pool's donated scatter executable — the
+        engine re-binds its pools because the call consumed them."""
+        self._pools = self._pool.write_block(self._pools, blk, payload)
 
     def _cow_copy(self, src: int, dst: int):
         """Copy one pool block (every layer, K and V — codes AND scales
@@ -1844,6 +1919,10 @@ class ServingEngine:
                 "inflight": inflight,
                 "overloaded_total": m.counters["overloaded"],
                 "rejected_total": m.counters["rejected"],
+                # goodput inputs (ISSUE 14): the autoscale controller
+                # derives completed/requests deltas per tick from here
+                "requests_total": m.counters["requests"],
+                "completed_total": m.counters["completed"],
                 "kv_occupancy": m.gauges["kv_occupancy"]}
 
     def statusz(self) -> dict:
@@ -1882,7 +1961,10 @@ class ServingEngine:
                 out["prefix_cache"] = {
                     "cached_blocks": self._prefix.cached_blocks,
                     "cached_bytes": self._prefix.cached_bytes,
+                    "spilled_blocks": self._prefix.spilled_blocks,
                     "byte_budget": self._prefix.byte_budget}
+            if self._spill is not None:
+                out["spill"] = self._spill.stats()
         return out
 
     def metrics_registry(self, prefix: str = "paddle_tpu_serving"):
@@ -1897,6 +1979,13 @@ class ServingEngine:
         reg.register("serving_batch",
                      lambda: self.monitor.metrics_text(
                          prefix=f"{prefix}_batch"))
+        if self._spill is not None:
+            # the spill tier's counters ride the same registry (ISSUE
+            # 14): one scrape shows blocks spilled/rehydrated next to
+            # the request metrics they are saving prefill for
+            reg.register("spill",
+                         lambda: self._spill.metrics_text(
+                             prefix=f"{prefix}_spill"))
         return reg
 
     def serve_telemetry(self, *, host: str = "127.0.0.1", port: int = 0,

@@ -27,6 +27,12 @@ Merge semantics per metric TYPE (`merge_exposition`):
               pages elide empty buckets, so layout equality can only be
               checked up to the populated bounds).
 
+Scrape-storm guard (ISSUE 14): member scrapes are cached per route for
+``cache_ttl`` seconds (default 1 s; 0 disables), so N clients hammering
+the fleet page cost the members ONE scrape per TTL window instead of N —
+membership changes invalidate the cache, and cached responses never
+touch the staleness bookkeeping below.
+
 Staleness (the degrade rule): a replica whose scrape fails (connection
 refused / timeout / bad payload) is marked ``stale`` and EXCLUDED from
 the merge — the merged page keeps serving from the live replicas and the
@@ -327,11 +333,26 @@ class FleetAggregator:
     """
 
     def __init__(self, replicas=None, *, timeout: float = 2.0,
-                 prefix: str = "paddle_tpu_fleet"):
+                 prefix: str = "paddle_tpu_fleet",
+                 cache_ttl: float = 1.0):
         self.timeout = float(timeout)
         self.prefix = prefix
         self.scrapes_total = 0
         self.scrape_errors_total = 0
+        self.scrape_cache_hits_total = 0
+        # scrape-storm guard (ISSUE 14 satellite): member scrapes were
+        # pull-through, so N dashboard clients hitting the fleet page
+        # multiplied into N scrapes of every member's /metrics. Results
+        # are now cached per route for `cache_ttl` seconds (0 disables)
+        # — staleness bookkeeping is untouched because cached responses
+        # never touch mark_ok/mark_failed, and membership changes
+        # invalidate the cache so an added/removed replica shows up in
+        # the very next scrape.
+        self.cache_ttl = float(cache_ttl)
+        self._cache: Dict[str, Tuple[float, Dict[str, object]]] = {}
+        self._cache_gen = 0     # membership generation: a scrape that
+        # started before an add/remove must not store its pre-change
+        # snapshot over the invalidation
         self._replicas: Dict[str, _Replica] = {}
         self._lock = threading.Lock()
         # one long-lived scrape pool: the fleet /metrics route is pull-
@@ -371,10 +392,14 @@ class FleetAggregator:
             if name in self._replicas:
                 raise ValueError(f"replica {name!r} already registered")
             self._replicas[name] = _Replica(name, url)
-        return self
+            self._cache.clear()     # membership change: next scrape is
+            self._cache_gen += 1    # fresh so the member shows up now
+            return self
 
     def remove_replica(self, name: str) -> bool:
         with self._lock:
+            self._cache.clear()
+            self._cache_gen += 1
             return self._replicas.pop(name, None) is not None
 
     @property
@@ -409,8 +434,17 @@ class FleetAggregator:
                       ok_codes: Tuple[int, ...] = ()) -> Dict[str, object]:
         """GET one route from every replica concurrently; successes update
         liveness, failures mark stale. Returns {name: decoded} for the
-        replicas that answered."""
+        replicas that answered. Within `cache_ttl` seconds of the last
+        scrape of the SAME route the cached result is returned without
+        touching any member (the scrape-storm guard — see __init__)."""
+        now = time.monotonic()
         with self._lock:
+            if self.cache_ttl > 0:
+                hit = self._cache.get(route)
+                if hit is not None and now - hit[0] < self.cache_ttl:
+                    self.scrape_cache_hits_total += 1
+                    return dict(hit[1])
+            gen = self._cache_gen
             members = list(self._replicas.values())
             self.scrapes_total += 1
         if not members:
@@ -435,6 +469,12 @@ class FleetAggregator:
                 continue
             rep.mark_ok()
             results[rep.name] = payload
+        if self.cache_ttl > 0:
+            with self._lock:
+                if self._cache_gen == gen:  # membership unchanged since
+                    # the member list was snapped — safe to cache; a
+                    # concurrent add/remove wins otherwise
+                    self._cache[route] = (now, dict(results))
         return results
 
     # ------------------------------------------------------------- surface
@@ -469,7 +509,11 @@ class FleetAggregator:
         lines += [
             f"# HELP {p}_scrape_errors_total failed member scrapes",
             f"# TYPE {p}_scrape_errors_total counter",
-            f"{p}_scrape_errors_total {self.scrape_errors_total}"]
+            f"{p}_scrape_errors_total {self.scrape_errors_total}",
+            f"# HELP {p}_scrape_cache_hits_total member scrapes served "
+            f"from the TTL cache (scrape-storm guard)",
+            f"# TYPE {p}_scrape_cache_hits_total counter",
+            f"{p}_scrape_cache_hits_total {self.scrape_cache_hits_total}"]
         return "\n".join(lines) + "\n"
 
     def fleet_healthz(self, _query: Optional[dict] = None) -> dict:
@@ -565,6 +609,8 @@ class FleetAggregator:
         return {"replicas": self.replica_states(),
                 "scrapes_total": self.scrapes_total,
                 "scrape_errors_total": self.scrape_errors_total,
+                "scrape_cache_hits_total": self.scrape_cache_hits_total,
+                "cache_ttl_s": self.cache_ttl,
                 "timeout_s": self.timeout}
 
     # -------------------------------------------------------------- serve

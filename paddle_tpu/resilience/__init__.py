@@ -24,7 +24,8 @@ from .checkpoint import (CheckpointManager, CheckpointCorruptError,
                          AsyncHandle, atomic_write_bytes)  # noqa: F401
 from .chaos import (Injector, Fault, KillAfterStep, KillAtSite,
                     RaiseInStep, TruncateDuringSave, TransientIOErrors,
-                    TransientIOError, SimulatedKill, corrupt_leaf,
+                    TransientIOError, SimulatedKill, ReplicaDown,
+                    ReplicaKill, ScrapeTimeout, corrupt_leaf,
                     retry)  # noqa: F401
 from .preempt import (PreemptionHandler, Preempted, RESUME_EXIT_CODE,
                       exit_for_resume, is_resume_exit)  # noqa: F401
@@ -35,7 +36,8 @@ __all__ = [
     "atomic_write_bytes",
     "Injector", "Fault", "KillAfterStep", "KillAtSite", "RaiseInStep",
     "TruncateDuringSave", "TransientIOErrors", "TransientIOError",
-    "SimulatedKill", "corrupt_leaf", "retry",
+    "SimulatedKill", "ReplicaDown", "ReplicaKill", "ScrapeTimeout",
+    "corrupt_leaf", "retry",
     "PreemptionHandler", "Preempted", "RESUME_EXIT_CODE",
     "exit_for_resume", "is_resume_exit",
     "TrainState",

@@ -20,7 +20,10 @@ faults a first-class, SEEDED test input:
                   preemptible fleet: process death, torn writes, host
                   exceptions, flaky storage. CorruptLeaf is post-hoc
                   (``corrupt_leaf``): bitrot happens to data at rest, not
-                  to code in flight.
+                  to code in flight. ReplicaKill / ScrapeTimeout
+                  (ISSUE 14) extend the taxonomy to FLEET faults: a
+                  serving replica dying mid-traffic (observed as
+                  ReplicaDown by the router) and a flaky health scrape.
 
   SimulatedKill   BaseException (like SystemExit): nothing should catch
                   it accidentally — ``except Exception`` recovery blocks
@@ -60,6 +63,20 @@ class TransientIOError(OSError):
     """An injected transient storage fault (the NFS hiccup / GCS 503
     class). OSError subclass: real checkpoint I/O retries exactly the
     errnos this models."""
+
+
+class ReplicaDown(ConnectionError):
+    """A replica death observed from OUTSIDE the replica (ISSUE 14) —
+    what a router's dispatch/step call sees when the peer process died.
+    Unlike SimulatedKill (THIS process dying, deliberately uncatchable),
+    a peer's death is exactly what fleet code must catch and route
+    around, so it derives from ConnectionError like the real thing."""
+
+    def __init__(self, replica: str, detail: str = ""):
+        self.replica = replica
+        self.detail = detail
+        super().__init__(f"replica {replica} is down" +
+                         (f" ({detail})" if detail else ""))
 
 
 # --------------------------------------------------------------- faults
@@ -179,6 +196,57 @@ class TransientIOErrors(Fault):
         self.remaining -= 1
         raise TransientIOError(
             f"injected transient IO fault at {ctx.get('path', site)} "
+            f"({self.times - self.remaining}/{self.times})")
+
+
+@dataclass
+class ReplicaKill(Fault):
+    """Kill one named replica the first time the router steps it at or
+    past `step` (site ``fleet.step``, ctx: replica/step) — the
+    replica-dies-mid-traffic case the fleet failover path exists for.
+    The router observes the death as a ReplicaDown at the step call and
+    must eject + redispatch; the fleet chaos tests assert the fault
+    FIRED (injector.fired) so a green run proves recovery ran, not that
+    nothing happened."""
+    replica: str
+    step: int = 0
+    kind: str = "replica_kill"
+    fired: bool = field(default=False, init=False)
+
+    def matches(self, site, ctx):
+        return (not self.fired and site == "fleet.step"
+                and ctx.get("replica") == self.replica
+                and ctx.get("step", -1) >= self.step)
+
+    def trigger(self, injector, site, ctx):
+        self.fired = True
+        raise ReplicaDown(self.replica,
+                          f"killed at step {ctx.get('step')}")
+
+
+@dataclass
+class ScrapeTimeout(Fault):
+    """Time out the next `times` health scrapes of one named replica
+    (site ``fleet.scrape``) — the flaky-network / overloaded-ops-surface
+    case. A registry must tolerate `fail_threshold - 1` consecutive
+    timeouts without ejecting (transients are the steady state) and
+    eject at the threshold; both sides are asserted in tests."""
+    replica: str
+    times: int = 1
+    kind: str = "scrape_timeout"
+    remaining: int = field(default=-1, init=False)
+
+    def __post_init__(self):
+        self.remaining = self.times
+
+    def matches(self, site, ctx):
+        return (self.remaining > 0 and site == "fleet.scrape"
+                and ctx.get("replica") == self.replica)
+
+    def trigger(self, injector, site, ctx):
+        self.remaining -= 1
+        raise TimeoutError(
+            f"injected scrape timeout on {self.replica} "
             f"({self.times - self.remaining}/{self.times})")
 
 

@@ -207,8 +207,11 @@ class TestFleetAggregator:
                             "rejected_total": 0}
         srvs = [_mk_server(i, health=health(i)) for i in range(3)]
         try:
+            # cache_ttl=0: this test asserts scrape-to-scrape staleness
+            # transitions; the TTL cache would serve pre-kill snapshots
             fleet = FleetAggregator(
-                {f"r{i}": s for i, s in enumerate(srvs)}, timeout=1.0)
+                {f"r{i}": s for i, s in enumerate(srvs)}, timeout=1.0,
+                cache_ttl=0.0)
             page = fleet.merged_metrics()
             lint_exposition(page)
             assert "s_requests_total 60" in page
@@ -342,6 +345,105 @@ class TestFleetAggregator:
                 srv.close()
             except Exception:
                 pass
+
+
+class TestScrapeTTLCache:
+    """ISSUE 14 satellite: the scrape-storm guard — member scrapes are
+    cached per route for cache_ttl seconds, so N fleet-page clients cost
+    the members ONE scrape per window; 0 disables; membership changes
+    invalidate; staleness bookkeeping untouched by cached responses."""
+
+    def _counting_server(self):
+        calls = [0]
+        reg = MetricsRegistry()
+
+        def produce():
+            calls[0] += 1
+            return "\n".join(
+                counter_lines("s", "requests_total", 10, "reqs")) + "\n"
+
+        reg.register("m", produce)
+        return TelemetryServer(reg).start(), calls
+
+    def test_ttl_collapses_scrape_storm(self):
+        srv, calls = self._counting_server()
+        try:
+            fleet = FleetAggregator({"r0": srv}, timeout=1.0,
+                                    cache_ttl=30.0)
+            pages = [fleet.merged_metrics() for _ in range(5)]
+            assert calls[0] == 1            # 5 clients, ONE member scrape
+            assert fleet.scrape_cache_hits_total == 4
+            assert all("s_requests_total 10" in p for p in pages)
+            assert "scrape_cache_hits_total 4" in pages[-1]
+            # a different route is a different cache entry
+            fleet.fleet_healthz()
+            assert fleet.scrape_cache_hits_total == 4
+            fleet.fleet_healthz()
+            assert fleet.scrape_cache_hits_total == 5
+            assert fleet.fleet_statusz()["scrape_cache_hits_total"] == 5
+        finally:
+            srv.close()
+
+    def test_ttl_zero_disables(self):
+        srv, calls = self._counting_server()
+        try:
+            fleet = FleetAggregator({"r0": srv}, timeout=1.0,
+                                    cache_ttl=0.0)
+            fleet.merged_metrics()
+            fleet.merged_metrics()
+            assert calls[0] == 2
+            assert fleet.scrape_cache_hits_total == 0
+        finally:
+            srv.close()
+
+    def test_ttl_expires(self):
+        srv, calls = self._counting_server()
+        try:
+            fleet = FleetAggregator({"r0": srv}, timeout=1.0,
+                                    cache_ttl=0.05)
+            fleet.merged_metrics()
+            time.sleep(0.06)
+            fleet.merged_metrics()
+            assert calls[0] == 2
+        finally:
+            srv.close()
+
+    def test_membership_change_invalidates(self):
+        srv, calls = self._counting_server()
+        srv2, calls2 = self._counting_server()
+        try:
+            fleet = FleetAggregator({"r0": srv}, timeout=1.0,
+                                    cache_ttl=30.0)
+            assert "s_requests_total 10" in fleet.merged_metrics()
+            fleet.add_replica("r1", srv2)
+            # the fresh member shows up on the VERY next scrape — the
+            # cache was invalidated, both members scraped once more
+            assert "s_requests_total 20" in fleet.merged_metrics()
+            assert (calls[0], calls2[0]) == (2, 1)
+            fleet.remove_replica("r1")
+            assert "s_requests_total 10" in fleet.merged_metrics()
+        finally:
+            srv.close()
+            srv2.close()
+
+    def test_cached_scrape_never_touches_staleness(self):
+        """A member dying inside the TTL window stays 'live' until the
+        cache expires — cached responses must not mark_ok a corpse, and
+        the first REAL scrape after expiry degrades it."""
+        srv, _ = self._counting_server()
+        fleet = FleetAggregator({"r0": srv}, timeout=0.5,
+                                cache_ttl=0.2)
+        try:
+            fleet.merged_metrics()
+            srv.close()
+            fleet.merged_metrics()                     # cached: still ok
+            assert not fleet.replica_states()["r0"]["stale"]
+            time.sleep(0.25)
+            fleet.merged_metrics()                     # real: degrades
+            assert fleet.replica_states()["r0"]["stale"]
+        finally:
+            srv.close()
+            fleet.close()
 
 
 class TestServerPoller:

@@ -685,3 +685,206 @@ def test_engine_exception_recovers_with_cache(served_model):
     done = eng.drain()
     assert [r.status for r in done] == ["done"]
     assert eng._prefix.cached_blocks == 2
+
+
+# ---------------------------------------------- host spill tier (ISSUE 14)
+
+def _spill_engine(m, budget_blocks=2, **kw):
+    """Engine with a device prefix budget of `budget_blocks` blocks and
+    an ample host spill tier — eviction spills instead of dying."""
+    from paddle_tpu.inference import BlockPool
+    bpb = BlockPool.for_model(m, num_blocks=2, block_size=4).bytes_per_block
+    base = dict(prefix_cache_bytes=budget_blocks * bpb,
+                spill_host_bytes=1 << 22)
+    base.update(kw)
+    return _engine(m, **base)
+
+
+class TestSpillTier:
+    def test_pool_block_round_trip_bit_identical(self, served_model):
+        """read_block -> write_block moves bytes, never recomputes:
+        the round-tripped block equals the source bitwise (f32 AND
+        int8 pools), and the write is one donated in-place scatter."""
+        m, cfg = served_model
+        for cache_dtype in (None, "int8"):
+            eng = _engine(m, cache_dtype=cache_dtype)
+            ids = _prompts(cfg, [CAP])
+            eng.submit(ids[0])
+            eng.drain()
+            blk = int(eng._prefix.match(ids[0])[0][0])
+            src = [tuple(np.asarray(p)[blk].copy() for p in layer)
+                   for layer in eng._pools]
+            payload = eng._pool.read_block(eng._pools, blk)
+            # scatter into a different free block and compare planes
+            dst = eng._pool.take(1)[0]
+            eng._pools = eng._pool.write_block(eng._pools, dst, payload)
+            for li, layer in enumerate(eng._pools):
+                for pi, p in enumerate(layer):
+                    np.testing.assert_array_equal(
+                        np.asarray(p)[dst], src[li][pi])
+            eng._pool.release([dst])
+
+    def test_evict_spill_rehydrate_bit_identical_decode(self, served_model):
+        """evict-under-budget -> spill -> later hit rehydrates with ONE
+        host->device copy per block, decode bit-identical to a
+        never-evicted engine AND to the cache-off reference."""
+        m, cfg = served_model
+        ids = _prompts(cfg, [CAP, CAP, CAP], seed=3)
+        eng = _spill_engine(m, budget_blocks=2, kv_blocks=40)
+        never = _engine(m, kv_blocks=40)         # ample budget, no spill
+        first = {}
+        for i in range(3):
+            r = eng.submit(ids[i]); eng.drain()
+            first[i] = r.tokens
+            never.submit(ids[i]); never.drain()
+        t = eng._spill
+        assert t.spilled_total >= 1              # the 2-block budget
+        assert eng._prefix.spilled_blocks == t.spilled_blocks
+        # resubmit the LRU-spilled prompt: its blocks rehydrate
+        r0 = t.rehydrated_total
+        ra = eng.submit(ids[0]); eng.drain()
+        rb = never.submit(ids[0]); never.drain()
+        assert t.rehydrated_total > r0
+        assert t.h2d_copies == t.rehydrated_total   # one copy per block
+        np.testing.assert_array_equal(ra.tokens, first[0])
+        np.testing.assert_array_equal(ra.tokens, rb.tokens)
+
+    def test_cow_after_rehydrate_checksum_invariance(self, served_model):
+        """A full-hit repeat on a REHYDRATED prefix still goes through
+        COW: the rehydrated shared blocks' checksums never change."""
+        m, cfg = served_model
+        ids = _prompts(cfg, [CAP], seed=5)
+        eng = _spill_engine(m, budget_blocks=2, kv_blocks=40, max_batch=1)
+        eng.submit(ids[0]); eng.drain()
+        eng._prefix.evict(eng._prefix.cached_blocks)     # all -> host
+        assert eng._prefix.cached_blocks == 0
+        eng.submit(ids[0])                               # rehydrates +
+        eng.drain()                                      # COW full hit
+        assert eng._spill.rehydrated_total >= 2
+        cached, t = eng._prefix.match(ids[0])
+        assert t == CAP
+        before = [tuple(np.asarray(p)[cached].tobytes() for p in layer)
+                  for layer in eng._pools]
+        eng.submit(ids[0]); eng.drain()                  # another COW hit
+        after = [tuple(np.asarray(p)[cached].tobytes() for p in layer)
+                 for layer in eng._pools]
+        assert before == after
+
+    def test_refcount_conservation_mixed_spill_traffic(self, served_model):
+        """Pool conservation through mixed spill/rehydrate/upgrade
+        traffic: after drain + clear, every block is back on the free
+        list and the refcount table is empty (spilled entries hold NO
+        pool reference)."""
+        m, cfg = served_model
+        eng = _spill_engine(m, budget_blocks=2, kv_blocks=40)
+        lens = [CAP, 5, CAP, 3, CAP, 7, CAP]
+        ids = _prompts(cfg, lens, seed=9)
+        for i, ln in enumerate(lens):
+            eng.submit(ids[i, :ln])
+            eng.drain()
+        eng.submit(ids[0, :CAP]); eng.drain()     # rehydrate + COW
+        t = eng._spill
+        assert t.spilled_total >= 1
+        # device refs == device-cached blocks; spilled hold none
+        assert eng._pool.free_blocks == \
+            eng._pool.capacity_blocks - eng._prefix.cached_blocks
+        eng._prefix.clear()
+        assert eng._pool.free_blocks == eng._pool.capacity_blocks
+        assert eng._pool._refs == {}
+        assert eng._prefix.spilled_blocks == 0
+        assert t.spilled_blocks == 0
+
+    def test_tier_budget_drops_lru_spilled(self, served_model):
+        """The host tier has its own budget: spilling past it drops the
+        LRU spilled leaves for good (dropped_total) and host residency
+        never exceeds capacity_blocks."""
+        m, cfg = served_model
+        from paddle_tpu.inference import BlockPool
+        bpb = BlockPool.for_model(m, num_blocks=2,
+                                  block_size=4).bytes_per_block
+        eng = _engine(m, kv_blocks=40, prefix_cache_bytes=2 * bpb,
+                      spill_host_bytes=2 * bpb)    # tier holds 2 blocks
+        lens = [CAP, CAP, CAP, CAP]
+        ids = _prompts(cfg, lens, seed=11)
+        for i, ln in enumerate(lens):
+            eng.submit(ids[i, :ln])
+            eng.drain()
+        t = eng._spill
+        assert t.dropped_total >= 1
+        assert t.spilled_blocks <= t.capacity_blocks
+        assert eng._prefix.spilled_blocks == t.spilled_blocks
+
+    def test_spill_zero_recompiles_after_warmup(self, served_model):
+        """warmup_prefix_cache's spill leg lowers the d2h gather and h2d
+        scatter too: steady spill/rehydrate traffic adds zero jit cache
+        misses and zero logged recompiles."""
+        m, cfg = served_model
+        eng = _spill_engine(m, budget_blocks=2, kv_blocks=40)
+        eng.warmup_prefix_cache(cfg.vocab_size)
+        miss0 = compile_cache_misses()
+        lens = [CAP, CAP, CAP, 5, CAP]
+        ids = _prompts(cfg, lens, seed=13)
+        for i, ln in enumerate(lens):
+            eng.submit(ids[i, :ln])
+            eng.drain()
+        eng.submit(ids[0, :CAP]); eng.drain()
+        assert eng._spill.rehydrated_total >= 1
+        assert compile_cache_misses() - miss0 == 0
+        assert eng.monitor.recompiles == 0
+
+    def test_statusz_and_metrics_surface(self, served_model):
+        """The tier is scrapeable: statusz carries the spill block and
+        metrics_registry renders a lint-clean spill producer."""
+        from paddle_tpu.obs import lint_exposition
+        m, cfg = served_model
+        eng = _spill_engine(m, budget_blocks=2, kv_blocks=40)
+        ids = _prompts(cfg, [CAP, CAP, CAP], seed=15)
+        for i in range(3):
+            eng.submit(ids[i]); eng.drain()
+        s = eng.statusz()
+        assert s["spill"]["spilled_total"] >= 1
+        assert s["prefix_cache"]["spilled_blocks"] == \
+            eng._prefix.spilled_blocks
+        reg = eng.metrics_registry()
+        assert "spill" in reg.producers
+        page = reg.render()
+        lint_exposition(page)
+        assert "paddle_tpu_serving_spill_spilled_total" in page
+
+    def test_rehydrate_survives_tier_trim_under_pool_pressure(self):
+        """Found in review: _rehydrate's eviction can spill ANOTHER
+        block, whose tier trim scans LRU childless spilled leaves — the
+        node being rehydrated is one (stale stamp) and must be
+        protected, or its payload is dropped mid-flight and the write
+        crashes. Unit-level: tier budget 1 block, pool exhausted."""
+        from paddle_tpu.inference import HostSpillTier
+        p = _pool(blocks=6, bs=4)
+        tier = HostSpillTier(bytes_per_block=p.bytes_per_block,
+                             byte_budget=p.bytes_per_block)
+        c = PrefixCache(p)
+        writes = []
+        c.attach_spill(tier,
+                       reader=lambda b: (f"payload{b}",),
+                       writer=lambda b, pl: writes.append((b, pl)))
+        ta = np.arange(4, dtype=np.int64) + 1
+        tb = np.arange(4, dtype=np.int64) + 50
+        A = p.alloc(1, 4)
+        c.insert(ta, A)
+        p.free(1)
+        B = p.alloc(2, 4)
+        c.insert(tb, B)
+        p.free(2)
+        c.evict(1)                    # spills LRU = A (tier now full)
+        assert c.spilled_blocks == 1 and tier.spilled_blocks == 1
+        p.alloc(9, p.free_blocks * 4)   # exhaust the free list
+        blocks, t = c.match(ta)       # rehydrate A: must evict+trim B,
+        assert t == 4                 # NOT drop A's own payload
+        assert writes and writes[-1][1] == (f"payload{int(A[0])}",)
+        assert tier.rehydrated_total == 1
+        assert tier.dropped_total == 1          # B: spilled then dropped
+        assert tier.spilled_blocks == 0
+        assert c.match(tb) == ([], 0)           # B is gone for good
+        # conservation: drop everything, pool whole again
+        p.free(9)
+        c.clear()
+        assert p.free_blocks == p.capacity_blocks and p._refs == {}

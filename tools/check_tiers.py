@@ -70,7 +70,9 @@ def check(records, *, budget: float, slow_threshold: float,
           obs_seconds: float = None,
           obs_budget: float = 60.0,
           fleet_seconds: float = None,
-          fleet_budget: float = 60.0) -> dict:
+          fleet_budget: float = 60.0,
+          fleet_chaos_seconds: float = None,
+          fleet_chaos_budget: float = 60.0) -> dict:
     unmarked_slow = []       # should carry `slow` but don't
     tier1 = []               # everything tier-1 actually collects
     for r in records:
@@ -108,6 +110,12 @@ def check(records, *, budget: float, slow_threshold: float,
     # + oracle checks must stay a small fraction of the tier cap
     fleet_over = (fleet_seconds is not None
                   and fleet_seconds > fleet_budget)
+    # the fleet-chaos budget line: tools/fleet_chaos_smoke.py drives a
+    # seeded replica kill through the FleetRouter inside the tier-1
+    # wrapper (ISSUE 14) — failover + spill round-trip + oracle parity
+    # must stay a small fraction of the tier cap
+    fleet_chaos_over = (fleet_chaos_seconds is not None
+                        and fleet_chaos_seconds > fleet_chaos_budget)
     return {
         "n_records": len(records),
         "n_tier1": len(tier1),
@@ -130,12 +138,16 @@ def check(records, *, budget: float, slow_threshold: float,
         "fleet_seconds": fleet_seconds,
         "fleet_budget_s": fleet_budget,
         "fleet_over_budget": fleet_over,
+        "fleet_chaos_seconds": fleet_chaos_seconds,
+        "fleet_chaos_budget_s": fleet_chaos_budget,
+        "fleet_chaos_over_budget": fleet_chaos_over,
         "unmarked_slow": sorted(unmarked_slow,
                                 key=lambda r: -r["duration"]),
         "slowest_tier1": sorted(tier1, key=lambda r: -r["duration"])[:10],
         "ok": (tier1_total <= budget and not unmarked_slow
                and not lint_over and not chaos_over and not goodput_over
-               and not obs_over and not fleet_over),
+               and not obs_over and not fleet_over
+               and not fleet_chaos_over),
     }
 
 
@@ -174,6 +186,13 @@ def main(argv=None) -> int:
                          "leg (tools/run_tier1.sh records it)")
     ap.add_argument("--fleet-budget", type=float, default=60.0,
                     help="max seconds the fleet smoke may take on tier-1")
+    ap.add_argument("--fleet-chaos-seconds", type=float, default=None,
+                    help="measured wall time of the tier-1 "
+                         "fleet_chaos_smoke leg (tools/run_tier1.sh "
+                         "records it)")
+    ap.add_argument("--fleet-chaos-budget", type=float, default=60.0,
+                    help="max seconds the fleet chaos smoke may take "
+                         "on tier-1")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -192,7 +211,9 @@ def main(argv=None) -> int:
                    obs_seconds=args.obs_seconds,
                    obs_budget=args.obs_budget,
                    fleet_seconds=args.fleet_seconds,
-                   fleet_budget=args.fleet_budget)
+                   fleet_budget=args.fleet_budget,
+                   fleet_chaos_seconds=args.fleet_chaos_seconds,
+                   fleet_chaos_budget=args.fleet_chaos_budget)
 
     if args.json:
         print(json.dumps(result, indent=2))
@@ -215,6 +236,9 @@ def main(argv=None) -> int:
         if result.get("fleet_seconds") is not None:
             print(f"  fleet: {result['fleet_seconds']:.2f}s "
                   f"(budget {result['fleet_budget_s']}s)")
+        if result.get("fleet_chaos_seconds") is not None:
+            print(f"  fleet-chaos: {result['fleet_chaos_seconds']:.2f}s "
+                  f"(budget {result['fleet_chaos_budget_s']}s)")
         if result["chaos_over_budget"]:
             print(f"  VIOLATION: chaos gate took "
                   f"{result['chaos_seconds']:.2f}s, over the "
@@ -231,6 +255,11 @@ def main(argv=None) -> int:
             print(f"  VIOLATION: fleet smoke took "
                   f"{result['fleet_seconds']:.2f}s, over the "
                   f"{result['fleet_budget_s']}s fleet budget")
+        if result["fleet_chaos_over_budget"]:
+            print(f"  VIOLATION: fleet chaos smoke took "
+                  f"{result['fleet_chaos_seconds']:.2f}s, over the "
+                  f"{result['fleet_chaos_budget_s']}s fleet-chaos "
+                  f"budget")
         if result["lint_over_budget"]:
             print(f"  VIOLATION: lint pass took "
                   f"{result['lint_seconds']:.2f}s, over the "

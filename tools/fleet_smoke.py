@@ -153,9 +153,12 @@ def main(argv=None) -> int:
     failures = []
     miss0 = compile_cache_misses()
 
+    # cache_ttl=0: this smoke asserts staleness TRANSITIONS right after
+    # the kill — the scrape-storm TTL cache (ISSUE 14, default 1s) would
+    # serve the pre-kill snapshot; the cache has its own unit tests
     fleet = FleetAggregator(
         {f"replica{i}": srv for i, srv in enumerate(servers)},
-        timeout=2.0)
+        timeout=2.0, cache_ttl=0.0)
     fleet_srv = fleet.serve()
     scraper = FleetScraper(fleet_srv, interval=args.scrape_interval)
     scraper.start()

@@ -91,6 +91,18 @@ fleetrc=$?
 fleet_secs=$(echo "$(date +%s.%N) $fleet_t0" | awk '{printf "%.2f", $1-$2}')
 echo "fleet_smoke: ${fleet_secs}s (exit $fleetrc)"
 
+# fleet chaos smoke (ISSUE 14): three in-process replicas behind the
+# prefix-aware FleetRouter, a seeded replica kill mid-traffic — router
+# ejects + redispatches, autoscaler replaces, spill tier rehydrates,
+# every output bit-identical to the fault-free oracle, zero post-warmup
+# jit misses, and the prefix-vs-random routing hit-rate A/B.
+fchaos_t0=$(date +%s.%N)
+timeout -k 10 "${TIER1_FLEET_CHAOS_TIMEOUT:-120}" \
+    env JAX_PLATFORMS=cpu python tools/fleet_chaos_smoke.py
+fchaosrc=$?
+fchaos_secs=$(echo "$(date +%s.%N) $fchaos_t0" | awk '{printf "%.2f", $1-$2}')
+echo "fleet_chaos_smoke: ${fchaos_secs}s (exit $fchaosrc)"
+
 timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
     PADDLE_TPU_TIER_DURATIONS="$DUR" \
     python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
@@ -102,6 +114,7 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -c
 [ "$rc" -eq 0 ] && rc=$gprc
 [ "$rc" -eq 0 ] && rc=$obsrc
 [ "$rc" -eq 0 ] && rc=$fleetrc
+[ "$rc" -eq 0 ] && rc=$fchaosrc
 
 if [ -s "$DUR" ]; then
     python tools/check_tiers.py "$DUR" \
@@ -116,7 +129,9 @@ if [ -s "$DUR" ]; then
         --obs-seconds "$obs_secs" \
         --obs-budget "${TIER1_OBS_BUDGET:-60}" \
         --fleet-seconds "$fleet_secs" \
-        --fleet-budget "${TIER1_FLEET_BUDGET:-60}"
+        --fleet-budget "${TIER1_FLEET_BUDGET:-60}" \
+        --fleet-chaos-seconds "$fchaos_secs" \
+        --fleet-chaos-budget "${TIER1_FLEET_CHAOS_BUDGET:-60}"
     crc=$?
     [ "$rc" -eq 0 ] && rc=$crc
 else
