@@ -10,10 +10,20 @@ of detecting their violation at runtime:
   zero recompiles     recompile module (abstract signature differ — the
                       ServingEngine pre-flight reject)
 
+  sharding proven      sharding module (ISSUE 15): the post-SPMD HLO's
+                       collective inventory (static twin of the runtime
+                       trace ledger), partitioner-inserted-resharding and
+                       large-replicated-parameter passes, and the
+                       CommPlan declared-communication check — all
+                       before a single chip runs the program
+
 Entry points: GraphLint.check(fn, *args) for one executable,
+GraphLint.check_sharded(...) for an executable lowered under a mesh,
 lint_capture()+check_calls for the framework's own serving executables,
 jit.TrainStep(lint=...) / inference.ServingConfig(lint=...) opt-ins, and
-the tools/graph_lint.py CLI over the standard model set.
+the tools/graph_lint.py CLI over the standard model set (including the
+train-step-dp / train-step-tp sharded targets and the comm-xcheck
+static-vs-runtime bytes cross-check).
 """
 from .findings import (Allowlist, ConfigValidationError,  # noqa: F401
                        DEFAULT_ALLOWLIST, Finding, Findings,
@@ -25,4 +35,9 @@ from .recompile import (abstract_signature, diff_signatures,  # noqa: F401
                         explain_recompile)
 from .transfer import (HostTransferError, current_layer_path,  # noqa: F401
                        transfer_guard)
+from .commplan import (CommPlan, CommPlanError,  # noqa: F401
+                       collective_kind, rows_by_kind)
+from .sharding import (ShardingAudit, audit_hlo,  # noqa: F401
+                       collective_inventory, compiled_hlo_text,
+                       diff_ledgers, replicated_pass, resharding_pass)
 from .lint import ALL_PASSES, GraphLint, lint_capture  # noqa: F401

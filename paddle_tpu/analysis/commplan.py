@@ -1,0 +1,204 @@
+"""CommPlan — declare the collectives an executable is ALLOWED to run,
+and fail lint when the SPMD partitioner inserted anything else.
+
+The sharding inventory (analysis.sharding) answers "which collectives did
+the partitioner emit?"; this module answers "are those the ones we MEANT?"
+A plan maps collective kinds to count specs:
+
+    plan = CommPlan({"all-reduce": "+"})              # grad sync only
+    plan = CommPlan({"all-reduce": 30,                # exact count
+                     "all-gather": (1, 8)})           # bounded range
+    plan.check(rows)                                  # -> Findings
+    plan.verify(rows, executable="train_step")        # -> CommPlanError
+
+Count specs: an int is exact, ``"+"`` means "present, any count",
+``(lo, hi)`` is an inclusive range, ``0`` forbids the kind explicitly
+(same as omitting it, but self-documenting). Kinds absent from the plan
+are FORBIDDEN unless ``allow_other=True`` — the default-deny is the
+point: an accidental resharding all-gather in a "one grad all-reduce per
+layer, nothing in forward" step must fail loudly, not average into a
+table nobody reads.
+
+Rows are collective-ledger rows (analysis.sharding.collective_inventory
+or profiler.trace_analysis.collective_rows — the plan checks the KIND
+aggregation, so it accepts either side of the static/runtime pair).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from .findings import Finding, Findings, GraphLintError
+
+#: the HLO collective opcodes a plan can speak about
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute",
+                    "collective-broadcast")
+
+_SUFFIX_RE = re.compile(r"(-start|-done)?(\.\d+)?$")
+
+
+def collective_kind(name: str) -> Optional[str]:
+    """Base collective kind of an op name ("all-reduce.3" ->
+    "all-reduce", "all-gather-start.1" -> "all-gather"); None for a
+    non-collective name. The one normalization both the static
+    inventory and the runtime trace ledger agree on — async -start/-done
+    pairs collapse onto their kind (the -done row carries no new
+    transfer)."""
+    low = name.lower()
+    base = _SUFFIX_RE.sub("", low)
+    for k in COLLECTIVE_KINDS:
+        if base == k or base.startswith(k):
+            return k
+    # fusion-wrapped names ("all-reduce-fusion") keep their kind
+    for k in COLLECTIVE_KINDS:
+        if k in low:
+            return k
+    return None
+
+
+def rows_by_kind(rows: Sequence[dict]) -> Dict[str, dict]:
+    """Aggregate ledger rows by collective kind: {kind: {"calls", "bytes",
+    "names"}}. `bytes` is None when NO row of the kind carries bytes;
+    "-done" rows are skipped (their "-start" twin carries the op)."""
+    out: Dict[str, dict] = {}
+    for r in rows:
+        name = r.get("name", "")
+        if "-done" in name:
+            continue
+        kind = collective_kind(name)
+        if kind is None:
+            continue
+        g = out.setdefault(kind, {"calls": 0, "bytes": None, "names": []})
+        g["calls"] += int(r.get("calls", 1))
+        b = r.get("bytes")
+        if b is not None:
+            g["bytes"] = (g["bytes"] or 0) + int(b)
+        g["names"].append(name)
+    return out
+
+
+CountSpec = Union[int, str, Tuple[int, int]]
+
+
+class CommPlanError(GraphLintError):
+    """The executable's collective inventory violates its CommPlan.
+    Subclasses GraphLintError so existing `except GraphLintError`
+    pre-flight callers catch plan violations too; `findings` carries the
+    structured comm_plan rows (extra / missing / count)."""
+
+
+class CommPlan:
+    """Declared communication plan for one executable (module docstring
+    has the spec grammar)."""
+
+    def __init__(self, expect: Dict[str, CountSpec],
+                 allow_other: bool = False):
+        self.expect: Dict[str, CountSpec] = {}
+        for kind, spec in (expect or {}).items():
+            k = collective_kind(kind) or kind
+            if k not in COLLECTIVE_KINDS:
+                raise ValueError(
+                    f"unknown collective kind {kind!r} "
+                    f"(one of {COLLECTIVE_KINDS})")
+            self._validate_spec(kind, spec)
+            self.expect[k] = spec
+        self.allow_other = allow_other
+
+    @staticmethod
+    def _validate_spec(kind, spec):
+        if isinstance(spec, bool) or not (
+                isinstance(spec, int)
+                or spec == "+"
+                or (isinstance(spec, (tuple, list)) and len(spec) == 2
+                    and all(isinstance(x, int) for x in spec))):
+            raise ValueError(
+                f"bad count spec for {kind!r}: {spec!r} (int exact, "
+                f"'+' present, (lo, hi) range, 0 forbidden)")
+
+    def __repr__(self):
+        other = ", other: allowed" if self.allow_other else ""
+        return (f"CommPlan({{"
+                + ", ".join(f"{k!r}: {v!r}"
+                            for k, v in self.expect.items())
+                + f"}}{other})")
+
+    @staticmethod
+    def _spec_ok(spec: CountSpec, count: int) -> bool:
+        if spec == "+":
+            return count >= 1
+        if isinstance(spec, (tuple, list)):
+            lo, hi = spec
+            return lo <= count <= hi
+        return count == int(spec)
+
+    @staticmethod
+    def _spec_str(spec: CountSpec) -> str:
+        if spec == "+":
+            return ">= 1"
+        if isinstance(spec, (tuple, list)):
+            return f"{spec[0]}..{spec[1]}"
+        return str(spec)
+
+    # ------------------------------------------------------------ check
+    def check(self, rows: Sequence[dict], executable: str = "") -> Findings:
+        """Findings for every way the inventory departs from the plan:
+
+        comm_extra    a kind the plan forbids is present (the accidental
+                      resharding case — the finding names the op names)
+        comm_missing  a planned kind is absent (the grad sync you meant
+                      to have did not lower — usually a mesh/pspec typo)
+        comm_count    a planned kind is present at the wrong count
+        """
+        got = rows_by_kind(rows)
+        out = Findings()
+        for kind, g in got.items():
+            spec = self.expect.get(kind)
+            if spec is None or spec == 0:
+                if self.allow_other and spec is None:
+                    continue
+                out.add(Finding(
+                    "comm_plan", "comm_extra", "error",
+                    f"{g['calls']} {kind} op(s) not in the comm plan "
+                    f"({', '.join(g['names'][:4])}"
+                    f"{', ...' if len(g['names']) > 4 else ''}) — "
+                    f"partitioner-inserted communication the plan "
+                    f"forbids",
+                    where=kind, executable=executable,
+                    data={"kind": kind, "calls": g["calls"],
+                          "bytes": g["bytes"],
+                          "names": g["names"][:16]}))
+            elif not self._spec_ok(spec, g["calls"]):
+                out.add(Finding(
+                    "comm_plan", "comm_count", "error",
+                    f"{kind}: {g['calls']} op(s), plan expects "
+                    f"{self._spec_str(spec)}",
+                    where=kind, executable=executable,
+                    data={"kind": kind, "calls": g["calls"],
+                          "expect": self._spec_str(spec)}))
+        for kind, spec in self.expect.items():
+            if kind in got:
+                continue
+            required = (spec == "+"
+                        or (isinstance(spec, int) and spec > 0)
+                        or (isinstance(spec, (tuple, list))
+                            and spec[0] > 0))
+            if not required:
+                continue
+            out.add(Finding(
+                "comm_plan", "comm_missing", "error",
+                f"{kind}: absent, plan expects "
+                f"{self._spec_str(spec)} — the collective you planned "
+                f"for never lowered (mesh axis missing or pspec "
+                f"filtered away?)",
+                where=kind, executable=executable,
+                data={"kind": kind, "expect": self._spec_str(spec)}))
+        return out
+
+    def verify(self, rows: Sequence[dict], executable: str = ""):
+        """Raise CommPlanError when `check` finds violations; returns the
+        (empty) Findings otherwise."""
+        fs = self.check(rows, executable=executable)
+        if fs:
+            raise CommPlanError(fs, executable)
+        return fs

@@ -59,7 +59,9 @@ class GraphLint:
                  mode: str = "report", fail_on: str = "warn",
                  upcast_bytes: int = 1 << 16,
                  const_bytes: int = 1 << 20,
-                 donate_bytes: int = 1 << 20):
+                 donate_bytes: int = 1 << 20,
+                 replicated_bytes: int = 1 << 20,
+                 comm_plan=None):
         unknown = set(passes) - set(ALL_PASSES)
         if unknown:
             raise ValueError(f"unknown lint passes: {sorted(unknown)} "
@@ -80,6 +82,11 @@ class GraphLint:
         self.upcast_bytes = upcast_bytes
         self.const_bytes = const_bytes
         self.donate_bytes = donate_bytes
+        # sharding lint (ISSUE 15): threshold for the large-replicated-
+        # parameter pass, and an optional declared CommPlan every
+        # check_sharded call verifies the inventory against
+        self.replicated_bytes = replicated_bytes
+        self.comm_plan = comm_plan
 
     @classmethod
     def coerce(cls, value) -> Optional["GraphLint"]:
@@ -163,6 +170,55 @@ class GraphLint:
         if guard:
             self._guard(findings, "captured executables")
         return findings
+
+    # --------------------------------------------------------- sharded
+    def check_sharded(self, fn, *args, name: str = "",
+                      in_shardings=None, out_shardings=None,
+                      donate_argnums: Sequence[int] = (),
+                      param_names=None, plan=None, mesh_axes=None,
+                      guard: bool = True, **kwargs):
+        """Statically audit the SPMD communication plan of an executable
+        lowered under a mesh (ISSUE 15): lower + compile (nothing
+        executes — CPU host-platform meshes work), then run the
+        sharding passes over the post-partitioning HLO — collective
+        inventory, partitioner-inserted-resharding detection, the
+        large-replicated-parameter pass, and the CommPlan check (`plan`
+        or this linter's `comm_plan`).
+
+        `fn` may be an already-jitted function carrying its own
+        shardings (the TrainStep path) or a plain callable with
+        `in_shardings`/`out_shardings` (NamedShardings — the mesh rides
+        in them). Returns a ShardingAudit; its findings pass through
+        the allowlist and, in guard mode, trip GraphLintError — plan
+        violations raise the sharper CommPlanError."""
+        from .commplan import CommPlanError
+        from .sharding import audit_hlo, compiled_hlo_text
+        name = name or getattr(fn, "__name__", "fn") or "fn"
+        if hasattr(fn, "lower") and hasattr(fn, "__wrapped__"):
+            jfn = fn
+        else:
+            jit_kwargs = {}
+            if in_shardings is not None:
+                jit_kwargs["in_shardings"] = in_shardings
+            if out_shardings is not None:
+                jit_kwargs["out_shardings"] = out_shardings
+            jfn = jax.jit(fn, donate_argnums=tuple(donate_argnums),
+                          **jit_kwargs)
+        text = compiled_hlo_text(jfn, *args, **kwargs)
+        audit = audit_hlo(text, executable=name,
+                          param_names=param_names,
+                          plan=plan if plan is not None else self.comm_plan,
+                          replicated_bytes=self.replicated_bytes,
+                          mesh_axes=mesh_axes)
+        self.allowlist.apply(audit.findings)
+        if guard and self.mode == "error":
+            plan_active = audit.findings.for_pass("comm_plan") \
+                .active(self.fail_on)
+            if plan_active:
+                raise CommPlanError(plan_active, name)
+        if guard:
+            self._guard(audit.findings, name)
+        return audit
 
     def _guard(self, findings: Findings, executable: str):
         if self.mode != "error":

@@ -23,14 +23,44 @@ import jax
 from .findings import Finding, Findings
 
 
+def _sharding_key(a) -> str:
+    """The sharding component of a leaf's cache key. Only a
+    NamedSharding participates (spec + mesh axis sizes — the same spec
+    on a different mesh shape is a different partition). Everything
+    else — host arrays, uncommitted and single-device leaves —
+    normalizes to "": moving a host batch onto the default device never
+    recompiled, and the signature must not claim it does. (Committed
+    non-default single-device placements DO recompile but are
+    indistinguishable from the default here without risking false
+    rejects on plain host batches; the runtime recompile detector still
+    catches that case.)"""
+    s = getattr(a, "sharding", None)
+    if s is None:
+        return ""
+    spec = getattr(s, "spec", None)
+    if spec is not None:
+        mesh = getattr(s, "mesh", None)
+        axes = ""
+        try:
+            axes = ",".join(f"{k}={v}" for k, v in dict(mesh.shape).items())
+        except Exception:
+            pass
+        return f"NamedSharding({spec}, mesh[{axes}])"
+    return ""
+
+
 def _leaf_key(a) -> Tuple:
-    """(shape, dtype, weak_type) for an array-like leaf; repr for a
-    static (non-array) leaf — exactly the distinctions jit keys on."""
+    """(shape, dtype, weak_type, sharding) for an array-like leaf; repr
+    for a static (non-array) leaf — exactly the distinctions jit keys
+    on. Sharding joined the key in ISSUE 15: two calls differing only by
+    NamedSharding recompile (and the resharding moves bytes first), and
+    the old signature reported "no difference" for them."""
     if hasattr(a, "shape") and hasattr(a, "dtype"):
         weak = bool(getattr(a, "weak_type", False)
                     or getattr(getattr(a, "aval", None), "weak_type",
                                False))
-        return ("array", tuple(a.shape), str(np.dtype(a.dtype)), weak)
+        return ("array", tuple(a.shape), str(np.dtype(a.dtype)), weak,
+                _sharding_key(a))
     return ("static", repr(a))
 
 
@@ -89,8 +119,8 @@ def diff_signatures(old, new, executable: str = "",
                 f"are baked into the executable",
                 where=name, executable=executable))
             continue
-        _, oshape, odt, oweak = o
-        _, nshape, ndt, nweak = n
+        _, oshape, odt, oweak, oshard = o
+        _, nshape, ndt, nweak, nshard = n
         if oshape != nshape:
             out.add(Finding(
                 "recompile_hazard", "shape", "error",
@@ -112,6 +142,15 @@ def diff_signatures(old, new, executable: str = "",
                 f"scalar vs array input distinction recompiles even at "
                 f"identical shape/dtype",
                 where=name, executable=executable))
+        if oshard != nshard:
+            out.add(Finding(
+                "recompile_hazard", "sharding", "error",
+                f"{name}: sharding {oshard or '(unspecified)'} -> "
+                f"{nshard or '(unspecified)'} — a resharded input "
+                f"forces a retrace + compile (and the device_put "
+                f"resharding moves the bytes first)",
+                where=name, executable=executable,
+                data={"old": oshard, "new": nshard}))
     return out
 
 

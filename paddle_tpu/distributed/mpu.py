@@ -63,8 +63,27 @@ class VocabParallelEmbedding(Layer):
                        * jnp.take(sv, ids, axis=0)).astype(w.dtype)
             else:
                 out = jnp.take(w, ids, axis=0)
-            return _mesh.shard_constraint(out, None, None, None)
+            return _act_constraint(out)
         return apply_op("vocab_parallel_embedding", fn, [x, self.weight])
+
+
+def _act_constraint(a, last=None):
+    """Pin a batch-leading activation's layout WITHOUT undoing data
+    parallelism: dim 0 stays on `dp`, dim 1 (when rank >= 3) on `sp`,
+    the last dim as requested (`"mp"` for a tensor-sharded feature dim,
+    None for replicated). The original mpu constraints pinned every
+    non-feature dim replicated — under a dp mesh the partitioner then
+    all-gathered the batch dim back together at EVERY layer boundary
+    (the accidental resharding the ISSUE-15 sharding lint exists to
+    catch; found by its collective inventory on the dp train step).
+    Absent axes are dropped by mesh.filter_spec, so the same constraint
+    degrades gracefully on any mesh."""
+    entries = ["dp"] + [None] * (a.ndim - 1)
+    if a.ndim >= 3:
+        entries[1] = "sp"
+    if a.ndim >= 2:
+        entries[-1] = last
+    return _mesh.shard_constraint(a, *entries)
 
 
 def _q8_payload(weight_tensor):
@@ -109,7 +128,7 @@ class ColumnParallelLinear(Layer):
                 if b:
                     y = y + b[0]
             if not gather:
-                y = _mesh.shard_constraint(y, *([None] * (y.ndim - 1)), "mp")
+                y = _act_constraint(y, "mp")
             return y
 
         args = [x, self.weight] + ([self.bias] if self.bias is not None else [])
@@ -140,13 +159,13 @@ class RowParallelLinear(Layer):
         q8 = _q8_payload(self.weight)
 
         def fn(x_, w, *b):
-            x_ = _mesh.shard_constraint(x_, *([None] * (x_.ndim - 1)), "mp")
+            x_ = _act_constraint(x_, "mp")
             if q8 is not None:
                 from ..ops.pallas.int8_matmul import int8_linear_nd
                 y = int8_linear_nd(x_, q8[0], q8[1].reshape(-1))
             else:
                 y = jnp.matmul(x_, w)
-            y = _mesh.shard_constraint(y, *([None] * y.ndim))
+            y = _act_constraint(y)
             if b:
                 y = y + b[0]
             return y
@@ -172,7 +191,7 @@ class ParallelCrossEntropy(Layer):
 
         def fn(lg, lb):
             lg32 = lg.astype(jnp.float32)
-            lg32 = _mesh.shard_constraint(lg32, *([None] * (lg32.ndim - 1)), "mp")
+            lg32 = _act_constraint(lg32, "mp")
             lse = jax.nn.logsumexp(lg32, axis=-1, keepdims=True)
             lb_ = lb[..., None] if lb.ndim == lg.ndim - 1 else lb
             picked = jnp.take_along_axis(lg32, jnp.maximum(lb_, 0).astype(jnp.int32), axis=-1)

@@ -138,6 +138,11 @@ class TrainStep:
         self._lint = _GraphLint.coerce(lint)
         self._lint_done = False
         self.lint_findings = None
+        # sharding lint (ISSUE 15): under a mesh the lint additionally
+        # compiles the step and audits the post-SPMD HLO — the static
+        # collective inventory + resharding/replication/CommPlan passes.
+        # The latest audit (a analysis.ShardingAudit) lands here.
+        self.comm_audit = None
 
         # optimizer state as pytree (init lazily so shapes match cast params)
         self._opt_state = None
@@ -708,36 +713,132 @@ class TrainStep:
         flat, treedef = jax.tree.flatten(arrays)
         return self._lint_check(linter, treedef, flat)
 
-    def _lint_check(self, linter, treedef, flat):
-        if self._opt_state is None:
-            self._opt_state = self._init_opt_state()
-            self._apply_param_shardings()
-        pure = self._build_pure(treedef)
+    @staticmethod
+    def _sds(a):
+        return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype) \
+            if hasattr(a, "shape") else a
 
-        def sds(a):
-            return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype) \
-                if hasattr(a, "shape") else a
-
-        p_sds = tuple(sds(p._data) for p in self._params)
-        s_sds = tuple({k: sds(v) for k, v in (st or {}).items()}
+    def _abstract_step_args(self):
+        """(params, opt state, scaler state) as ShapeDtypeStructs — the
+        abstract leading arguments of the pure/built step, shared by the
+        abstract lint and the sharded audit."""
+        p_sds = tuple(self._sds(p._data) for p in self._params)
+        s_sds = tuple({k: self._sds(v) for k, v in (st or {}).items()}
                       for st in self._opt_state)
         sstate = None
         if self._scaler is not None:
             sstate = tuple(jax.ShapeDtypeStruct((), d)
                            for d in (jnp.float32, jnp.int32, jnp.int32))
+        return p_sds, s_sds, sstate
+
+    @staticmethod
+    def _plan_guard(linter, findings):
+        """Guard-mode raise for CommPlan violations — the sharper
+        CommPlanError, ahead of the generic GraphLintError guard."""
+        if linter.mode != "error":
+            return
+        from ..analysis import CommPlanError
+        plan_active = findings.for_pass("comm_plan").active(linter.fail_on)
+        if plan_active:
+            raise CommPlanError(plan_active, "train_step")
+
+    def _lint_check(self, linter, treedef, flat):
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state()
+            self._apply_param_shardings()
+        pure = self._build_pure(treedef)
+        sds = self._sds
+        p_sds, s_sds, sstate = self._abstract_step_args()
+        built = None
+        if self.mesh is not None:
+            # under a mesh the abstract passes audit the BUILT jitted
+            # step (shardings + donation baked in): lowering the bare
+            # pure function would mix in-graph sharding constraints with
+            # unsharded parameters, and the donation pass would report
+            # aliasing misses the real executable does not have
+            built = self._build(
+                treedef,
+                [getattr(a, "ndim", len(getattr(a, "shape", ())))
+                 for a in flat])
         findings = linter.check(
-            pure, p_sds, s_sds, sstate, jnp.int32(1), jnp.float32(1e-3),
+            built if built is not None else pure,
+            p_sds, s_sds, sstate, jnp.int32(1), jnp.float32(1e-3),
             jax.random.PRNGKey(0), *[sds(a) for a in flat],
             # audit the donation config the REAL executable uses — with
             # donate=False the pass must report the donatable params/state,
             # not prove an aliasing the step doesn't have
             donate_argnums=(0, 1) if self.donate else (),
             name="train_step", guard=False)
+        if self.mesh is not None:
+            audit = self._sharded_audit(linter, treedef, flat, sstate,
+                                        built=built)
+            findings.extend(audit.findings)
         # stored BEFORE the guard fires: a caller catching GraphLintError
         # can still read step.lint_findings post-mortem
         self.lint_findings = findings
+        self._plan_guard(linter, findings)
         linter._guard(findings, "train_step")
         return findings
+
+    def _sharded_audit(self, linter, treedef, flat, sstate=None,
+                       built=None):
+        """The sharded half of the lint (ISSUE 15): build the jitted
+        step with its REAL in/out shardings, lower + compile it with
+        abstract inputs (nothing executes), and audit the
+        post-partitioning HLO — collective inventory, resharding and
+        replication passes, and the linter's CommPlan if one is
+        declared. Entry-parameter keypaths translate back to model
+        parameter names so a finding names the offending LAYER."""
+        if built is None:
+            built = self._build(
+                treedef,
+                [getattr(a, "ndim", len(getattr(a, "shape", ())))
+                 for a in flat])
+        sds = self._sds
+        p_sds, s_sds, _ = self._abstract_step_args()
+        key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+        names = {f"param_arrays[{i}]": n
+                 for i, n in enumerate(self._param_names)}
+        for i, n in enumerate(self._param_names):
+            for k in (self._opt_state[i] or {}):
+                names[f"opt_state[{i}][{k!r}]"] = f"{n}/{k}"
+        audit = linter.check_sharded(
+            built, p_sds, s_sds, sstate,
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.float32), key,
+            *[sds(a) for a in flat],
+            name="train_step", param_names=names,
+            mesh_axes=dict(self.mesh.shape), guard=False)
+        self.comm_audit = audit
+        return audit
+
+    def sharding_audit(self, *batch, lint=None, plan=None):
+        """The sharded audit alone (ISSUE 15): compile the step under
+        its mesh for this batch's shapes and statically inventory /
+        lint its collectives. Returns the analysis.ShardingAudit (also
+        on `self.comm_audit`); `plan` overrides the linter's CommPlan.
+        Requires a mesh — without one there is no SPMD partition to
+        audit."""
+        if self.mesh is None:
+            raise ValueError("sharding_audit requires TrainStep(mesh=...) "
+                             "— an unsharded step has no communication "
+                             "plan to prove")
+        from ..analysis import GraphLint
+        linter = GraphLint.coerce(lint) or self._lint or GraphLint()
+        if plan is not None:
+            import copy
+            linter = copy.copy(linter)
+            linter.comm_plan = plan
+        if self._opt_state is None:
+            self._opt_state = self._init_opt_state()
+            self._apply_param_shardings()
+        arrays = _tree_unwrap(batch)
+        flat, treedef = jax.tree.flatten(arrays)
+        _, _, sstate = self._abstract_step_args()
+        audit = self._sharded_audit(linter, treedef, flat, sstate)
+        self._plan_guard(linter, audit.findings)
+        linter._guard(audit.findings, "train_step")
+        return audit
 
     def _maybe_lint(self, treedef, flat):
         """TrainStep(lint=...): one audit before the first compile (the

@@ -111,6 +111,23 @@ class CollectiveLedger:
                      f"collective busy time)")
         return "\n".join(lines)
 
+    def check_static(self, static_rows: List[dict],
+                     rtol: float = 0.01) -> List[dict]:
+        """Cross-check this runtime ledger against a STATIC collective
+        inventory (analysis.sharding.collective_inventory / a
+        TrainStep.comm_audit's rows): per collective kind, the bytes the
+        trace measured must match the bytes the HLO promised within
+        `rtol`. Returns the analysis.sharding.diff_ledgers rows; kinds
+        disagree when the runtime capture carries no byte stats, when a
+        scan body multiplies trip counts the static side counts once, or
+        when the deployed executable is NOT the one that was audited —
+        all three are things a preflight gate wants to scream about.
+        This ledger's `steps` normalizes the runtime side to per-step
+        figures (static rows are per-step by construction)."""
+        from ..analysis.sharding import diff_ledgers
+        return diff_ledgers(static_rows, self.rows, steps=self.steps,
+                            rtol=rtol)
+
     def metrics_text(self, prefix: str = "paddle_tpu_comm") -> str:
         """Registry-composable exposition: per-op labeled gauges + the
         exposed-time roll-up, rendered from the series table shared with

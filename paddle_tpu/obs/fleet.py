@@ -536,6 +536,8 @@ class FleetAggregator:
             if h is None:
                 per[name] = {"state": "stale", **state}
                 continue
+            # healthz payloads are parsed JSON — host dicts, never
+            # tensors  # lint: allow(tracer-bool)
             is_draining = bool(h.get("draining")) \
                 or h.get("status") == "draining"
             draining += 1 if is_draining else 0

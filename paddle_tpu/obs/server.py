@@ -58,7 +58,8 @@ def _json_default(o):
     try:
         import numpy as np
         if isinstance(o, np.generic):
-            return o.item()
+            # isinstance-guarded numpy scalar: host data by construction
+            return o.item()  # lint: allow(tracer-item)
         if isinstance(o, np.ndarray):
             return o.tolist()
     except Exception:

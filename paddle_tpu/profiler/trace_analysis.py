@@ -161,7 +161,9 @@ def format_collective_rows(rows: List[dict],
     DistributedView and obs.collectives.CollectiveLedger.table() print
     (two renderers over the same row dicts would drift column by
     column). Header + one line per op; the caller adds its own title and
-    totals/overlap footer."""
+    totals/overlap footer. The STATIC inventory rows
+    (analysis.sharding.collective_inventory — same schema, no clock)
+    render through here too: None timing columns print as '-'."""
     div = max(steps or 1, 1)
     unit = "ms/step" if steps else "ms"
     lines = [f"{unit:>10}  {'exposed':>9}  {'hidden%':>7}  {'calls':>6}  "
@@ -171,11 +173,14 @@ def format_collective_rows(rows: List[dict],
             else f"{'-':>9}"
         bus = f"{r['bus_gbps']:7.1f}" if r["bus_gbps"] is not None \
             else f"{'-':>7}"
-        hidden = (1.0 - r["exposed_frac"]) * 100.0
-        lines.append(f"{r['dur_us'] / div / 1e3:10.3f}  "
-                     f"{r['exposed_us'] / div / 1e3:9.3f}  "
-                     f"{hidden:7.1f}  {r['calls']:6d}  {mb}  {bus}  "
-                     f"{r['name'][:70]}")
+        dur = f"{r['dur_us'] / div / 1e3:10.3f}" \
+            if r["dur_us"] is not None else f"{'-':>10}"
+        exp = f"{r['exposed_us'] / div / 1e3:9.3f}" \
+            if r["exposed_us"] is not None else f"{'-':>9}"
+        hidden = f"{(1.0 - r['exposed_frac']) * 100.0:7.1f}" \
+            if r["exposed_frac"] is not None else f"{'-':>7}"
+        lines.append(f"{dur}  {exp}  {hidden}  {r['calls']:6d}  "
+                     f"{mb}  {bus}  {r['name'][:70]}")
     return lines
 
 

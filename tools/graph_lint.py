@@ -26,16 +26,49 @@ Targets (--all = every one):
   resnet50     the vision forward executable (+ its TrainStep with
                --vision-train), channels-last flag as configured
 
+Sharded targets (ISSUE 15 — run on an 8-device host-platform CPU mesh,
+XLA_FLAGS=--xla_force_host_platform_device_count=8 is set automatically
+when one is requested; nothing executes, the step is lowered + compiled
+and its post-SPMD HLO statically audited):
+
+  train-step-dp   TrainStep(gpt) on a {"dp": 8} mesh. Declared CommPlan:
+                  all-reduce only (grad sync + loss reductions) — ANY
+                  other collective kind is a partitioner-inserted
+                  resharding and fails the plan check. Plus the full
+                  abstract pass suite and the resharding/replication
+                  sharding passes.
+  train-step-tp   the same step on a {"dp": 2, "mp": 4} hybrid mesh.
+                  CommPlan: all-reduce + all-gather (TP activation
+                  traffic); the vocab-parallel table gather arrives
+                  allowlisted with its documented reason.
+  comm-xcheck     static-vs-runtime bytes cross-check: compile the
+                  mini-step twin of the checked-in trace fixture
+                  (tests/fixtures/mini_step.trace.json.gz) and assert
+                  the static collective-bytes table matches the runtime
+                  trace-ledger bytes per collective kind within
+                  --xcheck-rtol (default 1%).
+
+--plant-reshard is a self-test of the detector: it gives one layer's
+weight a deliberately wrong pspec on the sharded train-step targets and
+INVERTS the expectation — exit 0 only if the planted resharding is
+detected and named, 1 if the lint missed it.
+
 Exit status: 0 = clean (allowlisted findings are clean — each carries its
-documented reason), 1 = active findings at/above --fail-on, 2 = bad usage.
+documented reason; with --plant-reshard: the planted resharding was
+detected), 1 = active findings at/above --fail-on (comm-plan violations
+and a failed comm-xcheck land here; with --plant-reshard: the planted
+resharding was MISSED), 2 = bad usage.
 
     python tools/graph_lint.py --all
+    python tools/graph_lint.py train-step-dp train-step-tp comm-xcheck
     python tools/graph_lint.py --target gpt-paged --json
     python tools/graph_lint.py --all --fail-on error --allow my_allow.json
+    python tools/graph_lint.py train-step-dp --plant-reshard
 """
 from __future__ import annotations
 
 import argparse
+import copy
 import json
 import os
 import sys
@@ -47,7 +80,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 TARGETS = ("gpt-static", "gpt-paged", "gpt-paged-int8", "gpt-paged-spec",
-           "train-step", "resnet50")
+           "train-step", "resnet50",
+           "train-step-dp", "train-step-tp", "comm-xcheck")
+#: targets that need the multi-device host-platform mesh
+SHARDED_TARGETS = ("train-step-dp", "train-step-tp", "comm-xcheck")
+
+FIXTURE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "fixtures",
+    "mini_step.trace.json.gz")
 
 
 def _tiny_gpt(dtype="bfloat16"):
@@ -179,8 +219,116 @@ def audit_resnet50(lint, train: bool = False):
     return findings
 
 
+def audit_train_step_sharded(lint, axes, plan=None, plant=False,
+                             audits=None):
+    """Sharded train-step audit (ISSUE 15): TrainStep(gpt) under a mesh,
+    audited end-to-end through TrainStep.lint — the abstract pass suite
+    PLUS the compiled-HLO sharding passes and the target's CommPlan.
+    With `plant`, one layer's weight gets a deliberately wrong pspec and
+    the run asserts the resharding is detected and NAMED (the detector's
+    self-test); detection inverts into a clean exit."""
+    import numpy as np
+    import jax
+    from jax.sharding import PartitionSpec as P
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.analysis import Findings
+    from paddle_tpu.jit.train_step import TrainStep
+    import paddle_tpu.distributed as dist
+    mesh = dist.build_mesh(axes)
+    dist.set_mesh(mesh)
+    try:
+        model, cfg = _tiny_gpt()
+        model.train()
+        planted = "gpt.h.0.mlp.up.weight"
+        if plant:
+            model.gpt.h[0].mlp.up.weight.pspec = P("dp", None)
+        o = opt.AdamW(parameters=model.parameters(), learning_rate=1e-4)
+        ts = TrainStep(model, o, lambda ids, lab: model.loss(ids, lab),
+                       mesh=mesh)
+        linter = copy.copy(lint)
+        linter.comm_plan = None if plant else plan
+        ids = jax.ShapeDtypeStruct((8, 16), "int64")
+        findings = ts.lint(ids, ids, lint=linter)
+        if audits is not None and ts.comm_audit is not None:
+            audits[f"train-step-{'x'.join(map(str, axes.values()))}"] = \
+                ts.comm_audit
+        if plant:
+            hits = [f for f in findings if f.code == "param_gather"
+                    and planted in (f.where or "")]
+            if not hits:
+                raise SystemExit(
+                    f"--plant-reshard: the planted wrong pspec on "
+                    f"{planted} was NOT detected — the resharding pass "
+                    f"is blind")
+            print(f"  plant-reshard: detected and named — {hits[0]}",
+                  file=sys.stderr)
+            # detection is the pass criterion; the planted findings must
+            # not fail the run
+            return Findings()
+        return findings
+    finally:
+        dist.set_mesh(None)
+
+
+def audit_comm_xcheck(rtol: float = 0.01, audits=None):
+    """Static-vs-runtime cross-check (ISSUE 15 acceptance): compile the
+    jitted twin of the checked-in mini-step fixture — one dp=8 grad-sync
+    all-reduce moving the fixture's 1 MiB per step — and assert the
+    static inventory's bytes match the runtime trace ledger's per-step
+    bytes per collective kind within `rtol`. A mismatch is a Finding
+    (exit 1), not an assert: the table prints either way."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from paddle_tpu.analysis import (Finding, Findings,
+                                     collective_inventory,
+                                     compiled_hlo_text)
+    from paddle_tpu.obs.collectives import CollectiveLedger
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    # the twin: a data-parallel partial-sum + all-reduce whose buffer is
+    # exactly the fixture's bytes_accessed (f32[131072]: 0.5 MiB operand
+    # + 0.5 MiB output = 1 MiB per step)
+    jfn = jax.jit(lambda x: jnp.sum(x, axis=0),
+                  in_shardings=(NamedSharding(mesh, P("dp", None)),),
+                  out_shardings=NamedSharding(mesh, P()))
+    text = compiled_hlo_text(
+        jfn, jax.ShapeDtypeStruct((8, 131072), jnp.float32))
+    rows = collective_inventory(text, "mini_step_twin")
+    ledger = CollectiveLedger.from_trace(FIXTURE, steps=2)
+    diff = ledger.check_static(rows, rtol=rtol)
+    findings = Findings()
+    for d in diff:
+        rel = f"{d['rel_err'] * 100:.2f}%" if d["rel_err"] is not None \
+            else "-"
+        if not d["ok"]:
+            findings.add(Finding(
+                "sharding", "static_runtime_bytes", "error",
+                f"{d['kind']}: static {d['static_bytes']} B/step vs "
+                f"runtime {d['runtime_bytes']} B/step "
+                f"(rel err {rel}, rtol {rtol:.0%}) — the audited "
+                f"executable is not the one the trace measured",
+                where=d["kind"], executable="comm-xcheck", data=d))
+    if audits is not None:
+        audits["comm-xcheck"] = {"diff": diff,
+                                 "rows": [dict(r) for r in rows]}
+    return findings
+
+
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        epilog="Exit status: 0 = clean (allowlisted findings count as "
+               "clean; with --plant-reshard: planted resharding "
+               "detected), 1 = active findings at/above --fail-on "
+               "(comm-plan violations and comm-xcheck byte mismatches "
+               "included; with --plant-reshard: detection MISSED), "
+               "2 = bad usage.")
+    ap.add_argument("targets", nargs="*", metavar="TARGET",
+                    help=f"targets to audit (positional form of "
+                         f"--target; one of {', '.join(TARGETS)})")
     ap.add_argument("--all", action="store_true",
                     help="audit every target")
     ap.add_argument("--target", choices=TARGETS, action="append",
@@ -200,17 +348,76 @@ def main(argv=None) -> int:
     ap.add_argument("--upcast-bytes", type=int, default=256)
     ap.add_argument("--const-bytes", type=int, default=1 << 16)
     ap.add_argument("--donate-bytes", type=int, default=1 << 16)
-    ap.add_argument("--json", action="store_true")
+    # replicated-parameter threshold stays at 1 MiB by default: the toy
+    # models' replicated layernorm/bias params are design, not findings
+    ap.add_argument("--replicated-bytes", type=int, default=1 << 20)
+    ap.add_argument("--plant-reshard", action="store_true",
+                    help="self-test: plant a wrong pspec on one layer "
+                         "of the sharded train-step targets and require "
+                         "the resharding pass to detect + name it")
+    ap.add_argument("--xcheck-rtol", type=float, default=0.01,
+                    help="comm-xcheck static-vs-runtime bytes tolerance "
+                         "(default 1%%)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report: per-target findings, "
+                         "the static comm tables of the sharded targets "
+                         "and the comm-xcheck diff, plus the active "
+                         "count (exit semantics unchanged)")
     args = ap.parse_args(argv)
 
-    targets = list(TARGETS) if args.all or not args.target else args.target
+    bad = [t for t in args.targets if t not in TARGETS]
+    if bad:
+        ap.error(f"unknown target(s) {bad} (choose from "
+                 f"{', '.join(TARGETS)})")
+    # dedupe, first mention wins (a target named both positionally and
+    # via --target must not be audited/counted twice)
+    targets = list(dict.fromkeys(
+        list(args.targets) + list(args.target or [])))
+    if args.all or not targets:
+        targets = list(TARGETS)
+    if args.plant_reshard and not any(
+            t in ("train-step-dp", "train-step-tp") for t in targets):
+        ap.error("--plant-reshard applies to the sharded train-step "
+                 "targets (train-step-dp / train-step-tp)")
 
-    from paddle_tpu.analysis import Allowlist, Findings, GraphLint
+    # the sharded targets need the virtual multi-device mesh. XLA reads
+    # XLA_FLAGS at first BACKEND INIT (not at jax import), so setting it
+    # here still works even when jax was imported earlier — only an
+    # already-initialized small backend is unrecoverable.
+    if any(t in SHARDED_TARGETS for t in targets):
+        if "--xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8")
+        if "jax" in sys.modules:
+            try:
+                from jax._src import xla_bridge as _xb
+                initialized = bool(getattr(_xb, "_backends", None))
+            except Exception:
+                initialized = True   # can't tell: probe (may init)
+            import jax
+            if initialized and len(jax.devices()) < 8:
+                print("graph_lint: jax already initialized with "
+                      f"{len(jax.devices())} device(s); sharded targets "
+                      "need 8 (set XLA_FLAGS="
+                      "--xla_force_host_platform_device_count=8 before "
+                      "the first jax backend use)",
+                      file=sys.stderr)
+                return 2
+
+    from paddle_tpu.analysis import (Allowlist, CommPlan, Findings,
+                                     GraphLint)
     extra = Allowlist.from_json(args.allow).entries if args.allow else None
     lint = GraphLint(allow=extra, upcast_bytes=args.upcast_bytes,
                      const_bytes=args.const_bytes,
-                     donate_bytes=args.donate_bytes)
+                     donate_bytes=args.donate_bytes,
+                     replicated_bytes=args.replicated_bytes)
 
+    audits = {}
+    # the declared communication plans of the shipped sharded configs:
+    # dp trains on grad-sync all-reduces ALONE; the hybrid tp mesh adds
+    # the TP activation all-gathers. Anything else = partitioner crept.
     runners = {
         "gpt-static": lambda: audit_gpt_engine(lint, paged=False),
         "gpt-paged": lambda: audit_gpt_engine(lint, paged=True),
@@ -221,6 +428,15 @@ def main(argv=None) -> int:
         "train-step": lambda: audit_train_step(lint),
         "resnet50": lambda: audit_resnet50(lint,
                                            train=args.vision_train),
+        "train-step-dp": lambda: audit_train_step_sharded(
+            lint, {"dp": 8}, plan=CommPlan({"all-reduce": "+"}),
+            plant=args.plant_reshard, audits=audits),
+        "train-step-tp": lambda: audit_train_step_sharded(
+            lint, {"dp": 2, "mp": 4},
+            plan=CommPlan({"all-reduce": "+", "all-gather": "+"}),
+            plant=args.plant_reshard, audits=audits),
+        "comm-xcheck": lambda: audit_comm_xcheck(
+            rtol=args.xcheck_rtol, audits=audits),
     }
 
     all_findings = Findings()
@@ -235,8 +451,27 @@ def main(argv=None) -> int:
         if not args.json:
             print(findings.grouped().table(f"{t} ({dt:.1f}s):"))
 
+    if not args.json:
+        for key, audit in audits.items():
+            if hasattr(audit, "table"):
+                print("\n" + audit.table())
+            elif isinstance(audit, dict) and "diff" in audit:
+                print(f"\n---- Static-vs-runtime bytes ({key}) ----")
+                print(f"  {'kind':<20} {'static B/step':>14} "
+                      f"{'runtime B/step':>14} {'rel err':>8}")
+                for d in audit["diff"]:
+                    rel = f"{d['rel_err'] * 100:.2f}%" \
+                        if d["rel_err"] is not None else "-"
+                    print(f"  {d['kind']:<20} "
+                          f"{str(d['static_bytes']):>14} "
+                          f"{str(d['runtime_bytes']):>14} {rel:>8}"
+                          + ("" if d["ok"] else "  MISMATCH"))
+
     active = all_findings.active(args.fail_on)
     if args.json:
+        report["comm"] = {
+            k: (a.to_dict() if hasattr(a, "to_dict") else a)
+            for k, a in audits.items()}
         report["active"] = len(active)
         print(json.dumps(report, indent=2))
     else:

@@ -57,6 +57,14 @@ HOT_GLOBS = (
     # are annotated, everything else must stay transfer-free
     "paddle_tpu/resilience/checkpoint.py",
     "paddle_tpu/resilience/state.py",
+    # ISSUE 15 satellite: the newer hot modules. The fleet router runs
+    # on the request path of every replica; the obs servers run threads
+    # INSIDE serving processes — a stray tensor sync in a scrape handler
+    # stalls the engine it observes. Deliberate host-side float()/bool()
+    # reads (metrics math on already-host scalars) carry annotations.
+    "paddle_tpu/inference/fleet.py",
+    "paddle_tpu/obs/server.py",
+    "paddle_tpu/obs/fleet.py",
 )
 # device-get additionally covers every file under these packages
 DEVICE_GET_DIRS = ("paddle_tpu/inference", "paddle_tpu/jit")

@@ -103,6 +103,21 @@ fchaosrc=$?
 fchaos_secs=$(echo "$(date +%s.%N) $fchaos_t0" | awk '{printf "%.2f", $1-$2}')
 echo "fleet_chaos_smoke: ${fchaos_secs}s (exit $fchaosrc)"
 
+# sharded graph-lint smoke (ISSUE 15): the SPMD communication plan of
+# TrainStep(gpt) proven statically on an 8-device host-platform CPU mesh
+# — dp is all-reduce-only by plan, tp adds the TP all-gathers, and the
+# comm-xcheck leg pins the static collective bytes to the checked-in
+# runtime trace fixture within 1%. graph_lint sets the XLA device-count
+# flag itself.
+shard_t0=$(date +%s.%N)
+timeout -k 10 "${TIER1_SHARDLINT_TIMEOUT:-120}" \
+    env JAX_PLATFORMS=cpu python tools/graph_lint.py \
+    train-step-dp train-step-tp comm-xcheck > /tmp/_shardlint.log 2>&1
+shardrc=$?
+[ "$shardrc" -ne 0 ] && cat /tmp/_shardlint.log
+shard_secs=$(echo "$(date +%s.%N) $shard_t0" | awk '{printf "%.2f", $1-$2}')
+echo "shardlint: ${shard_secs}s (exit $shardrc)"
+
 timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
     PADDLE_TPU_TIER_DURATIONS="$DUR" \
     python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
@@ -115,6 +130,7 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -c
 [ "$rc" -eq 0 ] && rc=$obsrc
 [ "$rc" -eq 0 ] && rc=$fleetrc
 [ "$rc" -eq 0 ] && rc=$fchaosrc
+[ "$rc" -eq 0 ] && rc=$shardrc
 
 if [ -s "$DUR" ]; then
     python tools/check_tiers.py "$DUR" \
@@ -131,7 +147,9 @@ if [ -s "$DUR" ]; then
         --fleet-seconds "$fleet_secs" \
         --fleet-budget "${TIER1_FLEET_BUDGET:-60}" \
         --fleet-chaos-seconds "$fchaos_secs" \
-        --fleet-chaos-budget "${TIER1_FLEET_CHAOS_BUDGET:-60}"
+        --fleet-chaos-budget "${TIER1_FLEET_CHAOS_BUDGET:-60}" \
+        --shardlint-seconds "$shard_secs" \
+        --shardlint-budget "${TIER1_SHARDLINT_BUDGET:-60}"
     crc=$?
     [ "$rc" -eq 0 ] && rc=$crc
 else
