@@ -649,6 +649,98 @@ def bench_decode_paged(on_tpu):
     })
 
 
+def bench_decode_paged_mp(on_tpu):
+    """Multi-chip sharded paged serving (ISSUE 16): the same long-tail
+    workload replayed through the head-sharded tensor-parallel paged
+    engine — KV pools sharded over the `mp` mesh axis, decode
+    communicating through mp-group all-reduces ONLY (the CommPlan the
+    graph_lint gpt-paged-sharded target proves statically) — and its
+    single-chip twin printed alongside. The row value is the sharded
+    tok/s; extras carry the twin, the speedup, and the shard count."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (ServingConfig, ServingEngine,
+                                      synthetic_traffic)
+    from paddle_tpu.models import GPTForCausalLM, GPTConfig, gpt_config
+
+    # a CPU host gets a virtual multi-device backend when nothing
+    # initialized one yet (XLA reads XLA_FLAGS at first backend init)
+    if not on_tpu and "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
+    import jax
+
+    if on_tpu:
+        preset, B, cap, new, chunk, n_req = "gpt3-1.3b", 8, 128, 128, 32, 48
+    else:
+        preset, B, cap, new, chunk, n_req = None, 2, 16, 8, 4, 10
+    preset = os.environ.get("PADDLE_TPU_BENCH_PRESET", preset) \
+        if on_tpu else preset
+    paddle.seed(0)
+    if preset:
+        cfg = gpt_config(preset)
+        model = GPTForCausalLM(cfg)
+        model.to(dtype="bfloat16")
+    else:
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=4, max_position_embeddings=128,
+                        intermediate_size=128)
+        model = GPTForCausalLM(cfg)
+    model.eval()
+
+    shards = 1
+    lim = min(len(jax.devices()), cfg.num_heads)
+    while shards * 2 <= lim and cfg.num_heads % (shards * 2) == 0:
+        shards *= 2
+    if shards < 2:
+        return _emit({
+            "metric": "multi-chip paged serving decode tokens/sec",
+            "value": None, "unit": "tokens/s", "vs_baseline": None,
+            "extra": {"reason": f"{len(jax.devices())} device(s), "
+                                f"{cfg.num_heads} heads: no mp axis "
+                                f">= 2 available"}})
+
+    traffic = synthetic_traffic(n_req, prompt_cap=cap,
+                                vocab_size=cfg.vocab_size, rate=1e9,
+                                seed=3, length_dist="longtail")
+
+    def run(s):
+        eng = ServingEngine(model, ServingConfig(
+            max_batch=B, prompt_cap=cap, max_new_tokens=new,
+            decode_chunk=chunk, paged=True, shards=s))
+        for item in traffic[:B]:            # warmup: compile the pair
+            eng.submit(item["prompt"])
+        eng.drain()
+        eng.metrics = type(eng.metrics)()
+        t0 = time.perf_counter()
+        for item in traffic:
+            eng.submit(item["prompt"])
+            while eng.queue_depth >= B:
+                eng.step()
+        while eng.busy:
+            eng.step()
+        dt = time.perf_counter() - t0
+        return (eng.metrics.counters["tokens_out"] / dt,
+                eng.monitor.recompiles)
+
+    one_tps, rc1 = run(1)
+    mp_tps, rc2 = run(shards)
+
+    return _emit({
+        "metric": f"multi-chip paged serving decode tokens/sec "
+                  f"({preset or 'toy'} longtail traffic, mp={shards}, "
+                  f"B={B} cap={cap} new={new} chunk={chunk})",
+        "value": round(mp_tps, 1), "unit": "tokens/s",
+        "vs_baseline": None,
+        "extra": {"shards": shards,
+                  "single_chip_tok_s": round(one_tps, 1),
+                  "mp_vs_single": round(mp_tps / one_tps, 3)
+                  if one_tps else None,
+                  "steady_recompiles": rc1 + rc2},
+    })
+
+
 def bench_decode_paged_prefix(on_tpu):
     """Prefix-cached serving on shared-prefix traffic (ISSUE 10): N system
     prompts x random suffixes replayed through the paged engine with the
@@ -980,6 +1072,7 @@ _SINGLE = {
     "vit": bench_vit,
     "decode": bench_decode,
     "decode-paged": bench_decode_paged,
+    "decode-paged-mp": bench_decode_paged_mp,
     "decode-paged-prefix": bench_decode_paged_prefix,
     "decode-spec": bench_decode_spec,
     "swin": bench_swin,
@@ -1017,6 +1110,9 @@ def _ladder(on_tpu):
         # paged KV serving (ISSUE 5): block-pool engine vs the padded
         # twin on long-tail traffic + the decode_static donation saving
         ("decode-paged", lambda: bench_decode_paged(on_tpu), 180),
+        # multi-chip sharded serving (ISSUE 16): head-sharded pools,
+        # tensor-parallel decode over the mp mesh vs the 1-chip twin
+        ("decode-paged-mp", lambda: bench_decode_paged_mp(on_tpu), 200),
         # prefix cache (ISSUE 10): shared-prefix traffic, radix-trie
         # block sharing off vs on — hit rate + prefill-tokens-saved
         ("decode-paged-prefix",
@@ -1092,12 +1188,20 @@ def _ladder(on_tpu):
 
 
 def main():
+    which = os.environ.get("PADDLE_TPU_BENCH_MODEL")
+    # the sharded row needs a multi-device backend BEFORE first init;
+    # scoped to that row so every other row keeps its 1-device CPU smoke
+    if which == "decode-paged-mp" and \
+            "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
     import jax
 
     devs = jax.devices()
     on_tpu = devs[0].platform in ("tpu", "axon")
 
-    which = os.environ.get("PADDLE_TPU_BENCH_MODEL")
     if which:
         fn = _SINGLE.get(which)
         if fn is None:

@@ -202,3 +202,22 @@ class CommPlan:
         if fs:
             raise CommPlanError(fs, executable)
         return fs
+
+
+def serving_comm_plan(num_layers: Optional[int] = None) -> CommPlan:
+    """THE declared multi-chip serving plan (ISSUE 16): a head-sharded
+    paged engine's executables communicate through mp-group all-reduces
+    and NOTHING else — exactly one per row-parallel matmul (attention
+    out-projection + MLP down-projection), i.e. ``2 * num_layers`` per
+    executable; weights ride replicated, the qkv projection head-shards
+    with a free local slice, pool scatters/gathers are shard-local by
+    construction, logits and sampling stay replicated.
+
+    With ``num_layers`` the count is EXACT (the sharp form the
+    graph_lint sharded-engine target gates on — a partitioner-inserted
+    KV gather or resharded embedding shows up as comm_extra/comm_count
+    and is named down to the op); without it the plan still default-
+    denies every non-all-reduce kind."""
+    if num_layers is None:
+        return CommPlan({"all-reduce": "+"})
+    return CommPlan({"all-reduce": 2 * int(num_layers)})

@@ -238,6 +238,16 @@ def _kind_name(kind) -> str:
 
 def _abstract_leaf(x):
     if hasattr(x, "shape") and hasattr(x, "dtype"):
+        # preserve MESH shardings (ISSUE 16): a sharded-serving pool's
+        # NamedSharding must survive abstraction or re-lowering the
+        # captured executable would silently audit the single-chip
+        # program. Single-device placements are dropped deliberately —
+        # they carry no SPMD information and would pin the lowering to
+        # one device id.
+        sh = getattr(x, "sharding", None)
+        if isinstance(sh, jax.sharding.NamedSharding):
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype,
+                                        sharding=sh)
         return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
     return x
 

@@ -216,6 +216,24 @@ def parse_io_aliases(lowered_text: str) -> Tuple[int, dict]:
     return n, aliases
 
 
+def parse_compiled_aliases(compiled_text: str) -> dict:
+    """{entry_param_index: output_tuple_index} from a compiled HloModule
+    header's ``input_output_alias={ {out}: (param, {}, may-alias), ...}``
+    table. Under SPMD partitioning (num_partitions > 1) jax defers
+    donation aliasing to XLA: the lowered StableHLO carries NO
+    tf.aliasing_output attributes and the alias table only exists after
+    compile — reading the pre-compile text alone would misreport every
+    sharded executable's donation as a silent copy."""
+    m = re.search(r"input_output_alias=\{((?:[^{}]|\{[^{}]*\})*)\}",
+                  compiled_text)
+    if not m:
+        return {}
+    out = {}
+    for om, pm in re.findall(r"\{(\d+)[^{}]*\}:\s*\((\d+)", m.group(1)):
+        out[int(pm)] = int(om)
+    return out
+
+
 def donation_pass(fn, args, donate_argnums: Sequence[int] = (),
                   executable: str = "", min_bytes: int = 1 << 20,
                   closed_jaxpr=None, kwargs=None) -> List[Finding]:
@@ -276,6 +294,17 @@ def donation_pass(fn, args, donate_argnums: Sequence[int] = (),
             leaves = jax.tree.flatten(a)[0]
             flat_donated += [ai in set(donate_argnums)] * len(leaves)
         flat_donated += [False] * (len(flat_leaves) - len(flat_donated))
+
+    if not aliases and any(flat_donated):
+        # No aliases in the StableHLO but donation was intended: under
+        # SPMD partitioning the alias table is only established at
+        # compile time (see parse_compiled_aliases) — compile before
+        # claiming the donation degraded to a copy. Failure-path only:
+        # executables whose donation lowered normally never pay this.
+        try:
+            aliases = parse_compiled_aliases(lowered.compile().as_text())
+        except Exception:
+            pass
 
     out: List[Finding] = []
     mapped = n_args == len(flat_leaves)

@@ -114,7 +114,13 @@ class ColumnParallelLinear(Layer):
             self.bias = self.create_parameter([out_features], is_bias=True)
             self.bias.pspec = P("mp")
 
-    def forward(self, x):
+    def forward(self, x, shard_output: bool = True):
+        # shard_output=False skips the mp constraint on the output: the
+        # caller will apply its own sharding after a reshape that the
+        # contiguous [*, out] mp-tiling cannot survive (e.g. the fused
+        # qkv [B,S,3H] -> [B,S,3,nh,hd] split in paged serving, where a
+        # head-axis constraint AFTER the reshape is a free local slice
+        # but an mp constraint BEFORE it forces a partitioner collective).
         gather = self.gather_output
         q8 = _q8_payload(self.weight)
 
@@ -127,7 +133,7 @@ class ColumnParallelLinear(Layer):
                 y = jnp.matmul(x_, w)
                 if b:
                     y = y + b[0]
-            if not gather:
+            if not gather and shard_output:
                 y = _act_constraint(y, "mp")
             return y
 
@@ -164,6 +170,16 @@ class RowParallelLinear(Layer):
                 from ..ops.pallas.int8_matmul import int8_linear_nd
                 y = int8_linear_nd(x_, q8[0], q8[1].reshape(-1))
             else:
+                # Pin the weight's contracting dim too: with BOTH operands
+                # sharded on the contraction the partitioner must lower
+                # partial-dot + all-reduce. Without it, on small shapes
+                # (b=1 prefill) the cost model prefers all-gathering the
+                # activation and doing a local full matmul — legal, but it
+                # breaks the all-reduce-only serving CommPlan. In training
+                # the weight already lives at P("mp", None), so this is a
+                # no-op; in serving (weights replicated) it is a free
+                # local slice.
+                w = _mesh.shard_constraint(w, "mp", None)
                 y = jnp.matmul(x_, w)
             y = _act_constraint(y)
             if b:

@@ -103,18 +103,43 @@ class BlockPool:
         with int8 ``[NB, bs, H, D]`` codes and f32 ``[NB, bs, H]``
         factored scales. The caller owns them from here — jitted steps
         donate and replace them, so the allocator deliberately does NOT
-        keep a reference."""
+        keep a reference.
+
+        Under an active mesh with an ``mp`` axis (multi-chip serving,
+        ISSUE 16) the pools come up HEAD-SHARDED: ``[NB, bs, H, D]``
+        with H split over mp (int8 scale pools ``[NB, bs, H]`` shard the
+        same axis, so codes and their scales always live on the same
+        shard). Block tables, the free list, refcounts, and every other
+        allocator structure stay host-side and replicated — sharding is
+        purely a device-placement property of the arrays."""
         import jax.numpy as jnp
+        from ..distributed import mesh as _mesh
+        mp = _mesh.mesh_axis_size("mp")
+        if mp > 1 and self.num_heads % mp != 0:
+            raise ValueError(
+                f"head-sharded pools need num_heads divisible by the mp "
+                f"axis; got num_heads={self.num_heads}, mp={mp}")
+        pool_sh = _mesh.named_sharding(None, None, "mp", None)
+        scale_sh = _mesh.named_sharding(None, None, "mp")
+
+        def _zeros(shape, dtype, sh):
+            z = jnp.zeros(shape, dtype)
+            if sh is not None:
+                import jax
+                z = jax.device_put(z, sh)
+            return z
+
         shape = (self.num_blocks, self.block_size,
                  self.num_heads, self.head_dim)
         if self.cache_dtype == "int8":
             sshape = shape[:3]
-            return [(jnp.zeros(shape, jnp.int8),
-                     jnp.zeros(sshape, jnp.float32),
-                     jnp.zeros(shape, jnp.int8),
-                     jnp.zeros(sshape, jnp.float32))
+            return [(_zeros(shape, jnp.int8, pool_sh),
+                     _zeros(sshape, jnp.float32, scale_sh),
+                     _zeros(shape, jnp.int8, pool_sh),
+                     _zeros(sshape, jnp.float32, scale_sh))
                     for _ in range(self.num_layers)]
-        return [(jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype))
+        return [(_zeros(shape, self.dtype, pool_sh),
+                 _zeros(shape, self.dtype, pool_sh))
                 for _ in range(self.num_layers)]
 
     # ------------------------------------------------------------- sizing
@@ -292,9 +317,11 @@ class BlockPool:
 
     # ------------------------------------------- spill payloads (ISSUE 14)
     def _spill_sig(self) -> tuple:
+        from ..distributed import mesh as _mesh
         return ("spill_scatter", self.num_blocks, self.block_size,
                 self.num_layers, self.num_heads, self.head_dim,
-                str(self.dtype), self.cache_dtype)
+                str(self.dtype), self.cache_dtype,
+                _mesh.mesh_axis_size("mp"))
 
     def read_block(self, pools, block: int) -> tuple:
         """ONE block's payload gathered to host — the spill tier's
@@ -305,7 +332,15 @@ class BlockPool:
         so a spill costs one transfer per payload array, not one per
         layer. Returns the tuple of host ndarrays `write_block` takes
         back verbatim — the round trip is bit-identical by construction
-        (same bytes, no recompute)."""
+        (same bytes, no recompute).
+
+        SHARD CONSISTENCY (ISSUE 16): on head-sharded pools the
+        `device_get` GATHERS across the mp shards, so the host payload
+        is always the full-width ``[2L, bs, H, D]`` array regardless of
+        shard count — a block spilled by an mp=4 engine rehydrates
+        bit-identically into an mp=1 (or mp=2) pool and vice versa. The
+        fleet spill tier's codec is therefore shard-count-independent by
+        construction (gather-on-spill / reshard-on-rehydrate)."""
         import jax
         import jax.numpy as jnp
         if self.cache_dtype == "int8":
@@ -324,7 +359,15 @@ class BlockPool:
         executable shared by every pool of this geometry. The block id
         is a data input, so rehydrating any block reuses the same
         compiled program. Returns the replaced pools (the old ones are
-        donated/consumed)."""
+        donated/consumed).
+
+        On head-sharded pools the full-width host payload enters as a
+        replicated jit input and the scatter RE-SHARDS it: the updated
+        pool keeps the operand's head-sharding (each shard writes only
+        its own H-slice of the payload), so rehydration never moves pool
+        bytes across shards. The executable cache key includes the mp
+        axis size — engines at different shard counts never share a
+        scatter program."""
         import jax
         sig = self._spill_sig()
         fn = _SPILL_SCATTER_CACHE.get(sig)

@@ -55,6 +55,19 @@ by the same token path holds bit-identical payloads — insert can
 therefore keep the FIRST block cached under a key and drop later
 duplicates without comparing device bytes (and an insert that passes a
 spilled node upgrades it in place with the freshly recomputed block).
+
+MULTI-CHIP (ISSUE 16, ``ServingConfig(shards=N)``): the trie is a
+host-side control-plane structure, so head-sharding the device pools
+changes NOTHING here — block ids, token keys, refcounts and LRU state
+stay replicated host facts. The two places sharding touches are both
+downstream contracts this module relies on: the engine's COW copy is
+shard-local by construction (source gather and target scatter carry the
+same head sharding — zero collectives, gated by ``serving_comm_plan(0)``
+in the graph_lint sharded target), and the spill tier's
+``read_block``/``write_block`` codec is shard-CONSISTENT (read gathers
+ONE full-width host payload whatever the shard count, write reshards on
+rehydrate — see kv_cache), so a node spilled under one shard count
+rehydrates under another.
 """
 from __future__ import annotations
 

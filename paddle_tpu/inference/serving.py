@@ -516,6 +516,14 @@ class ServingConfig:
     kv_block: int = 16              # KV rows per pool block
     kv_blocks: Optional[int] = None  # total pool blocks INCL. trash block;
     #                            default = worst case for max_batch rows
+    # --- multi-chip tensor-parallel serving (ISSUE 16): shard the paged
+    # pools' HEAD axis over an `mp` mesh of this many devices. The
+    # executables run through the mpu tensor-parallel layers; block
+    # tables, the allocator, refcounts and the radix trie stay host-side
+    # and replicated. None/1 = single-chip (no mesh built). Requires
+    # paged=True and num_heads % shards == 0; greedy output is
+    # bit-identical across shard counts (the per-shard invariant suite).
+    shards: Optional[int] = None
     # --- prefix cache (ISSUE 10): radix-trie prefix reuse over the pool.
     # A full-block-aligned cached prefix maps shared (refcounted) blocks
     # straight into the new request's table — full hit skips prefill
@@ -583,6 +591,18 @@ class ServingConfig:
             raise ValueError(
                 f"queue_high_watermark must be in [1, queue_capacity="
                 f"{self.queue_capacity}], got {self.queue_high_watermark}")
+        if self.shards is not None:
+            if self.shards < 1:
+                raise ValueError(f"shards must be >= 1, got {self.shards}")
+            if self.shards > 1 and not self.paged:
+                raise ConfigValidationError(Finding(
+                    "config", "sharded_requires_paged", "error",
+                    f"shards={self.shards} requires paged=True: tensor-"
+                    f"parallel serving shards the paged block pools' head "
+                    f"axis over the mp mesh; the padded static engine has "
+                    f"no pools to shard",
+                    executable="ServingConfig",
+                    data={"shards": self.shards, "paged": False}))
         if self.prefix_cache and not self.paged:
             raise ValueError("prefix_cache=True requires paged=True (the "
                              "trie shares BLOCK-pool blocks; the padded "
@@ -746,6 +766,28 @@ class ServingEngine:
         self._shape_sig = (((config.max_batch, config.prompt_cap), "int64"),
                            ((config.max_batch,), "int32"))
         self._spill = None     # host spill tier (paged + prefix + spill)
+        # multi-chip serving (ISSUE 16): a private mp mesh over the first
+        # `shards` devices. The engine activates it around pool creation
+        # and every step — NOT globally — so interleaved engines at
+        # different shard counts (the bit-identity suite, the bench's
+        # single-chip twin) never see each other's mesh.
+        self._mesh = None
+        if config.paged and (config.shards or 1) > 1:
+            from ..distributed import mesh as _dist_mesh
+            shards = int(config.shards)
+            devs = jax.devices()
+            if len(devs) < shards:
+                raise ValueError(
+                    f"shards={shards} needs {shards} devices, have "
+                    f"{len(devs)} (CPU hosts: set "
+                    f"--xla_force_host_platform_device_count)")
+            nh = model.config.num_heads
+            if nh % shards != 0:
+                raise ValueError(
+                    f"shards={shards} must divide num_heads={nh} (pools "
+                    f"shard the head axis)")
+            self._mesh = _dist_mesh.build_mesh({"mp": shards},
+                                               devs[:shards])
         if config.paged:
             # slot-level continuous batching over a paged block pool: each
             # batch slot runs its own request; EOS/budget frees the slot's
@@ -759,7 +801,8 @@ class ServingEngine:
                                              num_blocks=config.kv_blocks,
                                              block_size=config.kv_block,
                                              cache_dtype=config.cache_dtype)
-            self._pools = self._pool.make_pools()
+            with self._mesh_scope():
+                self._pools = self._pool.make_pools()
             self._slots: List[Optional[Request]] = [None] * B
             self._tables = np.zeros((B, MB), np.int32)
             self._lens = np.zeros((B,), np.int32)
@@ -799,6 +842,19 @@ class ServingEngine:
             # the trie (when present) drafts first, the hook fills misses
             self._draft_fn = config.spec_draft \
                 if callable(config.spec_draft) else None
+
+    def _mesh_scope(self):
+        """Activate the engine's private mp mesh (multi-chip serving) for
+        the duration of a step — a no-op nullcontext on single-chip
+        engines. Every compiled-signature component that depends on the
+        shard count reads `mesh_axis_size("mp")` under this scope, so
+        engines at different shard counts never collide in the compiled-
+        runner caches."""
+        import contextlib
+        if self._mesh is None:
+            return contextlib.nullcontext()
+        from ..distributed import mesh as _dist_mesh
+        return _dist_mesh.mesh_scope(self._mesh)
 
     # -- admission ------------------------------------------------------
     @property
@@ -993,6 +1049,10 @@ class ServingEngine:
         read them); a guard-mode lint raises as soon as an audited
         executable violates — after that batch was served, since the
         program must exist to be lowered."""
+        with self._mesh_scope():
+            return self._step_inner()
+
+    def _step_inner(self) -> List[Request]:
         if self._lint is None:
             return self._step_dispatch()
         from ..analysis import lint_capture
@@ -1386,9 +1446,10 @@ class ServingEngine:
         src/dst are data inputs of one tiny donated executable — steady
         COW traffic adds zero compilations."""
         import jax as _jax
+        from ..distributed import mesh as _dist_mesh
         sig = ("paged_cow", self._pool.num_blocks, self._pool.block_size,
                self._pool.num_layers, str(self._pool.dtype),
-               self._pool.cache_dtype)
+               self._pool.cache_dtype, _dist_mesh.mesh_axis_size("mp"))
 
         def build():
             def run(pools, s, d):

@@ -148,6 +148,22 @@ def _q8_bind(params, payloads):
             del p._q8
 
 
+def _replicate_tree(pa):
+    """Pin every leaf of a serving param payload REPLICATED under the
+    active mesh (no-op off-mesh). Multi-chip paged serving (ISSUE 16)
+    leaves the weights as uncommitted jit inputs, and XLA's auto-spmd is
+    then free to invent shardings for them — on the toy engines it picks
+    a vocab-sharded wte, which buys a partial-embedding all-reduce and
+    per-shard argmax all-gathers the serving CommPlan forbids. Declaring
+    the weights replicated keeps the decode inventory at exactly the mpu
+    layers' contribution: one mp all-reduce per row-parallel matmul."""
+    import jax as _jax
+    from ..distributed.mesh import get_mesh, shard_constraint
+    if get_mesh() is None:
+        return pa
+    return _jax.tree_util.tree_map(shard_constraint, pa)
+
+
 class GPTSelfAttention(Layer):
     """Fused QKV column-parallel attention block."""
 
@@ -168,11 +184,21 @@ class GPTSelfAttention(Layer):
 
     def forward(self, x, cache=None):
         nh, hd = self.num_heads, self.head_dim
-        qkv = self.qkv(x)                               # [B,S,3H] (mp-sharded)
+        # Paged serving shards the HEAD axis (ISSUE 16): the fused qkv
+        # output [B,S,3H] cannot keep a contiguous mp-tiling of 3H through
+        # the [B,S,3,nh,hd] split (mp does not divide the leading factor
+        # 3), so constraining it to mp here would force the partitioner to
+        # insert a collective before every pool write. Instead the paged
+        # branch leaves the matmul output unconstrained and pins the HEAD
+        # axis right after the reshape — a free replicated->sharded local
+        # slice; the redundant per-shard qkv FLOPs are noise against the
+        # KV-bandwidth-bound decode step.
+        paged = cache is not None and isinstance(cache[0], str)
+        qkv = self.qkv(x, shard_output=not paged)       # [B,S,3H]
         b, s = qkv.shape[0], qkv.shape[1]
 
         new_cache = None
-        if cache is not None and isinstance(cache[0], str):
+        if paged:
             # PAGED KV-cache serving (ISSUE 5/10): ("paged", k_pool,
             # v_pool, block_tables, lens[, start]) — or, int8 pools,
             # ("paged8", k_codes, k_scale, v_codes, v_scale, tables,
@@ -192,6 +218,13 @@ class GPTSelfAttention(Layer):
                                  f"'paged8')")
             q8c = cache[0] == "paged8"
             qkv = ops.reshape(qkv, [b, s, 3, nh, hd])
+            # head-axis pin (see note above): [B, S, 3, nh, hd] with nh
+            # over mp — no-op off-mesh; under an mp mesh this is the slice
+            # that makes every pool write/attend below shard-local
+            qkv = apply_op(
+                "qkv_head_shard",
+                lambda a: _mesh.shard_constraint(
+                    a, "dp", None, None, "mp", None), [qkv])
             q = qkv[:, :, 0]
             from ..ops.attention import (paged_cache_write,
                                          paged_cache_write_q8,
@@ -759,6 +792,16 @@ class GPTForCausalLM(Layer):
         x, new_caches = out if caches is not None else (out, None)
         if self.config.tie_word_embeddings:
             q8 = getattr(self.gpt.wte.weight, "_q8", None)
+            # paged serving (ISSUE 16): logits stay REPLICATED. The
+            # training-style vocab-over-mp constraint would shard this
+            # use of wte, and sharding propagates to the parameter — the
+            # embedding gather turns into a partial-gather + all-reduce
+            # and greedy argmax into per-shard candidates + all-gathers,
+            # all of which the serving CommPlan (all-reduce only, from
+            # the row-parallel matmuls) forbids. Vocab=128-class logits
+            # at decode width are noise next to the KV stream anyway.
+            paged = caches is not None and len(caches) > 0 and \
+                isinstance(caches[0][0], str)
 
             def _head_fn(a, w):
                 if q8 is not None:
@@ -767,6 +810,8 @@ class GPTForCausalLM(Layer):
                                        w_layout="nk")
                 else:
                     y = jnp.einsum("bsh,vh->bsv", a, w)
+                if paged:
+                    return _mesh.shard_constraint(y)
                 return _mesh.shard_constraint(y, "dp", "sp", "mp")
 
             logits = apply_op("tied_lm_head", _head_fn,
@@ -1274,6 +1319,7 @@ class GPTForCausalLM(Layer):
         expand = self._make_expand(q8, cdt)
 
         def run(pa, pools, prompt, lens, tbl, key0, st=None):
+            pa = _replicate_tree(pa)
             ex, pays = expand(pa)
             with _trace_guard(), _swap_params(params, ex), \
                     _q8_bind(params, pays), autograd.no_grad():
@@ -1301,7 +1347,7 @@ class GPTForCausalLM(Layer):
         sig = ("paged_prefill", b, p_cap, nb, bs, int(tables.shape[1]),
                float(temperature), int(top_k), float(top_p), str(cdt),
                "q8" if q8 else "full", "c8" if c8 else "fp",
-               "ofs" if ofs else "abs")
+               "ofs" if ofs else "abs", _mesh.mesh_axis_size("mp"))
         fn = self._gen_cache_get(
             sig, lambda: jax.jit(run, donate_argnums=(1,)))
         payload = tuple(qmap[i] if i in qmap else p._data
@@ -1370,6 +1416,8 @@ class GPTForCausalLM(Layer):
                                  top_k=top_k, top_p=top_p)
 
         def run(pa, pools, tbl, lens_, pending_, done_, key0):
+            pa = _replicate_tree(pa)
+
             def model_step(tokens, pools, ln):
                 ex, pays = expand(pa)
                 with _trace_guard(), _swap_params(params, ex), \
@@ -1408,7 +1456,8 @@ class GPTForCausalLM(Layer):
                int(max_new_tokens), float(temperature), int(top_k),
                float(top_p),
                None if eos_token_id is None else int(eos_token_id),
-               str(cdt), "q8" if q8 else "full", "c8" if c8 else "fp")
+               str(cdt), "q8" if q8 else "full", "c8" if c8 else "fp",
+               _mesh.mesh_axis_size("mp"))
         fn = self._gen_cache_get(
             sig, lambda: jax.jit(run, donate_argnums=(1,)))
         payload = tuple(qmap[i] if i in qmap else p._data
@@ -1487,6 +1536,7 @@ class GPTForCausalLM(Layer):
         expand = self._make_expand(q8, cdt)
 
         def run(pa, pools, tbl, lens_, pending_, draft_, done_):
+            pa = _replicate_tree(pa)
             window = jnp.concatenate([pending_[:, None], draft_], axis=1)
             ex, pays = expand(pa)
             with _trace_guard(), _swap_params(params, ex), \
@@ -1532,7 +1582,8 @@ class GPTForCausalLM(Layer):
         nb, bs = pools[0][0].shape[0], pools[0][0].shape[1]
         sig = ("paged_verify", b, k, nb, bs, int(tables.shape[1]),
                None if eos_token_id is None else int(eos_token_id),
-               str(cdt), "q8" if q8 else "full", "c8" if c8 else "fp")
+               str(cdt), "q8" if q8 else "full", "c8" if c8 else "fp",
+               _mesh.mesh_axis_size("mp"))
         fn = self._gen_cache_get(
             sig, lambda: jax.jit(run, donate_argnums=(1,)))
         payload = tuple(qmap[i] if i in qmap else p._data

@@ -17,6 +17,33 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor, apply_op
 from ..core import random as _random
+from ..distributed import mesh as _mesh
+
+
+def _pool_shard(pool):
+    """Pin a paged pool (or pool-shaped intermediate) to the serving
+    head-sharding: [NB, bs, H, D] with H over mp (int8 scale pools
+    [NB, bs, H] shard the same axis). No-op without a mesh or without
+    an mp axis — the single-chip path is untouched. Under an mp mesh
+    this is what keeps every pool scatter/gather SHARD-LOCAL: block
+    index arithmetic only touches axis 0, heads never cross shards."""
+    if pool.ndim == 4:
+        return _mesh.shard_constraint(pool, None, None, "mp", None)
+    if pool.ndim == 3:
+        return _mesh.shard_constraint(pool, None, None, "mp")
+    return pool
+
+
+def _gathered_shard(view):
+    """Pin a gathered [B, width, H, D] contiguous pool view to the same
+    head-sharding as the pool it came from — the axis-0 block gather is
+    shard-local by construction; this makes that choice explicit to the
+    partitioner instead of hoping propagation picks it."""
+    if view.ndim == 4:
+        return _mesh.shard_constraint(view, "dp", None, "mp", None)
+    if view.ndim == 3:
+        return _mesh.shard_constraint(view, "dp", None, "mp")
+    return view
 
 
 def _use_pallas(q_shape, head_dim):
@@ -258,7 +285,7 @@ def paged_cache_write(pool, new, tables, lens):
     dest = bidx * bs + (li % bs)
     flat = pool.reshape((nb * bs,) + pool.shape[2:])
     flat = flat.at[dest].set(new[:, 0].astype(pool.dtype))
-    return flat.reshape(pool.shape)
+    return _pool_shard(flat.reshape(pool.shape))
 
 
 def paged_prefill_write(pool, new, tables, start=None):
@@ -292,7 +319,7 @@ def paged_prefill_write(pool, new, tables, start=None):
     flat = pool.reshape((nb * bs,) + pool.shape[2:])
     flat = flat.at[dest].set(
         new.reshape((b * s,) + new.shape[2:]).astype(pool.dtype))
-    return flat.reshape(pool.shape)
+    return _pool_shard(flat.reshape(pool.shape))
 
 
 def paged_prefill_mask(s, lens):
@@ -323,8 +350,10 @@ def paged_attention_reference(q, k_pool, v_pool, tables, lens, *,
     nb, bs = k_pool.shape[0], k_pool.shape[1]
     b, mb = tables.shape
     t = tables.astype(jnp.int32)
-    k = jnp.take(k_pool, t, axis=0).reshape((b, mb * bs) + k_pool.shape[2:])
-    v = jnp.take(v_pool, t, axis=0).reshape((b, mb * bs) + v_pool.shape[2:])
+    k = _gathered_shard(
+        jnp.take(k_pool, t, axis=0).reshape((b, mb * bs) + k_pool.shape[2:]))
+    v = _gathered_shard(
+        jnp.take(v_pool, t, axis=0).reshape((b, mb * bs) + v_pool.shape[2:]))
     col = jnp.arange(mb * bs, dtype=jnp.int32)[None, None, None, :]
     mask = col < lens.astype(jnp.int32)[:, None, None, None]
     return attention_reference(q, k, v, mask=mask, scale=scale,
@@ -338,7 +367,8 @@ def _paged_gather(pool, tables):
     nb, bs = pool.shape[0], pool.shape[1]
     b, mb = tables.shape
     t = tables.astype(jnp.int32)
-    return jnp.take(pool, t, axis=0).reshape((b, mb * bs) + pool.shape[2:])
+    return _gathered_shard(
+        jnp.take(pool, t, axis=0).reshape((b, mb * bs) + pool.shape[2:]))
 
 
 def paged_prefix_mask(s, width, start):

@@ -74,7 +74,9 @@ def check(records, *, budget: float, slow_threshold: float,
           fleet_chaos_seconds: float = None,
           fleet_chaos_budget: float = 60.0,
           shardlint_seconds: float = None,
-          shardlint_budget: float = 60.0) -> dict:
+          shardlint_budget: float = 60.0,
+          sharded_serve_seconds: float = None,
+          sharded_serve_budget: float = 90.0) -> dict:
     unmarked_slow = []       # should carry `slow` but don't
     tier1 = []               # everything tier-1 actually collects
     for r in records:
@@ -125,6 +127,12 @@ def check(records, *, budget: float, slow_threshold: float,
     # of the tier cap
     shardlint_over = (shardlint_seconds is not None
                       and shardlint_seconds > shardlint_budget)
+    # the sharded-serve budget line: tools/graph_lint.py's
+    # gpt-paged-sharded target proves the multi-chip serving CommPlan
+    # (ISSUE 16) — one 4-shard toy engine's executable set audited on
+    # the host mesh must stay a small fraction of the tier cap
+    sharded_serve_over = (sharded_serve_seconds is not None
+                         and sharded_serve_seconds > sharded_serve_budget)
     return {
         "n_records": len(records),
         "n_tier1": len(tier1),
@@ -153,13 +161,17 @@ def check(records, *, budget: float, slow_threshold: float,
         "shardlint_seconds": shardlint_seconds,
         "shardlint_budget_s": shardlint_budget,
         "shardlint_over_budget": shardlint_over,
+        "sharded_serve_seconds": sharded_serve_seconds,
+        "sharded_serve_budget_s": sharded_serve_budget,
+        "sharded_serve_over_budget": sharded_serve_over,
         "unmarked_slow": sorted(unmarked_slow,
                                 key=lambda r: -r["duration"]),
         "slowest_tier1": sorted(tier1, key=lambda r: -r["duration"])[:10],
         "ok": (tier1_total <= budget and not unmarked_slow
                and not lint_over and not chaos_over and not goodput_over
                and not obs_over and not fleet_over
-               and not fleet_chaos_over and not shardlint_over),
+               and not fleet_chaos_over and not shardlint_over
+               and not sharded_serve_over),
     }
 
 
@@ -212,6 +224,14 @@ def main(argv=None) -> int:
     ap.add_argument("--shardlint-budget", type=float, default=60.0,
                     help="max seconds the sharded graph-lint smoke may "
                          "take on tier-1 (8-device CPU mesh)")
+    ap.add_argument("--sharded-serve-seconds", type=float, default=None,
+                    help="measured wall time of the tier-1 sharded "
+                         "serving lint leg (tools/run_tier1.sh records "
+                         "it)")
+    ap.add_argument("--sharded-serve-budget", type=float, default=90.0,
+                    help="max seconds the sharded serving lint leg may "
+                         "take on tier-1 (4-shard toy engine on the "
+                         "host mesh)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -234,7 +254,9 @@ def main(argv=None) -> int:
                    fleet_chaos_seconds=args.fleet_chaos_seconds,
                    fleet_chaos_budget=args.fleet_chaos_budget,
                    shardlint_seconds=args.shardlint_seconds,
-                   shardlint_budget=args.shardlint_budget)
+                   shardlint_budget=args.shardlint_budget,
+                   sharded_serve_seconds=args.sharded_serve_seconds,
+                   sharded_serve_budget=args.sharded_serve_budget)
 
     if args.json:
         print(json.dumps(result, indent=2))
@@ -263,6 +285,10 @@ def main(argv=None) -> int:
         if result.get("shardlint_seconds") is not None:
             print(f"  shardlint: {result['shardlint_seconds']:.2f}s "
                   f"(budget {result['shardlint_budget_s']}s)")
+        if result.get("sharded_serve_seconds") is not None:
+            print(f"  sharded-serve: "
+                  f"{result['sharded_serve_seconds']:.2f}s "
+                  f"(budget {result['sharded_serve_budget_s']}s)")
         if result["chaos_over_budget"]:
             print(f"  VIOLATION: chaos gate took "
                   f"{result['chaos_seconds']:.2f}s, over the "
@@ -288,6 +314,11 @@ def main(argv=None) -> int:
             print(f"  VIOLATION: sharded graph-lint smoke took "
                   f"{result['shardlint_seconds']:.2f}s, over the "
                   f"{result['shardlint_budget_s']}s shardlint budget")
+        if result["sharded_serve_over_budget"]:
+            print(f"  VIOLATION: sharded serving lint leg took "
+                  f"{result['sharded_serve_seconds']:.2f}s, over the "
+                  f"{result['sharded_serve_budget_s']}s sharded-serve "
+                  f"budget")
         if result["lint_over_budget"]:
             print(f"  VIOLATION: lint pass took "
                   f"{result['lint_seconds']:.2f}s, over the "

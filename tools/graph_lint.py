@@ -47,6 +47,18 @@ and its post-SPMD HLO statically audited):
                   the static collective-bytes table matches the runtime
                   trace-ledger bytes per collective kind within
                   --xcheck-rtol (default 1%).
+  gpt-paged-sharded  the MULTI-CHIP paged engine (ISSUE 16): serve a real
+                  warmup batch at --shards (default 4) on the host-
+                  platform mesh, then statically prove the whole paged
+                  executable set — the abstract pass suite (pool donation
+                  included), a zero-steady-state-recompile loop, and the
+                  compiled-HLO sharding audit of every executable against
+                  the DECLARED serving CommPlan: model executables are
+                  exactly 2*num_layers mp-group all-reduces (one per
+                  row-parallel matmul), the COW copy is zero collectives
+                  (shard-local by plan). A partitioner-inserted KV
+                  gather/resharding fails the plan check with the op
+                  named.
 
 --plant-reshard is a self-test of the detector: it gives one layer's
 weight a deliberately wrong pspec on the sharded train-step targets and
@@ -81,9 +93,11 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 TARGETS = ("gpt-static", "gpt-paged", "gpt-paged-int8", "gpt-paged-spec",
            "train-step", "resnet50",
-           "train-step-dp", "train-step-tp", "comm-xcheck")
+           "train-step-dp", "train-step-tp", "comm-xcheck",
+           "gpt-paged-sharded")
 #: targets that need the multi-device host-platform mesh
-SHARDED_TARGETS = ("train-step-dp", "train-step-tp", "comm-xcheck")
+SHARDED_TARGETS = ("train-step-dp", "train-step-tp", "comm-xcheck",
+                   "gpt-paged-sharded")
 
 FIXTURE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "tests", "fixtures",
@@ -156,6 +170,88 @@ def audit_gpt_engine(lint, *, paged: bool, int8: bool = False,
                              "window — the speculative executable was "
                              "never lowered, nothing was audited")
     return eng.lint_findings
+
+
+def audit_gpt_engine_sharded(lint, shards: int = 4, audits=None):
+    """Multi-chip sharded serving audit (ISSUE 16): run a real warmup
+    batch through a head-sharded paged engine on the host-platform mesh,
+    then prove the plan statically —
+
+      1. abstract pass suite over every captured executable (host
+         transfer, dtype, baked consts, POOL DONATION via the
+         input_output_alias cross-check);
+      2. zero steady-state recompiles: post-warmup traffic at the same
+         shard count must add zero jit cache misses;
+      3. compiled-HLO sharding audit of each executable under the mesh
+         against the DECLARED serving CommPlan
+         (analysis.commplan.serving_comm_plan): prefill/decode/verify
+         are EXACTLY 2*num_layers mp-group all-reduces (the row-parallel
+         matmuls) and nothing else; the COW block copy is ZERO
+         collectives (shard-locality, proven not claimed). Any
+         partitioner-inserted KV gather shows up as comm_extra with the
+         op named and fails the run.
+    """
+    import numpy as np
+    from paddle_tpu.analysis import Findings, lint_capture
+    from paddle_tpu.analysis.commplan import serving_comm_plan
+    from paddle_tpu.analysis.lint import _kind_name
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+    from paddle_tpu.jit.api import compile_cache_misses
+    model, mcfg = _tiny_gpt()
+    cfg = ServingConfig(max_batch=2, prompt_cap=8, max_new_tokens=6,
+                        decode_chunk=2, eos_token_id=None, paged=True,
+                        kv_block=4, shards=shards)
+    eng = ServingEngine(model, cfg)
+    rng = np.random.RandomState(0)
+    with lint_capture() as calls:
+        eng.submit(rng.randint(1, 100, (5,)))
+        eng.submit(rng.randint(1, 100, (8,)))
+        eng.drain()
+    if not calls:
+        raise SystemExit("gpt-paged-sharded: warmup captured no "
+                         "executables — nothing was audited")
+
+    # zero steady-state recompiles at this shard count
+    miss0 = compile_cache_misses()
+    for _ in range(2):
+        eng.submit(rng.randint(1, 100, (7,)))
+        eng.drain()
+    dm = compile_cache_misses() - miss0
+    if dm:
+        raise SystemExit(f"gpt-paged-sharded: steady sharded loop added "
+                         f"{dm} jit cache miss(es) — a shard-dependent "
+                         f"signature component is missing")
+
+    # abstract passes (donation included) over the captured set
+    findings = lint.check_calls(calls, guard=False)
+
+    # compiled-HLO sharding audit per unique executable, under the
+    # engine's mesh, against the declared serving plan
+    model_plan = serving_comm_plan(mcfg.num_layers)
+    local_plan = serving_comm_plan(0)     # COW copy: zero collectives
+    seen, audited = set(), set()
+    with eng._mesh_scope():
+        for kind, fn, (args, kwargs) in calls:
+            head = kind[0] if isinstance(kind, tuple) else str(kind)
+            if not str(head).startswith("paged_"):
+                continue
+            name = _kind_name(kind)
+            if (id(fn), name) in seen:
+                continue
+            seen.add((id(fn), name))
+            plan = local_plan if head == "paged_cow" else model_plan
+            audit = lint.check_sharded(fn, *args, name=name, plan=plan,
+                                       mesh_axes={"mp": shards},
+                                       guard=False, **kwargs)
+            findings.extend(audit.findings)
+            audited.add(str(head))
+            if audits is not None:
+                audits[name] = audit
+    if "paged_decode" not in audited:
+        raise SystemExit("gpt-paged-sharded: the decode executable was "
+                         "never captured/audited — the comm-plan gate "
+                         "proved nothing")
+    return findings
 
 
 def audit_train_step(lint):
@@ -358,6 +454,9 @@ def main(argv=None) -> int:
     ap.add_argument("--xcheck-rtol", type=float, default=0.01,
                     help="comm-xcheck static-vs-runtime bytes tolerance "
                          "(default 1%%)")
+    ap.add_argument("--shards", type=int, default=4,
+                    help="mp degree for gpt-paged-sharded (default 4; "
+                         "must divide the toy model's 4 heads)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report: per-target findings, "
                          "the static comm tables of the sharded targets "
@@ -437,6 +536,8 @@ def main(argv=None) -> int:
             plant=args.plant_reshard, audits=audits),
         "comm-xcheck": lambda: audit_comm_xcheck(
             rtol=args.xcheck_rtol, audits=audits),
+        "gpt-paged-sharded": lambda: audit_gpt_engine_sharded(
+            lint, shards=args.shards, audits=audits),
     }
 
     all_findings = Findings()

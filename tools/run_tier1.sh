@@ -118,6 +118,20 @@ shardrc=$?
 shard_secs=$(echo "$(date +%s.%N) $shard_t0" | awk '{printf "%.2f", $1-$2}')
 echo "shardlint: ${shard_secs}s (exit $shardrc)"
 
+# sharded serving lint (ISSUE 16): the multi-chip paged engine's
+# communication plan proven statically — decode/prefill/verify/COW at 4
+# shards are mp-group all-reduce only (no partitioner-inserted KV
+# gather), pools stay donated, the steady state never recompiles.
+# graph_lint sets the XLA device-count flag itself.
+sserve_t0=$(date +%s.%N)
+timeout -k 10 "${TIER1_SHARDED_SERVE_TIMEOUT:-150}" \
+    env JAX_PLATFORMS=cpu python tools/graph_lint.py \
+    gpt-paged-sharded > /tmp/_shardserve.log 2>&1
+sservrc=$?
+[ "$sservrc" -ne 0 ] && cat /tmp/_shardserve.log
+sserve_secs=$(echo "$(date +%s.%N) $sserve_t0" | awk '{printf "%.2f", $1-$2}')
+echo "sharded_serve_lint: ${sserve_secs}s (exit $sservrc)"
+
 timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
     PADDLE_TPU_TIER_DURATIONS="$DUR" \
     python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
@@ -131,6 +145,7 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -c
 [ "$rc" -eq 0 ] && rc=$fleetrc
 [ "$rc" -eq 0 ] && rc=$fchaosrc
 [ "$rc" -eq 0 ] && rc=$shardrc
+[ "$rc" -eq 0 ] && rc=$sservrc
 
 if [ -s "$DUR" ]; then
     python tools/check_tiers.py "$DUR" \
@@ -149,7 +164,9 @@ if [ -s "$DUR" ]; then
         --fleet-chaos-seconds "$fchaos_secs" \
         --fleet-chaos-budget "${TIER1_FLEET_CHAOS_BUDGET:-60}" \
         --shardlint-seconds "$shard_secs" \
-        --shardlint-budget "${TIER1_SHARDLINT_BUDGET:-60}"
+        --shardlint-budget "${TIER1_SHARDLINT_BUDGET:-60}" \
+        --sharded-serve-seconds "$sserve_secs" \
+        --sharded-serve-budget "${TIER1_SHARDED_SERVE_BUDGET:-90}"
     crc=$?
     [ "$rc" -eq 0 ] && rc=$crc
 else

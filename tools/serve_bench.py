@@ -101,10 +101,11 @@ def _serving_config(args, paged, prefix_cache=False, spec=False):
                          prefix_cache=prefix_cache,
                          prefix_cache_bytes=args.prefix_cache_bytes,
                          spec_decode=spec, spec_k=args.spec_k,
-                         # paged-only knob: --compare's padded leg must
-                         # not trip the config validation on it
+                         # paged-only knobs: --compare's padded leg must
+                         # not trip the config validation on them
                          prefill_chunk=args.prefill_chunk if paged
-                         else None)
+                         else None,
+                         shards=args.shards if paged else None)
 
 
 def _make_traffic(args, cfg, *, n, rate, seed):
@@ -201,6 +202,8 @@ def run_engine(model, cfg, args, *, paged, prefix_cache=False,
         mode += "+prefix"
     if spec:
         mode += "+spec"
+    if paged and args.shards and args.shards > 1:
+        mode += f"+mp{args.shards}"
     out = {"mode": mode,
            "preset": args.preset or "toy", "requests": args.requests,
            "rate_req_s": args.rate, "length_dist": args.length_dist,
@@ -392,6 +395,12 @@ def main(argv=None) -> int:
     ap.add_argument("--repeat", type=int, default=0, metavar="N",
                     help="workload = N fixed prompts repeated verbatim "
                          "(the agentic/retry shape trie drafting wants)")
+    ap.add_argument("--shards", type=int, default=None, metavar="N",
+                    help="tensor-parallel shards for the paged engine "
+                         "(ISSUE 16): head-shard the KV pools and run "
+                         "prefill/decode over an N-chip mp mesh (CPU "
+                         "hosts get a virtual mesh via XLA_FLAGS "
+                         "automatically)")
     ap.add_argument("--prefill-chunk", type=int, default=None,
                     help="cap per-step prefill work at [1, N] tokens "
                          "(chunked prefill)")
@@ -418,6 +427,25 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.prefix_len is None:
         args.prefix_len = max(1, args.prompt_cap // 2)
+
+    # --shards needs a multi-device backend. XLA reads XLA_FLAGS at first
+    # BACKEND INIT (not at jax import), so setting it here still works —
+    # only an already-initialized smaller backend is unrecoverable.
+    if args.shards and args.shards > 1 \
+            and not os.environ.get("PADDLE_TPU_EXAMPLE_TPU"):
+        if "--xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count"
+                  f"={max(8, args.shards)}")
+        if len(jax.devices()) < args.shards:
+            print(f"serve_bench: jax initialized with "
+                  f"{len(jax.devices())} device(s); --shards "
+                  f"{args.shards} needs at least that many (set "
+                  f"XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                  f"before the first jax backend use)", file=sys.stderr)
+            return 2
 
     try:
         reports, engine = run_bench(args)

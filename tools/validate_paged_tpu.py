@@ -11,9 +11,14 @@ scalar-prefetched block-table fetch path are checked here on the chip:
   3. serving-shape sweep (gpt3-1.3b geometry: nh=16 hd=128, bf16 pool)
   4. end-to-end: paged engine greedy == generate_static_ragged per row
      (plain AND speculative), zero steady jit cache misses
+  5. ``--shards N`` (ISSUE 16): sharded-parity mode — the SAME traffic
+     through the head-sharded tensor-parallel engine on an N-chip mp
+     mesh and the 1-chip engine; greedy output must be bit-identical,
+     pools must carry the head sharding, steady state must not recompile
 
-Usage: python tools/validate_paged_tpu.py
+Usage: python tools/validate_paged_tpu.py [--shards N]
 """
+import argparse
 import sys
 
 import numpy as np
@@ -187,7 +192,64 @@ def engine_parity():
           f"recompiles={eng.monitor.recompiles}")
 
 
+def sharded_engine_parity(shards):
+    """Sharded-parity mode (ISSUE 16): greedy output bit-identical at
+    shards=1 vs shards=N on the chip mesh, head-sharded pools, zero
+    steady jit cache misses on the sharded engine. The collective
+    inventory itself is proven statically by
+    `tools/graph_lint.py gpt-paged-sharded`; this checks the numerics
+    on real chips."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+    from paddle_tpu.jit.api import compile_cache_misses
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    ndev = len(jax.devices())
+    check(f"--shards {shards}: enough local devices", shards <= ndev,
+          f"({ndev} available)")
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=256, num_layers=2,
+                    num_heads=max(4, shards),  # divisible head count
+                    max_position_embeddings=512,
+                    intermediate_size=512)
+    m = GPTForCausalLM(cfg)
+    m.eval()                     # f32: same numerics-class note as above
+    CAP, NEW = 64, 16
+    lens = [64, 17, 3, 40]
+    rng = np.random.RandomState(1)
+    ids = rng.randint(1, cfg.vocab_size, (len(lens), CAP)).astype(np.int64)
+
+    def serve(s):
+        eng = ServingEngine(m, ServingConfig(
+            max_batch=2, prompt_cap=CAP, max_new_tokens=NEW,
+            decode_chunk=4, paged=True, kv_block=16, shards=s))
+        for i, ln in enumerate(lens):
+            eng.submit(ids[i, :ln])
+        eng.drain()
+        miss0 = compile_cache_misses()
+        for i, ln in enumerate(lens):
+            eng.submit(ids[i, :ln])
+        toks = {tuple(r.prompt.tolist()): list(r.tokens)
+                for r in eng.drain()}
+        return eng, toks, compile_cache_misses() - miss0
+
+    _, one, _ = serve(1)
+    eng, got, miss = serve(shards)
+    check(f"sharded (mp={shards}) greedy == single-chip greedy",
+          one == got)
+    specs = {str(getattr(p.sharding, "spec", None))
+             for layer in eng._pools for p in layer}
+    check("pools carry the mp head sharding",
+          all("'mp'" in s for s in specs), f"specs={sorted(specs)}")
+    check("steady sharded loop: zero jit cache misses", miss == 0,
+          f"recompiles={eng.monitor.recompiles}")
+
+
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", type=int, default=None, metavar="N",
+                    help="also run the sharded-parity suite on an "
+                         "N-chip mp mesh (ISSUE 16)")
+    args = ap.parse_args()
     dev = jax.devices()[0]
     print("device:", dev)
     if dev.platform not in ("tpu", "axon"):
@@ -209,6 +271,8 @@ def main():
                          starts=(16, 0, 7), tol=2e-2)
     engine_parity()
     spec_engine_parity()
+    if args.shards:
+        sharded_engine_parity(args.shards)
     print("all paged serving validations passed")
 
 
