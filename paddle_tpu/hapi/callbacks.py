@@ -300,16 +300,25 @@ class ProfilerCallback(Callback):
     and (when a timeline is attached) LIVE goodput gauges stitched from
     the in-memory recorder on every scrape — no waiting for the segment
     files. Producers unregister at train end; the server's lifecycle
-    (start/close) stays with the caller."""
+    (start/close) stays with the caller.
+    `flightrec`: an obs.FlightRecorder (ISSUE 17) — attached to the
+    monitor for the duration of fit (anomaly rows — recompiles,
+    stragglers, numerics events — pin profiler captures of the next
+    steps) and, when `telemetry` is given, mounted as its /profilez
+    route; detached and unmounted at train end."""
 
     def __init__(self, profiler=None, monitor=None, summary=True,
-                 timeline=None, telemetry=None):
+                 timeline=None, telemetry=None, flightrec=None):
         super().__init__()
         self.profiler = profiler
         self.monitor = monitor
         self.summary = summary
         self.timeline = timeline
         self.telemetry = telemetry
+        self.flightrec = flightrec
+        if flightrec is not None and monitor is None:
+            raise ValueError("flightrec needs a monitor: the recorder "
+                             "advances at the monitor's step brackets")
         self._tl_prev = None
         self._eval_t0 = None
         self._tele_registered = []
@@ -351,6 +360,13 @@ class ProfilerCallback(Callback):
             # installed from the previous cycle — restoring "prev" would
             # then self-reference. Treat that as nothing-to-restore.
             self._tl_prev = None if prev is self.timeline else prev
+        if self.flightrec is not None:
+            if getattr(self.monitor, "flightrec", None) is not \
+                    self.flightrec:     # died-mid-fit idempotence, as
+                self.flightrec.attach(monitor=self.monitor)  # above
+            if self.telemetry is not None:
+                self.telemetry.add_route("/profilez",
+                                         self.flightrec.profilez)
         if self.profiler is not None:
             self.profiler.start()
 
@@ -387,6 +403,10 @@ class ProfilerCallback(Callback):
             for name in self._tele_registered:
                 self.telemetry.registry.unregister(name)
             self._tele_registered = []
+        if self.flightrec is not None:
+            if self.telemetry is not None:
+                self.telemetry.remove_route("/profilez")
+            self.flightrec.detach()
         # restore the timeline FIRST: a profiler.stop() failure must not
         # leak this fit's recorder into the process-wide slot
         if self.timeline is not None:

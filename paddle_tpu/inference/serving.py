@@ -2051,7 +2051,8 @@ class ServingEngine:
 
     def serve_telemetry(self, *, host: str = "127.0.0.1", port: int = 0,
                         slo=None, poll_interval: Optional[float] = None,
-                        registry=None, trace_capacity: int = 256):
+                        registry=None, trace_capacity: int = 256,
+                        flightrec=None):
         """Boot the replica's ops surface: a started obs.TelemetryServer
         wired to this engine — /metrics from `metrics_registry()` (+ the
         SLO monitor's burn gauges when one is passed), /healthz from
@@ -2066,7 +2067,15 @@ class ServingEngine:
         burn-rate cadence: a timer thread drives slo.poll() for the
         server's lifetime, so alerts fire without any external driver
         and the thread shuts down with the server (the r15 NOTE
-        follow-up). The monitor rides `srv.slo` for introspection."""
+        follow-up). The monitor rides `srv.slo` for introspection.
+
+        `flightrec` is an obs.FlightRecorder (ISSUE 17): it attaches to
+        this engine's StepMonitor (captures advance at the engine's
+        device-call brackets), taps the SLO monitor's alert transitions
+        and the metrics' structured rows as capture triggers, exports
+        its counters on /metrics, and mounts the /profilez route. It
+        rides `srv.flightrec`; detaching at shutdown stays with the
+        caller (`flightrec.detach()`)."""
         from ..obs import SLOMonitor, TelemetryServer, TraceBuffer
         if self.metrics.trace_buffer is None:
             self.metrics.trace_buffer = TraceBuffer(trace_capacity)
@@ -2078,10 +2087,21 @@ class ServingEngine:
         elif poll_interval is not None:
             raise ValueError("poll_interval needs an slo monitor/spec "
                              "to poll")
+        routes = {}
+        if flightrec is not None:
+            # monitor: step brackets + straggler/recompile/numerics rows;
+            # metrics: every structured row INCLUDING slo_alert (the SLO
+            # monitor emits through metrics._emit — tapping on_alert too
+            # would double-count each alert on the trigger bus)
+            flightrec.attach(monitor=self.monitor, metrics=self.metrics)
+            reg.register("flightrec", flightrec.metrics_text)
+            routes["/profilez"] = flightrec.profilez
         srv = TelemetryServer(reg, host=host, port=port,
                               health=self.health, status=self.statusz,
-                              tracez=self.metrics.trace_buffer)
+                              tracez=self.metrics.trace_buffer,
+                              routes=routes)
         srv.slo = slo
+        srv.flightrec = flightrec
         if slo is not None and poll_interval is not None:
             srv.add_poller(slo.poll, poll_interval, name="slo")
         return srv.start()

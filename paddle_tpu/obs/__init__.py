@@ -37,6 +37,21 @@ Fleet scope (ISSUE 13) — one replica's surface is not a fleet's:
                      overlap_ratio gauge — plus shard-wall stitching for
                      the StepMonitor straggler gauges (collectives.py).
 
+Flight-recorder scope (ISSUE 17) — alerts that die as JSONL rows can't
+explain a regression:
+
+  FlightRecorder     a bounded ring of profiler captures — periodic
+                     low-duty-cycle background captures plus captures
+                     PINNED by the trigger bus (SLO alerts, straggler
+                     transitions, recompiles, numerics events), with an
+                     eviction policy that never drops pinned evidence
+                     before periodic baseline, a cooldown so an alert
+                     storm yields ONE capture, and the live `/profilez`
+                     route (list captures / render KernelView tables /
+                     download the raw trace) merged fleet-wide like
+                     tracez (flightrec.py; `tools/perf_diff.py` diffs
+                     two captures at kernel granularity).
+
 `ServingEngine.serve_telemetry()` wires all four around a live engine
 (and owns the SLO burn-rate poll cadence via `poll_interval=`);
 `hapi.callbacks.ProfilerCallback(telemetry=...)` exports a TRAINING
@@ -46,16 +61,19 @@ from .collectives import (CollectiveLedger, feed_shard_walls,  # noqa: F401
                           load_shard_walls)
 from .fleet import (FleetAggregator, FleetMergeError,  # noqa: F401
                     bucket_percentile, merge_exposition)
+from .flightrec import (FixtureBackend, FlightRecorder,  # noqa: F401
+                        JaxProfilerBackend)
 from .registry import (ExpositionError, MetricsCollisionError,  # noqa: F401
                        MetricsRegistry, lint_exposition)
-from .server import TelemetryServer  # noqa: F401
+from .server import Raw, TelemetryServer  # noqa: F401
 from .slo import (SLOMonitor, SLOTarget, evaluate_slo,  # noqa: F401
                   format_slo_table, parse_slo)
-from .tracez import TraceBuffer  # noqa: F401
+from .tracez import TraceBuffer, chrome_trace  # noqa: F401
 
 __all__ = ["ExpositionError", "MetricsCollisionError", "MetricsRegistry",
-           "lint_exposition", "TelemetryServer", "SLOMonitor", "SLOTarget",
-           "parse_slo", "evaluate_slo", "format_slo_table", "TraceBuffer",
-           "FleetAggregator", "FleetMergeError", "merge_exposition",
-           "bucket_percentile", "CollectiveLedger", "load_shard_walls",
-           "feed_shard_walls"]
+           "lint_exposition", "TelemetryServer", "Raw", "SLOMonitor",
+           "SLOTarget", "parse_slo", "evaluate_slo", "format_slo_table",
+           "TraceBuffer", "chrome_trace", "FleetAggregator",
+           "FleetMergeError", "merge_exposition", "bucket_percentile",
+           "CollectiveLedger", "load_shard_walls", "feed_shard_walls",
+           "FlightRecorder", "JaxProfilerBackend", "FixtureBackend"]

@@ -55,6 +55,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 from urllib.error import HTTPError
+from urllib.parse import urlencode
 from urllib.request import urlopen
 
 from ..profiler._metrics import (ExpositionError, format_value,
@@ -607,6 +608,57 @@ class FleetAggregator:
                             "per_replica": summaries},
                 "traces": merged}
 
+    def fleet_profilez(self, query: Optional[dict] = None) -> dict:
+        """Member /profilez surfaces merged (ISSUE 17), tracez-style.
+
+        List mode (no `replica` param): every member's capture ring in
+        one list, each capture labeled `replica`, newest first; members
+        without a flight recorder (404) just contribute nothing. Detail
+        mode (`?replica=NAME&id=...&view=...` or `&fmt=raw`): the query
+        is proxied verbatim to that member — the view tables and the
+        raw trace download render on the replica that owns the trace
+        file, so captures never move over the fleet scrape path."""
+        query = dict(query or {})
+        rep_name = query.pop("replica", None)
+        if rep_name is not None:
+            with self._lock:
+                rep = self._replicas.get(rep_name)
+            if rep is None:
+                raise ValueError(f"unknown replica {rep_name!r}")
+            qs = urlencode(query)
+            url = rep.base_url + "/profilez" + (f"?{qs}" if qs else "")
+            body = self._get(url, ok_codes=(400, 404))
+            try:
+                payload = json.loads(body)
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                # non-JSON body: the raw trace download — stream it
+                from .server import Raw
+                return Raw(body, content_type="application/gzip",
+                           filename=f"{rep_name}-"
+                                    f"{query.get('id', 'trace')}"
+                                    ".trace.json.gz")
+            if isinstance(payload, dict) and "error" in payload \
+                    and "captures" not in payload:
+                raise ValueError(f"{rep_name}: {payload['error']}")
+            return dict(payload, replica=rep_name)
+        payloads = self._scrape_route("/profilez", json.loads,
+                                      ok_codes=(404,))
+        merged: List[dict] = []
+        summaries: Dict[str, dict] = {}
+        for name, p in sorted(payloads.items()):
+            if not isinstance(p, dict) or "captures" not in p:
+                continue                # 404 body: no recorder attached
+            summaries[name] = p.get("summary", {})
+            merged.extend(dict(c, replica=name)
+                          for c in p.get("captures", []))
+        merged.sort(key=lambda c: -(c.get("ts") or 0.0))
+        return {"summary": {"replicas": len(self.replica_states()),
+                            "answered": len(payloads),
+                            "with_recorder": len(summaries),
+                            "captures": len(merged),
+                            "per_replica": summaries},
+                "captures": merged}
+
     def fleet_statusz(self, _query: Optional[dict] = None) -> dict:
         return {"replicas": self.replica_states(),
                 "scrapes_total": self.scrapes_total,
@@ -634,6 +686,7 @@ class FleetAggregator:
             health=self.fleet_healthz, status=self.fleet_statusz,
             routes={"/fleet/healthz": self.fleet_healthz,
                     "/fleet/tracez": self.fleet_tracez,
+                    "/fleet/profilez": self.fleet_profilez,
                     "/fleet/statusz": self.fleet_statusz})
         srv.fleet = self
         return srv.start()

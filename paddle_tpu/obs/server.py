@@ -48,10 +48,26 @@ _logger = logging.getLogger("paddle_tpu.obs.server")
 from .registry import MetricsRegistry
 from .tracez import TraceBuffer
 
-__all__ = ["TelemetryServer"]
+__all__ = ["TelemetryServer", "Raw"]
 
 _CONTENT_PROM = "text/plain; version=0.0.4; charset=utf-8"
 _CONTENT_JSON = "application/json; charset=utf-8"
+
+
+class Raw:
+    """A non-JSON payload an extra-route handler may return: raw bytes +
+    content type (+ optional download filename). Lets a route stream a
+    binary artifact — /profilez's trace.json.gz download — through the
+    same dispatch that serves JSON."""
+
+    __slots__ = ("body", "content_type", "filename")
+
+    def __init__(self, body: bytes,
+                 content_type: str = "application/octet-stream",
+                 filename: Optional[str] = None):
+        self.body = bytes(body)
+        self.content_type = content_type
+        self.filename = filename
 
 
 def _json_default(o):
@@ -101,6 +117,18 @@ class _Handler(BaseHTTPRequestHandler):
                     # page on as an aggregator failure
                     self._send_json(400, {"error": str(e)})
                     return
+                if isinstance(payload, Raw):
+                    self.send_response(200)
+                    self.send_header("Content-Type", payload.content_type)
+                    if payload.filename:
+                        self.send_header(
+                            "Content-Disposition",
+                            f'attachment; filename="{payload.filename}"')
+                    self.send_header("Content-Length",
+                                     str(len(payload.body)))
+                    self.end_headers()
+                    self.wfile.write(payload.body)
+                    return
                 self._send_json(200, payload if payload is not None
                                 else {})
             elif route == "/metrics":
@@ -126,6 +154,12 @@ class _Handler(BaseHTTPRequestHandler):
                     limit=int(one("limit", 64)),
                     status=one("status"),
                     order=one("order", "recent"))
+                if one("fmt") == "chrome":
+                    # Perfetto/Chrome trace-event export (ISSUE 17): the
+                    # span trees as a timeline ui.perfetto.dev loads
+                    from .tracez import chrome_trace
+                    self._send_json(200, chrome_trace(traces))
+                    return
                 self._send_json(200, {"summary": srv.tracez.summary(),
                                       "traces": traces})
             else:
@@ -181,6 +215,18 @@ class TelemetryServer:
     @staticmethod
     def _call(fn):
         return fn() if fn is not None else None
+
+    # ------------------------------------------------------------- routes
+    def add_route(self, route: str, fn: Callable) -> "TelemetryServer":
+        """Mount an extra GET route on a live server (same handler
+        contract as the `routes=` ctor arg). Replaces any previous
+        handler at that path."""
+        self.routes["/" + route.strip("/")] = fn
+        return self
+
+    def remove_route(self, route: str) -> "TelemetryServer":
+        self.routes.pop("/" + route.strip("/"), None)
+        return self
 
     @property
     def host(self) -> str:

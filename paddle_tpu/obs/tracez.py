@@ -34,7 +34,67 @@ from typing import List, Optional
 
 from ..profiler._metrics import LogHistogram
 
-__all__ = ["TraceBuffer"]
+__all__ = ["TraceBuffer", "chrome_trace"]
+
+
+def chrome_trace(records) -> dict:
+    """Render request trace records (TraceBuffer.snapshot() /
+    Request.record() dicts) as Chrome trace-event JSON — the format
+    ui.perfetto.dev and chrome://tracing load directly. One process per
+    request (named by trace_id + status), two lanes: `request` carries
+    the root span and the derived queue span, `engine` carries every
+    engine-call window the request rode (prefill/decode/spec_verify
+    chunks). Timestamps are microseconds relative to the earliest
+    enqueue across the batch, so the view opens on a shared timeline."""
+    out = []
+    bases = []
+    for rec in records:
+        t = (rec.get("spans") or {}).get("t_enqueue")
+        if t is not None:
+            bases.append(float(t))
+    t_base = min(bases) if bases else 0.0
+
+    def us(t):
+        return round((float(t) - t_base) * 1e6, 3)
+
+    for i, rec in enumerate(records):
+        pid = i + 1
+        spans = rec.get("spans") or {}
+        label = f"req {rec.get('trace_id') or rec.get('id')} " \
+                f"[{rec.get('status')}]"
+        out.append({"ph": "M", "pid": pid, "name": "process_name",
+                    "args": {"name": label}})
+        out.append({"ph": "M", "pid": pid, "tid": 0,
+                    "name": "thread_name", "args": {"name": "request"}})
+        out.append({"ph": "M", "pid": pid, "tid": 1,
+                    "name": "thread_name", "args": {"name": "engine"}})
+        t_enq = spans.get("t_enqueue")
+        t_adm = spans.get("t_admit")
+        t_fin = spans.get("t_finish")
+        t_tok = spans.get("t_first_token")
+        if t_enq is not None and t_fin is not None:
+            args = {k: rec[k] for k in ("queue_s", "ttft_s", "tpot_s",
+                                        "e2e_s", "reason") if k in rec}
+            out.append({"ph": "X", "pid": pid, "tid": 0,
+                        "name": "request",
+                        "cat": rec.get("status") or "request",
+                        "ts": us(t_enq),
+                        "dur": round((t_fin - t_enq) * 1e6, 3),
+                        "args": args})
+        if t_enq is not None and t_adm is not None:
+            out.append({"ph": "X", "pid": pid, "tid": 0, "name": "queue",
+                        "cat": "queue", "ts": us(t_enq),
+                        "dur": round((t_adm - t_enq) * 1e6, 3)})
+        if t_tok is not None:
+            out.append({"ph": "I", "pid": pid, "tid": 0,
+                        "name": "first_token", "s": "t",
+                        "ts": us(t_tok)})
+        for ev in rec.get("events") or []:
+            name, a, b = ev[0], ev[1], ev[2]
+            out.append({"ph": "X", "pid": pid, "tid": 1, "name": name,
+                        "cat": "engine", "ts": us(a),
+                        "dur": round((b - a) * 1e6, 3)})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 
 class TraceBuffer:

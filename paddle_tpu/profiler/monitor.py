@@ -112,9 +112,17 @@ class StepMonitor:
         self._t0 = None
         self._jit_miss_0 = None
         self._compiled_this_step = 0
+        # anomaly-triggered profiling (ISSUE 17): an attached
+        # obs.FlightRecorder rides the step brackets — its capture state
+        # machine advances at step boundaries, OUTSIDE the timed window
+        # (trace start/stop cost must not pollute step walls)
+        self.flightrec = None
 
     # ------------------------------------------------------------- steps
     def begin_step(self):
+        fr = self.flightrec
+        if fr is not None:
+            fr.begin_step()
         self._jit_miss_0 = _jit_cache_misses()
         self._compiled_this_step = 0
         self._t0 = time.perf_counter()
@@ -124,6 +132,7 @@ class StepMonitor:
         """Close the step opened by begin_step (or record an externally
         timed window via `wall_s`). `steps` > 1 amortizes one fused
         multi-step launch (TrainStep.run_steps) over its step count."""
+        external = wall_s is not None
         if wall_s is None:
             if self._t0 is None:
                 return
@@ -154,7 +163,16 @@ class StepMonitor:
                 rec["hbm_bytes_in_use"] = mem.get("bytes_in_use")
                 rec["hbm_peak_bytes"] = mem.get("peak_bytes_in_use")
         self.records.append(rec)
-        return self._emit(rec)
+        out = self._emit(rec)
+        fr = self.flightrec
+        if fr is not None:
+            fr.end_step()
+            if external:
+                # externally timed launches (TrainStep's wall_s path)
+                # never call begin_step — each end IS the step boundary,
+                # so arm the recorder here for the NEXT launch
+                fr.begin_step()
+        return out
 
     @contextlib.contextmanager
     def step(self, items: Optional[float] = None, steps: int = 1):
@@ -165,14 +183,17 @@ class StepMonitor:
             self.end_step(items=items, steps=steps)
 
     # ----------------------------------------------------------- emission
-    def _emit(self, row: dict, report: bool = True) -> dict:
+    def _emit(self, row: dict, report: bool = True,
+              jsonl: bool = True) -> dict:
         """One emission path for every structured row this monitor
         produces (step records, numerics, overlap, straggler events) —
         JSONL append + the on_report exporter hook stay in lockstep,
         mirroring ServingMetrics._emit. `report=False` keeps a row
         JSONL-only (rows that predate the shared path and whose on_report
-        delivery would change existing consumers' row counts)."""
-        if self.jsonl_path:
+        delivery would change existing consumers' row counts);
+        `jsonl=False` is the inverse, for hook-only rows the JSONL
+        stream's one-row-per-step consumers must not see."""
+        if jsonl and self.jsonl_path:
             with open(self.jsonl_path, "a") as f:
                 f.write(json.dumps(row) + "\n")
         if report and self.on_report is not None:
@@ -204,6 +225,16 @@ class StepMonitor:
                                "recompilation" if count
                                else "refused shape change",
                                kind, self._steps + 1, delta)
+            # structured row (ISSUE 17): recompiles join the on_report
+            # stream like straggler/numerics rows, so the flight
+            # recorder's trigger bus can pin a capture of the steps
+            # around the executable churn. Hook-only: the JSONL file
+            # keeps its one-row-per-step cadence (recompile_events and
+            # the step rows' `compiled` flag already record it there).
+            self._emit({"recompile": {"step": self._steps + 1,
+                                      "kind": kind, "delta": delta,
+                                      "counted": bool(count)},
+                        "ts": time.time()}, jsonl=False)
 
     # ------------------------------------------------------------ overlap
     def record_overlap(self, overlap):

@@ -137,6 +137,13 @@ class SpanRecorder:
         # on the side that makes badput look worse, never better.
         self._flush_every = max(1, int(flush_every))
         self._unflushed = 0
+        # pre-first-span init accounting (ISSUE 17 satellite): install()
+        # stamps an anchor; the first record() materializes the
+        # install->first-span gap as an `other` span when it is big
+        # enough to matter — the report's wall starts at its first span,
+        # so un-anchored build/init time would be silently excluded
+        self._init_anchor: Optional[float] = None
+        self.init_gap_min_s = 0.02
         if path is not None:
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)
@@ -151,6 +158,16 @@ class SpanRecorder:
     def now(self) -> float:
         """Seconds since this recorder's birth (monotonic)."""
         return time.monotonic() - self._mono0
+
+    def anchor_init(self):
+        """Called by install(): remember 'now' so the wall between
+        install and the first recorded span becomes a visible
+        `other`-category init span instead of leaking out of the
+        goodput ledger. A no-op once spans exist (re-installs of a
+        seasoned recorder must not fabricate init time)."""
+        with self._lock:
+            if not self._spans and self._init_anchor is None:
+                self._init_anchor = self.now()
 
     def _write_row(self, row: dict, flush: bool = False):
         if self._f is None:
@@ -177,6 +194,18 @@ class SpanRecorder:
                   meta=meta or None,
                   abs0=self.wall0 + t0, abs1=self.wall0 + t1)
         with self._lock:
+            anchor, self._init_anchor = self._init_anchor, None
+            if anchor is not None and not self._spans \
+                    and t0 - anchor >= self.init_gap_min_s:
+                # materialize the install->first-span gap (see
+                # anchor_init); sub-threshold gaps stay implicit so fast
+                # installs keep recording exactly what they recorded
+                isp = Span("other", anchor, float(t0),
+                           meta={"init": True},
+                           abs0=self.wall0 + anchor,
+                           abs1=self.wall0 + t0)
+                self._spans.append(isp)
+                self._write_row(isp.to_row())
             if len(self._spans) == self._spans.maxlen:
                 self.dropped += 1
             self._spans.append(sp)
@@ -268,10 +297,17 @@ def current() -> Optional[SpanRecorder]:
 
 def install(rec: Optional[SpanRecorder]) -> Optional[SpanRecorder]:
     """Install `rec` as the process-wide recorder; returns the previous
-    one (restore it when done — or use `installed()`)."""
+    one (restore it when done — or use `installed()`). Installing a
+    fresh recorder anchors its init accounting: wall spent between here
+    and its first span lands as an `other` init span (anchor_init)."""
     global _current
     with _current_lock:
         prev, _current = _current, rec
+    if rec is not None:
+        try:
+            rec.anchor_init()
+        except AttributeError:
+            pass                    # duck-typed recorder without anchors
     return prev
 
 

@@ -427,6 +427,114 @@ def analyze(path_or_events, steps: Optional[int] = None,
     return TraceAnalysis(events, steps=steps, window=window)
 
 
+# ------------------------------------------------- kernel-level diffing
+# (ISSUE 17) — the attribution tool behind tools/perf_diff.py: given two
+# captures, name the kernels that got slower.
+
+def kernel_diff(base: "TraceAnalysis", cand: "TraceAnalysis") -> dict:
+    """Kernel-granularity regression attribution between two captures.
+
+    Per op name (union of both captures): per-step device time in each
+    (`a_us`/`b_us` — the `steps` each analysis was built with normalizes
+    unequal capture lengths), the absolute and relative delta, the op's
+    occupancy of its step (`a_pct`/`b_pct`: share of total device time)
+    and a status — `common`, `new` (only in the candidate) or `vanished`
+    (only in the baseline). Collectives additionally diff their EXPOSED
+    time (the wall the step pays). Rows sort by |delta| descending: the
+    top row is where the regression lives."""
+    rows_a = {r["name"]: r for r in base.op_totals()}
+    rows_b = {r["name"]: r for r in cand.op_totals()}
+    div_a = max(base.steps or 1, 1)
+    div_b = max(cand.steps or 1, 1)
+    kernels = []
+    for name in set(rows_a) | set(rows_b):
+        ra, rb = rows_a.get(name), rows_b.get(name)
+        a_us = ra["dur_us"] / div_a if ra else 0.0
+        b_us = rb["dur_us"] / div_b if rb else 0.0
+        kernels.append({
+            "name": name, "category": (rb or ra)["category"],
+            "status": ("common" if ra and rb
+                       else ("new" if rb else "vanished")),
+            "a_us": a_us, "b_us": b_us,
+            "a_calls": ra["calls"] if ra else 0,
+            "b_calls": rb["calls"] if rb else 0,
+            "delta_us": b_us - a_us,
+            "delta_pct": ((b_us - a_us) / a_us * 100.0
+                          if a_us > 0 else None),
+            "a_pct": ra["pct"] if ra else 0.0,
+            "b_pct": rb["pct"] if rb else 0.0})
+    kernels.sort(key=lambda r: (-abs(r["delta_us"]), r["name"]))
+    total_a = base.total_device_us() / div_a
+    total_b = cand.total_device_us() / div_b
+    coll_a = {r["name"]: r for r in base.collective_rows()}
+    coll_b = {r["name"]: r for r in cand.collective_rows()}
+    collectives = []
+    for name in set(coll_a) | set(coll_b):
+        ea = coll_a[name]["exposed_us"] / div_a if name in coll_a else 0.0
+        eb = coll_b[name]["exposed_us"] / div_b if name in coll_b else 0.0
+        collectives.append({
+            "name": name,
+            "a_exposed_us": ea, "b_exposed_us": eb,
+            "delta_us": eb - ea,
+            "delta_pct": ((eb - ea) / ea * 100.0 if ea > 0 else None)})
+    collectives.sort(key=lambda r: (-abs(r["delta_us"]), r["name"]))
+    return {"kernels": kernels, "collectives": collectives,
+            "total": {"a_us": total_a, "b_us": total_b,
+                      "delta_us": total_b - total_a,
+                      "delta_pct": ((total_b - total_a) / total_a * 100.0
+                                    if total_a > 0 else None)}}
+
+
+def diff_regressions(diff: dict, *, regress_pct: float,
+                     min_us: float = 50.0) -> List[dict]:
+    """The kernels a --regress-pct gate fails on: common kernels whose
+    per-step time grew STRICTLY more than `regress_pct` percent, and new
+    kernels that appeared at all — both above the `min_us` noise floor
+    (per-step device time; sub-floor ops jitter across captures). A
+    capture diffed against itself regresses nothing at any threshold."""
+    out = []
+    for r in diff["kernels"]:
+        if r["status"] == "new":
+            if r["b_us"] >= min_us:
+                out.append(dict(r, reason="new kernel"))
+        elif r["status"] == "common":
+            if (r["delta_pct"] is not None
+                    and r["delta_pct"] > regress_pct
+                    and r["delta_us"] >= min_us):
+                out.append(dict(r, reason=(
+                    f"+{r['delta_pct']:.1f}% "
+                    f"(+{r['delta_us'] / 1e3:.3f} ms)")))
+    return out
+
+
+def format_kernel_diff(diff: dict, top: int = 30) -> str:
+    """Human table over kernel_diff()'s rows (perf_diff's stdout)."""
+    lines = ["---- KernelDiff (per-step device time, baseline -> "
+             "candidate) ----",
+             f"{'base ms':>10}  {'cand ms':>10}  {'delta ms':>10}  "
+             f"{'delta%':>8}  {'occ%':>11}  op"]
+    for r in diff["kernels"][:top]:
+        dp = f"{r['delta_pct']:8.1f}" if r["delta_pct"] is not None \
+            else f"{r['status']:>8}"
+        occ = f"{r['a_pct']:5.1f}>{r['b_pct']:5.1f}"
+        lines.append(f"{r['a_us'] / 1e3:10.3f}  {r['b_us'] / 1e3:10.3f}  "
+                     f"{r['delta_us'] / 1e3:10.3f}  {dp}  {occ}  "
+                     f"{r['name'][:70]}")
+    t = diff["total"]
+    tp = f"{t['delta_pct']:+.1f}%" if t["delta_pct"] is not None else "-"
+    lines.append(f"total: {t['a_us'] / 1e3:.3f} -> {t['b_us'] / 1e3:.3f} "
+                 f"ms/step ({tp})")
+    if diff["collectives"]:
+        lines.append("collective exposed-time deltas:")
+        for r in diff["collectives"]:
+            dp = f"{r['delta_pct']:+.1f}%" if r["delta_pct"] is not None \
+                else "new"
+            lines.append(f"  {r['a_exposed_us'] / 1e3:10.3f}  "
+                         f"{r['b_exposed_us'] / 1e3:10.3f}  {dp:>8}  "
+                         f"{r['name'][:70]}")
+    return "\n".join(lines)
+
+
 def summarize(path: str, views=None, steps: Optional[int] = None) -> str:
     """Render the requested views (names or SummaryView members) from the
     newest capture under `path`."""

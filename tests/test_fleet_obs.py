@@ -384,6 +384,25 @@ class TestScrapeTTLCache:
         finally:
             srv.close()
 
+    def test_back_to_back_fleet_scrapes_hit_each_member_once(self):
+        """ISSUE 17 satellite pin: with the default ~1s TTL, TWO
+        back-to-back fleet scrapes cost every member exactly ONE
+        /metrics request — the second page is served from cache."""
+        sa, calls_a = self._counting_server()
+        sb, calls_b = self._counting_server()
+        try:
+            fleet = FleetAggregator({"r0": sa, "r1": sb}, timeout=1.0)
+            assert fleet.cache_ttl == 1.0       # the default guard
+            page1 = fleet.merged_metrics()
+            page2 = fleet.merged_metrics()
+            assert (calls_a[0], calls_b[0]) == (1, 1)
+            assert fleet.scrape_cache_hits_total == 1
+            assert "s_requests_total 20" in page1
+            assert "s_requests_total 20" in page2
+        finally:
+            sa.close()
+            sb.close()
+
     def test_ttl_zero_disables(self):
         srv, calls = self._counting_server()
         try:
@@ -732,6 +751,8 @@ mon = StepMonitor(track_memory=False,
 for i in range(6):
     mon.begin_step()
     step(x).block_until_ready()
+    time.sleep(0.01)                    # floor the step wall so scheduler
+    #                                     jitter stays well under threshold
     if shard == 1 and i >= 2:
         time.sleep(0.08)                # the injected slow shard
     mon.end_step()
@@ -763,13 +784,13 @@ def test_multiprocess_mesh_straggler_event(tmp_path, nshards):
     assert all(set(w) == {"0", "1"} for w in walls.values())
     rows = []
     mon = StepMonitor(track_memory=False, on_report=rows.append,
-                      straggler_threshold=1.5)
+                      straggler_threshold=2.0)
     feed_shard_walls(mon, walls)
     events = [r for r in rows if "straggler" in r]
     assert len(events) == 1, events
     ev = events[0]["straggler"]
     assert ev["slowest_shard"] == "1"
-    assert ev["skew_ratio"] >= 1.5
+    assert ev["skew_ratio"] >= 2.0
     assert mon.straggling and mon.stragglers_total == 1
     text = mon.metrics_text()
     lint_exposition(text)

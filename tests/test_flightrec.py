@@ -1,0 +1,662 @@
+"""Flight recorder (ISSUE 17) — anomaly-triggered profiling invariants.
+
+The contract under test:
+
+  1. RING — capacity is a hard bound under churn; eviction never removes
+     a trigger-pinned capture while a periodic one remains (only an
+     all-pinned ring evicts its oldest pinned entry); evicted captures
+     drop their trace file from disk.
+  2. DEDUP — a trigger while a capture is pending/active COALESCES into
+     it (and pins it); a trigger within the cooldown window of the last
+     trigger-started capture is SUPPRESSED — an alert storm yields ONE
+     capture. The cooldown clock is injected, so the window is exact.
+  3. EVIDENCE — every finished capture appends one structured
+     {"capture"} JSONL row linking trigger kind -> trace path -> the
+     trigger's own row verbatim; a failing backend counts
+     capture_errors and the recorder re-arms.
+  4. BUS — attach() chains onto existing on_report/on_alert/on_record
+     hooks without dropping them, detach() restores; the tap fires on
+     slo_alert/straggler/recompile/numerics-with-events rows and
+     nothing else.
+  5. /profilez — list + KernelView/DeviceView/DistributedView tables
+     byte-identical to trace_analysis on the same file + raw download,
+     direct and over HTTP (bad input -> 400); fleet-merged like tracez.
+  6. SATELLITES — /tracez?fmt=chrome trace-event export, the goodput
+     timeline's install->first-span init anchor, kernel_diff /
+     diff_regressions attribution, and the live-engine run: /profilez
+     concurrent with closed-loop decode at zero post-warmup jit misses.
+"""
+import gzip
+import json
+import os
+import time
+import urllib.error
+from urllib.request import urlopen
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.obs import (FixtureBackend, FlightRecorder, MetricsRegistry,
+                            Raw, TelemetryServer, chrome_trace)
+from paddle_tpu.obs.flightrec import TRIGGER_KEYS
+from paddle_tpu.profiler.monitor import StepMonitor
+from paddle_tpu.profiler.trace_analysis import (analyze, diff_regressions,
+                                                format_kernel_diff,
+                                                kernel_diff)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "mini_step.trace.json.gz")
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+def _rec(tmp_path, **kw):
+    kw.setdefault("backend", FixtureBackend(FIXTURE))
+    kw.setdefault("cooldown_s", 0.0)
+    return FlightRecorder(str(tmp_path / "captures"), **kw)
+
+
+def _steps(rec, n):
+    for _ in range(n):
+        rec.begin_step()
+        rec.end_step()
+
+
+# ------------------------------------------------------------------ ring
+
+class TestRing:
+    def test_capacity_is_a_hard_bound_under_periodic_churn(self, tmp_path):
+        rec = _rec(tmp_path, ring=3, every=1, capture_steps=1)
+        _steps(rec, 10)
+        s = rec.summary()
+        assert s["captures_total"] == 10
+        assert s["retained"] == 3
+        assert s["evicted_periodic"] == 7
+        assert s["evicted_pinned"] == 0
+        # the ring keeps the newest captures
+        assert [c["id"] for c in rec.captures] == ["c0008", "c0009",
+                                                   "c0010"]
+
+    def test_pinned_survives_periodic_eviction(self, tmp_path):
+        rec = _rec(tmp_path, ring=3, every=1, capture_steps=1,
+                   trigger_steps=1)
+        _steps(rec, 2)                      # two periodic captures
+        cid = rec.trigger("slo_alert", {"slo_alert": {"burn": 9.9}})
+        _steps(rec, 8)                      # churn well past capacity
+        ids = [c["id"] for c in rec.captures]
+        assert cid in ids                   # the pinned one never evicted
+        pinned = [c["pinned"] for c in rec.captures]
+        assert sum(pinned) == 1
+        assert rec.evicted_pinned == 0
+        assert rec.evicted_periodic > 0
+
+    def test_all_pinned_ring_still_bounded(self, tmp_path):
+        rec = _rec(tmp_path, ring=2, trigger_steps=1)
+        for i in range(3):
+            rec.trigger("straggler", {"straggler": {"i": i}})
+            _steps(rec, 1)
+        s = rec.summary()
+        assert s["retained"] == 2
+        assert s["retained_pinned"] == 2
+        assert s["evicted_pinned"] == 1     # oldest pinned gave way
+        assert [c["id"] for c in rec.captures] == ["c0002", "c0003"]
+
+    def test_eviction_removes_trace_file(self, tmp_path):
+        rec = _rec(tmp_path, ring=1, every=1, capture_steps=1)
+        _steps(rec, 2)
+        gone = str(tmp_path / "captures" / "c0001.trace.json.gz")
+        kept = str(tmp_path / "captures" / "c0002.trace.json.gz")
+        assert not os.path.exists(gone)
+        assert os.path.exists(kept)
+
+    def test_periodic_cadence_and_validation(self, tmp_path):
+        rec = _rec(tmp_path, ring=8, every=4, capture_steps=2)
+        _steps(rec, 8)
+        # first periodic starts at step 1; next one `every` steps later
+        firsts = [c["step_first"] for c in rec.captures]
+        assert firsts == [1, 5]
+        with pytest.raises(ValueError):
+            _rec(tmp_path, ring=0)
+        with pytest.raises(ValueError):
+            _rec(tmp_path, every=-1)
+
+
+# ----------------------------------------------------------------- dedup
+
+class TestTriggerDedup:
+    def test_cooldown_suppresses_then_reopens(self, tmp_path):
+        clk = FakeClock()
+        rec = _rec(tmp_path, cooldown_s=30.0, trigger_steps=1, clock=clk)
+        assert rec.trigger("slo_alert", {}) == "c0001"
+        _steps(rec, 1)                      # capture finishes
+        clk.t = 10.0                        # inside the window
+        assert rec.trigger("slo_alert", {}) is None
+        assert rec.triggers_suppressed == 1
+        clk.t = 31.0                        # window expired
+        assert rec.trigger("slo_alert", {}) == "c0002"
+        assert rec.summary()["captures_total"] == 1
+
+    def test_storm_coalesces_into_one_capture(self, tmp_path):
+        rec = _rec(tmp_path, cooldown_s=600.0, trigger_steps=2)
+        first = rec.trigger("slo_alert", {"slo_alert": {"t": "e2e"}})
+        # the storm: more alerts before AND during the capture
+        assert rec.trigger("slo_alert", {"slo_alert": {"t": "ttft"}}) \
+            == first
+        rec.begin_step()
+        assert rec.trigger("straggler", {"straggler": {}}) == first
+        rec.end_step()
+        _steps(rec, 2)
+        s = rec.summary()
+        assert s["captures_total"] == 1
+        assert s["triggers_total"] == 3
+        assert s["triggers_coalesced"] == 2
+        cap = rec.captures[0]
+        assert cap["pinned"]
+        assert [t["kind"] for t in cap["triggers"]] \
+            == ["slo_alert", "slo_alert", "straggler"]
+
+    def test_trigger_pins_and_extends_active_periodic(self, tmp_path):
+        rec = _rec(tmp_path, every=100, capture_steps=1, trigger_steps=3)
+        rec.begin_step()                    # periodic capture is active
+        assert rec.captures_total == 0
+        cid = rec.trigger("recompile", {"recompile": {"kind": "train"}})
+        assert cid == "c0001"               # coalesced into the periodic
+        rec.end_step()                      # 1 of 3 steps — extended
+        assert rec.summary()["active"] == cid
+        _steps(rec, 2)
+        cap = rec.captures[0]
+        assert cap["kind"] == "periodic" and cap["pinned"]
+        assert cap["step_last"] - cap["step_first"] + 1 == 3
+
+    def test_tap_key_probe(self, tmp_path):
+        rec = _rec(tmp_path, trigger_steps=1, cooldown_s=0.0)
+        for key in TRIGGER_KEYS:
+            rec.tap({key: {}, "ts": 1.0})
+            _steps(rec, 1)
+        rec.tap({"numerics": {"events": [{"kind": "nan"}]}})
+        _steps(rec, 1)
+        assert rec.triggers_total == 4
+        # inert rows: clears, event-free numerics, plain steps, non-dicts
+        rec.tap({"slo_clear": {}})
+        rec.tap({"straggler_clear": {}})
+        rec.tap({"numerics": {"events": []}})
+        rec.tap({"step": 7, "wall_s": 0.1})
+        rec.tap("not a dict")
+        assert rec.triggers_total == 4
+
+
+# -------------------------------------------------------------- evidence
+
+class TestEvidence:
+    def test_capture_row_links_triggers_own_row(self, tmp_path):
+        path = str(tmp_path / "rows.jsonl")
+        rec = _rec(tmp_path, trigger_steps=1, jsonl_path=path)
+        alert_row = {"slo_alert": {"target": "e2e_p99", "burn_long": 9.0},
+                     "ts": 123.0}
+        rec.trigger("slo_alert", alert_row)
+        _steps(rec, 1)
+        rows = [json.loads(line) for line in open(path)]
+        caps = [r for r in rows if "capture" in r]
+        assert len(caps) == 1
+        meta = caps[0]["capture"]
+        assert meta["pinned"] and meta["kind"] == "trigger"
+        assert os.path.exists(meta["trace_path"])
+        assert meta["triggers"][0]["kind"] == "slo_alert"
+        assert meta["triggers"][0]["row"] == alert_row   # verbatim link
+        assert meta["steps"] == 1
+
+    def test_on_capture_hook_and_meta_shape(self, tmp_path):
+        seen = []
+        rec = _rec(tmp_path, every=1, capture_steps=1)
+        rec.on_capture = seen.append
+        _steps(rec, 2)
+        assert [m["id"] for m in seen] == ["c0001", "c0002"]
+        assert all(m["wall_s"] >= 0 for m in seen)
+
+    def test_failing_backend_counts_and_rearms(self, tmp_path):
+        class Boom:
+            def start(self):
+                pass
+
+            def stop(self, dest):
+                raise RuntimeError("tracer exploded")
+
+        rec = _rec(tmp_path, trigger_steps=1, backend=Boom())
+        rec.trigger("slo_alert", {})
+        _steps(rec, 1)
+        assert rec.capture_errors == 1
+        assert rec.captures[0]["error"].startswith("RuntimeError")
+        # the recorder re-arms: a later trigger captures again
+        rec.backend = FixtureBackend(FIXTURE)
+        rec.trigger("slo_alert", {})
+        _steps(rec, 1)
+        assert rec.captures[-1]["error"] is None
+        assert rec.captures[-1]["trace_path"]
+
+    def test_failing_start_counts_and_clears_active(self, tmp_path):
+        class BoomStart:
+            def start(self):
+                raise RuntimeError("no tracer")
+
+            def stop(self, dest):  # pragma: no cover
+                return None
+
+        rec = _rec(tmp_path, trigger_steps=1, backend=BoomStart())
+        rec.trigger("slo_alert", {})
+        _steps(rec, 1)
+        assert rec.capture_errors == 1
+        assert rec.summary()["active"] is None
+        assert rec.captures_total == 0
+
+    def test_metrics_text_exposes_counters(self, tmp_path):
+        from paddle_tpu.profiler._metrics import parse_exposition
+        rec = _rec(tmp_path, trigger_steps=1, every=1, capture_steps=1)
+        rec.trigger("slo_alert", {})
+        _steps(rec, 3)
+        fams = parse_exposition(rec.metrics_text())
+        pre = "paddle_tpu_flightrec_"
+
+        def val(name):
+            return float(fams[pre + name]["samples"][0][2])
+
+        assert val("captures_total") == 3
+        assert val("captures_pinned_total") == 1
+        assert val("triggers_total") == 1
+        assert val("ring_retained") == 3
+
+
+# ------------------------------------------------------------ trigger bus
+
+class TestAttach:
+    def test_chain_preserves_previous_hooks(self, tmp_path):
+        prev_rows = []
+        mon = StepMonitor(track_memory=False,
+                          on_report=prev_rows.append)
+        rec = _rec(tmp_path, trigger_steps=1)
+        rec.attach(monitor=mon)
+        assert mon.flightrec is rec
+        row = {"straggler": {"ratio": 3.0}, "ts": 1.0}
+        mon.on_report(row)                  # the chained hook
+        assert prev_rows == [row]           # previous hook still ran
+        assert rec.triggers_total == 1
+        rec.detach()
+        assert mon.flightrec is None
+        mon.on_report({"straggler": {}})
+        assert rec.triggers_total == 1      # tap unhooked
+        assert len(prev_rows) == 2          # original hook restored
+
+    def test_second_recorder_rejected(self, tmp_path):
+        mon = StepMonitor(track_memory=False)
+        a = _rec(tmp_path, trigger_steps=1)
+        b = FlightRecorder(str(tmp_path / "b"),
+                           backend=FixtureBackend(FIXTURE))
+        a.attach(monitor=mon)
+        with pytest.raises(ValueError):
+            b.attach(monitor=mon)
+        a.attach(monitor=mon)               # re-attach of self is fine
+        a.detach()
+
+    def test_monitor_steps_drive_recorder(self, tmp_path):
+        mon = StepMonitor(track_memory=False)
+        rec = _rec(tmp_path, trigger_steps=2).attach(monitor=mon)
+        rec.trigger("slo_alert", {})
+        for _ in range(3):
+            mon.begin_step()
+            mon.end_step(items=4)
+        assert rec.captures_total == 1
+        assert rec.captures[0]["step_last"] - \
+            rec.captures[0]["step_first"] + 1 == 2
+        rec.detach()
+
+    def test_externally_timed_steps_drive_recorder(self, tmp_path):
+        # TrainStep's path: end_step(wall_s=...) with NO begin_step —
+        # each external end IS a step boundary and must advance captures
+        mon = StepMonitor(track_memory=False)
+        rec = _rec(tmp_path, trigger_steps=2).attach(monitor=mon)
+        rec.trigger("slo_alert", {})
+        for _ in range(4):
+            mon.end_step(items=4, wall_s=0.01)
+        assert rec.captures_total == 1
+        rec.detach()
+
+    def test_recompile_rows_reach_the_bus(self, tmp_path):
+        mon = StepMonitor(track_memory=False, log_recompiles=False)
+        rec = _rec(tmp_path, trigger_steps=1).attach(monitor=mon)
+        mon.record_compile("train", ((4, 8),))       # first compile
+        assert rec.triggers_total == 0               # not a recompile
+        mon.record_compile("train", ((8, 8),), prev_sig=((4, 8),))
+        assert rec.triggers_total == 1
+        cap_trig = (rec.summary()["pending"] or
+                    rec.summary()["active"])
+        assert cap_trig is not None
+        rec.detach()
+
+    def test_slo_alert_via_metrics_hook(self, tmp_path):
+        # serve_telemetry taps metrics.on_record — SLO alerts flow
+        # through metrics._emit, so the bus sees them without touching
+        # slo.on_alert (no double-tap)
+        from paddle_tpu.inference import ServingMetrics
+        met = ServingMetrics()
+        rec = _rec(tmp_path, trigger_steps=1).attach(metrics=met)
+        met._emit({"slo_alert": {"target": "e2e_p99"}, "ts": 1.0})
+        met._emit({"slo_clear": {"target": "e2e_p99"}, "ts": 2.0})
+        assert rec.triggers_total == 1
+        rec.detach()
+
+
+# -------------------------------------------------------------- /profilez
+
+class TestProfilez:
+    def _captured(self, tmp_path):
+        rec = _rec(tmp_path, trigger_steps=2)
+        rec.trigger("slo_alert", {"slo_alert": {"burn": 5.0}})
+        _steps(rec, 2)
+        return rec
+
+    def test_list_and_views_match_trace_analysis(self, tmp_path):
+        rec = self._captured(tmp_path)
+        listing = rec.profilez({})
+        assert listing["summary"]["captures_total"] == 1
+        cap = listing["captures"][0]
+        assert cap["pinned"] and cap["steps"] == 2
+        an = analyze(cap["trace_path"], steps=2)
+        for view, table in (("kernel", an.kernel_view()),
+                            ("device", an.device_view()),
+                            ("distributed", an.distributed_view())):
+            p = rec.profilez({"id": cap["id"], "view": view})
+            assert p["table"] == table      # byte-identical render
+            assert p["rows"]
+            assert p["total_device_us"] == an.total_device_us()
+
+    def test_raw_download_and_errors(self, tmp_path):
+        rec = self._captured(tmp_path)
+        cap = rec.profilez({})["captures"][0]
+        raw = rec.profilez({"id": cap["id"], "fmt": "raw"})
+        assert isinstance(raw, Raw)
+        with open(cap["trace_path"], "rb") as f:
+            assert raw.body == f.read()
+        with pytest.raises(ValueError):
+            rec.profilez({"id": "c9999"})
+        with pytest.raises(ValueError):
+            rec.profilez({"id": cap["id"], "view": "bogus"})
+        os.remove(cap["trace_path"])
+        with pytest.raises(ValueError):
+            rec.profilez({"id": cap["id"], "view": "kernel"})
+
+    def test_over_http(self, tmp_path):
+        rec = self._captured(tmp_path)
+        srv = TelemetryServer(MetricsRegistry(),
+                              routes={"/profilez": rec.profilez}).start()
+        try:
+            listing = json.loads(urlopen(srv.url("/profilez"),
+                                         timeout=5).read())
+            cap = listing["captures"][0]
+            p = json.loads(urlopen(
+                srv.url(f"/profilez?id={cap['id']}&view=kernel"),
+                timeout=5).read())
+            assert p["table"] == analyze(cap["trace_path"],
+                                         steps=2).kernel_view()
+            resp = urlopen(srv.url(f"/profilez?id={cap['id']}&fmt=raw"),
+                           timeout=5)
+            assert resp.headers["Content-Type"] == "application/gzip"
+            assert "attachment" in resp.headers["Content-Disposition"]
+            with open(cap["trace_path"], "rb") as f:
+                assert resp.read() == f.read()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urlopen(srv.url("/profilez?id=c9999"), timeout=5)
+            assert ei.value.code == 400
+        finally:
+            srv.close()
+
+
+class TestFleetProfilez:
+    def _member(self, tmp_path, name):
+        rec = _rec(tmp_path / name, trigger_steps=1)
+        rec.trigger("slo_alert", {"slo_alert": {"replica": name}})
+        _steps(rec, 1)
+        srv = TelemetryServer(MetricsRegistry(),
+                              routes={"/profilez": rec.profilez}).start()
+        return rec, srv
+
+    def test_fleet_merge_and_detail_proxy(self, tmp_path):
+        from paddle_tpu.obs import FleetAggregator
+        ra, sa = self._member(tmp_path, "r0")
+        rb, sb = self._member(tmp_path, "r1")
+        bare = TelemetryServer(MetricsRegistry()).start()  # no recorder
+        try:
+            fleet = FleetAggregator({"r0": sa, "r1": sb, "r2": bare},
+                                    timeout=2.0, cache_ttl=0.0)
+            merged = fleet.fleet_profilez({})
+            assert merged["summary"]["with_recorder"] == 2
+            assert {c["replica"] for c in merged["captures"]} \
+                == {"r0", "r1"}
+            # detail mode proxies the member's own handler verbatim
+            cap = next(c for c in merged["captures"]
+                       if c["replica"] == "r0")
+            detail = fleet.fleet_profilez({"replica": "r0",
+                                           "id": cap["id"],
+                                           "view": "kernel"})
+            assert detail["replica"] == "r0"
+            assert detail["table"] == analyze(
+                cap["trace_path"], steps=1).kernel_view()
+            raw = fleet.fleet_profilez({"replica": "r0",
+                                        "id": cap["id"], "fmt": "raw"})
+            assert isinstance(raw, Raw)
+            with pytest.raises(ValueError):
+                fleet.fleet_profilez({"replica": "r0", "id": "c9999"})
+        finally:
+            sa.close(), sb.close(), bare.close()
+
+
+# ------------------------------------------------------- chrome export
+
+class TestChromeTrace:
+    REC = {"trace_id": "t-1", "status": "done", "reason": None,
+           "queue_s": 0.01, "ttft_s": 0.5, "tpot_s": 0.05, "e2e_s": 1.0,
+           "spans": {"t_enqueue": 100.0, "t_admit": 100.01,
+                     "t_first_token": 100.5, "t_finish": 101.0},
+           "events": [["prefill", 100.01, 100.4],
+                      ["decode", 100.4, 101.0]]}
+
+    def test_event_structure(self):
+        doc = chrome_trace([self.REC])
+        evs = doc["traceEvents"]
+        names = [(e["ph"], e.get("name")) for e in evs]
+        assert ("M", "process_name") in names
+        req = next(e for e in evs if e["ph"] == "X"
+                   and e["name"] == "request")
+        assert req["ts"] == 0.0             # relative to min enqueue
+        assert req["dur"] == pytest.approx(1e6)
+        assert req["args"]["e2e_s"] == 1.0
+        queue = next(e for e in evs if e["name"] == "queue")
+        assert queue["dur"] == pytest.approx(1e4)
+        assert any(e["ph"] == "I" and e["name"] == "first_token"
+                   for e in evs)
+        lanes = {e["name"]: e["tid"] for e in evs if e["ph"] == "X"}
+        assert lanes["request"] == 0 and lanes["prefill"] == 1
+        assert doc["displayTimeUnit"] == "ms"
+
+    def test_shared_timebase_across_requests(self):
+        rec2 = dict(self.REC, trace_id="t-2",
+                    spans=dict(self.REC["spans"], t_enqueue=99.0,
+                               t_finish=100.2))
+        evs = chrome_trace([self.REC, rec2])["traceEvents"]
+        reqs = {e["pid"]: e for e in evs
+                if e["ph"] == "X" and e["name"] == "request"}
+        assert reqs[2]["ts"] == 0.0         # earliest enqueue is t=0
+        assert reqs[1]["ts"] == pytest.approx(1e6)
+
+    def test_tracez_fmt_chrome_over_http(self):
+        from paddle_tpu.obs import TraceBuffer
+        buf = TraceBuffer(capacity=8)
+        buf.add(self.REC)
+        srv = TelemetryServer(MetricsRegistry(), tracez=buf).start()
+        try:
+            doc = json.loads(urlopen(srv.url("/tracez?fmt=chrome"),
+                                     timeout=5).read())
+            assert any(e.get("name") == "request"
+                       for e in doc["traceEvents"])
+        finally:
+            srv.close()
+
+
+# ----------------------------------------------------- timeline anchor
+
+class TestInitAnchor:
+    def test_slow_install_materializes_init_span(self, tmp_path):
+        from paddle_tpu.profiler import timeline as tl
+        from paddle_tpu.profiler.goodput import report_from
+        p = str(tmp_path / "seg.timeline.jsonl")
+        rec = tl.SpanRecorder(p)
+        rec.init_gap_min_s = 0.01
+        tl.install(rec)
+        try:
+            time.sleep(0.03)                # "model build" time
+            t1 = rec.now()
+            rec.record("step", t1 - 0.005, t1, step=1)
+        finally:
+            tl.install(None)
+        rec.close()
+        spans = list(rec._spans)
+        assert spans[0].cat == "other" and spans[0].meta.get("init")
+        assert spans[0].t1 == spans[1].t0   # seam, no gap, no overlap
+        rep = report_from(p)
+        rep.check_conservation()            # init time inside the ledger
+        assert rep.category_s["other"] >= 0.01
+
+    def test_fast_install_adds_nothing(self, tmp_path):
+        from paddle_tpu.profiler import timeline as tl
+        rec = tl.SpanRecorder()
+        tl.install(rec)
+        try:
+            t1 = rec.now()
+            rec.record("step", max(0.0, t1 - 0.001), t1, step=1)
+        finally:
+            tl.install(None)
+        assert len(rec._spans) == 1         # below the init threshold
+
+    def test_seasoned_recorder_reinstall_is_noop(self, tmp_path):
+        from paddle_tpu.profiler import timeline as tl
+        rec = tl.SpanRecorder()
+        rec.init_gap_min_s = 0.0
+        t = rec.now()
+        rec.record("step", t, t + 0.001)
+        rec.anchor_init()                   # re-install after spans
+        time.sleep(0.02)
+        rec.record("step", t + 0.001, t + 0.002)
+        assert len(rec._spans) == 2         # no fabricated init span
+
+
+# ------------------------------------------------------- kernel diffing
+
+class TestKernelDiff:
+    def _doctor(self, tmp_path, mutate):
+        with gzip.open(FIXTURE, "rt") as f:
+            data = json.load(f)
+        mutate(data["traceEvents"])
+        p = str(tmp_path / "doctored.trace.json.gz")
+        with gzip.open(p, "wt") as f:
+            json.dump(data, f)
+        return p
+
+    def test_self_diff_is_all_zero(self):
+        an = analyze(FIXTURE, steps=1)
+        diff = kernel_diff(an, an)
+        assert diff["total"]["delta_us"] == 0
+        assert all(r["status"] == "common" and r["delta_us"] == 0
+                   for r in diff["kernels"])
+        assert diff_regressions(diff, regress_pct=0.0) == []
+        assert "KernelDiff" in format_kernel_diff(diff)
+
+    def test_slowdown_attributed_to_the_kernel(self, tmp_path):
+        def slow(evs):
+            for e in evs:
+                if e.get("ph") == "X" and e.get("name") == "fusion.1":
+                    e["dur"] *= 2
+
+        b = analyze(self._doctor(tmp_path, slow), steps=1)
+        a = analyze(FIXTURE, steps=1)
+        diff = kernel_diff(a, b)
+        top = diff["kernels"][0]               # sorted by |delta|
+        assert top["name"] == "fusion.1"
+        assert top["delta_pct"] == pytest.approx(100.0)
+        regs = diff_regressions(diff, regress_pct=50.0)
+        assert [r["name"] for r in regs] == ["fusion.1"]
+        # the gate is strict: exactly-at-threshold does not fire
+        assert diff_regressions(diff, regress_pct=100.0) == []
+
+    def test_new_and_vanished_kernels(self, tmp_path):
+        def rename(evs):
+            for e in evs:
+                if e.get("ph") == "X" and e.get("name") == "copy.4":
+                    e["name"] = "copy.5"
+
+        b = analyze(self._doctor(tmp_path, rename), steps=1)
+        diff = kernel_diff(analyze(FIXTURE, steps=1), b)
+        status = {r["name"]: r["status"] for r in diff["kernels"]}
+        assert status["copy.4"] == "vanished"
+        assert status["copy.5"] == "new"
+        regs = diff_regressions(diff, regress_pct=5.0)
+        assert any(r["name"] == "copy.5" and r["reason"] == "new kernel"
+                   for r in regs)
+
+    def test_min_us_noise_floor(self, tmp_path):
+        def nudge(evs):
+            for e in evs:
+                if e.get("ph") == "X" and e.get("name") == "copy.4":
+                    e["dur"] += 20          # +20us = +20%, tiny in us
+        b = analyze(self._doctor(tmp_path, nudge), steps=1)
+        diff = kernel_diff(analyze(FIXTURE, steps=1), b)
+        assert diff_regressions(diff, regress_pct=5.0, min_us=50.0) == []
+        assert [r["name"] for r in
+                diff_regressions(diff, regress_pct=5.0, min_us=10.0)] \
+            == ["copy.4"]
+
+
+# -------------------------------------------------- live-engine closure
+
+class TestEngineIntegration:
+    def test_profilez_concurrent_with_decode_zero_misses(self, tmp_path):
+        from paddle_tpu.inference import ServingConfig, ServingEngine
+        from paddle_tpu.jit.api import compile_cache_misses
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=2, max_position_embeddings=32,
+                        intermediate_size=64)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        engine = ServingEngine(model, ServingConfig(
+            max_batch=2, prompt_cap=8, max_new_tokens=4, decode_chunk=2))
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, 64, (5,)).astype(np.int64)
+                   for _ in range(4)]
+        for p in prompts[:2]:
+            engine.submit(p)
+        engine.drain()
+
+        rec = _rec(tmp_path, trigger_steps=2, cooldown_s=600.0)
+        miss0 = compile_cache_misses()
+        srv = engine.serve_telemetry(flightrec=rec)
+        try:
+            rec.trigger("slo_alert", {"slo_alert": {"injected": True}})
+            for p in prompts:
+                engine.submit(p)
+            listing = json.loads(urlopen(srv.url("/profilez"),
+                                         timeout=5).read())
+            assert "captures" in listing    # live during decode
+            engine.drain()
+            assert compile_cache_misses() == miss0
+            assert rec.captures_total == 1
+            assert rec.captures[0]["pinned"]
+            page = urlopen(srv.url("/metrics"), timeout=5).read().decode()
+            assert "paddle_tpu_flightrec_captures_total 1" in page
+        finally:
+            srv.close()

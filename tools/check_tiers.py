@@ -76,7 +76,9 @@ def check(records, *, budget: float, slow_threshold: float,
           shardlint_seconds: float = None,
           shardlint_budget: float = 60.0,
           sharded_serve_seconds: float = None,
-          sharded_serve_budget: float = 90.0) -> dict:
+          sharded_serve_budget: float = 90.0,
+          flightrec_seconds: float = None,
+          flightrec_budget: float = 60.0) -> dict:
     unmarked_slow = []       # should carry `slow` but don't
     tier1 = []               # everything tier-1 actually collects
     for r in records:
@@ -133,6 +135,12 @@ def check(records, *, budget: float, slow_threshold: float,
     # the host mesh must stay a small fraction of the tier cap
     sharded_serve_over = (sharded_serve_seconds is not None
                          and sharded_serve_seconds > sharded_serve_budget)
+    # the flightrec budget line: tools/flightrec_smoke.py boots a toy
+    # engine with the flight recorder attached (ISSUE 17) — the injected
+    # SLO breach, one /profilez round-trip and two perf_diff subprocess
+    # gates must stay a small fraction of the tier cap
+    flightrec_over = (flightrec_seconds is not None
+                      and flightrec_seconds > flightrec_budget)
     return {
         "n_records": len(records),
         "n_tier1": len(tier1),
@@ -164,6 +172,9 @@ def check(records, *, budget: float, slow_threshold: float,
         "sharded_serve_seconds": sharded_serve_seconds,
         "sharded_serve_budget_s": sharded_serve_budget,
         "sharded_serve_over_budget": sharded_serve_over,
+        "flightrec_seconds": flightrec_seconds,
+        "flightrec_budget_s": flightrec_budget,
+        "flightrec_over_budget": flightrec_over,
         "unmarked_slow": sorted(unmarked_slow,
                                 key=lambda r: -r["duration"]),
         "slowest_tier1": sorted(tier1, key=lambda r: -r["duration"])[:10],
@@ -171,7 +182,7 @@ def check(records, *, budget: float, slow_threshold: float,
                and not lint_over and not chaos_over and not goodput_over
                and not obs_over and not fleet_over
                and not fleet_chaos_over and not shardlint_over
-               and not sharded_serve_over),
+               and not sharded_serve_over and not flightrec_over),
     }
 
 
@@ -232,6 +243,13 @@ def main(argv=None) -> int:
                     help="max seconds the sharded serving lint leg may "
                          "take on tier-1 (4-shard toy engine on the "
                          "host mesh)")
+    ap.add_argument("--flightrec-seconds", type=float, default=None,
+                    help="measured wall time of the tier-1 flight-"
+                         "recorder smoke (tools/run_tier1.sh records "
+                         "it)")
+    ap.add_argument("--flightrec-budget", type=float, default=60.0,
+                    help="max seconds the flight-recorder smoke may "
+                         "take on tier-1")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -256,7 +274,9 @@ def main(argv=None) -> int:
                    shardlint_seconds=args.shardlint_seconds,
                    shardlint_budget=args.shardlint_budget,
                    sharded_serve_seconds=args.sharded_serve_seconds,
-                   sharded_serve_budget=args.sharded_serve_budget)
+                   sharded_serve_budget=args.sharded_serve_budget,
+                   flightrec_seconds=args.flightrec_seconds,
+                   flightrec_budget=args.flightrec_budget)
 
     if args.json:
         print(json.dumps(result, indent=2))
@@ -289,6 +309,9 @@ def main(argv=None) -> int:
             print(f"  sharded-serve: "
                   f"{result['sharded_serve_seconds']:.2f}s "
                   f"(budget {result['sharded_serve_budget_s']}s)")
+        if result.get("flightrec_seconds") is not None:
+            print(f"  flightrec: {result['flightrec_seconds']:.2f}s "
+                  f"(budget {result['flightrec_budget_s']}s)")
         if result["chaos_over_budget"]:
             print(f"  VIOLATION: chaos gate took "
                   f"{result['chaos_seconds']:.2f}s, over the "
@@ -319,6 +342,10 @@ def main(argv=None) -> int:
                   f"{result['sharded_serve_seconds']:.2f}s, over the "
                   f"{result['sharded_serve_budget_s']}s sharded-serve "
                   f"budget")
+        if result["flightrec_over_budget"]:
+            print(f"  VIOLATION: flight-recorder smoke took "
+                  f"{result['flightrec_seconds']:.2f}s, over the "
+                  f"{result['flightrec_budget_s']}s flightrec budget")
         if result["lint_over_budget"]:
             print(f"  VIOLATION: lint pass took "
                   f"{result['lint_seconds']:.2f}s, over the "

@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""Flight-recorder smoke (ISSUE 17) — the tier-1 gate for anomaly-
+triggered profiling, end-to-end on a live toy engine:
+
+  1. a ServingEngine decodes closed-loop with a FlightRecorder attached
+     via serve_telemetry(flightrec=...) while a scraper thread hits
+     /profilez concurrently — zero post-warmup jit cache misses (the
+     r15 scrape invariant extends to profiling: the recorder only flips
+     host-side state at step boundaries);
+  2. an INJECTED SLO breach (unmeetable e2e/ttft targets over real
+     traffic) fires burn-rate alerts on the trigger bus -> exactly ONE
+     trigger-pinned capture (the multi-target alert storm coalesces),
+     discoverable via /profilez, whose KernelView table is byte-
+     identical to what trace_analysis renders from the same trace file,
+     and whose raw trace.json.gz downloads intact;
+  3. /tracez?fmt=chrome renders the retained request span trees as
+     loadable trace-event JSON;
+  4. tools/perf_diff.py gates the checked-in mini_step fixture against
+     itself at 0%% (exit 0) and catches a planted 2x kernel slowdown
+     (names the kernel, exit 1).
+
+The capture backend is the mini_step fixture (a CPU jax capture has no
+device lanes — the analysis path is what this smoke pins; the real
+JaxProfilerBackend is exercised for liveness by unit tests).
+
+Exit 0 = all gates hold; 1 = any violation (named on stderr).
+
+    PYTHONPATH=. python tools/flightrec_smoke.py [--batches 6] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import numpy as np  # noqa: E402
+
+FIXTURE = os.path.join(ROOT, "tests", "fixtures",
+                       "mini_step.trace.json.gz")
+
+
+class ProfilezScraper(threading.Thread):
+    """Hammer /profilez (list mode) while decode runs."""
+
+    def __init__(self, srv, interval: float = 0.05):
+        super().__init__(name="flightrec-smoke-scraper", daemon=True)
+        self.srv = srv
+        self.interval = interval
+        self.stop = threading.Event()
+        self.scrapes = 0
+        self.errors = []
+
+    def run(self):
+        from urllib.request import urlopen
+        while not self.stop.is_set():
+            try:
+                p = json.loads(urlopen(self.srv.url("/profilez"),
+                                       timeout=5).read())
+                if "summary" not in p or "captures" not in p:
+                    raise AssertionError("/profilez missing keys")
+                self.scrapes += 1
+            except Exception as e:          # noqa: BLE001 — the gate
+                self.errors.append(f"{type(e).__name__}: {e}")
+                return
+            if self.stop.wait(timeout=self.interval):
+                return
+
+
+def run_block(engine, prompts, batches):
+    B = engine.config.max_batch
+    for b in range(batches):
+        for i in range(B):
+            engine.submit(prompts[(b * B + i) % len(prompts)])
+        engine.drain()
+
+
+def perf_diff_legs(failures):
+    """Leg 4: the CLI gate on the checked-in fixture."""
+    base = [sys.executable, os.path.join(ROOT, "tools", "perf_diff.py")]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(base + [FIXTURE, FIXTURE, "--steps", "1",
+                               "--regress-pct", "0"],
+                       capture_output=True, text=True, env=env)
+    if r.returncode != 0:
+        failures.append(f"perf_diff self-diff exited {r.returncode} "
+                        f"(want 0): {r.stderr.strip()[:200]}")
+    if "+0.0%" not in r.stdout and "0.000" not in r.stdout:
+        failures.append("perf_diff self-diff did not report 0% deltas")
+
+    with gzip.open(FIXTURE, "rt") as f:
+        data = json.load(f)
+    slowed = None
+    for e in data["traceEvents"]:
+        if e.get("ph") == "X" and e.get("name") == "fusion.1":
+            e["dur"] = e["dur"] * 2
+            slowed = e["name"]
+    doctored = os.path.join(tempfile.mkdtemp(prefix="flightrec-smoke-"),
+                            "doctored.trace.json.gz")
+    with gzip.open(doctored, "wt") as f:
+        json.dump(data, f)
+    r = subprocess.run(base + [FIXTURE, doctored, "--steps", "1",
+                               "--regress-pct", "5"],
+                       capture_output=True, text=True, env=env)
+    if r.returncode != 1:
+        failures.append(f"perf_diff vs 2x-doctored trace exited "
+                        f"{r.returncode} (want 1)")
+    if slowed not in r.stderr:
+        failures.append(f"perf_diff did not name the slowed kernel "
+                        f"{slowed!r}: {r.stderr.strip()[:200]}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--batches", type=int, default=6,
+                    help="micro-batches per traffic block")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+    from paddle_tpu.jit.api import compile_cache_misses
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.obs import FixtureBackend, FlightRecorder, SLOMonitor
+    from paddle_tpu.profiler.trace_analysis import analyze
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    intermediate_size=128)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, prompt_cap=12, max_new_tokens=8, decode_chunk=4))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           (int(rng.randint(3, 13)),)).astype(np.int64)
+               for _ in range(16)]
+    for p in prompts[:2]:                   # warmup executable set
+        engine.submit(p)
+    engine.drain()
+
+    failures = []
+    workdir = tempfile.mkdtemp(prefix="flightrec-smoke-")
+    rec = FlightRecorder(
+        os.path.join(workdir, "captures"), ring=4, every=0,
+        trigger_steps=2, cooldown_s=600.0,
+        backend=FixtureBackend(FIXTURE),
+        jsonl_path=os.path.join(workdir, "rows.jsonl"))
+    # two unmeetable targets: real traffic breaches BOTH -> an alert
+    # storm on the trigger bus that must still yield ONE capture
+    slo = SLOMonitor("e2e_p99=1ms,ttft_p99=1ms", engine.metrics,
+                     long_s=60.0, short_s=5.0, burn_threshold=1.0)
+    miss0 = compile_cache_misses()
+    srv = engine.serve_telemetry(slo=slo, flightrec=rec)
+    scraper = ProfilezScraper(srv)
+    scraper.start()
+    try:
+        slo.poll()                          # baseline snapshot
+        run_block(engine, prompts, args.batches)
+        slo.poll()                          # breach -> alert transition
+        if not slo.breaching:
+            failures.append("injected SLO breach did not register "
+                            "(targets should be unmeetable)")
+        run_block(engine, prompts, args.batches)  # capture these steps
+        slo.poll()
+    finally:
+        scraper.stop.set()
+        scraper.join(timeout=5)
+    if scraper.errors:
+        failures.append(f"/profilez scrape failed concurrently with "
+                        f"decode: {scraper.errors[0]}")
+    if scraper.scrapes < 1:
+        failures.append("scraper completed zero /profilez passes")
+
+    dm = compile_cache_misses() - miss0
+    if dm:
+        failures.append(f"{dm} jit cache misses post-warmup with the "
+                        f"flight recorder attached (must be 0)")
+
+    # exactly ONE trigger-pinned capture from the alert storm
+    s = rec.summary()
+    pinned = [c for c in rec.profilez({})["captures"] if c["pinned"]]
+    if s["captures_total"] != 1 or len(pinned) != 1:
+        failures.append(f"want exactly 1 pinned capture, got "
+                        f"{s['captures_total']} total / {len(pinned)} "
+                        f"pinned (triggers={s['triggers_total']}, "
+                        f"coalesced={s['triggers_coalesced']}, "
+                        f"suppressed={s['triggers_suppressed']})")
+    if s["triggers_total"] < 2:
+        failures.append(f"expected an alert storm (>=2 triggers), got "
+                        f"{s['triggers_total']}")
+
+    kernel_match = False
+    if pinned:
+        cap = pinned[0]
+        if not any(t["kind"] == "slo_alert" for t in cap["triggers"]):
+            failures.append(f"pinned capture's triggers carry no "
+                            f"slo_alert: {cap['triggers']}")
+        from urllib.request import urlopen
+        listing = json.loads(urlopen(srv.url("/profilez"),
+                                     timeout=5).read())
+        if not any(c["id"] == cap["id"] and c["pinned"]
+                   for c in listing["captures"]):
+            failures.append("pinned capture not discoverable via "
+                            "/profilez")
+        view = json.loads(urlopen(
+            srv.url(f"/profilez?id={cap['id']}&view=kernel"),
+            timeout=5).read())
+        local = analyze(cap["trace_path"], steps=cap["steps"])
+        if view.get("table") == local.kernel_view():
+            kernel_match = True
+        else:
+            failures.append("/profilez KernelView differs from "
+                            "trace_analysis on the same trace file")
+        raw = urlopen(srv.url(f"/profilez?id={cap['id']}&fmt=raw"),
+                      timeout=5).read()
+        with open(cap["trace_path"], "rb") as f:
+            if raw != f.read():
+                failures.append("raw trace download differs from the "
+                                "capture's file")
+        rows = [json.loads(line) for line in
+                open(os.path.join(workdir, "rows.jsonl"))]
+        cap_rows = [r for r in rows if "capture" in r]
+        if len(cap_rows) != 1 or not any(
+                t.get("row", {}).get("slo_alert") is not None
+                for t in cap_rows[0]["capture"]["triggers"]):
+            failures.append("capture JSONL row missing or not linked "
+                            "to the alert's own row")
+
+    # chrome-trace export of the request timeline
+    from urllib.request import urlopen
+    chrome = json.loads(urlopen(srv.url("/tracez?fmt=chrome&limit=8"),
+                                timeout=5).read())
+    evs = chrome.get("traceEvents", [])
+    if not any(e.get("ph") == "X" and e.get("name") == "request"
+               for e in evs):
+        failures.append("/tracez?fmt=chrome carries no request slices")
+
+    srv.close()
+    perf_diff_legs(failures)
+
+    out = {"profilez_scrapes": scraper.scrapes,
+           "post_warmup_jit_misses": dm,
+           "slo_alerts": slo.alerts_total,
+           "triggers": s["triggers_total"],
+           "coalesced": s["triggers_coalesced"],
+           "suppressed": s["triggers_suppressed"],
+           "captures_total": s["captures_total"],
+           "pinned": len(pinned),
+           "kernelview_matches": kernel_match,
+           "chrome_events": len(evs),
+           "ok": not failures, "failures": failures}
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        print(f"flightrec_smoke: {out['slo_alerts']} SLO alerts -> "
+              f"{out['triggers']} triggers -> {out['captures_total']} "
+              f"capture(s) ({out['pinned']} pinned), "
+              f"{out['profilez_scrapes']} concurrent /profilez passes, "
+              f"jit misses {dm}")
+        print(f"flightrec_smoke: KernelView match={kernel_match}, "
+              f"chrome export {out['chrome_events']} events, "
+              f"perf_diff gates exercised")
+    for f in failures:
+        print(f"flightrec_smoke: VIOLATION: {f}", file=sys.stderr)
+    if not failures:
+        print("flightrec_smoke: OK")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

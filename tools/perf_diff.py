@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""perf_diff — kernel-level regression attribution between two captures.
+
+The flight recorder (paddle_tpu.obs.flightrec) answers "what did the
+anomaly's steps look like"; this CLI answers the follow-up the vision
+hot-path and comm-overlap roadmap items are blocked on: WHICH kernels
+got slower between two captures. Inputs are trace files, directories of
+captures (newest trace wins — a flight-recorder dir or a BENCH
+revision's profile dir work as-is), and the output is a per-op table:
+
+  - per-op Δ device time (per step when --steps-* is given, so captures
+    of different lengths compare)
+  - Δ occupancy of the step (the op's share of total device time)
+  - new / vanished kernels (a fusion that split is a new+vanished pair)
+  - per-collective EXPOSED-time deltas (the wall the step pays)
+
+`--regress-pct P` turns the report into a gate: exit 1 naming every
+common kernel whose per-step time grew more than P percent (and every
+new kernel) above the `--min-us` noise floor. A capture diffed against
+itself reports 0% everywhere and exits 0 at any threshold.
+
+    python tools/perf_diff.py BASELINE CANDIDATE [--steps N]
+        [--steps-a N] [--steps-b N] [--regress-pct 5] [--min-us 50]
+        [--top 30] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("baseline", help="trace file or directory of "
+                    "captures (newest *.trace.json[.gz] wins)")
+    ap.add_argument("candidate", help="trace file or directory")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="steps in BOTH captures (normalizes totals to "
+                         "per-step figures)")
+    ap.add_argument("--steps-a", type=int, default=None,
+                    help="steps in the baseline capture")
+    ap.add_argument("--steps-b", type=int, default=None,
+                    help="steps in the candidate capture")
+    ap.add_argument("--regress-pct", type=float, default=None,
+                    help="gate: exit 1 when any common kernel's "
+                         "per-step time grew MORE than this percent "
+                         "(or a new kernel appeared) above --min-us")
+    ap.add_argument("--min-us", type=float, default=50.0,
+                    help="noise floor for the gate: per-step device "
+                         "microseconds below which deltas/new kernels "
+                         "are ignored (default 50)")
+    ap.add_argument("--top", type=int, default=30,
+                    help="kernel rows to print (default 30)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full diff as JSON instead of tables")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.profiler.trace_analysis import (analyze,
+                                                    diff_regressions,
+                                                    format_kernel_diff,
+                                                    kernel_diff)
+    an_a = analyze(args.baseline,
+                   steps=args.steps_a if args.steps_a is not None
+                   else args.steps)
+    an_b = analyze(args.candidate,
+                   steps=args.steps_b if args.steps_b is not None
+                   else args.steps)
+    if not an_a.device_events or not an_b.device_events:
+        print("perf_diff: a capture has no device-lane events "
+              f"(baseline {len(an_a.device_events)}, candidate "
+              f"{len(an_b.device_events)}) — nothing to attribute",
+              file=sys.stderr)
+        return 2
+    diff = kernel_diff(an_a, an_b)
+    if args.json:
+        print(json.dumps(diff, indent=2))
+    else:
+        print(format_kernel_diff(diff, top=args.top))
+    if args.regress_pct is None:
+        return 0
+    regs = diff_regressions(diff, regress_pct=args.regress_pct,
+                            min_us=args.min_us)
+    for r in regs:
+        print(f"perf_diff: REGRESSION: {r['name']} "
+              f"[{r['category']}] {r['reason']} "
+              f"({r['a_us'] / 1e3:.3f} -> {r['b_us'] / 1e3:.3f} "
+              f"ms/step)", file=sys.stderr)
+    if regs:
+        print(f"perf_diff: {len(regs)} kernel(s) over the "
+              f"{args.regress_pct:g}% gate", file=sys.stderr)
+        return 1
+    print(f"perf_diff: OK — no kernel over the {args.regress_pct:g}% "
+          f"gate (floor {args.min_us:g}us/step)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -132,6 +132,19 @@ sservrc=$?
 sserve_secs=$(echo "$(date +%s.%N) $sserve_t0" | awk '{printf "%.2f", $1-$2}')
 echo "sharded_serve_lint: ${sserve_secs}s (exit $sservrc)"
 
+# flight-recorder smoke (ISSUE 17): toy engine + injected SLO breach ->
+# exactly one trigger-pinned capture whose KernelView renders through
+# /profilez byte-identical to trace_analysis, zero post-warmup jit
+# misses with the recorder attached, plus the perf_diff gates (fixture
+# vs itself at 0% exits 0; a planted 2x kernel slowdown is named and
+# exits 1).
+frec_t0=$(date +%s.%N)
+timeout -k 10 "${TIER1_FLIGHTREC_TIMEOUT:-120}" \
+    env JAX_PLATFORMS=cpu python tools/flightrec_smoke.py
+frecrc=$?
+frec_secs=$(echo "$(date +%s.%N) $frec_t0" | awk '{printf "%.2f", $1-$2}')
+echo "flightrec_smoke: ${frec_secs}s (exit $frecrc)"
+
 timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
     PADDLE_TPU_TIER_DURATIONS="$DUR" \
     python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
@@ -146,6 +159,7 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -c
 [ "$rc" -eq 0 ] && rc=$fchaosrc
 [ "$rc" -eq 0 ] && rc=$shardrc
 [ "$rc" -eq 0 ] && rc=$sservrc
+[ "$rc" -eq 0 ] && rc=$frecrc
 
 if [ -s "$DUR" ]; then
     python tools/check_tiers.py "$DUR" \
@@ -166,7 +180,9 @@ if [ -s "$DUR" ]; then
         --shardlint-seconds "$shard_secs" \
         --shardlint-budget "${TIER1_SHARDLINT_BUDGET:-60}" \
         --sharded-serve-seconds "$sserve_secs" \
-        --sharded-serve-budget "${TIER1_SHARDED_SERVE_BUDGET:-90}"
+        --sharded-serve-budget "${TIER1_SHARDED_SERVE_BUDGET:-90}" \
+        --flightrec-seconds "$frec_secs" \
+        --flightrec-budget "${TIER1_FLIGHTREC_BUDGET:-60}"
     crc=$?
     [ "$rc" -eq 0 ] && rc=$crc
 else
