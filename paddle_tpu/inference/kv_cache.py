@@ -84,6 +84,10 @@ class BlockPool:
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
         self._rows: Dict[int, List[int]] = {}
         self._refs: Dict[int, int] = {}     # block id -> reference count
+        # observer poked after every occupancy change (alloc/free/take/
+        # release/reset) — the MemoryLedger's per-owner delta stream rides
+        # this; must stay host-side and cheap, it sits on the alloc path
+        self.on_change = None
 
     @classmethod
     def for_model(cls, model, *, num_blocks: int, block_size: int,
@@ -218,6 +222,7 @@ class BlockPool:
             self._refs[b] = 1
         blocks = shared + fresh
         self._rows[owner] = blocks
+        self._notify()
         return np.asarray(blocks, dtype=np.int32)  # lint: allow(tracer-asarray)
 
     def free(self, owner: int) -> int:
@@ -228,7 +233,9 @@ class BlockPool:
         blocks = self._rows.pop(owner, None)
         if not blocks:
             return 0
-        return self._deref(reversed(blocks))
+        freed = self._deref(reversed(blocks))
+        self._notify()
+        return freed
 
     def take(self, n: int = 1) -> Optional[List[int]]:
         """Reserve `n` OWNERLESS blocks at refcount 1 — the rehydrate
@@ -245,6 +252,7 @@ class BlockPool:
             b = self._free.pop()
             self._refs[b] = 1
             out.append(b)
+        self._notify()
         return out
 
     # ------------------------------------------------- cache references
@@ -262,7 +270,9 @@ class BlockPool:
     def release(self, blocks) -> int:
         """Drop one reference per block (cache eviction path); returns
         how many hit zero and went back to the free list."""
-        return self._deref(int(b) for b in blocks)
+        freed = self._deref(int(b) for b in blocks)
+        self._notify()
+        return freed
 
     def refcount(self, block: int) -> int:
         return self._refs.get(int(block), 0)
@@ -314,6 +324,15 @@ class BlockPool:
         self._free = list(range(self.num_blocks - 1, 0, -1))
         self._rows.clear()
         self._refs.clear()
+        self._notify()
+
+    def _notify(self):
+        cb = self.on_change
+        if cb is not None:
+            try:
+                cb()
+            except Exception:   # noqa: BLE001 — an observability observer
+                pass            # must never take the allocator down
 
     # ------------------------------------------- spill payloads (ISSUE 14)
     def _spill_sig(self) -> tuple:

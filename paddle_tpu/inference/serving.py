@@ -319,7 +319,10 @@ class ServingMetrics:
                          # where each window's draft came from
                          "spec_windows": 0, "spec_proposed": 0,
                          "spec_accepted": 0, "spec_drafts_trie": 0,
-                         "spec_drafts_model": 0}
+                         "spec_drafts_model": 0,
+                         # HBM ledger (ISSUE 18): oversubscription-wait
+                         # episodes (admission stalled on the free list)
+                         "mem_pressure_episodes": 0}
         self.gauges = {"queue_depth": 0, "inflight": 0,
                        "batch_fill_ratio": None, "kv_occupancy": None,
                        "kv_slots_occupancy": None,
@@ -458,7 +461,10 @@ class ServingMetrics:
                                      "from the prefix-trie prompt "
                                      "lookup",
                  "spec_drafts_model": "verify windows whose draft came "
-                                      "from the draft-model hook"}
+                                      "from the draft-model hook",
+                 "mem_pressure_episodes": "admission stalls waiting on "
+                                          "KV blocks (one per episode, "
+                                          "not per step)"}
         for name, value in self.counters.items():
             lines.extend(counter_lines(prefix, f"{name}_total", value,
                                        helps[name]))
@@ -727,10 +733,18 @@ class ServingEngine:
     def __init__(self, model, config: ServingConfig, *,
                  metrics: Optional[ServingMetrics] = None,
                  monitor: Optional[StepMonitor] = None,
+                 chaos=None,
                  clock: Callable[[], float] = time.monotonic):
         self.model = model
         self.config = config
         self.metrics = metrics or ServingMetrics()
+        # fault injection (ISSUE 12 Injector): fired at serving.step so
+        # the OOM post-mortem path is rehearsable without a real OOM
+        self.chaos = chaos
+        # HBM ledger (ISSUE 18): attach_memory_ledger wires the pool /
+        # prefix-cache / spill owners; None = unattributed engine
+        self._memz = None
+        self._mem_pressure_t0 = None   # oversubscription-wait episode
         # the monitor carries batch step timing + the recompile guard; the
         # serving engine measures dispatch-to-sync walls (truthful: every
         # chunk ends in a host sync for the token handoff)
@@ -912,11 +926,20 @@ class ServingEngine:
                 data={"prompt_len": plen, "cap": cfg.prompt_cap}))
         if cfg.paged and plen >= 1 and want >= 1 \
                 and not self._pool.fits_ever(plen + want - 1):
+            msg = (f"request needs {plen + want - 1} KV rows — more than "
+                   f"the whole pool holds even fully drained")
+            data = {"rows": plen + want - 1}
+            if self._memz is not None:
+                # the ledger's census answers the operator's next question
+                # ("who do I evict to make room?") inside the reject itself
+                top = self._memz.top_owners(3)
+                if top:
+                    data["top_owners"] = top
+                    msg += "; top HBM owners: " + ", ".join(
+                        f"{t['owner']}={t['bytes']}B" for t in top)
             out.add(Finding(
-                "config", "kv_oom", "error",
-                f"request needs {plen + want - 1} KV rows — more than "
-                f"the whole pool holds even fully drained",
-                executable="serving", data={"rows": plen + want - 1}))
+                "config", "kv_oom", "error", msg,
+                executable="serving", data=data))
         return out
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -1236,6 +1259,12 @@ class ServingEngine:
         spill0 = (self._spill.spilled_total, self._spill.rehydrated_total) \
             if self._spill is not None else (0, 0)
         try:
+            if self.chaos is not None:
+                # rehearsal seam for the OOM forensics path: an injected
+                # AllocFailure raises here exactly like a device
+                # RESOURCE_EXHAUSTED unwinding out of the chunk call
+                self.chaos.fire("serving.step", step=self._batch_id,
+                                queue_depth=len(self._queue))
             finished, expired, admit_ran = self._admit_paged()
             ran |= admit_ran
             pf_done, pf_ran = self._advance_prefill()
@@ -1252,7 +1281,23 @@ class ServingEngine:
                         live_entry)
                     ran.add("decode")
                 finished.extend(chunk_done)
-        except BaseException:
+        except BaseException as step_exc:
+            # OOM forensics (ISSUE 18): dump the census BEFORE the
+            # recovery below resets the pool — the artifact must show the
+            # occupancy that failed, not the post-reset emptiness
+            if self._memz is not None:
+                from ..obs.memz import looks_like_oom
+                if looks_like_oom(step_exc):
+                    inflight = [
+                        {"id": r.id, "prompt_len": len(r.prompt),
+                         "n_out": r.n_out}
+                        for r in self._slots if r is not None]
+                    self._memz.post_mortem(
+                        error=step_exc,
+                        context={"site": "serving.step",
+                                 "batch_id": self._batch_id,
+                                 "queue_depth": len(self._queue),
+                                 "inflight": inflight})
             now = self.clock()
             for i, r in enumerate(self._slots):
                 if r is not None:
@@ -1536,7 +1581,13 @@ class ServingEngine:
                             self._pool.blocks_needed(need_rows)):
                         blocks = self._pool.alloc(req.id, need_rows)
             if blocks is None:
+                # oversubscription wait: queued head outsizes the free
+                # list. One structured row per EPISODE (ISSUE 18) — the
+                # enter transition carries the flight-recorder trigger
+                # key; steady-state waiting stays silent
+                self._mem_pressure_enter(req, need_rows)
                 break            # wait for live rows to free their blocks
+            self._mem_pressure_exit()
             self._queue.popleft()
             slot = free.pop(0)
             req.status = "active"
@@ -1611,6 +1662,10 @@ class ServingEngine:
                     finished.append(req)
                     free.insert(0, slot)
             self._batch_id += 1
+        if not self._queue:
+            # waiting head left some other way (deadline expiry, error
+            # recovery draining the queue): close the episode truthfully
+            self._mem_pressure_exit()
         self.metrics.gauges["queue_depth"] = len(self._queue)
         if ran:
             # admission-only steps (budget-1 / instant-EOS traffic) still
@@ -2026,7 +2081,107 @@ class ServingEngine:
                     "byte_budget": self._prefix.byte_budget}
             if self._spill is not None:
                 out["spill"] = self._spill.stats()
+        if self._memz is not None:
+            # one curl shows compute, KV, and memory state together
+            # (ISSUE 18 satellite): ledger summary + spill occupancy
+            out["memory"] = self._memz.statusz_block()
         return out
+
+    # -- HBM ledger (ISSUE 18) ------------------------------------------
+    def attach_memory_ledger(self, ledger=None):
+        """Wire a MemoryLedger to this engine's owners and return it.
+
+        Registers reader-backed owners over accounting the engine already
+        keeps host-side (a ledger read must never sync — pinned like
+        every other scrape):
+
+          model_params   named-parameter buffer bytes (live device copy)
+          kv_pool        the pool's full reservation (num_blocks ×
+                         bytes_per_block — the allocator-granularity
+                         truth; `used_bytes` rides as detail) with shard
+                         geometry in meta
+          prefix_cache   retained-block bytes, an OVERLAY — those blocks
+                         live inside kv_pool's reservation, reported but
+                         never double-counted in the conservation sum
+          spill_host     host-RAM tier (device=False: never summed
+                         against HBM)
+
+        The pool's `on_change` observer re-samples the pool/cache owners
+        on every alloc/free/COW so the delta ring is a faithful growth
+        curve; ledger rows (headroom_low, post-mortems) ride the metrics'
+        structured-row stream, which is what the flight recorder taps."""
+        if ledger is None:
+            from ..obs.memz import MemoryLedger
+            ledger = MemoryLedger()
+        self._memz = ledger
+
+        def _params_bytes():
+            return int(sum(p._data.nbytes
+                           for _, p in self.model.named_parameters()))
+        ledger.register("model_params", _params_bytes, kind="params",
+                        replace=True)
+        if self.config.paged:
+            pool = self._pool
+            shards = int(self.config.shards or 1)
+
+            def _pool_bytes():
+                bpb = pool.bytes_per_block
+                return {"bytes": pool.num_blocks * bpb,
+                        "used_bytes": pool.used_blocks * bpb,
+                        "used_blocks": pool.used_blocks,
+                        "free_blocks": pool.free_blocks}
+            ledger.register("kv_pool", _pool_bytes, kind="kv",
+                            meta={"shards": shards,
+                                  "block_size": pool.block_size,
+                                  "num_blocks": pool.num_blocks},
+                            replace=True)
+            pool.on_change = lambda: ledger.sample("kv_pool",
+                                                   "prefix_cache")
+            if self._prefix is not None:
+                prefix = self._prefix
+                ledger.register(
+                    "prefix_cache",
+                    lambda: {"bytes": prefix.cached_bytes,
+                             "cached_blocks": prefix.cached_blocks,
+                             "spilled_blocks": prefix.spilled_blocks},
+                    kind="kv", overlay=True, replace=True)
+            if self._spill is not None:
+                spill = self._spill
+                ledger.register("spill_host",
+                                lambda: int(spill.host_bytes),
+                                kind="spill", device=False, replace=True)
+        if ledger.on_row is None:
+            ledger.on_row = self.metrics._emit
+        # the StepMonitor's per-record memory sample reads the ledger's
+        # free host counters instead of rationing live-array scans
+        self.monitor.memz = ledger
+        ledger.sample()
+        return ledger
+
+    def _mem_pressure_enter(self, req, need_rows: int):
+        if self._mem_pressure_t0 is not None:
+            return                       # already inside the episode
+        self._mem_pressure_t0 = self.clock()
+        body = {"request": req.id, "need_rows": int(need_rows),
+                "free_blocks": self._pool.free_blocks,
+                "used_blocks": self._pool.used_blocks,
+                "queue_depth": len(self._queue)}
+        if self._memz is not None:
+            body["top_owners"] = self._memz.top_owners(3)
+        self.metrics._emit({"mem_pressure": body, "ts": time.time()})
+        self.metrics.counters["mem_pressure_episodes"] += 1
+
+    def _mem_pressure_exit(self):
+        if self._mem_pressure_t0 is None:
+            return
+        waited = self.clock() - self._mem_pressure_t0
+        self._mem_pressure_t0 = None
+        # *_clear key: inert on the flight-recorder trigger bus by the
+        # transition-rows-only convention
+        self.metrics._emit({"mem_pressure_clear":
+                            {"waited_s": round(waited, 6),
+                             "free_blocks": self._pool.free_blocks},
+                            "ts": time.time()})
 
     def metrics_registry(self, prefix: str = "paddle_tpu_serving"):
         """The engine's exposition producers composed through the
@@ -2047,6 +2202,12 @@ class ServingEngine:
             reg.register("spill",
                          lambda: self._spill.metrics_text(
                              prefix=f"{prefix}_spill"))
+        if self._memz is not None:
+            # hbm_bytes{owner=...} / hbm_headroom_bytes (ISSUE 18): the
+            # gauges the SLO/flight-recorder machinery consumes
+            reg.register("memz",
+                         lambda: self._memz.metrics_text(
+                             prefix="paddle_tpu"))
         return reg
 
     def serve_telemetry(self, *, host: str = "127.0.0.1", port: int = 0,
@@ -2058,8 +2219,9 @@ class ServingEngine:
         SLO monitor's burn gauges when one is passed), /healthz from
         `health()`, /statusz from `statusz()`, /tracez from the metrics'
         tail-sampling TraceBuffer (created and attached here when the
-        metrics don't carry one yet). Returns the server; `.close()` it
-        on shutdown.
+        metrics don't carry one yet), /memz from the HBM ledger (ISSUE
+        18 — `attach_memory_ledger()` runs here when none is attached
+        yet). Returns the server; `.close()` it on shutdown.
 
         `slo` is an obs.SLOMonitor or a parse_slo spec string
         ("ttft_p99=500ms,goodput=0.95" — built over this engine's
@@ -2079,6 +2241,11 @@ class ServingEngine:
         from ..obs import SLOMonitor, TelemetryServer, TraceBuffer
         if self.metrics.trace_buffer is None:
             self.metrics.trace_buffer = TraceBuffer(trace_capacity)
+        if self._memz is None:
+            # every served replica gets the HBM ledger (ISSUE 18): /memz,
+            # the hbm_* gauges and the OOM post-mortem come up with the
+            # ops surface unless the caller attached their own
+            self.attach_memory_ledger()
         reg = registry if registry is not None else self.metrics_registry()
         if isinstance(slo, str):
             slo = SLOMonitor(slo, self.metrics)
@@ -2087,7 +2254,7 @@ class ServingEngine:
         elif poll_interval is not None:
             raise ValueError("poll_interval needs an slo monitor/spec "
                              "to poll")
-        routes = {}
+        routes = {"/memz": self._memz.memz}
         if flightrec is not None:
             # monitor: step brackets + straggler/recompile/numerics rows;
             # metrics: every structured row INCLUDING slo_alert (the SLO

@@ -81,7 +81,7 @@ class TrainStep:
     def __init__(self, model, optimizer, loss_fn: Callable, mesh: Optional[Mesh] = None,
                  data_axes=("dp",), donate: bool = True, grad_accum_steps: int = 1,
                  monitor=None, numerics=None, scaler=None, lint=None,
-                 preemption=None, chaos=None, timeline=None):
+                 preemption=None, chaos=None, timeline=None, memz=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -105,6 +105,12 @@ class TrainStep:
         # XLA compile dominate their wall) or a `step` span (goodput).
         # Falls back to the process-wide installed recorder when unset.
         self.timeline = timeline
+        # HBM ledger (ISSUE 18): params/opt-state register as owners
+        # after the first compile (opt state materializes lazily), and a
+        # device allocation failure unwinding out of a launch dumps the
+        # OOM post-mortem artifact before re-raising
+        self.memz = memz
+        self._memz_registered = False
         self._step_i = 0
         self._compiled = {}
         self._last_sig = {}     # kind -> last compiled shape signature
@@ -993,6 +999,48 @@ class TrainStep:
         finally:
             self._opt_state = saved
 
+    def _register_memz(self):
+        """Register params/opt-state as HBM-ledger owners (ISSUE 18) —
+        after the first compile, once opt state has materialized at its
+        final (possibly cast) dtypes. Reader-backed: the ledger reads
+        host-side nbytes metadata, never device values."""
+        if self.memz is None or self._memz_registered:
+            return
+        self._memz_registered = True
+        self.memz.register(
+            "train_params",
+            lambda: int(sum(p._data.nbytes for p in self._params)),
+            kind="params", replace=True)
+        self.memz.register(
+            "train_opt_state",
+            lambda: int(sum(getattr(leaf, "nbytes", 0)
+                            for leaf in jax.tree.leaves(
+                                self._opt_state or ()))),
+            kind="opt_state", replace=True)
+        self.memz.sample("train_params", "train_opt_state")
+        if self.monitor is not None and getattr(self.monitor, "memz",
+                                                None) is None:
+            # per-record memory samples now read the ledger's host
+            # counters instead of rationing live-array scans (r7 fix)
+            self.monitor.memz = self.memz
+
+    def _launch(self, compiled, *args):
+        """Run one compiled launch; a device allocation failure dumps the
+        OOM post-mortem (census + growth curve + the offending step)
+        before re-raising — RESOURCE_EXHAUSTED leaves with a named
+        owner attached."""
+        try:
+            return compiled(*args)
+        except BaseException as e:
+            if self.memz is not None:
+                from ..obs.memz import looks_like_oom
+                if looks_like_oom(e):
+                    self.memz.post_mortem(
+                        error=e,
+                        context={"site": "train_step.launch",
+                                 "step": self._step_i})
+            raise
+
     def run_steps(self, n_steps: int, *stacked_batch):
         """Run `n_steps` steps from batches stacked on dim 0 ([n, ...] per
         leaf), one compiled launch. Returns the per-step losses Tensor."""
@@ -1019,13 +1067,15 @@ class TrainStep:
             self._on_compile(f"train_step.run_steps[n={n_steps}]", key_sig)
             compiled = self._build_scan(treedef, n_steps)
             self._compiled[(treedef, key_sig)] = compiled
+        self._register_memz()
         lr = jnp.float32(self.optimizer.get_lr())
         key = _random.split_key()
         if self.mesh is not None:
             flat = [self._to_global(a, P(None, *self.data_axes))
                     if a.ndim > 1 else a for a in flat]
         t0 = time.perf_counter() if self.monitor is not None else None
-        losses, new_params, new_state, new_sstate, auxs = compiled(
+        losses, new_params, new_state, new_sstate, auxs = self._launch(
+            compiled,
             tuple(p._data for p in self._params), tuple(self._opt_state),
             self._scaler_state_in(), jnp.int32(self._step_i + 1), lr, key,
             *flat)
@@ -1076,6 +1126,7 @@ class TrainStep:
             self._on_compile("train_step", key_sig)
             compiled = self._build(treedef, [a.ndim for a in flat])
             self._compiled[(treedef, key_sig)] = compiled
+        self._register_memz()
 
         self._step_i += 1
         lr = jnp.float32(self.optimizer.get_lr())
@@ -1084,7 +1135,8 @@ class TrainStep:
             flat = [self._to_global(a, P(*self.data_axes))
                     if a.ndim > 0 else a for a in flat]
         t0 = time.perf_counter() if self.monitor is not None else None
-        loss, new_params, new_state, new_sstate, aux = compiled(
+        loss, new_params, new_state, new_sstate, aux = self._launch(
+            compiled,
             tuple(p._data for p in self._params), tuple(self._opt_state),
             self._scaler_state_in(), jnp.int32(self._step_i), lr, key, *flat)
         if self.monitor is not None:

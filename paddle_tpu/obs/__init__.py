@@ -52,8 +52,23 @@ explain a regression:
                      tracez (flightrec.py; `tools/perf_diff.py` diffs
                      two captures at kernel granularity).
 
-`ServingEngine.serve_telemetry()` wires all four around a live engine
-(and owns the SLO burn-rate poll cadence via `poll_interval=`);
+HBM-ledger scope (ISSUE 18) — where the time went was answerable, where
+the HBM went was not:
+
+  MemoryLedger       owner-attributed device-memory accounting (model
+                     params, optimizer state, KV pools, prefix-cache
+                     overlays, spill/checkpoint host tiers) reconciled
+                     against `device.memory_allocated()` — attributed +
+                     unattributed ≡ the allocator view, host counters
+                     only (a /memz read never syncs). Exposes the /memz
+                     route (fleet-merged by FleetAggregator.fleet_memz),
+                     hbm_bytes{owner=...}/hbm_headroom_bytes gauges, a
+                     headroom-low flight-recorder trigger, and the OOM
+                     post-mortem artifact tools/oom_report.py renders
+                     (memz.py).
+
+`ServingEngine.serve_telemetry()` wires all of these around a live
+engine (and owns the SLO burn-rate poll cadence via `poll_interval=`);
 `hapi.callbacks.ProfilerCallback(telemetry=...)` exports a TRAINING
 loop's StepMonitor + live goodput gauges through the same server.
 """
@@ -63,6 +78,7 @@ from .fleet import (FleetAggregator, FleetMergeError,  # noqa: F401
                     bucket_percentile, merge_exposition)
 from .flightrec import (FixtureBackend, FlightRecorder,  # noqa: F401
                         JaxProfilerBackend)
+from .memz import MemoryLedger, looks_like_oom  # noqa: F401
 from .registry import (ExpositionError, MetricsCollisionError,  # noqa: F401
                        MetricsRegistry, lint_exposition)
 from .server import Raw, TelemetryServer  # noqa: F401
@@ -76,4 +92,5 @@ __all__ = ["ExpositionError", "MetricsCollisionError", "MetricsRegistry",
            "TraceBuffer", "chrome_trace", "FleetAggregator",
            "FleetMergeError", "merge_exposition", "bucket_percentile",
            "CollectiveLedger", "load_shard_walls", "feed_shard_walls",
-           "FlightRecorder", "JaxProfilerBackend", "FixtureBackend"]
+           "FlightRecorder", "JaxProfilerBackend", "FixtureBackend",
+           "MemoryLedger", "looks_like_oom"]

@@ -659,6 +659,51 @@ class FleetAggregator:
                             "per_replica": summaries},
                 "captures": merged}
 
+    def fleet_memz(self, query: Optional[dict] = None) -> dict:
+        """Member /memz censuses merged with per-replica labels (ISSUE
+        18). Every owner row carries its `replica`; the summary sums the
+        conservation columns fleet-wide (attributed / allocated /
+        unattributed / headroom — a None anywhere degrades that sum to
+        None rather than inventing bytes) and keeps each member's full
+        census under per_replica. Members without a ledger (404) and
+        dead members contribute nothing — degraded, never fatal."""
+        query = dict(query or {})
+        deltas = query.get("deltas")
+        member_q = "/memz" + (f"?deltas={int(deltas)}"
+                              if deltas is not None else "")
+        payloads = self._scrape_route(member_q, json.loads,
+                                      ok_codes=(404,))
+        owners: List[dict] = []
+        per: Dict[str, dict] = {}
+        sums = {"attributed_bytes": 0, "allocated_bytes": 0,
+                "unattributed_bytes": 0, "headroom_bytes": 0}
+        degraded = set()
+        pressure = []
+        for name, p in sorted(payloads.items()):
+            if not isinstance(p, dict) or "owners" not in p:
+                continue                # 404 body: no ledger attached
+            per[name] = p
+            owners.extend(dict(o, replica=name)
+                          for o in p.get("owners", []))
+            for k in sums:
+                v = p.get(k)
+                if v is None:
+                    degraded.add(k)
+                else:
+                    sums[k] += int(v)
+            if p.get("headroom_low"):
+                pressure.append(name)
+        for k in degraded:
+            sums[k] = None
+        owners.sort(key=lambda o: -(o.get("bytes") or 0))
+        return {"summary": {"replicas": len(self.replica_states()),
+                            "answered": len(payloads),
+                            "with_ledger": len(per),
+                            "headroom_low": sorted(pressure),
+                            **sums},
+                "owners": owners,
+                "per_replica": per}
+
     def fleet_statusz(self, _query: Optional[dict] = None) -> dict:
         return {"replicas": self.replica_states(),
                 "scrapes_total": self.scrapes_total,
@@ -687,6 +732,7 @@ class FleetAggregator:
             routes={"/fleet/healthz": self.fleet_healthz,
                     "/fleet/tracez": self.fleet_tracez,
                     "/fleet/profilez": self.fleet_profilez,
+                    "/fleet/memz": self.fleet_memz,
                     "/fleet/statusz": self.fleet_statusz})
         srv.fleet = self
         return srv.start()

@@ -67,8 +67,11 @@ __all__ = ["FlightRecorder", "JaxProfilerBackend", "FixtureBackend",
            "TRIGGER_KEYS"]
 
 # structured-row keys the trigger bus fires on (transition rows only:
-# *_clear rows carry different keys and stay inert)
-TRIGGER_KEYS = ("slo_alert", "straggler", "recompile")
+# *_clear rows carry different keys and stay inert). mem_pressure /
+# headroom_low (ISSUE 18): the ledger's episode-entry rows arm a pinned
+# capture BEFORE the OOM the episode is foreshadowing
+TRIGGER_KEYS = ("slo_alert", "straggler", "recompile",
+                "mem_pressure", "headroom_low")
 
 
 class JaxProfilerBackend:

@@ -117,6 +117,11 @@ class StepMonitor:
         # machine advances at step boundaries, OUTSIDE the timed window
         # (trace start/stop cost must not pollute step walls)
         self.flightrec = None
+        # HBM ledger (ISSUE 18): when attached, per-step memory samples
+        # read the ledger's free host counters EVERY step — the
+        # live-array scan rationing below becomes moot (it stays the
+        # reconciliation path, never the per-step one)
+        self.memz = None
 
     # ------------------------------------------------------------- steps
     def begin_step(self):
@@ -376,6 +381,10 @@ class StepMonitor:
         return flops / step_s / peak
 
     def _memory_due(self) -> bool:
+        if self.memz is not None:
+            # ledger host counters are free — sample every record (the
+            # r7 every-10th rationing exists only for live-array scans)
+            return True
         if self._mem_every is None:
             every = self.memory_sample_every
             if every is None:
@@ -389,6 +398,11 @@ class StepMonitor:
         return n == 1 or n % self._mem_every == 0
 
     def _memory(self) -> Optional[dict]:
+        if self.memz is not None:
+            try:
+                return self.memz.quick_stats()
+            except Exception:
+                pass                  # fall through to the device view
         try:
             from ..device import memory_stats
             return memory_stats()

@@ -23,9 +23,9 @@ fleet.elastic restart supervision.
 from .checkpoint import (CheckpointManager, CheckpointCorruptError,
                          AsyncHandle, atomic_write_bytes)  # noqa: F401
 from .chaos import (Injector, Fault, KillAfterStep, KillAtSite,
-                    RaiseInStep, TruncateDuringSave, TransientIOErrors,
-                    TransientIOError, SimulatedKill, ReplicaDown,
-                    ReplicaKill, ScrapeTimeout, corrupt_leaf,
+                    RaiseInStep, AllocFailure, TruncateDuringSave,
+                    TransientIOErrors, TransientIOError, SimulatedKill,
+                    ReplicaDown, ReplicaKill, ScrapeTimeout, corrupt_leaf,
                     retry)  # noqa: F401
 from .preempt import (PreemptionHandler, Preempted, RESUME_EXIT_CODE,
                       exit_for_resume, is_resume_exit)  # noqa: F401
@@ -35,6 +35,7 @@ __all__ = [
     "CheckpointManager", "CheckpointCorruptError", "AsyncHandle",
     "atomic_write_bytes",
     "Injector", "Fault", "KillAfterStep", "KillAtSite", "RaiseInStep",
+    "AllocFailure",
     "TruncateDuringSave", "TransientIOErrors", "TransientIOError",
     "SimulatedKill", "ReplicaDown", "ReplicaKill", "ScrapeTimeout",
     "corrupt_leaf", "retry",

@@ -177,6 +177,34 @@ class KillAtSite(Fault):
 
 
 @dataclass
+class AllocFailure(Fault):
+    """Raise a device-allocation-failure-shaped RuntimeError the Nth
+    time `site` fires (default ``serving.step``) — the RESOURCE_EXHAUSTED
+    class the HBM ledger's OOM forensics path (ISSUE 18) exists for. The
+    message matches `obs.memz.looks_like_oom`, so the post-mortem dump
+    rehearses end to end without a real OOM; tests assert the artifact
+    AND injector.fired."""
+    site: str = "serving.step"
+    nth: int = 0
+    bytes: int = 1 << 30
+    kind: str = "alloc_failure"
+    _seen: int = field(default=0, init=False)
+    fired: bool = field(default=False, init=False)
+
+    def matches(self, site, ctx):
+        if self.fired or site != self.site:
+            return False
+        self._seen += 1
+        return self._seen - 1 >= self.nth
+
+    def trigger(self, injector, site, ctx):
+        self.fired = True
+        raise RuntimeError(
+            f"RESOURCE_EXHAUSTED: Out of memory allocating {self.bytes} "
+            f"bytes (injected at {site}, ctx={dict(ctx)})")
+
+
+@dataclass
 class TransientIOErrors(Fault):
     """Fail the first `times` fires of `site` (default the checkpoint
     write path) with TransientIOError — absorbed by ``retry``; tests

@@ -227,6 +227,10 @@ class CheckpointManager:
         # is deliberately NOT badput. Explicit recorder here, or the
         # process-wide installed one.
         self.timeline = None
+        # HBM ledger (ISSUE 18): when attached, an async save's host
+        # snapshot registers as the `ckpt_inflight` owner (host tier —
+        # the copy lives in RAM, not HBM) for the writer's lifetime
+        self.memz = None
         self._inflight: Optional[AsyncHandle] = None
         # serializes the save()/wait()/discard_inflight() handoff of
         # _inflight — the fallback manager behind dist_save is shared
@@ -349,6 +353,11 @@ class CheckpointManager:
                     tl.record("ckpt_blocking", t0, tl.now(),
                               step=int(step), mode="sync")
         box: dict = {"cancel": threading.Event()}
+        memz = self.memz
+        if memz is not None:
+            snap_bytes = sum(a.nbytes for a in leaves.values())
+            memz.set("ckpt_inflight", snap_bytes, kind="checkpoint",
+                     device=False)
 
         def writer():
             _deprioritize_current_thread()
@@ -358,6 +367,10 @@ class CheckpointManager:
                                                  cancel=box["cancel"])
             except BaseException as e:   # surfaced by handle.wait()
                 box["exc"] = e
+            finally:
+                if memz is not None:
+                    # committed or died, the snapshot is no longer held
+                    memz.set("ckpt_inflight", 0)
 
         t = threading.Thread(target=writer, daemon=True,
                              name=f"ckpt-save-{step}")

@@ -182,14 +182,17 @@ class TestTriggerDedup:
             _steps(rec, 1)
         rec.tap({"numerics": {"events": [{"kind": "nan"}]}})
         _steps(rec, 1)
-        assert rec.triggers_total == 4
+        n_keys = len(TRIGGER_KEYS) + 1       # + numerics-with-events
+        assert rec.triggers_total == n_keys
         # inert rows: clears, event-free numerics, plain steps, non-dicts
         rec.tap({"slo_clear": {}})
         rec.tap({"straggler_clear": {}})
+        rec.tap({"mem_pressure_clear": {}})
+        rec.tap({"headroom_low_clear": {}})
         rec.tap({"numerics": {"events": []}})
         rec.tap({"step": 7, "wall_s": 0.1})
         rec.tap("not a dict")
-        assert rec.triggers_total == 4
+        assert rec.triggers_total == n_keys
 
 
 # -------------------------------------------------------------- evidence

@@ -78,7 +78,9 @@ def check(records, *, budget: float, slow_threshold: float,
           sharded_serve_seconds: float = None,
           sharded_serve_budget: float = 90.0,
           flightrec_seconds: float = None,
-          flightrec_budget: float = 60.0) -> dict:
+          flightrec_budget: float = 60.0,
+          memz_seconds: float = None,
+          memz_budget: float = 60.0) -> dict:
     unmarked_slow = []       # should carry `slow` but don't
     tier1 = []               # everything tier-1 actually collects
     for r in records:
@@ -141,6 +143,12 @@ def check(records, *, budget: float, slow_threshold: float,
     # gates must stay a small fraction of the tier cap
     flightrec_over = (flightrec_seconds is not None
                       and flightrec_seconds > flightrec_budget)
+    # the memz budget line: tools/memz_smoke.py boots a toy paged engine
+    # with the HBM ledger attached (ISSUE 18) — conservation under
+    # churn, the concurrent /memz scrape, one injected OOM post-mortem
+    # and a mem-pressure episode must stay a small fraction of the cap
+    memz_over = (memz_seconds is not None
+                 and memz_seconds > memz_budget)
     return {
         "n_records": len(records),
         "n_tier1": len(tier1),
@@ -175,6 +183,9 @@ def check(records, *, budget: float, slow_threshold: float,
         "flightrec_seconds": flightrec_seconds,
         "flightrec_budget_s": flightrec_budget,
         "flightrec_over_budget": flightrec_over,
+        "memz_seconds": memz_seconds,
+        "memz_budget_s": memz_budget,
+        "memz_over_budget": memz_over,
         "unmarked_slow": sorted(unmarked_slow,
                                 key=lambda r: -r["duration"]),
         "slowest_tier1": sorted(tier1, key=lambda r: -r["duration"])[:10],
@@ -182,7 +193,8 @@ def check(records, *, budget: float, slow_threshold: float,
                and not lint_over and not chaos_over and not goodput_over
                and not obs_over and not fleet_over
                and not fleet_chaos_over and not shardlint_over
-               and not sharded_serve_over and not flightrec_over),
+               and not sharded_serve_over and not flightrec_over
+               and not memz_over),
     }
 
 
@@ -250,6 +262,12 @@ def main(argv=None) -> int:
     ap.add_argument("--flightrec-budget", type=float, default=60.0,
                     help="max seconds the flight-recorder smoke may "
                          "take on tier-1")
+    ap.add_argument("--memz-seconds", type=float, default=None,
+                    help="measured wall time of the tier-1 HBM-ledger "
+                         "smoke (tools/run_tier1.sh records it)")
+    ap.add_argument("--memz-budget", type=float, default=60.0,
+                    help="max seconds the HBM-ledger smoke may take "
+                         "on tier-1")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -276,7 +294,9 @@ def main(argv=None) -> int:
                    sharded_serve_seconds=args.sharded_serve_seconds,
                    sharded_serve_budget=args.sharded_serve_budget,
                    flightrec_seconds=args.flightrec_seconds,
-                   flightrec_budget=args.flightrec_budget)
+                   flightrec_budget=args.flightrec_budget,
+                   memz_seconds=args.memz_seconds,
+                   memz_budget=args.memz_budget)
 
     if args.json:
         print(json.dumps(result, indent=2))
@@ -312,6 +332,9 @@ def main(argv=None) -> int:
         if result.get("flightrec_seconds") is not None:
             print(f"  flightrec: {result['flightrec_seconds']:.2f}s "
                   f"(budget {result['flightrec_budget_s']}s)")
+        if result.get("memz_seconds") is not None:
+            print(f"  memz: {result['memz_seconds']:.2f}s "
+                  f"(budget {result['memz_budget_s']}s)")
         if result["chaos_over_budget"]:
             print(f"  VIOLATION: chaos gate took "
                   f"{result['chaos_seconds']:.2f}s, over the "
@@ -346,6 +369,10 @@ def main(argv=None) -> int:
             print(f"  VIOLATION: flight-recorder smoke took "
                   f"{result['flightrec_seconds']:.2f}s, over the "
                   f"{result['flightrec_budget_s']}s flightrec budget")
+        if result["memz_over_budget"]:
+            print(f"  VIOLATION: HBM-ledger smoke took "
+                  f"{result['memz_seconds']:.2f}s, over the "
+                  f"{result['memz_budget_s']}s memz budget")
         if result["lint_over_budget"]:
             print(f"  VIOLATION: lint pass took "
                   f"{result['lint_seconds']:.2f}s, over the "

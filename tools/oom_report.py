@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Render an HBM-ledger OOM post-mortem artifact (ISSUE 18).
+
+`MemoryLedger.post_mortem()` writes one JSONL file per device
+allocation failure — the head row names the error and the largest
+owner, then the full owner census at the moment of failure, then the
+last N owner-delta rows (the growth curve). This tool turns that
+artifact back into the triage page:
+
+    PYTHONPATH=. python tools/oom_report.py oom_postmortem/oom_*.jsonl
+    PYTHONPATH=. python tools/oom_report.py --json path/to/oom.jsonl
+
+With several paths (or a directory) the newest artifact renders last,
+so the terminal ends on the most recent failure. Exit 0 = rendered;
+2 = no readable artifact among the arguments.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _expand(paths):
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(os.path.join(p, n) for n in sorted(os.listdir(p))
+                       if n.endswith(".jsonl"))
+        else:
+            out.append(p)
+    return sorted(out, key=lambda p: (os.path.getmtime(p)
+                                      if os.path.exists(p) else 0.0))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("paths", nargs="+",
+                    help="post-mortem JSONL artifact(s) or a directory "
+                         "of them")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the parsed artifact(s) as JSON instead "
+                         "of the rendered table")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.obs.memz import load_postmortem, render_report
+
+    rendered = 0
+    for path in _expand(args.paths):
+        try:
+            if args.json:
+                print(json.dumps(load_postmortem(path), indent=2))
+            else:
+                print(f"== {path}")
+                print(render_report(path))
+                print()
+            rendered += 1
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"oom_report: skipping {path}: {e}", file=sys.stderr)
+    if not rendered:
+        print("oom_report: no readable post-mortem artifact",
+              file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -145,6 +145,19 @@ frecrc=$?
 frec_secs=$(echo "$(date +%s.%N) $frec_t0" | awk '{printf "%.2f", $1-$2}')
 echo "flightrec_smoke: ${frec_secs}s (exit $frecrc)"
 
+# HBM-ledger smoke (ISSUE 18): toy paged engine + MemoryLedger —
+# conservation vs the allocator view under churn, /memz scraped
+# concurrently at zero post-warmup jit misses, an injected allocation
+# failure producing a post-mortem artifact that renders through
+# tools/oom_report.py, and paired mem_pressure episode rows under
+# forced pool oversubscription.
+memz_t0=$(date +%s.%N)
+timeout -k 10 "${TIER1_MEMZ_TIMEOUT:-120}" \
+    env JAX_PLATFORMS=cpu python tools/memz_smoke.py
+memzrc=$?
+memz_secs=$(echo "$(date +%s.%N) $memz_t0" | awk '{printf "%.2f", $1-$2}')
+echo "memz_smoke: ${memz_secs}s (exit $memzrc)"
+
 timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
     PADDLE_TPU_TIER_DURATIONS="$DUR" \
     python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
@@ -160,6 +173,7 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -c
 [ "$rc" -eq 0 ] && rc=$shardrc
 [ "$rc" -eq 0 ] && rc=$sservrc
 [ "$rc" -eq 0 ] && rc=$frecrc
+[ "$rc" -eq 0 ] && rc=$memzrc
 
 if [ -s "$DUR" ]; then
     python tools/check_tiers.py "$DUR" \
@@ -182,7 +196,9 @@ if [ -s "$DUR" ]; then
         --sharded-serve-seconds "$sserve_secs" \
         --sharded-serve-budget "${TIER1_SHARDED_SERVE_BUDGET:-90}" \
         --flightrec-seconds "$frec_secs" \
-        --flightrec-budget "${TIER1_FLIGHTREC_BUDGET:-60}"
+        --flightrec-budget "${TIER1_FLIGHTREC_BUDGET:-60}" \
+        --memz-seconds "$memz_secs" \
+        --memz-budget "${TIER1_MEMZ_BUDGET:-60}"
     crc=$?
     [ "$rc" -eq 0 ] && rc=$crc
 else
