@@ -41,6 +41,11 @@ survives a replica dying mid-request:
                     greedy decode is deterministic per prompt, so the
                     redispatched output is bit-identical to a fault-free
                     run (asserted against an oracle in the chaos tests).
+                    `step()` also consults each handle's attached
+                    obs.Prober (ISSUE 19): a replica whose golden-canary
+                    probe reports `failing` is drained + ejected exactly
+                    like a dead one — wrong answers are a liveness
+                    failure as far as routing is concerned.
 
   AutoscaleController  goodput-driven scaling over the registry. Each
                     `tick()` reads the members' /healthz payloads — the
@@ -92,6 +97,7 @@ class ReplicaHandle:
         self.steps = 0                 # router step attempts (chaos ctx)
         self.consecutive_failures = 0
         self.ejected_reason: Optional[str] = None
+        self.prober = None             # obs.Prober, when attached (r19)
 
     def health(self) -> dict:
         return self.engine.health()
@@ -284,7 +290,7 @@ class FleetRouter:
         self.counters = {"dispatched": 0, "completed": 0, "rejected": 0,
                          "timeout": 0, "errors": 0, "retries": 0,
                          "backoffs": 0, "redispatched": 0,
-                         "replicas_lost": 0}
+                         "replicas_lost": 0, "probe_ejected": 0}
 
     # ---------------------------------------------------------- routing
     def _block_tokens(self) -> int:
@@ -426,16 +432,52 @@ class FleetRouter:
                 # as backoff-step completions — never silently dropped
                 self._pending_done.append(freq)
 
+    def check_probes(self):
+        """Eject any replica whose attached Prober reports `failing`
+        (ISSUE 19): a correctness-failing replica leaves routing exactly
+        like a dead one — drained (stops accepting work it would answer
+        wrongly) and ejected, with its in-flight requests redispatched
+        elsewhere where greedy determinism re-produces the SAME tokens.
+        The LB stops trusting a replica the moment it stops being
+        correct, not merely fast."""
+        for h in list(self.registry.handles(("serving", "draining"))):
+            prober = getattr(h, "prober", None)
+            if prober is None or not prober.failing:
+                continue
+            bad = sorted(n for n, v in prober.probez()["variants"].items()
+                         if v.get("failing"))
+            try:
+                h.engine.begin_drain()
+            except Exception:
+                pass               # ejection must not depend on the drain
+            self.counters["probe_ejected"] += 1
+            self._replica_lost(h.name, "probe_fail:" + ",".join(bad))
+
     # ------------------------------------------------------ the step loop
     def step(self) -> List[FleetRequest]:
         """One engine step on every serving+draining replica (through
         the ``fleet.step`` chaos site — a ReplicaKill fault manifests
-        here as ReplicaDown). Returns every FleetRequest that reached a
-        terminal status — including any that finished inside a backoff
-        step since the last call."""
+        here as ReplicaDown). Consults probe status first — a
+        correctness-failing replica is ejected before it can emit more
+        wrong tokens. Returns every FleetRequest that reached a terminal
+        status — including any that finished inside a backoff step since
+        the last call."""
+        self.check_probes()
         out, self._pending_done = self._pending_done, []
         out.extend(self._step_once())
         return out
+
+    def _settle(self, freq: FleetRequest, req) -> FleetRequest:
+        freq.request = req
+        freq.status = req.status
+        freq.reason = req.reason
+        if req.status == "done":
+            self.counters["completed"] += 1
+        elif req.status == "timeout":
+            self.counters["timeout"] += 1
+        elif req.status == "error":
+            self.counters["errors"] += 1
+        return freq
 
     def _step_once(self) -> List[FleetRequest]:
         done: List[FleetRequest] = []
@@ -454,16 +496,18 @@ class FleetRouter:
                 freq = pending.pop(req.id, None)
                 if freq is None:
                     continue        # a replica-local caller's request
-                freq.request = req
-                freq.status = req.status
-                freq.reason = req.reason
-                if req.status == "done":
-                    self.counters["completed"] += 1
-                elif req.status == "timeout":
-                    self.counters["timeout"] += 1
-                elif req.status == "error":
-                    self.counters["errors"] += 1
-                done.append(freq)
+                done.append(self._settle(freq, req))
+            # the mirror case: a replica-local step loop on the same
+            # engine (a Prober cycle riding real decode) may have driven
+            # one of OUR requests terminal — that step()'s `finished`
+            # went to the local caller, not here. The Request object is
+            # shared, so its status is authoritative; without this sweep
+            # the FleetRequest pends forever.
+            for rid in [rid for rid, fq in pending.items()
+                        if fq.request is not None and fq.request.status
+                        in ("done", "timeout", "error")]:
+                freq = pending.pop(rid)
+                done.append(self._settle(freq, freq.request))
         return done
 
     @property
@@ -520,7 +564,9 @@ class FleetRouter:
                  "redispatched": "in-flight requests re-submitted after "
                                  "a replica died",
                  "replicas_lost": "replicas ejected after dying "
-                                  "mid-traffic"}
+                                  "mid-traffic",
+                 "probe_ejected": "replicas ejected on golden-probe "
+                                  "correctness failure"}
         lines: List[str] = []
         for name, value in self.counters.items():
             lines.extend(counter_lines(prefix, f"{name}_total", value,

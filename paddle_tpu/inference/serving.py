@@ -103,7 +103,7 @@ import time
 import uuid
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 import jax
@@ -213,6 +213,10 @@ class Request:
     # request across its verify windows, and how many the target accepted
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # active probing (ISSUE 19): golden-canary requests ride the normal
+    # submit()/decode path but are excluded end-to-end from user-facing
+    # SLO/latency/goodput accounting — they feed probe_* families instead
+    probe: bool = False
     trace: RequestTrace = field(default_factory=RequestTrace)
 
     @property
@@ -228,6 +232,8 @@ class Request:
                "spans": t.to_dict()}
         if t.trace_id is not None:
             rec["trace_id"] = t.trace_id
+        if self.probe:
+            rec["probe"] = True
         if self.retriable is not None:
             rec["retriable"] = self.retriable
         if t.events:
@@ -327,6 +333,17 @@ class ServingMetrics:
                        "batch_fill_ratio": None, "kv_occupancy": None,
                        "kv_slots_occupancy": None,
                        "kv_shared_tokens": None}
+        # active probing (ISSUE 19): golden-canary requests are accounted
+        # HERE, never in the user-facing counters/hists above — probe
+        # traffic must not move SLO burn rates, goodput, or the r12
+        # autoscaler's overload signal. Rejection reasons keep their own
+        # dimension (the satellite fix: a probe shed during drain is
+        # prober noise, not a user-facing rejected_total increment).
+        # Rendered by probe_metrics_text() as a separate producer so a
+        # no-prober exposition stays byte-identical by construction.
+        self.probe_counters = {"requests": 0, "completed": 0,
+                               "rejected": 0, "timeout": 0, "errors": 0}
+        self.probe_reject_reasons: Dict[str, int] = {}
 
     # -- recording ------------------------------------------------------
     def observe_call(self, e2e_s: float, items: int = 1):
@@ -338,6 +355,12 @@ class ServingMetrics:
         self.hists["e2e_seconds"].observe(e2e_s)
 
     def record_request(self, req: Request):
+        if req.probe:
+            # golden-canary traffic (ISSUE 19): full exclusion from the
+            # user-facing families — no counter, no histogram, no trace
+            # ring. The request stream stays a complete audit log (the
+            # row just carries its own key).
+            return self._record_probe_request(req)
         self.counters["requests"] += 1
         if req.status == "done":
             self.counters["completed"] += 1
@@ -372,6 +395,56 @@ class ServingMetrics:
         if self.trace_buffer is not None:
             self.trace_buffer.add(rec)
         return self._emit({"request": rec, "ts": time.time()})
+
+    def _record_probe_request(self, req: Request) -> dict:
+        pc = self.probe_counters
+        pc["requests"] += 1
+        if req.status == "done":
+            pc["completed"] += 1
+        elif req.status == "rejected":
+            pc["rejected"] += 1
+            reason = req.reason or "unknown"
+            self.probe_reject_reasons[reason] = \
+                self.probe_reject_reasons.get(reason, 0) + 1
+        elif req.status == "timeout":
+            pc["timeout"] += 1
+        elif req.status == "error":
+            pc["errors"] += 1
+        # distinct row key: consumers counting {"request"} rows (tracez,
+        # stitchers) never see probe traffic; the flight recorder's
+        # trigger bus ignores unknown keys
+        return self._emit({"probe_request": req.record(),
+                           "ts": time.time()})
+
+    def probe_metrics_text(self,
+                           prefix: str = "paddle_tpu_probe_serving") \
+            -> str:
+        """The engine-side probe families (submit/admission accounting;
+        the Prober renders verdicts separately). A separate producer on
+        purpose: metrics_text() is byte-identical with or without a
+        prober attached."""
+        lines: List[str] = []
+        helps = {"requests": "probe requests observed at terminal "
+                             "status",
+                 "completed": "probe requests served to completion",
+                 "rejected": "probe requests refused at submit "
+                             "(prober noise, never user-facing "
+                             "rejected_total)",
+                 "timeout": "probe requests expired in queue",
+                 "errors": "probe requests lost to engine exceptions"}
+        for name, value in self.probe_counters.items():
+            lines.extend(counter_lines(prefix, f"{name}_total", value,
+                                       helps[name]))
+        if self.probe_reject_reasons:
+            p = prefix
+            lines += [f"# HELP {p}_rejected_reason_total probe "
+                      f"rejections by reason (the probe label "
+                      f"dimension of the submit taxonomy)",
+                      f"# TYPE {p}_rejected_reason_total counter"]
+            lines += [f'{p}_rejected_reason_total{{reason="{r}"}} {c}'
+                      for r, c in
+                      sorted(self.probe_reject_reasons.items())]
+        return "\n".join(lines) + "\n"
 
     def _emit(self, row: dict) -> dict:
         """One emission path for per-request and drain-summary rows —
@@ -745,6 +818,12 @@ class ServingEngine:
         # prefix-cache / spill owners; None = unattributed engine
         self._memz = None
         self._mem_pressure_t0 = None   # oversubscription-wait episode
+        # active probing (ISSUE 19): serve_telemetry wires a Prober /
+        # InvariantAuditor here; the config fingerprint is cached (env
+        # and versions are process-stable)
+        self._prober = None
+        self._invariants = None
+        self._fingerprint = None
         # the monitor carries batch step timing + the recompile guard; the
         # serving engine measures dispatch-to-sync walls (truthful: every
         # chunk ends in a host sync for the token handoff)
@@ -944,7 +1023,8 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               enqueue_at: Optional[float] = None) -> Request:
+               enqueue_at: Optional[float] = None,
+               probe: bool = False) -> Request:
         """Admit one prompt into the bounded queue.
 
         Returns the Request; check `.status` — "queued" on success,
@@ -960,8 +1040,11 @@ class ServingEngine:
         prompt = np.asarray(prompt, dtype=np.int64).reshape(-1)  # lint: allow(tracer-asarray)
         want = cfg.max_new_tokens if max_new_tokens is None \
             else min(int(max_new_tokens), cfg.max_new_tokens)
+        # probe tag stamped BEFORE any rejection path (ISSUE 19): a probe
+        # shed here (draining/overload/queue_full) lands in the probe
+        # families, never in the user-facing rejection counters
         req = Request(id=self._next_id, prompt=prompt,
-                      max_new_tokens=want,
+                      max_new_tokens=want, probe=probe,
                       deadline_s=cfg.deadline_s if deadline_s is None
                       else deadline_s)
         self._next_id += 1
@@ -1601,7 +1684,12 @@ class ServingEngine:
             table_row = self._pool.table_row(req.id, self._tables.shape[1])
             self._tables[slot] = table_row
             self._shared_tok[slot] = len(shared) * bs
-            if self._prefix is not None:
+            # probe admissions (ISSUE 19) stay out of the cache-efficiency
+            # counters: a prober's hit/miss variants are DESIGNED to
+            # always hit / always miss, so counting them would turn the
+            # fleet hit-rate and prefill-savings signals into artifacts
+            # of the probe cadence
+            if self._prefix is not None and not req.probe:
                 self.metrics.counters[
                     "prefix_hit" if t else "prefix_miss"] += 1
             if t >= plen - 1 and t > 0:
@@ -1616,7 +1704,9 @@ class ServingEngine:
                 req._chunks = []
                 req._produced = 0
                 req.trace.t_prefill_done = now   # nothing to prefill
-                self.metrics.counters["prefill_tokens_saved"] += plen - 1
+                if not req.probe:
+                    self.metrics.counters["prefill_tokens_saved"] += \
+                        plen - 1
                 # re-stamp the matched chain; only positions < t hold
                 # written KV here (the pending re-decode hasn't run), so
                 # the insert must not cache any fresh block yet
@@ -1633,7 +1723,7 @@ class ServingEngine:
                 self._prefill_pos[slot] = t
                 req._chunks = []
                 req._produced = 0
-                if t:
+                if t and not req.probe:
                     self.metrics.counters["prefill_tokens_saved"] += t
             else:
                 suffix = plen - t
@@ -1656,7 +1746,7 @@ class ServingEngine:
                 req.trace.events.append(
                     ("prefill" if t == 0 else "suffix_prefill",
                      t_pf0, self.clock()))
-                if t:
+                if t and not req.probe:
                     self.metrics.counters["prefill_tokens_saved"] += t
                 if self._complete_prefill(slot, req, tok, self.clock()):
                     finished.append(req)
@@ -1912,12 +2002,14 @@ class ServingEngine:
                 used = min(int(acc[slot]), take, dlen)
                 req.spec_proposed += dlen
                 req.spec_accepted += used
-                mt.counters["spec_windows"] += 1
-                mt.counters["spec_proposed"] += dlen
-                mt.counters["spec_accepted"] += used
-                mt.counters["spec_drafts_trie" if tag == "trie"
-                            else "spec_drafts_model"] += 1
-                mt.hists["spec_accept_len"].observe(take)
+                if not req.probe:   # probe windows would skew the
+                    #                 acceptance-rate signal (ISSUE 19)
+                    mt.counters["spec_windows"] += 1
+                    mt.counters["spec_proposed"] += dlen
+                    mt.counters["spec_accepted"] += used
+                    mt.counters["spec_drafts_trie" if tag == "trie"
+                                else "spec_drafts_model"] += 1
+                    mt.hists["spec_accept_len"].observe(take)
             row_done = req._produced >= req.max_new_tokens or \
                 _hit_eos(fresh, cfg.eos_token_id)
             if row_done:
@@ -2041,11 +2133,23 @@ class ServingEngine:
                 "completed_total": m.counters["completed"],
                 "kv_occupancy": m.gauges["kv_occupancy"]}
 
+    def fingerprint(self) -> dict:
+        """Deterministic config/build identity (ISSUE 19): the key
+        goldens are minted under and the value fleet drift detection
+        compares. Cached — model config, ServingConfig, jax versions
+        and PADDLE_TPU_* env are all process-stable."""
+        if self._fingerprint is None:
+            from ..obs.probez import config_fingerprint
+            self._fingerprint = config_fingerprint(self.model.config,
+                                                   self.config)
+        return self._fingerprint
+
     def statusz(self) -> dict:
         """The /statusz payload: engine identity + config envelope,
-        compile/recompile accounting, KV/prefix-cache occupancy, and the
-        full counter/gauge snapshot — the page a human (or a fleet
-        inventory) reads to understand WHAT this replica is."""
+        compile/recompile accounting, KV/prefix-cache occupancy, the
+        config/build fingerprint, and the full counter/gauge snapshot —
+        the page a human (or a fleet inventory) reads to understand
+        WHAT this replica is."""
         out = {"engine": {"run_id": self._run_id,
                           "uptime_s": round(self.clock() - self._t_start,
                                             3),
@@ -2060,6 +2164,7 @@ class ServingEngine:
                "compile": {"compiles": self.monitor.compiles,
                            "recompiles": self.monitor.recompiles,
                            "jit_cache_misses": _jit_cache_misses()},
+               "fingerprint": self.fingerprint(),
                "counters": dict(self.metrics.counters),
                "gauges": dict(self.metrics.gauges)}
         if self.config.paged:
@@ -2208,12 +2313,22 @@ class ServingEngine:
             reg.register("memz",
                          lambda: self._memz.metrics_text(
                              prefix="paddle_tpu"))
+        if self._prober is not None:
+            # probe_* families (ISSUE 19) — separate producers, so an
+            # exposition without a prober is byte-identical by
+            # construction (the probe/SLO isolation guarantee)
+            reg.register("probe", self._prober.metrics_text)
+            reg.register("probe_serving", self.metrics.probe_metrics_text)
+        if self._invariants is not None:
+            reg.register("invariant", self._invariants.metrics_text)
         return reg
 
     def serve_telemetry(self, *, host: str = "127.0.0.1", port: int = 0,
                         slo=None, poll_interval: Optional[float] = None,
                         registry=None, trace_capacity: int = 256,
-                        flightrec=None):
+                        flightrec=None, prober=None,
+                        probe_interval: Optional[float] = None,
+                        invariant_interval: Optional[float] = None):
         """Boot the replica's ops surface: a started obs.TelemetryServer
         wired to this engine — /metrics from `metrics_registry()` (+ the
         SLO monitor's burn gauges when one is passed), /healthz from
@@ -2237,8 +2352,18 @@ class ServingEngine:
         and the metrics' structured rows as capture triggers, exports
         its counters on /metrics, and mounts the /profilez route. It
         rides `srv.flightrec`; detaching at shutdown stays with the
-        caller (`flightrec.detach()`)."""
-        from ..obs import SLOMonitor, TelemetryServer, TraceBuffer
+        caller (`flightrec.detach()`).
+
+        `prober` is an obs.Prober (ISSUE 19) or True to build one over
+        this engine; it mounts /probez, exports the probe_* families,
+        and with `probe_interval` the server drives golden-canary
+        cycles on a poller thread. `invariant_interval` schedules the
+        deep InvariantAuditor audits (paged engines) the same way —
+        both pollers hold the prober's lock; an external step-loop
+        thread must share it (`srv.prober.lock`), per the engine's
+        one-lock threading contract."""
+        from ..obs import (InvariantAuditor, Prober, SLOMonitor,
+                           TelemetryServer, TraceBuffer)
         if self.metrics.trace_buffer is None:
             self.metrics.trace_buffer = TraceBuffer(trace_capacity)
         if self._memz is None:
@@ -2246,6 +2371,20 @@ class ServingEngine:
             # the hbm_* gauges and the OOM post-mortem come up with the
             # ops surface unless the caller attached their own
             self.attach_memory_ledger()
+        if prober is True:
+            prober = Prober(self)
+        if prober is not None:
+            self._prober = prober
+        if self.config.paged and (prober is not None or
+                                  invariant_interval is not None):
+            auditor = InvariantAuditor(
+                self, lock=prober.lock if prober is not None else None)
+            self._invariants = auditor
+            if prober is not None:
+                prober.auditor = auditor
+        elif invariant_interval is not None:
+            raise ValueError("invariant_interval needs a paged engine "
+                             "(the audits walk the block pool)")
         reg = registry if registry is not None else self.metrics_registry()
         if isinstance(slo, str):
             slo = SLOMonitor(slo, self.metrics)
@@ -2255,6 +2394,8 @@ class ServingEngine:
             raise ValueError("poll_interval needs an slo monitor/spec "
                              "to poll")
         routes = {"/memz": self._memz.memz}
+        if prober is not None:
+            routes["/probez"] = prober.probez
         if flightrec is not None:
             # monitor: step brackets + straggler/recompile/numerics rows;
             # metrics: every structured row INCLUDING slo_alert (the SLO
@@ -2269,8 +2410,17 @@ class ServingEngine:
                               routes=routes)
         srv.slo = slo
         srv.flightrec = flightrec
+        srv.prober = prober
+        srv.invariants = self._invariants
         if slo is not None and poll_interval is not None:
             srv.add_poller(slo.poll, poll_interval, name="slo")
+        if prober is not None and probe_interval is not None:
+            srv.add_poller(prober.probe_once, probe_interval,
+                           name="probe")
+        if self._invariants is not None and \
+                invariant_interval is not None:
+            srv.add_poller(self._invariants.audit, invariant_interval,
+                           name="invariants")
         return srv.start()
 
 

@@ -67,6 +67,26 @@ the HBM went was not:
                      post-mortem artifact tools/oom_report.py renders
                      (memz.py).
 
+Active-probing scope (ISSUE 19) — everything above is passive; none of
+it can see a replica serving WRONG answers at perfect latency:
+
+  Prober             golden-canary correctness sentinels: synthetic
+                     requests through the REAL serving path (paged
+                     admission, prefix hit/miss, spec decode), output
+                     asserted BITWISE equal to goldens minted once per
+                     config fingerprint via generate_static_ragged.
+                     Tagged end-to-end out of user-facing SLO/goodput
+                     accounting; failures are structured {"probe_fail"}
+                     rows (flight-recorder trigger + memz census) and a
+                     `failing` /probez state the FleetRouter ejects on
+                     (probez.py; fleet-merged by fleet_probez with
+                     config-drift detection).
+  InvariantAuditor   deep host-side audits on the poller cadence:
+                     BlockPool conservation, per-owner rows ≅ refcounts,
+                     radix-trie ↔ pool cross-check, int8 scale
+                     co-residency — invariant_* gauges + structured
+                     findings on violation (probez.py).
+
 `ServingEngine.serve_telemetry()` wires all of these around a live
 engine (and owns the SLO burn-rate poll cadence via `poll_interval=`);
 `hapi.callbacks.ProfilerCallback(telemetry=...)` exports a TRAINING
@@ -79,6 +99,8 @@ from .fleet import (FleetAggregator, FleetMergeError,  # noqa: F401
 from .flightrec import (FixtureBackend, FlightRecorder,  # noqa: F401
                         JaxProfilerBackend)
 from .memz import MemoryLedger, looks_like_oom  # noqa: F401
+from .probez import (GoldenStore, InvariantAuditor, Prober,  # noqa: F401
+                     config_fingerprint)
 from .registry import (ExpositionError, MetricsCollisionError,  # noqa: F401
                        MetricsRegistry, lint_exposition)
 from .server import Raw, TelemetryServer  # noqa: F401
@@ -93,4 +115,5 @@ __all__ = ["ExpositionError", "MetricsCollisionError", "MetricsRegistry",
            "FleetMergeError", "merge_exposition", "bucket_percentile",
            "CollectiveLedger", "load_shard_walls", "feed_shard_walls",
            "FlightRecorder", "JaxProfilerBackend", "FixtureBackend",
-           "MemoryLedger", "looks_like_oom"]
+           "MemoryLedger", "looks_like_oom", "Prober", "GoldenStore",
+           "InvariantAuditor", "config_fingerprint"]

@@ -362,6 +362,14 @@ class FleetAggregator:
         # urlopen calls with timeouts, so shutdown is bounded)
         self._pool = ThreadPoolExecutor(
             max_workers=16, thread_name_prefix="paddle-tpu-fleet-scrape")
+        # active-probing scope (ISSUE 19): config-drift detection is
+        # transition-based — ONE structured {"config_drift"} finding
+        # when the fleet's /statusz fingerprints stop agreeing, handed
+        # to `on_finding` (e.g. a ServingMetrics._emit bound method) and
+        # retained in `findings` for the /fleet/probez payload.
+        self.on_finding: Optional[Callable[[dict], None]] = None
+        self.findings: List[dict] = []
+        self._config_drift = False
         for name, url in self._coerce(replicas):
             self.add_replica(name, url)
 
@@ -704,6 +712,57 @@ class FleetAggregator:
                 "owners": owners,
                 "per_replica": per}
 
+    def fleet_probez(self, _query: Optional[dict] = None) -> dict:
+        """Member /probez states merged fleet-wide (ISSUE 19) plus
+        config-drift detection over the /statusz fingerprints.
+
+        The summary lists which replicas are correctness-`failing` (what
+        the FleetRouter ejects on) and every member's config/build
+        fingerprint; goldens are keyed by that fingerprint, so when the
+        shas disagree the page both flags `config_drift` AND explains
+        any probe misses on the odd replica out. Drift emission is
+        transition-based: entering disagreement appends ONE structured
+        `{"config_drift"}` finding (and calls `on_finding`); members
+        without a prober (404 on /probez) still contribute their
+        fingerprint — drift detection does not require probing."""
+        payloads = self._scrape_route("/probez", json.loads,
+                                      ok_codes=(404,))
+        status = self._scrape_route("/statusz", json.loads,
+                                    ok_codes=(404,))
+        per: Dict[str, dict] = {}
+        failing: List[str] = []
+        for name, p in sorted(payloads.items()):
+            if not isinstance(p, dict) or "variants" not in p:
+                continue                # 404 body: no prober attached
+            per[name] = p
+            if p.get("state") == "failing":
+                failing.append(name)
+        fingerprints: Dict[str, str] = {}
+        for name, s in sorted(status.items()):
+            fp = s.get("fingerprint") if isinstance(s, dict) else None
+            if isinstance(fp, dict) and fp.get("sha"):
+                fingerprints[name] = fp["sha"]
+        drift = len(set(fingerprints.values())) > 1
+        if drift and not self._config_drift:
+            finding = {"config_drift": {"fingerprints": dict(fingerprints)},
+                       "ts": time.time()}
+            self.findings.append(finding)
+            del self.findings[:-64]
+            if self.on_finding is not None:
+                try:
+                    self.on_finding(finding)
+                except Exception:
+                    pass        # a finding sink must never break scrapes
+        self._config_drift = drift
+        return {"summary": {"replicas": len(self.replica_states()),
+                            "answered": len(payloads),
+                            "with_prober": len(per),
+                            "failing": sorted(failing),
+                            "fingerprints": fingerprints,
+                            "config_drift": drift},
+                "per_replica": per,
+                "findings": self.findings[-4:]}
+
     def fleet_statusz(self, _query: Optional[dict] = None) -> dict:
         return {"replicas": self.replica_states(),
                 "scrapes_total": self.scrapes_total,
@@ -733,6 +792,7 @@ class FleetAggregator:
                     "/fleet/tracez": self.fleet_tracez,
                     "/fleet/profilez": self.fleet_profilez,
                     "/fleet/memz": self.fleet_memz,
+                    "/fleet/probez": self.fleet_probez,
                     "/fleet/statusz": self.fleet_statusz})
         srv.fleet = self
         return srv.start()

@@ -69,9 +69,13 @@ __all__ = ["FlightRecorder", "JaxProfilerBackend", "FixtureBackend",
 # structured-row keys the trigger bus fires on (transition rows only:
 # *_clear rows carry different keys and stay inert). mem_pressure /
 # headroom_low (ISSUE 18): the ledger's episode-entry rows arm a pinned
-# capture BEFORE the OOM the episode is foreshadowing
+# capture BEFORE the OOM the episode is foreshadowing. probe_fail /
+# invariant_violation (ISSUE 19): a correctness sentinel tripping pins
+# the capture at the moment of divergence — silent-wrong-answer
+# forensics, the one failure class latency telemetry can never see
 TRIGGER_KEYS = ("slo_alert", "straggler", "recompile",
-                "mem_pressure", "headroom_low")
+                "mem_pressure", "headroom_low", "probe_fail",
+                "invariant_violation")
 
 
 class JaxProfilerBackend:

@@ -76,13 +76,26 @@ class StepMonitor:
                  track_memory: bool = True,
                  memory_sample_every: Optional[int] = None,
                  log_recompiles: bool = True,
-                 straggler_threshold: float = 1.5):
+                 straggler_threshold: float = 1.5,
+                 jsonl_flush_every: int = 1):
         self.flops_per_step = flops_per_step
         self.flops_per_item = flops_per_item
         self.items_per_step = items_per_step
         self.unit = unit
         self.peak_flops = peak_flops
         self.jsonl_path = jsonl_path
+        # JSONL write cadence (ISSUE 19 satellite, the r16 straggler-
+        # granularity follow-up): 1 (default) opens/appends/closes per
+        # row — every row durable immediately, the historical behavior.
+        # >1 keeps one handle and flushes every N rows (the SpanRecorder
+        # economics: a per-line flush costs most of a record()) — but
+        # straggler/straggler_clear transitions ALWAYS force a flush, so
+        # `load_shard_walls` stitching across live per-shard streams
+        # sees skew events at transition granularity, not buffer
+        # granularity.
+        self.jsonl_flush_every = max(1, int(jsonl_flush_every))
+        self._jsonl_f = None
+        self._jsonl_unflushed = 0
         self.on_report = on_report
         self.track_memory = track_memory
         # allocator counters are cheap to read every step; the live-array
@@ -199,11 +212,35 @@ class StepMonitor:
         `jsonl=False` is the inverse, for hook-only rows the JSONL
         stream's one-row-per-step consumers must not see."""
         if jsonl and self.jsonl_path:
-            with open(self.jsonl_path, "a") as f:
-                f.write(json.dumps(row) + "\n")
+            if self.jsonl_flush_every <= 1:
+                with open(self.jsonl_path, "a") as f:
+                    f.write(json.dumps(row) + "\n")
+            else:
+                if self._jsonl_f is None:
+                    self._jsonl_f = open(self.jsonl_path, "a")
+                self._jsonl_f.write(json.dumps(row) + "\n")
+                self._jsonl_unflushed += 1
+                if self._jsonl_unflushed >= self.jsonl_flush_every:
+                    self.flush_jsonl()
         if report and self.on_report is not None:
             self.on_report(row)
         return row
+
+    def flush_jsonl(self):
+        """Force buffered JSONL rows to the file. A no-op in the default
+        per-row mode; with `jsonl_flush_every` > 1 this is the handle
+        every must-be-visible-now row (straggler transitions) rides."""
+        if self._jsonl_f is not None:
+            self._jsonl_f.flush()
+            self._jsonl_unflushed = 0
+
+    def close(self):
+        """Flush and release the buffered JSONL handle (idempotent)."""
+        if self._jsonl_f is not None:
+            self._jsonl_f.flush()
+            self._jsonl_f.close()
+            self._jsonl_f = None
+            self._jsonl_unflushed = 0
 
     # ----------------------------------------------------------- compiles
     def record_compile(self, kind: str, sig, prev_sig=None,
@@ -330,6 +367,10 @@ class StepMonitor:
                     walls[slowest], median, skew,
                     self.straggler_threshold)
             self._emit(event)
+            # transition rows must be durable NOW (ISSUE 19 satellite):
+            # a buffered stream would hide the skew event from
+            # load_shard_walls stitching until 64 unrelated rows later
+            self.flush_jsonl()
         return self.shard_skew
 
     @property

@@ -25,8 +25,8 @@ from .checkpoint import (CheckpointManager, CheckpointCorruptError,
 from .chaos import (Injector, Fault, KillAfterStep, KillAtSite,
                     RaiseInStep, AllocFailure, TruncateDuringSave,
                     TransientIOErrors, TransientIOError, SimulatedKill,
-                    ReplicaDown, ReplicaKill, ScrapeTimeout, corrupt_leaf,
-                    retry)  # noqa: F401
+                    ReplicaDown, ReplicaKill, ScrapeTimeout,
+                    CorruptKVBlock, corrupt_leaf, retry)  # noqa: F401
 from .preempt import (PreemptionHandler, Preempted, RESUME_EXIT_CODE,
                       exit_for_resume, is_resume_exit)  # noqa: F401
 from .state import TrainState  # noqa: F401
@@ -38,7 +38,7 @@ __all__ = [
     "AllocFailure",
     "TruncateDuringSave", "TransientIOErrors", "TransientIOError",
     "SimulatedKill", "ReplicaDown", "ReplicaKill", "ScrapeTimeout",
-    "corrupt_leaf", "retry",
+    "CorruptKVBlock", "corrupt_leaf", "retry",
     "PreemptionHandler", "Preempted", "RESUME_EXIT_CODE",
     "exit_for_resume", "is_resume_exit",
     "TrainState",

@@ -24,6 +24,10 @@ faults a first-class, SEEDED test input:
                   (ISSUE 14) extend the taxonomy to FLEET faults: a
                   serving replica dying mid-traffic (observed as
                   ReplicaDown by the router) and a flaky health scrape.
+                  CorruptKVBlock (ISSUE 19) is the SILENT class: flip
+                  bytes inside one live KV block with no exception and
+                  no accounting change — only an active golden-probe
+                  comparison can observe it.
 
   SimulatedKill   BaseException (like SystemExit): nothing should catch
                   it accidentally — ``except Exception`` recovery blocks
@@ -276,6 +280,58 @@ class ScrapeTimeout(Fault):
         raise TimeoutError(
             f"injected scrape timeout on {self.replica} "
             f"({self.times - self.remaining}/{self.times})")
+
+
+@dataclass
+class CorruptKVBlock(Fault):
+    """Silently flip bytes inside ONE live KV block of a paged engine
+    (ISSUE 19) — the silent-wrong-answer fault class: no exception, no
+    accounting change, every passive metric stays green, only an active
+    probe comparing output chains against a golden can see it. Fires
+    once at the `nth` match of `site` (default ``probe.cycle``, fired by
+    the Prober at the top of each cycle, so "detected within one probe
+    cycle" is exact in tests). `block` picks the target device block; if
+    None the trigger corrupts the first live refcounted block. The
+    damage rides the pool's own read_block/write_block round-trip, so
+    host-side invariants (refcounts, owner rows, trie) remain intact —
+    exactly what makes the corruption invisible to everything but the
+    golden comparison."""
+    engine: object = None
+    site: str = "probe.cycle"
+    nth: int = 0
+    block: Optional[int] = None
+    n_bytes: int = 64
+    seed: int = 0
+    kind: str = "corrupt_kv_block"
+    seen: int = field(default=0, init=False)
+    fired: bool = field(default=False, init=False)
+    corrupted_block: Optional[int] = field(default=None, init=False)
+
+    def matches(self, site, ctx):
+        if self.fired or site != self.site:
+            return False
+        hit = self.seen >= self.nth
+        self.seen += 1
+        return hit
+
+    def trigger(self, injector, site, ctx):
+        self.fired = True
+        eng = self.engine
+        pool = eng._pool
+        blk = self.block
+        if blk is None:
+            live = sorted(b for b, r in pool._refs.items() if r > 0)
+            if not live:
+                raise RuntimeError("CorruptKVBlock: no live block to hit")
+            blk = live[0]
+        payload = tuple(np.array(p) for p in pool.read_block(eng._pools, blk))
+        rng = np.random.RandomState(self.seed)
+        flat = payload[0].view(np.uint8).reshape(-1)
+        n = min(self.n_bytes, flat.size)
+        for i in rng.randint(0, flat.size, size=max(1, n)):
+            flat[i] ^= 0xFF
+        eng._pools = pool.write_block(eng._pools, blk, payload)
+        self.corrupted_block = int(blk)
 
 
 class Injector:

@@ -80,7 +80,9 @@ def check(records, *, budget: float, slow_threshold: float,
           flightrec_seconds: float = None,
           flightrec_budget: float = 60.0,
           memz_seconds: float = None,
-          memz_budget: float = 60.0) -> dict:
+          memz_budget: float = 60.0,
+          probe_seconds: float = None,
+          probe_budget: float = 90.0) -> dict:
     unmarked_slow = []       # should carry `slow` but don't
     tier1 = []               # everything tier-1 actually collects
     for r in records:
@@ -149,6 +151,13 @@ def check(records, *, budget: float, slow_threshold: float,
     # and a mem-pressure episode must stay a small fraction of the cap
     memz_over = (memz_seconds is not None
                  and memz_seconds > memz_budget)
+    # the probe budget line: tools/probe_smoke.py drives golden-canary
+    # probers at 2 Hz over a 3-replica toy fleet inside the tier-1
+    # wrapper (ISSUE 19) — the clean interleaved leg, one corrupted KV
+    # block's detection/ejection and the fleet-page checks must stay a
+    # small fraction of the tier cap
+    probe_over = (probe_seconds is not None
+                  and probe_seconds > probe_budget)
     return {
         "n_records": len(records),
         "n_tier1": len(tier1),
@@ -186,6 +195,9 @@ def check(records, *, budget: float, slow_threshold: float,
         "memz_seconds": memz_seconds,
         "memz_budget_s": memz_budget,
         "memz_over_budget": memz_over,
+        "probe_seconds": probe_seconds,
+        "probe_budget_s": probe_budget,
+        "probe_over_budget": probe_over,
         "unmarked_slow": sorted(unmarked_slow,
                                 key=lambda r: -r["duration"]),
         "slowest_tier1": sorted(tier1, key=lambda r: -r["duration"])[:10],
@@ -194,7 +206,7 @@ def check(records, *, budget: float, slow_threshold: float,
                and not obs_over and not fleet_over
                and not fleet_chaos_over and not shardlint_over
                and not sharded_serve_over and not flightrec_over
-               and not memz_over),
+               and not memz_over and not probe_over),
     }
 
 
@@ -268,6 +280,13 @@ def main(argv=None) -> int:
     ap.add_argument("--memz-budget", type=float, default=60.0,
                     help="max seconds the HBM-ledger smoke may take "
                          "on tier-1")
+    ap.add_argument("--probe-seconds", type=float, default=None,
+                    help="measured wall time of the tier-1 active-"
+                         "probing smoke (tools/run_tier1.sh records "
+                         "it)")
+    ap.add_argument("--probe-budget", type=float, default=90.0,
+                    help="max seconds the active-probing smoke may "
+                         "take on tier-1")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -296,7 +315,9 @@ def main(argv=None) -> int:
                    flightrec_seconds=args.flightrec_seconds,
                    flightrec_budget=args.flightrec_budget,
                    memz_seconds=args.memz_seconds,
-                   memz_budget=args.memz_budget)
+                   memz_budget=args.memz_budget,
+                   probe_seconds=args.probe_seconds,
+                   probe_budget=args.probe_budget)
 
     if args.json:
         print(json.dumps(result, indent=2))
@@ -335,6 +356,9 @@ def main(argv=None) -> int:
         if result.get("memz_seconds") is not None:
             print(f"  memz: {result['memz_seconds']:.2f}s "
                   f"(budget {result['memz_budget_s']}s)")
+        if result.get("probe_seconds") is not None:
+            print(f"  probe: {result['probe_seconds']:.2f}s "
+                  f"(budget {result['probe_budget_s']}s)")
         if result["chaos_over_budget"]:
             print(f"  VIOLATION: chaos gate took "
                   f"{result['chaos_seconds']:.2f}s, over the "
@@ -373,6 +397,10 @@ def main(argv=None) -> int:
             print(f"  VIOLATION: HBM-ledger smoke took "
                   f"{result['memz_seconds']:.2f}s, over the "
                   f"{result['memz_budget_s']}s memz budget")
+        if result["probe_over_budget"]:
+            print(f"  VIOLATION: active-probing smoke took "
+                  f"{result['probe_seconds']:.2f}s, over the "
+                  f"{result['probe_budget_s']}s probe budget")
         if result["lint_over_budget"]:
             print(f"  VIOLATION: lint pass took "
                   f"{result['lint_seconds']:.2f}s, over the "

@@ -158,6 +158,20 @@ memzrc=$?
 memz_secs=$(echo "$(date +%s.%N) $memz_t0" | awk '{printf "%.2f", $1-$2}')
 echo "memz_smoke: ${memz_secs}s (exit $memzrc)"
 
+# active-probing smoke (ISSUE 19): three toy replicas with 2 Hz
+# golden-canary probers + deep invariant pollers interleaved with
+# closed-loop decode — zero probe failures and zero post-warmup jit
+# misses on the clean leg, probe/SLO isolation holds, one silently
+# corrupted KV block is caught within one probe cycle (exactly one
+# probe_fail row, pinned flight-recorder capture) and the router ejects
+# the replica while the surviving fleet serves bit-identically.
+probe_t0=$(date +%s.%N)
+timeout -k 10 "${TIER1_PROBE_TIMEOUT:-150}" \
+    env JAX_PLATFORMS=cpu python tools/probe_smoke.py
+probrc=$?
+probe_secs=$(echo "$(date +%s.%N) $probe_t0" | awk '{printf "%.2f", $1-$2}')
+echo "probe_smoke: ${probe_secs}s (exit $probrc)"
+
 timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
     PADDLE_TPU_TIER_DURATIONS="$DUR" \
     python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
@@ -174,6 +188,7 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -c
 [ "$rc" -eq 0 ] && rc=$sservrc
 [ "$rc" -eq 0 ] && rc=$frecrc
 [ "$rc" -eq 0 ] && rc=$memzrc
+[ "$rc" -eq 0 ] && rc=$probrc
 
 if [ -s "$DUR" ]; then
     python tools/check_tiers.py "$DUR" \
@@ -198,7 +213,9 @@ if [ -s "$DUR" ]; then
         --flightrec-seconds "$frec_secs" \
         --flightrec-budget "${TIER1_FLIGHTREC_BUDGET:-60}" \
         --memz-seconds "$memz_secs" \
-        --memz-budget "${TIER1_MEMZ_BUDGET:-60}"
+        --memz-budget "${TIER1_MEMZ_BUDGET:-60}" \
+        --probe-seconds "$probe_secs" \
+        --probe-budget "${TIER1_PROBE_BUDGET:-90}"
     crc=$?
     [ "$rc" -eq 0 ] && rc=$crc
 else
