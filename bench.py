@@ -1066,6 +1066,198 @@ def _bench_gpt27(on_tpu):
                      q8_emb=False, iters=6)
 
 
+def bench_gpt_dp(on_tpu):
+    """Data-parallel GPT pretraining with quantized gradient sync (ISSUE
+    20): the same config run three ways — single chip, dp with explicit
+    per-layer-group f32 gradient all-reduces, and dp with the int8
+    factored-scale sync (`TrainStep(grad_comm="int8")`). The row value is
+    the int8-sync tok/s; extras carry scaling efficiency both ways, the
+    per-run overlap ratio and EXPOSED collective seconds from a captured
+    trace, and the static gradient-sync bytes of both dp twins. Exit-1
+    gates: static sync bytes >= 3.5x under the f32 twin, CommPlan
+    compliance (zero f32-gradient-all-reduce escapes), int8 exposed time
+    / overlap ratio no worse than the f32 twin, zero steady recompiles.
+    On CPU the trace has no device lanes; the analyzer's host-lane
+    fallback still yields real overlap/exposed figures, but scheduler
+    noise is large — the timing gates get wide CPU tolerances while the
+    static-bytes and plan gates stay exact everywhere."""
+    import shutil
+    import tempfile
+    import numpy as np
+
+    # a CPU host gets a virtual multi-device backend when nothing
+    # initialized one yet (XLA reads XLA_FLAGS at first backend init)
+    if not on_tpu and "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.jit.api import compile_cache_misses
+    from paddle_tpu.analysis import train_comm_plan
+    from paddle_tpu.profiler.trace_analysis import analyze
+    from paddle_tpu.models import GPTForCausalLM, GPTConfig, gpt_config
+
+    dp = len(jax.devices())
+    if dp < 2:
+        return _emit({
+            "metric": "dp pretrain int8-gradient-sync tokens/sec",
+            "value": None, "unit": "tokens/s", "vs_baseline": None,
+            "extra": {"reason": f"{dp} device(s): no dp axis available"}})
+
+    if on_tpu:
+        # per-chip point = the best measured single-chip 2.7B config
+        # (_bench_gpt27): B=6 S=1024, save_qkv remat, int8 moments
+        preset, B1, S, iters = "gpt3-2.7b", 6, 1024, 6
+        cfg = gpt_config(preset, max_position_embeddings=max(1024, S))
+        cfg.use_recompute = True
+        cfg.recompute_policy = "save_qkv"
+        moment_dtype = "int8"
+    else:  # CPU smoke: toy dims, 8 virtual devices
+        preset, B1, S, iters = None, 1, 64, 3
+        cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=4,
+                        num_heads=8, max_position_embeddings=64,
+                        intermediate_size=1024)
+        moment_dtype = "float32"
+    np.random.seed(0)
+
+    def make(mesh, mode):
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        if on_tpu:
+            m.to(dtype="bfloat16")
+        o = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                   parameters=m.parameters(),
+                                   moment_dtype=moment_dtype)
+        st = TrainStep(m, o,
+                       lambda a, b: m.loss(a, b, chunk_size=512),
+                       mesh=mesh, grad_comm=mode)
+        return m, st
+
+    def ar_bytes(audit):
+        return sum(r.get("bytes") or 0 for r in audit.rows
+                   if r.get("kind") == "all-reduce")
+
+    def run(mesh, mode, Bx, plan=None):
+        """One configuration: fenced throughput + steady-recompile count,
+        and for dp runs a captured trace (overlap/exposed) + the static
+        collective audit (+ CommPlan findings when a plan is given)."""
+        dist.set_mesh(mesh)
+        try:
+            m, st = make(mesh, mode)
+            data = np.random.randint(0, cfg.vocab_size,
+                                     (iters, Bx, S)).astype("int32")
+            stacked = paddle.to_tensor(data)
+            # settle every executable BEFORE the miss snapshot so the
+            # timed reps prove the steady state never recompiles
+            _ = float(st.run_steps(iters, stacked, stacked).numpy()[-1])
+            miss0 = compile_cache_misses()
+            dt, final, mon = _timed_steps(st, iters, stacked, stacked)
+            out = {"tok_s": Bx * S * iters / dt,
+                   "step_ms": dt / iters * 1e3, "loss": final,
+                   "steady_recompiles": compile_cache_misses() - miss0,
+                   **_mon_fields(mon)}
+            if mesh is not None:
+                td = tempfile.mkdtemp(prefix=f"bench_dp_{mode}_")
+                try:
+                    with jax.profiler.trace(td):
+                        _ = float(st.run_steps(iters, stacked,
+                                               stacked).numpy()[-1])
+                    an = analyze(td, steps=iters)
+                finally:
+                    shutil.rmtree(td, ignore_errors=True)
+                ov = an.overlap()
+                out["overlap_ratio"] = ov["ratio"]
+                out["exposed_s"] = sum(
+                    r["exposed_us"] for r in an.collective_rows()
+                    if r.get("exposed_us") is not None) / 1e6
+                sds = jax.ShapeDtypeStruct((Bx, S), "int32")
+                audit = st.sharding_audit(sds, sds, plan=plan)
+                out["grad_sync_bytes"] = ar_bytes(audit)
+                out["plan_findings"] = [
+                    str(f) for f in audit.findings.for_pass("comm_plan")] \
+                    if plan is not None else None
+                out["n_groups"] = len(st._comm_groups)
+            return out
+        finally:
+            dist.set_mesh(None)
+
+    one = run(None, None, B1)
+    mesh = dist.build_mesh({"dp": dp})
+    B = B1 * dp
+    f32 = run(mesh, "f32", B)
+    plan = train_comm_plan(f32["n_groups"], dtype="int8",
+                           max_f32_bytes=max(f32["grad_sync_bytes"] // 8,
+                                             1))
+    i8 = run(mesh, "int8", B, plan=plan)
+
+    ratio = (f32["grad_sync_bytes"] / i8["grad_sync_bytes"]
+             if i8["grad_sync_bytes"] else None)
+    # CPU: 8 virtual devices share one host's cores — timing gates get
+    # wide tolerances there; static bytes + plan stay exact everywhere
+    exp_tol = 1.0 if on_tpu else 1.5
+    ov_tol = 0.05 if on_tpu else 0.25
+    violations = []
+    if ratio is None or ratio < 3.5:
+        violations.append(f"static gradient-sync bytes ratio {ratio} "
+                          f"< 3.5 (f32 {f32['grad_sync_bytes']} / int8 "
+                          f"{i8['grad_sync_bytes']})")
+    if i8["plan_findings"]:
+        violations.append(f"CommPlan violations: {i8['plan_findings']}")
+    for name, r in (("single", one), ("dp-f32", f32), ("dp-int8", i8)):
+        if r["steady_recompiles"]:
+            violations.append(f"{name}: {r['steady_recompiles']} steady "
+                              f"recompile(s)")
+    if i8["exposed_s"] > f32["exposed_s"] * exp_tol + 1e-3:
+        violations.append(f"int8 exposed {i8['exposed_s']:.4f}s worse "
+                          f"than f32 twin {f32['exposed_s']:.4f}s "
+                          f"(tol x{exp_tol})")
+    if (i8["overlap_ratio"] is not None
+            and f32["overlap_ratio"] is not None
+            and i8["overlap_ratio"] < f32["overlap_ratio"] - ov_tol):
+        violations.append(f"int8 overlap ratio {i8['overlap_ratio']:.3f} "
+                          f"worse than f32 twin "
+                          f"{f32['overlap_ratio']:.3f} - {ov_tol}")
+    if violations:
+        raise RuntimeError("gpt-dp gates failed: " + "; ".join(violations))
+
+    return _emit({
+        "metric": f"tokens/sec ({preset or 'toy'} dp={dp} pretrain, int8 "
+                  f"gradient sync, B={B} S={S})",
+        "value": round(i8["tok_s"], 1), "unit": "tokens/s",
+        "vs_baseline": round(i8["tok_s"] / f32["tok_s"], 3)
+        if f32["tok_s"] else None,
+        "extra": {
+            "shards": dp,
+            "scaling_efficiency": round(i8["tok_s"] / (dp * one["tok_s"]),
+                                        3) if one["tok_s"] else None,
+            "scaling_efficiency_f32": round(
+                f32["tok_s"] / (dp * one["tok_s"]), 3)
+            if one["tok_s"] else None,
+            "single_chip_tok_s": round(one["tok_s"], 1),
+            "step_ms": round(i8["step_ms"], 2),
+            "overlap_ratio": round(i8["overlap_ratio"], 3)
+            if i8["overlap_ratio"] is not None else None,
+            "overlap_ratio_f32": round(f32["overlap_ratio"], 3)
+            if f32["overlap_ratio"] is not None else None,
+            "exposed_s": round(i8["exposed_s"], 4),
+            "exposed_s_f32": round(f32["exposed_s"], 4),
+            "grad_sync_bytes_int8": i8["grad_sync_bytes"],
+            "grad_sync_bytes_f32": f32["grad_sync_bytes"],
+            "grad_sync_bytes_ratio": round(ratio, 2),
+            "comm_groups": i8["n_groups"],
+            "loss_delta_vs_f32": round(abs(i8["loss"] - f32["loss"]), 5),
+            "steady_recompiles": (one["steady_recompiles"]
+                                  + f32["steady_recompiles"]
+                                  + i8["steady_recompiles"]),
+            "hbm_peak_bytes": i8.get("hbm_peak_bytes"),
+            "recompiles": i8.get("recompiles")},
+    })
+
+
 _SINGLE = {
     "resnet50": bench_resnet50,
     "bert": bench_bert,
@@ -1079,6 +1271,7 @@ _SINGLE = {
     "moe": bench_moe,
     "gpt": bench_gpt,
     "gpt27": _bench_gpt27,
+    "gpt-2.7b-dp": bench_gpt_dp,
 }
 
 
@@ -1136,6 +1329,11 @@ def _ladder(on_tpu):
         ("gpt-s4096", lambda: bench_gpt(on_tpu, B=2, S=4096), 180),
         # 2.7B last: longest compile; config = best measured r3 point
         ("gpt-2.7b", lambda: _bench_gpt27(on_tpu), 420),
+        # dp scale-out (ISSUE 20): the 2.7B point data-parallel with the
+        # int8 factored-scale gradient sync vs its f32 twin — scaling
+        # efficiency, overlap/exposed from a captured trace, and the
+        # static sync-bytes ratio, all exit-1 gated inside the row
+        ("gpt-2.7b-dp", lambda: bench_gpt_dp(on_tpu), 420),
     ]
     flagship = None
     for name, fn, need in plan:
@@ -1189,9 +1387,9 @@ def _ladder(on_tpu):
 
 def main():
     which = os.environ.get("PADDLE_TPU_BENCH_MODEL")
-    # the sharded row needs a multi-device backend BEFORE first init;
-    # scoped to that row so every other row keeps its 1-device CPU smoke
-    if which == "decode-paged-mp" and \
+    # the sharded rows need a multi-device backend BEFORE first init;
+    # scoped to those rows so every other row keeps its 1-device CPU smoke
+    if which in ("decode-paged-mp", "gpt-2.7b-dp") and \
             "--xla_force_host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
         os.environ["XLA_FLAGS"] = (

@@ -36,7 +36,8 @@ from .recompile import (abstract_signature, diff_signatures,  # noqa: F401
 from .transfer import (HostTransferError, current_layer_path,  # noqa: F401
                        transfer_guard)
 from .commplan import (CommPlan, CommPlanError,  # noqa: F401
-                       collective_kind, rows_by_kind)
+                       collective_kind, rows_by_kind, serving_comm_plan,
+                       train_comm_plan)
 from .sharding import (ShardingAudit, audit_hlo,  # noqa: F401
                        collective_inventory, compiled_hlo_text,
                        diff_ledgers, replicated_pass, resharding_pass)

@@ -19,6 +19,20 @@ point: an accidental resharding all-gather in a "one grad all-reduce per
 layer, nothing in forward" step must fail loudly, not average into a
 table nobody reads.
 
+DTYPE-QUALIFIED specs (ISSUE 20): a key may pin the wire dtype —
+``"all-reduce:s8"`` matches only s8 all-reduces; rows whose dtype has no
+qualified key fall back to the bare-kind spec, and when only qualified
+keys exist for a kind the unmatched dtype is comm_extra. A spec may also
+be a dict ``{"calls": CountSpec, "max_bytes": int}`` — comm_bytes fires
+when the matched rows' summed bytes exceed the cap. Together these give
+the quantized-gradient default-deny: ``train_comm_plan(dtype="int8")``
+requires the s8 gradient all-reduces AND forbids any f32 all-reduce
+bigger than the scale/loss side-channel — an f32 gradient sync sneaking
+back (a fallback-classifier regression, a shard_map bypass) fails as
+comm_bytes, not as a byte row nobody reads. Dtype qualification needs
+rows that CARRY dtype (the static inventory does; runtime trace rows do
+not — check those against bare-kind plans).
+
 Rows are collective-ledger rows (analysis.sharding.collective_inventory
 or profiler.trace_analysis.collective_rows — the plan checks the KIND
 aggregation, so it accepts either side of the static/runtime pair).
@@ -57,10 +71,15 @@ def collective_kind(name: str) -> Optional[str]:
     return None
 
 
-def rows_by_kind(rows: Sequence[dict]) -> Dict[str, dict]:
+def rows_by_kind(rows: Sequence[dict],
+                 by_dtype: bool = False) -> Dict[str, dict]:
     """Aggregate ledger rows by collective kind: {kind: {"calls", "bytes",
     "names"}}. `bytes` is None when NO row of the kind carries bytes;
-    "-done" rows are skipped (their "-start" twin carries the op)."""
+    "-done" rows are skipped (their "-start" twin carries the op).
+    With ``by_dtype`` the key is ``"kind:dtype"`` for rows that carry a
+    dtype column (the static inventory) and the bare kind otherwise —
+    the aggregation dtype-qualified CommPlan specs check against; each
+    group additionally records its "kind" and "dtype"."""
     out: Dict[str, dict] = {}
     for r in rows:
         name = r.get("name", "")
@@ -69,7 +88,10 @@ def rows_by_kind(rows: Sequence[dict]) -> Dict[str, dict]:
         kind = collective_kind(name)
         if kind is None:
             continue
-        g = out.setdefault(kind, {"calls": 0, "bytes": None, "names": []})
+        dtype = r.get("dtype") if by_dtype else None
+        key = f"{kind}:{dtype}" if dtype else kind
+        g = out.setdefault(key, {"calls": 0, "bytes": None, "names": [],
+                                 "kind": kind, "dtype": dtype})
         g["calls"] += int(r.get("calls", 1))
         b = r.get("bytes")
         if b is not None:
@@ -95,18 +117,38 @@ class CommPlan:
     def __init__(self, expect: Dict[str, CountSpec],
                  allow_other: bool = False):
         self.expect: Dict[str, CountSpec] = {}
-        for kind, spec in (expect or {}).items():
+        for key, spec in (expect or {}).items():
+            kind, _, dtype = str(key).partition(":")
             k = collective_kind(kind) or kind
             if k not in COLLECTIVE_KINDS:
                 raise ValueError(
                     f"unknown collective kind {kind!r} "
                     f"(one of {COLLECTIVE_KINDS})")
-            self._validate_spec(kind, spec)
-            self.expect[k] = spec
+            self._validate_spec(key, spec)
+            self.expect[f"{k}:{dtype}" if dtype else k] = spec
         self.allow_other = allow_other
 
     @staticmethod
-    def _validate_spec(kind, spec):
+    def _split_spec(spec):
+        """(CountSpec, max_bytes) of a plain or dict spec."""
+        if isinstance(spec, dict):
+            return spec.get("calls", "+"), spec.get("max_bytes")
+        return spec, None
+
+    @classmethod
+    def _validate_spec(cls, kind, spec):
+        if isinstance(spec, dict):
+            extra = set(spec) - {"calls", "max_bytes"}
+            if extra:
+                raise ValueError(
+                    f"bad spec for {kind!r}: unknown dict keys {extra} "
+                    "(allowed: calls, max_bytes)")
+            mb = spec.get("max_bytes")
+            if mb is not None and (isinstance(mb, bool)
+                                   or not isinstance(mb, int) or mb < 0):
+                raise ValueError(
+                    f"bad max_bytes for {kind!r}: {mb!r}")
+            spec = spec.get("calls", "+")
         if isinstance(spec, bool) or not (
                 isinstance(spec, int)
                 or spec == "+"
@@ -114,7 +156,8 @@ class CommPlan:
                     and all(isinstance(x, int) for x in spec))):
             raise ValueError(
                 f"bad count spec for {kind!r}: {spec!r} (int exact, "
-                f"'+' present, (lo, hi) range, 0 forbidden)")
+                f"'+' present, (lo, hi) range, 0 forbidden, or "
+                "{'calls': ..., 'max_bytes': ...})")
 
     def __repr__(self):
         other = ", other: allowed" if self.allow_other else ""
@@ -144,55 +187,91 @@ class CommPlan:
     def check(self, rows: Sequence[dict], executable: str = "") -> Findings:
         """Findings for every way the inventory departs from the plan:
 
-        comm_extra    a kind the plan forbids is present (the accidental
-                      resharding case — the finding names the op names)
+        comm_extra    a kind (or kind:dtype) the plan forbids is present
+                      (the accidental resharding case — the finding names
+                      the op names)
         comm_missing  a planned kind is absent (the grad sync you meant
                       to have did not lower — usually a mesh/pspec typo)
         comm_count    a planned kind is present at the wrong count
+        comm_bytes    a planned kind's summed bytes exceed its max_bytes
+                      cap (the quantized-sync default-deny: a big f32
+                      gradient all-reduce under an int8 plan)
         """
-        got = rows_by_kind(rows)
+        has_dtype_keys = any(":" in k for k in self.expect)
+        got = rows_by_kind(rows, by_dtype=has_dtype_keys)
         out = Findings()
-        for kind, g in got.items():
-            spec = self.expect.get(kind)
-            if spec is None or spec == 0:
-                if self.allow_other and spec is None:
+        # resolve each row group onto a spec key (exact kind:dtype first,
+        # bare kind fallback), then judge counts/bytes PER SPEC KEY — a
+        # bare "all-reduce" spec pools every dtype, qualified keys split
+        matched: Dict[str, dict] = {}
+        for key, g in got.items():
+            kind = g.get("kind") or key
+            spec_key = key if key in self.expect else (
+                kind if kind in self.expect else None)
+            if spec_key is None or self._split_spec(
+                    self.expect.get(spec_key, 0))[0] == 0:
+                if self.allow_other and spec_key is None:
                     continue
                 out.add(Finding(
                     "comm_plan", "comm_extra", "error",
-                    f"{g['calls']} {kind} op(s) not in the comm plan "
+                    f"{g['calls']} {key} op(s) not in the comm plan "
                     f"({', '.join(g['names'][:4])}"
                     f"{', ...' if len(g['names']) > 4 else ''}) — "
                     f"partitioner-inserted communication the plan "
                     f"forbids",
-                    where=kind, executable=executable,
-                    data={"kind": kind, "calls": g["calls"],
-                          "bytes": g["bytes"],
+                    where=key, executable=executable,
+                    data={"kind": kind, "dtype": g.get("dtype"),
+                          "calls": g["calls"], "bytes": g["bytes"],
                           "names": g["names"][:16]}))
-            elif not self._spec_ok(spec, g["calls"]):
+                continue
+            m = matched.setdefault(spec_key, {"calls": 0, "bytes": None,
+                                              "names": []})
+            m["calls"] += g["calls"]
+            if g["bytes"] is not None:
+                m["bytes"] = (m["bytes"] or 0) + g["bytes"]
+            m["names"] += g["names"]
+        for spec_key, m in matched.items():
+            cspec, max_bytes = self._split_spec(self.expect[spec_key])
+            if not self._spec_ok(cspec, m["calls"]):
                 out.add(Finding(
                     "comm_plan", "comm_count", "error",
-                    f"{kind}: {g['calls']} op(s), plan expects "
-                    f"{self._spec_str(spec)}",
-                    where=kind, executable=executable,
-                    data={"kind": kind, "calls": g["calls"],
-                          "expect": self._spec_str(spec)}))
-        for kind, spec in self.expect.items():
-            if kind in got:
+                    f"{spec_key}: {m['calls']} op(s), plan expects "
+                    f"{self._spec_str(cspec)}",
+                    where=spec_key, executable=executable,
+                    data={"kind": spec_key, "calls": m["calls"],
+                          "expect": self._spec_str(cspec)}))
+            if max_bytes is not None and m["bytes"] is not None \
+                    and m["bytes"] > max_bytes:
+                out.add(Finding(
+                    "comm_plan", "comm_bytes", "error",
+                    f"{spec_key}: {m['bytes']} bytes exceed the plan's "
+                    f"{max_bytes}-byte cap "
+                    f"({', '.join(m['names'][:4])}"
+                    f"{', ...' if len(m['names']) > 4 else ''}) — "
+                    f"oversized communication on a lane the plan only "
+                    f"allows as a side-channel",
+                    where=spec_key, executable=executable,
+                    data={"kind": spec_key, "bytes": m["bytes"],
+                          "max_bytes": max_bytes,
+                          "names": m["names"][:16]}))
+        for spec_key, spec in self.expect.items():
+            if spec_key in matched:
                 continue
-            required = (spec == "+"
-                        or (isinstance(spec, int) and spec > 0)
-                        or (isinstance(spec, (tuple, list))
-                            and spec[0] > 0))
+            cspec, _ = self._split_spec(spec)
+            required = (cspec == "+"
+                        or (isinstance(cspec, int) and cspec > 0)
+                        or (isinstance(cspec, (tuple, list))
+                            and cspec[0] > 0))
             if not required:
                 continue
             out.add(Finding(
                 "comm_plan", "comm_missing", "error",
-                f"{kind}: absent, plan expects "
-                f"{self._spec_str(spec)} — the collective you planned "
+                f"{spec_key}: absent, plan expects "
+                f"{self._spec_str(cspec)} — the collective you planned "
                 f"for never lowered (mesh axis missing or pspec "
                 f"filtered away?)",
-                where=kind, executable=executable,
-                data={"kind": kind, "expect": self._spec_str(spec)}))
+                where=spec_key, executable=executable,
+                data={"kind": spec_key, "expect": self._spec_str(cspec)}))
         return out
 
     def verify(self, rows: Sequence[dict], executable: str = ""):
@@ -221,3 +300,35 @@ def serving_comm_plan(num_layers: Optional[int] = None) -> CommPlan:
     if num_layers is None:
         return CommPlan({"all-reduce": "+"})
     return CommPlan({"all-reduce": 2 * int(num_layers)})
+
+
+def train_comm_plan(n_groups: Optional[int] = None, dtype: str = "f32",
+                    max_f32_bytes: int = 1 << 20) -> CommPlan:
+    """THE declared data-parallel training plan (ISSUE 20): gradient sync
+    all-reduces and nothing else.
+
+    ``dtype="f32"`` (or None) is the classic plan — all-reduce present,
+    every other kind default-denied (the PR 14 regression class: a
+    partitioner-inserted batch all-gather in the dp step must fail).
+
+    ``dtype="int8"`` is the quantized plan for
+    ``TrainStep(grad_comm="int8")``: the s8 gradient all-reduces must be
+    present — ``n_groups`` (the ``_grad_groups`` layer-bucket count)
+    bounds them as a RANGE, because XLA's all-reduce combiner may merge
+    same-dtype neighbours — while f32 all-reduces are allowed only as the
+    side-channel (per-chunk scale pmax, loss/stats pmean, the 0/1-d
+    fallback groups) under ``max_f32_bytes``: an f32 GRADIENT all-reduce
+    sneaking back in blows the cap and fails as comm_bytes. Size the cap
+    at roughly an eighth of the f32 twin's all-reduce bytes (the default
+    1 MiB suits toy/CI models; real models pass their own)."""
+    if dtype in (None, "f32", "float32"):
+        return CommPlan({"all-reduce": "+"})
+    if dtype not in ("int8", "s8"):
+        raise ValueError(f"train_comm_plan dtype={dtype!r}: expected "
+                         "'f32' or 'int8'")
+    n_f32 = 2 * int(n_groups) + 2 if n_groups else 4096
+    return CommPlan({
+        "all-reduce:s8": (1, int(n_groups)) if n_groups else "+",
+        "all-reduce:f32": {"calls": (0, n_f32),
+                           "max_bytes": int(max_f32_bytes)},
+    })

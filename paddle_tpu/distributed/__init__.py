@@ -31,6 +31,10 @@ from .pipeline import (  # noqa: F401
 )
 from .heter import MeshShardedEmbedding  # noqa: F401
 from .dgc import sparse_allreduce, dgc_value_and_grad  # noqa: F401
+from .quant_collectives import (  # noqa: F401
+    int8_psum, quantize_chunked, dequantize_chunked, sync_grad_groups,
+    build_comm_groups, comm_group_stats, default_f32_fallback,
+)
 from ..ops.ring_attention import (  # noqa: F401
     ring_attention, ulysses_attention, sequence_parallel_attention,
 )

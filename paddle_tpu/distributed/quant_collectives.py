@@ -1,0 +1,221 @@
+"""Quantized gradient all-reduce — int8 sync with per-chunk factored scales.
+
+References: EQuARX (arxiv 2506.17615) — int8 ring all-reduce with
+per-block scales cuts gradient-sync wire bytes ~4x at negligible accuracy
+cost; T3 (arxiv 2401.16677) — per-layer gradient collectives issued as
+backward materializes each layer's grads let the latency-hiding scheduler
+overlap communication with the remaining backward compute.
+
+TPU-native design: like DGC (`distributed/dgc.py`) the exchange steps OUT
+of auto-sharding — `int8_psum` runs under shard_map manual over the dp
+axis.  The overflow-free recipe:
+
+  per chunk of `chunk` elements:
+    amax   = max |x| over the chunk          (local)
+    gmax   = pmax(amax, axis)                (tiny f32 all-reduce: the
+                                              factored per-chunk scales
+                                              must AGREE across shards)
+    levels = 127 // D                        (D = axis size)
+    scale  = max(gmax, eps) / levels
+    codes  = clip(round(x / scale), ±levels).astype(int8)
+    total  = psum(codes, axis)               (the int8 all-reduce; D codes
+                                              of magnitude ≤ 127//D cannot
+                                              overflow int8)
+    out    = total * (scale / D)             (mean folded into the scale)
+
+Wire math per step for n gradient elements over D shards (ring terms):
+  f32 all-reduce   ≈ 2·n·4 bytes
+  int8 all-reduce  ≈ 2·n·1 + 2·(n/chunk)·4 bytes   (codes + scale pmax)
+i.e. ~3.9x fewer bytes at the default chunk of 256.
+
+Stochastic rounding (optional) replaces round() with floor(q + u),
+u ~ U[0,1) — unbiased quantization for long training runs.  The same key
+is used on every shard (this jaxlib rejects `lax.axis_index` under
+partial-manual lowering, r7): still unbiased, because each shard rounds
+different values; shards stay bit-identical in the replicated outputs.
+
+`TrainStep(grad_comm="int8")` wires this into the training step per
+`_grad_groups` layer bucket (one collective per layer group, overlappable
+with backward), with an f32 fallback for norm-sensitive leaves —
+`default_f32_fallback` keeps 0/1-d params (layernorm scales, biases) in
+f32; embeddings quantize by default (override via
+`grad_comm_f32_fallback`).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DEFAULT_CHUNK = 256
+_EPS = 1e-30
+
+
+def _pad_to_chunks(flat, chunk: int):
+    n = flat.shape[0]
+    n_chunks = -(-n // chunk)
+    pad = n_chunks * chunk - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(n_chunks, chunk), n
+
+
+def quantize_chunked(x, chunk: int = DEFAULT_CHUNK, levels: int = 127,
+                     stochastic: bool = False, key=None):
+    """Per-chunk symmetric int8 quantization of any tensor.
+
+    Returns (codes int8 [n_chunks, chunk], scales f32 [n_chunks]) with the
+    tail chunk zero-padded; `dequantize_chunked` undoes both. `levels` is
+    the clip magnitude (127 for storage, 127//D for an overflow-free psum
+    over D shards).
+    """
+    q, _ = _pad_to_chunks(x.reshape(-1).astype(jnp.float32), chunk)
+    amax = jnp.max(jnp.abs(q), axis=1)
+    scales = jnp.maximum(amax, _EPS) / levels
+    q = q / scales[:, None]
+    if stochastic:
+        if key is None:
+            raise ValueError("stochastic rounding needs a PRNG key")
+        q = jnp.floor(q + jax.random.uniform(key, q.shape))
+    else:
+        q = jnp.round(q)
+    codes = jnp.clip(q, -levels, levels).astype(jnp.int8)
+    return codes, scales
+
+
+def dequantize_chunked(codes, scales, n: int, shape=None,
+                       dtype=jnp.float32):
+    """Inverse of quantize_chunked: codes [n_chunks, chunk] x scales
+    [n_chunks] -> the first `n` elements reshaped to `shape`."""
+    out = (codes.astype(jnp.float32) * scales[:, None]).reshape(-1)[:n]
+    if shape is not None:
+        out = out.reshape(shape)
+    return out.astype(dtype)
+
+
+def int8_psum(x, axis: str, axis_size: int, chunk: int = DEFAULT_CHUNK,
+              stochastic: bool = False, key=None, mean: bool = True):
+    """Quantize -> int8 all-reduce -> dequantize over mesh `axis`.
+
+    Must run under shard_map manual over `axis` (TrainStep's grad_comm
+    wiring does this; call directly only inside your own shard_map).
+    `axis_size` is the static mesh extent D — the clip level 127//D makes
+    the code-sum overflow-free, so ONE int8 psum replaces the f32 ring.
+    Returns the mean (default) or sum over shards, in x's dtype/shape.
+    """
+    levels = max(127 // int(axis_size), 1)
+    flat = x.reshape(-1).astype(jnp.float32)
+    q, n = _pad_to_chunks(flat, chunk)
+    amax = jnp.max(jnp.abs(q), axis=1)
+    gmax = lax.pmax(amax, axis)           # tiny f32 AR: shared scales
+    scales = jnp.maximum(gmax, _EPS) / levels
+    q = q / scales[:, None]
+    if stochastic:
+        if key is None:
+            raise ValueError("stochastic rounding needs a PRNG key")
+        q = jnp.floor(q + jax.random.uniform(key, q.shape))
+    else:
+        q = jnp.round(q)
+    codes = jnp.clip(q, -levels, levels).astype(jnp.int8)
+    total = lax.psum(codes, axis)         # the int8 all-reduce
+    div = float(axis_size) if mean else 1.0
+    out = (total.astype(jnp.float32) * (scales / div)[:, None]).reshape(-1)
+    return out[:n].reshape(x.shape).astype(x.dtype)
+
+
+def default_f32_fallback(name: str, shape: Sequence[int]) -> bool:
+    """The default norm-sensitive-leaf rule: keep 0/1-d params (layernorm
+    scales/biases, bias vectors) in f32 gradient sync; quantize every
+    matrix/embedding.  Falling back embeddings too would sink the wire
+    ratio below the 3.5x gate on embedding-heavy models — add them
+    explicitly via `grad_comm_f32_fallback` if their grads prove
+    norm-sensitive in YOUR run."""
+    return len(shape) <= 1
+
+
+def build_comm_groups(param_names: Sequence[str],
+                      param_shapes: Sequence[Sequence[int]],
+                      grad_groups: Sequence[Tuple[str, Sequence[int]]],
+                      f32_fallback: Optional[Callable[[str, Sequence[int]],
+                                                      bool]] = None):
+    """Host-side bucketing plan for per-layer-group gradient sync.
+
+    grad_groups is `debugging.grad_layer_groups()` output: [(layer_path,
+    param_indices)] covering every param.  Returns [(path, quant_idxs,
+    f32_idxs)] — per group, which leaves ride the int8 psum vs the f32
+    fallback.  Static (shapes/names only), so the jitted step closes over
+    it without retracing.
+    """
+    fb = f32_fallback or default_f32_fallback
+    plan = []
+    for path, idxs in grad_groups:
+        q_idxs = [i for i in idxs
+                  if not fb(param_names[i], tuple(param_shapes[i]))]
+        f_idxs = [i for i in idxs if i not in set(q_idxs)]
+        plan.append((path, tuple(q_idxs), tuple(f_idxs)))
+    return plan
+
+
+def comm_group_stats(plan, param_shapes) -> dict:
+    """Static wire accounting for a build_comm_groups plan: element counts
+    per lane, and the expected f32-twin vs int8 all-reduce byte ratio
+    (ring terms; scale pmax traffic included)."""
+    n_q = sum(int(np.prod(param_shapes[i]) or 1)
+              for _, qs, _ in plan for i in qs)
+    n_f = sum(int(np.prod(param_shapes[i]) or 1)
+              for _, _, fs in plan for i in fs)
+    total = n_q + n_f
+    f32_bytes = 2 * 4 * total
+    int8_bytes = (2 * 1 * n_q + 2 * 4 * -(-n_q // DEFAULT_CHUNK)
+                  + 2 * 4 * n_f)
+    return {"groups": len(plan), "quant_elems": n_q, "f32_elems": n_f,
+            "f32_twin_bytes": f32_bytes, "int8_bytes": int8_bytes,
+            "ratio": f32_bytes / max(int8_bytes, 1)}
+
+
+def sync_grad_groups(grads: List, plan, axis: str, axis_size: int,
+                     chunk: int = DEFAULT_CHUNK, stochastic: bool = False,
+                     key=None, mean: bool = True) -> List:
+    """Per-layer-group gradient sync inside shard_map manual over `axis`.
+
+    Per group: the quantizable leaves concatenate into ONE int8_psum (one
+    s8 all-reduce per layer group — the per-layer collectives XLA's
+    latency-hiding scheduler overlaps with backward), the fallback leaves
+    into one f32 pmean/psum.  Leaves return in their original positions,
+    dtypes preserved.
+    """
+    out = list(grads)
+    for gi, (path, q_idxs, f_idxs) in enumerate(plan):
+        if q_idxs:
+            parts = [grads[i].reshape(-1).astype(jnp.float32)
+                     for i in q_idxs]
+            cat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            k = None
+            if stochastic:
+                if key is None:
+                    raise ValueError("stochastic rounding needs a PRNG key")
+                k = jax.random.fold_in(key, gi)
+            synced = int8_psum(cat, axis, axis_size, chunk=chunk,
+                               stochastic=stochastic, key=k, mean=mean)
+            off = 0
+            for i in q_idxs:
+                n = int(np.prod(grads[i].shape) or 1)
+                out[i] = synced[off:off + n].reshape(
+                    grads[i].shape).astype(grads[i].dtype)
+                off += n
+        if f_idxs:
+            parts = [grads[i].reshape(-1).astype(jnp.float32)
+                     for i in f_idxs]
+            cat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            red = lax.pmean(cat, axis) if mean else lax.psum(cat, axis)
+            off = 0
+            for i in f_idxs:
+                n = int(np.prod(grads[i].shape) or 1)
+                out[i] = red[off:off + n].reshape(
+                    grads[i].shape).astype(grads[i].dtype)
+                off += n
+    return out

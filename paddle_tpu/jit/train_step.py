@@ -81,7 +81,10 @@ class TrainStep:
     def __init__(self, model, optimizer, loss_fn: Callable, mesh: Optional[Mesh] = None,
                  data_axes=("dp",), donate: bool = True, grad_accum_steps: int = 1,
                  monitor=None, numerics=None, scaler=None, lint=None,
-                 preemption=None, chaos=None, timeline=None, memz=None):
+                 preemption=None, chaos=None, timeline=None, memz=None,
+                 grad_comm: Optional[str] = None, grad_comm_chunk: int = 256,
+                 grad_comm_stochastic: bool = False,
+                 grad_comm_f32_fallback: Optional[Callable] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -135,6 +138,51 @@ class TrainStep:
 
         if numerics is not None:
             self.set_numerics(numerics)
+
+        # explicit gradient-sync modes (ISSUE 20): None keeps the
+        # partitioner's implicit f32 psum; "f32"/"int8" step OUT of
+        # auto-sharding into a shard_map over the dp axis with one
+        # collective per `_grad_groups` layer bucket — per-layer so the
+        # latency-hiding scheduler overlaps them with backward, int8 with
+        # per-chunk factored scales for the ~4x wire cut (EQuARX).
+        self.grad_comm = grad_comm
+        self.grad_comm_chunk = int(grad_comm_chunk)
+        self.grad_comm_stochastic = bool(grad_comm_stochastic)
+        self._comm_groups = None
+        if grad_comm is not None:
+            if grad_comm not in ("f32", "int8"):
+                raise ValueError(f"grad_comm={grad_comm!r}: expected None, "
+                                 "'f32' or 'int8'")
+            if mesh is None:
+                raise ValueError("grad_comm requires TrainStep(mesh=...) — "
+                                 "there is no gradient sync to replace "
+                                 "without a data-parallel mesh")
+            if len(data_axes) != 1 or tuple(mesh.axis_names) != tuple(data_axes):
+                raise ValueError(
+                    f"grad_comm needs a pure data-parallel mesh whose only "
+                    f"axis is {data_axes!r} (got mesh axes "
+                    f"{tuple(mesh.axis_names)}): partial-manual shard_map "
+                    "lowers through PartitionId, which this runtime's "
+                    "partitioner rejects")
+            if grad_accum_steps > 1:
+                raise ValueError("grad_comm with grad_accum_steps>1 is not "
+                                 "supported yet — the accumulation scan "
+                                 "would need the sync inside its body")
+            if not self._grad_groups:
+                from ..debugging import grad_layer_groups
+                self._grad_groups = grad_layer_groups(
+                    self._param_names, type(model).__name__)
+            from ..distributed.quant_collectives import build_comm_groups
+            shapes = [tuple(p.shape) for p in self._params]
+            if grad_comm == "int8":
+                self._comm_groups = build_comm_groups(
+                    self._param_names, shapes, self._grad_groups,
+                    grad_comm_f32_fallback)
+            else:
+                # "f32": same per-layer-group bucketing, every leaf on the
+                # f32 lane — isolates the overlap effect from quantization
+                self._comm_groups = [(path, (), tuple(idxs))
+                                     for path, idxs in self._grad_groups]
 
         # static analysis (analysis.GraphLint): True/"error"/GraphLint —
         # the step's pure function is audited ABSTRACTLY (no execution)
@@ -328,6 +376,15 @@ class TrainStep:
         scaler = self._scaler
         grad_groups = self._grad_groups
         act_paths_box = self._act_paths
+        grad_comm = self.grad_comm
+        comm_groups = self._comm_groups
+        if grad_comm is not None:
+            from ..distributed import quant_collectives as _qc
+            comm_axis = self.data_axes[0]
+            comm_D = int(self.mesh.shape[comm_axis])
+            comm_chunk = self.grad_comm_chunk
+            comm_stoch = self.grad_comm_stochastic
+            comm_mesh = self.mesh
         if numerics is not None or scaler is not None:
             from ..debugging import sentinel as _sentinel
         else:
@@ -358,7 +415,43 @@ class TrainStep:
                 scaled = loss_arr * scale if scale is not None else loss_arr
                 return scaled, (loss_arr, act_rows)
 
-            if accum == 1:
+            if accum == 1 and grad_comm is not None:
+                # explicit gradient sync (ISSUE 20): shard_map manual over
+                # the dp axis — per-shard backward on the local microbatch,
+                # then one collective per layer group (int8 psum with
+                # per-chunk scales, or the f32 twin), so the scheduler can
+                # overlap group N's all-reduce with layer N-1's backward
+                from jax import shard_map as _shard_map
+                from jax import lax as _lax
+
+                def _shard_step(pa, b, k):
+                    # the region is MANUAL over the dp axis: the model's
+                    # activation shard_constraints (global-mesh specs) are
+                    # illegal here — and on the pure-dp mesh grad_comm
+                    # requires they pin nothing the manual region doesn't
+                    # already fix, so trace the loss with no active mesh
+                    from ..distributed import mesh as _dmesh
+                    with _dmesh.mesh_scope(None):
+                        (_, (l, rows)), g = jax.value_and_grad(
+                            loss_of, has_aux=True)(list(pa), b, k)
+                    sk = jax.random.fold_in(k, 0x5C) if comm_stoch else None
+                    g = _qc.sync_grad_groups(
+                        g, comm_groups, comm_axis, comm_D,
+                        chunk=comm_chunk, stochastic=comm_stoch, key=sk)
+                    l = _lax.pmean(l, comm_axis)
+                    if rows is not None:
+                        rows = _lax.pmean(rows, comm_axis)
+                    return l, rows, g
+
+                bspec = jax.tree.map(
+                    lambda a: P(comm_axis) if getattr(a, "ndim", 0) > 0
+                    else P(), batch)
+                loss, act_rows, grads = _shard_map(
+                    _shard_step, mesh=comm_mesh, axis_names={comm_axis},
+                    in_specs=(P(), bspec, P()),
+                    out_specs=(P(), P(), [P()] * len(params)),
+                    check_vma=False)(list(param_arrays), batch, key)
+            elif accum == 1:
                 (_, (loss, act_rows)), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(list(param_arrays), batch, key)
             else:

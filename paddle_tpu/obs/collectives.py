@@ -77,16 +77,46 @@ class CollectiveLedger:
         from ..profiler.trace_analysis import analyze
         return cls.from_analysis(analyze(path_or_events, steps=steps))
 
+    @classmethod
+    def from_static(cls, rows: List[dict], steps: Optional[int] = None
+                    ) -> "CollectiveLedger":
+        """Wrap a STATIC collective inventory
+        (analysis.sharding.collective_inventory / TrainStep.comm_audit
+        rows) in the ledger's reporting surface: same table and gauges —
+        including the wire-dtype column and the bytes-by-dtype split the
+        int8 gradient sync is judged on — with the clock columns rendered
+        as '-' (nothing ran)."""
+        return cls(rows, steps=steps)
+
     # ---------------------------------------------------------- reporting
     def totals(self) -> dict:
-        busy = sum(r["busy_us"] for r in self.rows)
-        exposed = sum(r["exposed_us"] for r in self.rows)
+        # static inventory rows carry no clock — their busy/exposed is
+        # None, not 0 (nothing ran), so the sums skip them
+        busy = sum(r["busy_us"] for r in self.rows
+                   if r.get("busy_us") is not None)
+        exposed = sum(r["exposed_us"] for r in self.rows
+                      if r.get("exposed_us") is not None)
         nbytes = [r["bytes"] for r in self.rows if r["bytes"] is not None]
         return {"collectives": len(self.rows),
                 "busy_us": busy,
                 "exposed_us": exposed,
                 "exposed_frac": exposed / busy if busy else 0.0,
                 "bytes": sum(nbytes) if nbytes else None}
+
+    def by_dtype(self) -> Dict[str, dict]:
+        """{wire_dtype: {"calls", "bytes"}} over rows that carry a dtype
+        (static inventory rows; runtime trace rows don't) — the
+        int8-vs-f32 gradient-sync split as one aggregation."""
+        out: Dict[str, dict] = {}
+        for r in self.rows:
+            dt = r.get("dtype")
+            if not dt:
+                continue
+            g = out.setdefault(dt, {"calls": 0, "bytes": 0})
+            g["calls"] += int(r.get("calls", 1))
+            if r.get("bytes") is not None:
+                g["bytes"] += int(r["bytes"])
+        return out
 
     def summary(self) -> dict:
         return {"rows": [dict(r) for r in self.rows],

@@ -132,10 +132,15 @@ def _overlap_us(a: List[Tuple[float, float]],
 # "paddle_tpu_comm" -> the standalone ledger block); two copies of the
 # (name, help, getter) tuples had already drifted help-text-wise
 _COLLECTIVE_SERIES = (
+    # clock getters are None-safe: static inventory rows (from_static
+    # ledgers) carry bytes/dtype but no timing — labeled_gauge_lines
+    # drops the None samples
     ("collective_seconds", "device seconds per collective op",
-     lambda r: r["dur_us"] / 1e6),
+     lambda r: r["dur_us"] / 1e6 if r.get("dur_us") is not None else None),
     ("collective_exposed_seconds", "collective seconds NOT hidden under "
-     "compute — the wall the step pays", lambda r: r["exposed_us"] / 1e6),
+     "compute — the wall the step pays",
+     lambda r: r["exposed_us"] / 1e6
+     if r.get("exposed_us") is not None else None),
     ("collective_bytes", "bytes moved per collective op",
      lambda r: r.get("bytes")),
     ("collective_bus_gbps", "achieved bus bandwidth per collective op",
@@ -151,6 +156,18 @@ def collective_series_lines(rows: List[dict], prefix: str) -> List[str]:
         lines += labeled_gauge_lines(
             prefix, name, "op", [(r["name"], get(r)) for r in rows],
             help_)
+    # wire-dtype split (ISSUE 20): rows that carry a dtype (static
+    # inventory — e.g. the s8 vs f32 gradient-sync lanes) additionally
+    # aggregate their bytes per dtype, so the int8 wire cut is one gauge
+    by_dt: Dict[str, int] = {}
+    for r in rows:
+        dt, b = r.get("dtype"), r.get("bytes")
+        if dt and b is not None:
+            by_dt[dt] = by_dt.get(dt, 0) + int(b)
+    lines += labeled_gauge_lines(
+        prefix, "collective_bytes_by_dtype", "dtype",
+        sorted(by_dt.items()),
+        "bytes moved per collective wire dtype")
     return lines
 
 
@@ -167,7 +184,7 @@ def format_collective_rows(rows: List[dict],
     div = max(steps or 1, 1)
     unit = "ms/step" if steps else "ms"
     lines = [f"{unit:>10}  {'exposed':>9}  {'hidden%':>7}  {'calls':>6}  "
-             f"{'MB':>9}  {'GB/s':>7}  op"]
+             f"{'MB':>9}  {'GB/s':>7}  {'dtype':>6}  op"]
     for r in rows[:top]:
         mb = f"{r['bytes'] / 1e6:9.2f}" if r["bytes"] is not None \
             else f"{'-':>9}"
@@ -179,8 +196,11 @@ def format_collective_rows(rows: List[dict],
             if r["exposed_us"] is not None else f"{'-':>9}"
         hidden = f"{(1.0 - r['exposed_frac']) * 100.0:7.1f}" \
             if r["exposed_frac"] is not None else f"{'-':>7}"
+        # the int8-vs-f32 wire split (ISSUE 20): static inventory rows
+        # carry the collective's wire dtype; runtime trace rows print '-'
+        dt = f"{(r.get('dtype') or '-')[:6]:>6}"
         lines.append(f"{dur}  {exp}  {hidden}  {r['calls']:6d}  "
-                     f"{mb}  {bus}  {r['name'][:70]}")
+                     f"{mb}  {bus}  {dt}  {r['name'][:70]}")
     return lines
 
 
@@ -223,6 +243,29 @@ class TraceAnalysis:
 
         raw = [e for e in events
                if e.get("ph") == "X" and "dur" in e and is_device_op(e)]
+        # host-lane fallback (ISSUE 20): a CPU-backend capture has no
+        # device pid at all ("/host:CPU" only), but the XLA CPU client's
+        # execution threads (tf_XLATfrtCpuClient/...) carry real per-thunk
+        # op events — all-reduce, dot, fusion — so overlap/exposed-time
+        # stays measurable on the host platform. Runtime bookkeeping
+        # envelopes are dropped (they span whole executions and would
+        # count every op as "overlapped with compute").
+        self.host_lanes = False
+        if not raw:
+            _skip_host = ("ThreadpoolListener", "ThunkExecutor",
+                          "ExecuteHelper", "Dispatch", "CopyToDevice",
+                          "Execute")
+
+            def is_host_xla_op(e):
+                _, tname = lane_of(e)
+                if not tname.startswith("tf_XLA"):
+                    return False
+                return not any(k in e.get("name", "") for k in _skip_host)
+
+            raw = [e for e in events
+                   if e.get("ph") == "X" and "dur" in e
+                   and is_host_xla_op(e)]
+            self.host_lanes = bool(raw)
         if raw and window != (0.0, 1.0):
             t0 = min(e["ts"] for e in raw)
             t1 = max(e["ts"] + e["dur"] for e in raw)
@@ -329,7 +372,11 @@ class TraceAnalysis:
                          "overlapped_us": ovl, "exposed_us": exposed,
                          "exposed_frac": exposed / busy if busy else 0.0,
                          "bytes": nbytes,
-                         "bus_gbps": bus})
+                         "bus_gbps": bus,
+                         # wire dtype: traces don't carry it (the static
+                         # inventory's rows do) — schema parity with
+                         # collective_inventory for the shared renderers
+                         "dtype": None})
         rows.sort(key=lambda r: (-r["exposed_us"], -r["busy_us"]))
         return rows
 

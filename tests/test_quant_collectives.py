@@ -1,0 +1,337 @@
+"""Quantized gradient all-reduce (ISSUE 20): the int8 factored-scale
+sync and its gates.
+
+Covers: the quantize/dequantize round-trip oracle (per-element error
+bounded by half a chunk scale; stochastic rounding unbiased), the
+overflow-free int8_psum against the f32 pmean oracle on the 8-device
+host mesh, the f32-fallback classifier and the comm-group bucketing
+math, the dtype-qualified CommPlan specs (comm_extra / comm_bytes /
+comm_missing, dict-spec validation), TrainStep(grad_comm=...) precondition
+errors, convergence parity of the int8 step against its f32 twin with
+the f32 twin bit-identical to the implicit-psum baseline, the static
+sync-bytes ratio + train_comm_plan default-deny, and the collective
+ledger's wire-dtype surface (from_static / by_dtype / host-lane trace
+fallback)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.analysis import (CommPlan, CommPlanError, rows_by_kind,
+                                 train_comm_plan)
+from paddle_tpu.distributed.quant_collectives import (
+    build_comm_groups, comm_group_stats, default_f32_fallback,
+    dequantize_chunked, int8_psum, quantize_chunked)
+
+SDS = jax.ShapeDtypeStruct
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device host mesh")
+
+
+# ------------------------------------------------- quantization oracle
+
+def test_quantize_roundtrip_error_bound():
+    """Deterministic round-trip: every element lands within half its
+    chunk's scale (round-to-nearest, no clipping inside the amax)."""
+    rng = np.random.RandomState(0)
+    x = (rng.randn(512) * rng.uniform(0.01, 10.0, 512)).astype(np.float32)
+    codes, scales = quantize_chunked(jnp.asarray(x), chunk=128)
+    assert codes.shape == (4, 128) and codes.dtype == jnp.int8
+    assert scales.shape == (4,)
+    y = np.asarray(dequantize_chunked(codes, scales, x.size,
+                                      shape=x.shape))
+    bound = np.repeat(np.asarray(scales), 128) / 2 + 1e-7
+    assert np.all(np.abs(y - x) <= bound)
+    # zeros quantize exactly; padding never leaks into the round-trip
+    z = jnp.zeros((37,), jnp.float32)
+    zc, zs = quantize_chunked(z, chunk=16)
+    assert np.all(np.asarray(dequantize_chunked(zc, zs, 37)) == 0.0)
+
+
+def test_quantize_stochastic_rounding_unbiased():
+    """E[stochastic round-trip] = x: averaging many independent keys
+    converges on the input well below the deterministic step size."""
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.uniform(-1.0, 1.0, 64).astype(np.float32))
+    keys = jax.random.split(jax.random.PRNGKey(0), 512)
+
+    def rt(k):
+        c, s = quantize_chunked(x, chunk=64, stochastic=True, key=k)
+        return dequantize_chunked(c, s, x.size)
+
+    ys = np.asarray(jax.vmap(rt)(keys))
+    scale = float(jnp.max(jnp.abs(x))) / 127
+    # each draw is within one step of x (floor(q+u) vs q) ...
+    assert np.max(np.abs(ys - np.asarray(x))) <= scale + 1e-7
+    # ... and the mean is unbiased: sem = scale/sqrt(12*512), take 6x
+    assert np.max(np.abs(ys.mean(0) - np.asarray(x))) <= 6 * scale / 78
+
+
+def test_default_f32_fallback_is_ndim_le_1():
+    assert default_f32_fallback("gpt.h.0.ln_1.weight", (64,))
+    assert default_f32_fallback("gpt.h.0.ln_1.bias", (64,))
+    assert default_f32_fallback("scalar", ())
+    # matrices — embeddings included — quantize by default: falling
+    # embeddings back to f32 sinks the wire-bytes ratio below the gate
+    assert not default_f32_fallback("gpt.wte.weight", (128, 64))
+    assert not default_f32_fallback("gpt.h.0.mlp.up.weight", (64, 256))
+
+
+def test_build_comm_groups_and_wire_stats():
+    names = ["wte.weight", "h.0.ln.weight", "h.0.mlp.w"]
+    shapes = [(128, 64), (64,), (64, 64)]
+    groups = [("emb", [0]), ("h.0", [1, 2])]
+    plan = build_comm_groups(names, shapes, groups)
+    assert plan == [("emb", (0,), ()), ("h.0", (2,), (1,))]
+    st = comm_group_stats(plan, shapes)
+    n_q, n_f = 128 * 64 + 64 * 64, 64
+    assert st["quant_elems"] == n_q and st["f32_elems"] == n_f
+    # ring terms: f32 twin 2*4B/elem; int8 2*1B/elem + per-chunk scales
+    assert st["f32_twin_bytes"] == 2 * 4 * (n_q + n_f)
+    chunks = -(-128 * 64 // 256) + -(-64 * 64 // 256)
+    assert st["int8_bytes"] == 2 * n_q + 2 * 4 * chunks + 2 * 4 * n_f
+    assert st["ratio"] == pytest.approx(
+        st["f32_twin_bytes"] / st["int8_bytes"])
+
+
+@needs_mesh
+def test_int8_psum_close_to_f32_mean():
+    """The overflow-free recipe on a real 8-way mesh: shared pmax'd
+    scales, codes bounded by 127//8, dequantized mean within half a
+    scale of the exact f32 pmean."""
+    from jax import shard_map
+    mesh = dist.build_mesh({"dp": 8})
+    x = np.random.RandomState(2).randn(8, 37).astype(np.float32)
+
+    def f(xs):
+        xs = xs[0]
+        return (int8_psum(xs, "dp", 8, chunk=16)[None],
+                jax.lax.pmean(xs, "dp")[None])
+
+    q, m = shard_map(f, mesh=mesh, axis_names={"dp"},
+                     in_specs=(P("dp", None),), out_specs=(P(), P()),
+                     check_vma=False)(x)
+    scale = np.abs(x).max() / (127 // 8)
+    assert np.max(np.abs(np.asarray(q) - np.asarray(m))) <= scale / 2 + 1e-6
+
+
+# ------------------------------------------- dtype-qualified CommPlan
+
+def _static_rows(f32_bytes=64, with_s8=True, extra_kind=None):
+    rows = []
+    if with_s8:
+        rows.append({"name": "all-reduce.1", "kind": "all-reduce",
+                     "dtype": "s8", "bytes": 1000, "calls": 1})
+    rows.append({"name": "all-reduce.2", "kind": "all-reduce",
+                 "dtype": "f32", "bytes": f32_bytes, "calls": 1})
+    if extra_kind:
+        rows.append({"name": f"{extra_kind}.3", "kind": extra_kind,
+                     "dtype": "f32", "bytes": 64, "calls": 1})
+    return rows
+
+
+def test_rows_by_kind_dtype_split():
+    got = rows_by_kind(_static_rows(), by_dtype=True)
+    assert set(got) == {"all-reduce:s8", "all-reduce:f32"}
+    assert got["all-reduce:s8"]["kind"] == "all-reduce"
+    assert got["all-reduce:s8"]["dtype"] == "s8"
+    # rows without a dtype column fall back to the bare kind
+    got = rows_by_kind([{"name": "all-reduce.9"}], by_dtype=True)
+    assert set(got) == {"all-reduce"}
+
+
+def test_int8_plan_compliant_and_default_deny():
+    plan = train_comm_plan(4, dtype="int8", max_f32_bytes=128)
+    assert not plan.check(_static_rows())
+    # an f32 all-reduce above the side-channel cap = the gradient sync
+    # sneaking back in f32 — fails as comm_bytes
+    fs = plan.check(_static_rows(f32_bytes=4096))
+    assert [f.code for f in fs] == ["comm_bytes"]
+    # no s8 sync at all: the quantized path never lowered
+    fs = plan.check(_static_rows(with_s8=False))
+    assert "comm_missing" in [f.code for f in fs]
+    # any other kind stays default-denied
+    fs = plan.check(_static_rows(extra_kind="all-gather"))
+    assert "comm_extra" in [f.code for f in fs]
+    with pytest.raises(CommPlanError):
+        plan.verify(_static_rows(f32_bytes=4096), executable="ts")
+
+
+def test_qualified_only_plan_rejects_other_dtype():
+    plan = CommPlan({"all-reduce:s8": "+"})
+    fs = plan.check(_static_rows())
+    assert [f.code for f in fs] == ["comm_extra"]
+    assert fs[0].data["dtype"] == "f32"
+
+
+def test_plan_spec_validation():
+    with pytest.raises(ValueError):
+        CommPlan({"all-reduce": {"calls": "+", "max_bytes": -1}})
+    with pytest.raises(ValueError):
+        CommPlan({"all-reduce": {"calls": "+", "surprise": 1}})
+    with pytest.raises(ValueError):
+        train_comm_plan(4, dtype="int4")
+    # the f32 plan stays the classic bare default-deny
+    assert set(train_comm_plan(dtype="f32").expect) == {"all-reduce"}
+
+
+# ------------------------------------------------- TrainStep wiring
+
+def _tiny_gpt(mesh, grad_comm, **kw):
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=128,
+                    intermediate_size=128, param_dtype="float32")
+    model = GPTForCausalLM(cfg)
+    model.train()
+    o = opt.AdamW(parameters=model.parameters(), learning_rate=1e-3)
+    return TrainStep(model, o, lambda ids, lab: model.loss(ids, lab),
+                     mesh=mesh, grad_comm=grad_comm, **kw)
+
+
+@needs_mesh
+def test_grad_comm_precondition_errors():
+    with pytest.raises(ValueError, match="mesh"):
+        _tiny_gpt(None, "int8")
+    with pytest.raises(ValueError, match="grad_comm"):
+        _tiny_gpt(dist.build_mesh({"dp": 8}), "int4")
+    # partial-manual shard_map is off the table on this backend: the
+    # quantized sync requires a PURE data-parallel mesh
+    with pytest.raises(ValueError, match="pure"):
+        _tiny_gpt(dist.build_mesh({"dp": 4, "mp": 2}), "int8")
+    with pytest.raises(ValueError, match="grad_accum_steps"):
+        _tiny_gpt(dist.build_mesh({"dp": 8}), "int8", grad_accum_steps=2)
+
+
+@needs_mesh
+def test_int8_static_bytes_ratio_and_plan():
+    """The static acceptance gate at pytest level: the int8 step's
+    gradient-sync all-reduce bytes sit >= 3.5x under the f32 twin, the
+    executable satisfies train_comm_plan, and the same plan REJECTS the
+    f32 twin (the default-deny cuts both ways)."""
+    mesh = dist.build_mesh({"dp": 8})
+    dist.set_mesh(mesh)
+    try:
+        ids = SDS((8, 16), "int64")
+
+        def ar_bytes(audit):
+            return sum(r.get("bytes") or 0 for r in audit.rows
+                       if r.get("kind") == "all-reduce")
+
+        twin = _tiny_gpt(mesh, "f32")
+        twin_audit = twin.sharding_audit(ids, ids)
+        ts = _tiny_gpt(mesh, "int8")
+        plan = train_comm_plan(
+            len(ts._comm_groups), dtype="int8",
+            max_f32_bytes=max(ar_bytes(twin_audit) // 8, 1))
+        audit = ts.sharding_audit(ids, ids, plan=plan)
+        assert not audit.findings.for_pass("comm_plan"), \
+            [str(f) for f in audit.findings.for_pass("comm_plan")]
+        ratio = ar_bytes(twin_audit) / ar_bytes(audit)
+        assert ratio >= 3.5, f"sync-bytes ratio {ratio:.2f} < 3.5"
+        # the twin's f32 gradient sync violates the int8 plan
+        assert plan.check(twin_audit.rows)
+    finally:
+        dist.set_mesh(None)
+
+
+@needs_mesh
+def test_int8_convergence_parity():
+    """Numerics sentinel: 4 fixed-data steps — the explicit-f32 path is
+    BIT-identical to the implicit partitioner psum, and the int8 path
+    tracks it within the sentinel bound (quantization noise only)."""
+    mesh = dist.build_mesh({"dp": 8})
+    dist.set_mesh(mesh)
+    try:
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(
+            1, 128, (8, 16)).astype("int64"))
+
+        def losses(mode):
+            paddle.seed(7)
+            ts = _tiny_gpt(mesh, mode)
+            return [float(ts(ids, ids)) for _ in range(4)]
+
+        base = losses(None)
+        f32 = losses("f32")
+        i8 = losses("int8")
+        assert f32 == base, "explicit f32 per-group sync changed numerics"
+        assert max(abs(a - b) for a, b in zip(i8, f32)) < 0.05
+        assert i8[-1] < i8[0], "int8 step is not descending"
+    finally:
+        dist.set_mesh(None)
+
+
+# ------------------------------------------------- ledger dtype surface
+
+def _ledger_rows():
+    return [{"name": "all-reduce.1", "kind": "all-reduce", "dtype": "s8",
+             "calls": 15, "bytes": 160000, "dur_us": None,
+             "busy_us": None, "overlapped_us": None, "exposed_us": None,
+             "exposed_frac": None, "bus_gbps": None},
+            {"name": "all-reduce.2", "kind": "all-reduce", "dtype": "f32",
+             "calls": 17, "bytes": 15000, "dur_us": None,
+             "busy_us": None, "overlapped_us": None, "exposed_us": None,
+             "exposed_frac": None, "bus_gbps": None}]
+
+
+def test_ledger_from_static_dtype_surface():
+    from paddle_tpu.obs.collectives import CollectiveLedger
+    led = CollectiveLedger.from_static(_ledger_rows())
+    # totals must survive clock-less static rows (None, not 0)
+    t = led.totals()
+    assert t["collectives"] == 2 and t["busy_us"] == 0
+    assert t["bytes"] == 175000
+    by = led.by_dtype()
+    assert by["s8"] == {"calls": 15, "bytes": 160000}
+    assert by["f32"] == {"calls": 17, "bytes": 15000}
+    table = led.table()
+    assert "dtype" in table and "s8" in table
+    text = led.metrics_text()
+    assert 'collective_bytes_by_dtype{dtype="s8"} 160000' in text
+
+
+def test_trace_host_lane_fallback_overlap():
+    """A CPU capture has no device pid; the analyzer falls back to the
+    XLA CPU client's execution threads and still measures real
+    overlap/exposed — runtime envelopes are dropped so they can't count
+    everything as overlapped."""
+    from paddle_tpu.profiler.trace_analysis import TraceAnalysis
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 10,
+         "args": {"name": "tf_XLATfrtCpuClient/1"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 11,
+         "args": {"name": "tf_XLATfrtCpuClient/2"}},
+        {"ph": "X", "pid": 1, "tid": 10, "name": "all-reduce.1",
+         "ts": 0.0, "dur": 100.0},
+        {"ph": "X", "pid": 1, "tid": 11, "name": "dot.1",
+         "ts": 50.0, "dur": 100.0},
+        {"ph": "X", "pid": 1, "tid": 10, "name": "ThunkExecutor::run",
+         "ts": 0.0, "dur": 1000.0},
+    ]
+    ta = TraceAnalysis(events)
+    assert ta.host_lanes
+    assert len(ta.device_events) == 2       # envelope dropped
+    ov = ta.overlap()
+    assert ov["collective_us"] == 100.0 and ov["overlapped_us"] == 50.0
+    assert ov["ratio"] == pytest.approx(0.5)
+    rows = ta.collective_rows()
+    assert rows[0]["name"].startswith("all-reduce")
+    assert rows[0]["exposed_us"] == 50.0
+    assert rows[0]["dtype"] is None          # runtime rows carry no dtype
+    # a real device lane present -> no fallback
+    ta2 = TraceAnalysis(events + [
+        {"ph": "M", "name": "process_name", "pid": 2,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 2, "tid": 1, "name": "fusion.1",
+         "ts": 0.0, "dur": 10.0}])
+    assert not ta2.host_lanes
+    assert len(ta2.device_events) == 1

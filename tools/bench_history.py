@@ -162,7 +162,11 @@ def trend_table(history: Dict[str, Dict[str, dict]],
         lines.append(f"  {key:<{width}}  " + "  ".join(cells))
         sub = []
         for metric, nd in (("step_ms", 2), ("recompiles", 0),
-                           ("steady_recompiles", 0)):
+                           ("steady_recompiles", 0),
+                           # dp scale-out rows (ISSUE 20): efficiency and
+                           # exposed collective time trend alongside tok/s
+                           ("scaling_efficiency", 3),
+                           ("overlap_ratio", 3), ("exposed_s", 4)):
             vals = [revs.get(r, {}).get(metric) for r in revisions]
             if any(v is not None for v in vals):
                 sub.append((metric, [f"{_fmt(v, nd):>12}"

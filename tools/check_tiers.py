@@ -82,7 +82,9 @@ def check(records, *, budget: float, slow_threshold: float,
           memz_seconds: float = None,
           memz_budget: float = 60.0,
           probe_seconds: float = None,
-          probe_budget: float = 90.0) -> dict:
+          probe_budget: float = 90.0,
+          comm_seconds: float = None,
+          comm_budget: float = 180.0) -> dict:
     unmarked_slow = []       # should carry `slow` but don't
     tier1 = []               # everything tier-1 actually collects
     for r in records:
@@ -158,6 +160,13 @@ def check(records, *, budget: float, slow_threshold: float,
     # small fraction of the tier cap
     probe_over = (probe_seconds is not None
                   and probe_seconds > probe_budget)
+    # the comm budget line: tools/comm_smoke.py spawns two worker
+    # processes, each compiling a toy-GPT int8-gradient-sync TrainStep
+    # on a 2-device CPU mesh, twice per worker with a state-restore
+    # replay in between (ISSUE 20) — two toy XLA compiles per worker
+    # plus the CommPlan audit must stay a small fraction of the cap
+    comm_over = (comm_seconds is not None
+                 and comm_seconds > comm_budget)
     return {
         "n_records": len(records),
         "n_tier1": len(tier1),
@@ -198,6 +207,9 @@ def check(records, *, budget: float, slow_threshold: float,
         "probe_seconds": probe_seconds,
         "probe_budget_s": probe_budget,
         "probe_over_budget": probe_over,
+        "comm_seconds": comm_seconds,
+        "comm_budget_s": comm_budget,
+        "comm_over_budget": comm_over,
         "unmarked_slow": sorted(unmarked_slow,
                                 key=lambda r: -r["duration"]),
         "slowest_tier1": sorted(tier1, key=lambda r: -r["duration"])[:10],
@@ -206,7 +218,8 @@ def check(records, *, budget: float, slow_threshold: float,
                and not obs_over and not fleet_over
                and not fleet_chaos_over and not shardlint_over
                and not sharded_serve_over and not flightrec_over
-               and not memz_over and not probe_over),
+               and not memz_over and not probe_over
+               and not comm_over),
     }
 
 
@@ -287,6 +300,13 @@ def main(argv=None) -> int:
     ap.add_argument("--probe-budget", type=float, default=90.0,
                     help="max seconds the active-probing smoke may "
                          "take on tier-1")
+    ap.add_argument("--comm-seconds", type=float, default=None,
+                    help="measured wall time of the tier-1 quantized-"
+                         "gradient-sync smoke (tools/run_tier1.sh "
+                         "records it)")
+    ap.add_argument("--comm-budget", type=float, default=180.0,
+                    help="max seconds the comm smoke may take on "
+                         "tier-1 (two 2-device worker processes)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -317,7 +337,9 @@ def main(argv=None) -> int:
                    memz_seconds=args.memz_seconds,
                    memz_budget=args.memz_budget,
                    probe_seconds=args.probe_seconds,
-                   probe_budget=args.probe_budget)
+                   probe_budget=args.probe_budget,
+                   comm_seconds=args.comm_seconds,
+                   comm_budget=args.comm_budget)
 
     if args.json:
         print(json.dumps(result, indent=2))
@@ -359,6 +381,9 @@ def main(argv=None) -> int:
         if result.get("probe_seconds") is not None:
             print(f"  probe: {result['probe_seconds']:.2f}s "
                   f"(budget {result['probe_budget_s']}s)")
+        if result.get("comm_seconds") is not None:
+            print(f"  comm: {result['comm_seconds']:.2f}s "
+                  f"(budget {result['comm_budget_s']}s)")
         if result["chaos_over_budget"]:
             print(f"  VIOLATION: chaos gate took "
                   f"{result['chaos_seconds']:.2f}s, over the "
@@ -401,6 +426,10 @@ def main(argv=None) -> int:
             print(f"  VIOLATION: active-probing smoke took "
                   f"{result['probe_seconds']:.2f}s, over the "
                   f"{result['probe_budget_s']}s probe budget")
+        if result["comm_over_budget"]:
+            print(f"  VIOLATION: quantized-gradient-sync smoke took "
+                  f"{result['comm_seconds']:.2f}s, over the "
+                  f"{result['comm_budget_s']}s comm budget")
         if result["lint_over_budget"]:
             print(f"  VIOLATION: lint pass took "
                   f"{result['lint_seconds']:.2f}s, over the "

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""comm_smoke — the tier-1 quantized-gradient-sync gate (ISSUE 20).
+
+Two processes (the r16 straggler-harness shape: each process runs the
+same single-controller SPMD program on its own 2-device host-platform
+CPU mesh) each run a toy-GPT ``TrainStep(grad_comm="int8")`` and prove,
+per process:
+
+  1. CommPlan compliance — the step's static collective inventory
+     satisfies ``train_comm_plan`` (s8 per-layer-group all-reduces
+     present, every f32 all-reduce under the side-channel byte cap);
+  2. bit-repeatable loss under a fixed seed — the run is snapshotted
+     (params + opt state + RNG), replayed, and the two loss streams must
+     be BIT-identical (quantized sync must not introduce nondeterminism);
+  3. zero steady-state recompiles — the replay adds no jit cache miss.
+
+The parent then asserts the SHARDS agree: both processes' loss streams
+must be bit-identical to each other (replicas of one SPMD program).
+
+Exit 0 = all gates hold; 1 = any violation (the violating worker's
+output is printed).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+STEPS = 3
+
+
+def worker() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import numpy as np
+    import jax  # noqa: F401  (env already pinned by main())
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.jit.api import compile_cache_misses
+    from paddle_tpu.analysis import train_comm_plan
+    import paddle_tpu.distributed as dist
+
+    shard, world = dist.shard_identity()
+    assert world == 2, f"expected a 2-process harness, got world={world}"
+    mesh = dist.build_mesh({"dp": 2})
+    dist.set_mesh(mesh)
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    intermediate_size=128, param_dtype="float32")
+    model = GPTForCausalLM(cfg)
+    model.train()
+    o = opt.AdamW(parameters=model.parameters(), learning_rate=1e-3)
+    ts = TrainStep(model, o, lambda ids, lab: model.loss(ids, lab),
+                   mesh=mesh, grad_comm="int8")
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 128, (4, 16)).astype("int64")
+
+    # gate 1: CommPlan compliance on the very executable that will run
+    plan = train_comm_plan(len(ts._comm_groups), dtype="int8")
+    audit = ts.sharding_audit(ids, ids, plan=plan)
+    plan_findings = [str(f) for f in audit.findings.for_pass("comm_plan")]
+    if plan_findings:
+        print(json.dumps({"shard": shard, "ok": False,
+                          "plan_findings": plan_findings}))
+        return 1
+
+    # materialize opt state BEFORE the snapshot so the replay restores it
+    ts._opt_state = ts._init_opt_state()
+    ts._apply_param_shardings()
+    snap = ts.state_dict()
+
+    def run():
+        paddle.seed(123)            # pins the per-step dropout/SR keys
+        return [float(ts(ids, ids)) for _ in range(STEPS)]
+
+    losses1 = run()                 # first call compiles (the one miss)
+    miss0 = compile_cache_misses()
+    ts.set_state_dict(snap)
+    losses2 = run()                 # gate 3: replay must not recompile
+    steady_misses = compile_cache_misses() - miss0
+
+    ok = losses1 == losses2 and steady_misses == 0
+    print(json.dumps({"shard": shard, "ok": ok, "losses": losses1,
+                      "replay": losses2, "steady_misses": steady_misses,
+                      "n_groups": len(ts._comm_groups)}))
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--worker", action="store_true",
+                    help="internal: run as one shard process")
+    args = ap.parse_args(argv)
+    if args.worker:
+        return worker()
+
+    here = os.path.abspath(__file__)
+    procs = []
+    for shard in range(2):
+        env = dict(os.environ,
+                   PADDLE_TPU_PROCESS_ID=str(shard),
+                   PADDLE_TPU_NUM_PROCESSES="2",
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                              + " --xla_force_host_platform_device_count=2"))
+        env.pop("PADDLE_TPU_TIER_DURATIONS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, here, "--worker"], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results, rc = [], 0
+    for p in procs:
+        out, err = p.communicate(timeout=420)
+        if p.returncode != 0:
+            print(f"comm_smoke: worker failed (exit {p.returncode}):\n"
+                  f"{out}\n{err}", file=sys.stderr)
+            rc = 1
+            continue
+        row = json.loads(out.strip().splitlines()[-1])
+        results.append(row)
+        print(f"comm_smoke: shard {row['shard']}: losses {row['losses']} "
+              f"steady_misses {row['steady_misses']}")
+    if rc:
+        return rc
+    # cross-process agreement: replicas of one SPMD program must see the
+    # same loss bit-for-bit
+    streams = {json.dumps(r["losses"]) for r in results}
+    if len(streams) != 1:
+        print(f"comm_smoke: shard loss streams DISAGREE: {streams}",
+              file=sys.stderr)
+        return 1
+    print(f"comm_smoke: PASS — plan compliant, loss bit-repeatable "
+          f"across replay and across {len(results)} processes, "
+          f"zero steady recompiles")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

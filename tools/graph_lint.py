@@ -93,11 +93,11 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 TARGETS = ("gpt-static", "gpt-paged", "gpt-paged-int8", "gpt-paged-spec",
            "train-step", "resnet50",
-           "train-step-dp", "train-step-tp", "comm-xcheck",
-           "gpt-paged-sharded")
+           "train-step-dp", "train-step-tp", "train-step-int8",
+           "comm-xcheck", "gpt-paged-sharded")
 #: targets that need the multi-device host-platform mesh
-SHARDED_TARGETS = ("train-step-dp", "train-step-tp", "comm-xcheck",
-                   "gpt-paged-sharded")
+SHARDED_TARGETS = ("train-step-dp", "train-step-tp", "train-step-int8",
+                   "comm-xcheck", "gpt-paged-sharded")
 
 FIXTURE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "tests", "fixtures",
@@ -367,6 +367,77 @@ def audit_train_step_sharded(lint, axes, plan=None, plant=False,
         dist.set_mesh(None)
 
 
+def audit_train_step_int8(lint, audits=None, min_ratio: float = 3.5):
+    """Quantized gradient-sync audit (ISSUE 20): the dp=8 tiny-GPT
+    TrainStep is built twice — the f32 twin (implicit partitioner psum)
+    and ``grad_comm="int8"`` — both statically audited, and two
+    invariants gated:
+
+      1. the int8 inventory satisfies ``train_comm_plan`` — the s8
+         per-layer-group all-reduces are present and every f32 all-reduce
+         stays under the side-channel byte cap (an eighth of the twin's
+         gradient-sync bytes): an f32 gradient all-reduce sneaking back
+         (fallback-classifier regression, shard_map bypass) fails here;
+      2. the static all-reduce bytes-per-step drop >= ``min_ratio`` vs
+         the twin (the EQuARX ~4x wire cut, measured on the very HLO that
+         will run).
+    """
+    import jax
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.analysis import Finding, Findings, train_comm_plan
+    from paddle_tpu.jit.train_step import TrainStep
+    import paddle_tpu.distributed as dist
+    mesh = dist.build_mesh({"dp": 8})
+    dist.set_mesh(mesh)
+    try:
+        ids = jax.ShapeDtypeStruct((8, 16), "int64")
+
+        def build(mode):
+            model, _ = _tiny_gpt("float32")
+            model.train()
+            o = opt.AdamW(parameters=model.parameters(),
+                          learning_rate=1e-4)
+            return TrainStep(model, o,
+                             lambda i, l: model.loss(i, l),
+                             mesh=mesh, grad_comm=mode)
+
+        def ar_bytes(audit):
+            return sum(r.get("bytes") or 0 for r in audit.rows
+                       if r.get("kind") == "all-reduce")
+
+        twin_audit = build(None).sharding_audit(ids, ids)
+        twin_b = ar_bytes(twin_audit)
+        ts = build("int8")
+        plan = train_comm_plan(len(ts._comm_groups), dtype="int8",
+                               max_f32_bytes=max(twin_b // 8, 1))
+        linter = copy.copy(lint)
+        linter.comm_plan = plan
+        audit = ts.sharding_audit(ids, ids, lint=linter)
+        findings = Findings()
+        findings.extend(audit.findings)
+        int8_b = ar_bytes(audit)
+        ratio = twin_b / max(int8_b, 1)
+        print(f"  train-step-int8: all-reduce bytes/step "
+              f"{twin_b} (f32 twin) -> {int8_b} (int8), "
+              f"ratio {ratio:.2f}x (gate >= {min_ratio}x)",
+              file=sys.stderr)
+        if ratio < min_ratio:
+            findings.add(Finding(
+                "comm_plan", "comm_bytes", "error",
+                f"int8 gradient sync moves {int8_b} all-reduce "
+                f"bytes/step vs the f32 twin's {twin_b} — only "
+                f"{ratio:.2f}x, gate requires >= {min_ratio}x "
+                f"(quantized lanes regressed or fallback grew)",
+                where="all-reduce", executable="train-step-int8",
+                data={"twin_bytes": twin_b, "int8_bytes": int8_b,
+                      "ratio": ratio, "min_ratio": min_ratio}))
+        if audits is not None and ts.comm_audit is not None:
+            audits["train-step-int8"] = ts.comm_audit
+        return findings
+    finally:
+        dist.set_mesh(None)
+
+
 def audit_comm_xcheck(rtol: float = 0.01, audits=None):
     """Static-vs-runtime cross-check (ISSUE 15 acceptance): compile the
     jitted twin of the checked-in mini-step fixture — one dp=8 grad-sync
@@ -534,6 +605,8 @@ def main(argv=None) -> int:
             lint, {"dp": 2, "mp": 4},
             plan=CommPlan({"all-reduce": "+", "all-gather": "+"}),
             plant=args.plant_reshard, audits=audits),
+        "train-step-int8": lambda: audit_train_step_int8(
+            lint, audits=audits),
         "comm-xcheck": lambda: audit_comm_xcheck(
             rtol=args.xcheck_rtol, audits=audits),
         "gpt-paged-sharded": lambda: audit_gpt_engine_sharded(

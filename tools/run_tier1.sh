@@ -103,16 +103,19 @@ fchaosrc=$?
 fchaos_secs=$(echo "$(date +%s.%N) $fchaos_t0" | awk '{printf "%.2f", $1-$2}')
 echo "fleet_chaos_smoke: ${fchaos_secs}s (exit $fchaosrc)"
 
-# sharded graph-lint smoke (ISSUE 15): the SPMD communication plan of
-# TrainStep(gpt) proven statically on an 8-device host-platform CPU mesh
-# — dp is all-reduce-only by plan, tp adds the TP all-gathers, and the
+# sharded graph-lint smoke (ISSUE 15 + 20): the SPMD communication plan
+# of TrainStep(gpt) proven statically on an 8-device host-platform CPU
+# mesh — dp is all-reduce-only by plan, tp adds the TP all-gathers,
+# train-step-int8 proves the quantized gradient sync (s8 wire dtype by
+# plan, static sync bytes >= 3.5x under the f32 twin), and the
 # comm-xcheck leg pins the static collective bytes to the checked-in
 # runtime trace fixture within 1%. graph_lint sets the XLA device-count
 # flag itself.
 shard_t0=$(date +%s.%N)
-timeout -k 10 "${TIER1_SHARDLINT_TIMEOUT:-120}" \
+timeout -k 10 "${TIER1_SHARDLINT_TIMEOUT:-180}" \
     env JAX_PLATFORMS=cpu python tools/graph_lint.py \
-    train-step-dp train-step-tp comm-xcheck > /tmp/_shardlint.log 2>&1
+    train-step-dp train-step-tp train-step-int8 comm-xcheck \
+    > /tmp/_shardlint.log 2>&1
 shardrc=$?
 [ "$shardrc" -ne 0 ] && cat /tmp/_shardlint.log
 shard_secs=$(echo "$(date +%s.%N) $shard_t0" | awk '{printf "%.2f", $1-$2}')
@@ -172,6 +175,18 @@ probrc=$?
 probe_secs=$(echo "$(date +%s.%N) $probe_t0" | awk '{printf "%.2f", $1-$2}')
 echo "probe_smoke: ${probe_secs}s (exit $probrc)"
 
+# comm smoke (ISSUE 20): two processes each running a 2-device CPU-mesh
+# toy-GPT TrainStep(grad_comm="int8") — CommPlan compliance on the
+# live executable, bit-repeatable loss across a state-restore replay
+# and across processes, zero steady-state recompiles. The harness
+# sets its own JAX_PLATFORMS/XLA_FLAGS per worker.
+comm_t0=$(date +%s.%N)
+timeout -k 10 "${TIER1_COMM_TIMEOUT:-240}" \
+    python tools/comm_smoke.py
+commrc=$?
+comm_secs=$(echo "$(date +%s.%N) $comm_t0" | awk '{printf "%.2f", $1-$2}')
+echo "comm_smoke: ${comm_secs}s (exit $commrc)"
+
 timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
     PADDLE_TPU_TIER_DURATIONS="$DUR" \
     python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
@@ -189,6 +204,7 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -c
 [ "$rc" -eq 0 ] && rc=$frecrc
 [ "$rc" -eq 0 ] && rc=$memzrc
 [ "$rc" -eq 0 ] && rc=$probrc
+[ "$rc" -eq 0 ] && rc=$commrc
 
 if [ -s "$DUR" ]; then
     python tools/check_tiers.py "$DUR" \
@@ -215,7 +231,9 @@ if [ -s "$DUR" ]; then
         --memz-seconds "$memz_secs" \
         --memz-budget "${TIER1_MEMZ_BUDGET:-60}" \
         --probe-seconds "$probe_secs" \
-        --probe-budget "${TIER1_PROBE_BUDGET:-90}"
+        --probe-budget "${TIER1_PROBE_BUDGET:-90}" \
+        --comm-seconds "$comm_secs" \
+        --comm-budget "${TIER1_COMM_BUDGET:-180}"
     crc=$?
     [ "$rc" -eq 0 ] && rc=$crc
 else
